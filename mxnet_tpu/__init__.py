@@ -1,0 +1,22 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Built new for TPU (JAX/XLA/Pallas/pjit idioms) to the blueprint in SURVEY.md;
+reference for API/behavior parity: RustyRaptor/incubator-mxnet (read-only
+snapshot).  Import convention mirrors the reference::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus,  # noqa: F401
+                      num_tpus, current_context)
+from . import ops  # noqa: F401  (registers the op corpus)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from .ndarray import NDArray  # noqa: F401

@@ -1,0 +1,93 @@
+"""``mx.nd`` namespace: NDArray + op functions generated from the registry.
+
+Reference parity: ``python/mxnet/ndarray/`` where ``op.py``/``register.py``
+codegen python functions from the C op registry at import time.  Here the
+registry is python-native, so "codegen" is building closures over OpDefs.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concat, stack, waitall, zeros_like, ones_like, _wrap)
+from ..ops.registry import OPS as _OPS, invoke as _invoke
+
+
+import inspect as _inspect
+
+
+def _param_names(opdef):
+    """Non-tensor parameter names of the op fn, in signature order."""
+    try:
+        sig = _inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        return ()
+    skip = set(opdef.input_names) | {"rng", "_train"}
+    # op attributes always have defaults in this registry; params without a
+    # default are tensor data args (x, a, b, data, …) — skip those
+    names = [p.name for p in sig.parameters.values()
+             if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+             and p.name not in skip and p.default is not p.empty]
+    return tuple(names)
+
+
+def _make_op_func(opname, opdef):
+    input_names = opdef.input_names
+
+    def f(*args, out=None, name=None, **kwargs):
+        inputs, extra_pos = [], []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif a is None and not extra_pos:
+                pass  # optional tensor slot (e.g. bias=None)
+            else:
+                extra_pos.append(a)
+        params = {k: v for k, v in kwargs.items()}
+        # inputs may be passed by name (reference kwarg convention)
+        if input_names:
+            named = []
+            for n in input_names:
+                if n in params and isinstance(params[n], NDArray):
+                    named.append(params.pop(n))
+            if named:
+                inputs = inputs + named
+        # scalar positionals map onto the op's param names in order
+        # (reference allows e.g. one_hot(indices, depth))
+        if extra_pos:
+            pnames = [n for n in _param_names(opdef) if n not in params]
+            for name_, val in zip(pnames, extra_pos):
+                params[name_] = val
+        return _invoke(opdef, inputs, params, out=out)
+
+    f.__name__ = opname
+    f.__doc__ = (opdef.fn.__doc__ or "") + "\n(op: %s)" % opdef.name
+    return f
+
+
+_mod = _sys.modules[__name__]
+for _name, _opdef in list(_OPS.items()):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_name, _opdef))
+
+# sub-namespaces mirroring the reference layout
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+
+
+def imdecode(buf, **kwargs):  # pragma: no cover - host-side opencv-free decode
+    import io
+
+    import numpy as _np
+    from PIL import Image  # type: ignore
+
+    img = _np.asarray(Image.open(io.BytesIO(buf)))
+    return array(img)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _invoke("one_hot", [indices], {"depth": depth})
+    out._set_data(res.data)
+    return out

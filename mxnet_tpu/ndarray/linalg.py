@@ -1,0 +1,81 @@
+"""``mx.nd.linalg`` namespace (reference: src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, _wrap
+
+
+def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    from ..ops.registry import invoke
+
+    return invoke("linalg_gemm2", [a, b],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b,
+                   "alpha": alpha})
+
+
+def potrf(a):
+    from ..ops.registry import invoke
+
+    return invoke("linalg_potrf", [a], {})
+
+
+def syrk(a, transpose=False, alpha=1.0):
+    from ..ops.registry import invoke
+
+    return invoke("linalg_syrk", [a], {"transpose": transpose, "alpha": alpha})
+
+
+def trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+
+    A, B = a.data, b.data
+    if rightside:
+        # X·op(A) = αB  ⇔  op(A)ᵀ·Xᵀ = αBᵀ
+        xt = jsl.solve_triangular(jnp.swapaxes(A, -1, -2),
+                                  alpha * jnp.swapaxes(B, -1, -2),
+                                  trans=1 if transpose else 0,
+                                  lower=not lower)
+        return _wrap(jnp.swapaxes(xt, -1, -2))
+    x = jsl.solve_triangular(A, alpha * B, trans=1 if transpose else 0,
+                             lower=lower)
+    return _wrap(x)
+
+
+def trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    A = a.data
+    A = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+    r = alpha * (jnp.matmul(b.data, A) if rightside else jnp.matmul(A, b.data))
+    return _wrap(r)
+
+
+def sumlogdiag(a):
+    return _wrap(jnp.sum(jnp.log(jnp.diagonal(a.data, axis1=-2, axis2=-1)),
+                         axis=-1))
+
+
+def syevd(a):
+    # reference contract (la_op syevd): U holds eigenvectors as ROWS
+    # (A = Uᵀ·diag(L)·U); jnp.linalg.eigh returns them as columns
+    w, v = jnp.linalg.eigh(a.data)
+    return _wrap(jnp.swapaxes(v, -1, -2)), _wrap(w)
+
+
+def svd(a):
+    u, s, vt = jnp.linalg.svd(a.data, full_matrices=False)
+    return _wrap(u), _wrap(s), _wrap(vt)
+
+
+def inverse(a):
+    return _wrap(jnp.linalg.inv(a.data))
+
+
+def det(a):
+    return _wrap(jnp.linalg.det(a.data))
+
+
+def slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a.data)
+    return _wrap(sign), _wrap(logdet)

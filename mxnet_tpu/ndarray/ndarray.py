@@ -1,0 +1,513 @@
+"""NDArray: the imperative tensor type, backed by ``jax.Array``.
+
+Reference parity: ``python/mxnet/ndarray/ndarray.py`` (class NDArray:177) over
+``src/ndarray/ndarray.cc`` (shape+dtype+storage chunk+engine var+autograd entry).
+TPU-native redesign: the "engine var" disappears — jax.Array is already an async
+future (dispatch returns immediately, ``wait_to_read`` = ``block_until_ready``);
+the "storage chunk" disappears — XLA owns HBM; what remains is a mutable handle
+(`_data` can be swapped, giving in-place semantics over functional updates) plus
+the autograd linkage (``_tape_entry``/``_tape_var``/``_grad``) that mirrors the
+reference's ``AGInfo entry_``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from ..context import Context, current_context
+from ..ops.registry import invoke
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "stack", "waitall"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_entry",
+                 "_tape_var", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx or current_context()
+        self._grad = None
+        self._grad_req = None
+        self._tape_entry = None
+        self._tape_var = None
+
+    # -- core -----------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    def _set_data(self, value):
+        """In-place mutation: swap the backing array (bumps the 'version')."""
+        self._data = value
+        self._tape_entry = None  # a mutated array is a fresh tape leaf
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    @property
+    def ctx(self):
+        return self._ctx
+
+    @property
+    def stype(self):
+        return "default"
+
+    # -- sync / host transfer ------------------------------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    # -- autograd -------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+
+        self._grad = _wrap(jnp.zeros(self.shape, self.dtype), self._ctx)
+        autograd.mark_variables([self], [self._grad], grad_req)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self):
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- device movement ------------------------------------------------
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        data = jax.device_put(self._data, ctx.jax_device())
+        return _wrap(data, ctx)
+
+    def as_in_ctx(self, ctx):
+        return self.as_in_context(ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other._ctx.jax_device()))
+            return other
+        if isinstance(other, Context):
+            return _wrap(jax.device_put(self._data, other.jax_device()), other)
+        raise TypeError("copyto expects NDArray or Context")
+
+    def copy(self):
+        return _wrap(self._data + 0 if self.dtype != np.bool_ else jnp.array(self._data), self._ctx)
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and dt == self.dtype:
+            return self
+        return invoke("cast", [self], {"dtype": str(dt)})
+
+    # -- shape manipulation (functional; views are copies under XLA) ----
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if "shape" in kwargs:
+            shape = tuple(kwargs["shape"])
+        return invoke("reshape", [self], {"shape": shape})
+
+    def reshape_like(self, other):
+        return invoke("reshape", [self], {"shape": other.shape})
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", [self], {"axis": axis})
+
+    def flatten(self):
+        return invoke("Flatten", [self], {})
+
+    def transpose(self, axes=None):
+        return invoke("transpose", [self], {"axes": axes})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_to", [self], {"shape": other.shape})
+
+    def tile(self, reps):
+        return invoke("tile", [self], {"reps": tuple(reps)})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("split", [self], {"num_outputs": num_outputs,
+                                        "axis": axis,
+                                        "squeeze_axis": squeeze_axis})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", [self], {"axis": axis, "begin": begin,
+                                             "end": end})
+
+    # -- reductions -----------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", [self], {"ord": ord, "axis": axis,
+                                       "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    # -- elementwise convenience ---------------------------------------
+    def abs(self):
+        return invoke("abs", [self], {})
+
+    def sqrt(self):
+        return invoke("sqrt", [self], {})
+
+    def square(self):
+        return invoke("square", [self], {})
+
+    def exp(self):
+        return invoke("exp", [self], {})
+
+    def log(self):
+        return invoke("log", [self], {})
+
+    def relu(self):
+        return invoke("relu", [self], {})
+
+    def sigmoid(self):
+        return invoke("sigmoid", [self], {})
+
+    def tanh(self):
+        return invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", [self], {"axis": axis})
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return invoke("one_hot", [self], {"depth": depth, "on_value": on_value,
+                                          "off_value": off_value})
+
+    def round(self):
+        return invoke("round", [self], {})
+
+    def floor(self):
+        return invoke("floor", [self], {})
+
+    def ceil(self):
+        return invoke("ceil", [self], {})
+
+    def sign(self):
+        return invoke("sign", [self], {})
+
+    def zeros_like(self):
+        return _wrap(jnp.zeros(self.shape, self.dtype), self._ctx)
+
+    def ones_like(self):
+        return _wrap(jnp.ones(self.shape, self.dtype), self._ctx)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError(
+                "sparse storage is handled by mxnet_tpu.ndarray.sparse")
+        return self
+
+    # -- arithmetic -----------------------------------------------------
+    def _binop(self, op, other, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(op, [a, b], {})
+        scalar = float(other) if not isinstance(other, bool) else other
+        return invoke("_scalar_" + op,
+                      [self], {"scalar": scalar, "reverse": reverse})
+
+    def __add__(self, other):
+        return self._binop("broadcast_add", other)
+
+    def __radd__(self, other):
+        return self._binop("broadcast_add", other, True)
+
+    def __sub__(self, other):
+        return self._binop("broadcast_sub", other)
+
+    def __rsub__(self, other):
+        return self._binop("broadcast_sub", other, True)
+
+    def __mul__(self, other):
+        return self._binop("broadcast_mul", other)
+
+    def __rmul__(self, other):
+        return self._binop("broadcast_mul", other, True)
+
+    def __truediv__(self, other):
+        return self._binop("broadcast_div", other)
+
+    def __rtruediv__(self, other):
+        return self._binop("broadcast_div", other, True)
+
+    def __mod__(self, other):
+        return self._binop("broadcast_mod", other)
+
+    def __pow__(self, other):
+        return self._binop("broadcast_power", other)
+
+    def __rpow__(self, other):
+        return self._binop("broadcast_power", other, True)
+
+    def __matmul__(self, other):
+        return invoke("dot", [self, other], {})
+
+    def __neg__(self):
+        return invoke("negative", [self], {})
+
+    def __abs__(self):
+        return invoke("abs", [self], {})
+
+    def __eq__(self, other):
+        return self._binop("broadcast_equal", other)
+
+    def __ne__(self, other):
+        return self._binop("broadcast_not_equal", other)
+
+    def __gt__(self, other):
+        return self._binop("broadcast_greater", other)
+
+    def __ge__(self, other):
+        return self._binop("broadcast_greater_equal", other)
+
+    def __lt__(self, other):
+        return self._binop("broadcast_lesser", other)
+
+    def __le__(self, other):
+        return self._binop("broadcast_lesser_equal", other)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, other):
+        r = self.__add__(other)
+        self._set_data(r.data)
+        return self
+
+    def __isub__(self, other):
+        r = self.__sub__(other)
+        self._set_data(r.data)
+        return self
+
+    def __imul__(self, other):
+        r = self.__mul__(other)
+        self._set_data(r.data)
+        return self
+
+    def __itruediv__(self, other):
+        r = self.__truediv__(other)
+        self._set_data(r.data)
+        return self
+
+    # -- indexing -------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, (NDArray, np.ndarray)):
+            kd = key.dtype if isinstance(key, np.ndarray) else key.dtype
+            if np.dtype(kd) == np.bool_:
+                # boolean masking has a data-dependent output shape — XLA
+                # needs static shapes; gather on host instead (no tape)
+                mask = key if isinstance(key, np.ndarray) else key.asnumpy()
+                return _wrap(jnp.asarray(self.asnumpy()[mask.astype(bool)]),
+                             self._ctx)
+            if isinstance(key, np.ndarray):
+                key = array(key)
+            # integer-array indexing along axis 0 -> differentiable take
+            return invoke("take", [self, key], {"axis": 0, "mode": "clip"})
+        from ..ops.tensor import _encode_index
+
+        try:
+            enc = _encode_index(key)
+            hash(enc)
+        except TypeError:
+            return _wrap(self._data[key], self._ctx)  # exotic index: no tape
+        return invoke("_getitem", [self], {"key": enc})
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value.data
+        elif isinstance(value, (int, float)):
+            pass
+        else:
+            value = jnp.asarray(value)
+        if isinstance(key, NDArray):
+            key = key.asnumpy().astype(np.int64)
+        if isinstance(key, slice) and key == slice(None):
+            if isinstance(value, (int, float)):
+                self._set_data(jnp.full(self.shape, value, self.dtype))
+            else:
+                self._set_data(jnp.broadcast_to(value, self.shape).astype(self.dtype))
+            return
+        self._set_data(self._data.at[key].set(value))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(s) for s in self.shape), self._ctx)
+
+
+def _wrap(data, ctx=None):
+    return NDArray(data, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# creation functions
+# ---------------------------------------------------------------------------
+def _put(x, ctx):
+    ctx = ctx or current_context()
+    return jax.device_put(x, ctx.jax_device())
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    # reference semantics: dtype comes from an ndarray source, else float32
+    if dtype is None and not isinstance(source_array, np.ndarray):
+        dtype = np.float32
+    a = np.asarray(source_array, dtype=np_dtype(dtype) if dtype else None)
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)  # float64 unsupported on TPU; default f32
+    ctx = ctx or current_context()
+    return _wrap(_put(a, ctx), ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    return _wrap(_put(jnp.zeros(shape, np_dtype(dtype)), ctx), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    return _wrap(_put(jnp.ones(shape, np_dtype(dtype)), ctx), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    return _wrap(_put(jnp.full(shape, val, np_dtype(dtype)), ctx), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    a = np.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        a = np.repeat(a, repeat)
+    ctx = ctx or current_context()
+    return _wrap(_put(a, ctx), ctx)
+
+
+def concat(*arrays, dim=1):
+    return invoke("Concat", list(arrays), {"dim": dim})
+
+
+def stack(*arrays, axis=0):
+    return invoke("stack", list(arrays), {"axis": axis})
+
+
+def waitall():
+    """Block until all async work completes (reference: mx.nd.waitall)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def zeros_like(a):
+    return a.zeros_like()
+
+
+def ones_like(a):
+    return a.ones_like()

@@ -1,0 +1,102 @@
+"""``mx.nd.random`` namespace (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..ops.registry import invoke as _invoke
+from .ndarray import NDArray
+
+
+def _shape(shape):
+    if shape is None:
+        return (1,)
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None,
+            **kwargs):
+    if isinstance(low, NDArray):
+        s = () if shape is None else _shape(shape)
+        return _invoke("_sample_uniform", [low, high], {"shape": s}, out=out)
+    return _invoke("_random_uniform", [],
+                   {"low": low, "high": high, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None,
+           **kwargs):
+    if isinstance(loc, NDArray):
+        s = () if shape is None else _shape(shape)
+        return _invoke("_sample_normal", [loc, scale], {"shape": s}, out=out)
+    return _invoke("_random_normal", [],
+                   {"loc": loc, "scale": scale, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def randn(*shape, dtype="float32", ctx=None, **kwargs):
+    return normal(0.0, 1.0, shape=shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    if isinstance(alpha, NDArray):
+        beta_nd = beta if isinstance(beta, NDArray) else alpha.ones_like() * beta
+        return _invoke("_sample_gamma", [alpha, beta_nd],
+                       {"shape": () if shape is None else _shape(shape)},
+                       out=out)
+    return _invoke("_random_gamma", [],
+                   {"alpha": alpha, "beta": beta, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    if isinstance(scale, NDArray):
+        lam = 1.0 / scale
+        return _invoke("_sample_exponential", [lam],
+                       {"shape": () if shape is None else _shape(shape)},
+                       out=out)
+    return _invoke("_random_exponential", [],
+                   {"lam": 1.0 / scale, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    if isinstance(lam, NDArray):
+        return _invoke("_sample_poisson", [lam],
+                       {"shape": () if shape is None else _shape(shape),
+                        "dtype": dtype}, out=out)
+    return _invoke("_random_poisson", [],
+                   {"lam": lam, "shape": _shape(shape), "dtype": dtype},
+                   out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      out=None):
+    if isinstance(k, NDArray) or isinstance(p, NDArray):
+        raise NotImplementedError(
+            "tensor-parameter sampling for negative_binomial is not "
+            "implemented; pass python scalars")
+    return _invoke("_random_negative_binomial", [],
+                   {"k": k, "p": p, "shape": _shape(shape), "dtype": dtype},
+                   out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None):
+    return _invoke("_random_generalized_negative_binomial", [],
+                   {"mu": mu, "alpha": alpha, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return _invoke("_random_randint", [],
+                   {"low": low, "high": high, "shape": _shape(shape),
+                    "dtype": dtype}, out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
+    return _invoke("_sample_multinomial", [data],
+                   {"shape": shape, "get_prob": get_prob, "dtype": dtype})
+
+
+def shuffle(data, **kwargs):
+    return _invoke("_shuffle", [data], {})

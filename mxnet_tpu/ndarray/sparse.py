@@ -1,0 +1,108 @@
+"""Sparse NDArray API (row_sparse / csr).
+
+Reference parity: ``python/mxnet/ndarray/sparse.py`` over ``kRowSparseStorage``
+/ ``kCSRStorage`` chunks.  TPU-native design decision (SURVEY.md §7 hard part
+b): XLA has no native sparse storage, so these types keep the *API* and the
+(indices, values) construction/inspection surface, while compute lowers to
+dense gather/scatter — which on TPU is usually faster than true sparse for the
+embedding-gradient workloads row_sparse served.  Memory-bound huge-vocab cases
+are a documented scope cut this round.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, _wrap, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Dense-backed row_sparse: keeps .indices/.data views for API parity."""
+
+    __slots__ = ("_indices",)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        nz = np.nonzero(np.abs(self.asnumpy()).reshape(self.shape[0], -1)
+                        .sum(axis=1))[0]
+        return _dense_array(nz.astype(np.int64), dtype="int64")
+
+    @property
+    def values(self):
+        idx = self.indices.asnumpy().astype(np.int64)
+        return _wrap(self._data[idx])
+
+    def tostype(self, stype):
+        if stype == "default":
+            return _wrap(self._data, self._ctx)
+        if stype == "row_sparse":
+            return self
+        raise ValueError(stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def tostype(self, stype):
+        if stype == "default":
+            return _wrap(self._data, self._ctx)
+        if stype == "csr":
+            return self
+        raise ValueError(stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data.asnumpy() if isinstance(data, NDArray) else data,
+                          dtype=dtype or np.float32)
+        indices = np.asarray(
+            indices.asnumpy() if isinstance(indices, NDArray) else indices
+        ).astype(np.int64)
+        full_shape = shape or ((int(indices.max()) + 1 if len(indices) else 0,)
+                               + data.shape[1:])
+        dense = np.zeros(full_shape, dtype=data.dtype)
+        if len(indices):
+            dense[indices] = data
+        out = RowSparseNDArray(jnp.asarray(dense), ctx=ctx)
+        return out
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return RowSparseNDArray(jnp.asarray(src.astype(dtype or src.dtype)), ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        import scipy.sparse as sp  # available via jax deps
+
+        m = sp.csr_matrix(
+            (np.asarray(data.asnumpy() if isinstance(data, NDArray) else data),
+             np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices),
+             np.asarray(indptr.asnumpy() if isinstance(indptr, NDArray) else indptr)),
+            shape=shape)
+        return CSRNDArray(jnp.asarray(m.toarray().astype(dtype or np.float32)),
+                          ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return CSRNDArray(jnp.asarray(src.astype(dtype or src.dtype)), ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    z = jnp.zeros(shape, np.dtype(dtype or np.float32))
+    if stype == "row_sparse":
+        return RowSparseNDArray(z, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(z, ctx=ctx)
+    return _wrap(z, ctx)

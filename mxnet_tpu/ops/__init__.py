@@ -1,0 +1,9 @@
+"""Operator registry package — importing it registers the op corpus."""
+from .registry import OPS, OpDef, get_op, invoke, register  # noqa: F401
+
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,472 @@
+"""Neural-network operators.
+
+Reference parity: ``src/operator/nn/`` (convolution, fully_connected,
+batch_norm, layer_norm, pooling, softmax, activation, dropout, lrn, …) and the
+cuDNN specializations under ``src/operator/nn/cudnn/``.  TPU-native: layouts
+stay NCHW at the API (reference convention) but everything lowers to
+``jax.lax`` conv/reduce-window primitives that XLA tiles onto the MXU; there
+is no algo autotuning cache because XLA picks conv strategies at compile time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _tup(v, n, default):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+@register("FullyConnected", input_names=("data", "weight", "bias"))
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True):
+    """Reference: src/operator/nn/fully_connected.cc — y = x·Wᵀ + b.
+
+    Weight layout (num_hidden, input_dim) as in the reference; the matmul is
+    the MXU hot path — XLA emits a single dot with fused bias add.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    y = jnp.matmul(data, weight.T)
+    if not no_bias and bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+@register("Convolution", input_names=("data", "weight", "bias"))
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=1, num_group=1, no_bias=False,
+                 cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
+    """Reference: src/operator/nn/convolution.cc (NCHW / OIHW).
+
+    Grouped + dilated N-D conv via ``lax.conv_general_dilated``; fp32 params
+    with bf16-friendly accumulation are handled by the caller's dtype policy.
+    """
+    n = _conv_dims(kernel)
+    stride = _tup(stride, n, 1)
+    dilate = _tup(dilate, n, 1)
+    pad = _tup(pad, n, 0)
+    if data.ndim == n + 1:  # unbatched safety
+        data = data[None]
+    spatial = "DHW"[-n:]
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution", input_names=("data", "weight", "bias"))
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), num_filter=1, num_group=1, no_bias=True,
+                   target_shape=None, cudnn_tune=None, cudnn_off=False,
+                   workspace=1024, layout=None):
+    """Reference: src/operator/nn/deconvolution.cc — gradient of conv wrt data.
+    Weight layout (in_c, out_c/g, *kernel) as in the reference."""
+    n = _conv_dims(kernel)
+    stride = _tup(stride, n, 1)
+    dilate = _tup(dilate, n, 1)
+    pad = _tup(pad, n, 0)
+    adj = _tup(adj, n, 0)
+    spatial = "DHW"[-n:]
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    # transposed conv = lhs-dilated conv with flipped effective padding
+    pads = []
+    for i in range(n):
+        k_eff = (weight.shape[2 + i] - 1) * dilate[i] + 1
+        lo = k_eff - 1 - pad[i]
+        hi = k_eff - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=(1,) * n,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        transpose_kernel=True,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+@register("Pooling", input_names=("data",))
+def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+             pad=(), pooling_convention="valid", count_include_pad=True,
+             cudnn_off=False, p_value=2, layout=None):
+    """Reference: src/operator/nn/pooling.cc + pool.h (NCHW)."""
+    n = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * n
+        pad = (0,) * n
+    else:
+        kernel = _tup(kernel, n, 1)
+        stride = _tup(stride, n, 1)
+        pad = _tup(pad, n, 0)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full" and not global_pool:
+        # ceil instead of floor for output size: add extra hi padding
+        pads_l = [(0, 0), (0, 0)]
+        for i in range(n):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            rem = (in_sz - kernel[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            pads_l.append((pad[i], pad[i] + extra))
+        pads = tuple(pads_l)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        out = lax.reduce_window(data, init, lax.max, window, strides, pads)
+    elif pool_type in ("avg", "sum"):
+        zero = jnp.zeros((), data.dtype)
+        out = lax.reduce_window(data, zero, lax.add, window, strides, pads)
+        if pool_type == "avg":
+            if count_include_pad:
+                out = out / jnp.prod(jnp.array(kernel, jnp.float32)).astype(data.dtype)
+            else:
+                ones = jnp.ones_like(data)
+                cnt = lax.reduce_window(ones, zero, lax.add, window, strides,
+                                        pads)
+                out = out / cnt
+    elif pool_type == "lp":
+        p_in = jnp.abs(data) ** p_value
+        out = lax.reduce_window(p_in, jnp.zeros((), p_in.dtype), lax.add,
+                                window, strides, pads) ** (1.0 / p_value)
+    else:
+        raise ValueError("unknown pool_type %r" % pool_type)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+@register("BatchNorm", input_names=("data", "gamma", "beta", "moving_mean",
+                                    "moving_var"),
+          train_aware=True, mutate={3: 3, 4: 4}, num_outputs=5)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    """Reference: src/operator/nn/batch_norm.cc.
+
+    Returns (out, batch_mean, batch_var, new_moving_mean, new_moving_var):
+    outputs 1/2 are the reference's saved minibatch stats (its op outputs),
+    3/4 are written back into the aux inputs by the dispatcher
+    (FMutateInputs parity).  In a jit'd graph the executor carries the
+    running stats as explicit state — pure-functional BN.
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+
+    if _train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mean = lax.stop_gradient(
+            momentum * moving_mean + (1 - momentum) * mean)
+        new_var = lax.stop_gradient(
+            momentum * moving_var + (1 - momentum) * var)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
+        + beta.reshape(bshape)
+    return (out, lax.stop_gradient(mean), lax.stop_gradient(var),
+            new_mean, new_var)
+
+
+@register("LayerNorm", input_names=("data", "gamma", "beta"))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Reference: src/operator/nn/layer_norm.cc."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", input_names=("data", "gamma", "beta"))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization", input_names=("data",))
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        kd = True
+    elif mode == "channel":
+        red = (1,)
+        kd = True
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        kd = True
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=kd) + eps)
+    return data / norm
+
+
+@register("LRN", input_names=("data",))
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Reference: src/operator/nn/lrn.cc — cross-channel local response norm."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + lax.dynamic_slice_in_dim(sq_pad, i, data.shape[1], axis=1)
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax
+# ---------------------------------------------------------------------------
+@register("Activation", input_names=("data",))
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", input_names=("data", "gamma"))
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "selu":
+        return 1.0507009873554805 * jax.nn.elu(data, 1.6732632423543772)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("softmax")
+def _softmax(x, length=None, axis=-1, temperature=None, use_length=False):
+    if temperature:
+        x = x / temperature
+    if use_length and length is not None:
+        ax = axis % x.ndim
+        # mask positions >= length along `ax`; length has x's shape minus `ax`
+        pos_shape = [1] * x.ndim
+        pos_shape[ax] = x.shape[ax]
+        pos = jnp.arange(x.shape[ax]).reshape(pos_shape)
+        lens = length.astype(jnp.int32)
+        # length covers leading batch dims; pad trailing, then insert `ax`
+        lens = lens.reshape(lens.shape + (1,) * (x.ndim - 1 - lens.ndim))
+        lens = jnp.expand_dims(lens, ax)
+        x = jnp.where(pos < lens, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(pos < lens, out, jnp.zeros((), out.dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(x, axis=-1, temperature=None):
+    if temperature:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def _softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_out_grad(p, label, grad_scale, ignore_label, use_ignore,
+                      multi_output, normalization):
+    """The reference's fused softmax-CE gradient (softmax_output-inl.h)."""
+    if multi_output:
+        # p: (N, C, ...) label: (N, ...)
+        oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[1], axis=1,
+                            dtype=p.dtype)
+    else:
+        oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+    g = p - oh
+    if use_ignore:
+        keep = (label != ignore_label).astype(p.dtype)
+        keep = jnp.expand_dims(keep, 1 if multi_output else -1)
+        g = g * keep
+    norm = 1.0
+    if normalization == "batch":
+        norm = p.shape[0]
+    elif normalization == "valid" and use_ignore:
+        norm = jnp.maximum(jnp.sum(label != ignore_label).astype(p.dtype), 1.0)
+    elif normalization == "valid":
+        norm = float(label.size)
+    return g * (grad_scale / norm)
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         multi_output, normalization):
+    ax = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=ax)
+
+
+def _smo_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output,
+             normalization):
+    p = _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                             multi_output, normalization)
+    return p, (p, label)
+
+
+def _smo_bwd(grad_scale, ignore_label, use_ignore, multi_output, norm, res, g):
+    p, label = res
+    # the reference ignores the incoming out-grad: backward is defined as
+    # (p - onehot(label)) regardless (softmax_output-inl.h Backward)
+    dg = _softmax_out_grad(p, label, grad_scale, ignore_label, use_ignore,
+                           multi_output, norm)
+    return (dg, jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
+
+
+@register("SoftmaxOutput", input_names=("data", "label"), aliases=("Softmax",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                use_ignore, multi_output, normalization)
+
+
+@register("softmax_cross_entropy", input_names=("data", "label"))
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+# ---------------------------------------------------------------------------
+# Dropout / Embedding
+# ---------------------------------------------------------------------------
+@register("Dropout", input_names=("data",), needs_rng=True, train_aware=True)
+def _dropout(rng, data, p=0.5, mode="training", axes=(), cudnn_off=False,
+             _train=False):
+    """Reference: src/operator/nn/dropout.cc — inverted dropout."""
+    if (not _train and mode != "always") or p <= 0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = jax.random.bernoulli(rng, 1.0 - p, tuple(shape))
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros((), data.dtype))
+
+
+@register("Embedding", input_names=("data", "weight"))
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding.
+
+    A gather from the (input_dim, output_dim) table; on TPU the backward is a
+    scatter-add that XLA handles natively (no row_sparse grad needed —
+    sparse_grad accepted for API parity).
+    """
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Losses as ops (reference has them as ops too)
+# ---------------------------------------------------------------------------
+def _regression_output(fwd_fn, grad_fn):
+    @jax.custom_vjp
+    def core(d, l):
+        return fwd_fn(d)
+
+    def fwd(d, l):
+        return fwd_fn(d), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return (grad_fn(d, l), jnp.zeros_like(l))
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_linreg_core = _regression_output(
+    lambda d: d, lambda d, l: (d - l) / d.shape[0])
+_maereg_core = _regression_output(
+    lambda d: d, lambda d, l: jnp.sign(d - l) / d.shape[0])
+_logreg_core = _regression_output(
+    jax.nn.sigmoid, lambda d, l: (jax.nn.sigmoid(d) - l) / d.shape[0])
+
+
+@register("LinearRegressionOutput", input_names=("data", "label"))
+def _linear_regression_output(data, label, grad_scale=1.0):
+    """Reference: src/operator/regression_output.cc — fwd identity, bwd (p-y)."""
+    return _linreg_core(data, label)
+
+
+@register("MAERegressionOutput", input_names=("data", "label"))
+def _mae_regression_output(data, label, grad_scale=1.0):
+    return _maereg_core(data, label)
+
+
+@register("LogisticRegressionOutput", input_names=("data", "label"))
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    return _logreg_core(data, label)

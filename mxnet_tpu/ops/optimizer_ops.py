@@ -1,0 +1,159 @@
+"""Fused optimizer-update operators.
+
+Reference parity: ``src/operator/optimizer_op.cc`` (sgd_update, sgd_mom_update,
+mp_sgd_update, adam_update, rmsprop_update, ftrl_update, signsgd_update,
+signum_update, nag_mom_update) — optimizer math as ops so updates fuse and
+pipeline.  TPU-native: each update is one jit'd XLA module; ``lr``/``wd`` are
+traced scalars so LR schedules don't recompile.  The dispatcher's ``mutate``
+writes results back into weight/state handles (in-place semantics).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_AP = ("lr", "wd", "rescale_grad")
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", input_names=("weight", "grad"), mutate={0: 0},
+          array_params=_AP, no_grad=True)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", input_names=("weight", "grad", "mom"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", input_names=("weight", "grad", "weight32"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    """fp16 weights with fp32 master copy (reference mp_sgd_update)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update",
+          input_names=("weight", "grad", "mom", "weight32"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP, no_grad=True)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("nag_mom_update", input_names=("weight", "grad", "mom"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", input_names=("weight", "grad", "mean", "var"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP, no_grad=True)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    return w, m, v
+
+
+@register("adamw_update", input_names=("weight", "grad", "mean", "var"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP + ("eta",), no_grad=True)
+def _adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """Reference: src/operator/contrib/adamw.cc — decoupled weight decay."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return w, m, v
+
+
+@register("rmsprop_update", input_names=("weight", "grad", "n"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update",
+          input_names=("weight", "grad", "n", "g", "delta"),
+          mutate={0: 0, 1: 2, 2: 3, 3: 4}, array_params=_AP, no_grad=True)
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_state + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", input_names=("weight", "grad", "z", "n"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP, no_grad=True)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register("signsgd_update", input_names=("weight", "grad"), mutate={0: 0},
+          array_params=_AP, no_grad=True)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", input_names=("weight", "grad", "mom"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = weight + lr * jnp.sign(new_mom) - lr * wd_lh * weight
+    return w, new_mom

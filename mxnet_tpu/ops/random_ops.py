@@ -1,0 +1,145 @@
+"""Random sampling operators.
+
+Reference parity: ``src/operator/random/sample_op.*`` (uniform/normal/gamma/
+exponential/poisson/neg-binomial + randint + sampling from tensor params) and
+``shuffle``.  TPU-native: counter-based ``jax.random`` with explicit keys — the
+dispatcher threads a fresh split per call (see ``mxnet_tpu.random``), giving
+reproducible streams per seed without per-device generator state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", needs_rng=True, no_grad=True,
+          aliases=("random_uniform", "uniform"))
+def _uniform(rng, low=0.0, high=1.0, shape=(1,), dtype="float32"):
+    return jax.random.uniform(rng, tuple(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", needs_rng=True, no_grad=True,
+          aliases=("random_normal", "normal"))
+def _normal(rng, loc=0.0, scale=1.0, shape=(1,), dtype="float32"):
+    return loc + scale * jax.random.normal(rng, tuple(shape), _dt(dtype))
+
+
+@register("_random_gamma", needs_rng=True, no_grad=True,
+          aliases=("random_gamma",))
+def _gamma(rng, alpha=1.0, beta=1.0, shape=(1,), dtype="float32"):
+    return jax.random.gamma(rng, alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", needs_rng=True, no_grad=True,
+          aliases=("random_exponential",))
+def _exponential(rng, lam=1.0, shape=(1,), dtype="float32"):
+    return jax.random.exponential(rng, tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True, no_grad=True,
+          aliases=("random_poisson",))
+def _poisson(rng, lam=1.0, shape=(1,), dtype="float32"):
+    return jax.random.poisson(rng, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True, no_grad=True,
+          aliases=("random_negative_binomial",))
+def _neg_binomial(rng, k=1, p=1.0, shape=(1,), dtype="float32"):
+    g = jax.random.gamma(rng, float(k), tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(rng, 1), g,
+                              tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True, no_grad=True,
+          aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(rng, mu=1.0, alpha=1.0, shape=(1,), dtype="float32"):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    g = jax.random.gamma(rng, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(rng, 1), g,
+                              tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", needs_rng=True, no_grad=True,
+          aliases=("random_randint", "randint"))
+def _randint(rng, low=0, high=1, shape=(1,), dtype="int32"):
+    return jax.random.randint(rng, tuple(shape), low, high, _dt(dtype))
+
+
+@register("_sample_uniform", needs_rng=True, no_grad=True)
+def _sample_uniform(rng, low, high, shape=()):
+    s = tuple(shape) if shape else ()
+    return low[..., *([None] * len(s))] + (high - low)[..., *([None] * len(s))] \
+        * jax.random.uniform(rng, low.shape + s, low.dtype)
+
+
+@register("_sample_normal", needs_rng=True, no_grad=True)
+def _sample_normal(rng, mu, sigma, shape=()):
+    s = tuple(shape) if shape else ()
+    eps = jax.random.normal(rng, mu.shape + s, mu.dtype)
+    return mu[..., *([None] * len(s))] + sigma[..., *([None] * len(s))] * eps
+
+
+@register("_sample_gamma", needs_rng=True, no_grad=True)
+def _sample_gamma(rng, alpha, beta, shape=()):
+    s = tuple(shape) if shape else ()
+    exp = (Ellipsis,) + (None,) * len(s)
+    g = jax.random.gamma(rng, alpha[exp], alpha.shape + s, alpha.dtype)
+    return g * beta[exp]
+
+
+@register("_sample_exponential", needs_rng=True, no_grad=True)
+def _sample_exponential(rng, lam, shape=()):
+    s = tuple(shape) if shape else ()
+    exp = (Ellipsis,) + (None,) * len(s)
+    return jax.random.exponential(rng, lam.shape + s, lam.dtype) / lam[exp]
+
+
+@register("_sample_poisson", needs_rng=True, no_grad=True)
+def _sample_poisson(rng, lam, shape=(), dtype="float32"):
+    s = tuple(shape) if shape else ()
+    exp = (Ellipsis,) + (None,) * len(s)
+    return jax.random.poisson(rng, lam[exp], lam.shape + s).astype(
+        jnp.dtype(dtype))
+
+
+@register("_sample_multinomial", needs_rng=True, no_grad=True,
+          aliases=("sample_multinomial",))
+def _sample_multinomial(rng, data, shape=(), get_prob=False, dtype="int32"):
+    n = 1
+    for s in (shape if isinstance(shape, (list, tuple)) else (shape,)):
+        if s:
+            n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out = jax.random.categorical(rng, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    out = jnp.moveaxis(out, 0, -1)
+    if isinstance(shape, (list, tuple)) and shape:
+        out = out.reshape(data.shape[:-1] + tuple(shape))
+    elif not shape:
+        out = out.reshape(data.shape[:-1])
+    samples = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        # reference returns [sample, log-likelihood] (REINFORCE support)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(
+            logp, out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1).reshape(samples.shape).astype(data.dtype)
+        return samples, ll
+    return samples
+
+
+@register("_shuffle", needs_rng=True, no_grad=True, aliases=("shuffle",))
+def _shuffle(rng, data):
+    return jax.random.permutation(rng, data, axis=0)
+
+
+@register("bernoulli", needs_rng=True, no_grad=True)
+def _bernoulli(rng, prob=0.5, shape=(1,), dtype="float32"):
+    return jax.random.bernoulli(rng, prob, tuple(shape)).astype(_dt(dtype))

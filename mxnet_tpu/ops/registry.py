@@ -1,0 +1,239 @@
+"""Operator registry — the extensibility backbone.
+
+Reference parity: nnvm's attribute-functor registry (``NNVM_REGISTER_OP`` +
+``FCompute``/``FInferShape``/``FGradient``/``FMutateInputs``,
+``include/mxnet/op_attr_types.h:122-324``).  TPU-native redesign:
+
+* An op is ONE pure JAX function ``fn(*arrays, **params)`` (+ optional leading
+  ``rng`` key, + optional static ``_train`` flag).  There is no separate
+  shape/type/storage inference — ``jax.eval_shape`` derives it, and gradients
+  come from ``jax.vjp`` instead of hand-registered ``FGradient`` twins.
+* Imperative dispatch goes through a two-level cache: (op, static-params) ->
+  ``jax.jit`` callable -> XLA executable keyed on shapes.  This is the analogue
+  of the reference's per-op engine push, except the "engine" is XLA's async
+  dispatch and every op is a compiled module.
+* ``mutate`` declares in-place semantics (optimizer updates, BatchNorm running
+  stats): the functional op returns the new values and the dispatcher writes
+  them back into the input handles — same observable behavior as the
+  reference's ``FMutateInputs`` without aliasing hazards.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+__all__ = ["OpDef", "register", "get_op", "invoke", "OPS"]
+
+OPS: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (reference-compatible, e.g. ``Convolution``).
+    fn : pure function of jax arrays -> jax array or tuple of arrays.
+    needs_rng : if True, ``fn(rng, *arrays, **params)``.
+    train_aware : if True, ``fn`` accepts static kwarg ``_train``.
+    array_params : param names passed as traced scalars (e.g. optimizer ``lr``)
+        so that changing them does not trigger recompilation.
+    mutate : dict {output_index: input_index} — dispatcher writes output back
+        into that input handle (in-place semantics).
+    num_outputs : informational; actual count comes from the returned tuple.
+    """
+
+    __slots__ = (
+        "name",
+        "fn",
+        "needs_rng",
+        "train_aware",
+        "array_params",
+        "mutate",
+        "num_outputs",
+        "no_grad",
+        "aliases",
+        "input_names",
+        "cacheable",
+    )
+
+    def __init__(self, name, fn, needs_rng=False, train_aware=False,
+                 array_params=(), mutate=None, num_outputs=1, no_grad=False,
+                 aliases=(), input_names=(), cacheable=True):
+        self.name = name
+        self.fn = fn
+        self.needs_rng = needs_rng
+        self.train_aware = train_aware
+        self.array_params = tuple(array_params)
+        self.mutate = dict(mutate or {})
+        self.num_outputs = num_outputs
+        self.no_grad = no_grad
+        self.aliases = tuple(aliases)
+        self.input_names = tuple(input_names)
+        self.cacheable = cacheable
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return self is other
+
+    # ------------------------------------------------------------------
+    def bind(self, static_params, train):
+        """Return ``fn`` with static params closed over (for jit/trace use)."""
+        if not self.cacheable:
+            kw = dict(static_params)
+            if self.train_aware:
+                kw["_train"] = train
+            return lambda *args: self.fn(*args, **kw)
+        return _bound_fn(self, _freeze(static_params), train)
+
+    def call(self, arrays, params, rng=None, train=False):
+        """Eager compiled call: arrays are jax arrays, params a dict."""
+        static, arrs = split_params(self, params)
+        if self.cacheable:
+            f = _jitted(self, _freeze(static), tuple(k for k, _ in arrs), train)
+        else:
+            # one-shot ops (e.g. custom autograd.Function instances): caching
+            # on the OpDef would leak executables — run uncompiled instead
+            f = self.bind(static, train)
+        args = list(arrays) + [v for _, v in arrs]
+        if self.needs_rng:
+            return f(rng, *args)
+        return f(*args)
+
+
+def split_params(opdef, params):
+    """Split params into (static dict, [(name, traced scalar array)])."""
+    static, arrs = {}, []
+    for k, v in params.items():
+        if v is None:
+            continue
+        if k in opdef.array_params:
+            arrs.append((k, v if hasattr(v, "dtype") else np.float32(v)))
+        else:
+            static[k] = v
+    return static, arrs
+
+
+def _freeze(d):
+    return tuple(sorted((k, _hashable(v)) for k, v in d.items()))
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return tuple(v.ravel().tolist()) + ("__np", v.shape)
+    return v
+
+
+def _thaw(items):
+    return {k: v for k, v in items}
+
+
+@functools.lru_cache(maxsize=None)
+def _bound_fn(opdef, static_items, train):
+    kw = _thaw(static_items)
+    if opdef.train_aware:
+        kw["_train"] = train
+    fn = opdef.fn
+
+    def call(*args, **extra):
+        return fn(*args, **kw, **extra)
+
+    call.__name__ = opdef.name
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(opdef, static_items, array_param_names, train):
+    kw = _thaw(static_items)
+    if opdef.train_aware:
+        kw["_train"] = train
+    fn = opdef.fn
+    n_ap = len(array_param_names)
+
+    def call(*args):
+        if n_ap:
+            data, ap = args[:-n_ap], args[-n_ap:]
+            pkw = dict(kw)
+            pkw.update(zip(array_param_names, ap))
+            return fn(*data, **pkw)
+        return fn(*args, **kw)
+
+    call.__name__ = opdef.name
+    return jax.jit(call)
+
+
+def register(name, **opts):
+    """Decorator: register a pure-jax function as a framework op."""
+
+    def deco(fn):
+        op = OpDef(name, fn, **opts)
+        OPS[name] = op
+        for a in op.aliases:
+            OPS[a] = op
+        return fn
+
+    return deco
+
+
+def get_op(name):
+    if name not in OPS:
+        raise KeyError("operator %r is not registered" % name)
+    return OPS[name]
+
+
+def invoke(op_name, ndarray_inputs, params=None, out=None):
+    """Imperative dispatch of a registered op on NDArray inputs.
+
+    Mirrors the reference call stack ``mx.nd.op -> MXImperativeInvokeEx ->
+    Imperative::Invoke -> Engine::PushAsync`` (SURVEY.md §3.1) collapsed to:
+    python front -> cached jit -> XLA async dispatch.  Returns a single NDArray
+    or a list (reference convention).
+    """
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    opdef = get_op(op_name) if isinstance(op_name, str) else op_name
+    params = params or {}
+    inputs = list(ndarray_inputs)
+    datas = [x.data if isinstance(x, NDArray) else x for x in inputs]
+
+    rng = None
+    if opdef.needs_rng:
+        from .. import random as _random
+
+        rng = _random.next_key()
+    train = autograd.is_training() if opdef.train_aware else False
+
+    results = opdef.call(datas, params, rng=rng, train=train)
+    if not isinstance(results, (tuple, list)):
+        results = (results,)
+
+    outputs = []
+    for i, r in enumerate(results):
+        if i in opdef.mutate:
+            tgt = inputs[opdef.mutate[i]]
+            tgt._set_data(r)
+            outputs.append(tgt)
+        else:
+            outputs.append(_wrap(r, ctx=inputs[0].context if inputs and isinstance(inputs[0], NDArray) else None))
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o, r in zip(outs, outputs):
+            if o is not None and o is not r:
+                o._set_data(r.data)
+        outputs = list(outs)
+
+    if autograd.is_recording() and not opdef.no_grad:
+        autograd._record(opdef, inputs, params, rng, train, outputs)
+
+    return outputs[0] if len(outputs) == 1 else outputs

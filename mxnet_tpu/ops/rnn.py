@@ -1,0 +1,156 @@
+"""Fused multi-layer RNN/LSTM/GRU operator.
+
+Reference parity: ``src/operator/rnn-inl.h`` + ``cudnn_rnn-inl.h`` (the fused
+cuDNN RNN op behind ``gluon.rnn.LSTM`` etc.).  TPU-native: the time loop is a
+``lax.scan`` (compiler-friendly, no dynamic python control flow), each step is
+one gate matmul on the MXU; layers stack sequentially with optional inter-layer
+dropout, bidirectional runs a reversed scan.  Parameter packing follows the
+reference convention: all weights (per layer, per direction: W_i2h then W_h2h),
+then all biases (b_i2h then b_h2h).
+
+Layouts: data (T, N, I); states (L*dirs, N, H).  Gate order: LSTM i,f,g,o;
+GRU r,z,n (reference/cuDNN order).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else state_size * dirs
+        size += dirs * g * state_size * (in_sz + state_size)  # weights
+    size += num_layers * dirs * 2 * g * state_size  # biases
+    return size
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    ptr = 0
+    weights, biases = [], []
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else H * dirs
+        for d in range(dirs):
+            wi = params[ptr:ptr + g * H * in_sz].reshape(g * H, in_sz)
+            ptr += g * H * in_sz
+            wh = params[ptr:ptr + g * H * H].reshape(g * H, H)
+            ptr += g * H * H
+            weights.append((wi, wh))
+    for l in range(num_layers):
+        for d in range(dirs):
+            bi = params[ptr:ptr + g * H]
+            ptr += g * H
+            bh = params[ptr:ptr + g * H]
+            ptr += g * H
+            biases.append((bi, bh))
+    return weights, biases
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i, f, g_, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g_ = jnp.tanh(g_)
+            c_new = f * c + i * g_
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new)
+        return step
+    if mode == "gru":
+        def step(carry, pre):  # pre = (x_part(3H), h_part(3H))
+            h, _ = carry
+            xg, hg = pre
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new, h_new)
+        return step
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def step(carry, gates):
+        h, _ = carry
+        h_new = act(gates)
+        return (h_new, h_new)
+    return step
+
+
+def _layer_scan(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
+    """One direction of one layer. x: (T,N,I) -> (T,N,H)."""
+    H = h0.shape[-1]
+    step = _cell_step(mode, H)
+    if mode == "gru":
+        # GRU needs x-side and h-side gate pre-activations separate (the reset
+        # gate multiplies only the h-side 'new' term — cuDNN semantics)
+        xw = jnp.einsum("tni,gi->tng", x, wi) + bi
+
+        def body(carry, xt):
+            hg = jnp.matmul(carry[0], wh.T) + bh
+            new = step(carry, (xt, hg))
+            return new, new[0]
+    else:
+        # hoist the input projection out of the scan: one big MXU matmul
+        xw = jnp.einsum("tni,gi->tng", x, wi) + bi + bh
+
+        def body(carry, xt):
+            gates = xt + jnp.matmul(carry[0], wh.T)
+            new = step(carry, gates)
+            return new, new[0]
+
+    (hT, cT), ys = lax.scan(body, (h0, c0), xw, reverse=reverse)
+    return ys, hT, cT
+
+
+@register("RNN", input_names=("data", "parameters", "state", "state_cell"),
+          needs_rng=True, train_aware=True)
+def _rnn(rng, data, parameters, state, state_cell=None, mode="lstm",
+         state_size=0, num_layers=1, bidirectional=False, p=0.0,
+         state_outputs=True, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False,
+         projection_size=None, use_sequence_length=False, _train=False):
+    T, N, I = data.shape
+    H = state_size
+    dirs = 2 if bidirectional else 1
+    weights, biases = _unpack(parameters, mode, I, H, num_layers, bidirectional)
+
+    x = data
+    h_out, c_out = [], []
+    for l in range(num_layers):
+        ys = []
+        for d in range(dirs):
+            idx = l * dirs + d
+            wi, wh = weights[idx]
+            bi, bh = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if (mode == "lstm" and state_cell is not None) \
+                else jnp.zeros_like(h0)
+            y, hT, cT = _layer_scan(mode, x, h0, c0, wi, wh, bi, bh,
+                                    reverse=(d == 1))
+            ys.append(y)
+            h_out.append(hT)
+            c_out.append(cT)
+        x = jnp.concatenate(ys, axis=-1) if dirs == 2 else ys[0]
+        if p > 0 and _train and l < num_layers - 1:
+            keep = jax.random.bernoulli(jax.random.fold_in(rng, l), 1.0 - p,
+                                        x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+    hy = jnp.stack(h_out, axis=0)
+    if mode == "lstm":
+        cy = jnp.stack(c_out, axis=0)
+        return (x, hy, cy) if state_outputs else x
+    return (x, hy) if state_outputs else x

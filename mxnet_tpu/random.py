@@ -1,0 +1,46 @@
+"""Global RNG state.
+
+Reference parity: ``mx.random.seed`` with global + per-context generators
+(``include/mxnet/random_generator.h``, ``src/operator/random/``).  TPU-native
+design: a single splittable ``jax.random`` key chain; every random op consumes a
+fresh split, so imperative programs are reproducible given ``seed()`` while jit'd
+graphs receive keys as explicit inputs (threaded by the executor)."""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _key_state():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (ctx arg accepted for API parity)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split the global chain and return a fresh key (eager ops only)."""
+    k = _key_state()
+    _state.key, sub = jax.random.split(k)
+    return sub
+
+
+def next_keys(n):
+    k = _key_state()
+    out = jax.random.split(k, n + 1)
+    _state.key = out[0]
+    return out[1:]
+
+
+# numpy-compat helpers used by tests/data pipelines ------------------------
+def np_rng():
+    return _np.random

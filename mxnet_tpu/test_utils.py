@@ -1,0 +1,96 @@
+"""Test utilities — shipped in the package, as the reference does.
+
+Reference parity: ``python/mxnet/test_utils.py`` (check_numeric_gradient:801,
+check_consistency:1224, rand_ndarray:343, default_context:53).  The numpy/CPU
+oracle + finite-difference grad checking strategy ports wholesale (SURVEY.md §4
+"lessons").
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import autograd
+from .context import Context, cpu, current_context
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    import threading
+
+    from . import context as _ctx_mod
+
+    _ctx_mod._GLOBAL_DEFAULT = ctx
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-7, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s" % names)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, scale=1.0):
+    a = np.random.uniform(-scale, scale, size=shape).astype(dtype or np.float32)
+    return nd.array(a, ctx=ctx)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference vs autograd (reference: test_utils.py:801).
+
+    ``f``: callable taking NDArrays, returning a scalar-reducible NDArray.
+    ``inputs``: list of numpy arrays.
+    """
+    nds = [nd.array(x) for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*nds)
+        loss = out.sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in nds]
+
+    for i, base in enumerate(inputs):
+        numeric = np.zeros_like(base, dtype=np.float64)
+        flat = base.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(f(*[nd.array(x) for x in inputs]).sum().asscalar())
+            flat[j] = orig - eps
+            fm = float(f(*[nd.array(x) for x in inputs]).sum().asscalar())
+            flat[j] = orig
+            numeric.reshape(-1)[j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic[i], numeric, rtol=rtol, atol=atol,
+                                   err_msg="grad of input %d" % i)
+
+
+def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Run the same computation across contexts and cross-check outputs
+    (reference: test_utils.py:1224 — CPU is the oracle for the accelerator)."""
+    ctx_list = ctx_list or [cpu(0), current_context()]
+    datas = [np.random.uniform(-1, 1, s).astype(np.float32)
+             for s in input_shapes]
+    outs = []
+    for ctx in ctx_list:
+        with ctx:
+            r = f(*[nd.array(d, ctx=ctx) for d in datas])
+            outs.append(r.asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    return np.allclose(a, b, rtol=rtol, atol=atol)

@@ -1,0 +1,181 @@
+"""Autograd semantics (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_chain_multiple_vars():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        y = a * b + a
+    y.backward()
+    assert float(a.grad.asnumpy()) == 4.0  # b + 1
+    assert float(b.grad.asnumpy()) == 2.0  # a
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30, 300])
+
+
+def test_grad_req_add_and_null():
+    x = mx.nd.ones((2,))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6, 6])
+    z = mx.nd.ones((2,))
+    z.attach_grad(grad_req="null")
+    with ag.record():
+        w = (z * 2).sum()
+    with pytest.raises(ValueError):
+        w.backward()
+
+
+def test_is_recording_is_training():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+        assert not ag.is_recording()
+
+
+def test_pause_excludes_from_tape():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        with ag.pause():
+            c = x * 10  # not recorded
+        z = y + c.detach()
+    z.backward()
+    assert float(x.grad.asnumpy()) == 4.0
+
+
+def test_detach():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert float(x.grad.asnumpy()) == 9.0  # only through second factor
+
+
+def test_grad_function_api():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 2).sum()
+    (gx,) = ag.grad(y, [x])
+    assert_almost_equal(gx, [2, 4])
+
+
+def test_nondiff_op_on_tape():
+    x = mx.nd.array([1.0, 5.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        i = mx.nd.argmax(x)  # no_grad op
+        y = (x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2, 2, 2])
+
+
+def test_through_reshape_transpose():
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with ag.record():
+        y = x.reshape((3, 2)).T.sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.ones((2, 3)))
+
+
+def test_backward_twice_with_retain():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = float(x.grad.asnumpy())
+    y.backward()
+    assert g1 == 4.0
+    assert float(x.grad.asnumpy()) == 4.0
+
+
+def test_training_cache_hit():
+    """The same tape structure across iterations reuses the compiled vjp."""
+    from mxnet_tpu.autograd import _vjp_cache
+
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+
+    def step():
+        with ag.record():
+            loss = (x * x * 2).sum()
+        loss.backward()
+
+    step()
+    n = len(_vjp_cache)
+    for _ in range(5):
+        step()
+    assert len(_vjp_cache) == n
+
+
+def test_custom_function():
+    class Square(ag.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 2 * x
+
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = Square()(x)
+        z = (y * 3).sum()
+    z.backward()
+    assert_almost_equal(x.grad, [6, 12, 18])
+
+
+def test_mutated_leaf_sees_new_value():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert float(x.grad.asnumpy()) == 2.0
+    x._set_data(mx.nd.array([5.0]).data)
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert float(x.grad.asnumpy()) == 10.0

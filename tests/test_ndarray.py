@@ -1,0 +1,202 @@
+"""NDArray semantics tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = mx.nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    y = mx.nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = mx.nd.full((2, 2), 7.5)
+    assert_almost_equal(z, np.full((2, 2), 7.5))
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32  # reference default
+    r = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(r, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, [[6, 8], [10, 12]])
+    assert_almost_equal(a - b, [[-4, -4], [-4, -4]])
+    assert_almost_equal(a * b, [[5, 12], [21, 32]])
+    assert_almost_equal(b / a, [[5, 3], [7 / 3, 2]])
+    assert_almost_equal(a + 1, [[2, 3], [4, 5]])
+    assert_almost_equal(1 - a, [[0, -1], [-2, -3]])
+    assert_almost_equal(2 ** a, [[2, 4], [8, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(-a), [[1, 2], [3, 4]])
+
+
+def test_comparison_returns_numeric():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a > b, [0, 0, 1])
+    assert_almost_equal(a == b, [0, 1, 0])
+    assert (a > b).dtype == np.float32
+
+
+def test_inplace():
+    a = mx.nd.ones((3,))
+    a += 2
+    assert_almost_equal(a, [3, 3, 3])
+    a *= 2
+    assert_almost_equal(a, [6, 6, 6])
+
+
+def test_broadcast():
+    a = mx.nd.ones((3, 1))
+    b = mx.nd.ones((1, 4))
+    assert (a + b).shape == (3, 4)
+    c = mx.nd.ones((2, 3)).broadcast_to((4, 2, 3))
+    assert c.shape == (4, 2, 3)
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[0], np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], [20, 21, 22, 23])
+    assert_almost_equal(a[:, 1], [[4, 5, 6, 7], [16, 17, 18, 19]])
+    assert_almost_equal(a[0, 1:3], [[4, 5, 6, 7], [8, 9, 10, 11]])
+    idx = mx.nd.array([1, 0], dtype="int32")
+    assert_almost_equal(a[idx].asnumpy()[0], a.asnumpy()[1])
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 5.0
+    assert_almost_equal(a, [[0, 0, 0], [5, 5, 5], [0, 0, 0]])
+    a[:] = 1.0
+    assert_almost_equal(a, np.ones((3, 3)))
+    a[0, 1] = 9
+    assert a.asnumpy()[0, 1] == 9
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    assert a.reshape((4, 3)).shape == (4, 3)
+    assert a.reshape((-1,)).shape == (12,)
+    assert a.reshape((0, 2, 2)).shape == (3, 2, 2)  # 0 = keep dim
+    assert a.T.shape == (4, 3)
+    assert a.transpose().shape == (4, 3)
+    b = mx.nd.ones((2, 3, 4)).transpose((2, 0, 1))
+    assert b.shape == (4, 2, 3)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert mx.nd.ones((1, 3, 1)).squeeze().shape == (3,)
+
+
+def test_reductions():
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert float(a.sum()) == 15
+    assert float(a.mean()) == 2.5
+    assert float(a.max()) == 5
+    assert float(a.min()) == 0
+    assert_almost_equal(a.sum(axis=0), [3, 5, 7])
+    assert_almost_equal(a.sum(axis=1, keepdims=True), [[3], [12]])
+    assert_almost_equal(a.argmax(axis=1), [2, 2])
+    assert_almost_equal(mx.nd.norm(a), np.sqrt((np.arange(6) ** 2).sum()))
+
+
+def test_dot():
+    a = mx.nd.array(np.random.randn(3, 4))
+    b = mx.nd.array(np.random.randn(4, 5))
+    assert_almost_equal(mx.nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    c = mx.nd.dot(a, a, transpose_b=True)
+    assert_almost_equal(c, a.asnumpy() @ a.asnumpy().T, rtol=1e-4)
+    # batch_dot
+    x = mx.nd.array(np.random.randn(2, 3, 4))
+    y = mx.nd.array(np.random.randn(2, 4, 5))
+    assert_almost_equal(mx.nd.batch_dot(x, y),
+                        np.matmul(x.asnumpy(), y.asnumpy()), rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = mx.nd.split(mx.nd.ones((4, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (4, 2)
+    sq = mx.nd.split(mx.nd.ones((4, 2)), num_outputs=2, axis=1,
+                     squeeze_axis=True)
+    assert sq[0].shape == (4,)
+
+
+def test_astype_copy():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 99
+    assert float(a[0]) == 1.5
+
+
+def test_context_movement():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    c = mx.nd.zeros((2, 2))
+    a.copyto(c)
+    assert_almost_equal(c, np.ones((2, 2)))
+
+
+def test_scalar_conversion():
+    a = mx.nd.array([3.5])
+    assert a.asscalar() == 3.5
+    assert float(a) == 3.5
+    with pytest.raises(ValueError):
+        mx.nd.ones((2,)).asscalar()
+
+
+def test_wait_sync():
+    a = mx.nd.ones((10, 10))
+    b = mx.nd.dot(a, a)
+    b.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_take_pick_onehot():
+    a = mx.nd.array(np.arange(12).reshape(4, 3))
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert_almost_equal(mx.nd.take(a, idx),
+                        a.asnumpy()[[0, 2]])
+    p = mx.nd.pick(a, mx.nd.array([0, 1, 2, 0]), axis=1)
+    assert_almost_equal(p, [0, 4, 8, 9])
+    oh = mx.nd.one_hot(mx.nd.array([1, 0]), 3)
+    assert_almost_equal(oh, [[0, 1, 0], [1, 0, 0]])
+
+
+def test_where_clip():
+    cond = mx.nd.array([1, 0, 1])
+    x = mx.nd.array([1, 2, 3])
+    y = mx.nd.array([4, 5, 6])
+    assert_almost_equal(mx.nd.where(cond, x, y), [1, 5, 3])
+    assert_almost_equal(x.clip(1.5, 2.5), [1.5, 2, 2.5])
+
+
+def test_random_reproducible():
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    mx.random.seed(8)
+    c = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert not np.array_equal(a, c)
+
+
+def test_random_moments():
+    u = mx.nd.random.uniform(0, 1, shape=(10000,))
+    assert abs(float(u.mean()) - 0.5) < 0.02
+    n = mx.nd.random.normal(2.0, 3.0, shape=(10000,))
+    assert abs(float(n.mean()) - 2.0) < 0.15
+    assert abs(float(((n - n.mean()) ** 2).mean()) - 9.0) < 0.5

@@ -1,0 +1,337 @@
+"""Operator correctness vs numpy oracle + finite-difference gradients.
+
+Reference: tests/python/unittest/test_operator.py (7,590 LoC) — the densest
+test surface in the reference; this corpus grows with the op layer.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+
+
+# ---------------------------------------------------------------------------
+# elementwise + gradients
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op,npf", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("square", np.square), ("tanh", np.tanh), ("abs", np.abs),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("relu", lambda x: np.maximum(x, 0)),
+])
+def test_unary_forward(op, npf):
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    out = getattr(mx.nd, op)(mx.nd.array(x))
+    assert_almost_equal(out, npf(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("op", ["exp", "tanh", "sigmoid", "square"])
+def test_unary_grad(op):
+    x = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    check_numeric_gradient(lambda a: getattr(mx.nd, op)(a), [x])
+
+
+def test_binary_broadcast_grad():
+    a = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    b = np.random.uniform(0.5, 1.5, (1, 3)).astype(np.float32)
+    check_numeric_gradient(lambda x, y: x * y + x / y, [a, b])
+
+
+def test_dot_grad():
+    a = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4, 2)).astype(np.float32)
+    check_numeric_gradient(lambda x, y: mx.nd.dot(x, y), [a, b])
+
+
+# ---------------------------------------------------------------------------
+# NN ops vs numpy oracle
+# ---------------------------------------------------------------------------
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype(np.float32)
+    w = np.random.randn(5, 10).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=5)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    # flatten semantics: (N, C, H, W) -> (N, C*H*W)
+    x4 = np.random.randn(2, 3, 2, 2).astype(np.float32)
+    w4 = np.random.randn(5, 12).astype(np.float32)
+    out4 = mx.nd.FullyConnected(mx.nd.array(x4), mx.nd.array(w4),
+                                mx.nd.array(b), num_hidden=5)
+    assert_almost_equal(out4, x4.reshape(2, -1) @ w4.T + b, rtol=1e-4,
+                        atol=1e-5)
+
+
+def _np_conv2d(x, w, b, stride, pad):
+    n, c, h, wd = x.shape
+    oc, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return out + b.reshape(1, -1, 1, 1)
+
+
+def test_convolution():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), num_filter=4, stride=(2, 2),
+                            pad=(1, 1))
+    assert_almost_equal(out, _np_conv2d(x, w, b, 2, 1), rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grouped():
+    x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                            kernel=(3, 3), num_filter=4, num_group=2,
+                            no_bias=True)
+    assert out.shape == (1, 4, 3, 3)
+
+
+def test_conv_grad():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(
+        lambda a, b: mx.nd.Convolution(a, b, None, kernel=(3, 3),
+                                       num_filter=3, no_bias=True),
+        [x, w], rtol=0.05, atol=0.01)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    assert_almost_equal(mp, [[[[5, 7], [13, 15]]]])
+    ap = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    assert_almost_equal(ap, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    gp = mx.nd.Pooling(mx.nd.array(x), pool_type="max", global_pool=True)
+    assert gp.shape == (1, 1, 1, 1) and float(gp.asnumpy().squeeze()) == 15
+
+
+def test_batchnorm_train_inference():
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    rm = mx.nd.zeros((3,))
+    rv = mx.nd.ones((3,))
+    with mx.autograd.train_mode():
+        out, bmean, bvar, _, _ = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta), rm, rv,
+            fix_gamma=False, momentum=0.9)
+    # outputs 1/2 are the saved minibatch stats (reference op outputs)
+    assert np.allclose(bmean.asnumpy(), x.mean(axis=(0, 2, 3)), atol=1e-5)
+    # normalized output has ~zero mean / unit var per channel
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(o.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # running stats were updated in place
+    assert abs(rm.asnumpy() - 0.1 * x.mean(axis=(0, 2, 3))).max() < 1e-5
+    # inference mode uses running stats
+    out2 = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                           mx.nd.array(beta), rm, rv, fix_gamma=False)[0]
+    expect = (x - rm.asnumpy().reshape(1, -1, 1, 1)) / np.sqrt(
+        rv.asnumpy().reshape(1, -1, 1, 1) + 1e-3)
+    assert_almost_equal(out2, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.randn(4, 10).astype(np.float32)
+    g = np.random.rand(10).astype(np.float32) + 0.5
+    b = np.random.randn(10).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b))
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    assert_almost_equal(out, (x - mu) / np.sqrt(sig + 1e-5) * g + b,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ops():
+    x = np.random.randn(3, 5).astype(np.float32)
+    sm = mx.nd.softmax(mx.nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(sm, e / e.sum(-1, keepdims=True), rtol=1e-5,
+                        atol=1e-6)
+    lsm = mx.nd.log_softmax(mx.nd.array(x))
+    assert_almost_equal(lsm, np.log(sm + 1e-20), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_grad_semantics():
+    """SoftmaxOutput backward = (p - onehot) / normalization, ignoring out-grad."""
+    x = np.random.randn(4, 3).astype(np.float32)
+    label = np.array([0, 2, 1, 1], np.float32)
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    with mx.autograd.record():
+        p = mx.nd.SoftmaxOutput(xa, mx.nd.array(label))
+    p.backward()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    oh = np.eye(3, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(xa.grad, sm - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_modes():
+    x = mx.nd.ones((200, 200))
+    with mx.autograd.train_mode():
+        y = mx.nd.Dropout(x, p=0.3)
+    frac = float((y == 0).mean())
+    assert 0.25 < frac < 0.35
+    # scaling preserves expectation
+    assert abs(float(y.mean()) - 1.0) < 0.05
+    y2 = mx.nd.Dropout(x, p=0.3)  # predict mode: identity
+    assert float((y2 == 0).sum()) == 0
+
+
+def test_embedding():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([[1, 3], [5, 9]], np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out, w[idx.astype(int)])
+
+
+def test_embedding_grad_is_scatter():
+    w = np.random.randn(5, 3).astype(np.float32)
+    wa = mx.nd.array(w)
+    wa.attach_grad()
+    idx = mx.nd.array([0, 0, 2])
+    with mx.autograd.record():
+        out = mx.nd.Embedding(idx, wa, input_dim=5, output_dim=3)
+    out.backward()
+    expect = np.zeros_like(w)
+    expect[0] = 2  # row 0 picked twice
+    expect[2] = 1
+    assert_almost_equal(wa.grad, expect)
+
+
+def test_activation_leakyrelu():
+    x = np.array([-2.0, -0.5, 0.0, 1.0], np.float32)
+    assert_almost_equal(mx.nd.Activation(mx.nd.array(x), act_type="relu"),
+                        np.maximum(x, 0))
+    assert_almost_equal(
+        mx.nd.LeakyReLU(mx.nd.array(x), act_type="leaky", slope=0.1),
+        np.where(x >= 0, x, 0.1 * x), rtol=1e-5, atol=1e-6)
+    elu = mx.nd.LeakyReLU(mx.nd.array(x), act_type="elu", slope=1.0)
+    assert_almost_equal(elu, np.where(x >= 0, x, np.expm1(x)), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_sequence_ops():
+    # (T=3, B=2, D=2)
+    x = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+    lens = mx.nd.array([2, 3])
+    masked = mx.nd.SequenceMask(mx.nd.array(x), lens,
+                                use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2, 0] == -1).all() and (m[2, 1] == x[2, 1]).all()
+    last = mx.nd.SequenceLast(mx.nd.array(x), lens, use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[2, 1]]))
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), lens,
+                                use_sequence_length=True)
+    r = rev.asnumpy()
+    assert (r[0, 0] == x[1, 0]).all() and (r[1, 0] == x[0, 0]).all()
+    assert (r[0, 1] == x[2, 1]).all()
+
+
+def test_optimizer_ops():
+    w = np.random.randn(5).astype(np.float32)
+    g = np.random.randn(5).astype(np.float32)
+    wa, ga = mx.nd.array(w), mx.nd.array(g)
+    mx.nd.sgd_update(wa, ga, lr=0.1, wd=0.0)
+    assert_almost_equal(wa, w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    # momentum
+    w2, m2 = mx.nd.array(w), mx.nd.zeros((5,))
+    mx.nd.sgd_mom_update(w2, ga, m2, lr=0.1, momentum=0.9)
+    assert_almost_equal(w2, w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    mx.nd.sgd_mom_update(w2, ga, m2, lr=0.1, momentum=0.9)
+    # v1 = -0.1g; v2 = 0.9*v1 - 0.1g; w = w + v1 + v2
+    assert_almost_equal(w2, w - 0.1 * g + 0.9 * (-0.1 * g) - 0.1 * g,
+                        rtol=1e-5, atol=1e-6)
+    # adam
+    w3, m3, v3 = mx.nd.array(w), mx.nd.zeros((5,)), mx.nd.zeros((5,))
+    mx.nd.adam_update(w3, ga, m3, v3, lr=0.01)
+    m_exp = 0.1 * g
+    v_exp = 0.001 * g * g
+    assert_almost_equal(w3, w - 0.01 * m_exp / (np.sqrt(v_exp) + 1e-8),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_op_shapes():
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    for mode, gates in [("lstm", 4), ("gru", 3), ("rnn_tanh", 1)]:
+        psize = rnn_param_size(mode, I, H, L, False)
+        params = mx.nd.random.normal(scale=0.1, shape=(psize,))
+        state = mx.nd.zeros((L, N, H))
+        if mode == "lstm":
+            out, hy, cy = mx.nd.RNN(mx.nd.random.normal(shape=(T, N, I)),
+                                    params, state, mx.nd.zeros((L, N, H)),
+                                    mode=mode, state_size=H, num_layers=L)
+            assert cy.shape == (L, N, H)
+        else:
+            out, hy = mx.nd.RNN(mx.nd.random.normal(shape=(T, N, I)),
+                                params, state, mode=mode, state_size=H,
+                                num_layers=L)
+        assert out.shape == (T, N, H)
+        assert hy.shape == (L, N, H)
+
+
+def test_rnn_bidirectional():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    T, N, I, H = 4, 2, 3, 5
+    psize = rnn_param_size("lstm", I, H, 1, True)
+    out, hy, cy = mx.nd.RNN(mx.nd.random.normal(shape=(T, N, I)),
+                            mx.nd.random.normal(scale=0.1, shape=(psize,)),
+                            mx.nd.zeros((2, N, H)), mx.nd.zeros((2, N, H)),
+                            mode="lstm", state_size=H, num_layers=1,
+                            bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hy.shape == (2, N, H)
+
+
+def test_topk_sort():
+    x = mx.nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = mx.nd.topk(x, k=2)
+    assert_almost_equal(idx, [[0, 2], [1, 2]])
+    both_v, both_i = mx.nd.topk(x, k=1, ret_typ="both")
+    assert_almost_equal(both_v, [[3], [5]])
+    s = mx.nd.sort(x, axis=1)
+    assert_almost_equal(s, [[1, 2, 3], [0, 4, 5]])
+
+
+def test_slice_ops():
+    x = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    s = mx.nd.slice(x, begin=(0, 1, 0), end=(2, 3, 2))
+    assert s.shape == (2, 2, 2)
+    sa = mx.nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert sa.shape == (2, 3, 2)
+
+
+def test_tile_repeat_pad():
+    x = mx.nd.array([[1.0, 2.0]])
+    assert mx.nd.tile(x, reps=(2, 3)).shape == (2, 6)
+    assert mx.nd.repeat(x, repeats=2, axis=1).shape == (1, 4)
+    p = mx.nd.pad(mx.nd.ones((1, 1, 2, 2)), mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=9)
+    assert p.shape == (1, 1, 4, 4)
+    assert float(p[0, 0, 0, 0]) == 9
+
+
+def test_gather_scatter():
+    data = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    indices = mx.nd.array([[1, 0], [0, 1]])
+    out = mx.nd.gather_nd(data, indices)
+    assert_almost_equal(out, [3, 2])
