@@ -157,3 +157,136 @@ def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
     w = weight + lr * jnp.sign(new_mom) - lr * wd_lh * weight
     return w, new_mom
+
+
+@register("ftml_update", input_names=("weight", "grad", "d", "v", "z"),
+          mutate={0: 0, 1: 2, 2: 3, 3: 4}, array_params=_AP + ("t",),
+          no_grad=True)
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, t=1.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    """Reference: src/operator/optimizer_op.cc ftml_update (FTML optimizer)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - jnp.power(beta1, t)) / lr * (
+        jnp.sqrt(new_v / (1 - jnp.power(beta2, t))) + epsilon)
+    sigma_t = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma_t * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("adagrad_update", input_names=("weight", "grad", "history"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_hist = history + jnp.square(g)
+    w = weight - lr * (g / jnp.sqrt(new_hist + epsilon) + wd * weight)
+    return w, new_hist
+
+
+@register("adadelta_update", input_names=("weight", "grad", "acc_g", "acc_d"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP, no_grad=True)
+def _adadelta_update(weight, grad, acc_g, acc_d, lr=1.0, rho=0.9,
+                     epsilon=1e-5, wd=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_d + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_d = rho * acc_d + (1 - rho) * jnp.square(delta)
+    return weight - lr * delta, new_acc_g, new_acc_d
+
+
+@register("adamax_update", input_names=("weight", "grad", "mean", "var"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP + ("t",), no_grad=True)
+def _adamax_update(weight, grad, mean, var, lr=0.002, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, wd=0.0, t=1.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    u = jnp.maximum(beta2 * var, jnp.abs(g))
+    w = weight - (lr / (1 - jnp.power(beta1, t))) * m / (u + epsilon)
+    return w, m, u
+
+
+@register("nadam_update", input_names=("weight", "grad", "mean", "var"),
+          mutate={0: 0, 1: 2, 2: 3},
+          array_params=_AP + ("t", "m_schedule"), no_grad=True)
+def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, t=1.0, m_schedule=1.0,
+                  schedule_decay=0.004, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    momentum_t = beta1 * (1 - 0.5 * jnp.power(0.96, t * schedule_decay))
+    momentum_t_1 = beta1 * (1 - 0.5 * jnp.power(0.96, (t + 1) * schedule_decay))
+    m_sched = m_schedule * momentum_t
+    m_sched_next = m_sched * momentum_t_1
+    grad_prime = g / (1 - m_sched)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_prime = m / (1 - m_sched_next)
+    v_prime = v / (1 - jnp.power(beta2, t))
+    m_bar = (1 - momentum_t) * grad_prime + momentum_t_1 * m_prime
+    w = weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon)
+    return w, m, v
+
+
+@register("sgld_update", input_names=("weight", "grad"), mutate={0: 0},
+          array_params=_AP, no_grad=True, needs_rng=True)
+def _sgld_update(rng, weight, grad, lr=0.1, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Stochastic gradient Langevin dynamics (reference: SGLD optimizer)."""
+    import jax as _jax
+
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    noise = _jax.random.normal(rng, weight.shape, weight.dtype) * jnp.sqrt(lr)
+    return weight - lr / 2 * g + noise
+
+
+@register("dcasgd_update", input_names=("weight", "grad", "prev_weight"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _dcasgd_update(weight, grad, prev_weight, lr=0.01, lamda=0.04, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Delay-compensated async SGD (reference: DCASGD optimizer)."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    w = weight - lr * (g + lamda * g * g * (weight - prev_weight))
+    return w, w
+
+
+@register("group_adagrad_update", input_names=("weight", "grad", "history"),
+          mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0, wd=0.0):
+    """Reference: src/operator/contrib/optimizer_op.cc (GroupAdaGrad) —
+    per-row (group) accumulated squared gradient norm."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    new_hist = history + jnp.mean(jnp.square(g), axis=axes, keepdims=True) \
+        if g.ndim > 1 else history + jnp.square(g)
+    return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
+
+
+@register("lamb_update", input_names=("weight", "grad", "mean", "var"),
+          mutate={0: 0, 1: 2, 2: 3}, array_params=_AP + ("t",), no_grad=True)
+def _lamb_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, wd=0.0, t=1.0, bias_correction=True,
+                 rescale_grad=1.0, clip_gradient=-1.0,
+                 lower_bound=1e-3, upper_bound=10.0):
+    """LAMB layer-wise adaptive large-batch optimizer (TPU-native addition;
+    large-batch training is the TPU regime)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mhat = m / (1 - jnp.power(beta1, t))
+        vhat = v / (1 - jnp.power(beta2, t))
+    else:
+        mhat, vhat = m, v
+    update = mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight
+    wnorm = jnp.linalg.norm(weight)
+    unorm = jnp.linalg.norm(update)
+    trust = jnp.where(jnp.logical_and(wnorm > 0, unorm > 0),
+                      jnp.clip(wnorm, lower_bound, upper_bound) / unorm, 1.0)
+    return weight - lr * trust * update, m, v

@@ -1,0 +1,8 @@
+"""Optimizer package (reference: ``python/mxnet/optimizer/``)."""
+from .optimizer import (Optimizer, Updater, get_updater, create, register,  # noqa: F401
+                        SGD, Signum, SignSGD, FTML, DCASGD, NAG, SGLD, Adam,
+                        AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam,
+                        AdamW, LBSGD, LAMB, Test)
+from . import contrib  # noqa: F401
+
+opt_registry = Optimizer.opt_registry

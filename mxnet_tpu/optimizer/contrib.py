@@ -1,0 +1,32 @@
+"""Contrib optimizers (reference: ``python/mxnet/optimizer/contrib.py``)."""
+from __future__ import annotations
+
+from ..ndarray import zeros
+from ..ops.registry import invoke
+from .optimizer import Optimizer, register
+
+__all__ = ["GroupAdaGrad"]
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with per-row (group) accumulation — used for embeddings
+    (reference: contrib.py GroupAdaGrad over contrib group_adagrad_update)."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        shape = (weight.shape[0],) + (1,) * (len(weight.shape) - 1) \
+            if len(weight.shape) > 1 else weight.shape
+        return zeros(shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        assert self._get_wd(index) == 0, \
+            "Weight decay is not supported for GroupAdaGrad"
+        kwargs = self._common_kwargs(index)
+        kwargs.pop("wd")
+        invoke("group_adagrad_update", [weight, grad, state],
+               dict(epsilon=self.float_stable_eps, **kwargs))

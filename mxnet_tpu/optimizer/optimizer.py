@@ -1,0 +1,667 @@
+"""Optimizers: frontend classes dispatching to fused update ops.
+
+Reference parity: ``python/mxnet/optimizer/optimizer.py`` (17 @register
+classes, SGD:498 ... Nadam:1521, Updater:1608 with fp16 master weights) over
+``src/operator/optimizer_op.cc`` fused kernels.  TPU-native: every
+``update()`` invokes one registered jit'd update op
+(``mxnet_tpu/ops/optimizer_ops.py``); ``lr``/``wd``/step counters are traced
+scalars so schedules never trigger recompilation.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray, zeros
+from ..ops.registry import invoke
+
+__all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "DCASGD", "NAG",
+           "SGLD", "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "AdamW", "LBSGD", "LAMB", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference: ``optimizer.py`` class Optimizer).
+
+    Tracks per-parameter update counts (for time-dependent rules), lr/wd
+    multipliers resolved from parameter attributes, and optional fp16
+    multi-precision master weights.
+    """
+
+    opt_registry: dict = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry --------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("New optimizer %s is overriding existing "
+                            "optimizer %s", klass.__name__, name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # -- state -----------------------------------------------------------
+    def create_state(self, index, weight):
+        """Create optimizer state (momentum etc.) for one parameter."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        if weight.dtype == np.float16 and not self.multi_precision:
+            logging.warning("Accumulating with float16 in optimizer can lead "
+                            "to poor accuracy or slow convergence. Consider "
+                            "using multi_precision=True option.")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            original_state, weight_master_copy = state
+            grad32 = grad.astype(np.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight._set_data(weight_master_copy.astype(weight.dtype).data)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr / wd resolution ----------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret["param_dict"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        self.param_dict = {}
+
+    # -- op dispatch helper ----------------------------------------------
+    def _common_kwargs(self, index):
+        kwargs = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                  "rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        return kwargs
+
+
+register = Optimizer.register  # pylint: disable=invalid-name
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional fp16 master weights
+    (reference: optimizer.py:498, fused ops sgd_update/sgd_mom_update/
+    mp_sgd_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        self._update_count(index)
+        kwargs = self._common_kwargs(index)
+        if not multi_precision:
+            if state is not None:
+                invoke("sgd_mom_update", [weight, grad, state],
+                       dict(momentum=self.momentum, **kwargs))
+            else:
+                invoke("sgd_update", [weight, grad], kwargs)
+        else:
+            mom, weight32 = state
+            if mom is not None:
+                invoke("mp_sgd_mom_update", [weight, grad, mom, weight32],
+                       dict(momentum=self.momentum, **kwargs))
+            else:
+                invoke("mp_sgd_update", [weight, grad, weight32], kwargs)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype == np.float16
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD with momentum (reference: optimizer.py:644)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_kwargs(index)
+        if state is not None:
+            invoke("signum_update", [weight, grad, state],
+                   dict(momentum=self.momentum, wd_lh=self.wd_lh, **kwargs))
+        else:
+            invoke("signsgd_update", [weight, grad], kwargs)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    """Follow-the-moving-leader (reference: optimizer.py:711)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # d
+                zeros(weight.shape, weight.context, dtype=weight.dtype),  # v
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kwargs = self._common_kwargs(index)
+        clip = kwargs.pop("clip_gradient", None)
+        d, v, z = state
+        invoke("ftml_update", [weight, grad, d, v, z],
+               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    t=float(t), clip_grad=clip if clip else -1.0, **kwargs))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:962)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return weight.copy()  # previous weight
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_kwargs(index)
+        invoke("dcasgd_update", [weight, grad, state],
+               dict(lamda=self.lamda, **kwargs))
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated gradient (reference: optimizer.py:1018)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_kwargs(index)
+        if state is not None:
+            invoke("nag_mom_update", [weight, grad, state],
+                   dict(momentum=self.momentum, **kwargs))
+        else:
+            invoke("sgd_update", [weight, grad], kwargs)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py:1070)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        invoke("sgld_update", [weight, grad], self._common_kwargs(index))
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py:1107, fused op adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kwargs = self._common_kwargs(index)
+        # bias correction folded into lr (reference does the same)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        kwargs["lr"] = kwargs["lr"] * math.sqrt(coef2) / coef1
+        mean, var = state
+        invoke("adam_update", [weight, grad, mean, var],
+               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    **kwargs))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py:1191)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        invoke("adagrad_update", [weight, grad, state],
+               dict(epsilon=self.float_stable_eps,
+                    **self._common_kwargs(index)))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, plain (Hinton) or centered (Alex Graves) variant
+    (reference: optimizer.py:1250)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = dict(gamma1=self.gamma1, epsilon=self.epsilon,
+                      **self._common_kwargs(index))
+        if self.clip_weights:
+            kwargs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            invoke("rmsprop_update", [weight, grad, state], kwargs)
+        else:
+            n, g, delta = state
+            invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                   dict(gamma2=self.gamma2, **kwargs))
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py:1328)."""
+
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_d = state
+        kwargs = self._common_kwargs(index)
+        kwargs.pop("lr")
+        invoke("adadelta_update", [weight, grad, acc_g, acc_d],
+               dict(lr=1.0, rho=self.rho, epsilon=self.epsilon, **kwargs))
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: optimizer.py:1388)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # z
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        invoke("ftrl_update", [weight, grad, z, n],
+               dict(lamda1=self.lamda1, beta=self.beta,
+                    **self._common_kwargs(index)))
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference: optimizer.py:1464)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        invoke("adamax_update", [weight, grad, mean, var],
+               dict(beta1=self.beta1, beta2=self.beta2, t=float(t),
+                    **self._common_kwargs(index)))
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py:1521)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mean, var = state
+        invoke("nadam_update", [weight, grad, mean, var],
+               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    t=float(t), m_schedule=self.m_schedule,
+                    schedule_decay=self.schedule_decay,
+                    **self._common_kwargs(index)))
+        self.m_schedule *= momentum_t
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference:
+    src/operator/contrib/adamw.cc via contrib optimizer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.eta = eta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mean, var = state
+        invoke("adamw_update", [weight, grad, mean, var],
+               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    eta=self.eta, **self._common_kwargs(index)))
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (reference: optimizer.py:769; simplified to warmup+momentum SGD)."""
+
+    def __init__(self, momentum=0.9, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB large-batch optimizer (TPU-native addition — the standard choice
+    for large-batch pretraining on pods)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        invoke("lamb_update", [weight, grad, mean, var],
+               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    t=float(t), bias_correction=self.bias_correction,
+                    **self._common_kwargs(index)))
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer for testing (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad).data)
+        state._set_data(weight.data)
+
+
+create = Optimizer.create_optimizer  # pylint: disable=invalid-name
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triples, owning state
+    (reference: optimizer.py:1608; fp16 master weights in states)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices, grads, weights = [index], [grad], [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            elif not self.states_synced[i]:
+                self.states[i] = self.sync_state_context(self.states[i], w.context)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(
+                self.sync_state_context(i, context) for i in state)
+        return state
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(i) for i in s)
+            return s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    """Wrap an optimizer into an updater callable (reference: get_updater)."""
+    return Updater(optimizer)

@@ -1,0 +1,211 @@
+"""Optimizer tests — numpy reference implementations as the oracle
+(reference test strategy: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _run_steps(opt, w0, grads, n=3):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    np.random.seed(0)
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    grads = [np.random.randn(4, 3).astype(np.float32) for _ in range(3)]
+    lr, wd, mom = 0.1, 0.01, 0.9
+
+    opt = mx.optimizer.SGD(learning_rate=lr, wd=wd, momentum=mom)
+    got = _run_steps(opt, w0, grads)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m - lr * (g + wd * w)
+        w = w + m
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    np.random.seed(1)
+    w0 = np.random.randn(5).astype(np.float32)
+    grads = [np.random.randn(5).astype(np.float32) for _ in range(4)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+
+    opt = mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    got = _run_steps(opt, w0, grads)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "rmsprop", "adagrad",
+                                  "adadelta", "ftrl", "adamax", "nadam",
+                                  "nag", "signum", "ftml", "dcasgd", "sgld",
+                                  "adamw", "lamb", "groupadagrad"])
+def test_all_optimizers_step(name):
+    opt = mx.optimizer.create(name, rescale_grad=1.0)
+    w = mx.nd.array(np.ones((3, 2), dtype=np.float32))
+    g = mx.nd.array(np.full((3, 2), 0.5, dtype=np.float32))
+    state = opt.create_state(0, w)
+    before = w.asnumpy().copy()
+    opt.update(0, w, g, state)
+    assert not np.allclose(before, w.asnumpy()), name
+
+
+def test_updater_and_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((2, 2))
+    g = mx.nd.ones((2, 2))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.SGD(learning_rate=0.1,
+                                                     momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(1) == 1.0
+    assert abs(sched(11) - 0.5) < 1e-9
+    assert abs(sched(21) - 0.25) < 1e-9
+
+
+def test_lr_scheduler_warmup():
+    sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[100, 200], factor=0.1, base_lr=1.0, warmup_steps=10,
+        warmup_begin_lr=0.0)
+    assert sched(0) == 0.0
+    assert sched(5) == 0.5
+    assert sched(50) == 1.0
+
+
+def test_lr_in_optimizer_applies_schedule():
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=0.5)
+    opt = mx.optimizer.SGD(learning_rate=0.5, lr_scheduler=sched)
+    w = mx.nd.ones((2,))
+    g = mx.nd.zeros((2,))
+    for _ in range(3):
+        opt.update(0, w, g, opt.create_state(0, w))
+    assert opt._get_lr(0) < 0.5
+
+
+def test_multi_precision_sgd():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    w = mx.nd.ones((4,), dtype="float16")
+    g = mx.nd.ones((4,), dtype="float16")
+    state = opt.create_state_multi_precision(0, w)
+    opt.update_multi_precision(0, w, g, state)
+    assert w.dtype == np.float16
+    # master copy stays fp32
+    assert state[1].dtype == np.float32
+
+
+def test_metric_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3.0) < 1e-6
+
+
+def test_metric_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # both in top-2
+
+
+def test_metric_mse_perplexity_composite():
+    mse = mx.metric.create("mse")
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    ppl.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(ppl.get()[1] - expected) < 1e-5
+
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def test_metric_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_initializers():
+    for init, shape in [(mx.init.Xavier(), (8, 4)),
+                        (mx.init.Normal(0.1), (8, 4)),
+                        (mx.init.Uniform(1.0), (8, 4)),
+                        (mx.init.Orthogonal(), (8, 4)),
+                        (mx.init.MSRAPrelu(), (8, 4)),
+                        (mx.init.One(), (3,)),
+                        (mx.init.Zero(), (3,))]:
+        arr = mx.nd.zeros(shape)
+        init("fc_weight", arr)
+        a = arr.asnumpy()
+        if isinstance(init, mx.init.One):
+            assert (a == 1).all()
+        elif isinstance(init, mx.init.Zero):
+            assert (a == 0).all()
+        else:
+            assert a.std() > 0
+
+
+def test_initializer_name_dispatch():
+    init = mx.init.Xavier()
+    bias = mx.nd.ones((4,))
+    init("fc1_bias", bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = mx.nd.zeros((4,))
+    init("bn_gamma", gamma)
+    assert (gamma.asnumpy() == 1).all()
+
+
+def test_initializer_orthogonal_property():
+    arr = mx.nd.zeros((6, 6))
+    mx.init.Orthogonal(scale=1.0)("q_weight", arr)
+    q = arr.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(6), atol=1e-5)
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*fc2.*", ".*"],
+                         [mx.init.Constant(3.0), mx.init.Uniform(0.1)])
+    w = mx.nd.zeros((4, 2))
+    init("fc2_weight", w)
+    assert (w.asnumpy() == 3.0).all()
+    w2 = mx.nd.zeros((4, 2))
+    init("fc1_weight", w2)
+    assert (numpy_abs_max(w2) <= 0.1)
+
+
+def numpy_abs_max(x):
+    import numpy as np
+    return float(np.abs(x.asnumpy()).max())
