@@ -1,0 +1,19 @@
+"""Dev helper: `import devtools` FIRST in ad-hoc scripts to pin the CPU
+backend (8 virtual devices) without dialing the axon TPU tunnel.  Mirrors
+tests/conftest.py; see that file for why the deregistration is needed."""
+import os
+
+if not os.environ.get("MXTPU_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("tpu", None)
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    _jax.config.update("jax_default_matmul_precision", "highest")

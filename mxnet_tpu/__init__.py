@@ -26,3 +26,7 @@ from . import lr_scheduler  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import optimizer as opt  # noqa: F401
 from . import metric  # noqa: F401
+from . import kvstore  # noqa: F401
+from .kvstore import create as _kv_create  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import gluon  # noqa: F401

@@ -1,0 +1,700 @@
+"""Gluon Block / HybridBlock.
+
+Reference parity: ``python/mxnet/gluon/block.py`` (Block:127, HybridBlock:671,
+_build_cache:748 tracing into a CachedOp, export:868, SymbolBlock:952).
+
+TPU-native CachedOp redesign: hybridization does not build an nnvm graph.
+Instead the block's imperative ``hybrid_forward`` is captured as ONE pure jax
+function ``fn(rng, *inputs, *params, *auxs) -> (*outputs, *new_auxs)`` and
+registered as a framework op:
+
+* forward = one ``jax.jit`` XLA module (shape-keyed cache — the analogue of the
+  reference's static_alloc pre-planned CachedOp, ``cached_op.cc:690``);
+* the op is recorded on the autograd tape as a single node, so backward also
+  compiles to one fused module (tape replay re-traces the python forward);
+* aux state (BatchNorm running stats) rides along as extra outputs written
+  back by the dispatcher's ``mutate`` mechanism — the reference's
+  ``FMutateInputs`` semantics without aliasing;
+* rng is threaded explicitly (dropout masks differ per call even inside jit).
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+import numpy as np
+
+from .. import autograd, ndarray as nd
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+from ..ops.registry import OpDef, invoke
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name-scope manager for Blocks (reference: block.py:35)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_counter(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_NAME_COUNTERS = {}
+
+
+def _name_counter(hint):
+    count = _NAME_COUNTERS.get(hint, 0)
+    _NAME_COUNTERS[hint] = count + 1
+    return "%s%d" % (hint, count)
+
+
+def _flatten_arrays(args):
+    """Flatten nested lists/tuples of NDArrays; returns (flat, fmt)."""
+    if isinstance(args, NDArray):
+        return [args], 0
+    if args is None:
+        return [], -1
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock inputs must be (nested) NDArrays, got %s" % type(args)
+    flat, fmts = [], []
+    for a in args:
+        f, fmt = _flatten_arrays(a)
+        flat.extend(f)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup_arrays(flat, fmt):
+    if fmt == 0:
+        return flat[0], flat[1:]
+    if fmt == -1:
+        return None, flat
+    ret = []
+    for f in fmt:
+        res, flat = _regroup_arrays(flat, f)
+        ret.append(res)
+    return ret, flat
+
+
+class Block:
+    """Base class for all neural network layers and models
+    (reference: gluon/block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+        self._hook_counter = 0
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    "Changing attribute type for {name} from {type1} to "
+                    "{type2} is not allowed.".format(
+                        name=name, type1=type(existing), type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                "Overriding Parameter attribute %s is not allowed. If you " \
+                "want to share parameters between blocks, please set " \
+                "'params' at Block construction instead." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- naming -----------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    # -- params -----------------------------------------------------------
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """Return a ParameterDict of this block's and children's Parameters,
+        optionally filtered by regex ``select`` (reference: block.py
+        collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- serialization ----------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """Save parameters to file (reference: block.py:315 — params only,
+        load back with load_parameters)."""
+        params = self._collect_params_with_prefix()
+        if deduplicate:
+            # keep one key per shared Parameter object
+            seen = {}
+            params = {k: v for k, v in params.items()
+                      if seen.setdefault(id(v), k) == k}
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """Load parameters from file (reference: block.py:356)."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in i for i in loaded.keys()):
+            # legacy format: full-name keys via collect_params().save
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s', which contains " \
+                    "parameters: %s. Set allow_missing=True to ignore missing "\
+                    "parameters." % (name, filename, _brief_print_list(loaded.keys()))
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present in "
+                    "this block's ParameterDict, which contains parameters %s."
+                    " Set ignore_extra=True to ignore." % (
+                        name, filename, _brief_print_list(params.keys())))
+            if name in params:
+                param = params[name]
+                src = loaded[name]
+                if cast_dtype:
+                    if dtype_source == "current":
+                        src = src.astype(param.dtype)
+                    elif dtype_source == "saved":
+                        param.cast(src.dtype)
+                param._load_init_data(src, ctx)
+
+    # alias (deprecated reference names)
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- structure --------------------------------------------------------
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_counter += 1
+        handle = _HookHandle(self._forward_pre_hooks, self._hook_counter)
+        self._forward_pre_hooks[self._hook_counter] = hook
+        return handle
+
+    def register_forward_hook(self, hook):
+        self._hook_counter += 1
+        handle = _HookHandle(self._forward_hooks, self._hook_counter)
+        self._forward_hooks[self._hook_counter] = hook
+        return handle
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            from .. import initializer
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def summary(self, *inputs):
+        """Print a summary of the Block (reference: block.py summary)."""
+        rows = []
+
+        def count(block, indent):
+            n = sum(int(np.prod(p.shape)) for p in block._reg_params.values()
+                    if p.shape)
+            rows.append(("  " * indent + block.__class__.__name__, n))
+            for c in block._children.values():
+                count(c, indent + 1)
+
+        count(self, 0)
+        total = sum(r[1] for r in rows)
+        print("%-40s %s" % ("Layer", "Params"))
+        print("-" * 52)
+        for name_, n in rows:
+            print("%-40s %d" % (name_, n))
+        print("-" * 52)
+        print("Total params: %d" % total)
+
+    # -- execution --------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class _HookHandle:
+    def __init__(self, hooks_dict, key):
+        self._hooks_dict = hooks_dict
+        self._key = key
+
+    def detach(self):
+        self._hooks_dict.pop(self._key, None)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    if len(lines) == 1:
+        return s_
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return _brief_print_list(lst[:limit // 2], limit) + ", ..., " + \
+            _brief_print_list(lst[-limit // 2:], limit)
+    return ", ".join("'%s'" % str(i) for i in lst)
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock + CachedOp
+# ---------------------------------------------------------------------------
+_trace_state = threading.local()
+
+
+def _in_trace():
+    return getattr(_trace_state, "active", 0) > 0
+
+
+class _CachedOp:
+    """The compiled callable behind a hybridized block (reference:
+    ``src/imperative/cached_op.cc``; see module docstring for the TPU-native
+    design)."""
+
+    def __init__(self, block):
+        self._block = block
+        self._opdef = None
+        self._param_list = None   # Parameters with grad
+        self._aux_list = None     # Parameters with grad_req null (mutable state)
+        self._out_fmt = None
+        self._n_out = None
+
+    def _build(self, flat_fmt, n_inputs):
+        block = self._block
+        params = [p for p in block.collect_params().values()]
+        self._param_list = [p for p in params if p.grad_req != "null"]
+        self._aux_list = [p for p in params if p.grad_req == "null"]
+        n_param = len(self._param_list)
+        n_aux = len(self._aux_list)
+        cached = self
+
+        def pure_fn(rng, *arrays, _train=False):
+            from .. import random as _random
+
+            inputs = arrays[:n_inputs]
+            pdatas = arrays[n_inputs:n_inputs + n_param]
+            adatas = arrays[n_inputs + n_param:]
+            in_nds = [NDArray(a) for a in inputs]
+            p_nds = [NDArray(a) for a in pdatas]
+            a_nds = [NDArray(a) for a in adatas]
+            args, rest = _regroup_arrays(in_nds, flat_fmt)
+            assert not rest
+            scope = autograd.pause(train_mode=_train)
+            _trace_state.active = getattr(_trace_state, "active", 0) + 1
+            try:
+                with scope, _random.key_source(rng):
+                    with _ParamSubstitution(cached._param_list, p_nds,
+                                            cached._aux_list, a_nds):
+                        out = block.forward(*args) if isinstance(args, list) \
+                            else block.forward(args)
+            finally:
+                _trace_state.active -= 1
+            flat_out, out_fmt = _flatten_arrays(out)
+            cached._out_fmt = out_fmt
+            cached._n_out = len(flat_out)
+            # aux state rides along as extra outputs (mutate writes it back)
+            return tuple(o.data for o in flat_out) + \
+                tuple(a.data for a in a_nds)
+
+        mutate = {}
+        # filled after first call when _n_out is known; conservatively map all
+        # aux outputs — indices are appended after the real outputs
+        self._opdef = OpDef("_CachedOp_%s" % block.name, pure_fn,
+                            needs_rng=True, train_aware=True, mutate=mutate,
+                            no_grad=False)
+        self._n_inputs = n_inputs
+
+    def __call__(self, *flat_args_and_fmt):
+        flat, fmt = flat_args_and_fmt
+        if self._opdef is None:
+            self._build(fmt, len(flat))
+        params = self._param_list
+        auxs = self._aux_list
+        pds = [p.data() for p in params]
+        ads = [a.data() for a in auxs]
+        inputs = list(flat) + pds + ads
+        if self._n_out is None:
+            # first call: trace eagerly once to learn output structure
+            from .. import random as _random
+            from ..ops.registry import split_params
+
+            datas = [x.data for x in inputs]
+            rng = _random.next_key()
+            train = autograd.is_training()
+            res = self._opdef.call(datas, {}, rng=rng, train=train)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            # now _n_out/_out_fmt are set; fall through to set mutate and
+            # record properly by re-invoking (cheap: jit cache hit)
+            n_out = self._n_out
+            for j in range(len(auxs)):
+                self._opdef.mutate[n_out + j] = len(flat) + len(params) + j
+        outputs = invoke(self._opdef, inputs, {})
+        if not isinstance(outputs, (list, tuple)):
+            outputs = [outputs]
+        real = outputs[:self._n_out]
+        out, rest = _regroup_arrays(list(real), self._out_fmt)
+        return out
+
+
+class _ParamSubstitution:
+    """During a CachedOp trace, make Parameter.data() return the traced
+    stand-in arrays instead of the concrete ones."""
+
+    def __init__(self, params, p_nds, auxs, a_nds):
+        self._pairs = list(zip(params, p_nds)) + list(zip(auxs, a_nds))
+
+    def __enter__(self):
+        for p, ndarr in self._pairs:
+            p._trace_data = ndarr
+        _ParamSubstitution._install()
+        return self
+
+    def __exit__(self, *a):
+        for p, _ in self._pairs:
+            if hasattr(p, "_trace_data"):
+                del p._trace_data
+
+    _installed = False
+
+    @staticmethod
+    def _install():
+        if _ParamSubstitution._installed:
+            return
+        _ParamSubstitution._installed = True
+        orig_data = Parameter.data
+        orig_list_data = Parameter.list_data
+
+        def data(self, ctx=None):
+            t = getattr(self, "_trace_data", None)
+            if t is not None and _in_trace():
+                return t
+            return orig_data(self, ctx)
+
+        def list_data(self):
+            t = getattr(self, "_trace_data", None)
+            if t is not None and _in_trace():
+                return [t]
+            return orig_list_data(self)
+
+        Parameter.data = data
+        Parameter.list_data = list_data
+
+
+class HybridBlock(Block):
+    """A Block that can be compiled ("hybridized") into one XLA module
+    (reference: gluon/block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Activate compiled execution.  ``static_alloc``/``static_shape``
+        accepted for API parity (XLA always plans memory statically)."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._clear_cached_op()
+        for cld in self._children.values():
+            cld.hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Infer (and set) parameter shapes from inputs — per-layer hooks
+        override ``_infer_shape_from_input``; containers recurse through a
+        dry run."""
+        self._deferred_infer_shape(*args)
+
+    def _infer_shape_from_input(self, *args):
+        return None
+
+    def _deferred_infer_shape(self, *args):
+        """Resolve deferred-init params by a host-level abstract dry run:
+        run forward with zero-size-safe eager arrays, letting each layer's
+        ``_infer_shape_from_input`` hook set its param shapes just-in-time.
+        (reference: symbolic infer_shape pass, graph_executor.cc:371)."""
+        try:
+            self._shape_probe(*args)
+        except DeferredInitializationError as e:
+            raise RuntimeError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred: %s" % e) from e
+
+    def _shape_probe(self, *args):
+        # run the imperative forward; layers with deferred params implement
+        # _infer_shape_from_input and finish their params' init lazily
+        return self.forward(*args)
+
+    def export(self, path, epoch=0):
+        """Export model params for serving (reference: block.py:868 writes
+        symbol JSON + params; here: params + a structure descriptor)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {"arg:" + k: v._reduce() for k, v in params.items()}
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+
+    def forward(self, x, *args):
+        """Defers to ``hybrid_forward`` with resolved params
+        (reference: block.py:901)."""
+        if isinstance(x, NDArray):
+            ctx = x.context
+        else:
+            ctx = current_context()
+        if self._active and not _in_trace():
+            if self._cached_op is None:
+                self._ensure_init(ctx, x, *args)
+                self._cached_op = _CachedOp(self)
+            flat, fmt = _flatten_arrays([x, *args] if args else x)
+            return self._cached_op(flat, fmt)
+        try:
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(ctx, x, *args)
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _ensure_init(self, ctx, x, *args):
+        try:
+            for v in self.collect_params().values():
+                if v._data is None:
+                    v._check_and_get(v._data, ctx)
+        except DeferredInitializationError:
+            # one imperative dry run resolves every deferred param
+            self._call_imperative_once(ctx, x, *args)
+
+    def _call_imperative_once(self, ctx, x, *args):
+        active = self._active
+        try:
+            self._deactivate_tree()
+            with autograd.pause():
+                self.forward(x, *args)
+        finally:
+            self._reactivate_tree(active)
+
+    def _deactivate_tree(self):
+        self._saved_active = self._active
+        self._active = False
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c._deactivate_tree()
+
+    def _reactivate_tree(self, active):
+        self._active = getattr(self, "_saved_active", active)
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c._reactivate_tree(active)
+
+    def _finish_deferred(self, ctx, x, *args):
+        shape = self._infer_shape_from_input(x, *args)
+        if shape is not None:
+            for name, dims in shape.items():
+                p = self._reg_params[name]
+                p.shape = dims
+                p._finish_deferred_init()
+        else:
+            raise DeferredInitializationError(
+                "%s has deferred-initialized parameters but does not "
+                "implement _infer_shape_from_input" % self.name)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol (reference: block.py:952).  Requires
+    the symbolic frontend; constructed via ``SymbolBlock.imports`` or from a
+    Symbol + input variables."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from .. import symbol as sym
+
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(inputs, sym.Symbol):
+            inputs = [inputs]
+        self._output_sym = outputs
+        self._input_syms = inputs
+        input_names = {i.name for i in inputs}
+        # free variables of the graph become this block's parameters
+        for name in outputs.list_inputs():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        self._reg_params = {k[len(self.prefix):] if k.startswith(self.prefix)
+                            else k: v for k, v in self.params.items()}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym
+
+        output = sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym.var(i) for i in input_names]
+        ret = SymbolBlock(output, inputs)
+        if param_file is not None:
+            # strip arg:/aux: prefixes
+            loaded = nd.load(param_file)
+            data = {}
+            for k, v in loaded.items():
+                data[k.split(":", 1)[-1]] = v
+            for name, param in ret.params.items():
+                if name in data:
+                    param._load_init_data(data[name], ctx)
+        return ret
+
+    def forward(self, x, *args):
+        from .. import symbol as sym
+
+        ctx = x.context if isinstance(x, NDArray) else current_context()
+        arg_dict = {}
+        for s, v in zip(self._input_syms, [x] + list(args)):
+            arg_dict[s.name] = v
+        for name, p in self.params.items():
+            arg_dict[name] = p.data(ctx)
+        ex = self._output_sym._eval(arg_dict)
+        return ex[0] if len(ex) == 1 else ex
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

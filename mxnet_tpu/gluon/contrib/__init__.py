@@ -1,0 +1,1 @@
+"""Gluon contrib (reference: ``python/mxnet/gluon/contrib/``)."""

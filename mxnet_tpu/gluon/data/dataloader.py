@@ -1,0 +1,99 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``).
+
+Reference design: fork workers + POSIX-shm NDArray rebuild.  TPU-native
+design: the default path batches on host numpy and device_puts once per batch
+(HBM transfers are the bottleneck — one transfer per batch, not per sample);
+``num_workers > 0`` uses a thread pool for decode/augment overlap (the Python
+work releases the GIL in numpy/PIL), which composes with XLA's async dispatch
+without fork-safety issues.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype if data.dtype != np.float64
+                    else np.float32)
+
+
+class DataLoader:
+    """Loads data from a Dataset and returns mini-batches
+    (reference: dataloader.py DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            batchify_fn = default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+
+        # thread-pool pipeline with bounded prefetch
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            def fetch(batch):
+                return self._batchify_fn([self._dataset[i] for i in batch])
+
+            batches = iter(self._batch_sampler)
+            pending = []
+            try:
+                for _ in range(self._prefetch or 1):
+                    pending.append(pool.submit(fetch, next(batches)))
+            except StopIteration:
+                pass
+            while pending:
+                out = pending.pop(0).result()
+                try:
+                    pending.append(pool.submit(fetch, next(batches)))
+                except StopIteration:
+                    pass
+                yield out
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,5 @@
+"""Vision datasets and transforms (reference:
+``python/mxnet/gluon/data/vision/``)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,  # noqa: F401
+                       ImageRecordDataset, ImageFolderDataset)
+from . import transforms  # noqa: F401

@@ -1,0 +1,203 @@
+"""Vision datasets (reference:
+``python/mxnet/gluon/data/vision/datasets.py``).  No network egress in this
+environment: datasets read standard local files (idx-ubyte for MNIST,
+python pickles for CIFAR, RecordIO for ImageRecordDataset)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from .... import ndarray as nd
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        super().__init__()
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx-ubyte files (reference: datasets.py MNIST)."""
+
+    _TRAIN = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _TEST = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        images, labels = self._TRAIN if self._train else self._TEST
+        data_file = self._resolve(images)
+        label_file = self._resolve(labels)
+        with self._open(label_file) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with self._open(data_file) as fin:
+            _, _, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), rows, cols, 1)
+        self._data = nd.array(data, dtype=data.dtype)
+        self._label = label
+
+    def _resolve(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise RuntimeError(
+            "MNIST file %s not found under %s (no network egress; place the "
+            "idx-ubyte files there manually)" % (base, self._root))
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (same idx format, different files)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from local python-pickle batches (reference: CIFAR10)."""
+
+    _NCLASS = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return ["data_batch_%d" % i for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        if not os.path.isdir(base):
+            base = self._root
+        data, label = [], []
+        for b in self._batches():
+            p = os.path.join(base, b)
+            if not os.path.exists(p):
+                raise RuntimeError(
+                    "CIFAR batch %s not found under %s (no network egress)"
+                    % (b, base))
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32))
+            label.append(np.asarray(d.get(b"labels", d.get(b"fine_labels"))))
+        data = np.concatenate(data).transpose(0, 2, 3, 1)  # NHWC uint8
+        self._data = nd.array(data, dtype=np.uint8)
+        self._label = np.concatenate(label).astype(np.int32)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference: CIFAR100)."""
+
+    _NCLASS = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        return ["train" if self._train else "test"]
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels packed in a RecordIO file (reference:
+    ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """A dataset of images arranged in class folders (reference:
+    ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from .... import image
+
+        with open(self.items[idx][0], "rb") as f:
+            img = image.imdecode(f.read(), self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,253 @@
+"""Vision transforms (reference:
+``python/mxnet/gluon/data/vision/transforms.py``).  Transforms operate on
+per-sample HWC NDArrays on host (decode-time augmentation, like the
+reference's CPU augmenters) — the device only ever sees batched tensors."""
+from __future__ import annotations
+
+import numpy as np
+
+from .... import ndarray as nd
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential, Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Sequentially composes transforms (reference: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for i in transforms:
+            self.add(i)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1) (reference: ToTensor)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 3:
+            return F.cast(F.transpose(x, axes=(2, 0, 1)),
+                          dtype="float32") / 255.0
+        return F.cast(F.transpose(x, axes=(0, 3, 1, 2)),
+                      dtype="float32") / 255.0
+
+
+class Normalize(HybridBlock):
+    """Channelwise (x - mean) / std on CHW tensors (reference: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = np.asarray(self._mean, np.float32).reshape(-1, 1, 1)
+        std = np.asarray(self._std, np.float32).reshape(-1, 1, 1)
+        return (x - nd.array(mean, ctx=x.context)) / \
+            nd.array(std, ctx=x.context)
+
+
+class Resize(Block):
+    """Resize HWC image (reference: Resize; PIL-free bilinear on host)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+        w, h = self._size
+        out = _resize_bilinear(img, h, w)
+        return nd.array(out, dtype=img.dtype)
+
+
+def _resize_bilinear(img, out_h, out_w):
+    in_h, in_w = img.shape[:2]
+    if (in_h, in_w) == (out_h, out_w):
+        return img.copy()
+    ys = (np.arange(out_h) + 0.5) * in_h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * in_w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, in_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, in_w - 1)
+    y1 = np.clip(y0 + 1, 0, in_h - 1)
+    x1 = np.clip(x0 + 1, 0, in_w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+        w, h = self._size
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = _resize_bilinear(img, max(h, ih), max(w, iw))
+            ih, iw = img.shape[:2]
+        y0 = (ih - h) // 2
+        x0 = (iw - w) // 2
+        return nd.array(img[y0:y0 + h, x0:x0 + w], dtype=img.dtype)
+
+
+class RandomResizedCrop(Block):
+    """Random crop w/ area+aspect jitter then resize (reference:
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = np.random.randint(0, w - cw + 1)
+                y0 = np.random.randint(0, h - ch + 1)
+                crop = img[y0:y0 + ch, x0:x0 + cw]
+                return nd.array(_resize_bilinear(crop, self._size[1],
+                                                 self._size[0]),
+                                dtype=img.dtype)
+        # fallback: center crop
+        return CenterCrop(self._size).forward(nd.array(img, dtype=img.dtype))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            img = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+            return nd.array(img[:, ::-1].copy(), dtype=img.dtype)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            img = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+            return nd.array(img[::-1].copy(), dtype=img.dtype)
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _alpha(self):
+        return 1.0 + np.random.uniform(-self._amount, self._amount)
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, nd.NDArray)
+               else np.asarray(x)).astype(np.float32)
+        out = self._jitter(img)
+        return nd.array(np.clip(out, 0, 255) if img.max() > 1 else out,
+                        dtype=np.float32)
+
+    def _jitter(self, img):
+        raise NotImplementedError
+
+
+class RandomBrightness(_RandomJitter):
+    def _jitter(self, img):
+        return img * self._alpha()
+
+
+class RandomContrast(_RandomJitter):
+    def _jitter(self, img):
+        gray = img.mean()
+        return img * self._alpha() + gray * (1 - self._alpha())
+
+
+class RandomSaturation(_RandomJitter):
+    def _jitter(self, img):
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        alpha = self._alpha()
+        gray = (img * coef).sum(axis=2, keepdims=True)
+        return img * alpha + gray * (1 - alpha)
+
+
+class RandomHue(_RandomJitter):
+    def _jitter(self, img):
+        alpha = np.random.uniform(-self._amount, self._amount)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], np.float32)
+        t = np.array([[0.299, 0.587, 0.114], [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621], [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        m = ityiq @ bt @ t
+        return img @ m.T
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: RandomLighting)."""
+
+    _EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.814],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, nd.NDArray)
+               else np.asarray(x)).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        rgb = (self._EIGVEC * alpha * self._EIGVAL).sum(axis=1)
+        return nd.array(img + rgb, dtype=np.float32)

@@ -1,0 +1,480 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference parity: ``python/mxnet/gluon/parameter.py`` (Parameter:43 with
+deferred init, grad_req, lr_mult/wd_mult; ParameterDict:632 with prefix
+namespacing, sharing, save/load).  TPU-native: a Parameter holds one NDArray
+per context; under sharded execution the data lives as one ``jax.Array`` with
+a ``NamedSharding`` instead of per-device replicas (list_ctx then reports the
+mesh devices).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import autograd, initializer, ndarray as nd
+from ..context import Context, cpu, current_context
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError"]
+
+
+class DeferredInitializationError(Exception):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    """A Container holding parameters (weights) of Blocks
+    (reference: gluon/parameter.py:43)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError("invalid stype %s" % stype)
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self.grad_req = grad_req
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    # -- properties -------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), \
+            "grad_req must be one of 'write', 'add', or 'null', but got %s" % req
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null" and self._grad is not None:
+            self._grad = None
+            if self._data is not None:
+                for d in self._data:
+                    autograd.mark_variables([d], [None], "null")
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            "Expected shape %s is incompatible with given shape %s." % (
+                str(new_shape), str(self._shape))
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # -- init -------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        self._deferred_init = ()
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self._shape is None or np.prod(self._shape) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self._shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self._shape is not None and np.prod(self._shape) > 0, \
+            "Cannot initialize Parameter '%s' because it has invalid shape: " \
+            "%s. Please specify in_units, in_channels, etc for `Block`s." % (
+                self.name, str(self._shape))
+        with autograd.pause():
+            if data is None:
+                data = nd.zeros(self._shape, dtype=self.dtype, ctx=cpu())
+                # reference semantics (_finish_deferred_init): a param-specific
+                # init goes into the InitDesc and bypasses name dispatch; the
+                # global/default init dispatches by name pattern
+                desc = initializer.InitDesc(
+                    self.name, {"__init__": init} if init is not default_init
+                    and init is not None else {})
+                default_init(desc, data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list)
+        self._data = [data.as_in_context(c) for c in self._ctx_list]
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = [nd.zeros(d.shape, ctx=d.context, dtype=d.dtype)
+                      for d in self._data]
+        for d, g in zip(self._data, self._grad):
+            autograd.mark_variables([d], [g], self.grad_req)
+
+    def _reduce(self):
+        """Average data across contexts (for save)."""
+        if self._stype == "default":
+            block = self.list_data()
+            if len(block) == 1:
+                return block[0].copyto(cpu())
+            out = block[0].copyto(cpu())
+            for b in block[1:]:
+                out += b.as_in_context(cpu())
+            return out / len(block)
+        return self.list_data()[0]
+
+    # -- data access ------------------------------------------------------
+    def _check_and_get(self, arr_list, ctx):
+        if arr_list is not None:
+            if ctx is list:
+                return arr_list
+            if ctx is None:
+                if len(arr_list) == 1:
+                    return arr_list[0]
+                ctx = current_context()
+            ctx_list = self._ctx_list or []
+            for a, c in zip(arr_list, ctx_list):
+                if c == ctx:
+                    return a
+            # device-type match (tpu(0) vs gpu(0) alias)
+            for a, c in zip(arr_list, ctx_list):
+                if c.device_id == ctx.device_id:
+                    return a
+            raise RuntimeError(
+                "Parameter '%s' was not initialized on context %s. It was "
+                "only initialized on %s." % (self.name, str(ctx),
+                                             str(self._ctx_list)))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." %
+                self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with Block.collect_params() "
+            "instead of Block.params because the later does not include "
+            "Parameters of nested child Blocks" % self.name)
+
+    def data(self, ctx=None):
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter '%s' has not been initialized"
+                               % self.name)
+        return self._ctx_list
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g[:] = 0
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            self._finish_deferred_init()
+            return
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        for d in self._data:
+            d._set_data(data.as_in_context(d.context).astype(d.dtype).data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            with autograd.pause():
+                self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError("Cannot reset context for Parameter '%s' because "
+                             "it has not been initialized." % self.name)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = [i.astype(dtype) for i in self._data]
+            if self._grad is not None:
+                self._init_grad()
+
+    # -- symbolic bridge --------------------------------------------------
+    def var(self):
+        from .. import symbol as sym
+        if self._var is None:
+            self._var = sym.var(self.name, shape=self.shape, dtype=self.dtype,
+                                lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                                init=self.init)
+        return self._var
+
+
+class Constant(Parameter):
+    """A constant parameter (grad_req='null')
+    (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=initializer.Constant(value))
+
+
+class ParameterDict:
+    """A dictionary managing a set of Parameters with prefix namespacing
+    (reference: gluon/parameter.py:632)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create a Parameter named prefix+name."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    assert v is None or str(v) == str(existing), \
+                        "Cannot retrieve Parameter '%s' because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "'%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '{}'. Please specify value "
+                               "if you want to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                "Parameter '{}' already exists but it is not a constant.".format(name)
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for i in self.values():
+            i.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for i in self.values():
+            i.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for i in self.values():
+            setattr(i, name, value)
+
+    # -- serialization ----------------------------------------------------
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'." % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        from ..ndarray import utils as nd_utils
+        nd_utils.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not "\
+                    "start with '%s'" % (restore_prefix, name, restore_prefix)
+        lprefix = len(restore_prefix)
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init_data(arg_dict[name], ctx)
+
+
+def _load_init_data(param, data, ctx):
+    if param.shape is not None:
+        unknown = any(s == 0 for s in param.shape)
+        if not unknown and tuple(param.shape) != tuple(data.shape):
+            raise ValueError(
+                "Failed loading Parameter '%s' from saved params: shape "
+                "incompatible expected %s vs saved %s" % (
+                    param.name, str(param.shape), str(data.shape)))
+    if ctx is None:
+        ctx = [current_context()]
+    if isinstance(ctx, Context):
+        ctx = [ctx]
+    if param._data is None:
+        param._shape = tuple(data.shape)
+        with autograd.pause():
+            param._init_impl(data, ctx)
+        param._deferred_init = ()
+    else:
+        param.set_data(data)
+
+
+Parameter._load_init_data = _load_init_data

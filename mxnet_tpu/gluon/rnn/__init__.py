@@ -1,0 +1,5 @@
+"""Recurrent layers and cells (reference: ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,  # noqa: F401
+                       GRUCell, SequentialRNNCell, DropoutCell, ModifierCell,
+                       ZoneoutCell, ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401

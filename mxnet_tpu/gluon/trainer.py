@@ -1,0 +1,173 @@
+"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+
+Reference parity: ``python/mxnet/gluon/trainer.py`` (Trainer:27,
+_init_kvstore:169, step:302, _allreduce_grads:353).  TPU-native: gradient
+"allreduce" across local contexts is a sum on-device; for sharded (pjit)
+training the grads are already mesh-reduced by XLA collectives, so the Trainer
+just runs the fused update ops.  KVStore veneers plug in via ``kvstore=``
+(``mxnet_tpu.kvstore``).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Applies an optimizer over a set of parameters
+    (reference: gluon/trainer.py:27)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params),))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param),))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer(self)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._contains_sparse = False
+
+    @property
+    def _optimizer(self):
+        return self._updaters[0].optimizer if self._updaters else None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            optimizer.param_dict = param_dict
+            self._updaters = [opt.get_updater(optimizer)]
+        else:
+            optimizer = opt.create(optimizer, param_dict=param_dict,
+                                   **optimizer_params)
+            self._updaters = [opt.get_updater(optimizer)]
+
+    def _set_trainer_noop(self):
+        pass
+
+    def _init_kvstore(self):
+        from .. import kvstore as kvs
+
+        config = self._kvstore_params
+        kv = config["kvstore"]
+        if isinstance(kv, str):
+            if kv and any(p.list_ctx() and len(p.list_ctx()) > 1
+                          for p in self._params):
+                kv = kvs.create(kv)
+            else:
+                kv = None
+        self._kvstore = kv
+        self._update_on_kvstore = bool(
+            config["update_on_kvstore"]) if config["update_on_kvstore"] \
+            is not None else False
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                self._kvstore.init(i, param.list_data()[0])
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning"
+                              " rate can be accessed.")
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
+            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning"
+                              " rate is mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Make one parameter update: rescale by 1/batch_size, reduce grads
+        across devices, apply updates (reference: trainer.py:302)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+                continue
+            for upd, arr, grad in zip(
+                    self._updaters * len(param.list_data()),
+                    param.list_data(), param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        """Save optimizer (updater) states (reference: trainer.save_states)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._updaters[0].optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}
+
+
+def _set_trainer(self, trainer):
+    # Parameters keep a backref so sparse pulls can route through the trainer
+    # (reference: parameter.py _set_trainer); dense TPU path only records it.
+    self._trainer = trainer
+
+
+Parameter._set_trainer = _set_trainer

@@ -63,8 +63,13 @@ class Initializer:
             desc.global_init = self
         init = desc.attrs.get("__init__", "")
         if init:
-            klass, kwargs = json.loads(init)
-            _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
+            if isinstance(init, Initializer):
+                init._init_weight(desc, arr)
+            elif isinstance(init, str) and init.lower() in _INIT_REGISTRY:
+                _INIT_REGISTRY[init.lower()]()._init_weight(desc, arr)
+            else:
+                klass, kwargs = json.loads(init)
+                _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
             return
         name = desc.lower()
         if name.endswith("weight"):

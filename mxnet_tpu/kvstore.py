@@ -1,0 +1,248 @@
+"""KVStore: key-value store for parameter synchronization.
+
+Reference parity: ``python/mxnet/kvstore.py`` (KVStore:97 init/push/pull/
+row_sparse_pull/set_optimizer) over ``src/kvstore/`` (CommCPU/CommDevice
+reduce, KVStoreNCCL, ps-lite KVStoreDist; SURVEY.md §2 kvstore rows).
+
+TPU-native redesign: there are no NCCL rings or parameter servers to manage —
+* ``local``/``device``: in-process multi-device gradient aggregation; the
+  reduce is a jnp sum after device transfer (XLA schedules the ICI/PCIe
+  copies; the reference's CommDevice tree topology logic is unnecessary).
+* ``dist_sync``/``dist_device_sync``/``dist_async``/``dist_tpu``: map to
+  SPMD collectives.  Under ``jax.distributed`` (multi-host), the push reduce
+  becomes a ``jax.lax.psum`` over the 'hosts' axis of a global mesh
+  (BASELINE.json north star: dist_tpu ⇒ psum over ICI).  On a single host it
+  degrades to the local path, which keeps ``tools/launch.py``-style scripts
+  runnable anywhere.
+* ``row_sparse_pull`` keeps its API; rows are gathered densely (XLA has no
+  sparse HBM layout — SURVEY.md §7 hard part (b)).
+
+The update can run "on the kvstore" (reference: server-side optimizer,
+``kvstore_dist_server.h``) — here that simply means the kvstore owns the
+Updater and pull returns updated weights.
+"""
+from __future__ import annotations
+
+import pickle
+
+from . import ndarray as nd
+from . import optimizer as opt
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctx_key(ctx):
+    return (ctx.device_type, ctx.device_id)
+
+
+class KVStore:
+    """Single-process key-value store (reference: kvstore.py KVStore)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._data = {}
+        self._updater = None
+        self._update_on_kvstore_flag = False
+        self._compression_params = None
+        self._str_key_dict = {}
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """This worker's rank (reference: kvstore.rank).  Multi-host: the
+        jax process index."""
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    # -- init -------------------------------------------------------------
+    def init(self, key, value):
+        """Initialize a key with a value (reference: kvstore.init)."""
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        value = value if isinstance(value, NDArray) else value[0]
+        self._data[key] = value.copy()
+
+    # -- push / pull ------------------------------------------------------
+    def push(self, key, value, priority=0):
+        """Push (a list of per-device) values; they are reduced into the
+        store (reference: kvstore.push; CommDevice::Reduce semantics)."""
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        if isinstance(value, NDArray):
+            value = [value]
+        assert key in self._data, \
+            "please init \"%s\" before push" % str(key)
+        reduced = self._reduce(value)
+        if self._compression_params is not None:
+            reduced = self._compress_decompress(key, reduced)
+        if self._updater is not None and self._update_on_kvstore_flag:
+            idx = key if isinstance(key, int) else self._str_index(key)
+            self._updater(idx, reduced, self._data[key])
+        else:
+            self._data[key]._set_data(reduced.data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull the stored value into each output array
+        (reference: kvstore.pull; Comm::Broadcast semantics)."""
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        assert key in self._data, \
+            "please init \"%s\" before pull" % str(key)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        src = self._data[key]
+        for o in outs:
+            o._set_data(src.as_in_context(o.context).data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference: kvstore pushpull, the dist_tpu fast
+        path — one collective instead of two phases)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference: kvstore.row_sparse_pull;
+        dense gather under XLA)."""
+        assert row_ids is not None, "row_ids is required"
+        if isinstance(key, (list, tuple)):
+            for k, o, r in zip(key, out, row_ids):
+                self.row_sparse_pull(k, o, priority, r)
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        src = self._data[key]
+        for o, r in zip(outs, rids):
+            rows = nd.take(src, r.astype("int32"))
+            full = nd.zeros(src.shape, ctx=o.context, dtype=src.dtype)
+            idx = r.astype("int32")
+            full[idx] = rows
+            o._set_data(full.data)
+
+    # -- reduce -----------------------------------------------------------
+    def _reduce(self, values):
+        """Sum a list of per-device arrays.  Multi-host dist types add a
+        cross-process psum (SPMD collective over ICI/DCN)."""
+        if len(values) == 1:
+            total = values[0].copy()
+        else:
+            ctx0 = values[0].context
+            total = values[0].as_in_context(ctx0).copy()
+            for v in values[1:]:
+                total += v.as_in_context(ctx0)
+        if self._type.startswith("dist") and self.num_workers > 1:
+            total = self._cross_process_sum(total)
+        return total
+
+    def _cross_process_sum(self, arr):
+        import jax
+
+        # multi-host allreduce: one jitted psum over the global device set
+        # (jax.distributed must be initialized by the launcher —
+        # mxnet_tpu.tools.launch)
+        from jax.experimental.multihost_utils import (
+            global_array_to_host_local_array, host_local_array_to_global_array)
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devices = jax.devices()
+        mesh = Mesh(devices, ("hosts",))
+        garr = host_local_array_to_global_array(arr.data, mesh, P())
+        summed = jax.jit(
+            lambda x: jax.lax.psum(x, "hosts"),
+            in_shardings=jax.sharding.NamedSharding(mesh, P()),
+            out_shardings=jax.sharding.NamedSharding(mesh, P()))(garr)
+        local = global_array_to_host_local_array(summed, mesh, P())
+        return nd.NDArray(local, ctx=arr.context)
+
+    # -- optimizer placement ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run the optimizer inside the kvstore (reference: server-side
+        optimizer via pickled controller, kvstore.py set_optimizer)."""
+        # round-trip through pickle for reference parity (catches
+        # unpicklable optimizers the same way the reference does)
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(optimizer)
+        self._update_on_kvstore_flag = True
+
+    def _str_index(self, key):
+        if key not in self._str_key_dict:
+            self._str_key_dict[key] = len(self._str_key_dict)
+        return self._str_key_dict[key]
+
+    # -- gradient compression ---------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression parity (reference:
+        src/kvstore/gradient_compression.cc).  On TPU the ICI fabric makes
+        compression a pessimization for dense allreduce, but the API and
+        error-feedback semantics are kept for drop-in compatibility."""
+        if compression_params.get("type") not in ("2bit",):
+            raise ValueError("Unsupported compression type %s"
+                             % compression_params.get("type"))
+        self._compression_params = dict(compression_params)
+        self._residuals = {}
+
+    def _compress_decompress(self, key, grad):
+        import jax.numpy as jnp
+
+        threshold = float(self._compression_params.get("threshold", 0.5))
+        res = self._residuals.get(key)
+        g = grad.data + (res if res is not None else 0)
+        q = jnp.where(g >= threshold, threshold,
+                      jnp.where(g <= -threshold, -threshold,
+                                jnp.zeros((), g.dtype)))
+        self._residuals[key] = g - q
+        return nd.NDArray(q, ctx=grad.context)
+
+    # -- barrier / misc ---------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        if self.num_workers > 1:
+            import jax
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.create / kvstore.cc:40-77
+    factory: local / device / nccl / dist_sync / dist_device_sync /
+    dist_async — all map onto the same TPU-native store; 'nccl' is accepted
+    as an alias since the collective backend is XLA, not NCCL)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+             "dist_async", "dist", "dist_tpu")
+    if name not in known:
+        raise ValueError("unknown KVStore type %s (known: %s)"
+                         % (name, ", ".join(known)))
+    return KVStore(name)

@@ -74,6 +74,8 @@ for _name, _opdef in list(_OPS.items()):
 from . import random  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from .utils import save, load, load_frombuffer  # noqa: E402,F401
 
 
 def imdecode(buf, **kwargs):  # pragma: no cover - host-side opencv-free decode

@@ -1,0 +1,78 @@
+"""NDArray serialization: save/load.
+
+Reference parity: ``mx.nd.save``/``mx.nd.load`` (``src/ndarray/ndarray.cc``
+dmlc serialization of an NDArray list/dict; ``model.save_checkpoint`` writes
+``prefix-####.params`` with ``arg:``/``aux:`` key prefixes).  TPU-native
+format: a numpy ``.npz`` container (portable, mmap-able, no device state) with
+a magic key carrying format metadata.  Keys keep the reference's ``arg:``/
+``aux:`` convention so checkpoint-handling code ports unchanged.
+"""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+_MAGIC_KEY = "__mxnet_tpu_format__"
+_FORMAT_VERSION = "1"
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict to file (reference: mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    arrays = {}
+    if isinstance(data, dict):
+        for key, val in data.items():
+            if not isinstance(key, str) or not isinstance(val, NDArray):
+                raise ValueError("save only accepts dict str->NDArray or "
+                                 "list of NDArray")
+            arrays["name:" + key] = val.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, val in enumerate(data):
+            if not isinstance(val, NDArray):
+                raise ValueError("save only accepts dict str->NDArray or "
+                                 "list of NDArray")
+            arrays["idx:%09d" % i] = val.asnumpy()
+    else:
+        raise ValueError("data needs to either be a NDArray, dict of str to "
+                         "NDArray or a list of NDArray")
+    arrays[_MAGIC_KEY] = np.array(int(_FORMAT_VERSION))
+    tmp = fname + ".tmp%d" % os.getpid()
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, fname)
+
+
+def load(fname):
+    """Load from file: returns a list or dict matching what was saved."""
+    with np.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != _MAGIC_KEY]
+        if all(k.startswith("idx:") for k in keys):
+            return [array(z[k]) for k in sorted(keys)]
+        out = {}
+        for k in keys:
+            name = k[5:] if k.startswith("name:") else k
+            out[name] = array(z[k])
+        return out
+
+
+def load_frombuffer(buf):
+    import io
+
+    with np.load(io.BytesIO(buf), allow_pickle=False) as z:
+        keys = [k for k in z.files if k != _MAGIC_KEY]
+        if all(k.startswith("idx:") for k in keys):
+            return [array(z[k]) for k in sorted(keys)]
+        return {(k[5:] if k.startswith("name:") else k): array(z[k])
+                for k in keys}
+
+
+def is_np_file(fname):
+    try:
+        return zipfile.is_zipfile(fname)
+    except OSError:
+        return False

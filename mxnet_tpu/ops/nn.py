@@ -105,15 +105,17 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         lo = k_eff - 1 - pad[i]
         hi = k_eff - 1 - pad[i] + adj[i]
         pads.append((lo, hi))
+    # gradient-of-conv kernel: flip spatial dims ("IO" spec in `dn` already
+    # swaps the in/out feature roles)
+    w_flip = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
     out = lax.conv_general_dilated(
-        data, weight,
+        data, w_flip,
         window_strides=(1,) * n,
         padding=pads,
         lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        transpose_kernel=True,
     )
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * n)
@@ -470,3 +472,96 @@ def _mae_regression_output(data, label, grad_scale=1.0):
 @register("LogisticRegressionOutput", input_names=("data", "label"))
 def _logistic_regression_output(data, label, grad_scale=1.0):
     return _logreg_core(data, label)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+def _ctc_forward(log_probs, targets, input_len, target_len, blank):
+    """Log-space CTC forward algorithm over one batch, as a lax.scan over
+    time (static shapes; padded labels masked by target_len).
+
+    log_probs: (T, N, C) log-softmax scores; targets: (N, S) int labels.
+    Reference behavior: src/operator/nn/ctc_loss — here redesigned as a
+    scan so XLA pipelines the whole recursion on-device.
+    """
+    T, N, C = log_probs.shape
+    S = targets.shape[1]
+    # extended label sequence with blanks: length 2S+1
+    ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(targets.astype(jnp.int32))
+    L = 2 * S + 1
+
+    neg_inf = jnp.array(-1e30, log_probs.dtype)
+    # alpha init: alpha[0] = lp[0, blank], alpha[1] = lp[0, first label]
+    first = log_probs[0]  # (N, C)
+    a0 = first[jnp.arange(N), ext[:, 0]]
+    a1 = jnp.where(target_len > 0, first[jnp.arange(N), ext[:, 1]], neg_inf)
+    alpha = jnp.full((N, L), neg_inf)
+    alpha = alpha.at[:, 0].set(a0).at[:, 1].set(a1)
+
+    # skip-transition allowed where ext[s] != blank and ext[s] != ext[s-2]
+    can_skip = jnp.concatenate(
+        [jnp.zeros((N, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    def logaddexp(a, b):
+        mx_ = jnp.maximum(a, b)
+        safe = jnp.where(jnp.isfinite(mx_), mx_, 0.0)
+        out = safe + jnp.log(jnp.exp(a - safe) + jnp.exp(b - safe))
+        return jnp.where(mx_ <= neg_inf, neg_inf, out)
+
+    def step(alpha, t):
+        lp = log_probs[t]  # (N, C)
+        prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]],
+                                axis=1)
+        prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]],
+                                axis=1)
+        acc = logaddexp(alpha, prev1)
+        acc = jnp.where(can_skip, logaddexp(acc, prev2), acc)
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        new_alpha = acc + emit
+        # freeze beyond input_len (sequence already ended)
+        new_alpha = jnp.where((t < input_len)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha, jnp.arange(1, T))
+    # loss = -log(alpha[len-1] + alpha[len-2]) at the last valid position
+    last = 2 * target_len.astype(jnp.int32)  # index of final blank
+    aN = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    aN1 = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    total = logaddexp(aN, jnp.where(target_len > 0, aN1, neg_inf))
+    return -total
+
+
+@register("CTCLoss", input_names=("data", "label"),
+          aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="last"):
+    """CTC loss. data: (T, N, C) unnormalized scores, label: (N, S).
+
+    Reference parity: src/operator/nn/ctc_loss.cc (warp-ctc semantics:
+    blank_label first/last, padded labels; -1 padding when lengths unused).
+    """
+    T, N, C = data.shape
+    log_probs = jax.nn.log_softmax(data, axis=-1)
+    if blank_label == "last":
+        blank = C - 1
+        targets = label
+    else:
+        blank = 0
+        targets = label
+    if use_data_lengths and data_lengths is not None:
+        input_len = data_lengths.astype(jnp.int32)
+    else:
+        input_len = jnp.full((N,), T, jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        target_len = label_lengths.astype(jnp.int32)
+    else:
+        # labels padded with -1 (or 0 when blank is 0 per reference docs)
+        pad = -1 if blank_label == "last" else 0
+        target_len = jnp.sum((label != pad).astype(jnp.int32), axis=1)
+    targets = jnp.where(targets < 0, 0, targets)
+    return _ctc_forward(log_probs, targets, input_len, target_len, blank)

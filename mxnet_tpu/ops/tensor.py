@@ -560,3 +560,21 @@ def _diag(x, k=0):
 @register("embedding_like_weight_grad", no_grad=True)
 def _embedding_like_weight_grad(x):  # placeholder for sparse grad paths
     return x
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def _add_n(*xs, num_args=None):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("minimum")
+def _minimum_op(a, b):
+    return jnp.minimum(a, b)
+
+
+@register("maximum")
+def _maximum_op(a, b):
+    return jnp.maximum(a, b)

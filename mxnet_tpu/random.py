@@ -28,10 +28,33 @@ def seed(seed_state, ctx="all"):
 
 
 def next_key():
-    """Split the global chain and return a fresh key (eager ops only)."""
+    """Split the global chain and return a fresh key.  Inside a jit trace an
+    explicit key source (``key_source``) takes over so compiled programs get
+    keys as traced inputs instead of baked-in constants."""
+    sources = getattr(_state, "sources", None)
+    if sources:
+        src = sources[-1]
+        src[0], sub = jax.random.split(src[0])
+        return sub
     k = _key_state()
     _state.key, sub = jax.random.split(k)
     return sub
+
+
+class key_source:
+    """Scope: derive all random-op keys from one (possibly traced) key."""
+
+    def __init__(self, key):
+        self._cell = [key]
+
+    def __enter__(self):
+        if not hasattr(_state, "sources"):
+            _state.sources = []
+        _state.sources.append(self._cell)
+        return self
+
+    def __exit__(self, *a):
+        _state.sources.pop()
 
 
 def next_keys(n):

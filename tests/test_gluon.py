@@ -1,0 +1,446 @@
+"""Gluon tests (reference strategy: tests/python/unittest/test_gluon.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.grad(mx.cpu(0)).shape == (10, 10)
+
+
+def test_parameter_dict_get_sharing():
+    params = gluon.ParameterDict("net_")
+    p1 = params.get("w", shape=(2, 2))
+    p2 = params.get("w")
+    assert p1 is p2
+    assert p1.name == "net_w"
+
+
+def test_parameter_shape_inference_merge():
+    params = gluon.ParameterDict()
+    p = params.get("w", shape=(4, 0))
+    p2 = params.get("w", shape=(4, 5))
+    assert p is p2
+    assert p.shape == (4, 5)
+
+
+def test_constant_parameter():
+    const = gluon.Constant("c", [[1, 2], [3, 4]])
+    const.initialize()
+    assert (const.data().asnumpy() == np.array([[1, 2], [3, 4]])).all()
+    assert const.grad_req == "null"
+
+
+def test_block_naming_and_collect():
+    class Net(nn.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=3)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def hybrid_forward(self, F, x):
+            return self.dense1(self.dense0(x))
+
+    net = Net(prefix="net_")
+    names = list(net.collect_params().keys())
+    assert "net_dense0_weight" in names
+    assert "net_dense1_bias" in names
+    sub = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in sub.keys())
+
+
+def test_dense_flatten_false():
+    net = nn.Dense(7, flatten=False, in_units=4)
+    net.initialize()
+    x = mx.nd.ones((2, 3, 4))
+    assert net(x).shape == (2, 3, 7)
+
+
+def test_deferred_init_and_reinit():
+    net = nn.Dense(5)
+    net.initialize()
+    x = mx.nd.ones((4, 3))
+    net(x)
+    assert net.weight.shape == (5, 3)
+    # reinit on new shape requires force
+    with pytest.raises(Exception):
+        net.weight.shape = (5, 9)
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 4).astype(np.float32))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(imp, hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_backward_matches_imperative():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    np.random.seed(0)
+    x = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+
+    grads = []
+    for hybridize in (False, True):
+        np.random.seed(42)
+        net = build()
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        grads.append({k: v.grad(x.context).asnumpy()
+                      for k, v in net.collect_params().items()
+                      if v.grad_req != "null"})
+    for (k1, g1), (k2, g2) in zip(sorted(grads[0].items()),
+                                  sorted(grads[1].items())):
+        np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-5,
+                                   err_msg="%s vs %s" % (k1, k2))
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(16, kernel_size=3, strides=2, padding=1)
+    layer.initialize()
+    x = mx.nd.ones((2, 3, 32, 32))
+    assert layer(x).shape == (2, 16, 16, 16)
+    assert layer.weight.shape == (16, 3, 3, 3)
+
+
+def test_conv_transpose_shapes():
+    layer = nn.Conv2DTranspose(8, kernel_size=4, strides=2, padding=1)
+    layer.initialize()
+    x = mx.nd.ones((2, 3, 16, 16))
+    assert layer(x).shape == (2, 8, 32, 32)
+
+
+def test_pooling_layers():
+    x = mx.nd.ones((2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.randn(8, 4, 3, 3).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        out = bn(x)
+    m = out.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-4)
+    # eval mode uses running stats
+    out_eval = bn(x)
+    assert not np.allclose(out_eval.asnumpy(), out.asnumpy())
+
+
+def test_layernorm_embedding():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = mx.nd.array(np.random.randn(3, 6).astype(np.float32))
+    out = ln(x).asnumpy()
+    np.testing.assert_allclose(out.mean(axis=-1), np.zeros(3), atol=1e-5)
+
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([1, 2, 1])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[0].asnumpy(), out[2].asnumpy())
+
+
+def test_sequential_getitem_len():
+    net = nn.Sequential()
+    net.add(nn.Dense(3), nn.Dense(4), nn.Dense(5))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, in_units=3), nn.Dense(3, in_units=5))
+    net.initialize()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(5, in_units=3), nn.Dense(3, in_units=5))
+    net2.load_parameters(fname)
+    x = mx.nd.ones((2, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_trainer_step_updates():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = mx.nd.ones((4, 2))
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(4)
+    assert not np.allclose(w_before, net.weight.data().asnumpy())
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    tr2.load_states(f)
+    assert 0 in tr2._updaters[0].states
+
+
+@pytest.mark.parametrize("loss_cls,args", [
+    (gluon.loss.L2Loss, ()), (gluon.loss.L1Loss, ()),
+    (gluon.loss.HuberLoss, ()), (gluon.loss.HingeLoss, ()),
+    (gluon.loss.SquaredHingeLoss, ()), (gluon.loss.LogisticLoss, ()),
+])
+def test_regression_losses(loss_cls, args):
+    loss = loss_cls(*args)
+    pred = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = mx.nd.array(np.sign(np.random.randn(4, 3)).astype(np.float32))
+    out = loss(pred, label)
+    assert out.shape == (4,)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_softmax_ce_loss_values():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    pred = mx.nd.array([[10.0, -10.0], [-10.0, 10.0]])
+    label = mx.nd.array([0, 1])
+    out = loss(pred, label).asnumpy()
+    np.testing.assert_allclose(out, np.zeros(2), atol=1e-4)
+
+    dense_label = mx.nd.array([[1.0, 0.0], [0.0, 1.0]])
+    loss2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    out2 = loss2(pred, dense_label).asnumpy()
+    np.testing.assert_allclose(out2, np.zeros(2), atol=1e-4)
+
+
+def test_kl_and_bce_losses():
+    kl = gluon.loss.KLDivLoss()
+    pred = mx.nd.log(mx.nd.array([[0.3, 0.7], [0.5, 0.5]]))
+    label = mx.nd.array([[0.3, 0.7], [0.5, 0.5]])
+    np.testing.assert_allclose(kl(pred, label).asnumpy(), np.zeros(2),
+                               atol=1e-6)
+
+    bce = gluon.loss.SigmoidBCELoss()
+    pred = mx.nd.array([[100.0], [-100.0]])
+    label = mx.nd.array([[1.0], [0.0]])
+    np.testing.assert_allclose(bce(pred, label).asnumpy(), np.zeros(2),
+                               atol=1e-4)
+
+
+def test_ctc_loss_gluon():
+    loss = gluon.loss.CTCLoss()
+    pred = mx.nd.array(np.random.randn(2, 8, 5).astype(np.float32))
+    label = mx.nd.array([[1, 2, 2], [2, 1, -1]])
+    out = loss(pred, label)
+    assert out.shape == (2,)
+    assert (out.asnumpy() > 0).all()
+
+
+def test_rnn_cells_unroll():
+    for cell_cls, n_states in [(gluon.rnn.RNNCell, 1),
+                               (gluon.rnn.LSTMCell, 2),
+                               (gluon.rnn.GRUCell, 1)]:
+        cell = cell_cls(10, input_size=6)
+        cell.initialize()
+        x = mx.nd.ones((3, 5, 6))  # NTC
+        outputs, states = cell.unroll(5, x, merge_outputs=True)
+        assert outputs.shape == (3, 5, 10), cell_cls.__name__
+        assert len(states) == n_states
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8, input_size=4))
+    stack.add(gluon.rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    x = mx.nd.ones((2, 3, 4))
+    outputs, states = stack.unroll(3, x, merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_fused_lstm_layer():
+    layer = gluon.rnn.LSTM(12, num_layers=2, input_size=6)
+    layer.initialize()
+    x = mx.nd.ones((5, 3, 6))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 12)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 12)
+    assert new_states[0].shape == (2, 3, 12)
+    assert new_states[1].shape == (2, 3, 12)
+
+
+def test_fused_bidirectional_gru():
+    layer = gluon.rnn.GRU(7, num_layers=1, bidirectional=True, input_size=4,
+                          layout="NTC")
+    layer.initialize()
+    x = mx.nd.ones((2, 5, 4))
+    out = layer(x)
+    assert out.shape == (2, 5, 14)
+
+
+def test_fused_lstm_matches_cell():
+    """The fused lax.scan LSTM must agree with the unfused cell."""
+    np.random.seed(0)
+    T, N, I, H = 4, 2, 3, 5
+    x_np = np.random.randn(T, N, I).astype(np.float32)
+
+    fused = gluon.rnn.LSTM(H, input_size=I)
+    fused.initialize()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused params into cell
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+
+    x = mx.nd.array(x_np)
+    out_fused = fused(x).asnumpy()
+    outputs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    out_cell = outputs.asnumpy()
+    np.testing.assert_allclose(out_fused, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_dataset_dataloader():
+    X = np.random.randn(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, Y)
+    assert len(dataset) == 10
+    loader = gluon.data.DataLoader(dataset, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+
+    loader = gluon.data.DataLoader(dataset, batch_size=4,
+                                   last_batch="discard")
+    assert len(list(loader)) == 2
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(20).astype(np.float32).reshape(20, 1)
+    dataset = gluon.data.ArrayDataset(X, X[:, 0])
+    loader = gluon.data.DataLoader(dataset, batch_size=5, shuffle=True,
+                                   num_workers=2)
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_transforms():
+    t = gluon.data.vision.transforms.ToTensor()
+    img = mx.nd.array(np.random.randint(0, 255, (8, 8, 3)), dtype=np.uint8)
+    out = t(img)
+    assert out.shape == (3, 8, 8)
+    assert out.asnumpy().max() <= 1.0
+
+    norm = gluon.data.vision.transforms.Normalize(mean=(0.5, 0.5, 0.5),
+                                                  std=(0.5, 0.5, 0.5))
+    out2 = norm(out)
+    assert out2.shape == (3, 8, 8)
+
+    resize = gluon.data.vision.transforms.Resize(4)
+    out3 = resize(img)
+    assert out3.shape == (4, 4, 3)
+
+    comp = gluon.data.vision.transforms.Compose([t, norm])
+    assert comp(img).shape == (3, 8, 8)
+
+
+def test_split_and_load():
+    data = mx.nd.arange(12).reshape((6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert len(parts) == 3
+    assert parts[0].shape == (2, 2)
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert loaded[0].shape == (6, 2)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+
+def test_model_zoo_constructs_and_runs():
+    # thumbnail resnet on tiny input: full zoo model forward
+    net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10,
+                                           thumbnail=True)
+    net.initialize()
+    x = mx.nd.ones((1, 3, 32, 32))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_model_zoo_resnet_v2_runs():
+    net = gluon.model_zoo.vision.resnet18_v2(classes=7, thumbnail=True)
+    net.initialize()
+    x = mx.nd.ones((1, 3, 32, 32))
+    assert net(x).shape == (1, 7)
+
+
+def test_model_zoo_names():
+    with pytest.raises(ValueError):
+        gluon.model_zoo.vision.get_model("not_a_model")
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda("tanh")
+    hl = nn.HybridLambda(lambda F, x: F.relu(x))
+    x = mx.nd.array([[-1.0, 2.0]])
+    np.testing.assert_allclose(lam(x).asnumpy(), np.tanh([[-1.0, 2.0]]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(hl(x).asnumpy(), [[0.0, 2.0]], rtol=1e-6)
+
+
+def test_activations_layers():
+    x = mx.nd.array([[-2.0, -0.5, 0.5, 2.0]])
+    for layer in [nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.Swish(),
+                  nn.GELU(), nn.Activation("relu")]:
+        out = layer(x)
+        assert out.shape == x.shape
+
+    prelu = nn.PReLU()
+    prelu.initialize()
+    out = prelu(x)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], -0.5, rtol=1e-5)
