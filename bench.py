@@ -1,0 +1,88 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one TPU chip.
+
+Matches the reference's headline number: ResNet-50 training, batch 128, on
+V100 = 363.69 img/s (`docs/faq/perf.md:236`, see BASELINE.md) measured via
+`example/image-classification/train_imagenet.py`.  This script runs the same
+workload through the Gluon user path — hybridized model-zoo ResNet-50,
+SoftmaxCrossEntropyLoss, Trainer(sgd+momentum) — on synthetic ImageNet-shaped
+data, and prints ONE JSON line.
+
+Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (default 20),
+BENCH_MODEL (default resnet50_v1).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 363.69  # V100 fp32 batch 128, docs/faq/perf.md:236
+
+
+def main():
+    import numpy as np
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+
+    platform = jax.default_backend()
+    ctx = mx.tpu() if platform not in ("cpu",) else mx.cpu()
+
+    net = getattr(vision, model_name)(classes=1000)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize(static_alloc=True, static_shape=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32), ctx=ctx)
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)), ctx=ctx)
+
+    def one_step():
+        with mx.autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    # warmup: compile fwd+bwd+update
+    for _ in range(3):
+        loss = one_step()
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_b%d_%s" % (batch, platform),
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # the driver needs a JSON line no matter what
+        print(json.dumps({
+            "metric": "resnet50_train_img_per_sec",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "error": "%s: %s" % (type(e).__name__, e),
+        }))
+        sys.exit(0)

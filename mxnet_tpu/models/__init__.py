@@ -1,0 +1,11 @@
+"""Flagship end-to-end models, built TPU-first.
+
+The reference's model corpus lives in `example/image-classification/symbols/`
+and `python/mxnet/gluon/model_zoo/` (vision CNNs — mirrored in
+``mxnet_tpu.gluon.model_zoo``) plus `example/rnn/word_lm` (LSTM LM).  This
+package holds the pure-JAX flagship models used for benchmarking and the
+multi-chip parallelism demos: sharding-native transformer LM (dp/fsdp/tp/sp/
+ep/pp), the scale class the reference never reached.
+"""
+from .transformer import (TransformerLM, TransformerConfig,  # noqa: F401
+                          make_train_step)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register
@@ -151,23 +152,26 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
             extra = (stride[i] - rem) % stride[i] if rem else 0
             pads_l.append((pad[i], pad[i] + extra))
         pads = tuple(pads_l)
+    # NOTE: init values must be python/numpy scalars — a traced/array init
+    # defeats lax's monoid specialization (reduce_window_sum/max primitives)
+    # and the generic reduce_window has no reverse-mode AD rule.
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        init = (-np.inf if jnp.issubdtype(data.dtype, jnp.floating)
+                else jnp.iinfo(data.dtype).min)
         out = lax.reduce_window(data, init, lax.max, window, strides, pads)
     elif pool_type in ("avg", "sum"):
-        zero = jnp.zeros((), data.dtype)
-        out = lax.reduce_window(data, zero, lax.add, window, strides, pads)
+        out = lax.reduce_window(data, 0., lax.add, window, strides, pads)
         if pool_type == "avg":
             if count_include_pad:
-                out = out / jnp.prod(jnp.array(kernel, jnp.float32)).astype(data.dtype)
+                out = out / np.prod(kernel).astype(data.dtype)
             else:
                 ones = jnp.ones_like(data)
-                cnt = lax.reduce_window(ones, zero, lax.add, window, strides,
+                cnt = lax.reduce_window(ones, 0., lax.add, window, strides,
                                         pads)
                 out = out / cnt
     elif pool_type == "lp":
         p_in = jnp.abs(data) ** p_value
-        out = lax.reduce_window(p_in, jnp.zeros((), p_in.dtype), lax.add,
+        out = lax.reduce_window(p_in, 0., lax.add,
                                 window, strides, pads) ** (1.0 / p_value)
     else:
         raise ValueError("unknown pool_type %r" % pool_type)

@@ -1,0 +1,113 @@
+"""Device mesh management.
+
+The reference discovers GPU topology and builds reduction trees at runtime
+(`src/kvstore/gpu_topology.h`, `comm_tree.h:50`).  On TPU the topology is the
+ICI torus and XLA already knows it: we only *name* the axes.  A mesh here is a
+`jax.sharding.Mesh` plus the convention that axis names encode the parallelism
+strategy (see package docstring).
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "get_mesh", "local_mesh"]
+
+_state = threading.local()
+
+# canonical axis order: collectives for the rightmost axes ride the
+# fastest-varying device dimension (innermost ICI links on TPU)
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+class DeviceMesh:
+    """A named device mesh.  Thin, convention-carrying wrapper over
+    `jax.sharding.Mesh` that can be used as a context manager to set the
+    process-wide "current mesh" (the analogue of the reference's singleton
+    `KVStore` created once per training job, `src/kvstore/kvstore.cc:40`)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @property
+    def axis_names(self):
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self):
+        return dict(self.mesh.shape)
+
+    def size(self, axis=None):
+        if axis is None:
+            return math.prod(self.mesh.shape.values())
+        return self.mesh.shape.get(axis, 1)
+
+    def __enter__(self):
+        stack = getattr(_state, "stack", None)
+        if stack is None:
+            stack = _state.stack = []
+        stack.append(self)
+        self._mesh_ctx = self.mesh
+        self._mesh_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        self._mesh_ctx.__exit__(*exc)
+
+    def __repr__(self):
+        return "DeviceMesh(%s)" % (", ".join(
+            "%s=%d" % (k, v) for k, v in self.mesh.shape.items()))
+
+
+def make_mesh(devices=None, **axis_sizes) -> DeviceMesh:
+    """Build a mesh: ``make_mesh(dp=2, tp=4)``.
+
+    Unspecified axes default to 1 and are dropped unless explicitly given.
+    If the product of given sizes is less than the device count and ``dp`` was
+    not given, the remainder is absorbed into ``dp``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    sizes = {k: int(v) for k, v in axis_sizes.items() if v is not None}
+    for k in sizes:
+        if k not in AXIS_ORDER:
+            raise ValueError("unknown mesh axis %r (known: %s)"
+                             % (k, AXIS_ORDER))
+    given = math.prod(sizes.values()) if sizes else 1
+    if n % given:
+        raise ValueError("axis sizes %r do not divide device count %d"
+                         % (sizes, n))
+    if given < n and "dp" not in sizes:
+        sizes["dp"] = n // given
+        given = n
+    if given != n:
+        raise ValueError("axis sizes %r use %d of %d devices"
+                         % (sizes, given, n))
+    names = [a for a in AXIS_ORDER if a in sizes]
+    shape = [sizes[a] for a in names]
+    dev_array = np.asarray(devices).reshape(shape)
+    return DeviceMesh(Mesh(dev_array, tuple(names)))
+
+
+def local_mesh(**axis_sizes) -> DeviceMesh:
+    """Mesh over this process's addressable devices only."""
+    return make_mesh(devices=jax.local_devices(), **axis_sizes)
+
+
+def current_mesh() -> "DeviceMesh | None":
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+def get_mesh() -> DeviceMesh:
+    m = current_mesh()
+    if m is None:
+        raise RuntimeError("no active DeviceMesh — use `with make_mesh(...):`")
+    return m
+
+

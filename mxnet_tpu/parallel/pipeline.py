@@ -1,0 +1,99 @@
+"""Pipeline parallelism — GPipe microbatch schedule over mesh axis ``pp``.
+
+Net-new vs the reference (SURVEY.md §2.4: "no GPipe-style schedule"; its only
+model parallelism was manual `group2ctx` placement).  TPU-native design: all
+pipeline stages run the SAME program (SPMD) under `shard_map`; stage identity
+comes from `lax.axis_index("pp")`, activations move one hop per step via
+`lax.ppermute` (neighbor transfers ride ICI), and the whole schedule is a
+single `lax.scan` — one XLA module, no host round-trips.
+
+Schedule: with P stages and M microbatches, step t ∈ [0, M+P-1): stage p
+processes microbatch (t - p) when 0 ≤ t - p < M.  Bubble fraction is
+(P-1)/(M+P-1), as in GPipe; choose M ≥ 4·P to amortize.
+
+Constraint: the stage function must map activations to activations of the
+same shape/dtype (true for transformer blocks) — the classic homogeneous-
+pipeline requirement.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import shard_map
+from .mesh import current_mesh
+
+__all__ = ["pipeline_spmd"]
+
+
+def _make_worker(stage_fn, num_microbatches, n_stages, pp_axis):
+    from .collectives import ppermute_shift
+
+    M, P = num_microbatches, n_stages
+
+    def worker(params, x):
+        # params leaves arrive as [1, ...] (this rank's stage) — drop stage dim
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        my = lax.axis_index(pp_axis)
+        mb_shape = x.shape[1:]
+
+        def step(carry, t):
+            state, outbuf = carry
+            # pass activations one hop down the pipeline (ICI neighbor copy)
+            recv = ppermute_shift(state, pp_axis, 1)
+            inject = x[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(my == 0, inject, recv)
+            out = stage_fn(params, cur)
+            # at step t the last stage finishes microbatch (t - (P-1))
+            out_idx = jnp.clip(t - (P - 1), 0, M - 1)
+            is_out = (my == P - 1) & (t >= P - 1)
+            outbuf = jnp.where(
+                is_out,
+                lax.dynamic_update_index_in_dim(outbuf, out, out_idx, 0),
+                outbuf)
+            return (out, outbuf), None
+
+        init = (jnp.zeros(mb_shape, x.dtype),
+                jnp.zeros((M,) + mb_shape, x.dtype))
+        (_, outbuf), _ = lax.scan(step, init, jnp.arange(M + P - 1))
+        # replicate the last stage's buffer so out_spec can be unsharded
+        masked = jnp.where(my == P - 1, outbuf, jnp.zeros_like(outbuf))
+        return lax.psum(masked, pp_axis)
+
+    return worker
+
+
+def pipeline_spmd(stage_fn, stacked_params, x, num_microbatches, mesh=None,
+                  pp_axis="pp"):
+    """Run ``stage_fn(params, act) -> act`` as a P-stage pipeline.
+
+    stacked_params: pytree whose leaves have leading dim P (params of stage i
+    at index i) — sharded one-stage-per-rank over ``pp_axis``.
+    x: [M, mb, ...] microbatched input (M = num_microbatches).
+    Returns [M, mb, ...] outputs of the final stage.
+
+    With pp absent from the mesh (or no mesh), runs the stages sequentially —
+    the same math, so tests can diff pipelined vs sequential execution.
+    """
+    from jax.sharding import PartitionSpec as Pspec
+
+    mesh = mesh or current_mesh()
+    if mesh is None or mesh.size(pp_axis) == 1:
+        n = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+        def seq(mb):
+            h = mb
+            for i in range(n):
+                pi = jax.tree_util.tree_map(lambda p: p[i], stacked_params)
+                h = stage_fn(pi, h)
+            return h
+
+        return jax.vmap(seq)(x)
+
+    n = mesh.size(pp_axis)
+    worker = _make_worker(stage_fn, num_microbatches, n, pp_axis)
+    pspec = jax.tree_util.tree_map(lambda _: Pspec(pp_axis), stacked_params)
+    return shard_map(worker, mesh=mesh.mesh,
+                     in_specs=(pspec, Pspec()), out_specs=Pspec(),
+                     check_vma=False)(stacked_params, x)

@@ -1,0 +1,229 @@
+"""Parallelism subsystem tests on the virtual 8-device CPU mesh.
+
+Reference test-strategy parity (SURVEY.md §4): collective semantics verified
+on one host without a cluster (analogue of `tests/nightly/dist_sync_kvstore.py`
+via `launch.py --launcher local`), with dense single-device math as the oracle
+(`check_consistency` pattern).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (make_mesh, ring_attention, blockwise_attention,
+                                pipeline_spmd, moe_layer)
+from mxnet_tpu.parallel.collectives import shard_map
+from mxnet_tpu.parallel.ring_attention import ring_self_attention
+from jax.sharding import PartitionSpec as P
+
+
+def dense_causal_attention(q, k, v):
+    B, T, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    mask = np.tril(np.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_blockwise_attention_matches_dense():
+    q, k, v = _qkv()
+    ref = dense_causal_attention(q, k, v)
+    out = blockwise_attention(q, k, v, block_size=8, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_matches_dense():
+    q, k, v = _qkv()
+    ref = dense_causal_attention(q, k, v)
+    with make_mesh(sp=8) as mesh:
+        out = ring_self_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    q, k, v = _qkv(T=16)
+
+    def ref_loss(q, k, v):
+        return dense_causal_attention(q, k, v).sum()
+
+    gref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    with make_mesh(sp=4, dp=2):
+        def ring_loss(q, k, v):
+            return ring_self_attention(q, k, v, causal=True).sum()
+        gout = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gout, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_matches_sequential():
+    rng = np.random.RandomState(1)
+    PP, M, mb, E = 4, 8, 2, 16
+    w = jnp.asarray(rng.randn(PP, E, E).astype(np.float32) * 0.3)
+    b = jnp.asarray(rng.randn(PP, E).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(M, mb, E).astype(np.float32))
+
+    def stage(params, h):
+        return jnp.tanh(h @ params["w"] + params["b"])
+
+    params = {"w": w, "b": b}
+    ref = pipeline_spmd(stage, params, x, M, mesh=None)  # sequential path
+    with make_mesh(pp=4, dp=2) as mesh:
+        out = pipeline_spmd(stage, params, x, M, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_flow():
+    rng = np.random.RandomState(2)
+    PP, M, mb, E = 2, 4, 2, 8
+    params = {"w": jnp.asarray(rng.randn(PP, E, E).astype(np.float32) * 0.3)}
+    x = jnp.asarray(rng.randn(M, mb, E).astype(np.float32))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss(params, x):
+        return pipeline_spmd(stage, params, x, M).sum()
+
+    gseq = jax.grad(loss)(params, x)
+    with make_mesh(pp=2, dp=4):
+        gpp = jax.grad(loss)(params, x)
+    np.testing.assert_allclose(np.asarray(gpp["w"]), np.asarray(gseq["w"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_layer_shapes_and_balance_loss():
+    rng = np.random.RandomState(3)
+    B, T, E, NE, H = 2, 8, 16, 4, 32
+    x = jnp.asarray(rng.randn(B, T, E).astype(np.float32))
+    gw = jnp.asarray(rng.randn(E, NE).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(NE, E, H).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(NE, H, E).astype(np.float32) * 0.1)
+    y, aux = moe_layer(x, gw, w1, w2)
+    assert y.shape == (B, T, E)
+    assert np.isfinite(np.asarray(y)).all()
+    assert float(aux) >= 1.0 - 1e-5  # >= 1 by Cauchy-Schwarz, = 1 if balanced
+
+
+def test_moe_sharded_matches_unsharded():
+    rng = np.random.RandomState(4)
+    B, T, E, NE, H = 2, 8, 16, 4, 32
+    x = jnp.asarray(rng.randn(B, T, E).astype(np.float32))
+    gw = jnp.asarray(rng.randn(E, NE).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(NE, E, H).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(NE, H, E).astype(np.float32) * 0.1)
+    y_ref, _ = moe_layer(x, gw, w1, w2)
+    with make_mesh(ep=4, dp=2):
+        y, _ = jax.jit(moe_layer)(x, gw, w1, w2)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_collectives_roundtrip():
+    from mxnet_tpu.parallel import collectives as C
+
+    with make_mesh(dp=8) as mesh:
+        x = jnp.arange(8.0)
+
+        def f(x):
+            # x is [1] per device
+            s = C.allreduce(x, "dp")
+            g = C.allgather(x, "dp")
+            r = C.reduce_scatter(g, "dp")
+            b = C.broadcast(x, "dp", src=3)
+            return s, g, r, b
+
+        s, g, r, b = shard_map(f, mesh=mesh.mesh, in_specs=P("dp"),
+                               out_specs=(P("dp"), P(), P("dp"), P("dp")),
+                               check_vma=False)(x)
+    assert np.allclose(np.asarray(s), 28.0)
+    assert np.allclose(np.asarray(g), np.arange(8.0))
+    # reduce_scatter over 8 identical gathered copies: 8 * x_i
+    assert np.allclose(np.asarray(r), 8 * np.arange(8.0))
+    assert np.allclose(np.asarray(b), 3.0)
+
+
+class TestTransformer:
+    def _cfg(self, **kw):
+        from mxnet_tpu.models import TransformerConfig
+
+        base = dict(vocab_size=97, d_model=32, n_heads=4, n_layers=2,
+                    d_ff=64, max_len=32, dtype="float32", remat=False)
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    def _data(self, B=4, T=16, V=97, seed=0):
+        rng = np.random.RandomState(seed)
+        toks = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+        tgts = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+        return toks, tgts
+
+    def test_forward_and_loss_finite(self):
+        from mxnet_tpu.models import TransformerLM
+
+        model = TransformerLM(self._cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        toks, tgts = self._data()
+        loss = model.loss(params, toks, tgts)
+        assert np.isfinite(float(loss))
+        assert abs(float(loss) - np.log(97)) < 1.0  # ~uniform at init
+
+    def test_sharded_loss_matches_single_device(self):
+        from mxnet_tpu.models import TransformerLM, make_train_step
+        from mxnet_tpu.parallel.sharding import auto_shard
+        from mxnet_tpu.models.transformer import default_rules
+
+        model = TransformerLM(self._cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        toks, tgts = self._data()
+        ref = float(model.loss(params, toks, tgts))
+
+        with make_mesh(dp=2, sp=2, tp=2):
+            sp = auto_shard(params, default_rules())
+            out = float(jax.jit(model.loss)(sp, toks, tgts))
+        assert abs(out - ref) < 2e-3
+
+    def test_train_step_decreases_loss_sharded(self):
+        from mxnet_tpu.models import TransformerLM, make_train_step
+        from mxnet_tpu.parallel.sharding import auto_shard
+        from mxnet_tpu.models.transformer import default_rules
+
+        model = TransformerLM(self._cfg())
+        toks, tgts = self._data()
+        with make_mesh(dp=2, sp=2, tp=2):
+            params = auto_shard(model.init(jax.random.PRNGKey(0)),
+                                default_rules())
+            vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+            step = jax.jit(make_train_step(model, lr=0.1))
+            losses = []
+            for _ in range(5):
+                params, vel, loss = step(params, vel, toks, tgts)
+                losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_moe_transformer_sharded(self):
+        from mxnet_tpu.models import TransformerLM, make_train_step
+        from mxnet_tpu.parallel.sharding import auto_shard
+        from mxnet_tpu.models.transformer import default_rules
+
+        model = TransformerLM(self._cfg(use_moe=True, n_experts=4))
+        toks, tgts = self._data()
+        with make_mesh(dp=2, ep=4):
+            params = auto_shard(model.init(jax.random.PRNGKey(0)),
+                                default_rules())
+            vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+            step = jax.jit(make_train_step(model, lr=0.05))
+            p1, v1, l1 = step(params, vel, toks, tgts)
+            p2, v2, l2 = step(p1, v1, toks, tgts)
+        assert np.isfinite(float(l1)) and np.isfinite(float(l2))
