@@ -30,3 +30,12 @@ from . import kvstore  # noqa: F401
 from .kvstore import create as _kv_create  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import gluon  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from .executor import Executor  # noqa: F401
+from . import io  # noqa: F401
+from . import recordio  # noqa: F401
+from . import model  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
+from . import callback  # noqa: F401

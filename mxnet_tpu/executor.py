@@ -1,0 +1,292 @@
+"""Executor: a bound, compiled symbolic graph.
+
+Reference parity: `Executor`/`GraphExecutor` (`src/executor/graph_executor.cc`
+— Init:298 builds fwd+bwd graph, plans memory, creates cached engine ops;
+Forward/Backward :64-92; `simple_bind`:1626).  TPU-native redesign (the
+north-star in BASELINE.json): no nnvm passes, no memory planner, no cached
+opr segments — the WHOLE graph lowers to ONE `jax.jit` XLA module per
+(train, shape) key, and the backward graph is `jax.vjp` over that same pure
+function (fused fwd+bwd module on the training path).  XLA does scheduling,
+fusion, rematerialization, and memory planning — the jobs of
+`GraphExecutor::Init`.
+
+Aux states (BatchNorm running stats) are explicit carried outputs written
+back after each call — the functional version of the reference's mutable aux
+arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .context import current_context
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, grad_req="write", arg_shapes=None,
+                 args=None, args_grad=None, aux_states=None, type_dict=None,
+                 group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self._n_out = len(symbol._outputs)
+
+        # grad_req normalization: str | list | dict  (reference executor)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self.arg_names}
+
+        # -- materialize arg/aux arrays --------------------------------
+        if args is not None:
+            self.arg_arrays = self._align(args, self.arg_names, "args")
+        else:
+            shapes = dict(arg_shapes or {})
+            inferred, _, aux_inferred = symbol.infer_shape(**shapes)
+            self.arg_arrays = []
+            for name, shp in zip(self.arg_names, inferred):
+                if shp is None:
+                    raise ValueError(
+                        "cannot infer shape of argument %r — pass its shape "
+                        "to simple_bind" % name)
+                dt = (type_dict or {}).get(name, np.float32)
+                self.arg_arrays.append(nd.zeros(shp, dtype=dt, ctx=self._ctx))
+        if aux_states is not None:
+            self.aux_arrays = self._align(aux_states, self.aux_names, "aux")
+        else:
+            shapes = {n: a.shape for n, a in zip(self.arg_names,
+                                                 self.arg_arrays)}
+            _, _, aux_inferred = symbol.infer_shape(**shapes)
+            self.aux_arrays = []
+            for name, shp in zip(self.aux_names, aux_inferred):
+                if shp is None:
+                    raise ValueError("cannot infer aux shape %r" % name)
+                self.aux_arrays.append(nd.zeros(shp, ctx=self._ctx))
+
+        # -- gradient buffers ------------------------------------------
+        if args_grad is not None:
+            self.grad_arrays = self._align(args_grad, self.arg_names,
+                                           "args_grad", allow_missing=True)
+        else:
+            self.grad_arrays = [
+                nd.zeros(a.shape, dtype=a.dtype, ctx=self._ctx)
+                if self._grad_req.get(n, "null") != "null" else None
+                for n, a in zip(self.arg_names, self.arg_arrays)]
+
+        self.arg_dict = dict(zip(self.arg_names, self.arg_arrays))
+        self.aux_dict = dict(zip(self.aux_names, self.aux_arrays))
+        self.grad_dict = dict(zip(self.arg_names, self.grad_arrays))
+        self.outputs = []
+        self._fn_cache = {}
+        self._is_train = False
+
+    def _align(self, values, names, what, allow_missing=False):
+        if isinstance(values, dict):
+            out = []
+            for n in names:
+                if n in values:
+                    v = values[n]
+                    out.append(v if isinstance(v, NDArray) else nd.array(v))
+                elif allow_missing:
+                    out.append(None)
+                else:
+                    raise ValueError("missing %s entry %r" % (what, n))
+            return out
+        values = [v if (v is None or isinstance(v, NDArray)) else nd.array(v)
+                  for v in values]
+        if len(values) != len(names):
+            raise ValueError("%s length %d != expected %d"
+                             % (what, len(values), len(names)))
+        return list(values)
+
+    # ------------------------------------------------------------------
+    def _graph_fn(self, train):
+        """Pure function (rng, arg_list, aux_list) -> (outs..., new_auxs...)
+        — the single XLA module."""
+        sym = self._symbol
+        topo = sym._topo()
+        arg_index = {n: i for i, n in enumerate(self.arg_names)}
+        aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        # count rng consumers for key splitting
+        rng_ops = [node for node in topo
+                   if not node.is_var and node.op.needs_rng]
+
+        def fn(rng, arg_vals, aux_vals):
+            env = {}
+            new_aux = dict(enumerate(aux_vals))
+            keys = (list(jax.random.split(rng, len(rng_ops)))
+                    if rng_ops else [])
+            ki = 0
+            for node in topo:
+                if node.is_var:
+                    if node.name in arg_index:
+                        env[id(node)] = (arg_vals[arg_index[node.name]],)
+                    else:
+                        env[id(node)] = (aux_vals[aux_index[node.name]],)
+                    continue
+                ins = [env[id(src)][oi] for src, oi in node.inputs]
+                f = node.op.bind(dict(node.attrs), train)
+                if node.op.needs_rng:
+                    res = f(keys[ki], *ins)
+                    ki += 1
+                else:
+                    res = f(*ins)
+                if not isinstance(res, (tuple, list)):
+                    res = (res,)
+                env[id(node)] = tuple(res)
+                # aux write-back (FMutateInputs parity)
+                for out_i, in_i in node.op.mutate.items():
+                    if in_i < len(node.inputs):
+                        src, _ = node.inputs[in_i]
+                        if src.is_var and src.name in aux_index:
+                            new_aux[aux_index[src.name]] = res[out_i]
+            outs = tuple(env[id(n)][oi] for n, oi in sym._outputs)
+            return outs, tuple(new_aux[i] for i in range(len(aux_vals)))
+
+        return fn
+
+    def _compiled(self, kind, train):
+        key = (kind, train,
+               tuple(a.shape + (str(a.dtype),) for a in self.arg_arrays))
+        f = self._fn_cache.get(key)
+        if f is not None:
+            return f
+        graph_fn = self._graph_fn(train)
+        n_out = self._n_out
+        grad_pos = [i for i, n in enumerate(self.arg_names)
+                    if self._grad_req.get(n, "null") != "null"]
+
+        if kind == "forward":
+            def run(rng, args, auxs):
+                return graph_fn(rng, args, auxs)
+            f = jax.jit(run)
+        elif kind == "backward":
+            # fused fwd+bwd: one XLA module for the whole training step's
+            # compute (reference: full fwd+bwd graph in GraphExecutor::Init)
+            def run(rng, args, auxs, head_grads):
+                def fwd(diff_args):
+                    full = list(args)
+                    for p, v in zip(grad_pos, diff_args):
+                        full[p] = v
+                    outs, new_aux = graph_fn(rng, full, auxs)
+                    return outs, new_aux
+                diff = [args[p] for p in grad_pos]
+                (outs, new_aux), vjp = jax.vjp(lambda d: fwd(d), diff)
+                (grads,) = vjp((tuple(head_grads),
+                                tuple(jnp.zeros_like(a) for a in new_aux)))
+                return outs, new_aux, grads
+            f = jax.jit(run)
+        else:
+            raise ValueError(kind)
+        self._fn_cache[key] = f
+        return f
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        from . import random as _random
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise ValueError("unknown argument %r" % k)
+            data = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+            self.arg_dict[k]._set_data(data)
+        self._is_train = bool(is_train)
+        fn = self._compiled("forward", self._is_train)
+        rng = _random.next_key()
+        outs, new_aux = fn(rng, [a.data for a in self.arg_arrays],
+                           [a.data for a in self.aux_arrays])
+        self._last_rng = rng
+        for arr, val in zip(self.aux_arrays, new_aux):
+            arr._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        from . import random as _random
+
+        if out_grads is None:
+            head_grads = [None] * self._n_out
+        elif isinstance(out_grads, NDArray):
+            head_grads = [out_grads.data] + [None] * (self._n_out - 1)
+        else:
+            head_grads = [g.data if isinstance(g, NDArray) else
+                          (jnp.asarray(g) if g is not None else None)
+                          for g in out_grads]
+        fn = self._compiled("backward", True)
+        rng = getattr(self, "_last_rng", None)
+        if rng is None:
+            rng = _random.next_key()
+        # None head grads must be concrete arrays before entering jit
+        concrete_heads = []
+        if any(g is None for g in head_grads):
+            if not self.outputs:
+                self.forward(is_train=True)
+            for o, g in zip(self.outputs, head_grads):
+                concrete_heads.append(
+                    g if g is not None else jnp.ones(o.shape, o.dtype))
+        else:
+            concrete_heads = head_grads
+        outs, new_aux, grads = fn(rng, [a.data for a in self.arg_arrays],
+                                  [a.data for a in self.aux_arrays],
+                                  tuple(concrete_heads))
+        grad_pos = [i for i, n in enumerate(self.arg_names)
+                    if self._grad_req.get(n, "null") != "null"]
+        for p, g in zip(grad_pos, grads):
+            tgt = self.grad_arrays[p]
+            if tgt is None:
+                continue
+            name = self.arg_names[p]
+            if self._grad_req.get(name) == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g)
+        for arr, val in zip(self.aux_arrays, new_aux):
+            arr._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    arr.data if isinstance(arr, NDArray)
+                    else jnp.asarray(arr))
+            elif not allow_extra_params:
+                raise ValueError("unknown arg %r" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        arr.data if isinstance(arr, NDArray)
+                        else jnp.asarray(arr))
+                elif not allow_extra_params:
+                    raise ValueError("unknown aux %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes (cheap: jit recompiles per shape key)."""
+        shapes = {n: a.shape for n, a in self.arg_dict.items()}
+        shapes.update(kwargs)
+        new = Executor(self._symbol, ctx=self._ctx, grad_req=self._grad_req,
+                       arg_shapes=shapes)
+        for n, a in self.arg_dict.items():
+            if new.arg_dict[n].shape == a.shape:
+                new.arg_dict[n]._set_data(a.data)
+        for n, a in self.aux_dict.items():
+            if new.aux_dict[n].shape == a.shape:
+                new.aux_dict[n]._set_data(a.data)
+        return new
+
+    def debug_str(self):
+        return "Executor(%d nodes)" % len(self._symbol._topo())

@@ -1,0 +1,14 @@
+"""Data IO — iterators, batch types, RecordIO.
+
+Reference parity: `python/mxnet/io/io.py` (DataIter:178, NDArrayIter:489,
+MXDataIter:788 wrapping the C++ iterators in `src/io/`), `python/mxnet/
+recordio.py`.  TPU-native design: host-side numpy pipeline with double-buffer
+prefetch onto device (the reference's `iter_prefetcher.h`), sharded by
+`part_index/num_parts` for data parallelism; RecordIO keeps the reference's
+on-disk format so existing `.rec` datasets and `im2rec` tooling carry over.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter,  # noqa: F401
+                 ResizeIter, PrefetchingIter, CSVIter, MNISTIter)
+from . import io  # noqa: F401
+from ..recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader,  # noqa: F401
+                        pack, unpack, pack_img, unpack_img)

@@ -1,0 +1,42 @@
+"""Checkpoint helpers (symbolic format).
+
+Reference parity: `python/mxnet/model.py` — `save_checkpoint` (:394) writes
+``prefix-symbol.json`` (graph JSON) + ``prefix-####.params`` (NDArray map
+with ``arg:``/``aux:`` key prefixes), `load_checkpoint` (:424).  Formats are
+kept shape-compatible: the params file is `nd.save`'s container and the
+symbol file is the nnvm-shaped JSON from `Symbol.tojson`.
+"""
+from __future__ import annotations
+
+from . import ndarray as nd
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params)."""
+    from .symbol import load as sym_load
+
+    symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+from .module.base_module import BatchEndParam  # noqa: E402,F401

@@ -1,0 +1,13 @@
+"""Module API — the symbolic-era training stack (`mx.mod`).
+
+Reference parity: `python/mxnet/module/` — `BaseModule.fit` (base_module.py
+:409), `Module` (module.py:40), `BucketingModule` (bucketing_module.py).
+TPU-native: a Module binds its Symbol to ONE jit-compiled Executor
+(`mxnet_tpu/executor.py`); data parallelism over chips comes from the mesh/
+sharding layer rather than per-device executor replicas (the reference's
+`DataParallelExecutorGroup` splits batches host-side; on TPU the batch dim is
+sharded over the `dp` mesh axis and XLA handles the rest).
+"""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401

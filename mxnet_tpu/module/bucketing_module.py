@@ -1,0 +1,154 @@
+"""BucketingModule: variable-length training via per-bucket executors.
+
+Reference parity: `python/mxnet/module/bucketing_module.py` — one Module per
+bucket key, all sharing parameters; the batch's `bucket_key` selects which
+graph runs.  TPU-native: buckets are exactly the padded-shape-bucket strategy
+XLA wants (each bucket compiles once; SURVEY.md §7 hard part (a)) — the
+reference's memory-sharing trick is unnecessary because each bucket is its
+own jit cache entry and XLA arenas the memory.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    def _gen_symbol(self, key):
+        res = self._sym_gen(key)
+        if isinstance(res, tuple):
+            return res  # (sym, data_names, label_names)
+        return res, ("data",), ("softmax_label",)
+
+    def _module_for(self, bucket_key, data_shapes=None, label_shapes=None):
+        if bucket_key not in self._buckets:
+            sym, dnames, lnames = self._gen_symbol(bucket_key)
+            mod = Module(sym, data_names=dnames, label_names=lnames,
+                         logger=self.logger, context=self._context,
+                         fixed_param_names=self._fixed_param_names)
+            assert data_shapes is not None, \
+                "new bucket %r needs shapes" % (bucket_key,)
+            mod.bind(data_shapes, label_shapes,
+                     for_training=self.for_training)
+            if self.params_initialized:
+                ap, xp = self._curr_module.get_params()
+                mod.init_params(arg_params=ap, aux_params=xp,
+                                allow_missing=False, force_init=True)
+                if self.optimizer_initialized:
+                    mod._optimizer = self._curr_module._optimizer
+                    mod._updater = self._curr_module._updater
+                    mod.optimizer_initialized = True
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._curr_module = self._module_for(self._default_bucket_key,
+                                             data_shapes, label_shapes)
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        assert self.binded
+        mod = self._module_for(bucket_key, data_shapes, label_shapes)
+        # share latest params from current module
+        if self.params_initialized and mod is not self._curr_module:
+            ap, xp = self._curr_module.get_params()
+            mod.init_params(arg_params=ap, aux_params=xp, force_init=True)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._curr_module.set_params(arg_params, aux_params,
+                                     allow_missing=allow_missing,
+                                     force_init=force_init)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore=kvstore,
+                                         optimizer=optimizer,
+                                         optimizer_params=optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._curr_bucket_key
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        # param sync across buckets: all buckets share the updater; copy the
+        # current module's params into others lazily on switch
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

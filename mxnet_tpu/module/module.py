@@ -1,0 +1,269 @@
+"""Module: Symbol + Executor + Optimizer = trainable model.
+
+Reference parity: `python/mxnet/module/module.py` (Module:40 — bind:364,
+init_params:244, init_optimizer:478, forward:574, backward:608, update:644,
+save_checkpoint, Module.load).  TPU-native: one Executor (one fused XLA
+module per shape/train key) instead of a `DataParallelExecutorGroup`; the
+`update` path runs the framework optimizer's fused update ops; kvstore is
+accepted for API parity and maps to the collective-backed store
+(`mxnet_tpu/kvstore.py`).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import initializer as _init
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..context import current_context
+from ..model import load_checkpoint, save_checkpoint
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context if not isinstance(context, (list, tuple)) \
+            else context[0]
+        self._context = self._context or current_context()
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._preloaded_opt_states = None
+
+    # -- bind -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        shapes = {}
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes or [])
+        for desc in self._data_shapes + self._label_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else (desc[0], desc[1])
+            shapes[name] = tuple(shape)
+
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        self._exec = self._symbol.simple_bind(ctx=self._context,
+                                              grad_req=req, **shapes)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            ap, xp = shared_module.get_params()
+            self._exec.copy_params_from(ap, xp, allow_extra_params=True)
+            self.params_initialized = True
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        initializer = initializer or _init.Uniform(0.01)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                arr._set_data(src.data if isinstance(src, NDArray)
+                              else nd.array(src).data)
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+                if initializer is not None:
+                    initializer(_init.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                arr._set_data(src.data if isinstance(src, NDArray)
+                              else nd.array(src).data)
+            else:
+                if aux_params is not None and not allow_missing:
+                    raise RuntimeError("aux %s is not presented" % name)
+                if initializer is not None:
+                    initializer(_init.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # -- optimizer ------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            kwargs = dict(optimizer_params)
+            # reference module.py:497: grads from a batch-summed loss are
+            # rescaled by 1/batch_size unless the caller set it explicitly
+            if "rescale_grad" not in kwargs and self._data_shapes:
+                batch = self._data_shapes[0].shape[0] \
+                    if hasattr(self._data_shapes[0], "shape") \
+                    else self._data_shapes[0][1][0]
+                kwargs["rescale_grad"] = 1.0 / max(1, batch)
+            optimizer = opt.create(optimizer, **kwargs)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        if hasattr(optimizer, "idx2name"):
+            optimizer.idx2name = idx2name.copy()
+        self._kvstore = None  # collectives replace push/pull (SURVEY §2.4)
+        self.optimizer_initialized = True
+        if self._preloaded_opt_states:
+            self.load_optimizer_states(self._preloaded_opt_states)
+            self._preloaded_opt_states = None
+
+    # -- compute --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        data = data_batch.data
+        for name, arr in zip(self._data_names, data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feed[name] = arr
+        # shape change (e.g. last small batch) -> rebind executor cheaply
+        for name, arr in feed.items():
+            bound = self._exec.arg_dict[name].shape
+            if tuple(arr.shape) != bound:
+                self._exec = self._exec.reshape(
+                    **{n: tuple(a.shape) for n, a in feed.items()})
+                break
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self._symbol.list_outputs(), self._exec.outputs)))
+
+    # -- checkpoint -----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod._preloaded = (args, auxs)
+        if load_optimizer_states:
+            mod._preloaded_opt_states = "%s-%04d.states" % (prefix, epoch)
+        # defer applying until bind+init_params(arg_params=...)
+        orig_init = mod.init_params
+
+        def init_with_loaded(initializer=None, arg_params=None,
+                             aux_params=None, **kw):
+            orig_init(initializer=initializer,
+                      arg_params=arg_params or args,
+                      aux_params=aux_params or auxs, **kw)
+
+        mod.init_params = init_with_loaded
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [o.shape for o in self._exec.outputs]

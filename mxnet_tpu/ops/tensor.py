@@ -151,6 +151,26 @@ register("_rdiv_scalar", array_params=("scalar",))(
     lambda x, scalar=1.0: jnp.asarray(scalar, x.dtype) / x)
 register("_power_scalar", array_params=("scalar",))(
     lambda x, scalar=1.0: x ** jnp.asarray(scalar, x.dtype))
+register("_rpower_scalar", array_params=("scalar",))(
+    lambda x, scalar=1.0: jnp.asarray(scalar, x.dtype) ** x)
+
+
+# creation ops (no array inputs) — symbolic zeros/ones/arange compose these
+register("_zeros", no_grad=True)(
+    lambda shape=(), dtype="float32": jnp.zeros(tuple(shape), dtype))
+register("_ones", no_grad=True)(
+    lambda shape=(), dtype="float32": jnp.ones(tuple(shape), dtype))
+register("_full", no_grad=True)(
+    lambda shape=(), value=0.0, dtype="float32":
+        jnp.full(tuple(shape), value, dtype))
+
+
+@register("_arange", no_grad=True)
+def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
 
 
 @register("smooth_l1")

@@ -1,0 +1,190 @@
+"""RecordIO: the reference's packed binary record format, bit-compatible.
+
+Reference parity: `python/mxnet/recordio.py` (MXRecordIO, MXIndexedRecordIO,
+IRHeader pack/unpack, pack_img/unpack_img) over dmlc-core's recordio writer
+(`src/io/image_recordio.h` packs images this way; `tools/im2rec.py` creates
+the files).  The on-disk format is kept identical — magic 0xced7230a, a
+uint32 whose top 3 bits are a continuation flag and low 29 bits the length,
+4-byte record alignment — so `.rec` datasets made for the reference load here
+unchanged.  Implementation is pure python file IO (no dmlc-core); image
+encode/decode uses PIL instead of OpenCV.
+"""
+from __future__ import annotations
+
+import collections
+import io as _pyio
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+_CFLAG_SHIFT = 29
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference recordio.py:37)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        if flag not in ("r", "w"):
+            raise ValueError("flag must be 'r' or 'w'")
+        self.open()
+
+    def open(self):
+        self.fp = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self.writable = self.flag == "w"
+
+    def close(self):
+        if self.fp is not None:
+            self.fp.close()
+            self.fp = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        raise RuntimeError("MXRecordIO is not picklable across processes; "
+                           "reopen by uri in the worker")
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fp.tell()
+
+    def write(self, buf):
+        assert self.writable
+        n = len(buf)
+        if n > _LEN_MASK:
+            raise ValueError("record too large (%d bytes, max %d)"
+                             % (n, _LEN_MASK))
+        self.fp.write(struct.pack("<II", _MAGIC, n))
+        self.fp.write(buf)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self.fp.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _MAGIC:
+            raise IOError("invalid RecordIO magic at offset %d"
+                          % (self.fp.tell() - 8))
+        n = lrec & _LEN_MASK
+        buf = self.fp.read(n)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a sidecar ``.idx`` text file of
+    ``key\\toffset`` lines (reference recordio.py:139)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write("%s\t%d\n" % (key, self.idx[key]))
+            self.idx = dict(self.idx)
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + byte payload (reference recordio.py:211).  A vector
+    label is appended as float32s with flag = its length."""
+    header = IRHeader(*header)
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)) and np.ndim(label) > 0:
+        label = np.asarray(label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, int(header.flag), float(header.label),
+                       int(header.id), int(header.id2)) + s
+
+
+def unpack(s):
+    """Inverse of :func:`pack`: returns (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 image and pack it (reference recordio.py:257;
+    PIL instead of cv2)."""
+    from PIL import Image
+
+    arr = np.asarray(img, dtype=np.uint8)
+    mode = "L" if arr.ndim == 2 else "RGB"
+    buf = _pyio.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    kw = {"quality": quality} if fmt == "JPEG" else {}
+    Image.fromarray(arr, mode).save(buf, fmt, **kw)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack + decode an image record to (header, HWC uint8 array)."""
+    from PIL import Image
+
+    header, buf = unpack(s)
+    img = Image.open(_pyio.BytesIO(buf))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, np.asarray(img)

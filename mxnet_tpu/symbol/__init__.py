@@ -1,0 +1,15 @@
+"""Symbolic (declarative) API — `mx.sym`.
+
+Reference parity: `python/mxnet/symbol/` (`Symbol`:54, compose, simple_bind
+:1368, JSON save/load) over nnvm graph IR.  TPU-native redesign (SURVEY.md
+§7.5): a Symbol is a lightweight python DAG — there is no separate graph IR,
+pass manager, or memory planner, because `simple_bind` lowers the WHOLE graph
+(forward and, on demand, backward) into ONE `jax.jit` XLA module and XLA does
+optimization/fusion/memory planning.  Graph JSON keeps the nnvm node-list
+shape so `save_checkpoint` files and `mx.viz` tooling stay compatible.
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,  # noqa: F401
+                     zeros, ones, arange)
+from .register import _init_symbol_module
+
+_init_symbol_module()

@@ -1,0 +1,175 @@
+"""Graph shape inference.
+
+Reference parity: the `InferShape` nnvm pass (`src/executor/
+infer_graph_attr_pass.cc`; per-op `FInferShape` functors) that lets
+`simple_bind` materialize every parameter from just the data shape.
+TPU-native design: a forward walk where each op first derives its *parameter*
+input shapes from the (already-known) data input shape via a small hook
+table, then gets its output shapes from `jax.eval_shape` on the op's own jax
+function — one source of truth, no per-op duplicate shape math.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _conv_params(node, in_shapes):
+    p = node.attrs
+    d = in_shapes[0]
+    k = tuple(p.get("kernel", ()))
+    nf = int(p.get("num_filter", 1))
+    ng = int(p.get("num_group", 1))
+    shapes = {"weight": (nf, d[1] // ng) + k, "bias": (nf,)}
+    return shapes
+
+
+def _deconv_params(node, in_shapes):
+    p = node.attrs
+    d = in_shapes[0]
+    k = tuple(p.get("kernel", ()))
+    nf = int(p.get("num_filter", 1))
+    ng = int(p.get("num_group", 1))
+    return {"weight": (d[1], nf // ng) + k, "bias": (nf,)}
+
+
+def _fc_params(node, in_shapes):
+    p = node.attrs
+    d = in_shapes[0]
+    nh = int(p["num_hidden"])
+    in_dim = int(np.prod(d[1:])) if p.get("flatten", True) else d[-1]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+def _norm_params(node, in_shapes):
+    axis = int(node.attrs.get("axis", 1))
+    c = in_shapes[0][axis % len(in_shapes[0])]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
+            "moving_var": (c,)}
+
+
+def _layernorm_params(node, in_shapes):
+    axis = int(node.attrs.get("axis", -1))
+    c = in_shapes[0][axis % len(in_shapes[0])]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embedding_params(node, in_shapes):
+    p = node.attrs
+    return {"weight": (int(p["input_dim"]), int(p["output_dim"]))}
+
+
+def _rnn_params(node, in_shapes):
+    from ..ops.rnn import rnn_param_size
+
+    p = node.attrs
+    d = in_shapes[0]  # [T, B, input]
+    sz = rnn_param_size(p.get("mode", "lstm"), d[2],
+                        int(p.get("state_size", 0)),
+                        int(p.get("num_layers", 1)),
+                        bool(p.get("bidirectional", False)))
+    nl = int(p.get("num_layers", 1)) * (2 if p.get("bidirectional") else 1)
+    ss = int(p.get("state_size", 0))
+    return {"parameters": (sz,), "state": (nl, d[1], ss),
+            "state_cell": (nl, d[1], ss)}
+
+
+def _prelu_params(node, in_shapes):
+    if node.attrs.get("act_type") != "prelu":
+        return {}
+    return {"gamma": (in_shapes[0][1],)}
+
+
+def _softmax_label(node, in_shapes):
+    d = in_shapes[0]
+    if node.attrs.get("multi_output"):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+def _regression_label(node, in_shapes):
+    return {"label": tuple(in_shapes[0])}
+
+
+_PARAM_HOOKS = {
+    "Convolution": _conv_params,
+    "Deconvolution": _deconv_params,
+    "FullyConnected": _fc_params,
+    "BatchNorm": _norm_params,
+    "InstanceNorm": _layernorm_params,
+    "LayerNorm": _layernorm_params,
+    "Embedding": _embedding_params,
+    "RNN": _rnn_params,
+    "LeakyReLU": _prelu_params,
+    "SoftmaxOutput": _softmax_label,
+    "LinearRegressionOutput": _regression_label,
+    "MAERegressionOutput": _regression_label,
+    "LogisticRegressionOutput": _regression_label,
+}
+
+
+def infer_node_param_shapes(node, in_shapes):
+    """Shapes for a node's parameter inputs given data input shapes."""
+    hook = _PARAM_HOOKS.get(node.op.name)
+    return hook(node, in_shapes) if hook else {}
+
+
+def _eval_out_shapes(node, in_shapes, dtype=np.float32):
+    """Output shapes by abstract evaluation of the op's jax fn."""
+    opdef = node.op
+    f = opdef.bind(dict(node.attrs), train=True)
+    args = [jax.ShapeDtypeStruct(s, dtype) for s in in_shapes]
+    if opdef.needs_rng:
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        out = jax.eval_shape(f, key, *args)
+    else:
+        out = jax.eval_shape(f, *args)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return [tuple(o.shape) for o in out]
+
+
+def infer_shapes(sym, known):
+    """Walk the graph; returns (arg_shapes, out_shapes, aux_shapes) aligned
+    with list_arguments/list_outputs/list_auxiliary_states."""
+    shapes = {}     # id(node) -> list of output shapes
+    var_shape = {}  # var name -> shape
+
+    for node in sym._topo():
+        if node.is_var:
+            s = known.get(node.name, node.shape_hint)
+            var_shape[node.name] = tuple(s) if s is not None else None
+            shapes[id(node)] = [var_shape[node.name]]
+            continue
+        in_shapes = []
+        unknown_slots = []
+        for i, (src, oi) in enumerate(node.inputs):
+            s = shapes[id(src)][oi]
+            in_shapes.append(s)
+            if s is None:
+                unknown_slots.append((i, src))
+        if unknown_slots and in_shapes[0] is not None:
+            hints = infer_node_param_shapes(node, in_shapes)
+            in_names = node.op.input_names
+            for i, src in unknown_slots:
+                if i < len(in_names) and in_names[i] in hints:
+                    s = tuple(int(x) for x in hints[in_names[i]])
+                    in_shapes[i] = s
+                    if src.is_var:
+                        var_shape[src.name] = s
+                        shapes[id(src)][0] = s
+        if any(s is None for s in in_shapes):
+            shapes[id(node)] = [None] * max(node.op.num_outputs, 1)
+            continue
+        try:
+            shapes[id(node)] = _eval_out_shapes(node, in_shapes)
+        except Exception:
+            shapes[id(node)] = [None] * max(node.op.num_outputs, 1)
+
+    arg_shapes = [var_shape.get(n) for n in sym.list_arguments()]
+    aux_shapes = [var_shape.get(n) for n in sym.list_auxiliary_states()]
+    out_shapes = [shapes[id(node)][oi] if shapes[id(node)][oi] is not None
+                  else None
+                  for node, oi in sym._outputs]
+    return arg_shapes, out_shapes, aux_shapes

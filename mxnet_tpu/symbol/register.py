@@ -1,0 +1,87 @@
+"""Symbolic op wrappers, generated from the op registry.
+
+Reference parity: `python/mxnet/symbol/register.py` codegen of `mx.sym.*`
+from the C op registry at import time.  Each wrapper composes symbols and
+auto-creates parameter Variables for unbound named inputs (`{name}_weight`
+etc.) — the reference's "list_arguments grows implicit params" behavior.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import OPS
+from .symbol import Symbol, Variable, _NameManager, _Node, _single
+
+# trailing inputs that are optional given a static param setting
+_SKIP_INPUT = {
+    ("bias", "no_bias"): lambda p: bool(p.get("no_bias")),
+    ("state_cell", "mode"): lambda p: p.get("mode", "lstm") != "lstm",
+}
+
+
+def _make_wrapper(opdef):
+    input_names = tuple(opdef.input_names)
+
+    def creator(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        sym_kwargs, params = {}, {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            elif v is not None:
+                params[k] = v
+        name = name or _NameManager.get(opdef.name.lower().lstrip("_"))
+
+        if input_names:
+            bound = {}
+            if len(args) > len(input_names):
+                raise TypeError("%s takes at most %d positional inputs"
+                                % (opdef.name, len(input_names)))
+            for in_name, a in zip(input_names, args):
+                if not isinstance(a, Symbol):
+                    raise TypeError("positional input %r of %s must be a "
+                                    "Symbol" % (in_name, opdef.name))
+                bound[in_name] = a
+            bound.update(sym_kwargs)
+            inputs = []
+            for i, in_name in enumerate(input_names):
+                skip = any(in_name == k[0] and fn(params)
+                           for k, fn in _SKIP_INPUT.items())
+                if skip:
+                    continue
+                if in_name in bound:
+                    inputs.append(bound[in_name]._outputs[0])
+                elif i == 0:
+                    raise TypeError("%s requires input %r"
+                                    % (opdef.name, in_name))
+                else:
+                    # implicit parameter variable (reference convention)
+                    v = Variable("%s_%s" % (name, in_name))
+                    inputs.append(v._outputs[0])
+        else:
+            syms = list(args) + list(sym_kwargs.values())
+            inputs = []
+            for a in syms:
+                if len(a._outputs) != 1:
+                    raise ValueError("cannot compose with grouped symbol")
+                inputs.append(a._outputs[0])
+
+        node = _Node(opdef, name, inputs, params)
+        return _single(node)
+
+    creator.__name__ = opdef.name
+    creator.__doc__ = (opdef.fn.__doc__ or "") + \
+        "\n\n(symbolic wrapper; composes a graph node)"
+    return creator
+
+
+def _init_symbol_module():
+    mod = sys.modules[__package__]
+    done = set()
+    for name, opdef in OPS.items():
+        if id(opdef) in done and name != opdef.name:
+            pass  # alias: still expose under alias name
+        wrapper = _make_wrapper(opdef)
+        setattr(mod, name, wrapper)
+        done.add(id(opdef))

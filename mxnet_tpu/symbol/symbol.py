@@ -1,0 +1,443 @@
+"""Symbol: the declarative graph value type.
+
+Reference parity: `python/mxnet/symbol/symbol.py` class Symbol (:54) —
+composition, `list_arguments/list_outputs/list_auxiliary_states`,
+`infer_shape` (:996), `tojson/save/load`, `__getitem__` output selection,
+operator overloads — over `src/nnvm/` graph nodes.  See package docstring for
+the TPU-native executor design (`simple_bind` → one jit module, in
+`mxnet_tpu/executor.py`).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from ..ops.registry import OPS, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+
+class _Node:
+    """One graph node: a variable (op None) or an op application."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "shape_hint", "dtype_hint",
+                 "user_attrs")
+
+    def __init__(self, op, name, inputs=(), attrs=None, shape_hint=None,
+                 dtype_hint=None, user_attrs=None):
+        self.op = op                      # OpDef or None (variable)
+        self.name = name
+        self.inputs = list(inputs)        # [(node, out_index)]
+        self.attrs = dict(attrs or {})    # static op params
+        self.shape_hint = shape_hint      # for variables
+        self.dtype_hint = dtype_hint
+        self.user_attrs = dict(user_attrs or {})  # __xxx__ attributes
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    def num_visible_outputs(self):
+        if self.is_var:
+            return 1
+        n = max(self.op.num_outputs, 1)
+        return n - len(self.op.mutate)
+
+    def visible_output_indices(self):
+        if self.is_var:
+            return [0]
+        n = max(self.op.num_outputs, 1)
+        return [i for i in range(n) if i not in self.op.mutate]
+
+
+class _NameManager:
+    _lock = threading.Lock()
+    _counts: dict = {}
+
+    @classmethod
+    def get(cls, hint):
+        with cls._lock:
+            c = cls._counts.get(hint, 0)
+            cls._counts[hint] = c + 1
+        return "%s%d" % (hint, c)
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._counts.clear()
+
+
+class Symbol:
+    """A (multi-)output slice of the graph (reference symbol.py:54)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)     # [(node, out_index)]
+
+    # -- composition helpers -------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        if len(self._outputs) == 1:
+            return "<Symbol %s>" % self._outputs[0][0].name
+        return "<Symbol Grouped>"
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            # allow bare node name
+            for i, (node, oi) in enumerate(self._outputs):
+                if node.name == index:
+                    return Symbol([self._outputs[i]])
+            raise ValueError("cannot find output %r" % index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def get_internals(self):
+        """A symbol grouping every internal output (reference :588)."""
+        outs = []
+        for node in self._topo():
+            for oi in node.visible_output_indices():
+                outs.append((node, oi))
+        return Symbol(outs)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- attributes -----------------------------------------------------
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node.user_attrs.get(key)
+
+    def list_attr(self):
+        return dict(self._outputs[0][0].user_attrs)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = dict(node.user_attrs)
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].user_attrs.update(kwargs)
+
+    # -- graph walks ----------------------------------------------------
+    def _topo(self):
+        """Topological order of all reachable nodes (inputs first)."""
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self):
+        """Names of input variables in non-aux positions (reference :820)."""
+        aux = self._aux_nodes()
+        return [n.name for n in self._topo()
+                if n.is_var and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_nodes()
+        return [n.name for n in self._topo() if n.is_var and id(n) in aux]
+
+    def _aux_nodes(self):
+        """Variables feeding a mutated (aux-state) input slot, e.g.
+        BatchNorm's moving_mean/var (the reference's FMutateInputs)."""
+        aux = set()
+        for node in self._topo():
+            if node.is_var or not node.op.mutate:
+                continue
+            for _, in_idx in node.op.mutate.items():
+                if in_idx < len(node.inputs):
+                    src = node.inputs[in_idx][0]
+                    if src.is_var:
+                        aux.add(id(src))
+        return aux
+
+
+    def list_outputs(self):
+        names = []
+        for node, oi in self._outputs:
+            if node.is_var:
+                names.append(node.name)
+            elif node.num_visible_outputs() == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, oi))
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_var]
+
+    # -- shape/type inference ------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) (reference :996); unknown
+        shapes come back as None entries when inference is impossible."""
+        from .infer import infer_shapes
+
+        known = dict(kwargs)
+        if args:
+            for name, shp in zip(self.list_arguments(), args):
+                if shp is not None:
+                    known[name] = shp
+        return infer_shapes(self, known)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self.infer_shape(*args, **kwargs)
+
+    def infer_type(self, *args, **kwargs):
+        args_ = self.list_arguments()
+        dt = np.float32
+        return ([dt] * len(args_),
+                [dt] * len(self._outputs),
+                [dt] * len(self.list_auxiliary_states()))
+
+    # -- serialization --------------------------------------------------
+    def tojson(self):
+        """nnvm-shaped graph JSON (nodes/arg_nodes/heads), reference
+        `save`/`tojson` (:1207) + `src/nnvm/legacy_json_util.cc`."""
+        nodes_list = self._topo()
+        nid = {id(n): i for i, n in enumerate(nodes_list)}
+        aux = self._aux_nodes()
+        nodes_json = []
+        for n in nodes_list:
+            entry = {
+                "op": "null" if n.is_var else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[id(src)], oi, 0] for src, oi in n.inputs],
+            }
+            attrs = {k: json.dumps(v) for k, v in n.attrs.items()}
+            if attrs:
+                entry["attrs"] = attrs
+            if n.user_attrs:
+                entry["user_attrs"] = dict(n.user_attrs)
+            if n.is_var and n.shape_hint is not None:
+                entry["shape_hint"] = list(n.shape_hint)
+            nodes_json.append(entry)
+        heads = [[nid[id(n)], oi, 0] for n, oi in self._outputs]
+        arg_nodes = [nid[id(n)] for n in nodes_list if n.is_var]
+        return json.dumps({
+            "nodes": nodes_json,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes_list) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10400],
+                      "mxnet_tpu_format": ["int", 1]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding --------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor(self, ctx=ctx, grad_req=grad_req,
+                        arg_shapes=kwargs, type_dict=type_dict,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor(self, ctx=ctx, grad_req=grad_req, args=args,
+                        args_grad=args_grad, aux_states=aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx=ctx, args=kwargs, grad_req="null")
+        return ex.forward()
+
+    def gradient(self, wrt):  # pragma: no cover - reference compat stub
+        raise NotImplementedError(
+            "use simple_bind(...).backward() — gradients are computed by "
+            "jax.vjp over the bound executor")
+
+    # -- arithmetic -----------------------------------------------------
+    def _binop(self, other, op_name, scalar_op, rscalar_op=None, rev=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rev else (self, other)
+            return _apply(op_name, [a, b], {})
+        if isinstance(other, (int, float, np.floating, np.integer)):
+            name = rscalar_op if (rev and rscalar_op) else scalar_op
+            return _apply(name, [self], {"scalar": float(other)})
+        raise TypeError(type(other))
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar",
+                           "_rminus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar",
+                           "_rminus_scalar", rev=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar",
+                           "_rdiv_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar",
+                           "_rdiv_scalar", rev=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar",
+                           "_rpower_scalar")
+
+    def __rpow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar",
+                           "_rpower_scalar", rev=True)
+
+    def __neg__(self):
+        return _apply("negative", [self], {})
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-by-convention; shallow is safe
+        return Symbol(list(self._outputs))
+
+    def __eq__(self, other):
+        if isinstance(other, Symbol):
+            return _apply("broadcast_equal", [self, other], {})
+        if isinstance(other, (int, float)):
+            return _apply("_scalar_broadcast_equal", [self],
+                          {"scalar": float(other)})
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+
+def _single(node):
+    oi = node.visible_output_indices()
+    return Symbol([(node, i) for i in oi]) if len(oi) > 1 \
+        else Symbol([(node, oi[0])])
+
+
+def _apply(op_name, input_syms, attrs, name=None):
+    """Compose: apply a registered op to symbols (reference _symbol_creator)."""
+    opdef = get_op(op_name)
+    name = name or _NameManager.get(opdef.name.lower().lstrip("_"))
+    inputs = []
+    for s in input_syms:
+        if len(s._outputs) != 1:
+            raise ValueError("cannot compose with a grouped symbol input")
+        inputs.append(s._outputs[0])
+    node = _Node(opdef, name, inputs, attrs)
+    return _single(node)
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference symbol.py:2442)."""
+    ua = dict(attr or {})
+    if lr_mult is not None:
+        ua["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        ua["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        ua["__init__"] = init if isinstance(init, str) else init.dumps()
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            ua[k] = str(v)
+    node = _Node(None, name, shape_hint=tuple(shape) if shape else None,
+                 dtype_hint=dtype, user_attrs=ua)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference :2520)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from graph JSON (inverse of tojson)."""
+    g = json.loads(json_str)
+    nodes = []
+    for entry in g["nodes"]:
+        attrs = {k: json.loads(v) for k, v in entry.get("attrs", {}).items()}
+        inputs = [(nodes[nid], oi) for nid, oi, _ in entry.get("inputs", [])]
+        if entry["op"] == "null":
+            node = _Node(None, entry["name"],
+                         shape_hint=tuple(entry["shape_hint"])
+                         if entry.get("shape_hint") else None,
+                         user_attrs=entry.get("user_attrs"))
+        else:
+            node = _Node(get_op(entry["op"]), entry["name"], inputs, attrs,
+                         user_attrs=entry.get("user_attrs"))
+        nodes.append(node)
+    heads = [(nodes[nid], oi) for nid, oi, _ in g["heads"]]
+    return Symbol(heads)
+
+
+def zeros(shape, dtype=None, name=None, **kwargs):
+    return _apply("_zeros", [], {"shape": tuple(np.atleast_1d(shape)),
+                                 "dtype": dtype or "float32"}, name=name)
+
+
+def ones(shape, dtype=None, name=None, **kwargs):
+    return _apply("_ones", [], {"shape": tuple(np.atleast_1d(shape)),
+                                "dtype": dtype or "float32"}, name=name)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, name=None):
+    return _apply("_arange", [], {"start": start, "stop": stop, "step": step,
+                                  "repeat": repeat,
+                                  "dtype": dtype or "float32"}, name=name)
