@@ -1,0 +1,14 @@
+"""Pallas TPU kernels — the hand-tuned hot path.
+
+The reference's equivalent layer is its CUDA kernel corpus
+(`src/operator/nn/*.cu`, cuDNN bindings, mshadow expression templates).  Here
+XLA generates almost everything; Pallas kernels are reserved for the ops
+where explicit VMEM blocking beats XLA's default schedule — attention above
+all (the reference predates flash attention entirely; SURVEY.md §5
+"Long-context: absent").
+
+Kernels fall back to pure-lax implementations off-TPU (CPU oracle testing —
+SURVEY.md §4 test strategy).
+"""
+from .flash_attention import flash_attention  # noqa: F401
+from .layers import fused_rmsnorm, fused_softmax_xent  # noqa: F401
