@@ -170,9 +170,11 @@ class Executor:
             def run(rng, args, auxs):
                 return graph_fn(rng, args, auxs)
             f = jax.jit(run)
-        elif kind == "backward":
+        elif kind in ("backward", "backward_ones"):
             # fused fwd+bwd: one XLA module for the whole training step's
-            # compute (reference: full fwd+bwd graph in GraphExecutor::Init)
+            # compute (reference: full fwd+bwd graph in GraphExecutor::Init).
+            # "backward_ones" builds the head gradients as ones_like(outs)
+            # inside the module, so no standalone forward is needed first.
             def run(rng, args, auxs, head_grads):
                 def fwd(diff_args):
                     full = list(args)
@@ -182,38 +184,53 @@ class Executor:
                     return outs, new_aux
                 diff = [args[p] for p in grad_pos]
                 (outs, new_aux), vjp = jax.vjp(lambda d: fwd(d), diff)
-                (grads,) = vjp((tuple(head_grads),
+                heads = (tuple(jnp.ones_like(o) for o in outs)
+                         if head_grads is None else tuple(head_grads))
+                (grads,) = vjp((heads,
                                 tuple(jnp.zeros_like(a) for a in new_aux)))
                 return outs, new_aux, grads
-            f = jax.jit(run)
+            if kind == "backward":
+                f = jax.jit(run)
+            else:
+                f = jax.jit(lambda rng, args, auxs: run(rng, args, auxs,
+                                                        None))
         else:
             raise ValueError(kind)
         self._fn_cache[key] = f
         return f
 
     # ------------------------------------------------------------------
-    def forward(self, is_train=False, **kwargs):
-        from . import random as _random
-
-        for k, v in kwargs.items():
+    def _stage(self, feed):
+        """Write a {name: array} feed into the bound arg arrays."""
+        for k, v in feed.items():
             if k not in self.arg_dict:
                 raise ValueError("unknown argument %r" % k)
             data = v.data if isinstance(v, NDArray) else jnp.asarray(v)
             self.arg_dict[k]._set_data(data)
+
+    def forward(self, is_train=False, **kwargs):
+        from . import random as _random
+
+        self._stage(kwargs)
         self._is_train = bool(is_train)
         fn = self._compiled("forward", self._is_train)
         rng = _random.next_key()
-        outs, new_aux = fn(rng, [a.data for a in self.arg_arrays],
-                           [a.data for a in self.aux_arrays])
+        aux_in = [a.data for a in self.aux_arrays]
+        outs, new_aux = fn(rng, [a.data for a in self.arg_arrays], aux_in)
         self._last_rng = rng
+        # snapshot pre-update aux: a following backward() recomputes the
+        # forward from this same starting state, so aux EMA (BatchNorm
+        # moving stats) is applied exactly once per fwd+bwd pair
+        self._aux_in = aux_in
         for arr, val in zip(self.aux_arrays, new_aux):
             arr._set_data(val)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         return self.outputs
 
-    def backward(self, out_grads=None, is_train=True):
+    def backward(self, out_grads=None, is_train=True, **kwargs):
         from . import random as _random
 
+        self._stage(kwargs)
         if out_grads is None:
             head_grads = [None] * self._n_out
         elif isinstance(out_grads, NDArray):
@@ -222,23 +239,38 @@ class Executor:
             head_grads = [g.data if isinstance(g, NDArray) else
                           (jnp.asarray(g) if g is not None else None)
                           for g in out_grads]
-        fn = self._compiled("backward", True)
         rng = getattr(self, "_last_rng", None)
         if rng is None:
             rng = _random.next_key()
-        # None head grads must be concrete arrays before entering jit
-        concrete_heads = []
-        if any(g is None for g in head_grads):
-            if not self.outputs:
-                self.forward(is_train=True)
-            for o, g in zip(self.outputs, head_grads):
-                concrete_heads.append(
-                    g if g is not None else jnp.ones(o.shape, o.dtype))
+        self._last_rng = None  # consume: each fwd+bwd pair gets fresh keys
+        # aux inputs: recompute from the pre-forward snapshot when a forward
+        # already ran this step (single EMA application per fwd+bwd pair)
+        aux_in = getattr(self, "_aux_in", None)
+        if aux_in is None:
+            aux_in = [a.data for a in self.aux_arrays]
+        self._aux_in = None
+        arg_data = [a.data for a in self.arg_arrays]
+        if all(g is None for g in head_grads):
+            # head grads of ones built inside the jitted module — no
+            # standalone forward needed
+            fn = self._compiled("backward_ones", True)
+            outs, new_aux, grads = fn(rng, arg_data, aux_in)
         else:
-            concrete_heads = head_grads
-        outs, new_aux, grads = fn(rng, [a.data for a in self.arg_arrays],
-                                  [a.data for a in self.aux_arrays],
-                                  tuple(concrete_heads))
+            # mixed None/concrete heads need output shapes for the ones
+            concrete_heads = []
+            if any(g is None for g in head_grads):
+                if not self.outputs:
+                    self.forward(is_train=True)
+                    aux_in = self._aux_in
+                    self._aux_in = None
+                for o, g in zip(self.outputs, head_grads):
+                    concrete_heads.append(
+                        g if g is not None else jnp.ones(o.shape, o.dtype))
+            else:
+                concrete_heads = head_grads
+            fn = self._compiled("backward", True)
+            outs, new_aux, grads = fn(rng, arg_data, aux_in,
+                                      tuple(concrete_heads))
         grad_pos = [i for i, n in enumerate(self.arg_names)
                     if self._grad_req.get(n, "null") != "null"]
         for p, g in zip(grad_pos, grads):

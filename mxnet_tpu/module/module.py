@@ -80,6 +80,13 @@ class Module(BaseModule):
             ap, xp = shared_module.get_params()
             self._exec.copy_params_from(ap, xp, allow_extra_params=True)
             self.params_initialized = True
+        elif getattr(self, "_preloaded", None) is not None:
+            # Module.load workflow: loaded params apply at bind time, so
+            # load -> bind -> forward works without an init_params call
+            # (reference applies arg_params in bind via shared exec state)
+            args, auxs = self._preloaded
+            self._exec.copy_params_from(args, auxs, allow_extra_params=True)
+            self.params_initialized = True
 
     # -- params ---------------------------------------------------------
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -155,10 +162,9 @@ class Module(BaseModule):
             self._preloaded_opt_states = None
 
     # -- compute --------------------------------------------------------
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _feed_batch(self, data_batch):
+        """Stage a batch into the executor's arg arrays (rebinding on a
+        shape change, e.g. the last small batch)."""
         feed = {}
         data = data_batch.data
         for name, arr in zip(self._data_names, data):
@@ -167,14 +173,28 @@ class Module(BaseModule):
             for name, arr in zip(self._label_names, data_batch.label):
                 if name in self._exec.arg_dict:
                     feed[name] = arr
-        # shape change (e.g. last small batch) -> rebind executor cheaply
         for name, arr in feed.items():
             bound = self._exec.arg_dict[name].shape
             if tuple(arr.shape) != bound:
                 self._exec = self._exec.reshape(
                     **{n: tuple(a.shape) for n, a in feed.items()})
                 break
+        return feed
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = self._feed_batch(data_batch)
         self._exec.forward(is_train=is_train, **feed)
+
+    def forward_backward(self, data_batch):
+        """One fused fwd+bwd XLA module per step — forward compute runs
+        once, not twice (reference fuses them too: the full graph built in
+        GraphExecutor::Init covers forward and backward)."""
+        assert self.binded and self.params_initialized
+        feed = self._feed_batch(data_batch)
+        self._exec.backward(**feed)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized

@@ -183,7 +183,10 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
 # ---------------------------------------------------------------------------
 @register("BatchNorm", input_names=("data", "gamma", "beta", "moving_mean",
                                     "moving_var"),
-          train_aware=True, mutate={3: 3, 4: 4}, num_outputs=5)
+          train_aware=True, mutate={3: 3, 4: 4}, num_outputs=5,
+          visible_out=lambda attrs: [0, 1, 2]
+          if str(attrs.get("output_mean_var", False)).lower()
+          in ("true", "1") else [0])
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False, _train=False):
@@ -439,43 +442,49 @@ def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
 # Losses as ops (reference has them as ops too)
 # ---------------------------------------------------------------------------
 def _regression_output(fwd_fn, grad_fn):
-    @jax.custom_vjp
-    def core(d, l):
+    @_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(d, l, grad_scale):
         return fwd_fn(d)
 
-    def fwd(d, l):
+    def fwd(d, l, grad_scale):
         return fwd_fn(d), (d, l)
 
-    def bwd(res, g):
+    def bwd(grad_scale, res, g):
+        # reference scales by grad_scale / num_output, where num_output is
+        # the per-sample label size — NOT the batch size (batch rescaling
+        # is the optimizer's rescale_grad job), regression_output-inl.h:200
         d, l = res
-        return (grad_fn(d, l), jnp.zeros_like(l))
+        num_output = max(1, int(np.prod(l.shape[1:]))) if l.ndim > 1 else 1
+        scale = jnp.asarray(grad_scale / num_output, d.dtype)
+        return (grad_fn(d, l) * scale, jnp.zeros_like(l))
 
     core.defvjp(fwd, bwd)
     return core
 
 
 _linreg_core = _regression_output(
-    lambda d: d, lambda d, l: (d - l) / d.shape[0])
+    lambda d: d, lambda d, l: d - l)
 _maereg_core = _regression_output(
-    lambda d: d, lambda d, l: jnp.sign(d - l) / d.shape[0])
+    lambda d: d, lambda d, l: jnp.sign(d - l))
 _logreg_core = _regression_output(
-    jax.nn.sigmoid, lambda d, l: (jax.nn.sigmoid(d) - l) / d.shape[0])
+    jax.nn.sigmoid, lambda d, l: jax.nn.sigmoid(d) - l)
 
 
 @register("LinearRegressionOutput", input_names=("data", "label"))
 def _linear_regression_output(data, label, grad_scale=1.0):
-    """Reference: src/operator/regression_output.cc — fwd identity, bwd (p-y)."""
-    return _linreg_core(data, label)
+    """Reference: src/operator/regression_output.cc — fwd identity, bwd
+    (p-y) * grad_scale / num_output."""
+    return _linreg_core(data, label, float(grad_scale))
 
 
 @register("MAERegressionOutput", input_names=("data", "label"))
 def _mae_regression_output(data, label, grad_scale=1.0):
-    return _maereg_core(data, label)
+    return _maereg_core(data, label, float(grad_scale))
 
 
 @register("LogisticRegressionOutput", input_names=("data", "label"))
 def _logistic_regression_output(data, label, grad_scale=1.0):
-    return _logreg_core(data, label)
+    return _logreg_core(data, label, float(grad_scale))
 
 
 # ---------------------------------------------------------------------------

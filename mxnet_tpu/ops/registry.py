@@ -57,11 +57,13 @@ class OpDef:
         "aliases",
         "input_names",
         "cacheable",
+        "visible_out",
     )
 
     def __init__(self, name, fn, needs_rng=False, train_aware=False,
                  array_params=(), mutate=None, num_outputs=1, no_grad=False,
-                 aliases=(), input_names=(), cacheable=True):
+                 aliases=(), input_names=(), cacheable=True,
+                 visible_out=None):
         self.name = name
         self.fn = fn
         self.needs_rng = needs_rng
@@ -73,6 +75,10 @@ class OpDef:
         self.aliases = tuple(aliases)
         self.input_names = tuple(input_names)
         self.cacheable = cacheable
+        # optional callable attrs -> list of symbol-visible output indices
+        # (reference FNumVisibleOutputs, e.g. BatchNorm shows 1 output
+        # unless output_mean_var)
+        self.visible_out = visible_out
 
     def __repr__(self):
         return "OpDef(%s)" % self.name

@@ -62,33 +62,81 @@ class MXRecordIO:
     def tell(self):
         return self.fp.tell()
 
+    def _write_chunk(self, cflag, chunk):
+        lrec = (cflag << 29) | len(chunk)
+        self.fp.write(struct.pack("<II", _MAGIC, lrec))
+        self.fp.write(chunk)
+        pad = (4 - len(chunk) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
     def write(self, buf):
         assert self.writable
         n = len(buf)
         if n > _LEN_MASK:
             raise ValueError("record too large (%d bytes, max %d)"
                              % (n, _LEN_MASK))
-        self.fp.write(struct.pack("<II", _MAGIC, n))
-        self.fp.write(buf)
-        pad = (4 - n % 4) % 4
-        if pad:
-            self.fp.write(b"\x00" * pad)
+        buf = bytes(buf)
+        # dmlc framing: payloads containing the magic word at 4-byte-aligned
+        # offsets are split there into continuation parts (cflag 1=begin,
+        # 2=middle, 3=end); the reader re-inserts the magic between parts
+        magic_bytes = struct.pack("<I", _MAGIC)
+        parts = []
+        start = 0
+        pos = buf.find(magic_bytes)
+        while pos != -1:
+            if pos % 4 == 0:  # dmlc scans at 4-byte-aligned offsets only
+                parts.append(buf[start:pos])
+                start = pos + 4
+                pos = buf.find(magic_bytes, pos + 4)
+            else:
+                pos = buf.find(magic_bytes, pos + 1)
+        parts.append(buf[start:])
+        if len(parts) == 1:
+            self._write_chunk(0, buf)
+        else:
+            self._write_chunk(1, parts[0])
+            for p in parts[1:-1]:
+                self._write_chunk(2, p)
+            self._write_chunk(3, parts[-1])
 
     def read(self):
         assert not self.writable
-        hdr = self.fp.read(8)
-        if len(hdr) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", hdr)
-        if magic != _MAGIC:
-            raise IOError("invalid RecordIO magic at offset %d"
-                          % (self.fp.tell() - 8))
-        n = lrec & _LEN_MASK
-        buf = self.fp.read(n)
-        pad = (4 - n % 4) % 4
-        if pad:
-            self.fp.read(pad)
-        return buf
+        out = None
+        magic_bytes = struct.pack("<I", _MAGIC)
+        while True:
+            hdr = self.fp.read(8)
+            if len(hdr) < 8:
+                if out is not None:
+                    raise IOError("truncated multi-part record at EOF")
+                return None
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _MAGIC:
+                raise IOError("invalid RecordIO magic at offset %d"
+                              % (self.fp.tell() - 8))
+            cflag = lrec >> 29
+            n = lrec & _LEN_MASK
+            buf = self.fp.read(n)
+            pad = (4 - n % 4) % 4
+            if pad:
+                self.fp.read(pad)
+            if cflag == 0:
+                if out is not None:
+                    raise IOError("unexpected whole record inside "
+                                  "multi-part record")
+                return buf
+            if cflag == 1:
+                if out is not None:
+                    raise IOError("begin part inside multi-part record "
+                                  "(lost end part?)")
+                out = bytearray(buf)
+            elif out is None:
+                raise IOError("continuation part without a begin part")
+            else:
+                out += magic_bytes
+                out += buf
+                if cflag == 3:
+                    return bytes(out)
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -115,11 +115,13 @@ def infer_node_param_shapes(node, in_shapes):
     return hook(node, in_shapes) if hook else {}
 
 
-def _eval_out_shapes(node, in_shapes, dtype=np.float32):
-    """Output shapes by abstract evaluation of the op's jax fn."""
+def _eval_out(node, in_shapes, in_dtypes):
+    """Output (shape, dtype) pairs by abstract evaluation of the op's jax
+    fn — one source of truth for both shape and type inference."""
     opdef = node.op
     f = opdef.bind(dict(node.attrs), train=True)
-    args = [jax.ShapeDtypeStruct(s, dtype) for s in in_shapes]
+    args = [jax.ShapeDtypeStruct(s, dt)
+            for s, dt in zip(in_shapes, in_dtypes)]
     if opdef.needs_rng:
         key = jax.ShapeDtypeStruct((2,), jnp.uint32)
         out = jax.eval_shape(f, key, *args)
@@ -127,26 +129,48 @@ def _eval_out_shapes(node, in_shapes, dtype=np.float32):
         out = jax.eval_shape(f, *args)
     if not isinstance(out, (tuple, list)):
         out = (out,)
-    return [tuple(o.shape) for o in out]
+    return ([tuple(o.shape) for o in out],
+            [np.dtype(o.dtype) for o in out])
 
 
-def infer_shapes(sym, known):
-    """Walk the graph; returns (arg_shapes, out_shapes, aux_shapes) aligned
-    with list_arguments/list_outputs/list_auxiliary_states."""
+def _fallback_dtype(node, in_dtypes):
+    """Dtype propagation when shapes are unknown and eval is impossible."""
+    name = node.op.name
+    if name in ("Cast", "cast", "amp_cast"):
+        return np.dtype(node.attrs.get("dtype", "float32"))
+    if name in ("argmax", "argmin", "argsort"):
+        return np.dtype(np.float32)  # reference returns float indices
+    known = [dt for dt in in_dtypes if dt is not None]
+    if not known:
+        return np.dtype(np.float32)
+    return np.dtype(jnp.result_type(*known))
+
+
+def _walk(sym, known_shapes, known_types):
+    """Forward inference walk: id(node) -> ([shapes], [dtypes]); also
+    returns the var name -> shape/dtype maps."""
     shapes = {}     # id(node) -> list of output shapes
+    dtypes = {}     # id(node) -> list of output dtypes
     var_shape = {}  # var name -> shape
+    var_dtype = {}  # var name -> dtype
 
     for node in sym._topo():
         if node.is_var:
-            s = known.get(node.name, node.shape_hint)
+            s = known_shapes.get(node.name, node.shape_hint)
+            dt = known_types.get(node.name, node.dtype_hint)
             var_shape[node.name] = tuple(s) if s is not None else None
+            var_dtype[node.name] = np.dtype(dt) if dt is not None \
+                else np.dtype(np.float32)
             shapes[id(node)] = [var_shape[node.name]]
+            dtypes[id(node)] = [var_dtype[node.name]]
             continue
         in_shapes = []
+        in_dtypes = []
         unknown_slots = []
         for i, (src, oi) in enumerate(node.inputs):
             s = shapes[id(src)][oi]
             in_shapes.append(s)
+            in_dtypes.append(dtypes[id(src)][oi])
             if s is None:
                 unknown_slots.append((i, src))
         if unknown_slots and in_shapes[0] is not None:
@@ -159,17 +183,37 @@ def infer_shapes(sym, known):
                     if src.is_var:
                         var_shape[src.name] = s
                         shapes[id(src)][0] = s
+        n_out = max(node.op.num_outputs, 1)
         if any(s is None for s in in_shapes):
-            shapes[id(node)] = [None] * max(node.op.num_outputs, 1)
+            shapes[id(node)] = [None] * n_out
+            dtypes[id(node)] = [_fallback_dtype(node, in_dtypes)] * n_out
             continue
         try:
-            shapes[id(node)] = _eval_out_shapes(node, in_shapes)
+            shapes[id(node)], dtypes[id(node)] = _eval_out(
+                node, in_shapes, in_dtypes)
         except Exception:
-            shapes[id(node)] = [None] * max(node.op.num_outputs, 1)
+            shapes[id(node)] = [None] * n_out
+            dtypes[id(node)] = [_fallback_dtype(node, in_dtypes)] * n_out
 
+    return shapes, dtypes, var_shape, var_dtype
+
+
+def infer_shapes(sym, known):
+    """Walk the graph; returns (arg_shapes, out_shapes, aux_shapes) aligned
+    with list_arguments/list_outputs/list_auxiliary_states."""
+    shapes, _, var_shape, _ = _walk(sym, known, {})
     arg_shapes = [var_shape.get(n) for n in sym.list_arguments()]
     aux_shapes = [var_shape.get(n) for n in sym.list_auxiliary_states()]
-    out_shapes = [shapes[id(node)][oi] if shapes[id(node)][oi] is not None
-                  else None
-                  for node, oi in sym._outputs]
+    out_shapes = [shapes[id(node)][oi] for node, oi in sym._outputs]
     return arg_shapes, out_shapes, aux_shapes
+
+
+def infer_types(sym, known_types):
+    """(arg_types, out_types, aux_types) — dtype propagation through the
+    graph; uses shape hints where present so jax.eval_shape gives exact
+    promotion, and falls back to result_type rules otherwise."""
+    _, dtypes, _, var_dtype = _walk(sym, {}, known_types)
+    arg_types = [var_dtype.get(n) for n in sym.list_arguments()]
+    aux_types = [var_dtype.get(n) for n in sym.list_auxiliary_states()]
+    out_types = [dtypes[id(node)][oi] for node, oi in sym._outputs]
+    return arg_types, out_types, aux_types

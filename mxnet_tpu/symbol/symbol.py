@@ -41,14 +41,13 @@ class _Node:
         return self.op is None
 
     def num_visible_outputs(self):
-        if self.is_var:
-            return 1
-        n = max(self.op.num_outputs, 1)
-        return n - len(self.op.mutate)
+        return len(self.visible_output_indices())
 
     def visible_output_indices(self):
         if self.is_var:
             return [0]
+        if self.op.visible_out is not None:
+            return list(self.op.visible_out(self.attrs))
         n = max(self.op.num_outputs, 1)
         return [i for i in range(n) if i not in self.op.mutate]
 
@@ -216,11 +215,20 @@ class Symbol:
         return self.infer_shape(*args, **kwargs)
 
     def infer_type(self, *args, **kwargs):
-        args_ = self.list_arguments()
-        dt = np.float32
-        return ([dt] * len(args_),
-                [dt] * len(self._outputs),
-                [dt] * len(self.list_auxiliary_states()))
+        """(arg_types, out_types, aux_types) — dtype propagation through
+        the graph (reference :1124); positional args align with
+        list_arguments, kwargs override by name."""
+        from .infer import infer_types
+
+        known = {k: np.dtype(v) for k, v in kwargs.items() if v is not None}
+        if args:
+            for name, dt in zip(self.list_arguments(), args):
+                if dt is not None:
+                    known[name] = np.dtype(dt)
+        return infer_types(self, known)
+
+    def infer_type_partial(self, *args, **kwargs):
+        return self.infer_type(*args, **kwargs)
 
     # -- serialization --------------------------------------------------
     def tojson(self):

@@ -1,0 +1,165 @@
+"""Regression tests for round-2 advisor fixes.
+
+Covers: single aux (BatchNorm EMA) application per fwd+bwd pair, fused
+Module.forward_backward, regression-output gradient scaling
+(reference src/operator/regression_output-inl.h:200), Module.load ->
+bind -> forward, RecordIO continuation framing (dmlc recordio.cc), and
+Symbol.infer_type dtype propagation.
+"""
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym_api
+
+
+def test_batchnorm_aux_single_update_per_fwd_bwd():
+    momentum = 0.9
+    data = sym_api.Variable("data")
+    bn = sym_api.BatchNorm(data, momentum=momentum, fix_gamma=False,
+                           name="bn")
+    out = sym_api.sum(bn)
+    exe = out.simple_bind(ctx=mx.cpu(), data=(4, 3, 5, 5), grad_req="write")
+    x = np.random.RandomState(0).randn(4, 3, 5, 5).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+
+    mean0 = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    batch_mean = x.mean(axis=(0, 2, 3))
+    expect = momentum * mean0 + (1 - momentum) * batch_mean
+
+    exe.forward(is_train=True)
+    exe.backward()
+    got = exe.aux_dict["bn_moving_mean"].asnumpy()
+    # one EMA application, not two
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_backward_without_forward_uses_ones_heads():
+    data = sym_api.Variable("data")
+    out = sym_api.sum(data * 3.0)
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3), grad_req="write")
+    exe.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    exe.backward()  # no prior forward
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.full((2, 3), 3.0), rtol=1e-6)
+    assert len(exe.outputs) == 1
+
+
+def test_regression_output_grad_scale():
+    rs = np.random.RandomState(1)
+    d = rs.randn(4, 6).astype(np.float32)
+    l = rs.randn(4, 6).astype(np.float32)
+    for scale in (1.0, 2.5):
+        data = sym_api.Variable("data")
+        label = sym_api.Variable("label")
+        out = sym_api.LinearRegressionOutput(data, label, grad_scale=scale)
+        exe = out.simple_bind(ctx=mx.cpu(), data=(4, 6), label=(4, 6),
+                              grad_req={"data": "write", "label": "null"})
+        exe.arg_dict["data"][:] = d
+        exe.arg_dict["label"][:] = l
+        exe.forward(is_train=True)
+        exe.backward()
+        # reference: (p - y) * grad_scale / num_output, num_output = 6
+        np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                                   (d - l) * scale / 6.0,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_module_load_bind_forward():
+    from mxnet_tpu.io import NDArrayIter
+
+    data = sym_api.Variable("data")
+    net = sym_api.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym_api.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.1))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "m")
+        mod.save_checkpoint(prefix, 1)
+        # reference workflow: load -> bind -> forward, NO init_params call
+        mod2 = mx.mod.Module.load(prefix, 1, data_names=("data",),
+                                  label_names=("softmax_label",),
+                                  context=mx.cpu())
+        mod2.bind(data_shapes=[("data", (4, 5))],
+                  label_shapes=[("softmax_label", (4,))],
+                  for_training=False)
+        assert mod2.params_initialized
+        from mxnet_tpu.io import DataBatch
+        x = mx.nd.array(np.random.RandomState(0).rand(4, 5))
+        mod.forward(DataBatch(data=[x]), is_train=False)
+        mod2.forward(DataBatch(data=[x]), is_train=False)
+        np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                   mod2.get_outputs()[0].asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_module_fused_forward_backward_trains():
+    from mxnet_tpu.io import NDArrayIter
+
+    rs = np.random.RandomState(3)
+    x = rs.rand(128, 10).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float32)  # cleanly separable
+
+    data = sym_api.Variable("data")
+    net = sym_api.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym_api.Activation(net, act_type="relu")
+    net = sym_api.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym_api.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16, shuffle=True)
+    mod.fit(it, num_epoch=15, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.Accuracy()
+    mod.score(NDArrayIter(x, y, batch_size=16), metric)
+    assert metric.get()[1] > 0.9
+
+
+def test_recordio_magic_payload_roundtrip(tmp_path):
+    from mxnet_tpu.recordio import MXRecordIO
+
+    magic = struct.pack("<I", 0xced7230a)
+    payloads = [
+        b"plain",
+        magic,                       # exactly the magic word
+        b"abcd" + magic + b"efgh",   # aligned magic inside
+        magic + magic + b"xx",       # consecutive magics
+        b"ab" + magic + b"cd",       # UNaligned magic: must stay whole
+        os.urandom(1024) + magic + os.urandom(512),
+    ]
+    path = str(tmp_path / "t.rec")
+    w = MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_infer_type_propagates_dtypes():
+    data = sym_api.Variable("data", dtype="int32")
+    emb = sym_api.Embedding(data, input_dim=10, output_dim=4, name="emb")
+    out = sym_api.cast(emb, dtype="float16")
+    arg_types, out_types, aux_types = out.infer_type()
+    args = out.list_arguments()
+    tmap = dict(zip(args, arg_types))
+    assert tmap["data"] == np.dtype(np.int32)
+    assert tmap["emb_weight"] == np.dtype(np.float32)
+    assert out_types[0] == np.dtype(np.float16)
+
+    # type_dict style override
+    data2 = sym_api.Variable("x")
+    out2 = data2 + 1.0
+    arg_types2, out_types2, _ = out2.infer_type(x=np.float16)
+    assert arg_types2[0] == np.dtype(np.float16)
