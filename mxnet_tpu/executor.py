@@ -263,6 +263,9 @@ class Executor:
                     self.forward(is_train=True)
                     aux_in = self._aux_in
                     self._aux_in = None
+                    # the probe forward re-armed _last_rng; this fwd+bwd
+                    # pair's key is already consumed above
+                    self._last_rng = None
                 for o, g in zip(self.outputs, head_grads):
                     concrete_heads.append(
                         g if g is not None else jnp.ones(o.shape, o.dtype))

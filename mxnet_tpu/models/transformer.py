@@ -108,6 +108,13 @@ class TransformerLM:
 
     # -- forward -------------------------------------------------------
     def _rmsnorm(self, x, scale):
+        from ..parallel.mesh import current_mesh
+        if jax.default_backend() == "tpu" and current_mesh() is None:
+            # single-chip hot path: fused Pallas kernel (one VMEM pass);
+            # under a mesh GSPMD can't partition the custom call, and the
+            # lax form below fuses fine anyway
+            from ..ops.pallas import fused_rmsnorm
+            return fused_rmsnorm(x, scale.astype(x.dtype))
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
                 ).astype(x.dtype) * scale
@@ -126,6 +133,9 @@ class TransformerLM:
         v = v.reshape(B, T, H, D)
         if use_ring:
             attn = ring_self_attention(q, k, v, causal=True)
+        elif jax.default_backend() == "tpu":
+            from ..ops.pallas import flash_self_attention
+            attn = flash_self_attention(q, k, v, causal=True)
         else:
             attn = blockwise_attention(q, k, v, causal=True)
         attn = attn.reshape(B, T, H * D)
@@ -174,11 +184,16 @@ class TransformerLM:
 
     def loss(self, params, tokens, targets):
         """Causal LM loss: mean token cross-entropy (+ MoE aux loss)."""
+        from ..parallel.mesh import current_mesh
         logits, aux = self.apply(params, tokens)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, targets[..., None],
-                                   axis=-1)[..., 0]
-        nll = (logz - gold).mean()
+        if jax.default_backend() == "tpu" and current_mesh() is None:
+            from ..ops.pallas import fused_softmax_xent
+            nll = fused_softmax_xent(logits, targets).mean()
+        else:
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, targets[..., None],
+                                       axis=-1)[..., 0]
+            nll = (logz - gold).mean()
         return nll + self.cfg.moe_aux_weight * aux
 
 

@@ -87,6 +87,9 @@ class Module(BaseModule):
             args, auxs = self._preloaded
             self._exec.copy_params_from(args, auxs, allow_extra_params=True)
             self.params_initialized = True
+            # consume: a later force_rebind must keep the *current* params,
+            # not silently revert to the checkpoint snapshot
+            self._preloaded = None
 
     # -- params ---------------------------------------------------------
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

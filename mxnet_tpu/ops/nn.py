@@ -405,9 +405,14 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 @register("softmax_cross_entropy", input_names=("data", "label"))
 def _softmax_cross_entropy(data, label):
-    logp = jax.nn.log_softmax(data, axis=-1)
-    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
-    return jnp.sum(nll)
+    """Reference: src/operator/loss_binary_op.cc — sum of per-row CE.
+
+    On TPU this is the fused Pallas kernel (one streaming pass over the
+    class dim, no materialized log-softmax); off-TPU fused_softmax_xent
+    itself falls back to the identical lax math."""
+    from .pallas import fused_softmax_xent
+    return jnp.sum(fused_softmax_xent(data, label.astype(jnp.int32))
+                   ).astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------

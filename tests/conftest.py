@@ -23,6 +23,12 @@ if not os.environ.get("MXTPU_TEST_ON_TPU"):
     # CPU-only test corpus never needs the real chip.
     from jax._src import xla_bridge as _xb
 
+    # Pallas/checkify register MLIR lowerings for the "tpu" platform at
+    # import time, and registration fails once the factory is popped — import
+    # them while the platform is still known.
+    import jax.experimental.pallas  # noqa: F401
+    import jax.experimental.pallas.tpu  # noqa: F401
+
     _xb._backend_factories.pop("axon", None)
     _xb._backend_factories.pop("tpu", None)
     import jax as _jax

@@ -1,0 +1,149 @@
+"""Pallas kernel parity tests: kernels (interpret mode) vs lax fallbacks.
+
+Mirrors the reference's accelerator-vs-CPU `check_consistency` strategy
+(`/root/reference/python/mxnet/test_utils.py:1224`): the lax fallback is the
+oracle; the Pallas kernels run through the interpreter on CPU.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.pallas import flash_attention, fused_rmsnorm, \
+    fused_softmax_xent
+from mxnet_tpu.ops.pallas.flash_attention import _flash  # noqa: F401
+from mxnet_tpu.ops.pallas.layers import _rmsnorm_lax, _xent_lax
+from mxnet_tpu.parallel.ring_attention import blockwise_attention
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("shape", [(2, 128, 4, 64), (1, 256, 2, 32)])
+    def test_forward_parity(self, causal, shape):
+        B, T, H, D = shape
+        q = _rand(0, shape)
+        k = _rand(1, shape)
+        v = _rand(2, shape)
+        ref = blockwise_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_unaligned_seq_padding(self):
+        # T=100 is not a multiple of the kernel block; pad path must mask
+        q = _rand(0, (1, 100, 2, 32))
+        k = _rand(1, (1, 100, 2, 32))
+        v = _rand(2, (1, 100, 2, 32))
+        for causal in (True, False):
+            ref = blockwise_attention(q, k, v, causal=causal)
+            out = flash_attention(q, k, v, causal=causal, interpret=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_grad_parity(self):
+        shape = (1, 128, 2, 32)
+        q = _rand(0, shape)
+        k = _rand(1, shape)
+        v = _rand(2, shape)
+
+        def loss_ref(q, k, v):
+            return (blockwise_attention(q, k, v, causal=True) ** 2).sum()
+
+        def loss_ker(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    interpret=True) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gk = jax.grad(loss_ker, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg="d%s mismatch" % name)
+
+    def test_bf16_inputs(self):
+        shape = (1, 128, 2, 32)
+        q = _rand(0, shape, jnp.bfloat16)
+        k = _rand(1, shape, jnp.bfloat16)
+        v = _rand(2, shape, jnp.bfloat16)
+        ref = blockwise_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_cpu_fallback_dispatch(self):
+        # with interpret unset on CPU, must silently use the lax fallback
+        q = _rand(0, (1, 64, 2, 16))
+        out = flash_attention(q, q, q, causal=True)
+        ref = blockwise_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestFusedRMSNorm:
+    @pytest.mark.parametrize("shape", [(8, 256), (2, 17, 128), (100, 64)])
+    def test_forward_parity(self, shape):
+        x = _rand(0, shape)
+        scale = 1.0 + 0.1 * _rand(1, shape[-1:])
+        ref = _rmsnorm_lax(x, scale, 1e-6)
+        out = fused_rmsnorm(x, scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        x = _rand(0, (16, 128))
+        scale = 1.0 + 0.1 * _rand(1, (128,))
+
+        gr = jax.grad(lambda x, s: (_rmsnorm_lax(x, s, 1e-6) ** 2).sum(),
+                      argnums=(0, 1))(x, scale)
+        gk = jax.grad(
+            lambda x, s: (fused_rmsnorm(x, s, interpret=True) ** 2).sum(),
+            argnums=(0, 1))(x, scale)
+        np.testing.assert_allclose(np.asarray(gk[0]), np.asarray(gr[0]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gk[1]), np.asarray(gr[1]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bf16(self):
+        x = _rand(0, (8, 128), jnp.bfloat16)
+        scale = jnp.ones((128,), jnp.bfloat16)
+        out = fused_rmsnorm(x, scale, interpret=True)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestFusedSoftmaxXent:
+    @pytest.mark.parametrize("shape,V", [((32,), 1000), ((4, 16), 128),
+                                         ((10,), 77)])
+    def test_forward_parity(self, shape, V):
+        logits = _rand(0, shape + (V,))
+        labels = jax.random.randint(jax.random.PRNGKey(9), shape, 0, V)
+        ref = _xent_lax(logits, labels)
+        out = fused_softmax_xent(logits, labels, interpret=True)
+        assert out.shape == shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        logits = _rand(0, (16, 256))
+        labels = jax.random.randint(jax.random.PRNGKey(9), (16,), 0, 256)
+
+        gr = jax.grad(lambda l: _xent_lax(l, labels).mean())(logits)
+        gk = jax.grad(
+            lambda l: fused_softmax_xent(l, labels, interpret=True).mean()
+        )(logits)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_big_vocab_streaming(self):
+        # V > block_v forces the streaming path over vocab chunks
+        logits = _rand(0, (8, 5000))
+        labels = jax.random.randint(jax.random.PRNGKey(9), (8,), 0, 5000)
+        ref = _xent_lax(logits, labels)
+        out = fused_softmax_xent(logits, labels, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
