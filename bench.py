@@ -1,14 +1,18 @@
-"""Benchmark: ResNet-50 training throughput (images/sec) on one TPU chip.
+"""Benchmark: ResNet-50 training + inference throughput on one TPU chip.
 
-Matches the reference's headline number: ResNet-50 training, batch 128, on
-V100 = 363.69 img/s (`docs/faq/perf.md:236`, see BASELINE.md) measured via
-`example/image-classification/train_imagenet.py`.  This script runs the same
-workload through the Gluon user path — hybridized model-zoo ResNet-50,
-SoftmaxCrossEntropyLoss, Trainer(sgd+momentum) — on synthetic ImageNet-shaped
-data, and prints ONE JSON line.
+Reference headline numbers (BASELINE.md, `docs/faq/perf.md`):
+  * training  b128 fp32 V100: 363.69 img/s (`perf.md:236`)
+  * inference b128 fp16 V100: 2355.04 img/s (`perf.md:192`)
 
-Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (default 20),
-BENCH_MODEL (default resnet50_v1).
+This runs the same workload through the Gluon user path — model-zoo
+ResNet-50 cast to bfloat16 (the TPU-native training dtype, with fp32 master
+weights via the optimizer's multi-precision states), SoftmaxCrossEntropyLoss,
+sgd+momentum — with the whole train step compiled to ONE XLA module
+(`gluon.contrib.FusedTrainStep`).
+
+Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (default 30),
+BENCH_MODEL (default resnet50_v1), BENCH_DTYPE (default bfloat16).
+Prints ONE JSON line.
 """
 from __future__ import annotations
 
@@ -17,7 +21,8 @@ import os
 import sys
 import time
 
-BASELINE_IMG_S = 363.69  # V100 fp32 batch 128, docs/faq/perf.md:236
+TRAIN_BASELINE_IMG_S = 363.69   # V100 fp32 b128 training, perf.md:236
+INFER_BASELINE_IMG_S = 2355.04  # V100 fp16 b128 inference, perf.md:192
 
 
 def main():
@@ -26,11 +31,13 @@ def main():
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
     from mxnet_tpu.gluon.model_zoo import vision
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
     model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     platform = jax.default_backend()
     ctx = mx.tpu() if platform not in ("cpu",) else mx.cpu()
@@ -39,38 +46,64 @@ def main():
     net.initialize(mx.init.Xavier(), ctx=ctx)
     net.hybridize(static_alloc=True, static_shape=True)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.05, "momentum": 0.9})
 
     rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32), ctx=ctx)
+    x32 = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32),
+                      ctx=ctx)
     y = mx.nd.array(rng.randint(0, 1000, (batch,)), ctx=ctx)
 
-    def one_step():
-        with mx.autograd.record():
-            out = net(x)
-            loss = loss_fn(out, y)
-        loss.backward()
-        trainer.step(batch)
-        return loss
+    # finish deferred init in fp32, then cast the net to the compute dtype
+    # (BatchNorm keeps its statistics in fp32; the optimizer holds fp32
+    # master weights — the reference's mp_sgd flow)
+    with mx.autograd.pause():
+        net(x32)
+    multi_precision = dtype != "float32"
+    if multi_precision:
+        net.cast(dtype)
+    x = x32.astype(dtype) if multi_precision else x32
 
-    # warmup: compile fwd+bwd+update
-    for _ in range(3):
-        loss = one_step()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9,
+         "multi_precision": multi_precision})
+    step = FusedTrainStep(net, loss_fn, trainer)
+
+    # ---- training ----
+    for _ in range(3):  # warmup: compile fwd+bwd+update
+        loss = step(x, y)
     loss.wait_to_read()
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = one_step()
+        loss = step(x, y)
     loss.wait_to_read()
     dt = time.perf_counter() - t0
+    train_img_s = batch * steps / dt
 
-    img_s = batch * steps / dt
+    # ---- inference ----
+    with mx.autograd.pause(train_mode=False):
+        out = net(x)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = net(x)
+        out.wait_to_read()
+        dt = time.perf_counter() - t0
+    infer_img_s = batch * steps / dt
+
     print(json.dumps({
-        "metric": "resnet50_train_img_per_sec_b%d_%s" % (batch, platform),
-        "value": round(img_s, 2),
+        "metric": "resnet50_train_img_per_sec_b%d_%s_%s"
+                  % (batch, dtype, platform),
+        "value": round(train_img_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "vs_baseline": round(train_img_s / TRAIN_BASELINE_IMG_S, 4),
+        "extra": {
+            "inference_img_per_sec": round(infer_img_s, 2),
+            "inference_vs_v100_fp16": round(
+                infer_img_s / INFER_BASELINE_IMG_S, 4),
+            "loss_final": float(np.asarray(
+                loss.asnumpy(), dtype=np.float32).mean()),
+        },
     }))
 
 

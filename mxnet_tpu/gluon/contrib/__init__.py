@@ -1,1 +1,4 @@
 """Gluon contrib (reference: ``python/mxnet/gluon/contrib/``)."""
+from .fused import FusedTrainStep
+
+__all__ = ["FusedTrainStep"]

@@ -202,7 +202,8 @@ class BatchNorm(HybridBlock):
                 "running_mean": (channels,), "running_var": (channels,)}
 
     def cast(self, dtype):
-        if np.dtype(dtype).name == "float16":
+        from ...base import np_dtype
+        if np_dtype(dtype).name in ("float16", "bfloat16"):
             dtype = "float32"  # BN statistics stay fp32 (reference behavior)
         super().cast(dtype)
 

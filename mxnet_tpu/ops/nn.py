@@ -198,6 +198,11 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     (FMutateInputs parity).  In a jit'd graph the executor carries the
     running stats as explicit state — pure-functional BN.
     """
+    out_dtype = data.dtype
+    if data.dtype in (jnp.float16, jnp.bfloat16):
+        # low-precision inputs: normalize in fp32 (the reference's cuDNN BN
+        # likewise accumulates statistics in fp32 for fp16 tensors)
+        data = data.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
@@ -216,8 +221,8 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     inv = lax.rsqrt(var + eps)
     out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
         + beta.reshape(bshape)
-    return (out, lax.stop_gradient(mean), lax.stop_gradient(var),
-            new_mean, new_var)
+    return (out.astype(out_dtype), lax.stop_gradient(mean),
+            lax.stop_gradient(var), new_mean, new_var)
 
 
 @register("LayerNorm", input_names=("data", "gamma", "beta"))
@@ -327,6 +332,10 @@ def _softmax(x, length=None, axis=-1, temperature=None, use_length=False):
 def _log_softmax(x, axis=-1, temperature=None):
     if temperature:
         x = x / temperature
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        # accumulate the logsumexp in fp32 for low-precision logits
+        return jax.nn.log_softmax(x.astype(jnp.float32), axis=axis) \
+            .astype(x.dtype)
     return jax.nn.log_softmax(x, axis=axis)
 
 

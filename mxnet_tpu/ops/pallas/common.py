@@ -1,0 +1,16 @@
+"""Shared helpers for the Pallas kernel layer."""
+from __future__ import annotations
+
+_NEG = -1e30  # masked-logit filler: finite (NaN-safe) but exp() == 0 in f32
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def _mesh_active():
+    """True when a device mesh is active — GSPMD cannot partition a Pallas
+    custom call, so kernels must route to their lax fallbacks (or shard_map
+    wrappers) in that case."""
+    from ...parallel.mesh import current_mesh
+    return current_mesh() is not None

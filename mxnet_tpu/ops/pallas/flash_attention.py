@@ -30,9 +30,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_self_attention"]
+from .common import _NEG, _round_up
 
-_NEG = -1e30
+__all__ = ["flash_attention", "flash_self_attention"]
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, kv_len):
@@ -299,10 +299,6 @@ def _flash_bwd(causal, scale, block_q, block_k, kv_len, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _round_up(x, m):
-    return -(-x // m) * m
-
-
 def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
                     block_k=None, interpret=None):
     """Flash attention over [B, T, H, D] tensors.
@@ -361,6 +357,13 @@ def flash_self_attention(q, k, v, causal=True, batch_axis="dp",
     h = head_axis if mesh.size(head_axis) > 1 else None
     if b is None and h is None:
         return flash_attention(q, k, v, causal=causal)
+    if (b is not None and q.shape[0] % mesh.size(batch_axis)) or \
+            (h is not None and q.shape[2] % mesh.size(head_axis)):
+        # shard_map needs exact divisibility; under a mesh the raw pallas
+        # call is unpartitionable by GSPMD, so fall back to the blockwise
+        # lax path (which GSPMD shards/replicates freely)
+        from ...parallel.ring_attention import blockwise_attention
+        return blockwise_attention(q, k, v, causal=causal)
     from ...parallel.collectives import shard_map
     from jax.sharding import PartitionSpec as P
     spec = P(b, None, h, None)

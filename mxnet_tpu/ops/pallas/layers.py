@@ -19,13 +19,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .common import _NEG, _mesh_active, _round_up
+
 __all__ = ["fused_rmsnorm", "fused_softmax_xent"]
-
-_NEG = -1e30
-
-
-def _round_up(x, m):
-    return -(-x // m) * m
 
 
 # ---------------------------------------------------------------------------
@@ -93,7 +89,9 @@ def fused_rmsnorm(x, scale, eps=1e-6, interpret=None):
     """
     if interpret is None:
         interpret = False
-        if jax.default_backend() != "tpu":
+        if jax.default_backend() != "tpu" or _mesh_active():
+            # off-TPU, or under an active mesh (GSPMD can't partition the
+            # custom call): identical lax math, which XLA fuses/shards
             return _rmsnorm_lax(x, scale, eps)
     E = x.shape[-1]
     lead = x.shape[:-1]
@@ -231,7 +229,7 @@ def fused_softmax_xent(logits, labels, interpret=None):
     """
     if interpret is None:
         interpret = False
-        if jax.default_backend() != "tpu":
+        if jax.default_backend() != "tpu" or _mesh_active():
             return _xent_lax(logits, labels)
     V = logits.shape[-1]
     lead = logits.shape[:-1]

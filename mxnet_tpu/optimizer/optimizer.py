@@ -25,6 +25,19 @@ __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "DCASGD", "NAG",
            "create", "register"]
 
 
+def _is_low_precision(dtype):
+    """True for dtypes that want an fp32 master copy (fp16 on GPU in the
+    reference; bf16 is the TPU-native training dtype and gets the same
+    multi-precision treatment)."""
+    if np.dtype(dtype) == np.float16:
+        return True
+    try:
+        import ml_dtypes
+        return np.dtype(dtype) == np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 class Optimizer:
     """Base optimizer (reference: ``optimizer.py`` class Optimizer).
 
@@ -86,11 +99,11 @@ class Optimizer:
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = weight.astype(np.float32)
             return (self.create_state(index, weight_master_copy),
                     weight_master_copy)
-        if weight.dtype == np.float16 and not self.multi_precision:
+        if _is_low_precision(weight.dtype) and not self.multi_precision:
             logging.warning("Accumulating with float16 in optimizer can lead "
                             "to poor accuracy or slow convergence. Consider "
                             "using multi_precision=True option.")
@@ -100,7 +113,7 @@ class Optimizer:
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             original_state, weight_master_copy = state
             grad32 = grad.astype(np.float32)
             self.update(index, weight_master_copy, grad32, original_state)
@@ -206,7 +219,7 @@ class SGD(Optimizer):
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = weight.astype(np.float32)
             return (self.create_state(index, weight_master_copy),
                     weight_master_copy)
@@ -238,7 +251,7 @@ class SGD(Optimizer):
         self._update_impl(index, weight, grad, state, multi_precision=False)
 
     def update_multi_precision(self, index, weight, grad, state):
-        use_mp = self.multi_precision and weight.dtype == np.float16
+        use_mp = self.multi_precision and _is_low_precision(weight.dtype)
         self._update_impl(index, weight, grad, state, multi_precision=use_mp)
 
 

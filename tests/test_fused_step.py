@@ -1,0 +1,139 @@
+"""FusedTrainStep + bf16 mixed-precision tests.
+
+The fused step must be numerically identical to the plain Gluon path
+(record/backward/Trainer.step) — same optimizer math, same BN aux updates,
+same LR schedule — it only changes HOW the work is compiled (one XLA module
+per step instead of many dispatches).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+
+def _make_net():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Activation("relu"))
+        net.add(gluon.nn.GlobalAvgPool2D())
+        net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _copy_params(src, dst):
+    for ps, pd in zip(src.collect_params().values(),
+                      dst.collect_params().values()):
+        pd.set_data(ps.list_data()[0].copy())
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(4, 3, 8, 8).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 10, (4,)))
+    return x, y
+
+
+def _plain_steps(net, loss_fn, trainer, x, y, n):
+    out = []
+    for _ in range(n):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(x.shape[0])
+        out.append(float(l.asnumpy().mean()))
+    return out
+
+
+@pytest.mark.parametrize("opt,opt_args", [
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_step_matches_plain_path(opt, opt_args):
+    x, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    netA, netB = _make_net(), _make_net()
+    netA(x), netB(x)
+    _copy_params(netA, netB)
+    trA = gluon.Trainer(netA.collect_params(), opt, dict(opt_args))
+    trB = gluon.Trainer(netB.collect_params(), opt, dict(opt_args))
+    step = FusedTrainStep(netA, loss_fn, trA)
+    lossesA = [float(step(x, y).asnumpy().mean()) for _ in range(4)]
+    lossesB = _plain_steps(netB, loss_fn, trB, x, y, 4)
+    np.testing.assert_allclose(lossesA, lossesB, rtol=1e-5, atol=1e-6)
+    for pA, pB in zip(netA.collect_params().values(),
+                      netB.collect_params().values()):
+        np.testing.assert_allclose(pA.list_data()[0].asnumpy(),
+                                   pB.list_data()[0].asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_step_lr_schedule_stays_live():
+    """The LR schedule must keep advancing without recompilation (per-step
+    scalars are traced inputs, not baked constants)."""
+    x, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    netA, netB = _make_net(), _make_net()
+    netA(x), netB(x)
+    _copy_params(netA, netB)
+    mk = lambda: {"learning_rate": 0.5,
+                  "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+                      step=2, factor=0.1)}
+    trA = gluon.Trainer(netA.collect_params(), "sgd", mk())
+    trB = gluon.Trainer(netB.collect_params(), "sgd", mk())
+    step = FusedTrainStep(netA, loss_fn, trA)
+    lossesA = [float(step(x, y).asnumpy().mean()) for _ in range(6)]
+    lossesB = _plain_steps(netB, loss_fn, trB, x, y, 6)
+    np.testing.assert_allclose(lossesA, lossesB, rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_multi_precision_training():
+    """net.cast('bfloat16') + multi_precision trains: weights stay bf16,
+    master weights fp32, BN stats fp32, loss decreases."""
+    x32, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net = _make_net()
+    net(x32)
+    net.cast("bfloat16")
+    x = x32.astype("bfloat16")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "momentum": 0.9,
+                        "multi_precision": True})
+    step = FusedTrainStep(net, loss_fn, tr)
+    losses = [float(step(x, y).asnumpy().astype(np.float32).mean())
+              for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.8, losses
+    params = net.collect_params()
+    conv_w = [p for n, p in params.items() if "conv" in n and "weight" in n][0]
+    bn_gamma = [p for n, p in params.items() if "gamma" in n][0]
+    assert str(conv_w.list_data()[0].dtype) == "bfloat16"
+    # BN statistics stay fp32 (cast override)
+    assert bn_gamma.list_data()[0].dtype == np.float32
+    # fp32 master copy lives in the optimizer state
+    st = tr._updaters[0].states[list(tr._updaters[0].states)[0]]
+    assert isinstance(st, tuple) and st[1].dtype == np.float32
+
+
+def test_bf16_plain_path_multi_precision():
+    """The unfused Trainer.step path handles bf16 multi-precision too."""
+    x32, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net = _make_net()
+    net(x32)
+    net.cast("bfloat16")
+    x = x32.astype("bfloat16")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "momentum": 0.9,
+                        "multi_precision": True})
+    losses = []
+    for _ in range(6):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        tr.step(x.shape[0])
+        losses.append(float(l.asnumpy().astype(np.float32).mean()))
+    assert losses[-1] < losses[0] * 0.8, losses
