@@ -32,7 +32,6 @@ from ... import autograd
 from ... import random as _random
 from ...ndarray.ndarray import NDArray, _wrap
 from ...ops import registry as _registry
-from ...ops.registry import get_op
 from ..block import _ParamSubstitution, _trace_state
 
 __all__ = ["FusedTrainStep"]
@@ -77,21 +76,13 @@ class _FakeND:
         return None
 
 
-class _OptimTap:
-    """Patch ``optimizer.invoke`` so update ops run through a scalar feed;
-    in feed mode the op is not executed at all (only kwargs are recorded)."""
+class _OptimTap(_registry.invoke_tap):
+    """Route every op invoke on this thread through a scalar feed (works
+    for any optimizer module, however it imported ``invoke``); in feed mode
+    the op is not executed at all (only float kwargs are recorded)."""
 
     def __init__(self, feed, execute):
-        self._feed = feed
-        self._execute = execute
-
-    def __enter__(self):
-        from ... import optimizer as _optmod
-        self._saved = _optmod.optimizer.invoke
-        feed, execute = self._feed, self._execute
-
-        def tapped(op_name, nds, params=None, out=None):
-            opdef = get_op(op_name) if isinstance(op_name, str) else op_name
+        def tapped(opdef, nds, params=None, out=None):
             params = dict(params or {})
             for k in sorted(params):
                 if k in opdef.array_params and isinstance(
@@ -99,14 +90,8 @@ class _OptimTap:
                     params[k] = feed.take(params[k])
             if not execute:
                 return None
-            return _registry.invoke(opdef, nds, params, out=out)
-
-        _optmod.optimizer.invoke = tapped
-        return self
-
-    def __exit__(self, *a):
-        from ... import optimizer as _optmod
-        _optmod.optimizer.invoke = self._saved
+            return _registry._invoke_impl(opdef, nds, params, out=out)
+        super().__init__(tapped)
 
 
 class FusedTrainStep:

@@ -1,0 +1,137 @@
+"""nd.contrib: imperative control flow + misc contrib ops.
+
+Reference parity: ``python/mxnet/ndarray/contrib.py`` (foreach:135,
+while_loop:231, cond:399).
+
+Execution strategy (TPU-native):
+
+* recording under autograd -> unrolled Python loop of eager ops, so the
+  tape sees every step and gradients flow to parameters captured in the
+  body closure (the reference's imperative ``LoopState`` path likewise
+  keeps each iteration on the tape);
+* inside a jit/hybridize trace, or eager without recording ->
+  ``lax.scan`` / ``lax.cond`` cores (one compiled loop, no unrolling).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random
+from ..ops.control_flow import (_as_list, _flatten, _regroup, cond_core,
+                                foreach_core, while_core)
+from .ndarray import NDArray, _wrap
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+
+
+def _use_unrolled():
+    """Unroll only when the tape is live and we're NOT already inside an
+    outer jax trace (where jax.grad handles scan gradients itself)."""
+    from ..gluon.block import _in_trace
+    return autograd.is_recording() and not _in_trace()
+
+
+def foreach(body, data, init_states):
+    """Scan ``body(data_slice, states) -> (out, new_states)`` over axis 0
+    (reference ndarray/contrib.py:135)."""
+    flat_data, data_fmt = _flatten(data)
+    flat_states, state_fmt = _flatten(init_states)
+    if _use_unrolled() and flat_data[0].shape[0] > 0:
+        n = flat_data[0].shape[0]
+        outs_steps = []
+        states = init_states
+        out_fmt = None
+        for i in range(n):
+            slices = [d[i] for d in flat_data]
+            d_arg, rest = _regroup(slices, data_fmt)
+            assert not rest
+            out, states = body(d_arg, states)
+            flat_out, out_fmt = _flatten(out)
+            outs_steps.append(flat_out)
+        from ..ops.registry import invoke
+        stacked = [invoke("stack", [s[j] for s in outs_steps], {"axis": 0})
+                   for j in range(len(outs_steps[0]))]
+        outs, rest = _regroup(stacked, out_fmt)
+        return outs, states
+    outs, fin, out_fmt = foreach_core(
+        body, [d.data for d in flat_data], [s.data for s in flat_states],
+        data_fmt, state_fmt, _random.next_key(), autograd.is_training())
+    outs = [_wrap(o) for o in outs]
+    fin = [_wrap(s) for s in fin]
+    o, rest = _regroup(outs, out_fmt)
+    assert not rest
+    s, rest = _regroup(fin, state_fmt)
+    assert not rest
+    return o, s
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run ``func`` while ``cond`` holds (reference ndarray/contrib.py:231).
+
+    Returns (outputs, states); outputs are stacked along a new axis 0.  In
+    the compiled path axis 0 is ``max_iterations`` (padded with zeros past
+    termination, matching the reference's symbolic contract); in the
+    unrolled path it is the number of executed steps.
+    """
+    from ..gluon.block import _in_trace
+    flat_vars, var_fmt = _flatten(loop_vars)
+    if max_iterations is None:
+        # reference parity: ndarray while_loop requires max_iterations
+        raise ValueError("max_iterations should be specified")
+    if not _in_trace() and not isinstance(flat_vars[0].data,
+                                          jax.core.Tracer):
+        # imperative semantics (reference LoopState): host-evaluated cond,
+        # outputs stacked over the steps actually executed
+        from ..ops.registry import invoke
+        steps_out = []
+        out_fmt = None
+        steps = 0
+        while steps < max_iterations and \
+                bool(cond(*_as_list(loop_vars)).asnumpy().reshape(())):
+            out, loop_vars = func(*_as_list(loop_vars))
+            flat_out, out_fmt = _flatten(out)
+            steps_out.append(flat_out)
+            steps += 1
+        if not steps_out:
+            return [], loop_vars
+        stacked = [invoke("stack", [s[j] for s in steps_out], {"axis": 0})
+                   for j in range(len(steps_out[0]))]
+        outs, _ = _regroup(stacked, out_fmt)
+        return outs, loop_vars
+    outs, fin, out_fmt, _ = while_core(
+        cond, func, [v.data for v in flat_vars], var_fmt,
+        int(max_iterations), _random.next_key(), autograd.is_training())
+    outs = [_wrap(o) for o in outs]
+    fin = [_wrap(s) for s in fin]
+    o, rest = _regroup(outs, out_fmt)
+    s, rest = _regroup(fin, var_fmt)
+    return o, s
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else (reference ndarray/contrib.py:399)."""
+    if _use_unrolled() or not isinstance(pred, NDArray) or \
+            not isinstance(pred.data, jax.core.Tracer):
+        # concrete predicate: evaluate on host, run only the taken branch
+        p = pred.asnumpy().reshape(()) if isinstance(pred, NDArray) else pred
+        return then_func() if bool(p) else else_func()
+    outs, fmt = cond_core(pred.data, then_func, else_func,
+                          _random.next_key(), autograd.is_training())
+    outs = [_wrap(o) for o in outs]
+    o, rest = _regroup(outs, fmt)
+    return o
+
+
+# -- misc contrib helpers (reference ndarray/contrib.py) -------------------
+def isfinite(data):
+    return _wrap(jnp.isfinite(data.data).astype(jnp.float32))
+
+
+def isnan(data):
+    return _wrap(jnp.isnan(data.data).astype(jnp.float32))
+
+
+def isinf(data):
+    return _wrap(jnp.isinf(data.data).astype(jnp.float32))

@@ -7,3 +7,4 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
+from . import control_flow  # noqa: F401

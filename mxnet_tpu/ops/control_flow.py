@@ -1,0 +1,319 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference parity: ``src/operator/control_flow.cc:1255-1423`` (`_foreach`,
+`_while_loop`, `_cond` subgraph ops) + ``python/mxnet/{ndarray,symbol}/
+contrib.py`` frontends.
+
+TPU-native design: where the reference interprets the loop imperatively on
+the engine (`LoopState`), here loops lower onto XLA's native structured
+control flow —
+
+* ``foreach``    -> ``lax.scan``        (compiled loop, O(1) program size)
+* ``while_loop`` -> ``lax.scan`` over ``max_iterations`` with an alive mask
+  (XLA has no dynamic shapes, so outputs are padded to ``max_iterations`` —
+  the same contract the reference documents for its symbolic while_loop)
+* ``cond``       -> ``lax.cond``
+
+Each core takes a Python body operating on NDArray wrappers, so the same
+code serves (a) eager dispatch, (b) hybridize/jit traces, and (c) the
+symbolic `_foreach`/`_while_loop`/`_cond` registered ops, whose bodies are
+re-hydrated from subgraph JSON stored in node attrs (the analogue of the
+reference's subgraph-Symbol node attributes).
+"""
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# nested-list flatten/regroup (reference contrib._flatten/_regroup)
+# ---------------------------------------------------------------------------
+def _flatten(args):
+    """Flatten nested lists of NDArrays -> (flat list, format tree)."""
+    if isinstance(args, (list, tuple)):
+        flat, fmts = [], []
+        for a in args:
+            f, fmt = _flatten(a)
+            flat.extend(f)
+            fmts.append(fmt)
+        return flat, fmts
+    return [args], 0
+
+
+def _regroup(flat, fmt):
+    """Inverse of _flatten: consume from flat according to fmt."""
+    if isinstance(fmt, list):
+        out = []
+        for f in fmt:
+            o, flat = _regroup(flat, f)
+            out.append(o)
+        return out, flat
+    return flat[0], flat[1:]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+# ---------------------------------------------------------------------------
+# cores: jax arrays in / jax arrays out, python body over NDArray wrappers
+# ---------------------------------------------------------------------------
+def _wrap_body(body, rng_key, train):
+    """Run ``body(*nd_args)`` with the tape paused and random keys sourced
+    from a traced key (so dropout etc. inside loop bodies works in jit)."""
+    from .. import autograd
+    from .. import random as _random
+
+    def run(*nd_args):
+        with autograd.pause(train_mode=train), _random.key_source(rng_key):
+            return body(*nd_args)
+    return run
+
+
+def foreach_core(body, data_arrays, state_arrays, data_fmt, state_fmt,
+                 rng, train):
+    """lax.scan over axis 0 of every array in ``data_arrays``.
+
+    ``body(data_slices, states) -> (outputs, new_states)`` on NDArrays.
+    Returns (flat stacked out arrays, flat final state arrays, out_fmt).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    cell = {}
+
+    def scan_fn(carry, xs):
+        key = carry[0]
+        key, sub = jax.random.split(key)
+        states = [NDArray(a) for a in carry[1:]]
+        slices = [NDArray(a) for a in xs]
+        d_arg, rest = _regroup(slices, data_fmt)
+        assert not rest
+        s_arg, rest = _regroup(states, state_fmt)
+        assert not rest
+        out, new_states = _wrap_body(body, sub, train)(d_arg, s_arg)
+        flat_out, ofmt = _flatten(out)
+        cell["out_fmt"] = ofmt
+        flat_ns, nsfmt = _flatten(new_states)
+        if len(flat_ns) != len(carry) - 1:
+            raise ValueError(
+                "foreach body returned %d states, expected %d"
+                % (len(flat_ns), len(carry) - 1))
+        return ((key,) + tuple(n.data for n in flat_ns),
+                tuple(o.data for o in flat_out))
+
+    carry0 = (rng,) + tuple(state_arrays)
+    carry_f, ys = lax.scan(scan_fn, carry0, tuple(data_arrays))
+    return list(ys), list(carry_f[1:]), cell["out_fmt"]
+
+
+def while_core(cond, func, state_arrays, state_fmt, max_iterations,
+               rng, train):
+    """Masked lax.scan: runs ``max_iterations`` steps, committing state and
+    output only while ``cond`` holds (same padded-output contract as the
+    reference's symbolic while_loop — axis 0 is ``max_iterations``).
+
+    ``cond(*loop_vars) -> scalar NDArray``; ``func(*loop_vars) ->
+    (outputs, new_loop_vars)``.  Returns (flat stacked padded outs,
+    flat final states, out_fmt, n_steps array).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    cell = {}
+
+    def scan_fn(carry, _):
+        key, alive = carry[0], carry[1]
+        key, sub = jax.random.split(key)
+        states = [NDArray(a) for a in carry[2:]]
+        s_arg, rest = _regroup(states, state_fmt)
+        assert not rest
+        s_list = _as_list(s_arg)
+        runner = _wrap_body(lambda *a: (cond(*a), func(*a)), sub, train)
+        c_nd, (out, new_states) = runner(*s_list)
+        execute = alive & (jnp.squeeze(c_nd.data) != 0)
+        flat_out, ofmt = _flatten(out)
+        cell["out_fmt"] = ofmt
+        flat_ns, _ = _flatten(new_states)
+        if len(flat_ns) != len(carry) - 2:
+            raise ValueError(
+                "while_loop func returned %d loop_vars, expected %d"
+                % (len(flat_ns), len(carry) - 2))
+        committed = tuple(
+            jnp.where(execute, n.data, s) for n, s in
+            zip(flat_ns, carry[2:]))
+        step_out = tuple(
+            jnp.where(execute, o.data, jnp.zeros((), o.data.dtype))
+            for o in flat_out)
+        return ((key, execute) + committed,
+                step_out + (execute.astype(jnp.int32),))
+
+    carry0 = (rng, jnp.asarray(True)) + tuple(state_arrays)
+    carry_f, ys = lax.scan(scan_fn, carry0, None, length=max_iterations)
+    outs = list(ys[:-1])
+    n_steps = jnp.sum(ys[-1])
+    return outs, list(carry_f[2:]), cell["out_fmt"], n_steps
+
+
+def cond_core(pred_array, then_func, else_func, rng, train):
+    """lax.cond over two traced branches; both must produce matching
+    output trees (reference contract)."""
+    cell = {}
+
+    def mk(branch, tag):
+        def f(_):
+            out = _wrap_body(branch, rng, train)()
+            flat, fmt = _flatten(out)
+            cell.setdefault("fmt", fmt)
+            if fmt != cell["fmt"]:
+                raise ValueError("cond branches returned different "
+                                 "output structures")
+            return tuple(o.data for o in flat)
+        f.__name__ = tag
+        return f
+
+    outs = lax.cond(jnp.squeeze(pred_array) != 0,
+                    mk(then_func, "then_branch"),
+                    mk(else_func, "else_branch"), None)
+    return list(outs), cell["fmt"]
+
+
+# ---------------------------------------------------------------------------
+# subgraph re-hydration for the symbolic ops
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=256)
+def _load_subgraph(json_str):
+    from ..symbol.symbol import load_json
+    return load_json(json_str)
+
+
+def eval_graph(sym, feed, rng, train):
+    """Evaluate a Symbol's outputs given ``feed`` {var name: jax array}.
+
+    A lightweight interpreter over the graph (the in-loop analogue of
+    Executor._graph_fn; no aux write-back — loop subgraphs carry state
+    explicitly).
+    """
+    topo = sym._topo()
+    rng_ops = [n for n in topo if not n.is_var and n.op.needs_rng]
+    keys = list(jax.random.split(rng, len(rng_ops))) if rng_ops else []
+    ki = 0
+    env = {}
+    for node in topo:
+        if node.is_var:
+            if node.name not in feed:
+                raise ValueError("subgraph input %r not bound" % node.name)
+            env[id(node)] = (feed[node.name],)
+            continue
+        ins = [env[id(src)][oi] for src, oi in node.inputs]
+        f = node.op.bind(dict(node.attrs), train)
+        if node.op.needs_rng:
+            res = f(keys[ki], *ins)
+            ki += 1
+        else:
+            res = f(*ins)
+        env[id(node)] = tuple(res) if isinstance(res, (tuple, list)) \
+            else (res,)
+    return [env[id(n)][oi] for n, oi in sym._outputs]
+
+
+def _meta_out_count(attrs):
+    return list(range(int(attrs["n_out"]) + int(attrs["n_state"])))
+
+
+@register("_foreach", needs_rng=True, train_aware=True,
+          visible_out=_meta_out_count)
+def _foreach_op(rng, *arrays, subgraph="", n_data=0, n_state=0, n_out=0,
+                data_names=(), state_names=(), free_names=(), _train=False):
+    """Symbolic foreach node (reference control_flow.cc `_foreach`): scans
+    the stored subgraph over axis 0 of the data inputs."""
+    sub = _load_subgraph(subgraph)
+    n_data, n_state, n_out = int(n_data), int(n_state), int(n_out)
+    data = arrays[:n_data]
+    states = arrays[n_data:n_data + n_state]
+    frees = dict(zip(free_names, arrays[n_data + n_state:]))
+
+    def body(slices, sts):
+        feed = dict(frees)
+        feed.update(zip(data_names, (s.data for s in _as_list(slices))))
+        feed.update(zip(state_names, (s.data for s in _as_list(sts))))
+        from .. import random as _random
+        res = eval_graph(sub, feed, _random.next_key(), _train)
+        from ..ndarray.ndarray import NDArray
+        return ([NDArray(r) for r in res[:n_out]],
+                [NDArray(r) for r in res[n_out:]])
+
+    outs, fin, _ = foreach_core(
+        body, list(data), list(states),
+        [0] * n_data, [0] * n_state, rng, _train)
+    return tuple(outs) + tuple(fin)
+
+
+@register("_while_loop", needs_rng=True, train_aware=True,
+          visible_out=_meta_out_count)
+def _while_loop_op(rng, *arrays, cond_graph="", func_graph="", n_state=0,
+                   n_out=0, max_iterations=0, state_names=(),
+                   cond_free_names=(), func_free_names=(), _train=False):
+    """Symbolic while_loop node (reference `_while_loop`)."""
+    csub = _load_subgraph(cond_graph)
+    fsub = _load_subgraph(func_graph)
+    n_state, n_out = int(n_state), int(n_out)
+    states = arrays[:n_state]
+    n_cf = len(cond_free_names)
+    cfrees = dict(zip(cond_free_names, arrays[n_state:n_state + n_cf]))
+    ffrees = dict(zip(func_free_names, arrays[n_state + n_cf:]))
+    from ..ndarray.ndarray import NDArray
+    from .. import random as _random
+
+    def cond(*sts):
+        feed = dict(cfrees)
+        feed.update(zip(state_names, (s.data for s in sts)))
+        (c,) = eval_graph(csub, feed, _random.next_key(), _train)
+        return NDArray(c)
+
+    def func(*sts):
+        feed = dict(ffrees)
+        feed.update(zip(state_names, (s.data for s in sts)))
+        res = eval_graph(fsub, feed, _random.next_key(), _train)
+        return ([NDArray(r) for r in res[:n_out]],
+                [NDArray(r) for r in res[n_out:]])
+
+    outs, fin, _, _ = while_core(cond, func, list(states), [0] * n_state,
+                                 int(max_iterations), rng, _train)
+    return tuple(outs) + tuple(fin)
+
+
+@register("_cond", needs_rng=True, train_aware=True,
+          visible_out=lambda attrs: list(range(int(attrs["n_out"]))))
+def _cond_op(rng, *arrays, pred_graph="", then_graph="", else_graph="",
+             n_out=0, pred_free_names=(), then_free_names=(),
+             else_free_names=(), _train=False):
+    """Symbolic cond node (reference `_cond`)."""
+    psub = _load_subgraph(pred_graph)
+    tsub = _load_subgraph(then_graph)
+    esub = _load_subgraph(else_graph)
+    np_, nt = len(pred_free_names), len(then_free_names)
+    pfrees = dict(zip(pred_free_names, arrays[:np_]))
+    tfrees = dict(zip(then_free_names, arrays[np_:np_ + nt]))
+    efrees = dict(zip(else_free_names, arrays[np_ + nt:]))
+    from ..ndarray.ndarray import NDArray
+    from .. import random as _random
+
+    rng, pred_rng = jax.random.split(rng)
+    (pred,) = eval_graph(psub, pfrees, pred_rng, _train)
+
+    def then_func():
+        res = eval_graph(tsub, tfrees, _random.next_key(), _train)
+        return [NDArray(r) for r in res]
+
+    def else_func():
+        res = eval_graph(esub, efrees, _random.next_key(), _train)
+        return [NDArray(r) for r in res]
+
+    outs, _ = cond_core(pred, then_func, else_func, rng, _train)
+    return tuple(outs)

@@ -20,6 +20,7 @@ Reference parity: nnvm's attribute-functor registry (``NNVM_REGISTER_OP`` +
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import numpy as np
@@ -27,6 +28,28 @@ import numpy as np
 __all__ = ["OpDef", "register", "get_op", "invoke", "OPS"]
 
 OPS: dict[str, "OpDef"] = {}
+
+# thread-local dispatch hook: when set, every invoke() routes through it
+# (works regardless of how callers imported `invoke`).  Used by
+# FusedTrainStep to capture/replace per-step optimizer scalars.
+_invoke_tap = threading.local()
+
+
+class invoke_tap:
+    """Scope: route all invoke() calls on this thread through ``fn``.
+    ``fn(opdef, ndarray_inputs, params, out)`` may call ``_invoke_impl``
+    to run the real dispatch."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __enter__(self):
+        self._saved = getattr(_invoke_tap, "fn", None)
+        _invoke_tap.fn = self._fn
+        return self
+
+    def __exit__(self, *a):
+        _invoke_tap.fn = self._saved
 
 
 class OpDef:
@@ -204,6 +227,14 @@ def invoke(op_name, ndarray_inputs, params=None, out=None):
     python front -> cached jit -> XLA async dispatch.  Returns a single NDArray
     or a list (reference convention).
     """
+    tap = getattr(_invoke_tap, "fn", None)
+    if tap is not None:
+        opdef = get_op(op_name) if isinstance(op_name, str) else op_name
+        return tap(opdef, ndarray_inputs, params, out)
+    return _invoke_impl(op_name, ndarray_inputs, params, out)
+
+
+def _invoke_impl(op_name, ndarray_inputs, params=None, out=None):
     from .. import autograd
     from ..ndarray.ndarray import NDArray, _wrap
 

@@ -13,3 +13,5 @@ from .symbol import (Symbol, Variable, var, Group, load, load_json,  # noqa: F40
 from .register import _init_symbol_module
 
 _init_symbol_module()
+
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,152 @@
+"""sym.contrib: symbolic control flow (foreach / while_loop / cond).
+
+Reference parity: ``python/mxnet/symbol/contrib.py`` (foreach:212,
+while_loop:375, cond:598) over ``src/operator/control_flow.cc``.
+
+The body/cond/func callables are traced over fresh variable symbols; the
+resulting subgraph is serialized to JSON and stored in the node's attrs
+(the analogue of the reference's subgraph Symbol attributes), so symbols
+containing control flow save/load like any other.  Free variables of the
+subgraph (weights etc.) are detected and wired as extra node inputs —
+the reference's ``_get_graph_inputs`` cut.
+"""
+from __future__ import annotations
+
+from ..ops.control_flow import _as_list, _flatten, _regroup
+from .symbol import Symbol, _NameManager, _apply, var
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _trace_subgraph(fn, arg_syms):
+    """Call ``fn(*arg_syms)`` and return its (flat outputs, fmt)."""
+    out = fn(*arg_syms)
+    return out
+
+
+def _free_vars(syms, dummy_names):
+    """Free variable nodes of a list of symbols, minus the dummies, in
+    deterministic topo order."""
+    seen, order = set(), []
+    for s in syms:
+        for node in s._topo():
+            if node.is_var and node.name not in dummy_names \
+                    and id(node) not in seen:
+                seen.add(id(node))
+                order.append(node)
+    return order
+
+
+def _group(syms):
+    from .symbol import Group
+    return Group(syms)
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Symbolic scan (reference symbol/contrib.py:212)."""
+    name = _NameManager.get(name)
+    flat_data, data_fmt = _flatten(data)
+    flat_states, state_fmt = _flatten(init_states)
+    data_names = ["%s_data%d" % (name, i) for i in range(len(flat_data))]
+    state_names = ["%s_state%d" % (name, i) for i in range(len(flat_states))]
+    d_dum = [var(n) for n in data_names]
+    s_dum = [var(n) for n in state_names]
+    d_arg, rest = _regroup(d_dum, data_fmt)
+    s_arg, rest = _regroup(s_dum, state_fmt)
+    out, new_states = body(d_arg, s_arg)
+    flat_out, out_fmt = _flatten(out)
+    flat_ns, _ = _flatten(new_states)
+    if len(flat_ns) != len(flat_states):
+        raise ValueError("foreach body must return as many states as "
+                         "init_states")
+    sub = _group(flat_out + flat_ns)
+    dummies = set(data_names) | set(state_names)
+    frees = _free_vars(flat_out + flat_ns, dummies)
+    attrs = {
+        "subgraph": sub.tojson(),
+        "n_data": len(flat_data), "n_state": len(flat_states),
+        "n_out": len(flat_out),
+        "data_names": data_names, "state_names": state_names,
+        "free_names": [n.name for n in frees],
+    }
+    inputs = flat_data + flat_states + [Symbol([(n, 0)]) for n in frees]
+    res = _apply("_foreach", inputs, attrs, name)
+    outs = [res[i] for i in range(len(flat_out))]
+    fins = [res[len(flat_out) + i] for i in range(len(flat_states))]
+    o, _ = _regroup(outs, out_fmt)
+    s, _ = _regroup(fins, state_fmt)
+    return o, s
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Symbolic while loop (reference symbol/contrib.py:375).  Outputs are
+    stacked along axis 0 padded to ``max_iterations``."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    name = _NameManager.get(name)
+    flat_vars, var_fmt = _flatten(loop_vars)
+    state_names = ["%s_state%d" % (name, i) for i in range(len(flat_vars))]
+    s_dum = [var(n) for n in state_names]
+    s_arg, _ = _regroup(s_dum, var_fmt)
+    s_list = _as_list(s_arg)
+    c_sym = cond(*s_list)
+    out, new_vars = func(*s_list)
+    flat_out, out_fmt = _flatten(out)
+    flat_nv, _ = _flatten(new_vars)
+    if len(flat_nv) != len(flat_vars):
+        raise ValueError("while_loop func must return as many loop_vars "
+                         "as it received")
+    dummies = set(state_names)
+    c_frees = _free_vars([c_sym], dummies)
+    f_sub = _group(flat_out + flat_nv)
+    f_frees = _free_vars(flat_out + flat_nv, dummies)
+    attrs = {
+        "cond_graph": c_sym.tojson(), "func_graph": f_sub.tojson(),
+        "n_state": len(flat_vars), "n_out": len(flat_out),
+        "max_iterations": int(max_iterations),
+        "state_names": state_names,
+        "cond_free_names": [n.name for n in c_frees],
+        "func_free_names": [n.name for n in f_frees],
+    }
+    inputs = (flat_vars + [Symbol([(n, 0)]) for n in c_frees]
+              + [Symbol([(n, 0)]) for n in f_frees])
+    res = _apply("_while_loop", inputs, attrs, name)
+    outs = [res[i] for i in range(len(flat_out))]
+    fins = [res[len(flat_out) + i] for i in range(len(flat_vars))]
+    o, _ = _regroup(outs, out_fmt)
+    s, _ = _regroup(fins, var_fmt)
+    return o, s
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Symbolic if-then-else (reference symbol/contrib.py:598)."""
+    name = _NameManager.get(name)
+    p_sym = pred
+    t_out = then_func()
+    e_out = else_func()
+    flat_t, t_fmt = _flatten(t_out)
+    flat_e, e_fmt = _flatten(e_out)
+    if len(flat_t) != len(flat_e):
+        raise ValueError("cond branches must return the same number of "
+                         "outputs")
+    p_frees = _free_vars([p_sym], set())
+    t_frees = _free_vars(flat_t, set())
+    e_frees = _free_vars(flat_e, set())
+    t_sub = _group(flat_t)
+    e_sub = _group(flat_e)
+    attrs = {
+        "pred_graph": p_sym.tojson(),
+        "then_graph": t_sub.tojson(), "else_graph": e_sub.tojson(),
+        "n_out": len(flat_t),
+        "pred_free_names": [n.name for n in p_frees],
+        "then_free_names": [n.name for n in t_frees],
+        "else_free_names": [n.name for n in e_frees],
+    }
+    inputs = ([Symbol([(n, 0)]) for n in p_frees]
+              + [Symbol([(n, 0)]) for n in t_frees]
+              + [Symbol([(n, 0)]) for n in e_frees])
+    res = _apply("_cond", inputs, attrs, name)
+    outs = [res[i] for i in range(len(flat_t))]
+    o, _ = _regroup(outs, t_fmt)
+    return o

@@ -343,6 +343,29 @@ class Symbol:
     def __neg__(self):
         return _apply("negative", [self], {})
 
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser",
+                           "_scalar_broadcast_lesser")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal",
+                           "_scalar_broadcast_lesser_equal")
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater",
+                           "_scalar_broadcast_greater")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal",
+                           "_scalar_broadcast_greater_equal")
+
+    def __ne__(self, other):
+        try:
+            return self._binop(other, "broadcast_not_equal",
+                               "_scalar_broadcast_not_equal")
+        except TypeError:
+            return NotImplemented
+
     def __copy__(self):
         return Symbol(list(self._outputs))
 
@@ -351,12 +374,11 @@ class Symbol:
         return Symbol(list(self._outputs))
 
     def __eq__(self, other):
-        if isinstance(other, Symbol):
-            return _apply("broadcast_equal", [self, other], {})
-        if isinstance(other, (int, float)):
-            return _apply("_scalar_broadcast_equal", [self],
-                          {"scalar": float(other)})
-        return NotImplemented
+        try:
+            return self._binop(other, "broadcast_equal",
+                               "_scalar_broadcast_equal")
+        except TypeError:
+            return NotImplemented
 
     def __hash__(self):
         return id(self)

@@ -1,0 +1,209 @@
+"""Control-flow op tests (reference:
+tests/python/unittest/test_contrib_control_flow.py basic cases).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+# ---------------------------------------------------------------------------
+# imperative: nd.contrib
+# ---------------------------------------------------------------------------
+def test_nd_foreach_simple():
+    # reference ndarray/contrib.py:185 example
+    step = lambda data, states: (data + states[0], [states[0] * 2])
+    data = mx.nd.random.uniform(shape=(2, 10))
+    states = [mx.nd.random.uniform(shape=(10,))]
+    outs, final = mx.nd.contrib.foreach(step, data, states)
+    d = data.asnumpy()
+    s = states[0].asnumpy()
+    np.testing.assert_allclose(outs.asnumpy()[0], d[0] + s, rtol=1e-6)
+    np.testing.assert_allclose(outs.asnumpy()[1], d[1] + 2 * s, rtol=1e-6)
+    np.testing.assert_allclose(final[0].asnumpy(), 4 * s, rtol=1e-6)
+
+
+def test_nd_foreach_cumsum():
+    def step(data, states):
+        new = data + states[0]
+        return (new, [new])
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    outs, final = mx.nd.contrib.foreach(step, data, [mx.nd.zeros((3,))])
+    np.testing.assert_allclose(outs.asnumpy(),
+                               np.cumsum(data.asnumpy(), axis=0), rtol=1e-6)
+    np.testing.assert_allclose(final[0].asnumpy(),
+                               data.asnumpy().sum(0), rtol=1e-6)
+
+
+def test_nd_foreach_grad():
+    """Unrolled foreach under record: gradients reach the data."""
+    data = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    data.attach_grad()
+    w = mx.nd.array(np.ones((2,), dtype=np.float32) * 2.0)
+    w.attach_grad()
+
+    def step(x, states):
+        new = x * w + states[0]
+        return (new, [new])
+
+    with mx.autograd.record():
+        outs, final = mx.nd.contrib.foreach(step, data, [mx.nd.zeros((2,))])
+        loss = outs.sum()
+    loss.backward()
+    # d loss/d data[i] = w * (n - i)   (each slice feeds all later steps)
+    expect = np.stack([2.0 * (3 - i) * np.ones(2) for i in range(3)])
+    np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+    # d loss/d w = sum_i (n - i) * data[i]
+    d = data.asnumpy()
+    expect_w = sum((3 - i) * d[i] for i in range(3))
+    np.testing.assert_allclose(w.grad.asnumpy(), expect_w, rtol=1e-5)
+
+
+def test_nd_while_loop():
+    # reference ndarray/contrib.py:296 example
+    cond = lambda i, s: i <= 5
+    func = lambda i, s: ([i + s], [i + 1, s + i])
+    loop_vars = (mx.nd.array([0], dtype="int64"),
+                 mx.nd.array([1], dtype="int64"))
+    outputs, states = mx.nd.contrib.while_loop(
+        cond, func, loop_vars, max_iterations=10)
+    out = outputs[0].asnumpy()
+    np.testing.assert_array_equal(out[:6, 0], [1, 2, 4, 7, 11, 16])
+    assert int(states[0].asnumpy()[0]) == 6
+    assert int(states[1].asnumpy()[0]) == 16
+
+
+def test_nd_while_loop_zero_steps():
+    cond = lambda i: i < 0
+    func = lambda i: ([i], [i + 1])
+    outputs, states = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.array([5.0])], max_iterations=4)
+    assert outputs == []
+    np.testing.assert_allclose(states[0].asnumpy(), [5.0])
+
+
+def test_nd_cond():
+    a, b = mx.nd.array([1.0]), mx.nd.array([2.0])
+    out = mx.nd.contrib.cond(a * b < 5,
+                             lambda: (a + 5) * (b + 5),
+                             lambda: (a - 5) * (b - 5))
+    np.testing.assert_allclose(out.asnumpy(), [42.0])
+    out = mx.nd.contrib.cond(a * b > 5,
+                             lambda: (a + 5) * (b + 5),
+                             lambda: (a - 5) * (b - 5))
+    np.testing.assert_allclose(out.asnumpy(), [12.0])
+
+
+# ---------------------------------------------------------------------------
+# symbolic: sym.contrib
+# ---------------------------------------------------------------------------
+def test_sym_foreach_simple():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    step = lambda d, s: (d + s[0], [s[0] * 2])
+    outs, states = mx.sym.contrib.foreach(step, data, [init])
+    g = mx.sym.Group([outs, states[0]])
+    dn = np.random.rand(2, 10).astype(np.float32)
+    sn = np.random.rand(10).astype(np.float32)
+    ex = g.bind(args={"data": mx.nd.array(dn), "init": mx.nd.array(sn)})
+    o, f = ex.forward()
+    np.testing.assert_allclose(o.asnumpy()[0], dn[0] + sn, rtol=1e-6)
+    np.testing.assert_allclose(o.asnumpy()[1], dn[1] + 2 * sn, rtol=1e-6)
+    np.testing.assert_allclose(f.asnumpy(), 4 * sn, rtol=1e-6)
+
+
+def test_sym_foreach_free_var_and_grad():
+    """Free weight inside the body: wired as node input, grads flow."""
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    w = mx.sym.var("w")
+
+    def step(d, s):
+        new = d * w + s[0]
+        return (new, [new])
+
+    outs, states = mx.sym.contrib.foreach(step, data, [init])
+    loss = mx.sym.sum(outs)
+    dn = np.arange(6, dtype=np.float32).reshape(3, 2)
+    wn = 2.0 * np.ones((2,), dtype=np.float32)
+    ex = loss.bind(args={"data": mx.nd.array(dn),
+                         "init": mx.nd.zeros((2,)),
+                         "w": mx.nd.array(wn)},
+                   args_grad={"data": mx.nd.zeros((3, 2)),
+                              "init": mx.nd.zeros((2,)),
+                              "w": mx.nd.zeros((2,))})
+    ex.forward(is_train=True)
+    ex.backward()
+    expect = np.stack([2.0 * (3 - i) * np.ones(2) for i in range(3)])
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), expect,
+                               rtol=1e-5)
+    expect_w = sum((3 - i) * dn[i] for i in range(3))
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), expect_w,
+                               rtol=1e-5)
+
+
+def test_sym_foreach_json_roundtrip():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    step = lambda d, s: (d + s[0], [s[0] * 2])
+    outs, states = mx.sym.contrib.foreach(step, data, [init])
+    g = mx.sym.Group([outs, states[0]])
+    g2 = mx.sym.load_json(g.tojson())
+    dn = np.random.rand(2, 4).astype(np.float32)
+    sn = np.random.rand(4).astype(np.float32)
+    ex = g2.bind(args={"data": mx.nd.array(dn), "init": mx.nd.array(sn)})
+    o, f = ex.forward()
+    np.testing.assert_allclose(o.asnumpy()[1], dn[1] + 2 * sn, rtol=1e-6)
+
+
+def test_sym_while_loop():
+    i0 = mx.sym.var("i")
+    s0 = mx.sym.var("s")
+    outputs, states = mx.sym.contrib.while_loop(
+        cond=lambda i, s: i <= 5,
+        func=lambda i, s: ([i + s], [i + 1, s + i]),
+        loop_vars=[i0, s0], max_iterations=10)
+    g = mx.sym.Group([outputs[0], states[0], states[1]])
+    ex = g.bind(args={"i": mx.nd.array([0.0]), "s": mx.nd.array([1.0])})
+    o, si, ss = ex.forward()
+    np.testing.assert_allclose(o.asnumpy()[:6, 0], [1, 2, 4, 7, 11, 16])
+    assert o.asnumpy().shape[0] == 10  # padded to max_iterations
+    np.testing.assert_allclose(si.asnumpy(), [6.0])
+    np.testing.assert_allclose(ss.asnumpy(), [16.0])
+
+
+def test_sym_cond():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.contrib.cond(a * b < 5,
+                              lambda: (a + 5) * (b + 5),
+                              lambda: (a - 5) * (b - 5))
+    ex = out.bind(args={"a": mx.nd.array([1.0]), "b": mx.nd.array([2.0])})
+    (o,) = ex.forward()
+    np.testing.assert_allclose(o.asnumpy(), [42.0])
+    ex2 = out.bind(args={"a": mx.nd.array([3.0]), "b": mx.nd.array([2.0])})
+    (o2,) = ex2.forward()
+    np.testing.assert_allclose(o2.asnumpy(), [6.0])
+
+
+# ---------------------------------------------------------------------------
+# hybridize: control flow inside a jitted block
+# ---------------------------------------------------------------------------
+def test_foreach_in_hybrid_block():
+    class Cumsum(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            out, _ = F.contrib.foreach(
+                lambda d, s: (d + s[0], [d + s[0]]),
+                x, [F.zeros_like(x[0])] if F is mx.nd
+                else [mx.sym.zeros_like(x[0])])
+            return out
+
+    net = Cumsum()
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    y0 = net(x).asnumpy()
+    np.testing.assert_allclose(y0, np.cumsum(x.asnumpy(), 0), rtol=1e-6)
+    # and compiled: lax.scan inside the CachedOp trace
+    net.hybridize()
+    y1 = net(x).asnumpy()
+    np.testing.assert_allclose(y1, np.cumsum(x.asnumpy(), 0), rtol=1e-6)
