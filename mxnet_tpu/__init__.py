@@ -39,3 +39,4 @@ from . import model  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
+from . import predict  # noqa: F401

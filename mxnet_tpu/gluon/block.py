@@ -568,15 +568,45 @@ class HybridBlock(Block):
         return self.forward(*args)
 
     def export(self, path, epoch=0):
-        """Export model params for serving (reference: block.py:868 writes
-        symbol JSON + params; here: params + a structure descriptor)."""
-        params = self._collect_params_with_prefix()
-        arg_dict = {"arg:" + k: v._reduce() for k, v in params.items()}
+        """Export symbol JSON + params for serving (reference: block.py:868).
+
+        Writes ``path-symbol.json`` (the block traced symbolically over a
+        ``data`` variable) and ``path-####.params`` with ``arg:``/``aux:``
+        prefixed parameter names — the exact ``save_checkpoint`` format the
+        predict API (`mxnet_tpu.predict`, reference c_predict_api.cc) and
+        ``SymbolBlock.imports`` consume."""
+        from .. import symbol as _sym
+        # input arity: known exactly from the traced CachedOp if the net ran
+        # hybridized; otherwise default to the single-"data" convention
+        n_in = 1
+        if self._cached_op is not None and \
+                getattr(self._cached_op, "_n_inputs", None):
+            n_in = self._cached_op._n_inputs
+        if n_in <= 1:
+            data = [_sym.var("data")]
+        else:  # reference convention: data0, data1, ...
+            data = [_sym.var("data%d" % i) for i in range(n_in)]
+        out = self.forward(*data)
+        if isinstance(out, (list, tuple)):
+            out = _sym.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        aux_names = set(out.list_auxiliary_states())
+        arg_dict = {}
+        for p in self.collect_params().values():
+            prefix = "aux:" if p.name in aux_names else "arg:"
+            arg_dict[prefix + p.name] = p._reduce()
         nd.save("%s-%04d.params" % (path, epoch), arg_dict)
 
     def forward(self, x, *args):
         """Defers to ``hybrid_forward`` with resolved params
         (reference: block.py:901)."""
+        from .. import symbol as _sym
+        if isinstance(x, _sym.Symbol):
+            # symbolic composition (reference block.py:905): parameters
+            # enter the graph as their named variables — this is how
+            # ``export`` obtains the serving graph
+            params = {k: v.var() for k, v in self._reg_params.items()}
+            return self.hybrid_forward(_sym, x, *args, **params)
         if isinstance(x, NDArray):
             ctx = x.context
         else:
@@ -641,13 +671,47 @@ class HybridBlock(Block):
         raise NotImplementedError
 
 
+def _substitute_symbol(sym, mapping):
+    """Clone a Symbol graph, splicing ``mapping`` {var name: Symbol} onto
+    its input variables (composition for SymbolBlock's symbolic path)."""
+    from ..symbol.symbol import Symbol, _Node
+
+    node_memo = {}
+
+    def clone_node(node):
+        if node.is_var:
+            return node  # unmapped variable: shared verbatim
+        if id(node) in node_memo:
+            return node_memo[id(node)]
+        new_inputs = []
+        for src, oi in node.inputs:
+            if src.is_var and src.name in mapping:
+                new_inputs.append(mapping[src.name]._outputs[0])
+            else:
+                new_inputs.append((clone_node(src), oi))
+        new = _Node(node.op, node.name, new_inputs, node.attrs,
+                    user_attrs=node.user_attrs)
+        node_memo[id(node)] = new
+        return new
+
+    outs = []
+    for n, oi in sym._outputs:
+        if n.is_var and n.name in mapping:
+            outs.append(mapping[n.name]._outputs[0])
+        else:
+            outs.append((clone_node(n), oi))
+    return Symbol(outs)
+
+
 class SymbolBlock(HybridBlock):
     """Construct a block from a Symbol (reference: block.py:952).  Requires
     the symbolic frontend; constructed via ``SymbolBlock.imports`` or from a
     Symbol + input variables."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=params)
+        # free variables keep their graph names verbatim — no block prefix
+        # (reference SymbolBlock uses an unprefixed ParameterDict)
+        super().__init__(prefix="", params=params)
         from .. import symbol as sym
 
         if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
@@ -685,16 +749,57 @@ class SymbolBlock(HybridBlock):
         return ret
 
     def forward(self, x, *args):
-        from .. import symbol as sym
+        from .. import autograd as _ag
+        from .. import symbol as _symmod
+        from ..ops.registry import invoke as _invoke
+
+        sym = self._output_sym
+        if isinstance(x, _symmod.Symbol):
+            # symbolic composition: splice the stored graph onto the given
+            # input symbols (reference Symbol composition)
+            mapping = {s.name: v for s, v in
+                       zip(self._input_syms, [x] + list(args))}
+            return _substitute_symbol(sym, mapping)
 
         ctx = x.context if isinstance(x, NDArray) else current_context()
-        arg_dict = {}
+        feed = {}
         for s, v in zip(self._input_syms, [x] + list(args)):
-            arg_dict[s.name] = v
+            feed[s.name] = v
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = dict(feed)
+        aux_dict = {}
         for name, p in self.params.items():
-            arg_dict[name] = p.data(ctx)
-        ex = self._output_sym._eval(arg_dict)
-        return ex[0] if len(ex) == 1 else ex
+            (aux_dict if name in aux_names else arg_dict)[name] = p.data(ctx)
+
+        if _ag.is_recording():
+            # imperative interpretation so the tape sees every op and
+            # gradients reach this block's parameters (fine-tuning an
+            # imported model, reference SymbolBlock backward support)
+            env = {}
+            all_feed = dict(arg_dict)
+            all_feed.update(aux_dict)
+            for node in sym._topo():
+                if node.is_var:
+                    env[id(node)] = (all_feed[node.name],)
+                    continue
+                ins = [env[id(src)][oi] for src, oi in node.inputs]
+                res = _invoke(node.op, ins, dict(node.attrs))
+                env[id(node)] = tuple(res) if isinstance(res, list) \
+                    else (res,)
+            outs = [env[id(n)][oi] for n, oi in sym._outputs]
+            return outs[0] if len(outs) == 1 else outs
+
+        ex = getattr(self, "_cached_ex", None)
+        shapes = tuple(v.shape for v in feed.values())
+        if ex is None or self._cached_shapes != shapes:
+            ex = sym.bind(ctx=ctx, args=arg_dict, grad_req="null",
+                          aux_states=aux_dict)
+            self._cached_ex = ex
+            self._cached_shapes = shapes
+        else:
+            ex._stage(arg_dict)
+        outs = ex.forward(is_train=_ag.is_training())
+        return outs[0] if len(outs) == 1 else outs
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError

@@ -1,0 +1,215 @@
+"""Standalone predict (serving) API.
+
+Reference parity: ``src/c_api/c_predict_api.cc`` /
+``include/mxnet/c_predict_api.h:1-283`` — the minimal inference ABI: load a
+``prefix-symbol.json`` + ``prefix-####.params`` pair (written by
+``model.save_checkpoint`` or Gluon ``HybridBlock.export``) in a fresh
+process, bind for fixed input shapes, and run batched forward passes.
+
+TPU-native: the whole graph lowers to ONE jit'd XLA module (inference only,
+``grad_req='null'``); ``aot=True`` compiles at construction time
+(``jax.jit(...).lower().compile()`` — the analogue of the reference's
+bind-time ``GraphExecutor::Init``) so the first request pays no compile.
+
+Both the pythonic :class:`Predictor` and the C-shaped ``MXPred*`` functions
+(handle-based, mirroring the reference ABI one-to-one) are provided.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import Context, cpu
+from .ndarray import NDArray
+from .symbol import load_json as _sym_load_json
+
+__all__ = ["Predictor", "MXPredCreate", "MXPredCreatePartialOut",
+           "MXPredReshape", "MXPredGetOutputShape", "MXPredSetInput",
+           "MXPredForward", "MXPredGetOutput", "MXPredFree"]
+
+
+def _load_params(source):
+    """Accept a params file path, raw bytes, or a {name: NDArray} dict;
+    returns (arg_params, aux_params) with prefixes stripped."""
+    if isinstance(source, dict):
+        loaded = source
+    elif isinstance(source, bytes):
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".params") as f:
+            f.write(source)
+            f.flush()
+            loaded = nd.load(f.name)
+    else:
+        loaded = nd.load(source)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+class Predictor:
+    """Inference-only executor over an exported symbol+params pair
+    (reference ``MXPredCreate`` -> ``PredictorObj``)."""
+
+    def __init__(self, symbol, params, ctx=None, input_shapes=None,
+                 input_dtypes=None, output_names=None, aot=True):
+        from .symbol import Symbol, load as sym_load
+        if isinstance(symbol, Symbol):
+            sym = symbol
+        elif isinstance(symbol, str) and symbol.lstrip().startswith("{"):
+            sym = _sym_load_json(symbol)
+        else:
+            sym = sym_load(symbol)
+        if output_names is not None:
+            outs = sym.list_outputs()
+            picked = []
+            for name in output_names:
+                if name not in outs:
+                    raise ValueError("output %r not found in %s"
+                                     % (name, outs))
+                picked.append(sym[outs.index(name)])
+            from .symbol import Group
+            sym = Group(picked)
+        self._symbol = sym
+        self._ctx = ctx or cpu()
+        arg_params, aux_params = _load_params(params)
+        input_shapes = dict(input_shapes or {})
+        self._input_names = [n for n in sym.list_arguments()
+                             if n not in arg_params]
+        missing = [n for n in self._input_names if n not in input_shapes]
+        if missing:
+            raise ValueError("input_shapes must cover the data inputs; "
+                             "missing %s" % missing)
+
+        args = {}
+        for name in sym.list_arguments():
+            if name in arg_params:
+                args[name] = arg_params[name].as_in_context(self._ctx)
+            else:
+                dt = (input_dtypes or {}).get(name, np.float32)
+                args[name] = nd.zeros(input_shapes[name], dtype=dt,
+                                      ctx=self._ctx)
+        auxs = {}
+        for name in sym.list_auxiliary_states():
+            if name not in aux_params:
+                raise ValueError("missing auxiliary state %r in params"
+                                 % name)
+            auxs[name] = aux_params[name].as_in_context(self._ctx)
+
+        self._input_dtypes = dict(input_dtypes or {})
+        self._executor = sym.bind(ctx=self._ctx, args=args, grad_req="null",
+                                  aux_states=auxs)
+        self.outputs = None
+        if aot:
+            # AOT: trace + XLA-compile the module now by running one forward
+            # on the zero-initialized inputs (jit caches by shape, so real
+            # requests hit the compiled executable); outputs are discarded
+            self._executor.forward(is_train=False)
+
+    # -- c_predict_api surface ------------------------------------------
+    def set_input(self, key, data):
+        if key not in self._input_names:
+            raise ValueError("unknown input %r (inputs: %s)"
+                             % (key, self._input_names))
+        self._executor._stage({key: data})
+
+    def forward(self, **inputs):
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self.outputs = self._executor.forward(is_train=False)
+        return self.outputs
+
+    def get_output(self, index=0):
+        if self.outputs is None:
+            raise RuntimeError("call forward() before get_output()")
+        return self.outputs[index]
+
+    def get_output_shape(self, index=0):
+        if self.outputs is not None:
+            return tuple(self.outputs[index].shape)
+        feed = {n: self._executor.arg_dict[n].shape
+                for n in self._input_names}
+        _, out_shapes, _ = self._symbol.infer_shape(**feed)
+        return tuple(out_shapes[index])
+
+    def reshape(self, input_shapes):
+        """New predictor bound to different input shapes (reference
+        MXPredReshape); weights are shared, the graph recompiles."""
+        params = {}
+        for name, arr in self._executor.arg_dict.items():
+            if name not in self._input_names:
+                params["arg:" + name] = arr
+        for name, arr in self._executor.aux_dict.items():
+            params["aux:" + name] = arr
+        return Predictor(self._symbol, params, ctx=self._ctx,
+                         input_shapes=input_shapes,
+                         input_dtypes=self._input_dtypes)
+
+
+# ---------------------------------------------------------------------------
+# C-shaped ABI (handle-based; reference include/mxnet/c_predict_api.h)
+# ---------------------------------------------------------------------------
+_handles: dict = {}
+_next_handle = [1]
+
+
+def MXPredCreate(symbol_json_str, param_bytes, dev_type=1, dev_id=0,
+                 num_input_nodes=None, input_keys=(), input_shapes=()):
+    """reference c_predict_api.h:78.  dev_type 1=cpu, 2=gpu(tpu here)."""
+    ctx = Context("cpu" if dev_type == 1 else "tpu", dev_id)
+    shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
+    pred = Predictor(symbol_json_str, param_bytes, ctx=ctx,
+                     input_shapes=shapes)
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = pred
+    return h
+
+
+def MXPredCreatePartialOut(symbol_json_str, param_bytes, dev_type, dev_id,
+                           input_keys, input_shapes, output_keys):
+    """reference c_predict_api.h:111 — restrict outputs."""
+    ctx = Context("cpu" if dev_type == 1 else "tpu", dev_id)
+    shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
+    pred = Predictor(symbol_json_str, param_bytes, ctx=ctx,
+                     input_shapes=shapes, output_names=list(output_keys))
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = pred
+    return h
+
+
+def MXPredReshape(handle, input_keys, input_shapes):
+    """reference c_predict_api.h:170."""
+    shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
+    pred = _handles[handle].reshape(shapes)
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = pred
+    return h
+
+
+def MXPredGetOutputShape(handle, index=0):
+    return _handles[handle].get_output_shape(index)
+
+
+def MXPredSetInput(handle, key, data):
+    _handles[handle].set_input(key, data)
+
+
+def MXPredForward(handle):
+    _handles[handle].forward()
+
+
+def MXPredGetOutput(handle, index=0):
+    return _handles[handle].get_output(index).asnumpy()
+
+
+def MXPredFree(handle):
+    _handles.pop(handle, None)
