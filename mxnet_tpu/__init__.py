@@ -40,3 +40,4 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
 from . import predict  # noqa: F401
+from . import image  # noqa: F401

@@ -1,0 +1,9 @@
+"""Image loading + augmentation pipeline — `mx.image`.
+
+Reference parity: ``python/mxnet/image/`` (pre-Gluon augmenter pipeline)
++ ``src/io/image_aug_default.cc`` (decode-time augmenters).
+"""
+from .image import *  # noqa: F401,F403
+from .image import __all__ as _img_all
+
+__all__ = list(_img_all)

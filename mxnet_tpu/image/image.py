@@ -1,0 +1,606 @@
+"""Image IO + augmenters (reference: ``python/mxnet/image/image.py``).
+
+Design: the decode/augment stage is HOST-side work feeding the device (the
+reference runs it on CPU through OpenCV too — ``src/io/image_aug_default.cc``).
+Augmenters therefore operate on numpy HWC uint8/float32 arrays internally
+(zero per-image device dispatch); public functions accept/return NDArray for
+API parity, and ``ImageIter`` uploads once per BATCH — the TPU-friendly
+host->HBM pattern.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import io as _io
+from .. import recordio
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "copyMakeBorder", "fixed_crop", "random_crop", "center_crop",
+           "color_normalize", "random_size_crop", "Augmenter",
+           "SequentialAug", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug",
+           "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def _to_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def _wrap(arr):
+    return nd.array(np.ascontiguousarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# decode / geometry (reference image.py:45-604)
+# ---------------------------------------------------------------------------
+def imread(filename, flag=1, to_rgb=True):
+    """Read and decode an image file -> HWC uint8 NDArray (reference :45)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode a compressed image buffer (reference :143; OpenCV like the
+    reference's ``src/io/image_io.cc``)."""
+    cv2 = _cv2()
+    arr = np.frombuffer(buf if isinstance(buf, bytes) else bytes(buf),
+                        dtype=np.uint8)
+    img = cv2.imdecode(arr, cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise ValueError("imdecode failed: not a valid encoded image")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return _wrap(img)
+
+
+def _get_interp_method(interp, sizes=()):
+    """reference :289 — interp 9 = auto (area for shrink, cubic for
+    enlarge), 10 = random."""
+    cv2 = _cv2()
+    table = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR, 2: cv2.INTER_CUBIC,
+             3: cv2.INTER_AREA, 4: cv2.INTER_LANCZOS4}
+    if interp == 9:
+        if sizes:
+            oh, ow, nh, nw = sizes
+            if nh > oh and nw > ow:
+                return table[2]
+            if nh < oh and nw < ow:
+                return table[3]
+        return table[1]
+    if interp == 10:
+        return table[pyrandom.randint(0, 4)]
+    if interp not in table:
+        raise ValueError("Unknown interp method %d" % interp)
+    return table[interp]
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to (w, h) (reference :86)."""
+    cv2 = _cv2()
+    img = _to_np(src)
+    out = cv2.resize(img, (w, h), interpolation=_get_interp_method(
+        interp, (img.shape[0], img.shape[1], h, w)))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _wrap(out)
+
+
+def scale_down(src_size, size):
+    """Scale crop size down to fit src (reference :201)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to ``size`` (reference :344)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(img, new_w, new_h, interp=interp)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, values=0):
+    """Pad an image (reference :236)."""
+    cv2 = _cv2()
+    img = _to_np(src)
+    out = cv2.copyMakeBorder(img, top, bot, left, right, border_type,
+                             value=values)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _wrap(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a fixed region, optionally resize (reference :406)."""
+    img = _to_np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp=interp)
+    return _wrap(out)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop (w, h), scaled down if src is smaller (reference :438).
+    Returns (cropped NDArray, (x0, y0, w, h))."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop (reference :477).  Returns (NDArray, roi)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std on float images (reference :526)."""
+    img = _to_np(src).astype(np.float32)
+    mean = _to_np(mean) if mean is not None else None
+    std = _to_np(std) if std is not None else None
+    if mean is not None:
+        img = img - mean
+    if std is not None:
+        img = img / std
+    return _wrap(img)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random area+aspect crop, the Inception trick (reference :550)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if "min_area" in kwargs:
+        area = kwargs.pop("min_area")
+    assert not kwargs, "unexpected kwargs %s" % list(kwargs)
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference image.py:607-1016)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (reference :607)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, *self.size, interp=self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return _wrap(_to_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum() * 3.0 / img.size
+        return _wrap(img * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return _wrap(img * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference :861)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]])
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        img = _to_np(src).astype(np.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return _wrap(np.dot(img, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference :918)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return _wrap(_to_np(src).astype(np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(_to_np(mean))
+        self.std = None if std is None else np.asarray(_to_np(std))
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _wrap(np.dot(_to_np(src).astype(np.float32), self._mat))
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _wrap(_to_np(src)[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _wrap(_to_np(src).astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list (reference image.py:1017)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and not np.asarray(mean).size:
+        mean = None
+    if std is not None and not np.asarray(std).size:
+        std = None
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference image.py:1131) — the pythonic record/list iterator
+# ---------------------------------------------------------------------------
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec files or raw image lists with decode +
+    augmentation (reference image.py:1131).  Threaded decode happens in
+    `mx.io.ImageRecordIter`'s pool; this class is the flexible single-thread
+    variant the reference ships in python."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 part_index=0, num_parts=1, shuffle=False, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 dtype="float32", last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self.data_name = data_name
+        self.label_name = label_name
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path,
+                                                         path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+            self.imglist = None
+        else:
+            self.imgrec = None
+            if path_imglist:
+                self.imglist = {}
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        label = np.array(parts[1:-1], dtype=np.float32)
+                        self.imglist[int(parts[0])] = (label, parts[-1])
+            else:
+                self.imglist = {}
+                for i, (label, fname) in enumerate(imglist):
+                    self.imglist[i] = (np.array(label, dtype=np.float32)
+                                       .reshape(-1), fname)
+            self.seq = list(self.imglist.keys())
+        self.path_root = path_root
+        # distributed sharding (reference part_index/num_parts kwargs)
+        if num_parts > 1 and self.seq is not None:
+            assert 0 <= part_index < num_parts
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._cache = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc(self.data_name,
+                             (self.batch_size,) + self.data_shape,
+                             self.dtype)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [_io.DataDesc(self.label_name, shape, "float32")]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """(label, decoded HWC uint8 numpy image)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, imdecode(img).asnumpy()
+            label, fname = self.imglist[idx]
+            path = os.path.join(self.path_root or ".", fname)
+            return label, imread(path).asnumpy()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img).asnumpy()
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width),
+                               dtype=np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                for aug in self.auglist:
+                    img = aug(img)
+                img = _to_np(img)
+                if img.shape[:2] != (h, w):
+                    raise ValueError(
+                        "augmented image %s does not match data_shape %s"
+                        % (img.shape, self.data_shape))
+                batch_data[i] = img
+                batch_label[i] = np.asarray(label).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        # HWC -> CHW once per batch, single device upload
+        data = nd.array(batch_data.transpose(0, 3, 1, 2).astype(self.dtype))
+        label = nd.array(batch_label.reshape(-1) if self.label_width == 1
+                         else batch_label)
+        return _io.DataBatch([data], [label], pad=pad)

@@ -76,6 +76,7 @@ from . import linalg  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401
+from . import image  # noqa: E402,F401
 from .utils import save, load, load_frombuffer  # noqa: E402,F401
 
 

@@ -1,0 +1,65 @@
+"""`mx.nd.image` — device-side image op namespace (reference:
+``python/mxnet/ndarray/image.py`` codegen over ``_image_*`` ops)."""
+from __future__ import annotations
+
+from ..ops.registry import invoke as _invoke
+
+__all__ = ["to_tensor", "normalize", "flip_left_right", "flip_top_bottom",
+           "random_flip_left_right", "random_flip_top_bottom",
+           "random_brightness", "random_contrast", "random_saturation",
+           "random_lighting", "resize", "crop"]
+
+
+def to_tensor(data):
+    return _invoke("_image_to_tensor", [data])
+
+
+def normalize(data, mean=0.0, std=1.0):
+    return _invoke("_image_normalize", [data], {"mean": mean, "std": std})
+
+
+def flip_left_right(data):
+    return _invoke("_image_flip_left_right", [data])
+
+
+def flip_top_bottom(data):
+    return _invoke("_image_flip_top_bottom", [data])
+
+
+def random_flip_left_right(data):
+    return _invoke("_image_random_flip_left_right", [data])
+
+
+def random_flip_top_bottom(data):
+    return _invoke("_image_random_flip_top_bottom", [data])
+
+
+def random_brightness(data, min_factor, max_factor):
+    return _invoke("_image_random_brightness", [data],
+                   {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def random_contrast(data, min_factor, max_factor):
+    return _invoke("_image_random_contrast", [data],
+                   {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def random_saturation(data, min_factor, max_factor):
+    return _invoke("_image_random_saturation", [data],
+                   {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def random_lighting(data, alpha_std=0.05):
+    return _invoke("_image_random_lighting", [data],
+                   {"alpha_std": alpha_std})
+
+
+def resize(data, size=0, keep_ratio=False, interp=1):
+    return _invoke("_image_resize", [data],
+                   {"size": size, "keep_ratio": keep_ratio,
+                    "interp": interp})
+
+
+def crop(data, x, y, width, height):
+    return _invoke("_image_crop", [data],
+                   {"x": x, "y": y, "width": width, "height": height})

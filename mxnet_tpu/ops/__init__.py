@@ -8,3 +8,4 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import image_ops  # noqa: F401

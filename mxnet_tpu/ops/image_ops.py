@@ -1,0 +1,136 @@
+"""On-graph image operators (reference: ``src/operator/image/
+image_random-inl.h`` — to_tensor, normalize, flips, color jitters).
+
+These run INSIDE the compiled graph (device-side, differentiable where
+meaningful), unlike `mx.image`'s host-side decode augmenters.  Registered
+under the reference's ``_image_*`` internal names and surfaced as
+``mx.nd.image.*``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_image_to_tensor", aliases=("to_tensor",))
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (batched: NHWC -> NCHW)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return x.transpose(2, 0, 1)
+    return x.transpose(0, 3, 1, 2)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def _normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW float tensors."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    if mean.ndim == 1:
+        mean = mean.reshape((-1,) + (1,) * 2)
+    if std.ndim == 1:
+        std = std.reshape((-1,) + (1,) * 2)
+    return (data - mean) / std
+
+
+@register("_image_flip_left_right", aliases=("flip_left_right",))
+def _flip_lr(data):
+    # HWC or NHWC: width axis is -2
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_flip_top_bottom", aliases=("flip_top_bottom",))
+def _flip_tb(data):
+    # HWC or NHWC: height axis is -3
+    return jnp.flip(data, axis=-3)
+
+
+@register("_image_random_flip_left_right", needs_rng=True,
+          aliases=("random_flip_left_right",))
+def _random_flip_lr(rng, data):
+    flip = jax.random.bernoulli(rng)
+    return jnp.where(flip, jnp.flip(data, axis=-2), data)
+
+
+@register("_image_random_flip_top_bottom", needs_rng=True,
+          aliases=("random_flip_top_bottom",))
+def _random_flip_tb(rng, data):
+    flip = jax.random.bernoulli(rng)
+    return jnp.where(flip, jnp.flip(data, axis=-3), data)
+
+
+@register("_image_random_brightness", needs_rng=True,
+          aliases=("random_brightness",))
+def _random_brightness(rng, data, min_factor=0.0, max_factor=1.0):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor)
+    return data * alpha.astype(data.dtype)
+
+
+@register("_image_random_contrast", needs_rng=True,
+          aliases=("random_contrast",))
+def _random_contrast(rng, data, min_factor=0.0, max_factor=1.0):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor).astype(data.dtype)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    # per-pixel luminance, averaged per image (HWC and NHWC)
+    gray = (data * coef).sum(-1, keepdims=True)
+    gray = gray.mean(axis=(-3, -2), keepdims=True)
+    return data * alpha + gray * (1.0 - alpha)
+
+
+@register("_image_random_saturation", needs_rng=True,
+          aliases=("random_saturation",))
+def _random_saturation(rng, data, min_factor=0.0, max_factor=1.0):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor).astype(data.dtype)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    gray = (data * coef).sum(axis=-1, keepdims=True)
+    return data * alpha + gray * (1.0 - alpha)
+
+
+@register("_image_random_lighting", needs_rng=True,
+          aliases=("random_lighting",))
+def _random_lighting(rng, data, alpha_std=0.05):
+    eigval = jnp.asarray([55.46, 4.794, 1.148], data.dtype)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], data.dtype)
+    alpha = jax.random.normal(rng, (3,), data.dtype) * alpha_std
+    rgb = (eigvec * alpha) @ eigval
+    return data + rgb
+
+
+@register("_image_resize", aliases=("image_resize",))
+def _image_resize(data, size=0, keep_ratio=False, interp=1):
+    """Bilinear device-side resize (jax.image).  HWC or NHWC.
+
+    ``keep_ratio`` resizes the short edge to ``size`` preserving aspect
+    ratio (reference image_resize semantics); shapes are concrete at call
+    time so the output shape is static per call.
+    """
+    method = {0: "nearest", 1: "linear", 2: "cubic"}.get(interp, "linear")
+    ih, iw = int(data.shape[-3]), int(data.shape[-2])
+    if keep_ratio:
+        short = int(size if isinstance(size, int) else min(size))
+        if ih < iw:
+            h, w = short, max(1, round(iw * short / ih))
+        else:
+            h, w = max(1, round(ih * short / iw)), short
+    else:
+        if isinstance(size, int):
+            size = (size, size)
+        h, w = int(size[1]), int(size[0])
+    if data.ndim == 3:
+        return jax.image.resize(data, (h, w, data.shape[2]), method)
+    return jax.image.resize(
+        data, (data.shape[0], h, w, data.shape[3]), method)
+
+
+@register("_image_crop", aliases=("image_crop",))
+def _image_crop(data, x=0, y=0, width=0, height=0):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width]
+    return data[:, y:y + height, x:x + width]

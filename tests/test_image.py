@@ -1,0 +1,270 @@
+"""Image pipeline tests: mx.image, ImageRecordIter, im2rec, on-graph ops.
+
+Gold test (VERDICT #6 done-criterion): ResNet trains end-to-end from a
+generated .rec file.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, image, recordio
+
+
+def _png_bytes(arr):
+    import cv2
+    ok, buf = cv2.imencode(".png", arr[:, :, ::-1])  # RGB -> BGR for cv2
+    assert ok
+    return buf.tobytes()
+
+
+def _make_rec(tmp_path, n=12, size=20, classes=3):
+    """Write a small .rec/.idx; class is encoded in the dominant color so
+    the task stays learnable under crops/flips.  Returns (path, images)."""
+    rng = np.random.RandomState(0)
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    base = [(200, 40, 40), (40, 200, 40), (40, 40, 200)]
+    imgs = []
+    for i in range(n):
+        cls = i % classes
+        arr = (np.array(base[cls])[None, None]
+               + rng.randint(-30, 30, (size, size, 3))).clip(0, 255) \
+            .astype(np.uint8)
+        header = recordio.IRHeader(0, float(cls), i, 0)
+        rec.write_idx(i, recordio.pack(header, _png_bytes(arr)))
+        imgs.append((arr, float(cls)))
+    rec.close()
+    return rec_path, imgs
+
+
+# ---------------------------------------------------------------------------
+# mx.image basics
+# ---------------------------------------------------------------------------
+def test_imdecode_roundtrip():
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (8, 10, 3), dtype=np.uint8)
+    out = image.imdecode(_png_bytes(arr))
+    np.testing.assert_array_equal(out.asnumpy(), arr)  # PNG is lossless
+
+
+def test_imresize_and_resize_short():
+    arr = np.zeros((10, 20, 3), dtype=np.uint8)
+    out = image.imresize(arr, 8, 4)
+    assert out.shape == (4, 8, 3)
+    out2 = image.resize_short(arr, 5)
+    assert out2.shape == (5, 10, 3)
+
+
+def test_crops():
+    arr = np.arange(6 * 8 * 3, dtype=np.uint8).reshape(6, 8, 3)
+    out = image.fixed_crop(arr, 2, 1, 4, 3)
+    np.testing.assert_array_equal(out.asnumpy(), arr[1:4, 2:6])
+    out, roi = image.center_crop(arr, (4, 4))
+    assert out.shape == (4, 4, 3) and roi == (2, 1, 4, 4)
+    out, roi = image.random_crop(arr, (4, 4))
+    assert out.shape == (4, 4, 3)
+
+
+def test_color_normalize_and_augmenters():
+    arr = np.full((4, 4, 3), 128, dtype=np.uint8)
+    out = image.color_normalize(arr, mean=np.array([128.0, 128.0, 128.0]),
+                                std=np.array([2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+    aug = image.CreateAugmenter((3, 4, 4), rand_mirror=True,
+                                brightness=0.1, contrast=0.1,
+                                saturation=0.1, hue=0.1, pca_noise=0.1)
+    img = np.random.RandomState(0).randint(
+        0, 255, (6, 6, 3), dtype=np.uint8)
+    out = img
+    for a in aug:
+        out = a(out)
+    out = out.asnumpy() if hasattr(out, "asnumpy") else out
+    assert out.shape == (4, 4, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter_imglist(tmp_path):
+    import cv2
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(4):
+        arr = rng.randint(0, 255, (10, 10, 3), dtype=np.uint8)
+        p = str(tmp_path / ("img%d.png" % i))
+        cv2.imwrite(p, arr[:, :, ::-1])
+        files.append((float(i), "img%d.png" % i))
+    it = image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                         imglist=files, path_root=str(tmp_path),
+                         data_name="images", label_name="lab")
+    assert it.provide_data[0].name == "images"
+    assert it.provide_label[0].name == "lab"
+    batch = next(iter([it.next()]))
+    assert batch.data[0].shape == (2, 3, 8, 8)
+    assert batch.label[0].shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# ImageRecordIter
+# ---------------------------------------------------------------------------
+def test_image_record_iter(tmp_path):
+    rec_path, imgs = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               shuffle=False, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    np.testing.assert_array_equal(labels, [i % 3 for i in range(12)])
+    # reset + re-iterate works
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+
+
+def test_image_record_iter_sharded(tmp_path):
+    rec_path, _ = _make_rec(tmp_path)
+    seen = []
+    for part in range(2):
+        it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                                   data_shape=(3, 16, 16), batch_size=2,
+                                   part_index=part, num_parts=2)
+        for b in it:
+            seen.extend(b.label[0].asnumpy().tolist())
+    assert len(seen) == 12  # disjoint halves cover everything
+
+
+def test_image_record_iter_round_batch(tmp_path):
+    rec_path, _ = _make_rec(tmp_path, n=10)  # 10 % 4 = tail of 2
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               shuffle=False, round_batch=True)
+    batches = list(it)
+    assert len(batches) == 3
+    tail = batches[-1]
+    assert tail.pad == 2
+    # wrapped slots carry records from the epoch start, not zeros
+    np.testing.assert_array_equal(tail.label[0].asnumpy(),
+                                  [2, 0, 0, 1])  # labels 8%3,9%3 then wrap
+
+
+def test_image_record_iter_reset_mid_epoch(tmp_path):
+    rec_path, _ = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               prefetch_buffer=1)
+    it.next()  # consume one batch, producer blocked on full queue
+    it.reset()  # must not hang, leak, or interleave old-epoch batches
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    np.testing.assert_array_equal(labels, [i % 3 for i in range(12)])
+
+
+def test_image_record_iter_std_only(tmp_path):
+    """std_r/g/b must apply even when no mean is given."""
+    rec_path, imgs = _make_rec(tmp_path, n=4, size=16)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               shuffle=False, std_r=2.0, std_g=2.0,
+                               std_b=2.0)
+    data = it.next().data[0].asnumpy()
+    expect = np.stack([a for a, _ in imgs]).astype(np.float32) \
+        .transpose(0, 3, 1, 2) / 2.0
+    np.testing.assert_allclose(data, expect, rtol=1e-5)
+
+
+def test_resnet_trains_from_rec(tmp_path):
+    """VERDICT #6 gold: ResNet end-to-end from a .rec file."""
+    rec_path, _ = _make_rec(tmp_path, n=8, size=24)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               rand_crop=True, rand_mirror=True,
+                               mean_r=128, mean_g=128, mean_b=128,
+                               std_r=64, std_g=64, std_b=64)
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=3)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    epoch_losses = []
+    for epoch in range(6):
+        it.reset()
+        losses = []
+        for batch in it:
+            with mx.autograd.record():
+                out = net(batch.data[0])
+                loss = lf(out, batch.label[0])
+            loss.backward()
+            tr.step(batch.data[0].shape[0])
+            losses.append(float(loss.asnumpy().mean()))
+        epoch_losses.append(np.mean(losses))
+    assert np.isfinite(epoch_losses).all()
+    assert epoch_losses[-1] < epoch_losses[0], epoch_losses
+
+
+# ---------------------------------------------------------------------------
+# im2rec tool
+# ---------------------------------------------------------------------------
+def test_im2rec_tool(tmp_path):
+    import cv2
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        os.makedirs(str(tmp_path / "imgs" / cls))
+        for i in range(3):
+            arr = rng.randint(0, 255, (12, 12, 3), dtype=np.uint8)
+            cv2.imwrite(str(tmp_path / "imgs" / cls / ("%d.jpg" % i)), arr)
+    prefix = str(tmp_path / "ds")
+    r = subprocess.run([sys.executable, "tools/im2rec.py", prefix,
+                        str(tmp_path / "imgs")],
+                       capture_output=True, text=True, cwd="/root/repo",
+                       timeout=120)
+    assert "packed 6 records" in r.stdout, r.stdout + r.stderr
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 12, 12), batch_size=3)
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(labels.tolist()) == [0, 0, 0, 1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# on-graph image ops
+# ---------------------------------------------------------------------------
+def test_nd_image_ops():
+    rng = np.random.RandomState(0)
+    hwc = rng.randint(0, 255, (6, 8, 3), dtype=np.uint8)
+    x = mx.nd.array(hwc.astype(np.float32))
+    t = mx.nd.image.to_tensor(mx.nd.array(hwc))
+    assert t.shape == (3, 6, 8)
+    np.testing.assert_allclose(t.asnumpy(),
+                               hwc.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    n = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    np.testing.assert_allclose(n.asnumpy(), (t.asnumpy() - 0.5) / 0.5,
+                               rtol=1e-5)
+    f = mx.nd.image.flip_left_right(x)
+    np.testing.assert_array_equal(f.asnumpy(), hwc[:, ::-1].astype(np.float32))
+    f2 = mx.nd.image.flip_top_bottom(x)
+    np.testing.assert_array_equal(f2.asnumpy(),
+                                  hwc[::-1].astype(np.float32))
+    r = mx.nd.image.resize(x, size=(4, 3))
+    assert r.shape == (3, 4, 3)
+    rk = mx.nd.image.resize(x, size=4, keep_ratio=True)
+    assert rk.shape == (4, 5, 3)  # short edge 6 -> 4, 8 -> round(8*4/6)=5
+    # contrast: a uniform image is a fixed point of contrast jitter
+    gray = mx.nd.array(np.full((5, 5, 3), 128.0, dtype=np.float32))
+    rc = mx.nd.image.random_contrast(gray, 0.3, 0.7)
+    np.testing.assert_allclose(rc.asnumpy(), 128.0, rtol=1e-5)
+    c = mx.nd.image.crop(x, 1, 2, 4, 3)
+    np.testing.assert_array_equal(c.asnumpy(),
+                                  hwc[2:5, 1:5].astype(np.float32))
+    # random ops: shape-preserving, actually vary with the key chain
+    rb = mx.nd.image.random_brightness(x, 0.5, 1.5)
+    assert rb.shape == x.shape
+    rs = mx.nd.image.random_saturation(x, 0.5, 1.5)
+    assert rs.shape == x.shape
+    rf = mx.nd.image.random_flip_left_right(x)
+    assert rf.shape == x.shape
+    rl = mx.nd.image.random_lighting(x, 0.1)
+    assert rl.shape == x.shape

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory / .lst file into RecordIO (.rec + .idx).
+
+Reference parity: ``tools/im2rec.py`` — two modes:
+  * ``--list``: scan an image root and write a ``prefix.lst`` listing
+    (index \\t label \\t relpath);
+  * pack mode: read ``prefix.lst`` and write ``prefix.rec`` + ``prefix.idx``
+    with JPEG-encoded records (threaded encode).
+
+Usage:
+  python tools/im2rec.py --list prefix img_root
+  python tools/im2rec.py prefix img_root [--resize N] [--quality Q]
+          [--num-thread T]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def list_image(root, recursive, exts=EXTS):
+    """Yield (index, relpath, label) — label = folder index when recursive
+    (reference im2rec.py:38)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                if os.path.splitext(fname)[1].lower() in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for idx, relpath, label in image_list:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), relpath))
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]),
+                   [float(x) for x in parts[1:-1]], parts[-1])
+
+
+def _encode_one(args, idx, labels, relpath):
+    import cv2
+    import numpy as np
+
+    path = os.path.join(args.root, relpath)
+    img = cv2.imread(path, cv2.IMREAD_COLOR)
+    if img is None:
+        return None
+    if args.resize:
+        h, w = img.shape[:2]
+        if h > w:
+            newsize = (args.resize, int(h * args.resize / w))
+        else:
+            newsize = (int(w * args.resize / h), args.resize)
+        img = cv2.resize(img, newsize)
+    ok, buf = cv2.imencode(
+        ".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+    if not ok:
+        return None
+    label = labels[0] if len(labels) == 1 else np.asarray(labels)
+    header = recordio.IRHeader(0, label, idx, 0)
+    return recordio.pack(header, buf.tobytes())
+
+
+def make_rec(args):
+    lst = args.prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n_ok = n_fail = 0
+    # bounded in-flight window so encoded payloads don't pile up in memory
+    window = max(args.num_thread * 8, 64)
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        from collections import deque
+        pending = deque()
+
+        def flush(limit):
+            nonlocal n_ok, n_fail
+            while len(pending) > limit:
+                idx, fut = pending.popleft()
+                payload = fut.result()
+                if payload is None:
+                    n_fail += 1
+                else:
+                    rec.write_idx(idx, payload)
+                    n_ok += 1
+
+        for idx, labels, rel in read_list(lst):
+            pending.append(
+                (idx, pool.submit(_encode_one, args, idx, labels, rel)))
+            flush(window)
+        flush(0)
+    rec.close()
+    print("packed %d records (%d failed) -> %s.rec" %
+          (n_ok, n_fail, args.prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="make a .lst listing instead of packing")
+    ap.add_argument("--recursive", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="label = folder index (--no-recursive: flat dir, "
+                         "label 0)")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--num-thread", type=int, default=4)
+    args = ap.parse_args()
+    if args.list:
+        write_list(args.prefix + ".lst",
+                   list_image(args.root, args.recursive))
+        print("wrote %s.lst" % args.prefix)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            write_list(args.prefix + ".lst",
+                       list_image(args.root, args.recursive))
+        make_rec(args)
+
+
+if __name__ == "__main__":
+    main()
