@@ -11,6 +11,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from ._dist import init_from_env as _dist_init_from_env
+
+_dist_init_from_env()  # multi-worker bootstrap (mxnet_tpu.tools.launch)
+
 from .base import MXNetError  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus,  # noqa: F401
                       num_tpus, current_context)

@@ -156,25 +156,31 @@ class KVStore:
         return total
 
     def _cross_process_sum(self, arr):
+        # Multi-host allreduce (the reference's ps-lite push/ncclReduce
+        # path), staying on-device: each worker's locally-reduced gradient
+        # becomes one shard of a global array over a one-device-per-process
+        # mesh axis; a jitted sum over that axis compiles to an XLA
+        # all-reduce (ICI/DCN on TPU pods, gloo TCP on the CPU emulation
+        # harness).  All workers must push the same keys in the same order
+        # (SPMD) — the same contract the reference's dist_sync mode has.
         import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        # multi-host allreduce: one jitted psum over the global device set
-        # (jax.distributed must be initialized by the launcher —
-        # mxnet_tpu.tools.launch)
-        from jax.experimental.multihost_utils import (
-            global_array_to_host_local_array, host_local_array_to_global_array)
-        import jax.numpy as jnp
-        from jax.sharding import Mesh, PartitionSpec as P
-
-        devices = jax.devices()
-        mesh = Mesh(devices, ("hosts",))
-        garr = host_local_array_to_global_array(arr.data, mesh, P())
-        summed = jax.jit(
-            lambda x: jax.lax.psum(x, "hosts"),
-            in_shardings=jax.sharding.NamedSharding(mesh, P()),
-            out_shardings=jax.sharding.NamedSharding(mesh, P()))(garr)
-        local = global_array_to_host_local_array(summed, mesh, P())
-        return nd.NDArray(local, ctx=arr.context)
+        nproc = jax.process_count()
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = np.array([per_proc[i] for i in range(nproc)])
+        mesh = Mesh(devs, ("hosts",))
+        local = jax.device_put(arr.data[None],
+                               per_proc[jax.process_index()])
+        garr = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(arr.shape), NamedSharding(mesh, P("hosts")),
+            [local])
+        out = jax.jit(lambda a: a.sum(axis=0),
+                      out_shardings=NamedSharding(mesh, P()))(garr)
+        return nd.NDArray(out.addressable_shards[0].data, ctx=arr.context)
 
     # -- optimizer placement ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -245,4 +251,12 @@ def create(name="local"):
     if name not in known:
         raise ValueError("unknown KVStore type %s (known: %s)"
                          % (name, ", ".join(known)))
-    return KVStore(name)
+    store = KVStore(name)
+    if name.startswith("dist") and store.num_workers == 1:
+        import logging
+        logging.getLogger(__name__).warning(
+            "kvstore %r created with a single worker process; cross-"
+            "process reduce is a no-op. Launch workers via "
+            "`python -m mxnet_tpu.tools.launch -n N -- ...` for real "
+            "distributed sync.", name)
+    return store

@@ -1,0 +1,2 @@
+"""Cluster tooling (reference: ``tools/`` — launch.py, im2rec, bandwidth)."""
+from . import launch  # noqa: F401

@@ -1,0 +1,58 @@
+"""Distributed kvstore worker, run under ``mxnet_tpu.tools.launch``.
+
+Port of the reference's exact-equality dist test pattern
+(``tests/nightly/dist_sync_kvstore.py:30-33``): deterministic reductions
+must match bit-for-bit across workers.  Invoked by tests/test_dist.py.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main(out_dir):
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 3, "expected 3 workers, got %d" % nw
+
+    shape = (4, 5)
+    # 1. dense push/pull exact equality: sum of rank+1 = 1+2+3 = 6
+    kv.init("w", mx.nd.zeros(shape))
+    for rnd in range(2):  # repeatable across rounds
+        kv.push("w", mx.nd.full(shape, rank + 1.0))
+        out = mx.nd.zeros(shape)
+        kv.pull("w", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), 6.0)
+
+    # 2. per-worker multi-value push: local reduce then cross-process sum
+    kv.init(9, mx.nd.zeros(shape))
+    kv.push(9, [mx.nd.full(shape, rank + 1.0),
+                mx.nd.full(shape, rank + 1.0)])
+    out = mx.nd.zeros(shape)
+    kv.pull(9, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), 12.0)
+
+    # 3. server-side optimizer semantics (reference kvstore_dist_server
+    #    ApplyUpdates): one SGD step with the all-worker summed gradient
+    kv2 = mx.kv.create("dist_sync")
+    kv2.init("p", mx.nd.ones(shape))
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv2.push("p", mx.nd.full(shape, rank + 1.0))
+    out = mx.nd.zeros(shape)
+    kv2.pull("p", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * 6.0, rtol=1e-6)
+
+    # 4. barrier + rank-stamped result file for the parent to check
+    kv._barrier()
+    with open("%s/worker_%d.ok" % (out_dir, rank), "w") as f:
+        f.write("OK %d/%d global_devices=%d\n"
+                % (rank, nw, mx.context.num_tpus() or 0))
+    print("worker %d OK" % rank)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

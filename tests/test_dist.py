@@ -1,0 +1,37 @@
+"""Multi-process distributed tests (reference pattern:
+``tools/launch.py --launcher local`` forking ps-lite roles on one host +
+``tests/nightly/dist_sync_kvstore.py`` exact-equality assertions).
+
+Here the launcher forks N ``jax.distributed`` CPU workers (gloo
+collectives) on this host; kvstore ``dist_*`` runs the real cross-process
+reduce path — the same code that rides ICI/DCN on a TPU pod.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.tools.launch", "-n", "3",
+         "--platform", "cpu", "--local-devices", "2", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_worker.py"),
+         str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=570)
+    assert r.returncode == 0, "launcher failed:\n%s\n%s" % (r.stdout,
+                                                            r.stderr)
+    done = sorted(p.name for p in tmp_path.glob("worker_*.ok"))
+    assert done == ["worker_0.ok", "worker_1.ok", "worker_2.ok"], (
+        done, r.stdout, r.stderr)
+
+
+def test_launch_cli_errors():
+    from mxnet_tpu.tools import launch
+    with pytest.raises(NotImplementedError):
+        launch.main(["-n", "2", "--launcher", "ssh", "--", "true"])
+    with pytest.raises(SystemExit):
+        launch.main(["-n", "2"])  # no command
