@@ -16,6 +16,7 @@ from ._dist import init_from_env as _dist_init_from_env
 _dist_init_from_env()  # multi-worker bootstrap (mxnet_tpu.tools.launch)
 
 from .base import MXNetError  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus,  # noqa: F401
                       num_tpus, current_context)
 from . import ops  # noqa: F401  (registers the op corpus)
@@ -45,3 +46,4 @@ from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
 from . import predict  # noqa: F401
 from . import image  # noqa: F401
+from . import profiler  # noqa: F401

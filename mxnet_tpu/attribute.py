@@ -1,0 +1,47 @@
+"""Attribute scoping (reference: ``python/mxnet/attribute.py`` AttrScope).
+
+``with mx.AttrScope(ctx_group='dev1'):`` stamps ``__ctx_group__`` (and any
+other ``__key__`` attribute) onto every symbol node created inside the
+scope — the mechanism behind model-parallel device placement
+(``group2ctx``, reference ``graph_executor.cc:909-915`` AssignContext).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_stack = threading.local()
+
+
+def _frames():
+    if not hasattr(_stack, "frames"):
+        _stack.frames = []
+    return _stack.frames
+
+
+def current_attrs():
+    """Merged ``__key__`` attributes of all active scopes (inner wins)."""
+    merged = {}
+    for frame in _frames():
+        merged.update(frame)
+    return merged
+
+
+class AttrScope:
+    """Attach user attributes to symbols created within the scope."""
+
+    def __init__(self, **kwargs):
+        self._attr = {}
+        for k, v in kwargs.items():
+            key = k if k.startswith("__") and k.endswith("__") \
+                else "__%s__" % k
+            self._attr[key] = str(v)
+
+    def __enter__(self):
+        _frames().append(self._attr)
+        return self
+
+    def __exit__(self, *exc):
+        _frames().pop()
+        return False

@@ -231,8 +231,8 @@ def _structure_key(nodes, vars_, head_entries, consts_shapes):
     return (nk, vk, hk, consts_shapes)
 
 
-def _build_backward(nodes, vars_, head_entries):
-    """Build jitted fn (leaf_vals, head_grads, consts) -> leaf grads."""
+def _build_replay(nodes, vars_, head_entries):
+    """Build pure fn (leaf_vals, consts) -> head values (tape replay)."""
     node_ids = {id(n): i for i, n in enumerate(nodes)}
     var_ids = {id(v): i for i, v in enumerate(vars_)}
 
@@ -277,6 +277,13 @@ def _build_backward(nodes, vars_, head_entries):
             else:
                 heads.append(env[(node_ids[id(e[0])], e[1])])
         return heads
+
+    return replay
+
+
+def _build_backward(nodes, vars_, head_entries):
+    """Build jitted fn (leaf_vals, head_grads, consts) -> leaf grads."""
+    replay = _build_replay(nodes, vars_, head_entries)
 
     def run(leaf_vals, head_grads, consts):
         _, vjp_fn = jax.vjp(lambda lv: replay(lv, consts), leaf_vals)
@@ -470,13 +477,15 @@ def _clear_tape(heads, nodes):
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Functional gradient API (reference: mx.autograd.grad)."""
-    from .ndarray.ndarray import NDArray, _wrap
+    """Functional gradient API (reference: mx.autograd.grad,
+    ``src/imperative/imperative.cc:278-520``).
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order autograd) is not supported yet; "
-            "use jax-level composition via mxnet_tpu.ops directly")
+    ``create_graph=True`` makes the returned gradients differentiable: the
+    whole-tape vjp closure is itself recorded as one tape node (a pure jax
+    function, so the outer backward composes vjp-of-vjp — higher-order
+    autograd is native to JAX, unlike the reference's re-run of its
+    Gradient pass with ``create_graph``)."""
+    from .ndarray.ndarray import NDArray, _wrap
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -496,6 +505,23 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         hg = [jnp.ones(h.shape, h.dtype) for h in heads]
     else:
         hg = [g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in head_grads]
+    if create_graph:
+        if any(n.custom is not None for n in nodes):
+            raise NotImplementedError(
+                "create_graph=True through an opaque autograd.Function is "
+                "not supported (its python backward is not traceable)")
+        outs, out_vars = _grad_create_graph(
+            nodes, vars_, head_entries, hg,
+            head_grads if head_grads is not None else [None] * len(heads))
+        grads, vars_ = outs, out_vars
+        out, var_index = [], {id(v): i for i, v in enumerate(vars_)}
+        for v in variables:
+            tv = v._tape_var
+            if tv is not None and id(tv) in var_index:
+                out.append(grads[var_index[id(tv)]])
+            else:
+                out.append(_wrap(jnp.zeros(v.shape, v.dtype)))
+        return out[0] if single else out
     if any(n.custom is not None for n in nodes):
         grads = _eager_backward(nodes, vars_, head_entries, hg)
     else:
@@ -516,6 +542,74 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         else:
             out.append(_wrap(jnp.zeros(v.shape, v.dtype)))
     return out[0] if single else out
+
+
+_cg_cache: dict = {}
+
+
+def _grad_create_graph(nodes, vars_, head_entries, hg, head_grad_arrays):
+    """grad() with a differentiable result: record the tape-vjp closure as
+    one new tape node whose outputs are the per-leaf gradients.
+
+    Returns (grad NDArrays aligned with vars_, vars_).  Tape-tracked
+    head_grads become real node inputs, so second-order gradients flow
+    through them too (not silently-zero constants).
+    """
+    import weakref
+
+    from .ndarray.ndarray import NDArray, _wrap
+    from .ops.registry import OpDef, _freeze
+
+    n_vars, n_heads = len(vars_), len(head_entries)
+    consts = _flatten_consts(nodes)
+    inner_key = _structure_key(nodes, vars_, head_entries,
+                               tuple((c.shape, str(c.dtype))
+                                     for c in consts))
+    cached = _cg_cache.get(inner_key)
+    if cached is None:
+        replay = _build_replay(nodes, vars_, head_entries)
+
+        def grad_fn(*args, **_static):
+            lv = list(args[:n_vars])
+            heads_g = list(args[n_vars:n_vars + n_heads])
+            cs = list(args[n_vars + n_heads:])
+            _, vjp_fn = jax.vjp(lambda l: replay(l, cs), lv)
+            (gs,) = vjp_fn(heads_g)
+            return tuple(gs)
+
+        cached = (OpDef("_tape_grad", grad_fn, cacheable=False,
+                        num_outputs=n_vars), jax.jit(grad_fn))
+        _cg_cache[inner_key] = cached
+    opdef, jitted = cached
+
+    grads = jitted(*([v.array for v in vars_] + hg + consts))
+
+    # record the closure as a tape node: leaf vars are inputs; head_grads
+    # that are themselves tape-tracked join as inputs (entries), untracked
+    # ones and tape consts ride along as captured constants
+    entries = [("var", v) for v in vars_]
+    node_consts = []
+    hg_entries = []
+    for g_arr, g_nd in zip(hg, head_grad_arrays):
+        e = g_nd._tape_entry if isinstance(g_nd, NDArray) else None
+        if e is None and isinstance(g_nd, NDArray) \
+                and g_nd._tape_var is not None:
+            e = ("var", g_nd._tape_var)
+        if e is None:
+            node_consts.append(g_arr)
+            e = ("const", len(node_consts) - 1)
+        hg_entries.append(e)
+    entries.extend(hg_entries)
+    for c in consts:
+        node_consts.append(c)
+        entries.append(("const", len(node_consts) - 1))
+    node = _Node(opdef, _freeze({"__tape_key": inner_key}), (), None,
+                 is_training(), entries, node_consts, n_vars)
+    outs = [_wrap(g) for g in grads]
+    node.out_refs = tuple(weakref.ref(o) for o in outs)
+    for i, o in enumerate(outs):
+        o._tape_entry = (node, i)
+    return outs, vars_
 
 
 class Function:

@@ -33,6 +33,20 @@ class Executor:
                  group2ctx=None, shared_exec=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # model-parallel placement (reference AssignContext,
+        # graph_executor.cc:909-915): nodes stamped `__ctx_group__` (via
+        # mx.AttrScope(ctx_group=...)) are pinned to group2ctx[group]'s
+        # device; XLA inserts the cross-device copies the reference added
+        # as explicit copy nodes (:1380-1384).  Unmapped groups fall back
+        # to the default ctx, like the reference.
+        self._node_device = {}
+        if group2ctx:
+            for node in symbol._topo():
+                grp = (node.user_attrs or {}).get("__ctx_group__")
+                if grp and grp in group2ctx:
+                    dev = group2ctx[grp].jax_device()
+                    if dev != self._ctx.jax_device():
+                        self._node_device[id(node)] = dev
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -121,6 +135,8 @@ class Executor:
         rng_ops = [node for node in topo
                    if not node.is_var and node.op.needs_rng]
 
+        node_device = self._node_device
+
         def fn(rng, arg_vals, aux_vals):
             env = {}
             new_aux = dict(enumerate(aux_vals))
@@ -135,6 +151,9 @@ class Executor:
                         env[id(node)] = (aux_vals[aux_index[node.name]],)
                     continue
                 ins = [env[id(src)][oi] for src, oi in node.inputs]
+                dev = node_device.get(id(node))
+                if dev is not None:  # group2ctx placement
+                    ins = [jax.device_put(x, dev) for x in ins]
                 f = node.op.bind(dict(node.attrs), train)
                 if node.op.needs_rng:
                     res = f(keys[ki], *ins)
@@ -209,6 +228,11 @@ class Executor:
             self.arg_dict[k]._set_data(data)
 
     def forward(self, is_train=False, **kwargs):
+        from . import profiler as _prof
+        with _prof.symbolic_span("Executor::forward"):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         from . import random as _random
 
         self._stage(kwargs)
@@ -228,6 +252,11 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True, **kwargs):
+        from . import profiler as _prof
+        with _prof.symbolic_span("Executor::backward"):
+            return self._backward_impl(out_grads, is_train, **kwargs)
+
+    def _backward_impl(self, out_grads=None, is_train=True, **kwargs):
         from . import random as _random
 
         self._stage(kwargs)

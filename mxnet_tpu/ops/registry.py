@@ -231,6 +231,11 @@ def invoke(op_name, ndarray_inputs, params=None, out=None):
     if tap is not None:
         opdef = get_op(op_name) if isinstance(op_name, str) else op_name
         return tap(opdef, ndarray_inputs, params, out)
+    from .. import profiler as _prof
+    if _prof.state() == "run":
+        name = op_name if isinstance(op_name, str) else op_name.name
+        with _prof.op_span(name):
+            return _invoke_impl(op_name, ndarray_inputs, params, out)
     return _invoke_impl(op_name, ndarray_inputs, params, out)
 
 

@@ -1,0 +1,334 @@
+"""Profiler (reference: ``python/mxnet/profiler.py:33-291`` over
+``src/profiler/profiler.{h,cc}``).
+
+Reference mechanism: engine worker threads wrap op execution in
+``ProfileOperator`` spans, C-API calls get ``kAPI`` spans, storage hooks
+record memory; output is chrome://tracing JSON plus aggregate per-op tables
+(``aggregate_stats.cc``).
+
+TPU-native redesign: there is no engine thread to instrument — XLA owns
+device scheduling.  Two layers instead:
+
+* **Host spans** — every imperative op dispatch (``ops/registry.invoke``),
+  executor forward/backward, and user ProfileTask/Event/Frame objects are
+  recorded wall-clock into an in-process buffer and dumped as
+  chrome://tracing JSON (identical consumption story: load in
+  ``chrome://tracing`` / Perfetto).  Aggregate per-op stats parity via
+  :func:`dumps`.
+* **Device traces** — ``set_config(tensorboard_dir=...)`` brackets the run
+  with ``jax.profiler.start_trace/stop_trace`` (XLA's own profiler:
+  per-HLO timing, HBM usage — the TPU analogue of the reference's kernel
+  spans), and every op dispatch carries a ``jax.profiler.TraceAnnotation``
+  so op names appear on the device timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "state", "ProfileDomain", "Task", "Event",
+           "Counter", "Frame", "Marker"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+    "tensorboard_dir": None,
+}
+_state = "stop"
+_paused = False
+_events = []       # chrome trace events
+_agg = {}          # name -> [count, total_us, min_us, max_us]
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def _active(category="imperative"):
+    if _state != "run" or _paused:
+        return False
+    return bool(_config.get("profile_all")
+                or _config.get("profile_" + category, True))
+
+
+def record_span(name, cat, begin_us, dur_us, tid=None):
+    """Append one complete ('X') chrome-trace span (internal hook API).
+    No-op unless the profiler is running (so instrumented library code is
+    free to leave Task/Frame objects in place)."""
+    if _state != "run" or _paused:
+        return
+    _events.append({"name": name, "cat": cat, "ph": "X",
+                    "ts": begin_us, "dur": dur_us, "pid": os.getpid(),
+                    "tid": tid if tid is not None
+                    else threading.get_ident() % 10000})
+    if _config.get("aggregate_stats"):
+        with _lock:
+            a = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            a[0] += 1
+            a[1] += dur_us
+            a[2] = min(a[2], dur_us)
+            a[3] = max(a[3], dur_us)
+
+
+class _Span:
+    """Context manager used by the framework hook points."""
+
+    __slots__ = ("name", "cat", "begin", "_ann")
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.begin = _now_us()
+        try:  # op names onto the XLA device timeline too
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        record_span(self.name, self.cat, self.begin, _now_us() - self.begin)
+        return False
+
+
+def op_span(name):
+    """Hook for ops/registry.invoke: a span when imperative profiling is
+    live, else a no-op context."""
+    if _active("imperative"):
+        return _Span(name, "operator")
+    return _NULL
+
+
+def symbolic_span(name):
+    if _active("symbolic"):
+        return _Span(name, "symbolic")
+    return _NULL
+
+
+class _Null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _Null()
+
+
+# -- public API (reference profiler.py surface) -----------------------------
+def set_config(**kwargs):
+    """Configure the profiler (reference :33).  Accepts the reference kwargs
+    (filename, profile_all, profile_symbolic, profile_imperative,
+    profile_memory, profile_api, aggregate_stats, continuous_dump) plus
+    ``tensorboard_dir`` for XLA device traces."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError("unknown profiler options: %s" % sorted(unknown))
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' or 'stop' (reference :151)."""
+    global _state
+    assert state in ("run", "stop"), state
+    if state == _state:
+        return
+    if state == "run":
+        _maybe_start_device_trace()
+    else:
+        _maybe_stop_device_trace()
+        if _config.get("continuous_dump"):
+            dump()
+    _state = state
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def pause(profile_process="worker"):
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+
+
+def state():
+    return _state
+
+
+_device_trace_on = False
+
+
+def _maybe_start_device_trace():
+    global _device_trace_on
+    tb = _config.get("tensorboard_dir")
+    if tb:
+        import jax
+        jax.profiler.start_trace(tb)
+        _device_trace_on = True
+
+
+def _maybe_stop_device_trace():
+    global _device_trace_on
+    if _device_trace_on:
+        import jax
+        jax.profiler.stop_trace()
+        _device_trace_on = False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to ``filename`` (reference :287,
+    Profiler::DumpProfile).  ``finished=True`` (default) drains the event
+    buffer so back-to-back profile sessions in one process don't
+    accumulate (aggregate stats are kept; reset those via dumps)."""
+    with _lock:
+        payload = {"traceEvents": list(_events),
+                   "displayTimeUnit": "ms"}
+        if finished:
+            _events.clear()
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate per-op stats table (reference :291 over
+    aggregate_stats.cc).  Requires ``set_config(aggregate_stats=True)``."""
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        out = ["%-40s %8s %12s %12s %12s %12s" %
+               ("Name", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)",
+                "Max(ms)")]
+        for name, (cnt, tot, mn, mx) in rows:
+            out.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+                       (name, cnt, tot / 1e3, tot / cnt / 1e3, mn / 1e3,
+                        mx / 1e3))
+        if reset:
+            _agg.clear()
+    return "\n".join(out)
+
+
+# -- object model (reference ProfileDomain/Task/Event/Counter/Frame) --------
+class ProfileDomain:
+    """Named grouping for profile objects (reference profiler.py Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+
+class Task:
+    """A named span tied to a domain; start()/stop() (reference Task)."""
+
+    _cat = "task"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._begin = None
+
+    def start(self):
+        self._begin = _now_us()
+
+    def stop(self):
+        assert self._begin is not None, "%s not started" % self.name
+        record_span("%s::%s" % (self.domain.name, self.name), self._cat,
+                    self._begin, _now_us() - self._begin)
+        self._begin = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Event(Task):
+    """Like Task but not domain-scoped per-thread (reference Event)."""
+
+    _cat = "event"
+
+    def __init__(self, name):
+        self.domain = ProfileDomain("event")
+        self.name = name
+        self._begin = None
+
+
+class Frame(Task):
+    """Repeating frame span, e.g. one per training iteration."""
+
+    _cat = "frame"
+
+
+class Counter:
+    """A named value tracked over time (reference Counter)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if _state != "run" or _paused:
+            return
+        _events.append({"name": "%s::%s" % (self.domain.name, self.name),
+                        "cat": "counter", "ph": "C", "ts": _now_us(),
+                        "pid": os.getpid(),
+                        "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    """Instant event (reference Marker.mark)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _state != "run" or _paused:
+            return
+        _events.append({"name": "%s::%s" % (self.domain.name, self.name),
+                        "cat": "marker", "ph": "i", "ts": _now_us(),
+                        "pid": os.getpid(), "s": scope[0]})

@@ -67,7 +67,10 @@ def _make_wrapper(opdef):
                     raise ValueError("cannot compose with grouped symbol")
                 inputs.append(a._outputs[0])
 
-        node = _Node(opdef, name, inputs, params)
+        from ..attribute import current_attrs
+
+        node = _Node(opdef, name, inputs, params,
+                     user_attrs=current_attrs() or None)
         return _single(node)
 
     creator.__name__ = opdef.name

@@ -392,6 +392,8 @@ def _single(node):
 
 def _apply(op_name, input_syms, attrs, name=None):
     """Compose: apply a registered op to symbols (reference _symbol_creator)."""
+    from ..attribute import current_attrs
+
     opdef = get_op(op_name)
     name = name or _NameManager.get(opdef.name.lower().lstrip("_"))
     inputs = []
@@ -399,14 +401,18 @@ def _apply(op_name, input_syms, attrs, name=None):
         if len(s._outputs) != 1:
             raise ValueError("cannot compose with a grouped symbol input")
         inputs.append(s._outputs[0])
-    node = _Node(opdef, name, inputs, attrs)
+    node = _Node(opdef, name, inputs, attrs,
+                 user_attrs=current_attrs() or None)
     return _single(node)
 
 
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     """Create a symbolic variable (reference symbol.py:2442)."""
-    ua = dict(attr or {})
+    from ..attribute import current_attrs
+
+    ua = dict(current_attrs())
+    ua.update(attr or {})
     if lr_mult is not None:
         ua["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:
