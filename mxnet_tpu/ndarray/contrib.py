@@ -135,3 +135,23 @@ def isnan(data):
 
 def isinf(data):
     return _wrap(jnp.isinf(data.data).astype(jnp.float32))
+
+
+# -- registry-backed contrib ops -------------------------------------------
+# Expose every `_contrib_*` registry op under its short name, mirroring the
+# reference's codegen of mx.nd.contrib.* from the C op registry.
+def _attach_registry_ops():
+    import sys
+
+    from ..ops.registry import OPS
+
+    parent = sys.modules[__package__]
+    mod = sys.modules[__name__]
+    for name, opdef in list(OPS.items()):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if not hasattr(mod, short):
+                setattr(mod, short, parent._make_op_func(short, opdef))
+
+
+_attach_registry_ops()

@@ -79,3 +79,23 @@ def det(a):
 def slogdet(a):
     sign, logdet = jnp.linalg.slogdet(a.data)
     return _wrap(sign), _wrap(logdet)
+
+
+# -- registry-backed linalg ops --------------------------------------------
+# Expose every `linalg_*` registry op under its short name (reference:
+# mx.nd.linalg.* codegen), without overriding the hand-written wrappers.
+def _attach_registry_ops():
+    import sys
+
+    from ..ops.registry import OPS
+
+    parent = sys.modules[__package__]
+    mod = sys.modules[__name__]
+    for name, opdef in list(OPS.items()):
+        if name.startswith("linalg_"):
+            short = name[len("linalg_"):]
+            if not hasattr(mod, short):
+                setattr(mod, short, parent._make_op_func(short, opdef))
+
+
+_attach_registry_ops()

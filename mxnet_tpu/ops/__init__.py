@@ -9,3 +9,7 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import image_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import spatial  # noqa: F401
+from . import contrib_ops  # noqa: F401
+from . import misc  # noqa: F401

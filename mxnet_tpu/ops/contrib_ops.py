@@ -1,0 +1,337 @@
+"""Contrib operators (reference: ``src/operator/contrib/`` — ROIAlign,
+bounding_box.cc box_nms/box_iou/bipartite_matching, adaptive_avg_pooling,
+bilinear_resize, boolean_mask, index_copy, index_array, quadratic_op, fft).
+
+Registered under the reference's ``_contrib_*`` internal names with public
+aliases so both ``mx.nd.contrib.*`` and symbol composition work.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (contrib/roi_align.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_ROIAlign", aliases=("ROIAlign",),
+          input_names=("data", "rois"))
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=2, position_sensitive=False, aligned=False):
+    """Average of bilinear samples per bin (Mask R-CNN ROIAlign)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    sr = max(int(sample_ratio), 1)
+    n, c, h, w = data.shape
+    off = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        dy, dx = y - y0, x - x0
+
+        def tap(yi, xi):
+            inside = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            return jnp.where(inside, img[:, yc, xc], 0.0)
+
+        return (tap(y0, x0) * (1 - dy) * (1 - dx) +
+                tap(y0, x0 + 1) * (1 - dy) * dx +
+                tap(y0 + 1, x0) * dy * (1 - dx) +
+                tap(y0 + 1, x0 + 1) * dy * dx)
+
+    if position_sensitive:
+        assert c % (ph * pw) == 0, \
+            "position_sensitive needs channels divisible by ph*pw"
+        c_out = c // (ph * pw)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        img = data[bi]
+        bins = []
+        for py in range(ph):
+            for px in range(pw):
+                if position_sensitive:
+                    # PSROIAlign (R-FCN): bin (py,px) pools its own
+                    # channel group, output has C/(ph*pw) channels
+                    src = img.reshape(c_out, ph, pw, h, w)[:, py, px]
+                else:
+                    src = img
+                acc = 0.0
+                for iy in range(sr):
+                    for ix in range(sr):
+                        y = y1 + (py + (iy + 0.5) / sr) * rh / ph
+                        x = x1 + (px + (ix + 0.5) / sr) * rw / pw
+                        acc = acc + bilinear(src, y, x)
+                bins.append(acc / (sr * sr))
+        oc = c_out if position_sensitive else c
+        return jnp.stack(bins, axis=1).reshape(oc, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Bounding boxes (contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+def _iou_corner(a, b):
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def _box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        def c2c(b):
+            xy = b[..., :2]
+            wh = b[..., 2:4] / 2
+            return jnp.concatenate([xy - wh, xy + wh], -1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    la = lhs[..., :, None, :]
+    rb = rhs[..., None, :, :]
+    return _iou_corner(jnp.broadcast_to(la, la.shape[:-3] +
+                                        (la.shape[-3], rb.shape[-2], 4)),
+                       jnp.broadcast_to(rb, rb.shape[:-3] +
+                                        (la.shape[-3], rb.shape[-2], 4)))
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), no_grad=True)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             background_id=-1, force_suppress=False, in_format="corner",
+             out_format="corner"):
+    """Greedy NMS (contrib/bounding_box.cc): suppressed boxes get score -1,
+    output keeps the input layout sorted by score like the reference."""
+    shape = data.shape
+    boxes2d = data.reshape((-1,) + shape[-2:])  # (B, N, K)
+
+    def one_batch(b):
+        scores = b[:, score_index]
+        n = b.shape[0]
+        order = jnp.argsort(-scores)
+        b_sorted = b[order]
+        s = b_sorted[:, score_index]
+        valid = s > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(n) < topk)
+        if id_index >= 0 and background_id >= 0:
+            # reference: background-class boxes never survive NMS
+            valid = valid & (b_sorted[:, id_index] != background_id)
+        coords = jax.lax.dynamic_slice_in_dim(b_sorted, coord_start, 4,
+                                              axis=1)
+        if in_format == "center":
+            xy = coords[:, :2]
+            wh = coords[:, 2:4] / 2
+            coords = jnp.concatenate([xy - wh, xy + wh], -1)
+        ious = _iou_corner(coords[:, None, :], coords[None, :, :])
+        same_class = jnp.ones((n, n), bool)
+        if not force_suppress and id_index >= 0:
+            ids = b_sorted[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+
+        def body(i, keep):
+            sup = (ious[i] > overlap_thresh) & same_class[i] & \
+                (jnp.arange(n) > i) & keep[i] & valid
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, valid)
+        new_scores = jnp.where(keep, s, -1.0)
+        out_b = b_sorted.at[:, score_index].set(new_scores)
+        if out_format != in_format:
+            if out_format == "center":
+                ctr = jnp.concatenate([(coords[:, :2] + coords[:, 2:4]) / 2,
+                                       coords[:, 2:4] - coords[:, :2]], -1)
+            else:  # center -> corner (coords already corner-converted)
+                ctr = coords
+            out_b = jax.lax.dynamic_update_slice_in_dim(
+                out_b, ctr, coord_start, axis=1)
+        return out_b
+
+    out = jax.vmap(one_batch)(boxes2d)
+    return out.reshape(shape)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          num_outputs=2, no_grad=True)
+def _bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a (B, N, M) score matrix; returns
+    (row_match (B,N), col_match (B,M)) like the reference."""
+    shape = data.shape
+    d = data.reshape((-1,) + shape[-2:])
+
+    def one(mat):
+        n, m = mat.shape
+        k = min(n, m) if topk <= 0 else min(topk, n, m)
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(_, state):
+            mat_, rows, cols = state
+            flat = jnp.argmin(mat_) if is_ascend else jnp.argmax(mat_)
+            i, j = flat // m, flat % m
+            v = mat_[i, j]
+            ok = (v <= threshold) if is_ascend else (v >= threshold)
+            rows = jnp.where(ok & (rows[i] < 0), rows.at[i].set(j), rows)
+            cols = jnp.where(ok & (cols[j] < 0), cols.at[j].set(i), cols)
+            mat_ = mat_.at[i, :].set(big).at[:, j].set(big)
+            return mat_, rows, cols
+
+        rows = -jnp.ones((n,), jnp.float32)
+        cols = -jnp.ones((m,), jnp.float32)
+        _, rows, cols = jax.lax.fori_loop(0, k, body, (mat, rows, cols))
+        return rows, cols
+
+    rows, cols = jax.vmap(one)(d)
+    return (rows.reshape(shape[:-1]),
+            cols.reshape(shape[:-2] + (shape[-1],)))
+
+
+# ---------------------------------------------------------------------------
+# Adaptive pooling / bilinear resize (contrib/adaptive_avg_pooling.cc,
+# bilinear_resize.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def _adaptive_avg_pool(data, output_size=(1, 1)):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = int(output_size[0]), int(output_size[1])
+    n, c, h, w = data.shape
+    out = jnp.zeros((n, c, oh, ow), data.dtype)
+    for py in range(oh):
+        y0, y1 = (py * h) // oh, -(-((py + 1) * h) // oh)
+        for px in range(ow):
+            x0, x1 = (px * w) // ow, -(-((px + 1) * w) // ow)
+            out = out.at[:, :, py, px].set(
+                data[:, :, y0:y1, x0:x1].mean(axis=(2, 3)))
+    return out
+
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def _bilinear_resize(data, height=1, width=1, scale_height=None,
+                     scale_width=None, mode="size"):
+    if mode != "size":
+        raise NotImplementedError(
+            "BilinearResize2D mode=%r is not supported (only 'size'); "
+            "compute the target size explicitly" % mode)
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        if scale_width is None:
+            scale_width = scale_height
+        height = int(round(h * scale_height))
+        width = int(round(w * scale_width))
+    oh, ow = int(height), int(width)
+    # align_corners=True coordinate map (reference/PyTorch convention)
+    ys = jnp.linspace(0, h - 1, oh, dtype=data.dtype)
+    xs = jnp.linspace(0, w - 1, ow, dtype=data.dtype)
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    dy = (ys - y0)[None, None, :, None]
+    dx = (xs - x0)[None, None, None, :]
+    yi0, xi0 = y0.astype(jnp.int32), x0.astype(jnp.int32)
+    yi1, xi1 = y1.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = data[:, :, yi0][:, :, :, xi0]
+    v01 = data[:, :, yi0][:, :, :, xi1]
+    v10 = data[:, :, yi1][:, :, :, xi0]
+    v11 = data[:, :, yi1][:, :, :, xi1]
+    return (v00 * (1 - dy) * (1 - dx) + v01 * (1 - dy) * dx +
+            v10 * dy * (1 - dx) + v11 * dy * dx)
+
+
+# ---------------------------------------------------------------------------
+# boolean_mask / index ops (contrib/boolean_mask.cc, index_copy.cc,
+# index_array.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_boolean_mask", aliases=("boolean_mask",),
+          cacheable=False, no_grad=True)
+def _boolean_mask(data, index, axis=0):
+    """Select rows where index != 0.  Output shape is data-dependent, so
+    this op is host-evaluated (XLA needs static shapes — the documented
+    dynamic-shape hard part, SURVEY.md §7(a))."""
+    import numpy as np
+
+    mask = np.asarray(index) != 0
+    return jnp.asarray(np.compress(mask, np.asarray(data), axis=axis))
+
+
+@register("_contrib_index_copy", aliases=("index_copy",),
+          input_names=("old_tensor", "index_vector", "new_tensor"))
+def _index_copy(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("_contrib_index_array", aliases=("index_array",), no_grad=True)
+def _index_array(data, axes=None):
+    shp = data.shape
+    axes = tuple(range(len(shp))) if axes is None else tuple(axes)
+    planes = []
+    for a in axes:  # caller's order defines the last-dim coordinate order
+        view = [1] * len(shp)
+        view[a] = shp[a]
+        planes.append(jnp.broadcast_to(
+            jnp.arange(shp[a]).reshape(view), shp))
+    return jnp.stack(planes, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# quadratic (contrib/quadratic_op.cc — the tutorial op) + fft
+# ---------------------------------------------------------------------------
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    return a * data * data + b * data + c
+
+
+@register("_contrib_fft", aliases=("fft",))
+def _fft(data, compute_size=128):
+    """FFT along the last axis; output interleaves real/imag (reference
+    contrib/fft.cc output convention: last dim doubled)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (data.shape[-1] * 2,)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    ri = data.reshape(data.shape[:-1] + (n, 2))
+    comp = ri[..., 0] + 1j * ri[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("_contrib_allclose", aliases=("allclose",), no_grad=True)
+def _allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("_contrib_arange_like", aliases=("arange_like",), no_grad=True)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    rep = max(int(repeat), 1)
+
+    def seq(n):
+        vals = jnp.arange(n, dtype=data.dtype) // rep
+        return start + step * vals.astype(data.dtype)
+
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        return seq(n).reshape(data.shape)
+    return seq(data.shape[axis])

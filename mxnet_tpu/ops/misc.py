@@ -1,0 +1,59 @@
+"""Remaining core-op stragglers (reference: ``src/operator/nn/group_norm*``,
+``mshadow_op.h`` scalar zoo entries, ``tensor/ravel.cc``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+@register("GroupNorm", input_names=("data", "gamma", "beta"))
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5,
+                output_mean_var=False):
+    n, c = data.shape[0], data.shape[1]
+    g = int(num_groups)
+    x = data.reshape((n, g, c // g) + data.shape[2:])
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    xn = ((x - mean) / jnp.sqrt(var + eps)).reshape(data.shape)
+    shape = (1, c) + (1,) * (data.ndim - 2)
+    out = xn * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, mean.reshape(n, g), var.reshape(n, g)
+    return out
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("digamma")
+def _digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register("ravel_multi_index", no_grad=True)
+def _ravel_multi_index(data, shape=None):
+    """(ndim, N) indices -> (N,) flat indices (tensor/ravel.cc)."""
+    import numpy as np
+
+    strides = np.cumprod([1] + list(shape[::-1]))[:-1][::-1]
+    return (data * jnp.asarray(strides, data.dtype)[:, None]).sum(axis=0)
+
+
+@register("unravel_index", no_grad=True)
+def _unravel_index(data, shape=None):
+    """(N,) flat indices -> (ndim, N) coordinates (tensor/ravel.cc)."""
+    import numpy as np
+
+    strides = np.cumprod([1] + list(shape[::-1]))[:-1][::-1]
+    out = []
+    rem = data.astype(jnp.int64)
+    for s, dim in zip(strides, shape):
+        out.append((rem // int(s)) % int(dim))
+    return jnp.stack(out).astype(data.dtype)

@@ -1,0 +1,223 @@
+"""Spatial / warping operators (reference: ``src/operator/upsampling.cc``,
+``grid_generator.cc``, ``bilinear_sampler.cc``, ``spatial_transformer.cc``,
+``roi_pooling.cc``, ``crop.cc``, plus MakeLoss/SVMOutput glue ops).
+
+TPU-native: everything is expressed as gather + weighted sums over static
+shapes, which XLA fuses; there are no hand-written CUDA kernels to port.
+Layout is NCHW at the API (reference parity); grids use the reference's
+normalized [-1, 1] coordinate convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# UpSampling (upsampling.cc)
+# ---------------------------------------------------------------------------
+@register("UpSampling", input_names=("data",))
+def _upsampling(data, scale=2, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=None):
+    """Nearest repeats pixels; bilinear resizes (the reference's bilinear
+    mode is a fixed-init Deconvolution — the interpolation result is
+    identical for the default bilinear kernel)."""
+    n, c, h, w = data.shape
+    scale = int(scale)
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        return out
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "linear")
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator (grid_generator.cc)
+# ---------------------------------------------------------------------------
+def _base_grid(h, w, dtype):
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return gx, gy  # (H, W) each
+
+
+@register("GridGenerator", input_names=("data",))
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: (N, 6) params -> (N, 2, H, W) sampling grid.
+    warp: (N, 2, H, W) flow -> normalized grid (reference semantics)."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        gx, gy = _base_grid(h, w, data.dtype)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3,HW)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # (N, 2, HW)
+        return out.reshape(-1, 2, h, w)
+    # warp: flow field in pixels added to the identity grid
+    n, _, h, w = data.shape
+    gx, gy = _base_grid(h, w, data.dtype)
+    fx = data[:, 0] * 2.0 / max(w - 1, 1)
+    fy = data[:, 1] * 2.0 / max(h - 1, 1)
+    return jnp.stack([gx[None] + fx, gy[None] + fy], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler (bilinear_sampler.cc)
+# ---------------------------------------------------------------------------
+def _bilinear_sample_one(img, gx, gy):
+    """img (C, H, W); gx/gy (Ho, Wo) in [-1, 1]; zero padding outside."""
+    c, h, w = img.shape
+    x = (gx + 1.0) * (w - 1) / 2.0
+    y = (gy + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    dx = x - x0
+    dy = y - y0
+
+    def tap(yi, xi):
+        inside = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(inside[None], v, 0.0)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wx0, wx1 = (1 - dx)[None], dx[None]
+    wy0, wy1 = (1 - dy)[None], dy[None]
+    return v00 * wy0 * wx0 + v01 * wy0 * wx1 + \
+        v10 * wy1 * wx0 + v11 * wy1 * wx1
+
+
+@register("BilinearSampler", input_names=("data", "grid"))
+def _bilinear_sampler(data, grid, cudnn_off=None):
+    return jax.vmap(_bilinear_sample_one)(data, grid[:, 0], grid[:, 1])
+
+
+@register("SpatialTransformer", input_names=("data", "loc"))
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=None):
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling (roi_pooling.cc)
+# ---------------------------------------------------------------------------
+@register("ROIPooling", input_names=("data", "rois"))
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI into a (ph, pw) grid (reference roi_pooling.cc;
+    rois are (R, 5) [batch_idx, x1, y1, x2, y2] in image coordinates)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        img = data[bi]  # (C, H, W)
+        outs = []
+        for py in range(ph):
+            for px in range(pw):
+                ys0 = jnp.floor(y1 + py * rh / ph)
+                ye = jnp.ceil(y1 + (py + 1) * rh / ph)
+                xs0 = jnp.floor(x1 + px * rw / pw)
+                xe = jnp.ceil(x1 + (px + 1) * rw / pw)
+                mask = ((ys >= ys0) & (ys < ye))[:, None] & \
+                       ((xs >= xs0) & (xs < xe))[None, :]
+                v = jnp.where(mask[None], img, -jnp.inf).max(axis=(1, 2))
+                outs.append(jnp.where(jnp.isfinite(v), v, 0.0))
+        return jnp.stack(outs, axis=1).reshape(c, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Crop (crop.cc) — crop data to match a reference symbol's spatial size
+# ---------------------------------------------------------------------------
+@register("Crop", input_names=("data", "crop_like"))
+def _crop(data, crop_like=None, offset=(0, 0), h_w=(0, 0),
+          num_args=1, center_crop=False):
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# Loss glue ops (make_loss.cc, svm_output.cc)
+# ---------------------------------------------------------------------------
+from jax import custom_vjp as _custom_vjp
+
+
+@register("MakeLoss", input_names=("data",))
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null"):
+    """Identity forward whose backward is grad_scale (reference
+    make_loss.cc: turns any symbol into a loss head)."""
+    @_custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        if normalization == "batch":
+            scale = grad_scale / x.shape[0]
+        elif normalization == "valid":
+            # reference: divide by the count of entries above valid_thresh
+            n_valid = jnp.maximum((x > valid_thresh).sum(), 1)
+            scale = grad_scale / n_valid.astype(x.dtype)
+        else:
+            scale = grad_scale
+        return (jnp.broadcast_to(jnp.asarray(scale, x.dtype), x.shape),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("SVMOutput", input_names=("data", "label"))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """Hinge-loss output head (svm_output.cc): forward is identity on
+    scores; backward applies the (squared) hinge gradient."""
+    @_custom_vjp
+    def f(x, lab):
+        return x
+
+    def fwd(x, lab):
+        return x, (x, lab)
+
+    def bwd(res, g):
+        x, lab = res
+        k = x.shape[1]
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), k, dtype=x.dtype)
+        # one-vs-all hinge: target +1 for the true class, -1 otherwise
+        viol = jnp.maximum(0.0, margin - (2 * onehot - 1) * x)
+        if use_linear:
+            grad = jnp.where(viol > 0, -(2 * onehot - 1), 0.0)
+        else:
+            grad = -2.0 * viol * (2 * onehot - 1)
+        return (grad * regularization_coefficient, jnp.zeros_like(lab))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)

@@ -150,3 +150,21 @@ def cond(pred, then_func, else_func, name="cond"):
     outs = [res[i] for i in range(len(flat_t))]
     o, _ = _regroup(outs, t_fmt)
     return o
+
+
+# -- registry-backed contrib ops -------------------------------------------
+def _attach_registry_ops():
+    import sys
+
+    from ..ops.registry import OPS
+    from .register import _make_wrapper
+
+    mod = sys.modules[__name__]
+    for name, opdef in list(OPS.items()):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if not hasattr(mod, short):
+                setattr(mod, short, _make_wrapper(opdef))
+
+
+_attach_registry_ops()
