@@ -47,3 +47,4 @@ from . import callback  # noqa: F401
 from . import predict  # noqa: F401
 from . import image  # noqa: F401
 from . import profiler  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,3 @@
+"""Contrib namespace (reference: ``python/mxnet/contrib/``)."""
+from . import quantization  # noqa: F401
+from .quantization import quantize_model  # noqa: F401

@@ -1,0 +1,283 @@
+"""Post-training INT8 quantization driver (reference:
+``python/mxnet/contrib/quantization.py`` over
+``src/operator/quantization/`` — graph rewrite + calibration).
+
+Pipeline (reference ``quantize_model``):
+1. pick quantizable nodes (Convolution / FullyConnected, minus exclusions);
+2. calibrate the fp32 model on sample data, recording each quantized
+   input's representable range — ``naive`` min/max or ``entropy``
+   (KL-divergence optimal threshold, reference ``_get_optimal_threshold`` /
+   ``calibrate.cc``);
+3. rewrite the graph: ``quantize_v2`` (with calibrated ranges) feeding
+   int8 kernels, ``dequantize`` back to fp32 after each quantized op;
+   weights are quantized offline into the returned ``qarg_params``.
+
+TPU-native: the int8 kernels run on the MXU with int32 accumulation
+(``ops/quantization.py``); there is no cuDNN/MKLDNN backend split.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..symbol.symbol import Symbol, Variable, _Node
+
+_QUANTIZABLE = {"Convolution", "FullyConnected"}
+
+__all__ = ["quantize_model", "_get_optimal_threshold"]
+
+
+def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence-minimizing saturation threshold (reference
+    contrib/quantization.py _get_optimal_threshold)."""
+    arr = np.asarray(arr).ravel()
+    max_abs = float(np.max(np.abs(arr))) or 1e-8
+    hist, edges = np.histogram(arr, bins=num_bins, range=(-max_abs, max_abs))
+    return _optimal_threshold_from_hist(hist, edges,
+                                        num_quantized_bins)
+
+
+def _optimal_threshold_from_hist(hist, edges, num_quantized_bins=255):
+    num_bins = len(hist)
+    hist = hist.astype(np.float64)
+    zero = num_bins // 2
+    best_kl, best_thr = np.inf, float(edges[-1])
+    for i in range(num_quantized_bins // 2 + 1, zero + 1, 16):
+        thr = edges[zero + i]
+        sliced = hist[zero - i:zero + i].copy()
+        # p: clipped distribution — outlier mass folds into the edge bins
+        p = sliced.copy()
+        p[0] += hist[:zero - i].sum()
+        p[-1] += hist[zero + i:].sum()
+        if p.sum() == 0:
+            continue
+        # q: int8-quantized rendering of the in-range histogram only —
+        # the clipped mass is NOT representable, which is what makes
+        # aggressive clipping expensive (reference calibrate.cc /
+        # contrib/quantization.py _get_optimal_threshold)
+        n = len(sliced)
+        factor = n / num_quantized_bins
+        q = np.zeros(n)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = max(int((j + 1) * factor), lo + 1)
+            seg = sliced[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
+        # smooth: spread tiny eps onto zero bins so KL stays finite
+        eps = 1e-4
+        pp = p / p.sum()
+        qq = q / q.sum() if q.sum() else q
+        pp = (1 - eps) * pp + eps / n
+        qq = (1 - eps) * qq + eps / n
+        kl = np.sum(pp * np.log(pp / qq))
+        if kl < best_kl:
+            best_kl, best_thr = kl, thr
+    return best_thr
+
+
+def _node_key(node, oi):
+    return (id(node), oi)
+
+
+def _collect_calibration(sym, arg_params, aux_params, calib_data,
+                         entries, calib_mode, num_calib_examples, ctx,
+                         num_bins=8001):
+    """Run the fp32 graph on calibration batches and return
+    {entry_key: (min, max)} for the requested graph entries.
+
+    Reductions are streaming (running min/max per batch; for entropy a
+    second pass accumulates fixed-range histograms) so host memory stays
+    O(entries), not O(activations) — reference collector semantics."""
+    group = Symbol([e for e in entries])
+    data_desc = calib_data.provide_data
+    shapes = {d.name: tuple(d.shape) for d in data_desc}
+    exe = group.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    exe.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+
+    def batches():
+        seen = 0
+        calib_data.reset()
+        for batch in calib_data:
+            feed = {d.name: v for d, v in zip(data_desc, batch.data)}
+            yield exe.forward(is_train=False, **feed)
+            seen += batch.data[0].shape[0]
+            if num_calib_examples is not None and \
+                    seen >= num_calib_examples:
+                return
+
+    # pass 1: running min/max
+    mins = np.full(len(entries), np.inf)
+    maxs = np.full(len(entries), -np.inf)
+    for outs in batches():
+        for i, o in enumerate(outs):
+            a = o.asnumpy()
+            mins[i] = min(mins[i], float(a.min()))
+            maxs[i] = max(maxs[i], float(a.max()))
+
+    if calib_mode == "naive":
+        return {_node_key(*e): (mins[i], maxs[i])
+                for i, e in enumerate(entries)}
+
+    # pass 2 (entropy): fixed-range histograms, then KL thresholds
+    abs_max = np.maximum(np.abs(mins), np.abs(maxs))
+    hists = [np.zeros(num_bins) for _ in entries]
+    for outs in batches():
+        for i, o in enumerate(outs):
+            h, _ = np.histogram(o.asnumpy().ravel(), bins=num_bins,
+                                range=(-abs_max[i], abs_max[i]))
+            hists[i] += h
+    ranges = {}
+    for i, e in enumerate(entries):
+        edges = np.linspace(-abs_max[i], abs_max[i], num_bins + 1)
+        thr = _optimal_threshold_from_hist(hists[i], edges)
+        ranges[_node_key(*e)] = (-thr, thr)
+    return ranges
+
+
+def _quantize_weight(w):
+    arr = w.asnumpy() if hasattr(w, "asnumpy") else np.asarray(w)
+    thr = max(abs(float(arr.min())), abs(float(arr.max())), 1e-10)
+    scale = thr / 127.0
+    q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+    return q, -thr, thr
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=(), calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a model (reference contrib/quantization.py:quantize_model).
+
+    Returns ``(qsym, qarg_params, aux_params)``.
+    """
+    logger = logger or logging.getLogger(__name__)
+    if quantized_dtype != "int8":
+        raise ValueError("only int8 is supported")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise ValueError("calib_mode must be none/naive/entropy")
+    excluded = set(excluded_sym_names)
+
+    topo = sym._topo()
+
+    def _quantizable(n):
+        if n.is_var or n.op.name not in _QUANTIZABLE \
+                or n.name in excluded:
+            return False
+        # weight (and bias) must be plain Variables with known params —
+        # computed weights (weight tying through expressions, masking…)
+        # stay fp32 (reference behavior: such nodes are excluded)
+        for e in n.inputs[1:]:
+            if not e[0].is_var or e[0].name not in arg_params:
+                logger.warning(
+                    "not quantizing %s: input %r is not a parameter "
+                    "Variable", n.name, e[0].name)
+                return False
+        return True
+
+    quant_nodes = [n for n in topo if _quantizable(n)]
+
+    # -- calibration: ranges of each quantized op's data input -----------
+    calib_entries = []
+    for n in quant_nodes:
+        src = n.inputs[0]  # (node, oi) feeding `data`
+        if src not in calib_entries:
+            calib_entries.append(src)
+    ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_data is required for calib_mode=%r"
+                             % calib_mode)
+        ranges = _collect_calibration(sym, arg_params, aux_params,
+                                      calib_data, calib_entries,
+                                      calib_mode, num_calib_examples, ctx)
+
+    # -- graph rewrite ----------------------------------------------------
+    from ..ops.registry import get_op
+
+    qarg_params = dict(arg_params)
+    mapped = {}   # id(old node) -> new node
+    q_cache = {}  # entry key -> (qsym_node, min_node, max_node)
+
+    def map_entry(e):
+        node, oi = e
+        return (mapped[id(node)], oi)
+
+    def const_var(name, value):
+        qarg_params[name] = nd.array(np.float32(value).reshape(1))
+        return Variable(name, shape=(1,))._outputs[0][0]
+
+    for node in topo:
+        if node.is_var:
+            mapped[id(node)] = node
+            continue
+        if node in quant_nodes:
+            data_e = node.inputs[0]
+            key = _node_key(data_e[0], data_e[1])
+            # quantize the activation input (cached across consumers)
+            if key not in q_cache:
+                if key in ranges:
+                    mn, mx = ranges[key]
+                    attrs = {"min_calib_range": float(mn),
+                             "max_calib_range": float(mx)}
+                else:
+                    attrs = {}
+                qn = _Node(get_op("_contrib_quantize_v2"),
+                           node.name + "_data_quantize",
+                           [map_entry(data_e)], attrs)
+                q_cache[key] = qn
+            qn = q_cache[key]
+            # offline-quantize the weight (and bias) params
+            # offline-quantized params live under fresh `_quantize` names
+            # so an fp32 consumer sharing the original Variable (weight
+            # tying, excluded twin layer) keeps its fp32 values
+            w_name = node.inputs[1][0].name
+            qw, wmin, wmax = _quantize_weight(arg_params[w_name])
+            qw_name = w_name + "_quantize"
+            qarg_params[qw_name] = nd.array(qw)
+            qw_var = Variable(qw_name, shape=qw.shape)._outputs[0][0]
+            # input layout of the quantized ops:
+            # (data, weight, min_data, max_data, min_w, max_w[, bias,
+            #  min_b, max_b]) — bias group last so no_bias stays positional
+            ins = [(qn, 0), (qw_var, 0),
+                   (qn, 1), (qn, 2),
+                   (const_var(node.name + "_wmin", wmin), 0),
+                   (const_var(node.name + "_wmax", wmax), 0)]
+            no_bias = len(node.inputs) < 3 or \
+                str(node.attrs.get("no_bias", False)) in ("True", "1")
+            if not no_bias:
+                b_name = node.inputs[2][0].name
+                qb, bmin, bmax = _quantize_weight(arg_params[b_name])
+                qb_name = b_name + "_quantize"
+                qarg_params[qb_name] = nd.array(qb)
+                qb_var = Variable(qb_name, shape=qb.shape)._outputs[0][0]
+                ins += [(qb_var, 0),
+                        (const_var(node.name + "_bmin", bmin), 0),
+                        (const_var(node.name + "_bmax", bmax), 0)]
+            qop = "_contrib_quantized_conv" if node.op.name == \
+                "Convolution" else "_contrib_quantized_fully_connected"
+            attrs = dict(node.attrs)
+            if no_bias:
+                attrs["no_bias"] = True
+            qnode = _Node(get_op(qop), node.name + "_quantized", ins,
+                          attrs)
+            deq = _Node(get_op("_contrib_dequantize"),
+                        node.name + "_dequantize",
+                        [(qnode, 0), (qnode, 1), (qnode, 2)], {})
+            mapped[id(node)] = deq
+        else:
+            new = _Node(node.op, node.name,
+                        [map_entry(e) for e in node.inputs],
+                        dict(node.attrs), user_attrs=dict(node.user_attrs)
+                        if node.user_attrs else None)
+            mapped[id(node)] = new
+
+    replaced = {id(n) for n in quant_nodes}
+    qsym = Symbol([(mapped[id(n)], 0 if id(n) in replaced else oi)
+                   for n, oi in sym._outputs])
+    logger.info("quantized %d nodes (%s calibration)",
+                len(quant_nodes), calib_mode)
+    return qsym, qarg_params, aux_params

@@ -215,6 +215,16 @@ class Executor:
                                                         None))
         else:
             raise ValueError(kind)
+        # pin execution to the bound context's device: without this a
+        # cpu()-bound executor on a TPU host runs under the default (TPU)
+        # device and its outputs silently migrate the arg arrays there
+        dev = self._ctx.jax_device()
+        inner = f
+
+        def f(*a, _inner=inner, _dev=dev):
+            with jax.default_device(_dev):
+                return _inner(*a)
+
         self._fn_cache[key] = f
         return f
 

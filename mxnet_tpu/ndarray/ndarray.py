@@ -41,7 +41,22 @@ class NDArray:
         return self._data
 
     def _set_data(self, value):
-        """In-place mutation: swap the backing array (bumps the 'version')."""
+        """In-place mutation: swap the backing array (bumps the 'version').
+
+        Enforces the context invariant: a cpu()-bound array on a TPU host
+        must not silently migrate to the accelerator when a default-device
+        computation's result is written into it (and vice versa).  Sharded
+        (multi-device) values and tracers pass through untouched.
+        """
+        try:
+            devs = value.devices()
+            if len(devs) == 1:
+                tgt = self._ctx.jax_device()
+                (d,) = devs
+                if d != tgt:
+                    value = jax.device_put(value, tgt)
+        except Exception:
+            pass  # numpy input, tracer, or abstract value
         self._data = value
         self._tape_entry = None  # a mutated array is a fresh tape leaf
 

@@ -1,0 +1,177 @@
+"""INT8 quantization operators (reference: ``src/operator/quantization/`` —
+quantize_v2, dequantize, requantize, quantized_conv, quantized_fully_connected,
+quantized_pooling, quantized_flatten).
+
+TPU-native: int8 matmul/conv lower to the MXU with int32 accumulation
+(``preferred_element_type``) — the XLA analogue of the reference's cuDNN/
+MKLDNN int8 kernels.  Quantization is symmetric per-tensor (scale =
+max(|min|,|max|)/127, zero-point 0), matching the reference's
+``kQuantizeSymmetric`` path for weights and the int8 data path the
+calibration driver produces.
+
+Each quantized op follows the reference's 3-output convention:
+``(quantized_out, min_out, max_out)`` carrying the represented real range.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+INT8_MAX = 127.0
+INT32_MAX = 2147483647.0
+
+
+def _scale(mn, mx, qmax=INT8_MAX):
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)),
+                       1e-10) / qmax
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",), no_grad=True,
+          num_outputs=3)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """fp32 -> int8 (quantize_v2-inl.h).  Without calib ranges the range
+    is computed from the data (the reference's online path)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = data.min()
+        mx = data.max()
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(data / s), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    r = s * INT8_MAX
+    return q, -r, r
+
+
+@register("_contrib_dequantize", aliases=("dequantize",), no_grad=True)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 (or int32 accumulator) -> fp32.  min/max describe the real
+    range represented by the extreme quantized value of `data`'s dtype."""
+    qmax = INT8_MAX if data.dtype == jnp.int8 else INT32_MAX
+    return data.astype(jnp.float32) * _scale(min_range, max_range, qmax)
+
+
+@register("_contrib_requantize", aliases=("requantize",), no_grad=True,
+          num_outputs=3)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, out_type="int8"):
+    """int32 -> int8 (requantize-inl.h): rescale the int32 accumulator
+    range onto int8."""
+    real = data.astype(jnp.float32) * _scale(min_range, max_range,
+                                             INT32_MAX)
+    if min_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = real.min()
+        mx = real.max()
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(real / s), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    r = s * INT8_MAX
+    return q, -r, r
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), no_grad=True,
+          num_outputs=3,
+          input_names=("data", "weight", "min_data", "max_data",
+                       "min_weight", "max_weight", "bias", "min_bias",
+                       "max_bias"))
+def _quantized_fc(data, weight, min_data, max_data, min_weight,
+                  max_weight, bias=None, min_bias=None, max_bias=None,
+                  num_hidden=None, no_bias=False, flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU (quantized_fully_connected.cc)."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(data, weight,
+                          (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    sd = _scale(min_data, max_data)
+    sw = _scale(min_weight, max_weight)
+    out_scale = sd * sw
+    if not no_bias and bias is not None:
+        # bias arrives int8 with its own scale; rescale into the
+        # accumulator's scale (reference shifts bias likewise)
+        sb = _scale(min_bias, max_bias)
+        b32 = jnp.round(bias.astype(jnp.float32) * sb / out_scale) \
+            .astype(jnp.int32)
+        out = out + b32
+    r = out_scale * INT32_MAX
+    return out, -r, r
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",),
+          no_grad=True, num_outputs=3,
+          input_names=("data", "weight", "min_data", "max_data",
+                       "min_weight", "max_weight", "bias", "min_bias",
+                       "max_bias"))
+def _quantized_conv(data, weight, min_data, max_data, min_weight,
+                    max_weight, bias=None, min_bias=None, max_bias=None,
+                    kernel=(),
+                    stride=(), dilate=(), pad=(), num_filter=1, num_group=1,
+                    no_bias=False, layout=None, cudnn_tune=None,
+                    cudnn_off=False, workspace=1024):
+    n = len(kernel)
+    stride = tuple(stride) or (1,) * n
+    dilate = tuple(dilate) or (1,) * n
+    pad = tuple(pad) or (0,) * n
+    spatial = "DHW"[-n:]
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sd = _scale(min_data, max_data)
+    sw = _scale(min_weight, max_weight)
+    out_scale = sd * sw
+    if not no_bias and bias is not None:
+        sb = _scale(min_bias, max_bias)
+        b32 = jnp.round(bias.astype(jnp.float32) * sb / out_scale) \
+            .astype(jnp.int32)
+        out = out + b32.reshape((1, -1) + (1,) * n)
+    r = out_scale * INT32_MAX
+    return out, -r, r
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          no_grad=True, num_outputs=3,
+          input_names=("data", "min_data", "max_data"))
+def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                       stride=(), pad=(), global_pool=False,
+                       pooling_convention="valid", count_include_pad=True,
+                       cudnn_off=False):
+    """Pooling commutes with quantization (same scale in/out)."""
+    from .nn import _pooling
+
+    if pool_type == "avg":
+        # average in int32 then round back to int8
+        out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                       pool_type=pool_type, stride=stride, pad=pad,
+                       global_pool=global_pool,
+                       pooling_convention=pooling_convention,
+                       count_include_pad=count_include_pad)
+        out = jnp.clip(jnp.round(out), -INT8_MAX, INT8_MAX) \
+            .astype(jnp.int8)
+    else:
+        out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                       pool_type=pool_type, stride=stride, pad=pad,
+                       global_pool=global_pool,
+                       pooling_convention=pooling_convention,
+                       count_include_pad=count_include_pad) \
+            .astype(jnp.int8)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          no_grad=True, num_outputs=3,
+          input_names=("data", "min_data", "max_data"))
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data

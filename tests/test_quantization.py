@@ -1,0 +1,163 @@
+"""INT8 quantization tests (reference: tests/python/quantization/
+test_quantization.py — op-level int8 checks + quantize_model flow)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.quantization import (_get_optimal_threshold,
+                                            quantize_model)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.RandomState(0).uniform(-3, 3, (4, 5)).astype(np.float32)
+    q, mn, mx_ = nd.contrib.quantize_v2(nd.array(x))
+    assert q.asnumpy().dtype == np.int8
+    back = nd.contrib.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_with_calib_range():
+    x = np.array([[-1.0, 0.5, 2.0]], dtype=np.float32)
+    q, mn, mx_ = nd.contrib.quantize_v2(nd.array(x), min_calib_range=-2.0,
+                                        max_calib_range=2.0)
+    np.testing.assert_allclose(q.asnumpy(), [[-64, 32, 127]])
+    np.testing.assert_allclose(mn.asnumpy(), -2.0, rtol=1e-6)
+
+
+def test_requantize():
+    acc = np.array([[1 << 20, -(1 << 21)]], dtype=np.int32)
+    q, mn, mx_ = nd.contrib.requantize(
+        nd.array(acc.astype(np.float32)).astype("int32"),
+        nd.array(np.float32([-1.0])), nd.array(np.float32([1.0])))
+    assert q.asnumpy().dtype == np.int8
+    # ratio preserved (~ -2x)
+    v = q.asnumpy().astype(np.float64)
+    assert abs(v[0, 1] / v[0, 0] + 2.0) < 0.05
+
+
+def test_quantized_fc_matches_fp32():
+    r = np.random.RandomState(1)
+    x = r.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = r.uniform(-1, 1, (4, 16)).astype(np.float32)
+    b = r.uniform(-1, 1, (4,)).astype(np.float32)
+    ref = x @ w.T + b
+
+    def q(arr):
+        thr = np.abs(arr).max()
+        s = thr / 127.0
+        return np.clip(np.round(arr / s), -127, 127).astype(np.int8), thr
+
+    qx, tx = q(x)
+    qw, tw = q(w)
+    qb, tb = q(b)
+    out, mn, mx_ = nd.contrib.quantized_fully_connected(
+        nd.array(qx), nd.array(qw),
+        nd.array(np.float32([-tx])), nd.array(np.float32([tx])),
+        nd.array(np.float32([-tw])), nd.array(np.float32([tw])),
+        nd.array(qb),
+        nd.array(np.float32([-tb])), nd.array(np.float32([tb])),
+        num_hidden=4)
+    deq = nd.contrib.dequantize(out, mn, mx_)
+    np.testing.assert_allclose(deq.asnumpy(), ref, atol=0.15)
+
+
+def test_optimal_threshold_sane():
+    r = np.random.RandomState(2)
+    arr = np.concatenate([r.randn(100000), np.array([50.0])])  # outlier
+    thr = _get_optimal_threshold(arr)
+    assert 2.0 < thr < 25.0  # clips the outlier, keeps the mass
+
+
+def _train_small_convnet(seed=3):
+    r = np.random.RandomState(seed)
+    n = 256
+    X = r.uniform(0, 1, (n, 1, 8, 8)).astype(np.float32)
+    Y = r.randint(0, 2, (n,)).astype(np.float32)
+    X += 0.6 * Y[:, None, None, None]  # class-separable shift
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3})
+    return net, mod, X, Y
+
+
+def _accuracy(sym, args, auxs, X, Y):
+    # quantized graphs have no shape-inference rules for int8 kernels;
+    # bind with explicit param shapes (like loading a quantized checkpoint)
+    shapes = {"data": (32, 1, 8, 8), "softmax_label": (32,)}
+    for name in sym.list_arguments():
+        if name in args:
+            shapes[name] = tuple(args[name].shape)
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null", **shapes)
+    exe.copy_params_from(args, auxs, allow_extra_params=True)
+    correct = 0
+    for i in range(0, len(X), 32):
+        out = exe.forward(is_train=False, data=X[i:i + 32])[0].asnumpy()
+        correct += (out.argmax(1) == Y[i:i + 32]).sum()
+    return correct / len(X)
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_model_accuracy(calib_mode):
+    """VERDICT item: quantize a convnet; int8 accuracy within tolerance of
+    fp32 on the task (reference quantization acceptance criterion)."""
+    net, mod, X, Y = _train_small_convnet()
+    args, auxs = mod.get_params()
+    fp32_acc = _accuracy(net, args, auxs, X, Y)
+    assert fp32_acc > 0.9, "fp32 model failed to train (acc=%s)" % fp32_acc
+
+    calib = mx.io.NDArrayIter(X[:96], Y[:96], batch_size=32)
+    qsym, qargs, qauxs = quantize_model(
+        net, args, auxs, ctx=mx.cpu(), calib_mode=calib_mode,
+        calib_data=calib, num_calib_examples=96)
+    # graph actually rewritten to int8 kernels
+    names = [n.name for n in qsym._topo() if not n.is_var]
+    assert any("quantized" in n for n in names), names
+    int8_acc = _accuracy(qsym, qargs, qauxs, X, Y)
+    assert int8_acc >= fp32_acc - 0.03, (fp32_acc, int8_acc)
+
+
+def test_quantize_model_keeps_fp32_weights_for_shared_vars():
+    """Quantized params live under *_quantize names; an excluded layer
+    sharing the same weight Variable must keep its fp32 values."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    a = mx.sym.FullyConnected(data, weight=w, no_bias=True, num_hidden=8,
+                              name="fca")
+    b = mx.sym.FullyConnected(data, weight=w, no_bias=True, num_hidden=8,
+                              name="fcb")
+    net = a + b
+    r = np.random.RandomState(0)
+    args = {"w": mx.nd.array(r.randn(8, 8).astype(np.float32))}
+    X = r.randn(64, 8).astype(np.float32)
+    calib = mx.io.NDArrayIter(X, r.randn(64).astype(np.float32),
+                              batch_size=32)
+    qsym, qargs, _ = quantize_model(
+        net, args, {}, ctx=mx.cpu(), calib_mode="naive", calib_data=calib,
+        excluded_sym_names=["fcb"])
+    # original fp32 weight untouched; int8 copy under a new name
+    np.testing.assert_array_equal(qargs["w"].asnumpy(),
+                                  args["w"].asnumpy())
+    assert qargs["w_quantize"].asnumpy().dtype == np.int8
+
+
+def test_quantize_model_excludes():
+    net, mod, X, Y = _train_small_convnet(seed=4)
+    args, auxs = mod.get_params()
+    calib = mx.io.NDArrayIter(X[:32], Y[:32], batch_size=32)
+    qsym, qargs, _ = quantize_model(
+        net, args, auxs, ctx=mx.cpu(), calib_mode="naive",
+        calib_data=calib, excluded_sym_names=["conv1"])
+    names = [n.name for n in qsym._topo() if not n.is_var]
+    assert not any(n.startswith("conv1_quantized") for n in names)
+    assert any(n.startswith("fc1_quantized") for n in names)
