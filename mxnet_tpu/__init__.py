@@ -48,3 +48,14 @@ from . import predict  # noqa: F401
 from . import image  # noqa: F401
 from . import profiler  # noqa: F401
 from . import contrib  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from .config import config  # noqa: F401  (mx.config = the knob registry;
+#                            the module stays importable as mxnet_tpu.config
+#                            via sys.modules and has the same describe())
+from . import runtime  # noqa: F401
+
+if config.profiler_autostart:
+    profiler.start()

@@ -1,0 +1,102 @@
+"""Environment-variable configuration tier (reference: ~61 ``MXNET_*``
+env vars read via ``dmlc::GetEnv`` across ``src/``, documented centrally
+in ``docs/faq/env_var.md``).
+
+Each knob is declared once with a type, default, and doc — ``describe()``
+prints the env_var.md-style table.  Reference names are kept where the
+behavior maps; TPU-obsolete knobs are accepted but marked inert so
+existing launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["config", "describe", "Knob"]
+
+
+class Knob:
+    def __init__(self, name, typ, default, doc, inert=False):
+        self.name = name
+        self.typ = typ
+        self.default = default
+        self.doc = doc
+        self.inert = inert
+
+    @property
+    def value(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        if self.typ is bool:
+            return raw.strip().lower() not in ("0", "false", "no", "off",
+                                               "f", "")
+        return self.typ(raw)
+
+
+class _Config:
+    """Typed view over the MXNET_* env tier."""
+
+    _KNOBS = [
+        Knob("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+             "Execution engine. 'NaiveEngine' disables op-level jit "
+             "compilation (every op runs eagerly interpreted) — the "
+             "debugging mode the reference uses to serialize execution "
+             "(src/engine/engine.cc:40)."),
+        Knob("MXNET_CPU_WORKER_NTHREADS", int, 4,
+             "Host-side worker threads (decode/augment pools, e.g. "
+             "ImageRecordIter preprocess_threads default; reference "
+             "threaded_engine_perdevice.cc:79)."),
+        Knob("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+             "Reference op-bulking switch. Inert: XLA fuses the whole "
+             "graph into one module already.", inert=True),
+        Knob("MXNET_GPU_MEM_POOL_RESERVE", int, 5,
+             "Reference GPU pool reserve %. Inert: XLA owns the HBM "
+             "arena.", inert=True),
+        Knob("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+             "Reference PS sharding bound. Inert: collectives shard by "
+             "mesh, not key size.", inert=True),
+        Knob("MXNET_PROFILER_AUTOSTART", bool, False,
+             "Start mx.profiler at import (reference env var of the same "
+             "name)."),
+        Knob("MXNET_ENFORCE_DETERMINISM", bool, False,
+             "Ask XLA for deterministic ops (maps to "
+             "--xla_gpu_deterministic_ops on GPU; TPU is deterministic "
+             "by default)."),
+        Knob("MXNET_SUBGRAPH_BACKEND", str, "",
+             "Reference subgraph-fusion backend selector. Inert: XLA "
+             "fusion replaces subgraph properties.", inert=True),
+    ]
+
+    def __init__(self):
+        self._by_name = {k.name: k for k in self._KNOBS}
+
+    def __getattr__(self, item):
+        key = "MXNET_" + item.upper()
+        if key in self._by_name:
+            return self._by_name[key].value
+        raise AttributeError(item)
+
+    def knob(self, name):
+        return self._by_name[name]
+
+    def describe(self):
+        """env_var.md-style knob table (also module-level describe())."""
+        return describe()
+
+    @property
+    def naive_engine(self):
+        return self.engine_type == "NaiveEngine"
+
+
+config = _Config()
+
+
+def describe():
+    """env_var.md-style table of every knob."""
+    lines = ["%-32s %-10s %-12s %s" % ("Variable", "Type", "Default",
+                                       "Description")]
+    for k in _Config._KNOBS:
+        doc = k.doc + (" [inert on TPU]" if k.inert else "")
+        lines.append("%-32s %-10s %-12s %s" % (k.name, k.typ.__name__,
+                                               k.default, doc))
+    return "\n".join(lines)

@@ -103,6 +103,8 @@ class Executor:
         self.outputs = []
         self._fn_cache = {}
         self._is_train = False
+        self._monitor_cb = None
+        self._monitor_fn_cache = {}
 
     def _align(self, values, names, what, allow_missing=False):
         if isinstance(values, dict):
@@ -124,9 +126,20 @@ class Executor:
         return list(values)
 
     # ------------------------------------------------------------------
-    def _graph_fn(self, train):
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-node output tap (reference
+        ``MXExecutorSetMonitorCallbackEX`` / ``ExecuteMonInputCallback``,
+        graph_executor.cc:1295,1375).  While installed, ``forward`` runs
+        the graph eagerly node-by-node (outside jit) so every
+        intermediate is observable — the debugging trade the reference
+        also makes when a monitor is attached."""
+        self._monitor_cb = callback
+        self._monitor_fn_cache = {}
+
+    def _graph_fn(self, train, tap=None):
         """Pure function (rng, arg_list, aux_list) -> (outs..., new_auxs...)
-        — the single XLA module."""
+        — the single XLA module (or, with ``tap``, the eager monitored
+        interpretation)."""
         sym = self._symbol
         topo = sym._topo()
         arg_index = {n: i for i, n in enumerate(self.arg_names)}
@@ -163,6 +176,8 @@ class Executor:
                 if not isinstance(res, (tuple, list)):
                     res = (res,)
                 env[id(node)] = tuple(res)
+                if tap is not None:
+                    tap(node.name, res)
                 # aux write-back (FMutateInputs parity)
                 for out_i, in_i in node.op.mutate.items():
                     if in_i < len(node.inputs):
@@ -247,7 +262,22 @@ class Executor:
 
         self._stage(kwargs)
         self._is_train = bool(is_train)
-        fn = self._compiled("forward", self._is_train)
+        if self._monitor_cb is not None:
+            fn = self._monitor_fn_cache.get(self._is_train)
+            if fn is None:
+                inner = self._graph_fn(self._is_train,
+                                       tap=self._monitor_cb)
+                dev = self._ctx.jax_device()
+
+                def fn(*a, _inner=inner, _dev=dev):
+                    # same context pin as the compiled path — observation
+                    # must not move the computation to another device
+                    with jax.default_device(_dev):
+                        return _inner(*a)
+
+                self._monitor_fn_cache[self._is_train] = fn
+        else:
+            fn = self._compiled("forward", self._is_train)
         rng = _random.next_key()
         aux_in = [a.data for a in self.aux_arrays]
         outs, new_aux = fn(rng, [a.data for a in self.arg_arrays], aux_in)

@@ -41,12 +41,16 @@ class ImageRecordIter(DataIter):
                  path_imgidx=None, shuffle=False, rand_crop=False,
                  rand_mirror=False, resize=0, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 part_index=0, num_parts=1, preprocess_threads=4,
+                 part_index=0, num_parts=1, preprocess_threads=None,
                  prefetch_buffer=4, label_width=1, round_batch=True,
                  seed=0, dtype="float32", data_name="data",
                  label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
+        from ..config import config
         from ..image import CreateAugmenter
+
+        if preprocess_threads is None:
+            preprocess_threads = config.cpu_worker_nthreads
 
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size

@@ -197,6 +197,10 @@ class Module(BaseModule):
         GraphExecutor::Init covers forward and backward)."""
         assert self.binded and self.params_initialized
         feed = self._feed_batch(data_batch)
+        if self._exec._monitor_cb is not None:
+            # monitored (debug) mode: an eager tapped forward makes every
+            # intermediate observable before the fused step runs
+            self._exec.forward(is_train=True, **feed)
         self._exec.backward(**feed)
 
     def backward(self, out_grads=None):

@@ -1,0 +1,78 @@
+"""Monitor: per-op output statistics during training (reference:
+``python/mxnet/monitor.py:33`` — taps every executor-internal tensor via
+``monitor_callback`` and prints a stat per matching tensor).
+
+TPU-native: installing a monitor switches the bound Executor into eager
+node-by-node interpretation (outputs are inside one XLA module otherwise),
+so every intermediate is observable.  Remove the monitor to get the fused
+fast path back — same slow-when-watched trade as the reference.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect statistics of internal tensors every ``interval`` batches.
+
+    Parameters (reference parity): ``interval``, ``stat_func`` (numpy
+    array -> scalar/array stat; default mean absolute value), ``pattern``
+    (regex over tensor names), ``sort`` (sort results by name).
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return np.abs(x).mean()
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._exes = []
+
+    # -- executor hookup -------------------------------------------------
+    def install(self, exe):
+        """Attach to an executor (reference: Monitor.install)."""
+        exe.set_monitor_callback(self._tap)
+        self._exes.append(exe)
+
+    def _tap(self, name, outputs):
+        if not self.activated:
+            return
+        for i, o in enumerate(outputs):
+            full = name if len(outputs) == 1 else "%s_output%d" % (name, i)
+            if self.re_pattern.match(full):
+                self.queue.append((self.step, full,
+                                   self.stat_func(np.asarray(o))))
+
+    # -- batch lifecycle (reference tic/toc/toc_print) -------------------
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; return [(step, name, stat)] (reference :97)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = list(self.queue)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.getLogger(__name__).info(
+                "Batch: %7d %30s %s", step, name, stat)

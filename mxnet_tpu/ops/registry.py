@@ -124,13 +124,21 @@ class OpDef:
 
     def call(self, arrays, params, rng=None, train=False):
         """Eager compiled call: arrays are jax arrays, params a dict."""
+        from ..config import config
+
         static, arrs = split_params(self, params)
-        if self.cacheable:
-            f = _jitted(self, _freeze(static), tuple(k for k, _ in arrs), train)
-        else:
-            # one-shot ops (e.g. custom autograd.Function instances): caching
-            # on the OpDef would leak executables — run uncompiled instead
+        if config.naive_engine or not self.cacheable:
+            # MXNET_ENGINE_TYPE=NaiveEngine (debug: run uncompiled /
+            # interpreted) and one-shot ops (custom autograd.Function —
+            # caching would leak executables).  array_params must go by
+            # keyword here: uncompiled fns take them as named kwargs,
+            # unlike the _jitted wrapper which remaps positions itself.
             f = self.bind(static, train)
+            kw = dict(arrs)
+            if self.needs_rng:
+                return f(rng, *arrays, **kw)
+            return f(*arrays, **kw)
+        f = _jitted(self, _freeze(static), tuple(k for k, _ in arrs), train)
         args = list(arrays) + [v for _, v in arrs]
         if self.needs_rng:
             return f(rng, *args)

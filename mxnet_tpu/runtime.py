@@ -1,0 +1,75 @@
+"""Runtime feature detection (reference: ``python/mxnet/runtime.py`` over
+``src/libinfo.cc`` — enumerate compile/runtime capabilities).
+
+The reference's features are compile flags (CUDA, CUDNN, MKLDNN, …); here
+they are runtime probes of the JAX environment (platform, pallas, dtypes,
+IO deps), served through the same ``Features``/``feature_list`` API.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = bool(enabled)
+
+    def __repr__(self):
+        return "[%s: %s]" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _probe():
+    import jax
+
+    feats = {}
+    try:
+        platforms = {d.platform for d in jax.local_devices()}
+    except Exception:
+        platforms = set()
+    feats["TPU"] = "tpu" in platforms or "axon" in platforms
+    feats["CPU"] = True
+    feats["GPU"] = "gpu" in platforms or "cuda" in platforms
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    feats["BF16"] = True  # native on TPU; emulated on host CPU
+    feats["INT8"] = True  # int8 dot/conv with int32 accumulation
+    feats["F16C"] = False
+    feats["INT64_TENSOR_SIZE"] = bool(jax.config.jax_enable_x64)
+    feats["DIST_KVSTORE"] = True  # jax.distributed + gloo/ICI collectives
+    feats["PROFILER"] = True
+    try:
+        import cv2  # noqa: F401
+        feats["OPENCV"] = True
+    except Exception:
+        feats["OPENCV"] = False
+    try:
+        import graphviz  # noqa: F401
+        feats["GRAPHVIZ"] = True
+    except Exception:
+        feats["GRAPHVIZ"] = False
+    # reference compile-flags with no TPU analogue: permanently off
+    for off in ("CUDA", "CUDNN", "NCCL", "TENSORRT", "MKLDNN", "OPENMP"):
+        feats[off] = False
+    return feats
+
+
+class Features(dict):
+    """Mapping name -> Feature (reference runtime.Features)."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _probe().items()})
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(repr(v) for v in self.values())
+
+
+def feature_list():
+    """List of runtime features (reference runtime.feature_list)."""
+    return list(Features().values())

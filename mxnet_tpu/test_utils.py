@@ -74,16 +74,33 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
 
 
 def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4, atol=1e-5):
-    """Run the same computation across contexts and cross-check outputs
-    (reference: test_utils.py:1224 — CPU is the oracle for the accelerator)."""
+    """Run the same computation across backends and cross-check outputs
+    (reference: test_utils.py:1224 — CPU is the oracle for the
+    accelerator).
+
+    When the ctx_list spans distinct devices (cpu vs tpu), each context
+    runs for real.  When every context resolves to the SAME device (the
+    CPU-only CI case that used to make this check vacuous), the oracle
+    leg instead runs with jit disabled — interpreted (op-by-op) vs
+    XLA-compiled is a genuine two-implementation comparison."""
+    import jax
+
     ctx_list = ctx_list or [cpu(0), current_context()]
     datas = [np.random.uniform(-1, 1, s).astype(np.float32)
              for s in input_shapes]
+    devices = {c.jax_device() for c in ctx_list}
     outs = []
-    for ctx in ctx_list:
-        with ctx:
-            r = f(*[nd.array(d, ctx=ctx) for d in datas])
-            outs.append(r.asnumpy())
+    if len(devices) == 1:
+        with jax.disable_jit():  # interpreted oracle
+            r = f(*[nd.array(d, ctx=ctx_list[0]) for d in datas])
+            outs.append(np.asarray(r.data))
+        r = f(*[nd.array(d, ctx=ctx_list[0]) for d in datas])
+        outs.append(r.asnumpy())
+    else:
+        for ctx in ctx_list:
+            with ctx:
+                r = f(*[nd.array(d, ctx=ctx) for d in datas])
+                outs.append(r.asnumpy())
     for o in outs[1:]:
         np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
 

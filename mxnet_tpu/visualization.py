@@ -1,0 +1,110 @@
+"""Network visualization (reference: ``python/mxnet/visualization.py`` —
+``print_summary`` text table and ``plot_network`` graphviz digraph)."""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_params(node, shapes):
+    """Parameter count of one op node given its input var shapes."""
+    count = 0
+    for src, _ in node.inputs:
+        if src.is_var and src.name in shapes and \
+                not src.name.endswith("label") and src.name != "data":
+            n = 1
+            for s in shapes[src.name]:
+                n *= s
+            count += n
+    return count
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-table summary (reference visualization.py:print_summary).
+
+    ``shape``: dict of input shapes (e.g. ``{'data': (1, 3, 224, 224)}``)
+    enabling output-shape and parameter counting.
+    """
+    shapes = {}
+    out_shapes = {}
+    if shape:
+        arg_shapes, out_s, _ = symbol.infer_shape(**shape)
+        shapes = dict(zip(symbol.list_arguments(), arg_shapes))
+        internals = symbol.get_internals()
+        try:
+            _, int_out, _ = internals.infer_shape(**shape)
+            for (node, oi), s in zip(internals._outputs, int_out):
+                out_shapes.setdefault(node.name, s)
+        except Exception:
+            pass
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    lines = []
+
+    def row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line += str(f)
+            line = line[:pos - 1]
+            line += " " * (pos - len(line))
+        lines.append(line)
+
+    lines.append("=" * line_length)
+    row(headers)
+    lines.append("=" * line_length)
+    total = 0
+    for node in symbol._topo():
+        if node.is_var:
+            continue
+        prev = ",".join(src.name for src, _ in node.inputs
+                        if not src.is_var)
+        n_params = _node_params(node, shapes)
+        total += n_params
+        row(["%s (%s)" % (node.name, node.op.name),
+             out_shapes.get(node.name, ""), n_params, prev])
+        lines.append("_" * line_length)
+    lines.append("Total params: %d" % total)
+    lines.append("=" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the network (reference plot_network).
+
+    Returns a ``graphviz.Digraph`` (render with ``.render()`` /
+    ``.view()``, same as the reference).
+    """
+    try:
+        from graphviz import Digraph
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("plot_network requires the graphviz package") \
+            from e
+
+    node_attrs = dict(node_attrs or {})
+    attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    attrs.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    palette = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+               "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+               "BatchNorm": "#bebada", "Pooling": "#80b1d3",
+               "Concat": "#fdb462", "Flatten": "#fdb462",
+               "SoftmaxOutput": "#b3de69"}
+    for node in symbol._topo():
+        if node.is_var:
+            if hide_weights and node.name != "data":
+                continue
+            dot.node(node.name, node.name, fillcolor="#8dd3c7", **attrs)
+            continue
+        label = "%s\n%s" % (node.name, node.op.name)
+        dot.node(node.name, label,
+                 fillcolor=palette.get(node.op.name, "#d9d9d9"), **attrs)
+        for src, _ in node.inputs:
+            if src.is_var and hide_weights and src.name != "data":
+                continue
+            dot.edge(src.name, node.name)
+    return dot

@@ -1,0 +1,155 @@
+"""Monitor, visualization, and exception-semantics tests.
+
+References: ``python/mxnet/monitor.py:33`` (Monitor over executor
+monitor_callback), ``python/mxnet/visualization.py`` (print_summary /
+plot_network), ``tests/python/unittest/test_exc_handling.py``
+(exception propagation semantics around the async engine).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    return net
+
+
+# ---------------------------------------------------------------------------
+# Monitor
+# ---------------------------------------------------------------------------
+def test_monitor_collects_stats():
+    net = _mlp()
+    X = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    collected = []
+    orig_toc = mon.toc
+
+    def toc_spy():
+        res = orig_toc()
+        collected.extend(res)
+        return res
+
+    mon.toc = toc_spy
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.1})
+    names = {n for _, n, _ in collected}
+    assert any("fc1" in n for n in names), names
+    assert any("fc2" in n for n in names), names
+    assert all(np.isfinite(s) for _, _, s in collected)
+    # pattern filter: relu not collected
+    assert not any("relu" in n for n in names)
+
+
+def test_monitor_interval_and_sort():
+    mon = mx.Monitor(interval=2, sort=True)
+    mon.tic()
+    mon._tap("b_layer", (np.ones((2,)),))
+    mon._tap("a_layer", (np.ones((2,)),))
+    res = mon.toc()
+    assert [n for _, n, _ in res] == ["a_layer", "b_layer"]
+    mon.tic()  # step 1: interval 2 -> inactive
+    mon._tap("c_layer", (np.ones((2,)),))
+    assert mon.toc() == []
+
+
+def test_monitor_removed_restores_fused_path():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=(4, 6))
+    seen = []
+    exe.set_monitor_callback(lambda name, outs: seen.append(name))
+    exe.forward(is_train=False, data=np.zeros((4, 6), np.float32))
+    assert seen, "monitored forward must tap nodes"
+    n = len(seen)
+    exe.set_monitor_callback(None)
+    exe.forward(is_train=False, data=np.zeros((4, 6), np.float32))
+    assert len(seen) == n  # no more taps once removed
+
+
+# ---------------------------------------------------------------------------
+# Visualization
+# ---------------------------------------------------------------------------
+def test_print_summary(capsys):
+    net = _mlp()
+    out = mx.viz.print_summary(net, shape={"data": (1, 6)})
+    assert "fc1" in out and "FullyConnected" in out
+    # fc1: 6*8 weights + 8 bias; fc2: 8*4 + 4
+    assert "Total params: %d" % (6 * 8 + 8 + 8 * 4 + 4) in out
+
+
+def test_plot_network():
+    net = _mlp()
+    dot = mx.viz.plot_network(net, shape={"data": (1, 6)})
+    src = dot.source
+    assert "fc1" in src and "softmax" in src
+    assert "fc1_weight" not in src  # hide_weights default
+    dot2 = mx.viz.plot_network(net, hide_weights=False)
+    assert "fc1_weight" in dot2.source
+
+
+# ---------------------------------------------------------------------------
+# Exception semantics (reference test_exc_handling.py)
+# ---------------------------------------------------------------------------
+def test_imperative_op_error_raises_and_recovers():
+    a = mx.nd.ones((3, 4))
+    b = mx.nd.ones((5, 6))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b).wait_to_read()  # incompatible shapes
+    # the "engine" is not poisoned: subsequent ops still run
+    c = mx.nd.dot(a, mx.nd.ones((4, 2)))
+    assert c.shape == (3, 2)
+    mx.nd.waitall()
+
+
+def test_backward_error_propagates():
+    class Bad(mx.autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            raise RuntimeError("injected backward failure")
+
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    f = Bad()
+    with mx.autograd.record():
+        y = f(x)
+    with pytest.raises(RuntimeError, match="injected backward failure"):
+        y.backward()
+    # tape is reusable afterwards
+    with mx.autograd.record():
+        z = x * 3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3.0)
+
+
+def test_executor_bad_feed_raises_cleanly():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=(4, 6))
+    with pytest.raises(ValueError):
+        exe.forward(is_train=False, bogus=np.zeros((4, 6), np.float32))
+    # still usable
+    outs = exe.forward(is_train=False, data=np.zeros((4, 6), np.float32))
+    assert outs[0].shape == (4, 4)
+
+
+def test_dataiter_producer_error_surfaces_in_consumer(tmp_path):
+    """Errors on the decode/prefetch thread surface at next() (the
+    reference surfaces engine-thread errors at WaitForVar)."""
+    rec_path = str(tmp_path / "bad.rec")
+    from mxnet_tpu import recordio
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rec.write(b"not an image at all")
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                               batch_size=1)
+    with pytest.raises(Exception):
+        it.next()
