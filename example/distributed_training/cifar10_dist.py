@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training (reference:
+``example/distributed_training/cifar10_dist.py``).
+
+Launch with the local emulation harness::
+
+    python -m mxnet_tpu.tools.launch -n 2 --platform cpu -- \
+        python example/distributed_training/cifar10_dist.py --num-epochs 2
+
+Each worker trains on its shard and synchronizes gradients through
+kvstore ``dist_sync`` (XLA collectives over ICI on a real pod, gloo on
+the CPU harness).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    args = ap.parse_args()
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    print("worker %d/%d starting" % (rank, nw), flush=True)
+
+    # per-worker shard of a synthetic CIFAR-shaped task (deterministic
+    # across workers, sharded like ImageRecordIter part_index/num_parts)
+    rng = np.random.RandomState(7)
+    X = rng.uniform(0, 0.3, (args.num_examples, 3, 32, 32)) \
+        .astype(np.float32)
+    Y = rng.randint(0, 10, (args.num_examples,)).astype(np.float32)
+    X += (Y * 0.07)[:, None, None, None]
+    shard = args.num_examples // nw
+    Xs = X[rank * shard:(rank + 1) * shard]
+    Ys = Y[rank * shard:(rank + 1) * shard]
+    it = mx.io.NDArrayIter(Xs, Ys, args.batch_size, shuffle=True)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            with mx.autograd.record():
+                out = net(batch.data[0])
+                loss = loss_fn(out, batch.label[0])
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update(batch.label, [out])
+        print("worker %d epoch %d %s" % (rank, epoch, metric.get()),
+              flush=True)
+    print("worker %d done" % rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark across the model zoo (reference:
+``example/image-classification/benchmark_score.py`` — the script behind
+docs/faq/perf.md's tables / BASELINE.md)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def score(model_name, batch_size, image_shape, dtype="float32",
+          warmup=3, iters=10):
+    net = getattr(vision, model_name)(classes=1000)
+    net.initialize(mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    net.hybridize()
+    data = mx.nd.array(np.random.uniform(
+        size=(batch_size,) + image_shape).astype(dtype if dtype != "bfloat16"
+                                                 else "float32"))
+    if dtype == "bfloat16":
+        data = data.astype("bfloat16")
+    for _ in range(warmup):
+        net(data).wait_to_read()
+    # queue all steps, sync once: per-call wait_to_read would measure
+    # host<->device round-trip latency, not throughput (XLA dispatch is
+    # async; the reference's engine is async for the same reason)
+    tic = time.time()
+    out = None
+    for _ in range(iters):
+        out = net(data)
+    out.wait_to_read()
+    dt = time.time() - tic
+    return batch_size * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", type=str,
+                    default="alexnet,resnet18_v1,resnet50_v1,vgg16,"
+                            "mobilenet1_0,squeezenet1_0")
+    ap.add_argument("--batch-sizes", type=str, default="1,32,128")
+    ap.add_argument("--image-shape", type=str, default="3,224,224")
+    ap.add_argument("--dtype", type=str, default="float32")
+    args = ap.parse_args()
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    for name in args.networks.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(name, bs, shape, args.dtype)
+            print("network: %-16s batch: %4d  dtype: %-9s  %10.1f img/s"
+                  % (name, bs, args.dtype, ips), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Shared training driver (reference:
+``example/image-classification/common/fit.py:148`` — the fit() that every
+train_* script calls: kvstore, optimizer, LR schedule, checkpoints,
+Speedometer).
+"""
+import argparse
+import logging
+import os
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training")
+    train.add_argument("--network", type=str, default="mlp")
+    train.add_argument("--batch-size", type=int, default=64)
+    train.add_argument("--num-epochs", type=int, default=3)
+    train.add_argument("--lr", type=float, default=0.05)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--kv-store", type=str, default="device")
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str, default=None)
+    train.add_argument("--load-epoch", type=int, default=None)
+    train.add_argument("--dtype", type=str, default="float32",
+                       choices=["float32", "bfloat16"])
+    train.add_argument("--ctx", type=str, default="auto",
+                       choices=["auto", "tpu", "cpu"])
+    return parser
+
+
+def _context(args):
+    import mxnet_tpu as mx
+
+    if args.ctx == "cpu":
+        return mx.cpu()
+    if args.ctx == "tpu":
+        return mx.tpu()
+    return mx.tpu() if mx.context.num_tpus() else mx.cpu()
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Train `network` (a Symbol) on the iterators from data_loader
+    (reference fit.py:148)."""
+    import mxnet_tpu as mx
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    kv = mx.kv.create(args.kv_store)
+    train, val = data_loader(args, kv)
+
+    lr_scheduler = None
+    if args.lr_step_epochs:
+        epoch_size = max(args.num_examples // args.batch_size //
+                         max(kv.num_workers, 1), 1)
+        steps = [epoch_size * int(e)
+                 for e in args.lr_step_epochs.split(",") if e]
+        if steps:
+            lr_scheduler = mx.lr_scheduler.MultiFactorScheduler(
+                step=steps, factor=args.lr_factor)
+
+    optimizer_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag", "signum"):
+        optimizer_params["momentum"] = args.mom
+    if lr_scheduler is not None:
+        optimizer_params["lr_scheduler"] = lr_scheduler
+
+    mod = mx.mod.Module(network, context=_context(args))
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    checkpoint = None
+    if args.model_prefix:
+        os.makedirs(os.path.dirname(args.model_prefix) or ".",
+                    exist_ok=True)
+        checkpoint = mx.callback.do_checkpoint(args.model_prefix)
+
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=["accuracy"],
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            arg_params=arg_params,
+            aux_params=aux_params,
+            begin_epoch=begin_epoch,
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches),
+            epoch_end_callback=checkpoint,
+            **kwargs)
+    return mod

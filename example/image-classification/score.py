@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Score a saved checkpoint on a dataset (reference:
+``example/image-classification/score.py``)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from common import data as data_mod  # noqa: E402
+
+
+def main():
+    import mxnet_tpu as mx
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", type=str, required=True)
+    ap.add_argument("--load-epoch", type=int, required=True)
+    ap.add_argument("--batch-size", type=int, default=64)
+    data_mod.add_data_args(ap)
+    args = ap.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.load_epoch)
+    _, val = data_mod.get_iters(args)
+    mod = mx.mod.Module(sym, context=mx.cpu()
+                        if not mx.context.num_tpus() else mx.tpu())
+    mod.bind(data_shapes=val.provide_data,
+             label_shapes=val.provide_label, for_training=False)
+    mod.set_params(arg_params, aux_params)
+    res = mod.score(val, ["accuracy"])
+    for name, value in res:
+        print("%s=%f" % (name, value))
+
+
+if __name__ == "__main__":
+    main()

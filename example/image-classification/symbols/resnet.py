@@ -1,0 +1,111 @@
+"""Symbolic ResNet (reference: example/image-classification/symbols/
+resnet.py — CIFAR depths 3n*6+2 and ImageNet depths 18..152).
+
+Kept symbolic (mx.sym) like the reference; the Gluon model zoo
+(mxnet_tpu/gluon/model_zoo) is the imperative twin.
+"""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottle_neck=True):
+    bn1 = mx.sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=0.9,
+                           name=name + "_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+    if bottle_neck:
+        conv1 = mx.sym.Convolution(act1, num_filter=num_filter // 4,
+                                   kernel=(1, 1), stride=(1, 1),
+                                   pad=(0, 0), no_bias=True,
+                                   name=name + "_conv1")
+        bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu",
+                                 name=name + "_relu2")
+        conv2 = mx.sym.Convolution(act2, num_filter=num_filter // 4,
+                                   kernel=(3, 3), stride=stride,
+                                   pad=(1, 1), no_bias=True,
+                                   name=name + "_conv2")
+        bn3 = mx.sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn3")
+        act3 = mx.sym.Activation(bn3, act_type="relu",
+                                 name=name + "_relu3")
+        conv3 = mx.sym.Convolution(act3, num_filter=num_filter,
+                                   kernel=(1, 1), stride=(1, 1),
+                                   pad=(0, 0), no_bias=True,
+                                   name=name + "_conv3")
+        body = conv3
+    else:
+        conv1 = mx.sym.Convolution(act1, num_filter=num_filter,
+                                   kernel=(3, 3), stride=stride,
+                                   pad=(1, 1), no_bias=True,
+                                   name=name + "_conv1")
+        bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu",
+                                 name=name + "_relu2")
+        body = mx.sym.Convolution(act2, num_filter=num_filter,
+                                  kernel=(3, 3), stride=(1, 1),
+                                  pad=(1, 1), no_bias=True,
+                                  name=name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(act1, num_filter=num_filter,
+                                      kernel=(1, 1), stride=stride,
+                                      no_bias=True, name=name + "_sc")
+    return body + shortcut
+
+
+def get_symbol(num_classes=10, num_layers=20, image_shape="3,32,32",
+               **kwargs):
+    image_shape = [int(x) for x in image_shape.split(",")] \
+        if isinstance(image_shape, str) else list(image_shape)
+    (nchannel, height, _) = image_shape
+    if height <= 32:  # CIFAR
+        assert (num_layers - 2) % 6 == 0
+        per_stage = (num_layers - 2) // 6
+        units = [per_stage] * 3
+        filter_list = [16, 16, 32, 64]
+        bottle_neck = False
+    else:  # ImageNet
+        cfg = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+               50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+               152: ([3, 8, 36, 3], True)}
+        units, bottle_neck = cfg[num_layers]
+        filter_list = [64, 256, 512, 1024, 2048] if bottle_neck \
+            else [64, 64, 128, 256, 512]
+
+    data = mx.sym.Variable("data")
+    body = mx.sym.BatchNorm(data, fix_gamma=True, eps=2e-5,
+                            momentum=0.9, name="bn_data")
+    if height <= 32:
+        body = mx.sym.Convolution(body, num_filter=filter_list[0],
+                                  kernel=(3, 3), stride=(1, 1),
+                                  pad=(1, 1), no_bias=True, name="conv0")
+    else:
+        body = mx.sym.Convolution(body, num_filter=filter_list[0],
+                                  kernel=(7, 7), stride=(2, 2),
+                                  pad=(3, 3), no_bias=True, name="conv0")
+        body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name="bn0")
+        body = mx.sym.Activation(body, act_type="relu", name="relu0")
+        body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), pool_type="max")
+
+    for i, n_units in enumerate(units):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = residual_unit(body, filter_list[i + 1], stride, False,
+                             "stage%d_unit1" % (i + 1), bottle_neck)
+        for j in range(n_units - 1):
+            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+                                 "stage%d_unit%d" % (i + 1, j + 2),
+                                 bottle_neck)
+
+    bn1 = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=0.9,
+                           name="bn1")
+    relu1 = mx.sym.Activation(bn1, act_type="relu", name="relu1")
+    pool1 = mx.sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
+                           pool_type="avg", name="pool1")
+    flat = mx.sym.Flatten(pool1)
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(fc1, name="softmax")

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Train a CIFAR-10-shaped task (reference:
+``example/image-classification/train_cifar10.py`` — resnet by default)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from common import data, fit  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.add_argument("--num-layers", type=int, default=20)
+    parser.set_defaults(network="resnet", image_shape="3,32,32",
+                        num_classes=10, num_examples=2048, batch_size=128,
+                        num_epochs=3, lr=0.1, lr_step_epochs="60,100",
+                        rand_crop=True, rand_mirror=True)
+    args = parser.parse_args()
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "symbols"))
+    net_mod = __import__(args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers,
+                             image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_iters)
+
+
+if __name__ == "__main__":
+    main()

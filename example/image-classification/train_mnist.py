@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Train on MNIST-shaped data (reference:
+``example/image-classification/train_mnist.py``).
+
+The BASELINE "minimum end-to-end slice" config: MNISTIter-style data ->
+Module.fit -> jit'd fwd/bwd -> SGD -> Accuracy -> checkpoint.  Runs on
+real MNIST if ``--data-train`` points at a .rec, else a deterministic
+synthetic MNIST-shaped task (zero-egress default).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from common import data, fit  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train MNIST",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    # reference train_mnist.py defaults: mlp, sgd lr 0.05, 20 epochs
+    parser.set_defaults(network="mlp", image_shape="1,28,28",
+                        num_classes=10, num_examples=2048, batch_size=64,
+                        num_epochs=20, lr=0.05)
+    args = parser.parse_args()
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "symbols"))
+    net_mod = __import__(args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_iters)
+
+
+if __name__ == "__main__":
+    main()

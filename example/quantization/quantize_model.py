@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Post-training INT8 quantization example (reference:
+``example/quantization/imagenet_gen_qsym.py``): train (or load) an fp32
+model, calibrate, quantize, compare accuracies, save the int8 model."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", type=str, default=None,
+                    help="load an existing checkpoint instead of training")
+    ap.add_argument("--load-epoch", type=int, default=0)
+    ap.add_argument("--calib-mode", type=str, default="entropy",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--num-calib-examples", type=int, default=128)
+    ap.add_argument("--out-prefix", type=str, default="model_int8")
+    ap.add_argument("--image-shape", type=str, default="3,16,16",
+                    help="input shape (must match a loaded checkpoint)")
+    ap.add_argument("--num-classes", type=int, default=10)
+    args = ap.parse_args()
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (512,) + shape).astype(np.float32)
+    Y = rng.randint(0, args.num_classes, (512,)).astype(np.float32)
+    X += (Y * 0.7 / args.num_classes)[:, None, None, None]
+
+    if args.model_prefix:
+        sym, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+    else:
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                                 name="conv1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+        net = mx.sym.Flatten(net)
+        net = mx.sym.FullyConnected(net, num_hidden=args.num_classes,
+                                    name="fc1")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        it = mx.io.NDArrayIter(X, Y, 64, shuffle=True)
+        mod = mx.mod.Module(sym)
+        mod.fit(it, num_epoch=5, optimizer="adam",
+                optimizer_params={"learning_rate": 2e-3})
+        arg_params, aux_params = mod.get_params()
+
+    calib = mx.io.NDArrayIter(X[:args.num_calib_examples],
+                              Y[:args.num_calib_examples], 64)
+    qsym, qargs, qauxs = quantize_model(
+        sym, arg_params, aux_params, calib_mode=args.calib_mode,
+        calib_data=calib, num_calib_examples=args.num_calib_examples)
+
+    def acc(s, a, x):
+        shapes = {"data": (64,) + shape, "softmax_label": (64,)}
+        for n in s.list_arguments():
+            if n in a:
+                shapes[n] = tuple(a[n].shape)
+        exe = s.simple_bind(grad_req="null", **shapes)
+        exe.copy_params_from(a, x, allow_extra_params=True)
+        hit = 0
+        for i in range(0, len(X), 64):
+            out = exe.forward(is_train=False, data=X[i:i + 64])[0]
+            hit += (out.asnumpy().argmax(1) == Y[i:i + 64]).sum()
+        return hit / len(X)
+
+    print("fp32 accuracy: %.4f" % acc(sym, arg_params, aux_params))
+    print("int8 accuracy: %.4f" % acc(qsym, qargs, qauxs))
+    os.makedirs(os.path.dirname(args.out_prefix) or ".", exist_ok=True)
+    mx.model.save_checkpoint(args.out_prefix, 0, qsym, qargs, qauxs)
+    print("saved %s-symbol.json / %s-0000.params"
+          % (args.out_prefix, args.out_prefix))
+
+
+if __name__ == "__main__":
+    main()

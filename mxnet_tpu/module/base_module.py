@@ -42,10 +42,8 @@ class BaseModule:
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        if isinstance(eval_metric, str):
+        if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
-        elif isinstance(eval_metric, _metric.EvalMetric):
-            pass
         eval_metric.reset()
         actual_num_batch = 0
         for nbatch, eval_batch in enumerate(eval_data):

@@ -100,6 +100,7 @@ class Module(BaseModule):
         assert self.binded, "call bind before initializing the parameters"
         initializer = initializer or _init.Uniform(0.01)
 
+        attrs = self._symbol.attr_dict()  # per-variable __init__ etc.
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -110,7 +111,8 @@ class Module(BaseModule):
                 if arg_params is not None and not allow_missing:
                     raise RuntimeError("%s is not presented" % name)
                 if initializer is not None:
-                    initializer(_init.InitDesc(name), arr)
+                    initializer(_init.InitDesc(name, attrs.get(name)),
+                                arr)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
@@ -121,7 +123,8 @@ class Module(BaseModule):
                 if aux_params is not None and not allow_missing:
                     raise RuntimeError("aux %s is not presented" % name)
                 if initializer is not None:
-                    initializer(_init.InitDesc(name), arr)
+                    initializer(_init.InitDesc(name, attrs.get(name)),
+                                arr)
         self.params_initialized = True
 
     def get_params(self):

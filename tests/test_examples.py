@@ -1,0 +1,88 @@
+"""Smoke tests for the example scripts (reference: ``example/`` is the
+de-facto acceptance suite — SURVEY §2.3)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "example")
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+
+def _run(args, timeout=540):
+    r = subprocess.run([sys.executable] + args, cwd=REPO, env=ENV,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, "%s failed:\n%s\n%s" % (args, r.stdout[-2000:],
+                                                      r.stderr[-2000:])
+    return r.stdout + r.stderr
+
+
+def test_train_mnist_mlp(tmp_path):
+    out = _run([os.path.join(EX, "image-classification", "train_mnist.py"),
+                "--num-epochs", "2", "--num-examples", "512",
+                "--batch-size", "64", "--ctx", "cpu",
+                "--model-prefix", str(tmp_path / "mnist")])
+    assert "Train-accuracy" in out
+    assert (tmp_path / "mnist-symbol.json").exists()
+    assert (tmp_path / "mnist-0002.params").exists()
+    # resume from checkpoint
+    out2 = _run([os.path.join(EX, "image-classification",
+                              "train_mnist.py"),
+                 "--num-epochs", "3", "--num-examples", "512",
+                 "--batch-size", "64", "--ctx", "cpu",
+                 "--model-prefix", str(tmp_path / "mnist"),
+                 "--load-epoch", "2"])
+    assert "Epoch[2]" in out2
+    # score the checkpoint
+    out3 = _run([os.path.join(EX, "image-classification", "score.py"),
+                 "--model-prefix", str(tmp_path / "mnist"),
+                 "--load-epoch", "3", "--image-shape", "1,28,28",
+                 "--num-examples", "256"])
+    assert "accuracy=" in out3
+
+
+def test_train_mnist_lenet():
+    out = _run([os.path.join(EX, "image-classification", "train_mnist.py"),
+                "--network", "lenet", "--num-epochs", "1",
+                "--num-examples", "256", "--batch-size", "32",
+                "--ctx", "cpu"])
+    assert "Train-accuracy" in out
+
+
+def test_train_cifar10_resnet():
+    out = _run([os.path.join(EX, "image-classification",
+                             "train_cifar10.py"),
+                "--num-epochs", "1", "--num-examples", "256",
+                "--batch-size", "64", "--num-layers", "8",
+                "--ctx", "cpu"])
+    assert "Train-accuracy" in out and "Validation-accuracy" in out
+
+
+def test_word_lm():
+    out = _run([os.path.join(EX, "rnn", "word_lm.py"),
+                "--epochs", "2", "--vocab", "50", "--batch-size", "8",
+                "--bptt", "16", "--emsize", "32", "--nhid", "32",
+                "--nlayers", "1"])
+    assert "final perplexity" in out
+
+
+def test_cifar10_dist():
+    out = _run(["-m", "mxnet_tpu.tools.launch", "-n", "2",
+                "--platform", "cpu", "--",
+                sys.executable,
+                os.path.join(EX, "distributed_training",
+                             "cifar10_dist.py"),
+                "--num-epochs", "1", "--num-examples", "256",
+                "--batch-size", "32"])
+    assert "worker 0 done" in out and "worker 1 done" in out
+
+
+def test_quantization_example(tmp_path):
+    out = _run([os.path.join(EX, "quantization", "quantize_model.py"),
+                "--out-prefix", str(tmp_path / "qmodel"),
+                "--num-calib-examples", "64"])
+    assert "fp32 accuracy" in out and "int8 accuracy" in out
+    assert (tmp_path / "qmodel-symbol.json").exists()
