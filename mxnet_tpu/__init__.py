@@ -56,6 +56,7 @@ from .config import config  # noqa: F401  (mx.config = the knob registry;
 #                            the module stays importable as mxnet_tpu.config
 #                            via sys.modules and has the same describe())
 from . import runtime  # noqa: F401
+from . import rtc  # noqa: F401
 
 if config.profiler_autostart:
     profiler.start()

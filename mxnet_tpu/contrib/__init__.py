@@ -1,3 +1,4 @@
 """Contrib namespace (reference: ``python/mxnet/contrib/``)."""
 from . import quantization  # noqa: F401
 from .quantization import quantize_model  # noqa: F401
+from . import onnx  # noqa: F401

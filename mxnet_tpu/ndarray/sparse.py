@@ -21,9 +21,19 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Dense-backed row_sparse: keeps .indices/.data views for API parity."""
+    """Dense-backed row_sparse: keeps .indices/.data views for API parity.
 
-    __slots__ = ("_indices",)
+    ``indices`` are cached: construction from (data, indices) stores them
+    directly (no host scan ever); dense-derived arrays compute the nonzero
+    rows once and reuse the result until the array is mutated.
+    """
+
+    __slots__ = ("_indices", "_indices_nd")
+
+    def __init__(self, data, ctx=None, indices=None):
+        super().__init__(data, ctx=ctx)
+        self._indices = indices  # np.int64 array or None (lazy)
+        self._indices_nd = None  # cached device wrapper
 
     @property
     def stype(self):
@@ -31,14 +41,35 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        nz = np.nonzero(np.abs(self.asnumpy()).reshape(self.shape[0], -1)
-                        .sum(axis=1))[0]
-        return _dense_array(nz.astype(np.int64), dtype="int64")
+        if self._indices is None:
+            self._indices = np.nonzero(
+                np.abs(self.asnumpy()).reshape(self.shape[0], -1)
+                .sum(axis=1))[0].astype(np.int64)
+        if self._indices_nd is None:
+            self._indices_nd = _dense_array(self._indices, dtype="int64")
+        return self._indices_nd
+
+    def _set_data(self, value):
+        super()._set_data(value)
+        self._indices = None  # mutation invalidates the cached rows
+        self._indices_nd = None
 
     @property
     def values(self):
         idx = self.indices.asnumpy().astype(np.int64)
         return _wrap(self._data[idx])
+
+    def retain(self, rsp_indices):
+        """Keep only the given rows, zero the rest (reference
+        sparse.retain — used by kvstore row_sparse flows)."""
+        keep = np.asarray(
+            rsp_indices.asnumpy() if isinstance(rsp_indices, NDArray)
+            else rsp_indices).astype(np.int64)
+        mask = np.zeros(self.shape[0], bool)
+        mask[keep] = True
+        dense = jnp.where(jnp.asarray(mask).reshape(
+            (-1,) + (1,) * (len(self.shape) - 1)), self._data, 0)
+        return RowSparseNDArray(dense, ctx=self._ctx, indices=np.sort(keep))
 
     def tostype(self, stype):
         if stype == "default":
@@ -77,8 +108,8 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         dense = np.zeros(full_shape, dtype=data.dtype)
         if len(indices):
             dense[indices] = data
-        out = RowSparseNDArray(jnp.asarray(dense), ctx=ctx)
-        return out
+        return RowSparseNDArray(jnp.asarray(dense), ctx=ctx,
+                                indices=np.sort(indices))
     src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
     return RowSparseNDArray(jnp.asarray(src.astype(dtype or src.dtype)), ctx=ctx)
 
@@ -106,3 +137,25 @@ def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "csr":
         return CSRNDArray(z, ctx=ctx)
     return _wrap(z, ctx)
+
+
+def retain(data, indices):
+    """Module-level retain (reference mx.nd.sparse.retain)."""
+    assert isinstance(data, RowSparseNDArray)
+    return data.retain(indices)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse dot (reference mx.nd.sparse.dot: csr x dense, dense x csr).
+
+    Dense-backed storage means XLA's dense dot IS the kernel — on TPU the
+    MXU makes this faster than emulated sparse gather-matmul for the
+    densities these workloads see.
+    """
+    a = lhs.data
+    b = rhs.data
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return _wrap(jnp.matmul(a, b))

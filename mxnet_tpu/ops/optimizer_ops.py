@@ -290,3 +290,51 @@ def _lamb_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     trust = jnp.where(jnp.logical_and(wnorm > 0, unorm > 0),
                       jnp.clip(wnorm, lower_bound, upper_bound) / unorm, 1.0)
     return weight - lr * trust * update, m, v
+
+
+# ---------------------------------------------------------------------------
+# Row-sparse (lazy) updates — reference: the row_sparse stype kernels of
+# sgd/adam in src/operator/optimizer_op.cc ("lazy update": only rows that
+# appear in the gradient's indices are touched, so untouched rows keep
+# their state unchanged — semantics that matter for adaptive optimizers on
+# embedding tables).
+# ---------------------------------------------------------------------------
+@register("_sparse_sgd_update", input_names=("weight", "grad", "indices"),
+          mutate={0: 0}, array_params=_AP, no_grad=True)
+def _sparse_sgd_update(weight, grad, indices, lr=0.01, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    idx = indices.astype(jnp.int32)
+    g = _prep(grad[idx], rescale_grad, clip_gradient)
+    rows = weight[idx]
+    return weight.at[idx].set(rows - lr * (g + wd * rows))
+
+
+@register("_sparse_sgd_mom_update",
+          input_names=("weight", "grad", "indices", "mom"),
+          mutate={0: 0, 1: 3}, array_params=_AP, no_grad=True)
+def _sparse_sgd_mom_update(weight, grad, indices, mom, lr=0.01,
+                           momentum=0.0, wd=0.0, rescale_grad=1.0,
+                           clip_gradient=-1.0):
+    idx = indices.astype(jnp.int32)
+    g = _prep(grad[idx], rescale_grad, clip_gradient)
+    rows = weight[idx]
+    new_mom_rows = momentum * mom[idx] - lr * (g + wd * rows)
+    return (weight.at[idx].set(rows + new_mom_rows),
+            mom.at[idx].set(new_mom_rows))
+
+
+@register("_sparse_adam_update",
+          input_names=("weight", "grad", "indices", "mean", "var"),
+          mutate={0: 0, 1: 3, 2: 4}, array_params=_AP, no_grad=True)
+def _sparse_adam_update(weight, grad, indices, mean, var, lr=0.001,
+                        beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    # lr arrives with bias correction pre-folded, like dense adam_update
+    idx = indices.astype(jnp.int32)
+    rows = weight[idx]
+    g = _prep(grad[idx], rescale_grad, clip_gradient) + wd * rows
+    m = beta1 * mean[idx] + (1 - beta1) * g
+    v = beta2 * var[idx] + (1 - beta2) * g * g
+    new_rows = rows - lr * m / (jnp.sqrt(v) + epsilon)
+    return (weight.at[idx].set(new_rows), mean.at[idx].set(m),
+            var.at[idx].set(v))

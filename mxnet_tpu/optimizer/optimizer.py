@@ -19,6 +19,36 @@ from .. import ndarray as nd
 from ..ndarray import NDArray, zeros
 from ..ops.registry import invoke
 
+
+def _row_sparse_indices(grad):
+    """The gradient's explicit row indices when it is a RowSparseNDArray
+    (None otherwise) — the trigger for lazy row-sparse update kernels.
+
+    Indices are padded to the next power-of-two length (by repeating the
+    first index, which is harmless for the .set-based kernels: duplicate
+    rows write the identical value) so the jitted update compiles per
+    size *bucket*, not per distinct nonzero count.
+    """
+    from ..ndarray.sparse import RowSparseNDArray
+
+    if not isinstance(grad, RowSparseNDArray):
+        return None
+    idx = grad.indices
+    n = idx.shape[0]
+    if n == 0:
+        return None  # nothing to update; caller falls back to dense
+    cap = grad.shape[0]
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    bucket = min(bucket, cap)
+    if bucket == n:
+        return idx
+    raw = idx.asnumpy()
+    padded = np.concatenate([raw, np.full(bucket - n, raw[0],
+                                          raw.dtype)])
+    return nd.array(padded, dtype="int64")
+
 __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "DCASGD", "NAG",
            "SGLD", "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
            "Nadam", "AdamW", "LBSGD", "LAMB", "Test", "Updater", "get_updater",
@@ -234,7 +264,19 @@ class SGD(Optimizer):
         self._update_count(index)
         kwargs = self._common_kwargs(index)
         if not multi_precision:
-            if state is not None:
+            idx = _row_sparse_indices(grad) if self.lazy_update else None
+            if idx is not None:
+                # lazy row-sparse update: only rows present in the
+                # gradient are touched (reference optimizer_op.cc
+                # row_sparse sgd kernels)
+                if state is not None:
+                    invoke("_sparse_sgd_mom_update",
+                           [weight, grad, idx, state],
+                           dict(momentum=self.momentum, **kwargs))
+                else:
+                    invoke("_sparse_sgd_update", [weight, grad, idx],
+                           kwargs)
+            elif state is not None:
                 invoke("sgd_mom_update", [weight, grad, state],
                        dict(momentum=self.momentum, **kwargs))
             else:
@@ -388,9 +430,15 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         kwargs["lr"] = kwargs["lr"] * math.sqrt(coef2) / coef1
         mean, var = state
-        invoke("adam_update", [weight, grad, mean, var],
-               dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-                    **kwargs))
+        idx = _row_sparse_indices(grad) if self.lazy_update else None
+        if idx is not None:
+            invoke("_sparse_adam_update", [weight, grad, idx, mean, var],
+                   dict(beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon, **kwargs))
+        else:
+            invoke("adam_update", [weight, grad, mean, var],
+                   dict(beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon, **kwargs))
 
 
 @register

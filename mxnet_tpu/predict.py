@@ -84,8 +84,24 @@ class Predictor:
                              if n not in arg_params]
         missing = [n for n in self._input_names if n not in input_shapes]
         if missing:
-            raise ValueError("input_shapes must cover the data inputs; "
-                             "missing %s" % missing)
+            # label inputs of training heads (SoftmaxOutput etc.) are
+            # inert at inference: infer their shapes from the data inputs
+            # and bind zeros (reference c_predict_api binds them too)
+            try:
+                inferred, _, _ = sym.infer_shape(**input_shapes)
+                by_name = dict(zip(sym.list_arguments(), inferred))
+            except Exception:
+                by_name = {}
+            still = []
+            for n in missing:
+                shp = by_name.get(n)
+                if shp is not None and n.endswith("label"):
+                    input_shapes[n] = shp
+                else:
+                    still.append(n)
+            if still:
+                raise ValueError("input_shapes must cover the data "
+                                 "inputs; missing %s" % still)
 
         args = {}
         for name in sym.list_arguments():

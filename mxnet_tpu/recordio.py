@@ -26,21 +26,72 @@ _LEN_MASK = (1 << 29) - 1
 _CFLAG_SHIFT = 29
 
 
+def _load_native():
+    """The C++ RecordIO backend (native/recordio.cc — the dmlc-core
+    analogue), when built.  MXNET_RECORDIO_BACKEND=python forces the
+    pure-python path."""
+    if os.environ.get("MXNET_RECORDIO_BACKEND") == "python":
+        return None
+    import ctypes
+
+    so = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "libmxtpu_recordio.so")
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.rio_open.restype = ctypes.c_void_p
+    lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rio_close.argtypes = [ctypes.c_void_p]
+    lib.rio_tell.restype = ctypes.c_int64
+    lib.rio_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint64]
+    lib.rio_read.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                             ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.rio_last_error.restype = ctypes.c_char_p
+    return lib
+
+
+_NATIVE = _load_native()
+
+
 class MXRecordIO:
-    """Sequential record reader/writer (reference recordio.py:37)."""
+    """Sequential record reader/writer (reference recordio.py:37).
+
+    Uses the native C++ backend when ``native/libmxtpu_recordio.so`` is
+    built (``make -C native``); transparently falls back to pure-python
+    file IO otherwise.  Both speak the identical dmlc on-disk format.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
+        self._h = None
         if flag not in ("r", "w"):
             raise ValueError("flag must be 'r' or 'w'")
         self.open()
 
     def open(self):
-        self.fp = open(self.uri, "rb" if self.flag == "r" else "wb")
         self.writable = self.flag == "w"
+        if _NATIVE is not None:
+            self.fp = None
+            self._h = _NATIVE.rio_open(self.uri.encode(),
+                                       1 if self.writable else 0)
+            if not self._h:
+                raise IOError(_NATIVE.rio_last_error().decode())
+        else:
+            self.fp = open(self.uri, "rb" if self.flag == "r" else "wb")
 
     def close(self):
+        if self._h is not None:
+            _NATIVE.rio_close(self._h)
+            self._h = None
         if self.fp is not None:
             self.fp.close()
             self.fp = None
@@ -60,7 +111,16 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._h is not None:
+            return _NATIVE.rio_tell(self._h)
         return self.fp.tell()
+
+    def _seek(self, pos):
+        if self._h is not None:
+            if _NATIVE.rio_seek(self._h, pos) != 0:
+                raise IOError(_NATIVE.rio_last_error().decode())
+        else:
+            self.fp.seek(pos)
 
     def _write_chunk(self, cflag, chunk):
         lrec = (cflag << 29) | len(chunk)
@@ -77,6 +137,10 @@ class MXRecordIO:
             raise ValueError("record too large (%d bytes, max %d)"
                              % (n, _LEN_MASK))
         buf = bytes(buf)
+        if self._h is not None:
+            if _NATIVE.rio_write(self._h, buf, n) != 0:
+                raise IOError(_NATIVE.rio_last_error().decode())
+            return
         # dmlc framing: payloads containing the magic word at 4-byte-aligned
         # offsets are split there into continuation parts (cflag 1=begin,
         # 2=middle, 3=end); the reader re-inserts the magic between parts
@@ -102,6 +166,21 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._h is not None:
+            import ctypes
+
+            buf = ctypes.POINTER(ctypes.c_char)()
+            blen = ctypes.c_uint64()
+            rc = _NATIVE.rio_read(self._h, ctypes.byref(buf),
+                                  ctypes.byref(blen))
+            if rc == 1:
+                return None
+            if rc != 0:
+                raise IOError(_NATIVE.rio_last_error().decode())
+            try:
+                return ctypes.string_at(buf, blen.value)
+            finally:
+                _NATIVE.rio_free(buf)
         out = None
         magic_bytes = struct.pack("<I", _MAGIC)
         while True:
@@ -168,7 +247,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.fp.seek(self.idx[idx])
+        self._seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,253 @@
+// C predict ABI over an embedded CPython running mxnet_tpu.predict
+// (reference: src/c_api/c_predict_api.cc — there the ABI fronts the C++
+// GraphExecutor; here the executor IS an XLA module reached through
+// Python, so the native layer embeds the interpreter and marshals
+// buffers).  Thread-safe via the GIL; errors land in MXGetLastError.
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct PredictorObj {
+  PyObject* predictor = nullptr;      // mxnet_tpu.predict.Predictor
+  std::vector<mx_uint> shape_buf;     // backing for MXPredGetOutputShape
+};
+
+int fail(const std::string& msg) {
+  g_error = msg;
+  return -1;
+}
+
+int fail_py(const char* what) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = what;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return fail(msg);
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization so PyGILState_Ensure
+    // works from any caller thread
+    PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError(void) { return g_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (!mod) return fail_py("import mxnet_tpu.predict failed");
+  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (!cls) return fail_py("Predictor class not found");
+
+  PyObject* shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* json = PyUnicode_FromString(symbol_json_str);
+
+  PyObject* ctx = nullptr;
+  {
+    PyObject* ctxmod = PyImport_ImportModule("mxnet_tpu.context");
+    if (ctxmod) {
+      const char* maker = (dev_type == 1) ? "cpu" : "tpu";
+      PyObject* fn = PyObject_GetAttrString(ctxmod, maker);
+      if (fn) {
+        ctx = PyObject_CallFunction(fn, "i", dev_id);
+        Py_DECREF(fn);
+      }
+      Py_DECREF(ctxmod);
+    }
+    if (!ctx) {
+      Py_DECREF(cls);
+      Py_DECREF(shapes);
+      Py_DECREF(params);
+      Py_DECREF(json);
+      return fail_py("context creation failed");
+    }
+  }
+
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "ctx", ctx);
+  PyDict_SetItemString(kwargs, "input_shapes", shapes);
+  PyObject* args = PyTuple_Pack(2, json, params);
+  PyObject* pred = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(cls);
+  Py_DECREF(shapes);
+  Py_DECREF(params);
+  Py_DECREF(json);
+  Py_DECREF(ctx);
+  if (!pred) return fail_py("Predictor construction failed");
+
+  auto* obj = new PredictorObj();
+  obj->predictor = pred;
+  *out = obj;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const mx_float* data, mx_uint size) {
+  Gil gil;
+  auto* obj = static_cast<PredictorObj*>(handle);
+  // shape of this input comes from the predictor's executor binding
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) return fail_py("numpy import failed");
+  PyObject* frombuf = PyObject_GetAttrString(np, "frombuffer");
+  PyObject* mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<mx_float*>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject* view =
+      PyObject_CallFunction(frombuf, "Os", mem, "float32");
+  Py_DECREF(frombuf);
+  Py_DECREF(mem);
+  Py_DECREF(np);
+  if (!view) return fail_py("input buffer conversion failed");
+  // copy out of the caller's buffer NOW — the reference ABI copies
+  // synchronously, and the zero-copy view would alias freed memory if
+  // the caller releases its buffer before forward()
+  PyObject* flat = PyObject_CallMethod(view, "copy", nullptr);
+  Py_DECREF(view);
+  if (!flat) return fail_py("input copy failed");
+
+  // reshape to the bound input's shape
+  PyObject* exec = PyObject_GetAttrString(obj->predictor, "_executor");
+  PyObject* arg_dict = exec ? PyObject_GetAttrString(exec, "arg_dict")
+                            : nullptr;
+  PyObject* bound =
+      arg_dict ? PyMapping_GetItemString(arg_dict, key) : nullptr;
+  PyObject* shape = bound ? PyObject_GetAttrString(bound, "shape")
+                          : nullptr;
+  Py_XDECREF(exec);
+  Py_XDECREF(arg_dict);
+  Py_XDECREF(bound);
+  if (!shape) {
+    Py_DECREF(flat);
+    return fail_py("unknown input key");
+  }
+  PyObject* reshaped =
+      PyObject_CallMethod(flat, "reshape", "O", shape);
+  Py_DECREF(flat);
+  Py_DECREF(shape);
+  if (!reshaped) return fail_py("input reshape failed");
+  PyObject* r = PyObject_CallMethod(obj->predictor, "set_input", "sO",
+                                    key, reshaped);
+  Py_DECREF(reshaped);
+  if (!r) return fail_py("set_input failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  auto* obj = static_cast<PredictorObj*>(handle);
+  PyObject* r = PyObject_CallMethod(obj->predictor, "forward", nullptr);
+  if (!r) return fail_py("forward failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim) {
+  Gil gil;
+  auto* obj = static_cast<PredictorObj*>(handle);
+  // get_output_shape works before the first forward too (it infers from
+  // the binding), matching the reference ABI's buffer-sizing flow
+  PyObject* shape = PyObject_CallMethod(obj->predictor,
+                                        "get_output_shape", "I", index);
+  if (!shape) return fail_py("get_output_shape failed");
+  Py_ssize_t n = PyTuple_Size(shape);
+  obj->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    obj->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = obj->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float* data,
+                    mx_uint size) {
+  Gil gil;
+  auto* obj = static_cast<PredictorObj*>(handle);
+  PyObject* out = PyObject_CallMethod(obj->predictor, "get_output", "I",
+                                      index);
+  if (!out) return fail_py("get_output failed");
+  PyObject* arr = PyObject_CallMethod(out, "asnumpy", nullptr);
+  Py_DECREF(out);
+  if (!arr) return fail_py("asnumpy failed");
+  PyObject* f32 = PyObject_CallMethod(arr, "astype", "s", "float32");
+  Py_DECREF(arr);
+  if (!f32) return fail_py("astype failed");
+  PyObject* bytes = PyObject_CallMethod(f32, "tobytes", nullptr);
+  Py_DECREF(f32);
+  if (!bytes) return fail_py("tobytes failed");
+  Py_ssize_t blen = PyBytes_Size(bytes);
+  if (static_cast<Py_ssize_t>(size) * 4 < blen) {
+    Py_DECREF(bytes);
+    return fail("output buffer too small");
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), blen);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  auto* obj = static_cast<PredictorObj*>(handle);
+  Py_XDECREF(obj->predictor);
+  delete obj;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,56 @@
+/*
+ * C predict API (reference: include/mxnet/c_predict_api.h:1-283 — the
+ * standalone inference ABI used by the cpp/matlab/amalgamation frontends).
+ *
+ * Same function surface and calling conventions; the implementation
+ * (c_predict_api.cc) embeds CPython and drives mxnet_tpu.predict.Predictor,
+ * whose executor is one AOT-compiled XLA module on TPU.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Last error message for this thread (reference MXGetLastError). */
+const char *MXGetLastError(void);
+
+/* Create a predictor from a symbol JSON string + a .params blob.
+ * input_keys/input_shape_indptr/input_shape_data describe the data
+ * inputs exactly like the reference: shapes of input i are
+ * input_shape_data[indptr[i] .. indptr[i+1]).  dev_type: 1 = cpu,
+ * 2 = accelerator (tpu).  Returns 0 on success, -1 on error. */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Copy data into the named input (reference MXPredSetInput). */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the forward pass (reference MXPredForward). */
+int MXPredForward(PredictorHandle handle);
+
+/* Shape of output `index`; pointers are valid until the next call on
+ * this handle (reference MXPredGetOutputShape). */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy output `index` into user memory (reference MXPredGetOutput). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+/* Free the predictor (reference MXPredFree). */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,163 @@
+// Native RecordIO reader/writer (reference: dmlc-core's recordio
+// implementation used by src/io/ — SURVEY.md layer 0).  Bit-identical
+// framing with mxnet_tpu/recordio.py: magic 0xced7230a, uint32 whose top 3
+// bits are the continuation flag and low 29 bits the payload length,
+// payloads containing the magic at 4-byte-aligned offsets split into
+// continuation parts (1=begin, 2=middle, 3=end; the reader re-inserts the
+// magic), 4-byte record alignment.
+//
+// Exposed as a flat C ABI loaded via ctypes (mxnet_tpu/recordio.py picks
+// it up when built; pure-python fallback otherwise).  Build: `make -C
+// native` -> libmxtpu_recordio.so.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+thread_local std::string g_error;
+
+struct RioFile {
+  FILE* fp = nullptr;
+  bool writable = false;
+};
+
+int fail(const std::string& msg) {
+  g_error = msg;
+  return -1;
+}
+
+bool write_chunk(RioFile* f, uint32_t cflag, const char* data, size_t len) {
+  uint32_t lrec = (cflag << 29) | static_cast<uint32_t>(len);
+  if (std::fwrite(&kMagic, 4, 1, f->fp) != 1) return false;
+  if (std::fwrite(&lrec, 4, 1, f->fp) != 1) return false;
+  if (len && std::fwrite(data, 1, len, f->fp) != len) return false;
+  size_t pad = (4 - len % 4) % 4;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, f->fp) != pad) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* rio_last_error() { return g_error.c_str(); }
+
+void* rio_open(const char* path, int writable) {
+  FILE* fp = std::fopen(path, writable ? "wb" : "rb");
+  if (!fp) {
+    g_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  auto* f = new RioFile();
+  f->fp = fp;
+  f->writable = writable != 0;
+  return f;
+}
+
+int rio_close(void* h) {
+  auto* f = static_cast<RioFile*>(h);
+  if (f) {
+    if (f->fp) std::fclose(f->fp);
+    delete f;
+  }
+  return 0;
+}
+
+int64_t rio_tell(void* h) {
+  return std::ftell(static_cast<RioFile*>(h)->fp);
+}
+
+int rio_seek(void* h, int64_t pos) {
+  return std::fseek(static_cast<RioFile*>(h)->fp, pos, SEEK_SET) == 0
+             ? 0
+             : fail("seek failed");
+}
+
+int rio_write(void* h, const char* buf, uint64_t len) {
+  auto* f = static_cast<RioFile*>(h);
+  if (!f->writable) return fail("file not opened for writing");
+  if (len > kLenMask) return fail("record too large");
+  // split at 4-byte-aligned occurrences of the magic word
+  std::vector<std::pair<const char*, size_t>> parts;
+  size_t start = 0;
+  for (size_t pos = 0; pos + 4 <= len; pos += 4) {
+    uint32_t word;
+    std::memcpy(&word, buf + pos, 4);
+    if (word == kMagic) {
+      parts.emplace_back(buf + start, pos - start);
+      start = pos + 4;
+      // next scan position is the following aligned word (loop += 4)
+    }
+  }
+  parts.emplace_back(buf + start, len - start);
+  bool ok;
+  if (parts.size() == 1) {
+    ok = write_chunk(f, 0, buf, len);
+  } else {
+    ok = write_chunk(f, 1, parts.front().first, parts.front().second);
+    for (size_t i = 1; ok && i + 1 < parts.size(); ++i)
+      ok = write_chunk(f, 2, parts[i].first, parts[i].second);
+    if (ok)
+      ok = write_chunk(f, 3, parts.back().first, parts.back().second);
+  }
+  return ok ? 0 : fail("short write");
+}
+
+// returns 0 on success, 1 at EOF, -1 on error
+int rio_read(void* h, char** out, uint64_t* out_len) {
+  auto* f = static_cast<RioFile*>(h);
+  if (f->writable) return fail("file not opened for reading");
+  std::string acc;
+  bool in_multi = false;
+  while (true) {
+    uint32_t magic, lrec;
+    size_t got = std::fread(&magic, 4, 1, f->fp);
+    if (got != 1) {
+      if (in_multi) return fail("truncated multi-part record at EOF");
+      return 1;  // clean EOF
+    }
+    if (std::fread(&lrec, 4, 1, f->fp) != 1)
+      return fail("truncated header");
+    if (magic != kMagic) return fail("invalid RecordIO magic");
+    uint32_t cflag = lrec >> 29;
+    uint32_t n = lrec & kLenMask;
+    std::string buf(n, '\0');
+    if (n && std::fread(&buf[0], 1, n, f->fp) != n)
+      return fail("truncated payload");
+    size_t pad = (4 - n % 4) % 4;
+    char sink[4];
+    if (pad && std::fread(sink, 1, pad, f->fp) != pad)
+      return fail("truncated padding");
+    if (cflag == 0) {
+      if (in_multi) return fail("whole record inside multi-part record");
+      acc = std::move(buf);
+      break;
+    }
+    if (cflag == 1) {
+      if (in_multi) return fail("begin part inside multi-part record");
+      in_multi = true;
+      acc = std::move(buf);
+    } else {
+      if (!in_multi) return fail("continuation without a begin part");
+      acc.append(reinterpret_cast<const char*>(&kMagic), 4);
+      acc.append(buf);
+      if (cflag == 3) break;
+    }
+  }
+  *out_len = acc.size();
+  *out = static_cast<char*>(std::malloc(acc.size() ? acc.size() : 1));
+  std::memcpy(*out, acc.data(), acc.size());
+  return 0;
+}
+
+void rio_free(char* buf) { std::free(buf); }
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+/* C client for the predict ABI (reference: the cpp predict examples over
+ * c_predict_api.h).  Usage: test_client <symbol.json> <model.params>
+ * <batch> <feature_dim>.  Loads the exported model, feeds a ramp input,
+ * prints the argmax of each row's output. */
+#include "c_predict_api.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(1);
+  }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fprintf(stderr, "short read on %s\n", path);
+    exit(1);
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr,
+            "usage: %s symbol.json model.params batch feature_dim\n",
+            argv[0]);
+    return 2;
+  }
+  long json_size, param_size;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  mx_uint batch = (mx_uint)atoi(argv[3]);
+  mx_uint dim = (mx_uint)atoi(argv[4]);
+
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {batch, dim};
+  PredictorHandle h = NULL;
+  if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, keys, indptr,
+                   shape, &h) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint n = batch * dim;
+  mx_float *input = (mx_float *)malloc(n * sizeof(mx_float));
+  for (mx_uint i = 0; i < n; ++i)
+    input[i] = (mx_float)(i % dim) / (mx_float)dim - 0.5f;
+  if (MXPredSetInput(h, "data", input, n) != 0 || MXPredForward(h) != 0) {
+    fprintf(stderr, "predict failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint osize = 1;
+  printf("output shape: (");
+  for (mx_uint i = 0; i < ondim; ++i) {
+    osize *= oshape[i];
+    printf(i ? ", %u" : "%u", oshape[i]);
+  }
+  printf(")\n");
+
+  mx_float *out = (mx_float *)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(h, 0, out, osize) != 0) {
+    fprintf(stderr, "get_output failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint classes = oshape[ondim - 1];
+  for (mx_uint b = 0; b < batch && b < 4; ++b) {
+    mx_uint best = 0;
+    for (mx_uint c = 1; c < classes; ++c)
+      if (out[b * classes + c] > out[b * classes + best]) best = c;
+    printf("row %u argmax %u\n", b, best);
+  }
+  MXPredFree(h);
+  printf("C_PREDICT_OK\n");
+  free(json);
+  free(params);
+  free(input);
+  free(out);
+  return 0;
+}

@@ -1,0 +1,143 @@
+"""Native C++ layer tests: RecordIO backend parity + the C predict ABI.
+
+References: dmlc-core recordio (layer 0 of SURVEY §1),
+``include/mxnet/c_predict_api.h`` + the cpp predict example client.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def _built():
+    return os.path.exists(os.path.join(NATIVE, "libmxtpu_recordio.so"))
+
+
+def _ensure_built():
+    r = subprocess.run(["make", "-C", NATIVE], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_native_backend_loaded():
+    _ensure_built()
+    # the module-level probe ran at import; if the .so existed then, the
+    # native backend must be active (rebuild happens in CI before tests)
+    if recordio._NATIVE is None:
+        pytest.skip("native lib was not built at import time")
+    assert recordio._NATIVE.rio_last_error is not None
+
+
+RECORDS = [b"hello", b"x" * 1000, b"", b"\x0a\x23\xd7\xce" * 3,
+           # payload containing the magic at an aligned offset must be
+           # split into continuation parts and reassembled
+           b"abcd" + bytes.fromhex("0a23d7ce") + b"efgh",
+           bytes.fromhex("0a23d7ce"),
+           np.random.RandomState(0).bytes(4096)]
+
+
+def _roundtrip(writer_env, reader_env, tmp_path, name):
+    """Write with one backend, read with the other — files must be
+    bit-compatible both ways."""
+    path = str(tmp_path / name)
+    code_w = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from mxnet_tpu import recordio\n"
+        "import numpy as np\n"
+        "recs = %r\n"
+        "r = recordio.MXRecordIO(%r, 'w')\n"
+        "[r.write(x) for x in recs]\n"
+        "r.close()\n" % (REPO, RECORDS, path))
+    code_r = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from mxnet_tpu import recordio\n"
+        "r = recordio.MXRecordIO(%r, 'r')\n"
+        "out = []\n"
+        "while True:\n"
+        "    s = r.read()\n"
+        "    if s is None: break\n"
+        "    out.append(s)\n"
+        "recs = %r\n"
+        "assert out == list(recs), 'mismatch'\n"
+        "print('READ_OK')\n" % (REPO, path, RECORDS))
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, "-c", code_w],
+                       env={**env_base, **writer_env}, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([sys.executable, "-c", code_r],
+                       env={**env_base, **reader_env}, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0 and "READ_OK" in r.stdout, r.stderr
+
+
+def test_recordio_native_writes_python_reads(tmp_path):
+    _ensure_built()
+    _roundtrip({}, {"MXNET_RECORDIO_BACKEND": "python"}, tmp_path, "a.rec")
+
+
+def test_recordio_python_writes_native_reads(tmp_path):
+    _ensure_built()
+    _roundtrip({"MXNET_RECORDIO_BACKEND": "python"}, {}, tmp_path, "b.rec")
+
+
+def test_indexed_recordio_native(tmp_path):
+    _ensure_built()
+    if recordio._NATIVE is None:
+        pytest.skip("native lib not loaded in this process")
+    idx = str(tmp_path / "c.idx")
+    rec = str(tmp_path / "c.rec")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, b"rec%03d" % i + b"\x00" * (i % 7))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    for i in (7, 0, 19, 3):
+        assert r.read_idx(i).startswith(b"rec%03d" % i)
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# C predict ABI
+# ---------------------------------------------------------------------------
+def test_c_predict_client(tmp_path):
+    """Train -> save_checkpoint -> C client loads + predicts via the
+    MXPred* ABI (reference cpp predict example flow)."""
+    r = subprocess.run(["make", "-C", NATIVE, "test_client"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # train a tiny model whose prediction the client can sanity-check
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-0.5, 0.5, (256, 8)).astype(np.float32)
+    Y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, 32, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 8)
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    r = subprocess.run(
+        [os.path.join(NATIVE, "test_client"), prefix + "-symbol.json",
+         prefix + "-0008.params", "4", "8"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "C_PREDICT_OK" in r.stdout, r.stdout
+    assert "output shape: (4, 2)" in r.stdout, r.stdout

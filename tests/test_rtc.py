@@ -1,0 +1,51 @@
+"""mx.rtc tests (reference: tests for mx.rtc.CudaModule — compile source
+text at runtime, fetch kernel, launch on device)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SRC = """
+def axpy(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.5 * x_ref[...] + y_ref[...]
+
+def scale(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 3.0
+"""
+
+
+def test_pallas_module_from_source():
+    mod = mx.rtc.PallasModule(SRC, exports=["axpy", "scale"])
+    k = mod.get_kernel("axpy")
+    x = mx.nd.array(np.arange(8, dtype=np.float32))
+    y = mx.nd.array(np.ones(8, dtype=np.float32))
+    out = k.launch([x, y])
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.5 * np.arange(8) + 1.0, rtol=1e-6)
+    s = mod.get_kernel("scale")
+    np.testing.assert_allclose(s.launch([x]).asnumpy(),
+                               np.arange(8) * 3.0, rtol=1e-6)
+    # launch cache reused across calls
+    assert len(k._compiled) == 1
+    k.launch([x, y])
+    assert len(k._compiled) == 1
+
+
+def test_pallas_module_from_callable():
+    def double(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + x_ref[...]
+
+    mod = mx.rtc.PallasModule(double)
+    out = mod.get_kernel("double").launch(
+        [mx.nd.array(np.full((4, 4), 3.0, np.float32))])
+    np.testing.assert_allclose(out.asnumpy(), 6.0)
+
+
+def test_pallas_module_errors():
+    mod = mx.rtc.PallasModule(SRC, exports=["axpy"])
+    with pytest.raises(ValueError):
+        mod.get_kernel("nonexistent")
+    with pytest.raises(ValueError):
+        mx.rtc.PallasModule("x = 1", exports=["missing_fn"])
+    with pytest.raises(NotImplementedError):
+        mx.rtc.CudaModule("__global__ void k() {}")

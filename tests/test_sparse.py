@@ -1,0 +1,138 @@
+"""Sparse NDArray + row-sparse optimizer tests (reference:
+tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py,
+and optimizer_op row_sparse kernel tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_construction_and_cached_indices():
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3) + 1
+    rsp = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 3))
+    # explicit construction: indices available with NO host scan
+    assert rsp._indices is not None
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    np.testing.assert_allclose(rsp.values.asnumpy(), vals)
+    dense = rsp.tostype("default").asnumpy()
+    assert dense[0].sum() == 0 and dense[2].sum() == 0
+    np.testing.assert_allclose(dense[[1, 3]], vals)
+    # dense-derived: computed lazily once, cached
+    rsp2 = sparse.row_sparse_array(dense)
+    assert rsp2._indices is None
+    np.testing.assert_array_equal(rsp2.indices.asnumpy(), [1, 3])
+    assert rsp2._indices is not None  # cached now
+    # mutation invalidates
+    rsp2[:] = np.zeros((5, 3), np.float32)
+    assert rsp2._indices is None
+    assert len(rsp2.indices.asnumpy()) == 0
+
+
+def test_retain():
+    vals = np.ones((3, 2), np.float32)
+    rsp = sparse.row_sparse_array((vals, [0, 2, 4]), shape=(6, 2))
+    kept = sparse.retain(rsp, mx.nd.array(np.array([0, 4])))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [0, 4])
+    d = kept.tostype("default").asnumpy()
+    assert d[2].sum() == 0 and d[0].sum() == 2 and d[4].sum() == 2
+
+
+def test_sparse_dot():
+    rng = np.random.RandomState(0)
+    dense = rng.randn(4, 6).astype(np.float32)
+    dense[1] = 0
+    csr = sparse.csr_matrix(dense)
+    rhs = mx.nd.array(rng.randn(6, 3).astype(np.float32))
+    out = sparse.dot(csr, rhs)
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs.asnumpy(),
+                               rtol=1e-5)
+    out_t = sparse.dot(csr, mx.nd.array(rng.randn(4, 3).astype(np.float32)),
+                       transpose_a=True)
+    assert out_t.shape == (6, 3)
+
+
+def test_sparse_sgd_lazy_update_touches_only_grad_rows():
+    """Rows absent from the sparse grad must be bit-identical after the
+    update — including when weight decay is on (the lazy semantic)."""
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    gvals = rng.randn(2, 4).astype(np.float32)
+    grad = sparse.row_sparse_array((gvals, [1, 4]), shape=(6, 4))
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=1.0)
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    np.testing.assert_array_equal(wn[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+    # touched rows follow dense sgd_mom math exactly
+    expect = w0[[1, 4]] + (-0.1 * (gvals + 0.01 * w0[[1, 4]]))
+    np.testing.assert_allclose(wn[[1, 4]], expect, rtol=1e-5)
+    # momentum state only on touched rows
+    mom = state.asnumpy()
+    assert np.abs(mom[[0, 2, 3, 5]]).sum() == 0
+
+
+def test_sparse_adam_lazy_update_state_isolation():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    g1 = sparse.row_sparse_array(
+        (rng.randn(1, 3).astype(np.float32), [2]), shape=(5, 3))
+    opt.update(0, w, g1, state)
+    mean, var = state
+    m = mean.asnumpy()
+    assert np.abs(m[[0, 1, 3, 4]]).sum() == 0 and np.abs(m[2]).sum() > 0
+    wn = w.asnumpy()
+    np.testing.assert_array_equal(wn[[0, 1, 3, 4]], w0[[0, 1, 3, 4]])
+    assert not np.allclose(wn[2], w0[2])
+
+
+def test_dense_vs_sparse_update_equivalence_on_full_support():
+    """A sparse grad covering every row must reproduce the dense update."""
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(4, 2).astype(np.float32)
+    g = rng.randn(4, 2).astype(np.float32)
+
+    w_dense = mx.nd.array(w0.copy())
+    opt_d = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    opt_d.update(0, w_dense, mx.nd.array(g), None)
+
+    w_sparse = mx.nd.array(w0.copy())
+    opt_s = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    gs = sparse.row_sparse_array((g, [0, 1, 2, 3]), shape=(4, 2))
+    opt_s.update(0, w_sparse, gs, None)
+    np.testing.assert_allclose(w_sparse.asnumpy(), w_dense.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_sparse_update_index_padding_correct():
+    """Indices are padded to a power-of-two bucket (repeating the first
+    index) — the duplicate writes must not change the result."""
+    rng = np.random.RandomState(5)
+    w0 = rng.randn(8, 2).astype(np.float32)
+    g = rng.randn(3, 2).astype(np.float32)  # nnz=3 -> bucket 4
+    gs = sparse.row_sparse_array((g, [0, 3, 6]), shape=(8, 2))
+    w = mx.nd.array(w0.copy())
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    opt.update(0, w, gs, None)
+    wn = w.asnumpy()
+    expect = w0.copy()
+    expect[[0, 3, 6]] -= 0.1 * g
+    np.testing.assert_allclose(wn, expect, rtol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    rng = np.random.RandomState(4)
+    w = rng.randn(8, 3).astype(np.float32)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((8, 3))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=mx.nd.array(np.array([1.0, 5.0])))
+    o = out.asnumpy()
+    np.testing.assert_allclose(o[[1, 5]], w[[1, 5]], rtol=1e-6)
+    assert np.abs(o[[0, 2, 3, 4, 6, 7]]).sum() == 0
