@@ -1,0 +1,27 @@
+/* Device context (reference: cpp-package/include/mxnet-cpp/base.h
+ * DeviceType + context.h).  dev_type 1 = cpu, 2 = tpu (the accelerator
+ * slot the reference uses for gpu). */
+#ifndef MXNET_CPP_CONTEXT_H_
+#define MXNET_CPP_CONTEXT_H_
+
+namespace mxnet {
+namespace cpp {
+
+enum class DeviceType : int { kCPU = 1, kTPU = 2 };
+
+class Context {
+ public:
+  Context(DeviceType type, int id) : type_(type), id_(id) {}
+  static Context cpu(int id = 0) { return Context(DeviceType::kCPU, id); }
+  static Context tpu(int id = 0) { return Context(DeviceType::kTPU, id); }
+  int dev_type() const { return static_cast<int>(type_); }
+  int dev_id() const { return id_; }
+
+ private:
+  DeviceType type_;
+  int id_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_CONTEXT_H_

@@ -1,0 +1,160 @@
+/* NDArray: RAII value type over the C ABI's NDArrayHandle.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/ndarray.h (shared-ptr blob
+ * over the C handle, SyncCopy* + WaitToRead sync points).  Here the
+ * handle fronts an mxnet_tpu NDArray whose buffer lives in TPU HBM;
+ * SyncCopyToCPU is the sync point where deferred XLA errors surface,
+ * matching the reference's engine semantics. */
+#ifndef MXNET_CPP_NDARRAY_H_
+#define MXNET_CPP_NDARRAY_H_
+
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/context.h"
+
+namespace mxnet {
+namespace cpp {
+
+enum class DType : int {
+  kFloat32 = 0,
+  kFloat64 = 1,
+  kFloat16 = 2,
+  kUint8 = 3,
+  kInt32 = 4,
+  kInt8 = 5,
+  kInt64 = 6,
+  kBfloat16 = 7,  // TPU-native extension
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  NDArray(const std::vector<mx_uint>& shape, const Context& ctx,
+          DType dtype = DType::kFloat32) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<mx_uint>(shape.size()),
+                          ctx.dev_type(), ctx.dev_id(),
+                          static_cast<int>(dtype), &h));
+    reset(h);
+  }
+
+  NDArray(const std::vector<float>& data,
+          const std::vector<mx_uint>& shape, const Context& ctx)
+      : NDArray(shape, ctx, DType::kFloat32) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  /* adopt a raw handle (e.g. from MXImperativeInvoke / MXNDArrayLoad) */
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  /* The float-typed copies require a float32 array: the C ABI copies in
+   * the array's dtype, so a wider dtype would overflow the caller's
+   * float buffer.  Use the raw C ABI for other dtypes. */
+  void SyncCopyFromCPU(const float* data, size_t size) {
+    RequireFloat32();
+    Check(MXNDArraySyncCopyFromCPU(handle(), data, size));
+  }
+
+  void SyncCopyToCPU(float* data, size_t size) const {
+    RequireFloat32();
+    Check(MXNDArraySyncCopyToCPU(handle(), data, size));
+  }
+
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint* data = nullptr;
+    Check(MXNDArrayGetShape(handle(), &ndim, &data));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  DType GetDType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle(), &dt));
+    return static_cast<DType>(dt);
+  }
+
+  Context GetContext() const {
+    int t = 0, i = 0;
+    Check(MXNDArrayGetContext(handle(), &t, &i));
+    return Context(static_cast<DeviceType>(t), i);
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle())); }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+  static void Save(const std::string& fname,
+                   const std::vector<NDArray>& arrays,
+                   const std::vector<std::string>& names = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto& a : arrays) hs.push_back(a.handle());
+    std::vector<const char*> keys;
+    for (const auto& n : names) keys.push_back(n.c_str());
+    Check(MXNDArraySave(fname.c_str(),
+                        static_cast<mx_uint>(hs.size()), hs.data(),
+                        names.empty() ? nullptr : keys.data()));
+  }
+
+  static std::vector<std::pair<std::string, NDArray>> Load(
+      const std::string& fname) {
+    mx_uint n = 0, nn = 0;
+    NDArrayHandle* hs = nullptr;
+    const char** names = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &hs, &nn, &names));
+    std::vector<std::pair<std::string, NDArray>> out;
+    for (mx_uint i = 0; i < n; ++i)
+      out.emplace_back(i < nn ? names[i] : "", FromHandle(hs[i]));
+    return out;
+  }
+
+  NDArrayHandle handle() const { return blob_ ? blob_->h : nullptr; }
+  bool empty() const { return !blob_; }
+
+ private:
+  void RequireFloat32() const {
+    if (GetDType() != DType::kFloat32)
+      throw std::runtime_error(
+          "float-typed copy on a non-float32 NDArray; use the C ABI");
+  }
+
+  struct Blob {
+    explicit Blob(NDArrayHandle handle) : h(handle) {}
+    ~Blob() {
+      if (h) MXNDArrayFree(h);
+    }
+    NDArrayHandle h;
+  };
+
+  void reset(NDArrayHandle h) { blob_ = std::make_shared<Blob>(h); }
+
+  std::shared_ptr<Blob> blob_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_NDARRAY_H_

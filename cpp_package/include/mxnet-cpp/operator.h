@@ -1,0 +1,103 @@
+/* Fluent by-name operator invoke.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/operator.h — Operator("name")
+ * .SetParam(...).SetInput(...).Invoke(); there the per-op wrappers are
+ * code-generated (OpWrapperGenerator.py) against the C registry.  Here
+ * the registry is the TPU op table (mxnet_tpu/ops/registry.py, 270+
+ * ops): MXListAllOpNames enumerates it and any registered name can be
+ * invoked; hyper-parameters travel as strings and are parsed backend-side
+ * against the op signature (the reference's dmlc::Parameter convention).
+ */
+#ifndef MXNET_CPP_OPERATOR_H_
+#define MXNET_CPP_OPERATOR_H_
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : name_(op_name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+    return *this;
+  }
+
+  Operator& SetParam(const std::string& key, bool value) {
+    keys_.push_back(key);
+    vals_.push_back(value ? "True" : "False");
+    return *this;
+  }
+
+  Operator& PushInput(const NDArray& array) {
+    inputs_.push_back(array);
+    return *this;
+  }
+
+  Operator& operator()(const NDArray& array) { return PushInput(array); }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<NDArrayHandle> ins;
+    for (const auto& a : inputs_) ins.push_back(a.handle());
+    std::vector<const char*> keys, vals;
+    for (const auto& k : keys_) keys.push_back(k.c_str());
+    for (const auto& v : vals_) vals.push_back(v.c_str());
+    int num_out = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXImperativeInvoke(name_.c_str(),
+                             static_cast<int>(ins.size()), ins.data(),
+                             &num_out, &outs,
+                             static_cast<int>(keys.size()), keys.data(),
+                             vals.data()));
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i)
+      result.push_back(NDArray::FromHandle(outs[i]));
+    return result;
+  }
+
+  NDArray InvokeOne() { return Invoke().at(0); }
+
+  static std::vector<std::string> ListAllOpNames() {
+    mx_uint n = 0;
+    const char** names = nullptr;
+    Check(MXListAllOpNames(&n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+ private:
+  std::string name_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<NDArray> inputs_;
+};
+
+/* convenience arithmetic (reference op.h generated wrappers) */
+inline NDArray operator+(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_add")(a)(b).InvokeOne();
+}
+inline NDArray operator-(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_sub")(a)(b).InvokeOne();
+}
+inline NDArray operator*(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_mul")(a)(b).InvokeOne();
+}
+inline NDArray operator/(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_div")(a)(b).InvokeOne();
+}
+inline NDArray dot(const NDArray& a, const NDArray& b) {
+  return Operator("dot")(a)(b).InvokeOne();
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_OPERATOR_H_

@@ -1,0 +1,129 @@
+"""Python half of the native imperative C ABI (``native/c_api.cc``).
+
+The reference routes every frontend through ``src/c_api/c_api.cc`` /
+``c_api_ndarray.cc:118-235`` (``MXImperativeInvokeEx``): handles are C++
+``NDArray*`` and hyper-parameters arrive as strings that the backend
+parses against each op's ``dmlc::Parameter`` signature.  Here the roles
+invert — the runtime is Python/XLA, so the embedded-C layer marshals
+into *this* module: handles are ``mxnet_tpu.ndarray.NDArray`` objects
+held by native code as ``PyObject*``, and this module does the
+string->typed-param parsing the reference does with dmlc parameter
+structs.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from . import context as _context
+from .ndarray import ndarray as _nd
+from .ndarray import utils as _nd_utils
+from .ops import registry as _registry
+
+# reference dtype codes: python/mxnet/base.py _DTYPE_MX_TO_NP; code 7 is
+# the TPU-native bfloat16 extension (the reference era predates bf16).
+_DTYPE_FROM_CODE = {
+    0: "float32",
+    1: "float64",
+    2: "float16",
+    3: "uint8",
+    4: "int32",
+    5: "int8",
+    6: "int64",
+    7: "bfloat16",
+}
+_CODE_FROM_DTYPE = {v: k for k, v in _DTYPE_FROM_CODE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    return _context.cpu(dev_id) if dev_type == 1 else _context.tpu(dev_id)
+
+
+def create(shape, dev_type, dev_id, dtype_code):
+    dtype = _DTYPE_FROM_CODE.get(int(dtype_code))
+    if dtype is None:
+        raise ValueError("unknown dtype code %r" % (dtype_code,))
+    return _nd.zeros(tuple(int(s) for s in shape),
+                     ctx=_ctx(dev_type, dev_id), dtype=dtype)
+
+
+def dtype_code(arr):
+    name = np.dtype(arr.dtype).name if arr.dtype != "bfloat16" else "bfloat16"
+    try:
+        return _CODE_FROM_DTYPE[str(name)]
+    except KeyError:
+        raise TypeError("dtype %r has no ABI code" % (name,))
+
+
+def context_of(arr):
+    c = arr.context
+    return (1 if c.device_type == "cpu" else 2), c.device_id
+
+
+def copy_from_bytes(arr, buf):
+    """Host->device: reinterpret ``buf`` in the array's dtype/shape."""
+    if str(arr.dtype) == "bfloat16":
+        import jax.numpy as jnp
+
+        host = np.frombuffer(buf, dtype=np.uint16).view(jnp.bfloat16.dtype)
+    else:
+        host = np.frombuffer(buf, dtype=np.dtype(str(arr.dtype)))
+    if host.size != arr.size:
+        raise ValueError("copy size %d != array size %d"
+                         % (host.size, arr.size))
+    arr._set_data(
+        _nd.array(host.reshape(arr.shape), ctx=arr.context,
+                  dtype=arr.dtype).data)
+    return arr
+
+
+def to_bytes(arr):
+    """Device->host: raw bytes in the array's dtype (sync point)."""
+    host = arr.asnumpy()
+    return np.ascontiguousarray(host).tobytes()
+
+
+def element_bytes(arr):
+    return np.dtype(str(arr.dtype)).itemsize if str(arr.dtype) != "bfloat16" else 2
+
+
+def wait_all():
+    import jax
+
+    jax.effects_barrier()
+
+
+def save(fname, handles, keys):
+    if keys:
+        _nd_utils.save(fname, dict(zip(keys, handles)))
+    else:
+        _nd_utils.save(fname, list(handles))
+
+
+def load(fname):
+    """Returns (names, arrays); names is [] for list-style containers."""
+    data = _nd_utils.load(fname)
+    if isinstance(data, dict):
+        names = sorted(data)
+        return names, [data[k] for k in names]
+    return [], list(data)
+
+
+def list_ops():
+    return sorted(_registry.OPS)
+
+
+def _parse_value(s):
+    """String -> typed hyper-parameter, the analogue of dmlc::Parameter
+    parsing (numbers, bools, tuples; anything else stays a string)."""
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def invoke(op_name, inputs, keys, vals):
+    params = {k: _parse_value(v) for k, v in zip(keys, vals)}
+    out = _registry.invoke(op_name, list(inputs), params)
+    return list(out) if isinstance(out, (list, tuple)) else [out]

@@ -65,11 +65,14 @@ class RowSparseNDArray(BaseSparseNDArray):
         keep = np.asarray(
             rsp_indices.asnumpy() if isinstance(rsp_indices, NDArray)
             else rsp_indices).astype(np.int64)
+        # result indices are the intersection with rows actually stored
+        # (reference retain: a requested-but-absent row is not materialized)
+        keep = np.intersect1d(keep, self.indices.asnumpy().astype(np.int64))
         mask = np.zeros(self.shape[0], bool)
         mask[keep] = True
         dense = jnp.where(jnp.asarray(mask).reshape(
             (-1,) + (1,) * (len(self.shape) - 1)), self._data, 0)
-        return RowSparseNDArray(dense, ctx=self._ctx, indices=np.sort(keep))
+        return RowSparseNDArray(dense, ctx=self._ctx, indices=keep)
 
     def tostype(self, stype):
         if stype == "default":

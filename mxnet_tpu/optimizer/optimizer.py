@@ -20,6 +20,9 @@ from ..ndarray import NDArray, zeros
 from ..ops.registry import invoke
 
 
+_NO_ROWS = object()  # sentinel: row-sparse grad storing zero rows
+
+
 def _row_sparse_indices(grad):
     """The gradient's explicit row indices when it is a RowSparseNDArray
     (None otherwise) — the trigger for lazy row-sparse update kernels.
@@ -36,7 +39,11 @@ def _row_sparse_indices(grad):
     idx = grad.indices
     n = idx.shape[0]
     if n == 0:
-        return None  # nothing to update; caller falls back to dense
+        # sparse grad with zero stored rows: the lazy-update contract says
+        # untouched rows stay bit-identical, so the whole update is a no-op
+        # (only the update count advances) — falling back to the dense
+        # kernel would wd-decay and momentum-integrate every row
+        return _NO_ROWS
     cap = grad.shape[0]
     bucket = 1
     while bucket < n:
@@ -265,6 +272,8 @@ class SGD(Optimizer):
         kwargs = self._common_kwargs(index)
         if not multi_precision:
             idx = _row_sparse_indices(grad) if self.lazy_update else None
+            if idx is _NO_ROWS:
+                return
             if idx is not None:
                 # lazy row-sparse update: only rows present in the
                 # gradient are touched (reference optimizer_op.cc
@@ -431,6 +440,8 @@ class Adam(Optimizer):
         kwargs["lr"] = kwargs["lr"] * math.sqrt(coef2) / coef1
         mean, var = state
         idx = _row_sparse_indices(grad) if self.lazy_update else None
+        if idx is _NO_ROWS:
+            return
         if idx is not None:
             invoke("_sparse_adam_update", [weight, grad, idx, mean, var],
                    dict(beta1=self.beta1, beta2=self.beta2,

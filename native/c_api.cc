@@ -1,0 +1,366 @@
+// Imperative C ABI over an embedded CPython running mxnet_tpu
+// (reference: src/c_api/c_api.cc + c_api_ndarray.cc:118-235 — there the
+// ABI fronts the C++ engine/Imperative; here every invoke reaches the
+// TPU op registry, whose ops are cached-jitted XLA computations, through
+// mxnet_tpu.c_api_bridge).  Thread-safe via the GIL; errors land in the
+// thread-local MXGetLastError string, matching the reference's
+// MXAPIThreadLocalEntry error convention (src/c_api/c_api_error.cc).
+#include "c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct NDArrayObj {
+  PyObject* array = nullptr;        // mxnet_tpu.ndarray.NDArray
+  std::vector<mx_uint> shape_buf;   // backing for MXNDArrayGetShape
+};
+
+// thread-local result buffers (reference MXAPIThreadLocalEntry pattern:
+// returned pointers stay valid until the next call on the same thread)
+struct TLS {
+  std::vector<NDArrayHandle> invoke_out;
+  std::vector<std::string> str_store;
+  std::vector<const char*> cstr_out;
+  std::vector<NDArrayHandle> load_out;
+};
+TLS* tls() {
+  thread_local TLS t;
+  return &t;
+}
+
+int fail(const std::string& msg) {
+  g_error = msg;
+  return -1;
+}
+
+int fail_py(const char* what) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = what;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return fail(msg);
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// import mxnet_tpu.c_api_bridge and fetch `name` (new reference)
+PyObject* bridge_fn(const char* name) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.c_api_bridge");
+  if (!mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return fn;
+}
+
+NDArrayObj* wrap(PyObject* array) {
+  auto* obj = new NDArrayObj();
+  obj->array = array;  // steals the reference
+  return obj;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError(void) { return g_error.c_str(); }
+
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int dtype, NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* fn = bridge_fn("create");
+  if (!fn) return fail_py("c_api_bridge.create not found");
+  PyObject* shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* arr =
+      PyObject_CallFunction(fn, "Oiii", shp, dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  Py_DECREF(fn);
+  if (!arr) return fail_py("NDArray create failed");
+  *out = wrap(arr);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* eb = bridge_fn("element_bytes");
+  if (!eb) return fail_py("bridge missing");
+  PyObject* nbytes = PyObject_CallFunction(eb, "O", obj->array);
+  Py_DECREF(eb);
+  if (!nbytes) return fail_py("element size failed");
+  size_t itemsize = PyLong_AsSize_t(nbytes);
+  Py_DECREF(nbytes);
+  PyObject* fn = bridge_fn("copy_from_bytes");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* buf = PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                            size * itemsize);
+  PyObject* r = PyObject_CallFunction(fn, "OO", obj->array, buf);
+  Py_DECREF(buf);
+  Py_DECREF(fn);
+  if (!r) return fail_py("copy from cpu failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* fn = bridge_fn("to_bytes");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* bytes = PyObject_CallFunction(fn, "O", obj->array);
+  Py_DECREF(fn);
+  if (!bytes) return fail_py("copy to cpu failed");
+  size_t blen = static_cast<size_t>(PyBytes_Size(bytes));
+  size_t nelem = 0;
+  {
+    PyObject* sz = PyObject_GetAttrString(obj->array, "size");
+    nelem = sz ? PyLong_AsSize_t(sz) : 0;
+    Py_XDECREF(sz);
+  }
+  if (size < nelem) {
+    Py_DECREF(bytes);
+    return fail("destination buffer too small");
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), blen);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_ndim,
+                      const mx_uint** out_pdata) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* shape = PyObject_GetAttrString(obj->array, "shape");
+  if (!shape) return fail_py("shape failed");
+  Py_ssize_t n = PyTuple_Size(shape);
+  obj->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    obj->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *out_ndim = static_cast<mx_uint>(n);
+  *out_pdata = obj->shape_buf.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* fn = bridge_fn("dtype_code");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* r = PyObject_CallFunction(fn, "O", obj->array);
+  Py_DECREF(fn);
+  if (!r) return fail_py("dtype failed");
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* fn = bridge_fn("context_of");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* r = PyObject_CallFunction(fn, "O", obj->array);
+  Py_DECREF(fn);
+  if (!r) return fail_py("context failed");
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = PyObject_CallMethod(obj->array, "wait_to_read", nullptr);
+  if (!r) return fail_py("wait_to_read failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  ensure_python();
+  Gil gil;
+  PyObject* fn = bridge_fn("wait_all");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* r = PyObject_CallFunction(fn, nullptr);
+  Py_DECREF(fn);
+  if (!r) return fail_py("wait_all failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  Py_XDECREF(obj->array);
+  delete obj;
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, mx_uint num_args, NDArrayHandle* args,
+                  const char** keys) {
+  Gil gil;
+  PyObject* arrs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject* a = static_cast<NDArrayObj*>(args[i])->array;
+    Py_INCREF(a);
+    PyList_SET_ITEM(arrs, i, a);
+  }
+  PyObject* names;
+  if (keys) {
+    names = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* fn = bridge_fn("save");
+  if (!fn) {
+    Py_DECREF(arrs);
+    Py_DECREF(names);
+    return fail_py("bridge missing");
+  }
+  PyObject* r = PyObject_CallFunction(fn, "sOO", fname, arrs, names);
+  Py_DECREF(fn);
+  Py_DECREF(arrs);
+  Py_DECREF(names);
+  if (!r) return fail_py("save failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names) {
+  ensure_python();
+  Gil gil;
+  PyObject* fn = bridge_fn("load");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* r = PyObject_CallFunction(fn, "s", fname);
+  Py_DECREF(fn);
+  if (!r) return fail_py("load failed");
+  PyObject* names = PyTuple_GET_ITEM(r, 0);
+  PyObject* arrays = PyTuple_GET_ITEM(r, 1);
+  TLS* t = tls();
+  t->load_out.clear();
+  t->str_store.clear();
+  t->cstr_out.clear();
+  Py_ssize_t n = PyList_Size(arrays);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(a);
+    t->load_out.push_back(wrap(a));
+  }
+  Py_ssize_t nn = PyList_Size(names);
+  for (Py_ssize_t i = 0; i < nn; ++i)
+    t->str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  for (auto& s : t->str_store) t->cstr_out.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(t->load_out.size());
+  *out_arr = t->load_out.data();
+  *out_name_size = static_cast<mx_uint>(t->cstr_out.size());
+  *out_names = t->cstr_out.data();
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* fn = bridge_fn("list_ops");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* r = PyObject_CallFunction(fn, nullptr);
+  Py_DECREF(fn);
+  if (!r) return fail_py("list_ops failed");
+  TLS* t = tls();
+  t->str_store.clear();
+  t->cstr_out.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    t->str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  for (auto& s : t->str_store) t->cstr_out.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(t->cstr_out.size());
+  *out_array = t->cstr_out.data();
+  return 0;
+}
+
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  ensure_python();
+  Gil gil;
+  PyObject* fn = bridge_fn("invoke");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* a = static_cast<NDArrayObj*>(inputs[i])->array;
+    Py_INCREF(a);
+    PyList_SET_ITEM(ins, i, a);
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* r =
+      PyObject_CallFunction(fn, "sOOO", op_name, ins, keys, vals);
+  Py_DECREF(fn);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!r) return fail_py("invoke failed");
+  TLS* t = tls();
+  t->invoke_out.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GET_ITEM(r, i);
+    Py_INCREF(a);
+    t->invoke_out.push_back(wrap(a));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(t->invoke_out.size());
+  *outputs = t->invoke_out.data();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+/*
+ * Imperative C API: NDArray handles + operator invoke by name.
+ *
+ * Reference: include/mxnet/c_api.h (the 203-function ABI every frontend
+ * marshals through — SURVEY §2.1 "C API" row) — here scoped to the
+ * imperative data plane the C++ frontend needs: NDArray lifecycle,
+ * host<->device copies, shape/dtype introspection, save/load, and
+ * MXImperativeInvoke against the TPU op registry.  The implementation
+ * (c_api.cc) embeds CPython and drives mxnet_tpu; every op executes as
+ * a cached-jitted XLA computation on the TPU.
+ *
+ * Conventions match the reference ABI: every call returns 0 on success
+ * and -1 on error with the message available from MXGetLastError()
+ * (thread-local).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+
+/* dtype codes (reference python/mxnet/base.py _DTYPE_NP_TO_MX):
+ * 0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64;
+ * TPU-native extension: 7=bfloat16. */
+
+const char *MXGetLastError(void);
+
+/* Create a zero-initialized NDArray.  dev_type: 1 = cpu, 2 = tpu. */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int dtype, NDArrayHandle *out);
+
+/* Synchronous host->device copy; size is in elements and must equal the
+ * array's size.  `data` is interpreted in the array's dtype. */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+
+/* Synchronous device->host copy; size in elements. */
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+
+/* Shape of the array; pointers valid until the next call on this handle. */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_pdata);
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+
+/* Block until the array's async computation is complete (reference
+ * WaitToRead — the sync point where deferred errors surface). */
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+
+/* Block until all outstanding computation is complete. */
+int MXNDArrayWaitAll(void);
+
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* Save named arrays to the reference-compatible .params container. */
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+
+/* Load a .params container.  Output pointers are owned by the library
+ * and valid until the next MXNDArrayLoad on this thread; the handles
+ * must each be freed with MXNDArrayFree. */
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* Names of all registered operators.  Pointers owned by the library,
+ * valid until the next call on this thread. */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+
+/* Invoke a registered operator by name on NDArray inputs.  Scalar/tuple
+ * hyper-parameters are passed as strings (reference convention: the
+ * frontend stringifies, the backend parses against the op signature).
+ * `*outputs` is set to a thread-local array of fresh handles (caller
+ * frees each with MXNDArrayFree; the array itself is reused by the next
+ * invoke on this thread). */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_C_API_H_ */

@@ -136,3 +136,38 @@ def test_kvstore_row_sparse_pull():
     o = out.asnumpy()
     np.testing.assert_allclose(o[[1, 5]], w[[1, 5]], rtol=1e-6)
     assert np.abs(o[[0, 2, 3, 4, 6, 7]]).sum() == 0
+
+
+def test_retain_intersects_with_stored_rows():
+    """retain() of a row absent from the sparse array must not
+    materialize it (reference sparse.retain semantics)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse
+
+    dense = np.zeros((5, 3), np.float32)
+    dense[[0, 2, 4]] = np.random.RandomState(0).randn(3, 3)
+    rsp = sparse.row_sparse_array(mx.nd.array(dense))
+    out = rsp.retain(mx.nd.array([0, 1], dtype="int64"))
+    assert out.indices.asnumpy().tolist() == [0]
+    assert np.allclose(out.values.asnumpy(), dense[[0]])
+
+
+def test_zero_row_sparse_grad_is_noop():
+    """A lazy row-sparse update whose gradient stores zero rows must not
+    touch any row (no wd decay, no momentum integration)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse
+    from mxnet_tpu import optimizer as opt
+
+    w = mx.nd.array(np.random.RandomState(1).randn(4, 3))
+    before = w.asnumpy().copy()
+    grad = sparse.row_sparse_array(mx.nd.zeros((4, 3)))
+    assert grad.indices.shape[0] == 0
+    for o in (opt.SGD(learning_rate=0.5, momentum=0.9, wd=0.1,
+                      lazy_update=True),
+              opt.Adam(learning_rate=0.5, wd=0.1, lazy_update=True)):
+        state = o.create_state(0, w)
+        o.update(0, w, grad, state)
+        assert np.array_equal(w.asnumpy(), before)
