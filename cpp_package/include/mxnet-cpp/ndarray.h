@@ -111,6 +111,8 @@ class NDArray {
   static void Save(const std::string& fname,
                    const std::vector<NDArray>& arrays,
                    const std::vector<std::string>& names = {}) {
+    if (!names.empty() && names.size() != arrays.size())
+      throw std::runtime_error("Save: names/arrays size mismatch");
     std::vector<NDArrayHandle> hs;
     for (const auto& a : arrays) hs.push_back(a.handle());
     std::vector<const char*> keys;

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Gluon imperative training (reference: ``example/gluon/mnist.py``).
+
+The modern-API counterpart of ``image-classification/train_mnist.py``:
+HybridSequential net, ``autograd.record`` + ``Trainer.step`` loop,
+``--hybridize`` compiles the whole net to one cached XLA callable.
+
+Zero-egress: trains on a deterministic synthetic digit-like task by
+default; pass ``--mnist-dir`` with idx files for the real dataset.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    palette = np.linspace(-1.0, 1.0, 10)
+    X = rng.normal(0, 0.2, (n, 1, 28, 28)).astype(np.float32)
+    X += palette[y.astype(int)][:, None, None, None]
+    return X, y
+
+
+def build_net(gluon):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2),
+            gluon.nn.Conv2D(32, kernel_size=3, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def evaluate(net, loader, ctx, mx):
+    correct = total = 0
+    for X, y in loader:
+        out = net(X.as_in_context(ctx))
+        pred = out.asnumpy().argmax(axis=1)
+        correct += int((pred == y.asnumpy()).sum())
+        total += X.shape[0]
+    return correct / total
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--num-examples", type=int, default=2048)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--hybridize", action="store_true")
+    ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--mnist-dir", default=None,
+                    help="directory with MNIST idx files")
+    ap.add_argument("--save", default=None, help="save params path")
+    args = ap.parse_args()
+
+    ctx = mx.cpu() if args.ctx == "cpu" else mx.tpu()
+    if args.mnist_dir:
+        it = mx.io.MNISTIter(
+            image=os.path.join(args.mnist_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(args.mnist_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        X = np.concatenate([b.data[0].asnumpy() for b in it])
+        it.reset()
+        y = np.concatenate([b.label[0].asnumpy() for b in it])
+    else:
+        X, y = synthetic_mnist(args.num_examples)
+    n_train = int(0.9 * len(X))
+    train_set = gluon.data.ArrayDataset(X[:n_train], y[:n_train])
+    val_set = gluon.data.ArrayDataset(X[n_train:], y[n_train:])
+    train_loader = gluon.data.DataLoader(train_set, args.batch_size,
+                                         shuffle=True)
+    val_loader = gluon.data.DataLoader(val_set, args.batch_size)
+
+    net = build_net(gluon)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if args.hybridize:
+        net.hybridize(static_alloc=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr,
+                             "momentum": args.momentum})
+
+    for epoch in range(args.num_epochs):
+        loss_sum = 0.0
+        nbatch = 0
+        for Xb, yb in train_loader:
+            Xb = Xb.as_in_context(ctx)
+            yb = yb.as_in_context(ctx)
+            with autograd.record():
+                out = net(Xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(Xb.shape[0])
+            loss_sum += float(loss.mean().asnumpy())
+            nbatch += 1
+        acc = evaluate(net, val_loader, ctx, mx)
+        print("Epoch[%d] Train-loss=%.4f Validation-accuracy=%.4f"
+              % (epoch, loss_sum / max(nbatch, 1), acc))
+
+    if args.save:
+        net.save_parameters(args.save)
+        print("saved to %s" % args.save)
+
+
+if __name__ == "__main__":
+    main()

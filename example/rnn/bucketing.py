@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model over variable-length sequences.
+
+Reference: ``example/rnn/bucketing/`` — sentences are grouped into
+length buckets; ``BucketingModule`` builds one executor per bucket and
+shares parameters across them (``python/mxnet/module/bucketing_module.py``).
+
+TPU-native note: each bucket key is a distinct static shape, so each
+bucket compiles once into its own XLA module and is cached — the same
+shape-bucketing strategy XLA itself demands for dynamic lengths (the
+reference invented it to share memory pools; here it also kills
+recompilation).  Synthetic Markov sentences, zero egress.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+BUCKETS = [8, 16, 24, 32]
+
+
+def synthetic_sentences(vocab, n, seed=0):
+    """Markov sentences with varied lengths (pad id 0 reserved)."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.full(vocab - 1, 0.05), size=vocab - 1)
+    out = []
+    for _ in range(n):
+        ln = int(rng.choice([6, 10, 14, 20, 28, 31]))
+        s = np.zeros(ln, np.int64)
+        s[0] = rng.randint(1, vocab)
+        for i in range(1, ln):
+            s[i] = 1 + rng.choice(vocab - 1, p=trans[s[i - 1] - 1])
+        out.append(s)
+    return out
+
+
+class BucketSentenceIter:
+    """Minimal BucketSentenceIter (reference python/mxnet/rnn/io.py):
+    pads each sentence up to its bucket, serves per-bucket batches with
+    ``bucket_key`` stamped on the DataBatch."""
+
+    def __init__(self, sentences, batch_size, mx):
+        self.mx = mx
+        self.batch_size = batch_size
+        self.data = {b: [] for b in BUCKETS}
+        for s in sentences:
+            for b in BUCKETS:
+                if len(s) <= b:
+                    pad = np.zeros(b, np.int64)
+                    pad[:len(s)] = s
+                    self.data[b].append(pad)
+                    break
+        self.default_bucket_key = max(BUCKETS)
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (batch_size,
+                                             self.default_bucket_key))]
+        self.provide_label = [mx.io.DataDesc(
+            "softmax_label", (batch_size, self.default_bucket_key))]
+        self.reset()
+
+    def reset(self):
+        self._plan = []
+        for b, rows in self.data.items():
+            arr = np.stack(rows) if rows else np.zeros((0, b), np.int64)
+            for i in range(0, len(arr) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((b, arr[i:i + self.batch_size]))
+        np.random.RandomState(1).shuffle(self._plan)
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        mx = self.mx
+        if self._i >= len(self._plan):
+            raise StopIteration
+        b, chunk = self._plan[self._i]
+        self._i += 1
+        x = chunk[:, :-1] if chunk.shape[1] > 1 else chunk
+        y = chunk[:, 1:] if chunk.shape[1] > 1 else chunk
+        seq = b - 1
+        return mx.io.DataBatch(
+            [mx.nd.array(x.T.astype(np.float32))],
+            [mx.nd.array(y.T.astype(np.float32))],
+            bucket_key=b,
+            provide_data=[mx.io.DataDesc("data",
+                                         (seq, self.batch_size))],
+            provide_label=[mx.io.DataDesc("softmax_label",
+                                          (seq, self.batch_size))])
+
+
+def main():
+    import mxnet_tpu as mx
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=120)
+    ap.add_argument("--num-sentences", type=int, default=1500)
+    ap.add_argument("--emsize", type=int, default=48)
+    ap.add_argument("--nhid", type=int, default=96)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    def sym_gen(bucket_key):
+        data = mx.sym.Variable("data")      # (seq, batch)
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=args.vocab,
+                                 output_dim=args.emsize, name="embed")
+        rnn_params = mx.sym.Variable("lstm_parameters",
+                                     init=mx.init.Normal(0.05))
+        state = mx.sym.Variable("lstm_state", init=mx.init.Zero())
+        cell = mx.sym.Variable("lstm_state_cell", init=mx.init.Zero())
+        rnn = mx.sym.RNN(embed, parameters=rnn_params, state=state,
+                         state_cell=cell, state_size=args.nhid,
+                         num_layers=1, mode="lstm", name="lstm")
+        pred = mx.sym.reshape(rnn, shape=(-1, args.nhid))
+        pred = mx.sym.FullyConnected(pred, num_hidden=args.vocab,
+                                     name="decoder")
+        out = mx.sym.SoftmaxOutput(
+            pred, mx.sym.reshape(label, shape=(-1,)), name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    sentences = synthetic_sentences(args.vocab, args.num_sentences)
+    it = BucketSentenceIter(sentences, args.batch_size, mx)
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=it.default_bucket_key - 1,
+        context=ctx)
+    seq = it.default_bucket_key - 1
+    mod.bind(data_shapes=[mx.io.DataDesc("data",
+                                         (seq, args.batch_size))],
+             label_shapes=[mx.io.DataDesc("softmax_label",
+                                          (seq, args.batch_size))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+    metric = mx.metric.Perplexity(ignore_label=0)
+    for epoch in range(args.epochs):
+        metric.reset()
+        it.reset()
+        for batch in it:
+            # rebind per bucket_key happens inside BucketingModule
+            bk = batch.bucket_key - 1
+            batch.bucket_key = bk
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        print("Epoch %d: %s" % (epoch, metric.get()), flush=True)
+    name, ppl = metric.get()
+    print("final perplexity %.2f (uniform would be %d)"
+          % (ppl, args.vocab))
+    assert np.isfinite(ppl) and ppl < args.vocab
+
+
+if __name__ == "__main__":
+    main()

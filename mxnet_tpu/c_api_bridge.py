@@ -105,7 +105,9 @@ def load(fname):
     """Returns (names, arrays); names is [] for list-style containers."""
     data = _nd_utils.load(fname)
     if isinstance(data, dict):
-        names = sorted(data)
+        # container order (== save order; dicts preserve insertion) —
+        # the reference ABI pairs names/arrays positionally
+        names = list(data)
         return names, [data[k] for k in names]
     return [], list(data)
 

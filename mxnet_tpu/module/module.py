@@ -37,6 +37,12 @@ class Module(BaseModule):
         self._context = context if not isinstance(context, (list, tuple)) \
             else context[0]
         self._context = self._context or current_context()
+        # reference semantics: group2ctxs is a per-context list of
+        # {group: ctx} dicts (module.py:40); single-executor here, so one
+        # dict (a 1-element list is unwrapped) flows to Executor placement
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctxs = group2ctxs
 
         arg_names = symbol.list_arguments()
         input_names = self._data_names + self._label_names
@@ -74,7 +80,9 @@ class Module(BaseModule):
             else:
                 req[n] = grad_req if for_training else "null"
         self._exec = self._symbol.simple_bind(ctx=self._context,
-                                              grad_req=req, **shapes)
+                                              grad_req=req,
+                                              group2ctx=self._group2ctxs,
+                                              **shapes)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             ap, xp = shared_module.get_params()

@@ -26,9 +26,14 @@ struct NDArrayObj {
 // returned pointers stay valid until the next call on the same thread)
 struct TLS {
   std::vector<NDArrayHandle> invoke_out;
-  std::vector<std::string> str_store;
-  std::vector<const char*> cstr_out;
+  // load and op-name results use separate backing stores so calling
+  // MXListAllOpNames does not invalidate a prior MXNDArrayLoad's names
+  // (each is documented valid until the next call of the SAME kind)
+  std::vector<std::string> load_str_store;
+  std::vector<const char*> load_cstr_out;
   std::vector<NDArrayHandle> load_out;
+  std::vector<std::string> op_str_store;
+  std::vector<const char*> op_cstr_out;
 };
 TLS* tls() {
   thread_local TLS t;
@@ -281,8 +286,8 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
   PyObject* arrays = PyTuple_GET_ITEM(r, 1);
   TLS* t = tls();
   t->load_out.clear();
-  t->str_store.clear();
-  t->cstr_out.clear();
+  t->load_str_store.clear();
+  t->load_cstr_out.clear();
   Py_ssize_t n = PyList_Size(arrays);
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* a = PyList_GET_ITEM(arrays, i);
@@ -291,13 +296,14 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
   }
   Py_ssize_t nn = PyList_Size(names);
   for (Py_ssize_t i = 0; i < nn; ++i)
-    t->str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
-  for (auto& s : t->str_store) t->cstr_out.push_back(s.c_str());
+    t->load_str_store.push_back(
+        PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  for (auto& s : t->load_str_store) t->load_cstr_out.push_back(s.c_str());
   Py_DECREF(r);
   *out_size = static_cast<mx_uint>(t->load_out.size());
   *out_arr = t->load_out.data();
-  *out_name_size = static_cast<mx_uint>(t->cstr_out.size());
-  *out_names = t->cstr_out.data();
+  *out_name_size = static_cast<mx_uint>(t->load_cstr_out.size());
+  *out_names = t->load_cstr_out.data();
   return 0;
 }
 
@@ -310,15 +316,15 @@ int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
   Py_DECREF(fn);
   if (!r) return fail_py("list_ops failed");
   TLS* t = tls();
-  t->str_store.clear();
-  t->cstr_out.clear();
+  t->op_str_store.clear();
+  t->op_cstr_out.clear();
   Py_ssize_t n = PyList_Size(r);
   for (Py_ssize_t i = 0; i < n; ++i)
-    t->str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+    t->op_str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
   Py_DECREF(r);
-  for (auto& s : t->str_store) t->cstr_out.push_back(s.c_str());
-  *out_size = static_cast<mx_uint>(t->cstr_out.size());
-  *out_array = t->cstr_out.data();
+  for (auto& s : t->op_str_store) t->op_cstr_out.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(t->op_cstr_out.size());
+  *out_array = t->op_cstr_out.data();
   return 0;
 }
 

@@ -9,7 +9,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(REPO, "example")
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
-       "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+       # REPO only: the ambient PYTHONPATH carries the TPU-tunnel
+       # sitecustomize, which binds the real chip in children even under
+       # JAX_PLATFORMS=cpu
+       "PYTHONPATH": REPO}
 
 
 def _run(args, timeout=540):
@@ -86,3 +89,36 @@ def test_quantization_example(tmp_path):
                 "--num-calib-examples", "64"])
     assert "fp32 accuracy" in out and "int8 accuracy" in out
     assert (tmp_path / "qmodel-symbol.json").exists()
+
+
+def test_sparse_linear_classification():
+    out = _run([os.path.join(EX, "sparse", "linear_classification.py"),
+                "--num-epochs", "3", "--num-features", "300"])
+    # accuracy is printed per epoch; the last one must show real learning
+    last = [l for l in out.splitlines() if "Train-accuracy" in l][-1]
+    acc = float(last.split("Train-accuracy=")[1].split()[0])
+    assert acc > 0.8, out
+
+
+def test_model_parallel_matrix_factorization():
+    out = _run([os.path.join(EX, "model-parallel", "matrix_factorization",
+                             "train.py"), "--num-epochs", "3"])
+    mse = float(out.split("Final MSE=")[1].split()[0])
+    assert mse < 0.5, out
+
+
+def test_gluon_mnist(tmp_path):
+    out = _run([os.path.join(EX, "gluon", "mnist.py"),
+                "--num-epochs", "3", "--num-examples", "1024",
+                "--hybridize", "--save", str(tmp_path / "net.params")])
+    accs = [float(l.split("Validation-accuracy=")[1])
+            for l in out.splitlines() if "Validation-accuracy" in l]
+    assert accs[-1] > 0.6, out
+    assert (tmp_path / "net.params").exists()
+
+
+def test_rnn_bucketing():
+    out = _run([os.path.join(EX, "rnn", "bucketing.py"),
+                "--epochs", "3", "--num-sentences", "600"], timeout=900)
+    ppl = float(out.split("final perplexity ")[1].split()[0])
+    assert ppl < 120, out

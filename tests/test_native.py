@@ -131,9 +131,7 @@ def test_c_predict_client(tmp_path):
     prefix = str(tmp_path / "model")
     mod.save_checkpoint(prefix, 8)
 
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": REPO + os.pathsep +
-           os.environ.get("PYTHONPATH", "")}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
     r = subprocess.run(
         [os.path.join(NATIVE, "test_client"), prefix + "-symbol.json",
          prefix + "-0008.params", "4", "8"],
@@ -153,9 +151,7 @@ def test_cpp_package_example(tmp_path):
     r = subprocess.run(["make", "-C", NATIVE, "cpp_example"],
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": REPO + os.pathsep +
-           os.environ.get("PYTHONPATH", "")}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
     r = subprocess.run([os.path.join(NATIVE, "cpp_example")], env=env,
                        cwd=str(tmp_path), capture_output=True, text=True,
                        timeout=540)

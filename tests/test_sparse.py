@@ -171,3 +171,33 @@ def test_zero_row_sparse_grad_is_noop():
         state = o.create_state(0, w)
         o.update(0, w, grad, state)
         assert np.array_equal(w.asnumpy(), before)
+
+
+def test_libsvm_iter(tmp_path):
+    """LibSVMIter parses the text format, serves CSR batches, shards by
+    part_index/num_parts (reference src/io/iter_libsvm.cc)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:3.0 3:1.0\n")
+        f.write("0 0:2.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2,
+                          round_batch=False)
+    batches = list(it)
+    assert len(batches) == 2
+    X = batches[0].data[0]
+    assert isinstance(X, CSRNDArray) and X.stype == "csr"
+    assert np.allclose(X.asnumpy(),
+                       [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    assert batches[0].label[0].asnumpy().tolist() == [1.0, 0.0]
+    # sharding
+    it2 = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                           batch_size=1, part_index=1, num_parts=2,
+                           round_batch=False)
+    rows = [b.data[0].asnumpy() for b in it2]
+    assert len(rows) == 2 and np.allclose(rows[0][0], [0, 0.5, 0, 0])

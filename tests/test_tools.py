@@ -1,0 +1,85 @@
+"""Ops tooling tests (reference: ``tools/`` — launch, im2rec, bandwidth,
+parse_log, flakiness_checker; SURVEY §2.3 Tools row)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+# PYTHONPATH is REPO only: the ambient path carries the TPU-tunnel
+# sitecustomize, which force-registers the real-TPU backend in every
+# child process regardless of JAX_PLATFORMS=cpu (see tests/conftest.py)
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+       "PYTHONPATH": REPO}
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Batch [20] Speed: 500.0 samples/sec accuracy=0.5\n"
+        "INFO Epoch[0] Train-accuracy=0.612345\n"
+        "INFO Epoch[0] Time cost=12.5\n"
+        "INFO Epoch[0] Validation-accuracy=0.58\n"
+        "INFO Epoch[1] Batch [20] Speed: 520.0 samples/sec\n"
+        "INFO Epoch[1] Batch [40] Speed: 540.0 samples/sec\n"
+        "INFO Epoch[1] Train-accuracy=0.70\n"
+        "INFO Epoch[1] Validation-accuracy=0.66\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "parse_log.py"), str(log),
+         "--format", "csv"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("epoch,")
+    assert len(lines) == 3
+    header = lines[0].split(",")
+    row1 = dict(zip(header, lines[2].split(",")))
+    assert float(row1["train-accuracy"]) == 0.70
+    assert float(row1["speed"]) == 530.0
+    # markdown mode renders a table
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "parse_log.py"), str(log)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and r.stdout.startswith("| epoch |")
+
+
+def test_bandwidth_measure():
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bandwidth", "measure.py"),
+         "--sizes", "1e4,1e5", "--iters", "2"],
+        env=ENV, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "devices: 8 x cpu" in r.stdout
+    # one row per size with finite bandwidth numbers
+    rows = [l for l in r.stdout.splitlines()
+            if l.strip() and l.lstrip()[0].isdigit()]
+    assert len(rows) == 2
+    vals = [float(x) for x in rows[0].split()]
+    assert all(v > 0 for v in vals), r.stdout
+
+
+def test_flakiness_checker_stable(tmp_path):
+    t = tmp_path / "test_stable.py"
+    t.write_text("def test_ok():\n    assert 1 + 1 == 2\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "flakiness_checker.py"),
+         str(t), "-n", "2"],
+        env=ENV, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stable: 2/2" in r.stdout
+
+
+def test_flakiness_checker_detects_flaky(tmp_path):
+    t = tmp_path / "test_flaky.py"
+    t.write_text(
+        "import os\n"
+        "def test_seeded():\n"
+        "    assert int(os.environ.get('MXTPU_TEST_SEED', '0')) % 2 == 0\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "flakiness_checker.py"),
+         str(t), "-n", "2"],
+        env=ENV, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 1
+    assert "FLAKY" in r.stdout and "seeds: [1]" in r.stdout

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Communication micro-benchmark (reference: ``tools/bandwidth/measure.py``
+— measures kvstore push/pull bandwidth across devices/machines for a
+range of array sizes).
+
+TPU-native: the comm fabric is the XLA collective stack, so this
+measures (a) host->device and device->host transfer bandwidth (the PCIe
+analogue) and (b) all-reduce (`psum`) bus bandwidth over the device
+mesh (the NCCL-allreduce analogue; on a real pod this rides ICI).
+
+Usage::
+
+    python tools/bandwidth/measure.py [--sizes 1e6,1e7] [--iters 10]
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bandwidth/measure.py   # 8-way virtual mesh
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def bench(fn, iters):
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="1e5,1e6,1e7",
+                    help="comma-separated element counts (fp32)")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    sizes = [int(float(s)) for s in args.sizes.split(",")]
+
+    devs = jax.devices()
+    print("devices: %d x %s" % (len(devs), devs[0].platform))
+    print("%12s %14s %14s %14s" %
+          ("size(MB)", "h2d(GB/s)", "d2h(GB/s)", "allreduce(GB/s)"))
+
+    mesh = Mesh(np.array(devs), ("dp",))
+    repl = NamedSharding(mesh, P())
+
+    for n in sizes:
+        host = np.random.RandomState(0).rand(n).astype(np.float32)
+        mb = host.nbytes / 1e6
+
+        t_h2d, dev_arr = bench(
+            lambda: jax.device_put(host, devs[0]).block_until_ready(),
+            args.iters)
+        t_d2h, _ = bench(lambda: np.asarray(dev_arr), args.iters)
+
+        if len(devs) > 1:
+            sharded = jax.device_put(host, repl)
+            from jax.experimental.shard_map import shard_map
+
+            ar = jax.jit(shard_map(lambda x: jax.lax.psum(x, "dp"),
+                                   mesh=mesh, in_specs=P(),
+                                   out_specs=P()))
+            t_ar, _ = bench(lambda: ar(sharded).block_until_ready(),
+                            args.iters)
+            # ring all-reduce moves 2*(k-1)/k of the data per link
+            k = len(devs)
+            bus_gbs = (host.nbytes * 2 * (k - 1) / k) / t_ar / 1e9
+        else:
+            bus_gbs = float("nan")
+
+        print("%12.2f %14.2f %14.2f %14.2f" %
+              (mb, host.nbytes / t_h2d / 1e9, host.nbytes / t_d2h / 1e9,
+               bus_gbs))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Flakiness checker (reference: ``tools/flakiness_checker.py`` — reruns
+a test many times under different seeds to detect nondeterministic
+failures).
+
+Usage::
+
+    python tools/flakiness_checker.py tests/test_operator.py::test_dot \
+        [-n 20] [--seed-start 0]
+
+Each trial runs pytest in a fresh process with ``MXTPU_TEST_SEED`` set
+(consumed by tests/conftest.py when present); exit status is nonzero if
+any trial fails, and the failing seeds are printed for reproduction.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("--seed-start", type=int, default=0)
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = []
+    for i in range(args.trials):
+        seed = args.seed_start + i
+        env = {**os.environ, "MXTPU_TEST_SEED": str(seed)}
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-x", "-q"],
+            cwd=repo, env=env, capture_output=True, text=True)
+        status = "PASS" if r.returncode == 0 else "FAIL"
+        print("trial %2d seed %3d: %s" % (i, seed, status), flush=True)
+        if r.returncode != 0:
+            failures.append(seed)
+            if args.stop_on_fail:
+                print(r.stdout[-3000:])
+                break
+    if failures:
+        print("FLAKY: %d/%d trials failed; seeds: %s"
+              % (len(failures), args.trials, failures))
+        print("reproduce with: MXTPU_TEST_SEED=%d python -m pytest %s"
+              % (failures[0], args.test))
+        return 1
+    print("stable: %d/%d trials passed" % (args.trials, args.trials))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

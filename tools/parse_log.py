@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Parse a training log into a per-epoch table (reference:
+``tools/parse_log.py`` — extracts accuracy/time per epoch from
+``Module.fit``-style logs).
+
+Understands the log lines this framework's fit loop and callbacks emit:
+
+    Epoch[3] Train-accuracy=0.91
+    Epoch[3] Validation-accuracy=0.88
+    Epoch[3] Time cost=12.3
+    Epoch[3] Batch [20] Speed: 512.1 samples/sec ...
+
+Usage::
+
+    python tools/parse_log.py train.log [--format csv|md]
+"""
+import argparse
+import re
+import sys
+
+EPOCH_RE = re.compile(r"Epoch\[(\d+)\]")
+KV_RE = re.compile(r"(Train|Validation)-([A-Za-z0-9_]+)=([-\d.eE]+)")
+TIME_RE = re.compile(r"Time cost=([-\d.eE]+)")
+SPEED_RE = re.compile(r"Speed: ([-\d.eE]+) samples/sec")
+
+
+def parse(lines):
+    epochs = {}
+    for line in lines:
+        m = EPOCH_RE.search(line)
+        if not m:
+            continue
+        e = int(m.group(1))
+        row = epochs.setdefault(e, {"speeds": []})
+        for phase, metric, val in KV_RE.findall(line):
+            row["%s-%s" % (phase.lower(), metric)] = float(val)
+        t = TIME_RE.search(line)
+        if t:
+            row["time"] = float(t.group(1))
+        s = SPEED_RE.search(line)
+        if s:
+            row["speeds"].append(float(s.group(1)))
+    table = []
+    for e in sorted(epochs):
+        row = epochs[e]
+        speeds = row.pop("speeds")
+        if speeds:
+            row["speed"] = sum(speeds) / len(speeds)
+        table.append((e, row))
+    return table
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", default="md", choices=["md", "csv"])
+    args = ap.parse_args()
+    lines = (sys.stdin if args.logfile == "-"
+             else open(args.logfile)).readlines()
+    table = parse(lines)
+    if not table:
+        print("no epoch lines found", file=sys.stderr)
+        return 1
+    cols = sorted({k for _, row in table for k in row})
+    if args.format == "csv":
+        print(",".join(["epoch"] + cols))
+        for e, row in table:
+            print(",".join([str(e)] + ["%.6g" % row[c] if c in row else ""
+                                       for c in cols]))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for e, row in table:
+            print("| %d | " % e +
+                  " | ".join("%.4g" % row[c] if c in row else "-"
+                             for c in cols) + " |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
