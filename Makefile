@@ -1,0 +1,36 @@
+# Top-level build (reference: root Makefile + CMakeLists.txt option
+# matrix).  The compute path is JAX/XLA (no build step); `native` builds
+# the C runtime layer (RecordIO, predict ABI, imperative C API) and
+# `cpp` the C++ frontend example against it.
+#
+#   make            -> native libs
+#   make cpp        -> C++ frontend example binary
+#   make test       -> full pytest suite (CPU oracle, 8-device mesh)
+#   make test-fast  -> quick shard (operators + ndarray + autograd)
+#   make ci         -> everything ci/runtime_functions.sh runs
+#   make clean
+
+PYTHON ?= python
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+cpp: native
+	$(MAKE) -C native cpp_example
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/test_operator.py tests/test_ndarray.py \
+	    tests/test_autograd.py -q
+
+ci:
+	bash ci/runtime_functions.sh all
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native cpp test test-fast ci clean

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# CI entrypoint matrix (reference: ci/docker/runtime_functions.sh — the
+# function-per-job entrypoints the CI matrix dispatches on).
+#
+#   ci/runtime_functions.sh <function> [args...]
+#
+# Shards are grouped so each stays within a CI worker's budget; all run
+# on the CPU oracle backend with the virtual 8-device mesh
+# (tests/conftest.py forces this; MXTPU_TEST_ON_TPU=1 reruns the same
+# corpus on a real chip — the reference's test_operator_gpu.py trick).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+build_native() {
+    make -C native
+    make -C native test_client cpp_example
+}
+
+sanity_check() {
+    # import + op registry + entry-point compile check
+    python -c "import mxnet_tpu as mx; import mxnet_tpu.ops.pallas;
+from mxnet_tpu.ops import registry
+assert len(registry.OPS) > 250, len(registry.OPS)
+print('ops:', len(registry.OPS))"
+}
+
+unittest_core() {
+    python -m pytest tests/test_operator.py tests/test_operator_corpus.py \
+        tests/test_ndarray.py tests/test_autograd.py \
+        tests/test_higher_order.py tests/test_sparse.py -q
+}
+
+unittest_frontend() {
+    python -m pytest tests/test_gluon.py tests/test_module.py \
+        tests/test_optimizer.py tests/test_monitor_viz.py \
+        tests/test_runtime_config.py tests/test_fixes_r2.py \
+        tests/test_image.py tests/test_control_flow.py -q
+}
+
+unittest_parallel() {
+    python -m pytest tests/test_parallel.py tests/test_dist.py \
+        tests/test_fused_step.py tests/test_elastic.py -q
+}
+
+unittest_serving() {
+    python -m pytest tests/test_predict.py tests/test_native.py \
+        tests/test_quantization.py tests/test_pallas.py \
+        tests/test_profiler.py tests/test_rtc.py tests/test_contrib.py -q
+}
+
+integration_examples() {
+    python -m pytest tests/test_examples.py tests/test_tools.py -q
+}
+
+multichip_dryrun() {
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+}
+
+all() {
+    build_native
+    sanity_check
+    unittest_core
+    unittest_frontend
+    unittest_parallel
+    unittest_serving
+    integration_examples
+    multichip_dryrun
+}
+
+"$@"

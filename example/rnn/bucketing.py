@@ -53,12 +53,13 @@ class BucketSentenceIter:
                     pad[:len(s)] = s
                     self.data[b].append(pad)
                     break
-        self.default_bucket_key = max(BUCKETS)
-        self.provide_data = [mx.io.DataDesc("data",
-                                            (batch_size,
-                                             self.default_bucket_key))]
+        # bucket keys are SEQUENCE lengths (padded length - 1: the data
+        # is tokens[:-1], the label tokens[1:])
+        self.default_bucket_key = max(BUCKETS) - 1
+        self.provide_data = [mx.io.DataDesc(
+            "data", (self.default_bucket_key, batch_size))]
         self.provide_label = [mx.io.DataDesc(
-            "softmax_label", (batch_size, self.default_bucket_key))]
+            "softmax_label", (self.default_bucket_key, batch_size))]
         self.reset()
 
     def reset(self):
@@ -86,7 +87,7 @@ class BucketSentenceIter:
         return mx.io.DataBatch(
             [mx.nd.array(x.T.astype(np.float32))],
             [mx.nd.array(y.T.astype(np.float32))],
-            bucket_key=b,
+            bucket_key=seq,
             provide_data=[mx.io.DataDesc("data",
                                          (seq, self.batch_size))],
             provide_label=[mx.io.DataDesc("softmax_label",
@@ -133,13 +134,9 @@ def main():
 
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     mod = mx.mod.BucketingModule(
-        sym_gen, default_bucket_key=it.default_bucket_key - 1,
-        context=ctx)
-    seq = it.default_bucket_key - 1
-    mod.bind(data_shapes=[mx.io.DataDesc("data",
-                                         (seq, args.batch_size))],
-             label_shapes=[mx.io.DataDesc("softmax_label",
-                                          (seq, args.batch_size))])
+        sym_gen, default_bucket_key=it.default_bucket_key, context=ctx)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
     mod.init_params(mx.init.Xavier())
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": args.lr,
@@ -150,8 +147,6 @@ def main():
         it.reset()
         for batch in it:
             # rebind per bucket_key happens inside BucketingModule
-            bk = batch.bucket_key - 1
-            batch.bucket_key = bk
             mod.forward_backward(batch)
             mod.update()
             mod.update_metric(metric, batch.label)

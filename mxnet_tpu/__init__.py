@@ -57,6 +57,7 @@ from .config import config  # noqa: F401  (mx.config = the knob registry;
 #                            via sys.modules and has the same describe())
 from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
+from . import elastic  # noqa: F401
 
 if config.profiler_autostart:
     profiler.start()

@@ -2,3 +2,7 @@
 from . import quantization  # noqa: F401
 from .quantization import quantize_model  # noqa: F401
 from . import onnx  # noqa: F401
+from . import svrg  # noqa: F401
+from .svrg import SVRGModule  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401

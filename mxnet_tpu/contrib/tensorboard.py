@@ -1,0 +1,147 @@
+"""TensorBoard logging without external dependencies.
+
+Reference: ``python/mxnet/contrib/tensorboard.py`` — a
+``LogMetricsCallback`` that forwards eval metrics to a TensorBoard
+``SummaryWriter`` (there: the dmlc tensorboard package).  Zero-egress
+here, so this module writes the TensorBoard wire format itself: scalar
+``Summary`` protos inside ``Event`` records, framed as TFRecords with
+masked CRC32-C — the files load in stock TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire encoding (varint + tagged fields) for:
+#   Event { double wall_time=1; int64 step=2; Summary summary=5; }
+#   Summary { repeated Value value=1; }  Value { string tag=1; float simple_value=2; }
+# ---------------------------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _scalar_summary(tag, value):
+    val = (_len_delim(1, tag.encode("utf-8")) +
+           _tag(2, 5) + struct.pack("<f", float(value)))
+    return _len_delim(1, val)
+
+
+def _event(wall_time, step, summary=None, file_version=None):
+    out = _tag(1, 1) + struct.pack("<d", wall_time)
+    out += _tag(2, 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)
+    if file_version is not None:
+        out += _len_delim(3, file_version.encode("utf-8"))
+    if summary is not None:
+        out += _len_delim(5, summary)
+    return out
+
+
+# CRC32-C (Castagnoli), table-driven, + TFRecord masking
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    tbl = _crc_table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    c = _crc32c(data)
+    return ((c >> 15) | (c << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _tfrecord(payload):
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header)) + payload +
+            struct.pack("<I", _masked_crc(payload)))
+
+
+class SummaryWriter:
+    """Minimal events-file writer (`events.out.tfevents.*`), scalar
+    summaries only — the piece ``LogMetricsCallback`` needs."""
+
+    _seq = 0  # per-process disambiguator
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        # pid + sequence keep concurrent writers on one logdir from
+        # clobbering each other within the same wall-clock second
+        SummaryWriter._seq += 1
+        fname = "events.out.tfevents.%d.%s.%d.%d" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            SummaryWriter._seq)
+        self._f = open(os.path.join(logdir, fname), "wb")
+        # mandatory version header event
+        self._f.write(_tfrecord(_event(time.time(), 0,
+                                       file_version="brain.Event:2")))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        ev = _event(time.time(), int(global_step),
+                    summary=_scalar_summary(tag, value))
+        self._f.write(_tfrecord(ev))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics to TensorBoard
+    (reference contrib/tensorboard.py:25 — same constructor and
+    ``__call__(param)`` contract: reads ``param.eval_metric`` and logs
+    each name/value pair, tagged with an optional prefix)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

@@ -1,0 +1,157 @@
+"""Text utilities: vocabulary indexing + token embeddings.
+
+Reference: ``python/mxnet/contrib/text/`` (vocab.py Vocabulary,
+embedding.py token embeddings, utils.py count_tokens_from_str).  The
+reference's pretrained downloads (GloVe/fastText) are replaced by
+:class:`CustomEmbedding` from a local file — this is a zero-egress
+environment; the lookup/composition API is the same.
+"""
+from __future__ import annotations
+
+import collections
+import re
+
+import numpy as np
+
+from ..ndarray import ndarray as _nd
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (reference utils.py:count_tokens_from_str)."""
+    source_str = re.sub(r"(%s)+" % seq_delim, token_delim, source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in source_str.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with an unknown token and optional reserved
+    tokens (reference vocab.py:30 — same indexing rules: unknown gets
+    index 0, then reserved tokens, then counter keys by descending
+    frequency, ties broken alphabetically)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError("unknown token cannot be reserved")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("reserved tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self._reserved_tokens
+        if counter is not None:
+            # frequency-descending, alphabetical tiebreak (reference
+            # _index_counter_keys ordering)
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            taken = set(self._idx_to_token)
+            kept = 0
+            for tok, freq in pairs:
+                if freq < min_freq:
+                    break
+                if most_freq_count is not None and kept >= most_freq_count:
+                    break
+                if tok in taken:
+                    continue
+                self._idx_to_token.append(tok)
+                kept += 1
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError("index %d out of vocabulary range" % i)
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out
+
+
+class CustomEmbedding:
+    """Token embedding from a local text file of ``token v1 v2 ...``
+    lines (reference embedding.py:CustomTokenEmbedding — the pretrained
+    GloVe/fastText loaders share this file format after download).
+
+    ``get_vecs_by_tokens`` returns the unknown vector (zeros by default)
+    for out-of-file tokens, like the reference.
+    """
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 vocabulary=None, init_unknown_vec=None):
+        tokens, vecs = [], []
+        dim = None
+        with open(pretrained_file_path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tok, vals = parts[0], [float(x) for x in parts[1:] if x]
+                if dim is None:
+                    dim = len(vals)
+                elif len(vals) != dim:
+                    raise ValueError("inconsistent embedding dim for %r"
+                                     % tok)
+                tokens.append(tok)
+                vecs.append(vals)
+        self.vec_len = dim or 0
+        unk = (init_unknown_vec(self.vec_len) if init_unknown_vec
+               else np.zeros(self.vec_len, np.float32))
+        if vocabulary is not None:
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            table = {t: v for t, v in zip(tokens, vecs)}
+            mat = [table.get(t, unk) for t in self._idx_to_token]
+        else:
+            self._idx_to_token = ["<unk>"] + tokens
+            mat = [unk] + vecs
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        self._mat = np.asarray(mat, np.float32)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_vec(self):
+        return _nd.array(self._mat)
+
+    def get_vecs_by_tokens(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        rows = [self._mat[self._token_to_idx.get(t, 0)] for t in toks]
+        out = np.stack(rows) if rows else np.zeros((0, self.vec_len))
+        return _nd.array(out[0] if single else out)

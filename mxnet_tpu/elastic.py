@@ -1,0 +1,189 @@
+"""Failure detection + elastic recovery (checkpoint-based auto-resume).
+
+The reference is thin here (SURVEY §5): in-process it surfaces async
+errors at sync points (``threaded_engine.cc:474-487``), cross-process it
+leans on ps-lite heartbeats and job-level restart by ``dmlc_tracker``;
+there is no in-framework auto-resume.  This module fills the gap the
+TPU-native way — on a TPU slice a failed host kills the whole SPMD job
+and the recovery unit is *job restart from the newest checkpoint*:
+
+* :class:`CheckpointManager` — atomic (write-temp + rename), versioned,
+  pruned checkpoints of params + optimizer/step state; ``latest()``
+  gives the resume point after an unclean death.
+* :func:`supervise` — the job-level restarter (the ``dmlc_tracker``
+  "restart dead jobs" analogue): reruns a training command until clean
+  exit, bounding restarts; sets ``MXTPU_RESTART_COUNT`` so the script
+  can tell a cold start from a resume.
+* :class:`Watchdog` — liveness detection for hangs (a wedged collective
+  never raises): if the training loop stops kicking it, the process is
+  killed with a distinctive exit code so ``supervise`` restarts it.
+* :class:`FaultInjector` — deterministic fault injection for testing
+  the recovery path (crash at step K on the first incarnation only).
+
+Exact-resume contract: with deterministic data order and seeds, a run
+that crashes and resumes must produce *bit-identical* final parameters
+to an uninterrupted run (tests/test_elastic.py asserts equality — the
+same standard the dist_sync kvstore tests use).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from .ndarray import utils as _nd_utils
+
+__all__ = ["CheckpointManager", "FaultInjector", "InjectedFault",
+           "Watchdog", "supervise", "WATCHDOG_EXIT_CODE"]
+
+WATCHDOG_EXIT_CODE = 75  # distinctive "stalled, please restart" status
+
+
+class CheckpointManager:
+    """Versioned atomic checkpoints: ``prefix-####.params`` (the
+    reference .params container format) + ``prefix-####.meta.json``
+    (step counter, user state such as optimizer hyper-state / epoch).
+
+    Atomicity: both files are written to ``.tmp`` paths and renamed;
+    the meta file is renamed LAST and is the commit point, so a crash
+    mid-save leaves the previous checkpoint as ``latest()``.
+    """
+
+    def __init__(self, prefix, keep_n=3):
+        self.prefix = prefix
+        self.keep_n = keep_n
+        d = os.path.dirname(os.path.abspath(prefix))
+        os.makedirs(d, exist_ok=True)
+
+    def _params_path(self, step):
+        return "%s-%04d.params" % (self.prefix, step)
+
+    def _meta_path(self, step):
+        return "%s-%04d.meta.json" % (self.prefix, step)
+
+    def save(self, step, params, extra=None):
+        """params: dict name -> NDArray; extra: JSON-able dict."""
+        pp, mp = self._params_path(step), self._meta_path(step)
+        _nd_utils.save(pp + ".tmp", dict(params))
+        os.replace(pp + ".tmp", pp)
+        with open(mp + ".tmp", "w") as f:
+            json.dump({"step": int(step), "extra": extra or {}}, f)
+        os.replace(mp + ".tmp", mp)  # commit point
+        self._prune()
+
+    def steps(self):
+        """Committed checkpoint steps, ascending."""
+        d = os.path.dirname(os.path.abspath(self.prefix)) or "."
+        base = os.path.basename(self.prefix)
+        out = []
+        for fn in os.listdir(d):
+            if fn.startswith(base + "-") and fn.endswith(".meta.json"):
+                num = fn[len(base) + 1:-len(".meta.json")]
+                if num.isdigit() and os.path.exists(
+                        self._params_path(int(num))):
+                    out.append(int(num))
+        return sorted(out)
+
+    def latest(self):
+        """(step, params, extra) of the newest committed checkpoint, or
+        None on a cold start."""
+        steps = self.steps()
+        if not steps:
+            return None
+        step = steps[-1]
+        with open(self._meta_path(step)) as f:
+            meta = json.load(f)
+        params = _nd_utils.load(self._params_path(step))
+        return step, params, meta.get("extra", {})
+
+    def _prune(self):
+        for s in self.steps()[:-self.keep_n]:
+            for p in (self._params_path(s), self._meta_path(s)):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+
+class InjectedFault(RuntimeError):
+    pass
+
+
+class FaultInjector:
+    """Deterministic crash for recovery tests: dies at step
+    ``MXTPU_FI_AT_STEP`` but only on incarnation ``MXTPU_FI_AT_RESTART``
+    (default 0 — the first run), so the supervised rerun survives.
+    ``MXTPU_FI_AT_RESTART=-1`` faults on every incarnation (for testing
+    restart-budget exhaustion)."""
+
+    def __init__(self):
+        self.at_step = int(os.environ.get("MXTPU_FI_AT_STEP", "-1"))
+        self.at_restart = int(os.environ.get("MXTPU_FI_AT_RESTART", "0"))
+        self.incarnation = int(os.environ.get("MXTPU_RESTART_COUNT", "0"))
+
+    def maybe_fail(self, step):
+        if step == self.at_step and self.at_restart in (-1,
+                                                        self.incarnation):
+            raise InjectedFault("injected fault at step %d (incarnation "
+                                "%d)" % (step, self.incarnation))
+
+
+class Watchdog:
+    """Hang detector: a daemon thread that calls ``on_stall`` (default:
+    ``os._exit(WATCHDOG_EXIT_CODE)``) if ``kick()`` is not called within
+    ``timeout`` seconds.  A wedged XLA collective or a dead tunnel hangs
+    forever without raising — exiting with a distinctive status converts
+    the hang into a restartable failure for :func:`supervise`."""
+
+    def __init__(self, timeout, on_stall=None):
+        self.timeout = timeout
+        self.on_stall = on_stall or (
+            lambda: os._exit(WATCHDOG_EXIT_CODE))
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+
+    def start(self):
+        self._last = time.monotonic()
+        self._thread.start()
+        return self
+
+    def kick(self):
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+
+    def _watch(self):
+        while not self._stop.wait(min(self.timeout / 4.0, 1.0)):
+            if time.monotonic() - self._last > self.timeout:
+                self.on_stall()
+                return
+
+
+def supervise(argv, max_restarts=3, env=None, logger=None):
+    """Run ``argv`` until clean exit, restarting on failure (job-level
+    elasticity — the dmlc_tracker restart analogue, reference
+    ``tools/launch.py`` job lifecycle).
+
+    Each incarnation gets ``MXTPU_RESTART_COUNT`` in its env; the
+    training script resumes from ``CheckpointManager.latest()``.
+    Returns the number of restarts used.  Raises ``RuntimeError`` when
+    the budget is exhausted.
+    """
+    log = logger or (lambda msg: print("[supervise] %s" % msg,
+                                       file=sys.stderr, flush=True))
+    base_env = dict(env if env is not None else os.environ)
+    for restart in range(max_restarts + 1):
+        run_env = {**base_env, "MXTPU_RESTART_COUNT": str(restart)}
+        r = subprocess.run(list(argv), env=run_env)
+        if r.returncode == 0:
+            return restart
+        log("incarnation %d exited rc=%d%s" %
+            (restart, r.returncode,
+             " (watchdog stall)" if r.returncode == WATCHDOG_EXIT_CODE
+             else ""))
+    raise RuntimeError("job failed after %d restarts" % max_restarts)

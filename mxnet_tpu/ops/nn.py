@@ -199,18 +199,21 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     running stats as explicit state — pure-functional BN.
     """
     out_dtype = data.dtype
-    if data.dtype in (jnp.float16, jnp.bfloat16):
-        # low-precision inputs: normalize in fp32 (the reference's cuDNN BN
-        # likewise accumulates statistics in fp32 for fp16 tensors)
-        data = data.astype(jnp.float32)
+    low_precision = data.dtype in (jnp.float16, jnp.bfloat16)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
 
+    # the whole normalization computes in fp32 for fp16/bf16 inputs, like
+    # the reference's cuDNN BN (math AND statistics — normalizing in the
+    # compute dtype cancels catastrophically when |mean| >> std); XLA
+    # fuses the upcast + affine into the surrounding ops, so no fp32 copy
+    # is materialized in HBM
+    data32 = data.astype(jnp.float32) if low_precision else data
     if _train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(data32, axis=red)
+        var = jnp.var(data32, axis=red)
         new_mean = lax.stop_gradient(
             momentum * moving_mean + (1 - momentum) * mean)
         new_var = lax.stop_gradient(
@@ -219,8 +222,8 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
-        + beta.reshape(bshape)
+    out = data32 * (g * inv).reshape(bshape) \
+        + (beta - mean * g * inv).reshape(bshape)
     return (out.astype(out_dtype), lax.stop_gradient(mean),
             lax.stop_gradient(var), new_mean, new_var)
 
@@ -468,9 +471,14 @@ def _regression_output(fwd_fn, grad_fn):
         # the per-sample label size — NOT the batch size (batch rescaling
         # is the optimizer's rescale_grad job), regression_output-inl.h:200
         d, l = res
-        num_output = max(1, int(np.prod(l.shape[1:]))) if l.ndim > 1 else 1
+        # reference reshapes the label to the data shape (a (N,) label
+        # against (N,1) preds is the common Module layout); without it
+        # d - l broadcasts to (N,N) and inflates gradients N-fold
+        l2 = l.reshape(d.shape) if (l.size == d.size and
+                                    l.shape != d.shape) else l
+        num_output = max(1, int(np.prod(d.shape[1:]))) if d.ndim > 1 else 1
         scale = jnp.asarray(grad_scale / num_output, d.dtype)
-        return (grad_fn(d, l) * scale, jnp.zeros_like(l))
+        return (grad_fn(d, l2) * scale, jnp.zeros_like(l))
 
     core.defvjp(fwd, bwd)
     return core

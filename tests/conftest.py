@@ -48,6 +48,21 @@ import jax  # noqa: E402
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def subprocess_env(**extra):
+    """Env for test subprocesses: CPU oracle backend, 8-device virtual
+    mesh, and a repo-only PYTHONPATH — the ambient path carries the
+    TPU-tunnel sitecustomize, which force-binds the real chip in child
+    processes even under JAX_PLATFORMS=cpu.  Single source of truth for
+    every test that spawns a python child (import as
+    ``from conftest import subprocess_env``)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PYTHONPATH": repo}
+    env.update(extra)
+    return env
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Reference parity: @with_seed decorator — reproducible randomized

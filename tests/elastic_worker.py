@@ -1,0 +1,72 @@
+"""Deterministic training worker for the elastic-recovery tests.
+
+Trains a small dense regression for N steps, checkpointing every step;
+resumes from the newest checkpoint on restart.  With MXTPU_FI_AT_STEP
+set it crashes there on the first incarnation only — the supervised
+rerun must finish and (the test asserts) produce final params
+bit-identical to an uninterrupted run.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.elastic import CheckpointManager, FaultInjector
+
+    prefix = sys.argv[1]
+    total_steps = int(sys.argv[2])
+
+    rng = np.random.RandomState(7)
+    Xh = rng.randn(64, 10).astype(np.float32)
+    X = mx.nd.array(Xh)
+    Y = mx.nd.array((Xh @ rng.randn(10, 1)).astype(np.float32))
+
+    ckpt = CheckpointManager(prefix, keep_n=2)
+    fi = FaultInjector()
+
+    resumed = ckpt.latest()
+    if resumed is None:
+        start = 0
+        w = mx.nd.zeros((1, 10))
+        b = mx.nd.zeros((1,))
+        mom_w = mx.nd.zeros((1, 10))
+        mom_b = mx.nd.zeros((1,))
+    else:
+        step0, params, extra = resumed
+        start = step0
+        w, b = params["w"], params["b"]
+        mom_w, mom_b = params["mom_w"], params["mom_b"]
+        print("resumed at step %d (incarnation %s)"
+              % (start, os.environ.get("MXTPU_RESTART_COUNT")))
+
+    w.attach_grad()
+    b.attach_grad()
+    for step in range(start, total_steps):
+        fi.maybe_fail(step)
+        with mx.autograd.record():
+            loss = ((mx.nd.FullyConnected(X, w, b, num_hidden=1) - Y)
+                    ** 2).mean()
+        loss.backward()
+        # explicit momentum sgd so optimizer state rides the checkpoint
+        mx.nd.sgd_mom_update(w, w.grad, mom_w, lr=0.05, momentum=0.9,
+                             out=w)
+        mx.nd.sgd_mom_update(b, b.grad, mom_b, lr=0.05, momentum=0.9,
+                             out=b)
+        ckpt.save(step + 1, {"w": w, "b": b,
+                             "mom_w": mom_w, "mom_b": mom_b},
+                  extra={"loss": float(loss.asnumpy())})
+    final = {"w": w.asnumpy().tolist(), "b": b.asnumpy().tolist(),
+             "loss": float(loss.asnumpy())}
+    with open(prefix + ".final.json", "w") as f:
+        json.dump(final, f)
+    print("done at step %d loss=%.6f" % (total_steps, final["loss"]))
+
+
+if __name__ == "__main__":
+    main()

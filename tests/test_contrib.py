@@ -1,0 +1,163 @@
+"""Contrib subsystem tests: SVRG, text utilities, tensorboard logging
+(reference: python/mxnet/contrib/{svrg_optimization,text,tensorboard}).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.svrg import SVRGModule
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.tensorboard import (SummaryWriter,
+                                           LogMetricsCallback)
+
+
+# ---------------------------------------------------------------------------
+# SVRG
+# ---------------------------------------------------------------------------
+def _linreg_data(n=256, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, 1).astype(np.float32)
+    Y = (X @ w).ravel() + 0.01 * rng.randn(n).astype(np.float32)
+    return X, Y.astype(np.float32)
+
+
+def _linreg_sym():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(fc, label, name="lin")
+
+
+def test_svrg_module_converges():
+    X, Y = _linreg_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name="lin_label")
+    mod = SVRGModule(_linreg_sym(), data_names=("data",),
+                     label_names=("lin_label",), context=mx.cpu(),
+                     update_freq=2)
+    mod.fit(it, num_epoch=10, optimizer="sgd", eval_metric="mse",
+            optimizer_params={"learning_rate": 0.1,
+                              "rescale_grad": 1.0 / 32})
+    it.reset()
+    mse = dict(mod.score(it, "mse"))["mse"]
+    assert mse < 0.05, mse
+
+
+def test_svrg_gradient_rule():
+    """At the snapshot point (w == w_tilde), the SVRG gradient must equal
+    mu exactly when the batch is the whole dataset."""
+    X, Y = _linreg_data(n=64)
+    it = mx.io.NDArrayIter(X, Y, batch_size=64, label_name="lin_label")
+    mod = SVRGModule(_linreg_sym(), data_names=("data",),
+                     label_names=("lin_label",), context=mx.cpu(),
+                     update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    for name in ("fc_weight", "fc_bias"):
+        g = mod._exec.grad_dict[name].asnumpy()
+        m = mod._mu[name].asnumpy()
+        np.testing.assert_allclose(g, m, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+def test_vocabulary_indexing():
+    counter = ctext.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = ctext.Vocabulary(counter, min_freq=2,
+                             reserved_tokens=["<pad>"])
+    # unk=0, reserved next, then by frequency desc (d:4, c:3, b:2); a
+    # dropped by min_freq
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz", "b"]) == [2, 0, 4]
+    assert vocab.to_tokens([3, 0]) == ["c", "<unk>"]
+    assert vocab.to_indices("c") == 3
+    with pytest.raises(ValueError):
+        vocab.to_tokens(99)
+    assert len(ctext.Vocabulary(counter, most_freq_count=2)) == 3
+
+
+def test_custom_embedding(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = ctext.CustomEmbedding(str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    v = emb.get_vecs_by_tokens(["world", "hello", "missing"]).asnumpy()
+    assert np.allclose(v[0], [4, 5, 6]) and np.allclose(v[1], [1, 2, 3])
+    assert np.allclose(v[2], 0)  # unknown -> zeros
+    # with a vocabulary: rows follow vocab order
+    vocab = ctext.Vocabulary(ctext.count_tokens_from_str("world world"))
+    emb2 = ctext.CustomEmbedding(str(p), vocabulary=vocab)
+    mat = emb2.idx_to_vec.asnumpy()
+    assert mat.shape == (2, 3) and np.allclose(mat[1], [4, 5, 6])
+
+
+# ---------------------------------------------------------------------------
+# tensorboard
+# ---------------------------------------------------------------------------
+def _read_tfrecords(path):
+    """Parse TFRecord framing, verifying the masked CRCs."""
+    from mxnet_tpu.contrib.tensorboard import _masked_crc
+
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == _masked_crc(header)
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            assert pcrc == _masked_crc(payload)
+            out.append(payload)
+    return out
+
+
+def test_summary_writer_tfrecord_format(tmp_path):
+    logdir = str(tmp_path / "tb")
+    w = SummaryWriter(logdir)
+    w.add_scalar("loss", 0.5, global_step=3)
+    w.add_scalar("acc", 0.75, global_step=3)
+    w.close()
+    files = os.listdir(logdir)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents.")
+    recs = _read_tfrecords(os.path.join(logdir, files[0]))
+    assert len(recs) == 3  # version header + 2 scalars
+    assert b"brain.Event:2" in recs[0]
+    assert b"loss" in recs[1] and struct.pack("<f", 0.5) in recs[1]
+    assert b"acc" in recs[2]
+
+
+def test_log_metrics_callback(tmp_path):
+    logdir = str(tmp_path / "tb2")
+    cb = LogMetricsCallback(logdir, prefix="train")
+    m = mx.metric.create("acc")
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.9, 0.1],
+                                                  [0.2, 0.8]])])
+    param = mx.model.BatchEndParam(epoch=0, nbatch=1, eval_metric=m,
+                                   locals=None)
+    cb(param)
+    cb.summary_writer.close()
+    fn = os.listdir(logdir)[0]
+    data = open(os.path.join(logdir, fn), "rb").read()
+    assert b"train-accuracy" in data
+
+
+def test_crc32c_known_vectors():
+    """CRC32-C against published test vectors (RFC 3720 appendix)."""
+    from mxnet_tpu.contrib.tensorboard import _crc32c
+
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(bytes(32)) == 0x8A9136AA

@@ -6,13 +6,11 @@ import sys
 
 import pytest
 
+from conftest import subprocess_env
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(REPO, "example")
-ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
-       # REPO only: the ambient PYTHONPATH carries the TPU-tunnel
-       # sitecustomize, which binds the real chip in children even under
-       # JAX_PLATFORMS=cpu
-       "PYTHONPATH": REPO}
+ENV = subprocess_env()
 
 
 def _run(args, timeout=540):

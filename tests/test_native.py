@@ -10,6 +10,8 @@ import sys
 import numpy as np
 import pytest
 
+from conftest import subprocess_env
+
 import mxnet_tpu as mx
 from mxnet_tpu import recordio
 
@@ -131,7 +133,7 @@ def test_c_predict_client(tmp_path):
     prefix = str(tmp_path / "model")
     mod.save_checkpoint(prefix, 8)
 
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    env = subprocess_env()
     r = subprocess.run(
         [os.path.join(NATIVE, "test_client"), prefix + "-symbol.json",
          prefix + "-0008.params", "4", "8"],
@@ -151,7 +153,7 @@ def test_cpp_package_example(tmp_path):
     r = subprocess.run(["make", "-C", NATIVE, "cpp_example"],
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    env = subprocess_env()
     r = subprocess.run([os.path.join(NATIVE, "cpp_example")], env=env,
                        cwd=str(tmp_path), capture_output=True, text=True,
                        timeout=540)

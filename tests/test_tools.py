@@ -8,12 +8,9 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(REPO, "tools")
-# PYTHONPATH is REPO only: the ambient path carries the TPU-tunnel
-# sitecustomize, which force-registers the real-TPU backend in every
-# child process regardless of JAX_PLATFORMS=cpu (see tests/conftest.py)
-ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
-       "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-       "PYTHONPATH": REPO}
+from conftest import subprocess_env
+
+ENV = subprocess_env()
 
 
 def test_parse_log(tmp_path):
