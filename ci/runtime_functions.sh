@@ -26,6 +26,7 @@ print('ops:', len(registry.OPS))"
 
 unittest_core() {
     python -m pytest tests/test_operator.py tests/test_operator_corpus.py \
+        tests/test_operator_extra.py tests/test_random.py \
         tests/test_ndarray.py tests/test_autograd.py \
         tests/test_higher_order.py tests/test_sparse.py -q
 }
@@ -34,7 +35,8 @@ unittest_frontend() {
     python -m pytest tests/test_gluon.py tests/test_module.py \
         tests/test_optimizer.py tests/test_monitor_viz.py \
         tests/test_runtime_config.py tests/test_fixes_r2.py \
-        tests/test_image.py tests/test_control_flow.py -q
+        tests/test_image.py tests/test_control_flow.py \
+        tests/test_io.py -q
 }
 
 unittest_parallel() {

@@ -12,9 +12,6 @@ fused elementwise updates on device, no host round-trip.
 """
 from __future__ import annotations
 
-import time
-
-from .. import metric as _metric
 from ..module.module import Module
 
 __all__ = ["SVRGModule"]
@@ -86,64 +83,9 @@ class SVRGModule(Module):
                 continue
             g[:] = g - g_tilde + m
 
-    def fit(self, train_data, eval_data=None, eval_metric="acc",
-            epoch_end_callback=None, batch_end_callback=None,
-            kvstore="local", optimizer="sgd",
-            optimizer_params=(("learning_rate", 0.01),),
-            initializer=None, arg_params=None, aux_params=None,
-            allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None):
-        """The base fit loop with a full-gradient snapshot every
-        ``update_freq`` epochs (reference svrg_module.py:395)."""
-        assert num_epoch is not None, "please specify number of epochs"
-        from ..initializer import Uniform
-
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
-        self.init_params(initializer=initializer or Uniform(0.01),
-                         arg_params=arg_params, aux_params=aux_params,
-                         allow_missing=allow_missing,
-                         force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
-        if not isinstance(eval_metric, _metric.EvalMetric):
-            eval_metric = _metric.create(eval_metric)
-        validation_metric = validation_metric or eval_metric
-
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            if (epoch - begin_epoch) % self.update_freq == 0:
-                self.update_full_grads(train_data)
-            eval_metric.reset()
-            nbatch = 0
-            for batch in train_data:
-                self.forward_backward(batch)
-                self.update()
-                self.update_metric(eval_metric, batch.label)
-                if batch_end_callback is not None:
-                    from ..module.base_module import BatchEndParam
-
-                    batch_end_callback(BatchEndParam(
-                        epoch=epoch, nbatch=nbatch,
-                        eval_metric=eval_metric, locals=locals()))
-                nbatch += 1
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
-                                 val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
-            if epoch_end_callback is not None:
-                arg, aux = self.get_params()
-                cbs = epoch_end_callback if isinstance(
-                    epoch_end_callback, (list, tuple)) \
-                    else [epoch_end_callback]
-                for cb in cbs:
-                    cb(epoch, self.symbol, arg, aux)
-            train_data.reset()
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-                eval_data.reset()
+    def _epoch_begin(self, epoch, train_data):
+        """BaseModule.fit hook: refresh the snapshot + full gradient
+        every ``update_freq`` epochs (reference svrg_module.py:395's
+        epoch loop delta — the rest of fit is the base loop)."""
+        if epoch % self.update_freq == 0:
+            self.update_full_grads(train_data)

@@ -124,6 +124,9 @@ class BaseModule:
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
+            # subclass hook (e.g. SVRG's periodic full-gradient snapshot);
+            # implementations must leave train_data reset to the epoch head
+            self._epoch_begin(epoch, train_data)
             eval_metric.reset()
             nbatch = 0
             end_of_batch = False
@@ -170,6 +173,9 @@ class BaseModule:
             train_data.reset()
 
     # -- stubs ----------------------------------------------------------
+    def _epoch_begin(self, epoch, train_data):
+        """Called at the top of each fit epoch before any batch."""
+
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
 

@@ -222,8 +222,11 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     inv = lax.rsqrt(var + eps)
-    out = data32 * (g * inv).reshape(bshape) \
-        + (beta - mean * g * inv).reshape(bshape)
+    # subtract-first form: (data - mean) cancels exactly before scaling,
+    # so |mean| >> std inputs don't lose precision to rounding at the
+    # data's magnitude
+    out = (data32 - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
+        + beta.reshape(bshape)
     return (out.astype(out_dtype), lax.stop_gradient(mean),
             lax.stop_gradient(var), new_mean, new_var)
 

@@ -72,21 +72,24 @@ def _randint(rng, low=0, high=1, shape=(1,), dtype="int32"):
     return jax.random.randint(rng, tuple(shape), low, high, _dt(dtype))
 
 
-@register("_sample_uniform", needs_rng=True, no_grad=True)
+@register("_sample_uniform", needs_rng=True, no_grad=True,
+          aliases=("sample_uniform",), input_names=("low", "high"))
 def _sample_uniform(rng, low, high, shape=()):
     s = tuple(shape) if shape else ()
     return low[..., *([None] * len(s))] + (high - low)[..., *([None] * len(s))] \
         * jax.random.uniform(rng, low.shape + s, low.dtype)
 
 
-@register("_sample_normal", needs_rng=True, no_grad=True)
+@register("_sample_normal", needs_rng=True, no_grad=True,
+          aliases=("sample_normal",), input_names=("mu", "sigma"))
 def _sample_normal(rng, mu, sigma, shape=()):
     s = tuple(shape) if shape else ()
     eps = jax.random.normal(rng, mu.shape + s, mu.dtype)
     return mu[..., *([None] * len(s))] + sigma[..., *([None] * len(s))] * eps
 
 
-@register("_sample_gamma", needs_rng=True, no_grad=True)
+@register("_sample_gamma", needs_rng=True, no_grad=True,
+          aliases=("sample_gamma",), input_names=("alpha", "beta"))
 def _sample_gamma(rng, alpha, beta, shape=()):
     s = tuple(shape) if shape else ()
     exp = (Ellipsis,) + (None,) * len(s)
@@ -94,14 +97,16 @@ def _sample_gamma(rng, alpha, beta, shape=()):
     return g * beta[exp]
 
 
-@register("_sample_exponential", needs_rng=True, no_grad=True)
+@register("_sample_exponential", needs_rng=True, no_grad=True,
+          aliases=("sample_exponential",), input_names=("lam",))
 def _sample_exponential(rng, lam, shape=()):
     s = tuple(shape) if shape else ()
     exp = (Ellipsis,) + (None,) * len(s)
     return jax.random.exponential(rng, lam.shape + s, lam.dtype) / lam[exp]
 
 
-@register("_sample_poisson", needs_rng=True, no_grad=True)
+@register("_sample_poisson", needs_rng=True, no_grad=True,
+          aliases=("sample_poisson",), input_names=("lam",))
 def _sample_poisson(rng, lam, shape=(), dtype="float32"):
     s = tuple(shape) if shape else ()
     exp = (Ellipsis,) + (None,) * len(s)

@@ -47,6 +47,9 @@ def main():
 
     w.attach_grad()
     b.attach_grad()
+    # resume landing exactly at total_steps (killed after the last save
+    # but before final.json): nothing to train, report the saved loss
+    last_loss = resumed[2].get("loss") if resumed else None
     for step in range(start, total_steps):
         fi.maybe_fail(step)
         with mx.autograd.record():
@@ -58,14 +61,15 @@ def main():
                              out=w)
         mx.nd.sgd_mom_update(b, b.grad, mom_b, lr=0.05, momentum=0.9,
                              out=b)
+        last_loss = float(loss.asnumpy())
         ckpt.save(step + 1, {"w": w, "b": b,
                              "mom_w": mom_w, "mom_b": mom_b},
-                  extra={"loss": float(loss.asnumpy())})
+                  extra={"loss": last_loss})
     final = {"w": w.asnumpy().tolist(), "b": b.asnumpy().tolist(),
-             "loss": float(loss.asnumpy())}
+             "loss": last_loss}
     with open(prefix + ".final.json", "w") as f:
         json.dump(final, f)
-    print("done at step %d loss=%.6f" % (total_steps, final["loss"]))
+    print("done at step %d loss=%s" % (total_steps, final["loss"]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,163 @@
+"""Data-IO iterator tests (reference: ``tests/python/unittest/test_io.py``
+— batching semantics per last_batch_handle, CSV/MNIST parsing, resize,
+prefetch equivalence).
+"""
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _collect(it):
+    out = []
+    for b in it:
+        out.append((b.data[0].asnumpy().copy(),
+                    None if not b.label else b.label[0].asnumpy().copy(),
+                    b.pad))
+    return out
+
+
+def test_ndarrayiter_pad_semantics():
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="pad")
+    batches = _collect(it)
+    assert len(batches) == 3
+    assert batches[2][2] == 2  # pad count on final batch
+    # pad wraps to the epoch head
+    assert batches[2][0].ravel().tolist() == [8, 9, 0, 1]
+    # second epoch identical (no shuffle)
+    it.reset()
+    assert [b[0].ravel().tolist() for b in _collect(it)] \
+        == [b[0].ravel().tolist() for b in batches]
+
+
+def test_ndarrayiter_discard_semantics():
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="discard")
+    batches = _collect(it)
+    assert len(batches) == 2
+    assert all(b[2] == 0 for b in batches)
+
+
+def test_ndarrayiter_roll_over_semantics():
+    """roll_over: the unserved tail leads the next epoch (reference
+    io.py roll_over contract)."""
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="roll_over")
+    e1 = _collect(it)
+    assert len(e1) == 2  # 8 served, 2 carried
+    it.reset()
+    e2 = _collect(it)
+    # epoch 2 starts with the carried-over [8, 9]
+    assert e2[0][0].ravel().tolist()[:2] == [8, 9]
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    X = np.arange(64, dtype=np.float32).reshape(64, 1)
+    it = mx.io.NDArrayIter(X, batch_size=8, shuffle=True)
+    seen = np.concatenate([b[0].ravel() for b in _collect(it)])
+    assert sorted(seen.tolist()) == list(range(64))
+    it.reset()  # reshuffles
+    seen2 = np.concatenate([b[0].ravel() for b in _collect(it)])
+    assert not np.array_equal(seen, seen2)  # reshuffled per epoch
+
+
+def test_ndarrayiter_dict_data_and_descs():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2), np.float32),
+                            "b": np.ones((6, 3), np.float32)},
+                           np.arange(6, dtype=np.float32),
+                           batch_size=3)
+    descs = {d.name: tuple(d.shape) for d in it.provide_data}
+    assert descs == {"a": (3, 2), "b": (3, 3)}
+    assert it.provide_label[0].name == "softmax_label"
+    b = next(iter(it))
+    assert len(b.data) == 2 and b.data[1].shape == (3, 3)
+
+
+def test_csviter(tmp_path):
+    data = np.arange(12, dtype=np.float32).reshape(6, 2)
+    label = np.arange(6, dtype=np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(2,), label_csv=lpath,
+                       batch_size=2)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:2])
+    np.testing.assert_allclose(b.label[0].asnumpy(), label[:2])
+    # sharding
+    it2 = mx.io.CSVIter(data_csv=dpath, data_shape=(2,), batch_size=1,
+                        part_index=1, num_parts=2, round_batch=False)
+    rows = np.concatenate([b.data[0].asnumpy() for b in it2])
+    np.testing.assert_allclose(rows, data[1::2])
+
+
+def _write_idx_images(path, imgs, gz=False):
+    op = gzip.open if gz else open
+    with op(path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, imgs.shape[0], imgs.shape[1],
+                            imgs.shape[2]))
+        f.write(imgs.tobytes())
+
+
+def _write_idx_labels(path, labels, gz=False):
+    op = gzip.open if gz else open
+    with op(path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, labels.shape[0]))
+        f.write(labels.tobytes())
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_mnistiter_idx_format(tmp_path, gz):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (20, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, (20,)).astype(np.uint8)
+    suffix = ".gz" if gz else ""
+    ipath = str(tmp_path / ("img-idx3-ubyte" + suffix))
+    lpath = str(tmp_path / ("lbl-idx1-ubyte" + suffix))
+    _write_idx_images(ipath, imgs, gz)
+    _write_idx_labels(lpath, labels, gz)
+    it = mx.io.MNISTIter(image=ipath, label=lpath, batch_size=5,
+                         shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0, 0],
+                               imgs[0] / 255.0, rtol=1e-6)
+    assert b.label[0].asnumpy().tolist() == labels[:5].tolist()
+    # flat mode
+    it = mx.io.MNISTIter(image=ipath, label=lpath, batch_size=5,
+                         shuffle=False, flat=True)
+    assert next(iter(it)).data[0].shape == (5, 784)
+
+
+def test_resizeiter():
+    X = np.arange(8, dtype=np.float32).reshape(8, 1)
+    base = mx.io.NDArrayIter(X, batch_size=2)
+    it = mx.io.ResizeIter(base, 7)  # longer than the base epoch
+    assert len(_collect(it)) == 7
+    it.reset()
+    assert len(_collect(it)) == 7
+
+
+def test_prefetching_iter_equivalence():
+    X = np.arange(48, dtype=np.float32).reshape(24, 2)
+    y = np.arange(12, dtype=np.float32).repeat(2)[:24]
+    base = mx.io.NDArrayIter(X, y, batch_size=4)
+    pref = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(X, y, batch_size=4))
+    a = _collect(base)
+    b = _collect(pref)
+    assert len(a) == len(b)
+    for (da, la, _), (db, lb, _) in zip(a, b):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_databatch_attributes():
+    b = mx.io.DataBatch([mx.nd.zeros((2, 2))], [mx.nd.zeros((2,))],
+                        pad=1, bucket_key=7)
+    assert b.pad == 1 and b.bucket_key == 7
+    assert len(b.data) == 1 and len(b.label) == 1

@@ -1,0 +1,346 @@
+"""Operator semantic depth: numpy-oracle checks for op families beyond
+the registry-wide gradient corpus (reference: test_operator.py's
+per-family semantic cases — axis/keepdims combos, padding conventions,
+index-op consistency, known-value geometry ops).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# reductions: axis/keepdims lattice against numpy
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op,npf", [
+    ("sum", np.sum), ("mean", np.mean), ("prod", np.prod),
+    ("max", np.max), ("min", np.min),
+])
+@pytest.mark.parametrize("axis", [None, 0, 1, 2, (0, 2), (1, 2)])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_reduce_axis_keepdims(op, npf, axis, keepdims):
+    x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32) + 0.5
+    got = getattr(mx.nd, op)(_nd(x), axis=axis,
+                             keepdims=keepdims).asnumpy()
+    want = npf(x, axis=axis, keepdims=keepdims)
+    np.testing.assert_allclose(got.reshape(np.shape(want)), want,
+                               rtol=2e-5)
+
+
+def test_nansum_nanprod():
+    x = np.array([[1.0, np.nan], [2.0, 3.0]], np.float32)
+    np.testing.assert_allclose(mx.nd.nansum(_nd(x)).asnumpy(), 6.0)
+    np.testing.assert_allclose(
+        mx.nd.nanprod(_nd(x), axis=1).asnumpy(), [1.0, 6.0])
+
+
+def test_norm_ord_axis():
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.norm(_nd(x)).asnumpy(),
+        np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.norm(_nd(x), ord=1, axis=1).asnumpy(),
+        np.abs(x).sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.norm(_nd(x), ord=2, axis=0).asnumpy(),
+        np.sqrt((x * x).sum(axis=0)), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# indexing family consistency
+# ---------------------------------------------------------------------------
+def test_take_axis_and_modes():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = _nd([0, 2])
+    np.testing.assert_array_equal(
+        mx.nd.take(_nd(x), idx).asnumpy(), x[[0, 2]])
+    np.testing.assert_array_equal(
+        mx.nd.take(_nd(x), idx, axis=1).asnumpy(), x[:, [0, 2]])
+    # clip mode: out-of-range clamps (reference default mode='clip')
+    np.testing.assert_array_equal(
+        mx.nd.take(_nd(x), _nd([5]), mode="clip").asnumpy(), x[[2]])
+
+
+def test_pick_matches_numpy():
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    idx = np.array([0, 3, 1, 4], np.float32)
+    got = mx.nd.pick(_nd(x), _nd(idx)).asnumpy()
+    np.testing.assert_allclose(got, x[np.arange(4), idx.astype(int)])
+    # keepdims
+    got = mx.nd.pick(_nd(x), _nd(idx), keepdims=True).asnumpy()
+    assert got.shape == (4, 1)
+
+
+def test_gather_nd_scatter_nd_roundtrip():
+    data = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    indices = np.array([[0, 1, 2], [1, 3, 0]], np.float32)  # (2, M)
+    picked = mx.nd.gather_nd(_nd(data), _nd(indices)).asnumpy()
+    np.testing.assert_allclose(picked, data[[0, 1, 2], [1, 3, 0]])
+    scat = mx.nd.scatter_nd(_nd(picked), _nd(indices),
+                            shape=(3, 4)).asnumpy()
+    mask = np.zeros((3, 4), bool)
+    mask[[0, 1, 2], [1, 3, 0]] = True
+    np.testing.assert_allclose(scat[mask], picked)
+    assert (scat[~mask] == 0).all()
+
+
+def test_one_hot_and_argmax_inverse():
+    idx = np.array([1, 0, 3], np.float32)
+    oh = mx.nd.one_hot(_nd(idx), depth=4).asnumpy()
+    assert oh.shape == (3, 4)
+    np.testing.assert_array_equal(oh.argmax(axis=1), idx)
+    np.testing.assert_array_equal(
+        mx.nd.argmax(_nd(oh), axis=1).asnumpy(), idx)
+    # on/off values
+    oh2 = mx.nd.one_hot(_nd(idx), depth=4, on_value=2.0,
+                        off_value=-1.0).asnumpy()
+    assert oh2.max() == 2.0 and oh2.min() == -1.0
+
+
+def test_boolean_mask():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    mask = np.array([1, 0, 1, 0], np.float32)
+    got = mx.nd.contrib.boolean_mask(_nd(x), _nd(mask)).asnumpy() \
+        if hasattr(mx.nd, "contrib") and hasattr(mx.nd.contrib,
+                                                 "boolean_mask") \
+        else mx.nd.boolean_mask(_nd(x), _nd(mask)).asnumpy()
+    np.testing.assert_array_equal(got[:2], x[[0, 2]])
+
+
+def test_index_copy():
+    x = mx.nd.zeros((5, 2))
+    upd = _nd([[1.0, 2.0], [3.0, 4.0]])
+    out = mx.nd.index_copy(x, _nd([1, 3]), upd).asnumpy()
+    np.testing.assert_array_equal(out[1], [1, 2])
+    np.testing.assert_array_equal(out[3], [3, 4])
+    assert (out[[0, 2, 4]] == 0).all()
+
+
+def test_ravel_multi_index():
+    idx = np.array([[1, 2], [0, 3]], np.float32)  # (ndim=2, n)
+    got = mx.nd.ravel_multi_index(_nd(idx), shape=(3, 4)).asnumpy()
+    np.testing.assert_array_equal(
+        got, np.ravel_multi_index(([1, 2], [0, 3]), (3, 4)))
+
+
+# ---------------------------------------------------------------------------
+# layout ops
+# ---------------------------------------------------------------------------
+def test_depth_space_roundtrip():
+    x = np.random.RandomState(0).rand(2, 8, 3, 3).astype(np.float32)
+    d2s = mx.nd.depth_to_space(_nd(x), block_size=2)
+    assert d2s.shape == (2, 2, 6, 6)
+    back = mx.nd.space_to_depth(d2s, block_size=2).asnumpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_swapaxis_flip_reverse():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(
+        mx.nd.SwapAxis(_nd(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+    np.testing.assert_array_equal(
+        mx.nd.reverse(_nd(x), axis=1).asnumpy(), x[:, ::-1])
+    np.testing.assert_array_equal(
+        mx.nd.flip(_nd(x), axis=2).asnumpy(), x[:, :, ::-1])
+
+
+def test_pad_constant_and_edge():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = mx.nd.pad(_nd(x), mode="constant", constant_value=7.0,
+                    pad_width=(0, 0, 0, 0, 1, 1, 2, 2)).asnumpy()
+    assert got.shape == (1, 1, 6, 8)
+    assert (got[0, 0, 0] == 7).all() and (got[0, 0, :, :2] == 7).all()
+    np.testing.assert_array_equal(got[0, 0, 1:-1, 2:-2], x[0, 0])
+    got = mx.nd.pad(_nd(x), mode="edge",
+                    pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).asnumpy()
+    np.testing.assert_array_equal(got[0, 0, 0, 1:-1], x[0, 0, 0])
+
+
+def test_diag_and_linalg_extract():
+    x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    np.testing.assert_allclose(mx.nd.diag(_nd(x)).asnumpy(),
+                               np.diag(x))
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(mx.nd.diag(_nd(v)).asnumpy(), np.diag(v))
+    np.testing.assert_allclose(
+        mx.nd.diag(_nd(x), k=1).asnumpy(), np.diag(x, k=1))
+
+
+# ---------------------------------------------------------------------------
+# matmul family transpose lattice
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_dot_transpose_combos(ta, tb):
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    A = a.T.copy() if ta else a
+    B = b.T.copy() if tb else b
+    got = mx.nd.dot(_nd(A), _nd(B), transpose_a=ta,
+                    transpose_b=tb).asnumpy()
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+
+
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_batch_dot_transpose_combos(ta, tb):
+    rng = np.random.RandomState(0)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    b = rng.rand(2, 4, 5).astype(np.float32)
+    A = np.swapaxes(a, 1, 2).copy() if ta else a
+    B = np.swapaxes(b, 1, 2).copy() if tb else b
+    got = mx.nd.batch_dot(_nd(A), _nd(B), transpose_a=ta,
+                          transpose_b=tb).asnumpy()
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# conv/pool conventions
+# ---------------------------------------------------------------------------
+def test_convolution_dilation_matches_explicit():
+    """Dilated 3x3 == undilated 5x5 with zero-interleaved kernel."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 1, 8, 8).astype(np.float32)
+    k3 = rng.rand(1, 1, 3, 3).astype(np.float32)
+    k5 = np.zeros((1, 1, 5, 5), np.float32)
+    k5[:, :, ::2, ::2] = k3
+    got = mx.nd.Convolution(_nd(x), _nd(k3), kernel=(3, 3),
+                            dilate=(2, 2), num_filter=1,
+                            no_bias=True).asnumpy()
+    want = mx.nd.Convolution(_nd(x), _nd(k5), kernel=(5, 5),
+                             num_filter=1, no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_pooling_count_include_pad():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    # avg pool with padding: padded zeros change the mean only when
+    # count_include_pad (reference pooling-inl.h semantics)
+    incl = mx.nd.Pooling(_nd(x), kernel=(3, 3), pool_type="avg",
+                         stride=(1, 1), pad=(1, 1),
+                         count_include_pad=True).asnumpy()
+    excl = mx.nd.Pooling(_nd(x), kernel=(3, 3), pool_type="avg",
+                         stride=(1, 1), pad=(1, 1),
+                         count_include_pad=False).asnumpy()
+    assert abs(incl[0, 0, 0, 0] - 4.0 / 9.0) < 1e-6
+    assert abs(excl[0, 0, 0, 0] - 1.0) < 1e-6
+    np.testing.assert_allclose(incl[0, 0, 1:-1, 1:-1], 1.0)
+
+
+def test_global_pooling():
+    x = np.random.RandomState(0).rand(2, 3, 5, 5).astype(np.float32)
+    got = mx.nd.Pooling(_nd(x), global_pool=True, pool_type="avg",
+                        kernel=(1, 1)).asnumpy()
+    np.testing.assert_allclose(got.reshape(2, 3),
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+    got = mx.nd.Pooling(_nd(x), global_pool=True, pool_type="max",
+                        kernel=(1, 1)).asnumpy()
+    np.testing.assert_allclose(got.reshape(2, 3), x.max(axis=(2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# geometry ops with known values
+# ---------------------------------------------------------------------------
+def test_box_iou_known_values():
+    a = _nd([[0.0, 0.0, 2.0, 2.0]])
+    b = _nd([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0],
+             [5.0, 5.0, 6.0, 6.0]])
+    iou = mx.nd.contrib.box_iou(a, b, format="corner").asnumpy() \
+        if hasattr(mx.nd, "contrib") and hasattr(mx.nd.contrib,
+                                                 "box_iou") \
+        else mx.nd.box_iou(a, b, format="corner").asnumpy()
+    np.testing.assert_allclose(iou.ravel(), [1.0 / 7.0, 1.0, 0.0],
+                               rtol=1e-5)
+
+
+def test_bilinear_resize_exact_on_linear_ramp():
+    """Bilinear upsampling of a linear ramp reproduces the ramp."""
+    H = W = 4
+    ramp = np.arange(H, dtype=np.float32).reshape(1, 1, H, 1) \
+        * np.ones((1, 1, 1, W), np.float32)
+    out = mx.nd.contrib.BilinearResize2D(_nd(ramp), height=7,
+                                         width=7).asnumpy() \
+        if hasattr(mx.nd, "contrib") and hasattr(
+            mx.nd.contrib, "BilinearResize2D") \
+        else mx.nd.BilinearResize2D(_nd(ramp), height=7,
+                                    width=7).asnumpy()
+    # rows remain constant across width, monotone down height
+    assert np.allclose(out[0, 0, :, 0], out[0, 0, :, -1], atol=1e-5)
+    d = np.diff(out[0, 0, :, 0])
+    assert (d > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# gradient spot checks on tricky ops
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op,kw", [
+    ("clip", dict(a_min=-0.5, a_max=0.5)),
+    ("pick", None),  # handled below
+])
+def test_clip_gradient_zero_outside_range(op, kw):
+    if op != "clip":
+        pytest.skip("parametrize artifact")
+    x = _nd([-1.0, 0.0, 1.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.clip(x, **kw)
+    y.backward(mx.nd.ones((3,)))
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0.0, 1.0, 0.0])
+
+
+def test_where_gradients_route_by_condition():
+    cond = _nd([1.0, 0.0, 1.0])
+    a = _nd([1.0, 2.0, 3.0])
+    b = _nd([4.0, 5.0, 6.0])
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.where(cond, a, b)
+    y.backward(_nd([1.0, 1.0, 1.0]))
+    np.testing.assert_array_equal(a.grad.asnumpy(), [1.0, 0.0, 1.0])
+    np.testing.assert_array_equal(b.grad.asnumpy(), [0.0, 1.0, 0.0])
+
+
+def test_softmax_with_temperature_and_axis():
+    x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32)
+    for axis in (0, 1, 2, -1):
+        got = mx.nd.softmax(_nd(x), axis=axis).asnumpy()
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(axis=axis,
+                                                  keepdims=True),
+                                   rtol=1e-5)
+    got = mx.nd.softmax(_nd(x), temperature=2.0).asnumpy()
+    e = np.exp(x / 2.0 - (x / 2.0).max(axis=-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(axis=-1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_l2_normalization_modes():
+    x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32)
+    got = mx.nd.L2Normalization(_nd(x), mode="instance").asnumpy()
+    want = x / np.sqrt((x ** 2).sum(axis=(1, 2),
+                                    keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    got = mx.nd.L2Normalization(_nd(x), mode="channel").asnumpy()
+    want = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_numeric_gradient_spot_checks():
+    """Finite differences on ops whose vjp routes through indexing."""
+    rng = np.random.RandomState(0)
+    data = rng.rand(3, 4).astype(np.float64)
+    check_numeric_gradient(
+        lambda d: mx.nd.take(d, _nd([2, 0])), [data])
+    check_numeric_gradient(
+        lambda d: mx.nd.SwapAxis(d, dim1=0, dim2=1), [data])
+    check_numeric_gradient(
+        lambda d: mx.nd.reverse(d, axis=0), [data])

@@ -1,0 +1,154 @@
+"""Random-op statistical tests (reference:
+``tests/python/unittest/test_random.py`` — moment checks per
+distribution, seed reproducibility, shuffle permutation invariants).
+
+Tolerances follow the reference's pattern: generous k-sigma bands on
+large samples so the tests are seed-robust (the conftest seed fixture
+pins them anyway).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 50_000  # big enough that 5-sigma moment bands are tight
+
+
+def _draw(fn, **kw):
+    return fn(shape=(N,), **kw).asnumpy().astype(np.float64)
+
+
+def _check_moments(x, mean, var, name, k=5.0):
+    se_mean = np.sqrt(var / len(x))
+    assert abs(x.mean() - mean) < k * se_mean + 1e-3, \
+        "%s mean %g vs %g" % (name, x.mean(), mean)
+    # variance concentrates ~ sqrt(2/n)*var for near-gaussian tails; use
+    # a loose 20%% band to stay robust for skewed distributions
+    assert abs(x.var() - var) < 0.2 * var + 1e-3, \
+        "%s var %g vs %g" % (name, x.var(), var)
+
+
+def test_uniform_moments_and_bounds():
+    x = _draw(mx.nd.random.uniform, low=-2.0, high=3.0)
+    assert x.min() >= -2.0 and x.max() < 3.0
+    _check_moments(x, 0.5, 25.0 / 12.0, "uniform")
+
+
+def test_normal_moments():
+    x = _draw(mx.nd.random.normal, loc=1.5, scale=2.0)
+    _check_moments(x, 1.5, 4.0, "normal")
+
+
+def test_gamma_moments():
+    # shape k=3, scale theta=2 -> mean 6, var 12
+    x = _draw(mx.nd.random.gamma, alpha=3.0, beta=2.0)
+    assert x.min() > 0
+    _check_moments(x, 6.0, 12.0, "gamma")
+
+
+def test_exponential_moments():
+    x = _draw(mx.nd.random.exponential, scale=0.5)
+    assert x.min() >= 0
+    _check_moments(x, 0.5, 0.25, "exponential")
+
+
+def test_poisson_moments():
+    x = _draw(mx.nd.random.poisson, lam=4.0)
+    assert np.allclose(x, np.round(x)) and x.min() >= 0
+    _check_moments(x, 4.0, 4.0, "poisson")
+
+
+def test_negative_binomial_moments():
+    # k failures, success prob p: mean k(1-p)/p, var k(1-p)/p^2
+    k, p = 5, 0.4
+    x = _draw(mx.nd.random.negative_binomial, k=k, p=p)
+    _check_moments(x, k * (1 - p) / p, k * (1 - p) / p ** 2, "negbin")
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 3.0, 0.5
+    x = _draw(mx.nd.random.generalized_negative_binomial, mu=mu,
+              alpha=alpha)
+    _check_moments(x, mu, mu + alpha * mu * mu, "gen-negbin")
+
+
+def test_randint_bounds_and_coverage():
+    x = mx.nd.random.randint(-3, 4, shape=(N,)).asnumpy()
+    assert x.min() >= -3 and x.max() <= 3
+    # every value in the range appears
+    assert set(np.unique(x).tolist()) == set(range(-3, 4))
+
+
+def test_multinomial_frequencies():
+    probs = mx.nd.array(np.array([[0.1, 0.2, 0.3, 0.4]], np.float32))
+    x = mx.nd.random.multinomial(probs, shape=(N,)).asnumpy().ravel()
+    counts = np.bincount(x.astype(np.int64), minlength=4) / len(x)
+    np.testing.assert_allclose(counts, [0.1, 0.2, 0.3, 0.4], atol=0.02)
+
+
+def test_shuffle_is_permutation():
+    src = np.arange(1000, dtype=np.float32)
+    out = mx.nd.random.shuffle(mx.nd.array(src)).asnumpy()
+    assert not np.array_equal(out, src)  # astronomically unlikely
+    assert np.array_equal(np.sort(out), src)
+
+
+def test_seed_reproducibility_and_divergence():
+    """Reference semantics: same seed -> identical streams, different
+    seed -> different streams; the stream advances call to call."""
+    mx.random.seed(123)
+    a1 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    a2 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    mx.random.seed(123)
+    b1 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    b2 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    assert not np.array_equal(a1, a2)  # stream advances
+    mx.random.seed(124)
+    c1 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    assert not np.array_equal(a1, c1)
+
+
+def test_sample_ops_vectorized_params():
+    """Per-row parameters (the reference's *sample_op* family): each row
+    drawn from its own distribution."""
+    mu = mx.nd.array(np.array([0.0, 10.0], np.float32))
+    sigma = mx.nd.array(np.array([1.0, 0.1], np.float32))
+    x = mx.nd.sample_normal(mu=mu, sigma=sigma,
+                            shape=(N // 10,)).asnumpy()
+    assert x.shape == (2, N // 10)
+    assert abs(x[0].mean()) < 0.1 and abs(x[1].mean() - 10.0) < 0.05
+    assert x[0].std() > 5 * x[1].std()
+
+
+def test_dropout_rate_statistics():
+    """Dropout keeps ~(1-p) of units scaled by 1/(1-p) in train mode and
+    is identity in inference (reference test_operator dropout checks)."""
+    x = mx.nd.ones((N // 5,))
+    with mx.autograd.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.3)
+    yn = y.asnumpy()
+    kept = yn != 0
+    assert abs(kept.mean() - 0.7) < 0.02
+    np.testing.assert_allclose(yn[kept], 1.0 / 0.7, rtol=1e-5)
+    y_inf = mx.nd.Dropout(x, p=0.3).asnumpy()
+    np.testing.assert_allclose(y_inf, 1.0, rtol=1e-6)
+
+
+def test_bernoulli_rate():
+    x = mx.nd.bernoulli(prob=0.25, shape=(N,)).asnumpy()
+    assert set(np.unique(x).tolist()) <= {0.0, 1.0}
+    assert abs(x.mean() - 0.25) < 0.02
+
+
+@pytest.mark.parametrize("op,kw", [
+    ("random_uniform", dict(low=0, high=1)),
+    ("random_normal", dict(loc=0, scale=1)),
+    ("random_gamma", dict(alpha=2.0, beta=1.0)),
+    ("random_poisson", dict(lam=2.0)),
+])
+def test_registry_random_ops_shapes(op, kw):
+    out = getattr(mx.nd, op)(shape=(3, 4), **kw)
+    assert out.shape == (3, 4)
+    assert np.isfinite(out.asnumpy()).all()
