@@ -83,9 +83,17 @@ class SVRGModule(Module):
                 continue
             g[:] = g - g_tilde + m
 
+    def fit(self, train_data, *args, begin_epoch=0, **kwargs):
+        # anchor the snapshot schedule to this fit call's first epoch so
+        # resumed training (begin_epoch > 0) still snapshots immediately
+        self._fit_begin_epoch = begin_epoch
+        return super().fit(train_data, *args, begin_epoch=begin_epoch,
+                           **kwargs)
+
     def _epoch_begin(self, epoch, train_data):
         """BaseModule.fit hook: refresh the snapshot + full gradient
         every ``update_freq`` epochs (reference svrg_module.py:395's
         epoch loop delta — the rest of fit is the base loop)."""
-        if epoch % self.update_freq == 0:
+        start = getattr(self, "_fit_begin_epoch", 0)
+        if (epoch - start) % self.update_freq == 0:
             self.update_full_grads(train_data)

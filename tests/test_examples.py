@@ -120,3 +120,15 @@ def test_rnn_bucketing():
                 "--epochs", "3", "--num-sentences", "600"], timeout=900)
     ppl = float(out.split("final perplexity ")[1].split()[0])
     assert ppl < 120, out
+
+
+def test_gan_dcgan():
+    out = _run([os.path.join(EX, "gan", "dcgan.py"),
+                "--num-epochs", "3", "--steps-per-epoch", "20"],
+               timeout=900)
+    assert "final stat-dist" in out, out
+    dists = [float(l.split("stat-dist=")[1])
+             for l in out.splitlines() if "Epoch" in l and
+             "stat-dist=" in l]
+    # generator distribution moves toward the real one
+    assert dists and dists[-1] < dists[0], out

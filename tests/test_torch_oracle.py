@@ -1,0 +1,240 @@
+"""Cross-framework consistency: our TPU-native kernels vs torch-CPU.
+
+The reference's main accelerator-correctness device is
+``check_consistency`` with CPU as the oracle backend
+(``python/mxnet/test_utils.py:1224``).  Here the XLA-CPU run already IS
+our oracle, so this file adds an *independent* oracle — PyTorch's CPU
+kernels — for the structured ops whose math has real room for
+implementation bugs (conv/deconv padding+dilation+groups, pooling
+conventions, norms, LSTM/GRU recurrences, CTC).  Forward AND input
+gradients are compared.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _grad_pair(mx_fn, torch_fn, x_np, rtol=1e-4, atol=1e-5):
+    """Run fwd+bwd through both frameworks, compare outputs and dX."""
+    x_mx = mx.nd.array(x_np)
+    x_mx.attach_grad()
+    with mx.autograd.record():
+        y_mx = mx_fn(x_mx)
+    y_mx.backward(mx.nd.ones(y_mx.shape))
+
+    x_t = torch.tensor(x_np, requires_grad=True)
+    y_t = torch_fn(x_t)
+    y_t.backward(torch.ones_like(y_t))
+
+    np.testing.assert_allclose(y_mx.asnumpy(), y_t.detach().numpy(),
+                               rtol=rtol, atol=atol)
+    np.testing.assert_allclose(x_mx.grad.asnumpy(), x_t.grad.numpy(),
+                               rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 2), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_conv2d_vs_torch(stride, pad, dilate, groups):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    _grad_pair(
+        lambda d: mx.nd.Convolution(
+            d, mx.nd.array(w), mx.nd.array(b), kernel=(3, 3),
+            num_filter=6, stride=stride, pad=pad, dilate=dilate,
+            num_group=groups),
+        lambda t: F.conv2d(t, torch.tensor(w), torch.tensor(b),
+                           stride=stride, padding=pad, dilation=dilate,
+                           groups=groups),
+        x)
+
+
+def test_deconv2d_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    # reference weight layout (in_c, out_c, kh, kw) == torch's
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    _grad_pair(
+        lambda d: mx.nd.Deconvolution(
+            d, mx.nd.array(w), kernel=(3, 3), num_filter=3,
+            stride=(2, 2), pad=(1, 1), no_bias=True),
+        lambda t: F.conv_transpose2d(t, torch.tensor(w), stride=2,
+                                     padding=1),
+        x)
+
+
+@pytest.mark.parametrize("pool_type,torch_fn", [
+    ("max", lambda t: F.max_pool2d(t, 2, 2)),
+    ("avg", lambda t: F.avg_pool2d(t, 2, 2)),
+])
+def test_pooling_vs_torch(pool_type, torch_fn):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    _grad_pair(
+        lambda d: mx.nd.Pooling(d, kernel=(2, 2), stride=(2, 2),
+                                pool_type=pool_type),
+        torch_fn, x)
+
+
+def test_avg_pool_padded_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    _grad_pair(
+        lambda d: mx.nd.Pooling(d, kernel=(3, 3), stride=(2, 2),
+                                pad=(1, 1), pool_type="avg",
+                                count_include_pad=True),
+        lambda t: F.avg_pool2d(t, 3, 2, padding=1,
+                               count_include_pad=True),
+        x)
+
+
+def test_batchnorm_train_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 3, 5, 5).astype(np.float32) * 3 + 2
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+
+    x_mx = mx.nd.array(x)
+    x_mx.attach_grad()
+    mm = mx.nd.zeros((3,))
+    mv = mx.nd.ones((3,))
+    with mx.autograd.record(train_mode=True):
+        y_mx = mx.nd.BatchNorm(x_mx, mx.nd.array(gamma),
+                               mx.nd.array(beta), mm, mv,
+                               fix_gamma=False, eps=1e-5)[0]
+    y_mx.backward(mx.nd.ones(y_mx.shape))
+
+    x_t = torch.tensor(x, requires_grad=True)
+    y_t = F.batch_norm(x_t, torch.zeros(3), torch.ones(3),
+                       torch.tensor(gamma), torch.tensor(beta),
+                       training=True, eps=1e-5)
+    y_t.backward(torch.ones_like(y_t))
+    np.testing.assert_allclose(y_mx.asnumpy(), y_t.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(x_mx.grad.asnumpy(), x_t.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm_vs_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 10).astype(np.float32)
+    g = rng.rand(10).astype(np.float32) + 0.5
+    b = rng.randn(10).astype(np.float32)
+    _grad_pair(
+        lambda d: mx.nd.LayerNorm(d, mx.nd.array(g), mx.nd.array(b),
+                                  eps=1e-5),
+        lambda t: F.layer_norm(t, (10,), torch.tensor(g),
+                               torch.tensor(b), eps=1e-5),
+        x)
+
+
+def test_lstm_forward_vs_torch():
+    """Fused RNN op (mode=lstm) against torch.nn.LSTM with the weights
+    packed the reference way (gate order i,f,g,o in both)."""
+    rng = np.random.RandomState(6)
+    T, N, I, H = 5, 3, 4, 6
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    lstm = torch.nn.LSTM(I, H, 1)
+    # pack torch weights into the reference's flat parameter layout:
+    # W_ih (4H, I), W_hh (4H, H), b_ih (4H), b_hh (4H)
+    with torch.no_grad():
+        w_ih = lstm.weight_ih_l0.numpy().copy()
+        w_hh = lstm.weight_hh_l0.numpy().copy()
+        b_ih = lstm.bias_ih_l0.numpy().copy()
+        b_hh = lstm.bias_hh_l0.numpy().copy()
+    params = np.concatenate([w_ih.ravel(), w_hh.ravel(),
+                             b_ih.ravel(), b_hh.ravel()])
+
+    out_mx = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                       mx.nd.zeros((1, N, H)), mx.nd.zeros((1, N, H)),
+                       state_size=H, num_layers=1,
+                       mode="lstm")[0].asnumpy()
+    out_t, _ = lstm(torch.tensor(x))
+    np.testing.assert_allclose(out_mx, out_t.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_forward_vs_torch():
+    rng = np.random.RandomState(7)
+    T, N, I, H = 4, 2, 3, 5
+    x = rng.randn(T, N, I).astype(np.float32)
+    gru = torch.nn.GRU(I, H, 1)
+    with torch.no_grad():
+        params = np.concatenate([
+            gru.weight_ih_l0.numpy().ravel(),
+            gru.weight_hh_l0.numpy().ravel(),
+            gru.bias_ih_l0.numpy().ravel(),
+            gru.bias_hh_l0.numpy().ravel()])
+    out_mx = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                       mx.nd.zeros((1, N, H)), state_size=H,
+                       num_layers=1, mode="gru")[0].asnumpy()
+    out_t, _ = gru(torch.tensor(x))
+    np.testing.assert_allclose(out_mx, out_t.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    rng = np.random.RandomState(8)
+    T, N, C, S = 10, 2, 5, 4  # C includes blank (index 0 in both)
+    logits = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2, 3, 0], [2, 2, 0, 0]], np.float32)
+    label_lens = np.array([3, 2], np.float32)
+
+    loss_mx = mx.nd.ctc_loss(mx.nd.array(logits), mx.nd.array(labels),
+                             blank_label="first").asnumpy()
+
+    lp = F.log_softmax(torch.tensor(logits), dim=-1)
+    loss_t = F.ctc_loss(lp, torch.tensor(labels[:, :3].astype(np.int64)),
+                        torch.full((N,), T, dtype=torch.long),
+                        torch.tensor(label_lens.astype(np.int64)),
+                        blank=0, reduction="none")
+    np.testing.assert_allclose(loss_mx, loss_t.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_softmax_cross_entropy_grad_vs_torch():
+    rng = np.random.RandomState(9)
+    x = rng.randn(6, 8).astype(np.float32)
+    label = rng.randint(0, 8, (6,)).astype(np.float32)
+
+    x_mx = mx.nd.array(x)
+    x_mx.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(x_mx, mx.nd.array(label))
+    out.backward()  # SoftmaxOutput: grad is (p - onehot)/1
+
+    x_t = torch.tensor(x, requires_grad=True)
+    loss = F.cross_entropy(x_t, torch.tensor(label.astype(np.int64)),
+                           reduction="sum")
+    loss.backward()
+    np.testing.assert_allclose(x_mx.grad.asnumpy(), x_t.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_grad_vs_torch():
+    rng = np.random.RandomState(10)
+    table = rng.randn(7, 4).astype(np.float32)
+    idx = np.array([1, 3, 1, 6], np.float32)
+
+    w_mx = mx.nd.array(table)
+    w_mx.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Embedding(mx.nd.array(idx), w_mx, input_dim=7,
+                              output_dim=4)
+    out.backward(mx.nd.ones(out.shape))
+
+    w_t = torch.tensor(table, requires_grad=True)
+    out_t = F.embedding(torch.tensor(idx.astype(np.int64)), w_t)
+    out_t.backward(torch.ones_like(out_t))
+    np.testing.assert_allclose(w_mx.grad.asnumpy(), w_t.grad.numpy(),
+                               rtol=1e-5, atol=1e-6)
