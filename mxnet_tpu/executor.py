@@ -30,9 +30,22 @@ __all__ = ["Executor"]
 class Executor:
     def __init__(self, symbol, ctx=None, grad_req="write", arg_shapes=None,
                  args=None, args_grad=None, aux_states=None, type_dict=None,
-                 group2ctx=None, shared_exec=None):
+                 group2ctx=None, shared_exec=None, dp_args=None):
         self._symbol = symbol
-        self._ctx = ctx or current_context()
+        # data parallelism over a context LIST (reference:
+        # DataParallelExecutorGroup, module/executor_group.py:143 — batch
+        # split across contexts, per-device executor replicas, gradient
+        # reduce via kvstore).  TPU-native redesign: ONE SPMD module over
+        # a ("dp",) device mesh — args named in `dp_args` (the data/label
+        # inputs) are sharded on their batch dim, params are replicated,
+        # and XLA's partitioner inserts the gradient all-reduce the
+        # reference routed through kvstore push/pull.
+        ctx_list = list(ctx) if isinstance(ctx, (list, tuple)) else None
+        self._ctx = (ctx_list[0] if ctx_list else ctx) or current_context()
+        self._ctx_list = ctx_list  # preserved across reshape()
+        self._dp_devs = ([c.jax_device() for c in ctx_list]
+                         if ctx_list and len(ctx_list) > 1 else None)
+        self._dp_args = set(dp_args or ()) if self._dp_devs else set()
         # model-parallel placement (reference AssignContext,
         # graph_executor.cc:909-915): nodes stamped `__ctx_group__` (via
         # mx.AttrScope(ctx_group=...)) are pinned to group2ctx[group]'s
@@ -230,20 +243,49 @@ class Executor:
                                                         None))
         else:
             raise ValueError(kind)
-        # pin execution to the bound context's device: without this a
-        # cpu()-bound executor on a TPU host runs under the default (TPU)
-        # device and its outputs silently migrate the arg arrays there
-        dev = self._ctx.jax_device()
         inner = f
+        if self._dp_devs and self._dp_args:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        def f(*a, _inner=inner, _dev=dev):
-            with jax.default_device(_dev):
-                return _inner(*a)
+            mesh = Mesh(np.array(self._dp_devs), ("dp",))
+            shard = NamedSharding(mesh, PartitionSpec("dp"))
+            repl = NamedSharding(mesh, PartitionSpec())
+            dp_idx = {i for i, n in enumerate(self.arg_names)
+                      if n in self._dp_args}
+
+            def f(rng, args, auxs, *rest, _inner=inner):
+                args = [jax.device_put(a, shard if i in dp_idx else repl)
+                        for i, a in enumerate(args)]
+                auxs = [jax.device_put(a, repl) for a in auxs]
+                # head gradients (the "backward" kind's extra arg) match
+                # the outputs' batch-sharded layout
+                rest = tuple(
+                    tuple(jax.device_put(h, shard) if h is not None
+                          else None for h in r)
+                    if isinstance(r, (tuple, list)) else r for r in rest)
+                return _inner(rng, args, auxs, *rest)
+        else:
+            # pin execution to the bound context's device: without this a
+            # cpu()-bound executor on a TPU host runs under the default
+            # (TPU) device and its outputs silently migrate the arg
+            # arrays there
+            dev = self._ctx.jax_device()
+
+            def f(*a, _inner=inner, _dev=dev):
+                with jax.default_device(_dev):
+                    return _inner(*a)
 
         self._fn_cache[key] = f
         return f
 
     # ------------------------------------------------------------------
+    def _devolve(self, vals):
+        """Under dp: move mesh-replicated results to the primary device."""
+        if not self._dp_devs:
+            return vals
+        prim = self._ctx.jax_device()
+        return tuple(jax.device_put(v, prim) for v in vals)
+
     def _stage(self, feed):
         """Write a {name: array} feed into the bound arg arrays."""
         for k, v in feed.items():
@@ -281,6 +323,7 @@ class Executor:
         rng = _random.next_key()
         aux_in = [a.data for a in self.aux_arrays]
         outs, new_aux = fn(rng, [a.data for a in self.arg_arrays], aux_in)
+        new_aux = self._devolve(new_aux)
         self._last_rng = rng
         # snapshot pre-update aux: a following backward() recomputes the
         # forward from this same starting state, so aux EMA (BatchNorm
@@ -343,6 +386,12 @@ class Executor:
             fn = self._compiled("backward", True)
             outs, new_aux, grads = fn(rng, arg_data, aux_in,
                                       tuple(concrete_heads))
+        # under dp, grads/aux are mesh-replicated; bring them home to the
+        # primary device so the (single-device) optimizer kernels and any
+        # imperative follow-up ops see ordinary committed arrays — the
+        # replicated layout makes this a local shard fetch, not a gather
+        grads = self._devolve(grads)
+        new_aux = self._devolve(new_aux)
         grad_pos = [i for i, n in enumerate(self.arg_names)
                     if self._grad_req.get(n, "null") != "null"]
         for p, g in zip(grad_pos, grads):
@@ -382,8 +431,9 @@ class Executor:
         """Rebind with new shapes (cheap: jit recompiles per shape key)."""
         shapes = {n: a.shape for n, a in self.arg_dict.items()}
         shapes.update(kwargs)
-        new = Executor(self._symbol, ctx=self._ctx, grad_req=self._grad_req,
-                       arg_shapes=shapes)
+        new = Executor(self._symbol, ctx=self._ctx_list or self._ctx,
+                       grad_req=self._grad_req, arg_shapes=shapes,
+                       dp_args=self._dp_args)
         for n, a in self.arg_dict.items():
             if new.arg_dict[n].shape == a.shape:
                 new.arg_dict[n]._set_data(a.data)

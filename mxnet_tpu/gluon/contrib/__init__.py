@@ -1,4 +1,5 @@
 """Gluon contrib (reference: ``python/mxnet/gluon/contrib/``)."""
 from .fused import FusedTrainStep
+from . import nn  # noqa: F401
 
-__all__ = ["FusedTrainStep"]
+__all__ = ["FusedTrainStep", "nn"]

@@ -1,0 +1,95 @@
+"""Contrib neural-network layers (reference: ``gluon/contrib/nn/
+basic_layers.py`` — Concurrent/HybridConcurrent/Identity/
+SyncBatchNorm/PixelShuffle2D)."""
+from __future__ import annotations
+
+from ...ndarray import concat as _nd_concat
+from ..block import HybridBlock
+from ..nn.basic_layers import BatchNorm, Sequential, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm",
+           "PixelShuffle2D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs along ``axis``
+    (reference contrib Concurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return _nd_concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference contrib HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity passthrough — useful in Concurrent branches (reference
+    contrib Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference:
+    ``src/operator/contrib/sync_batch_norm.cc`` + contrib
+    SyncBatchNorm(num_devices=...) — per-batch statistics reduced over
+    all data-parallel replicas).
+
+    TPU-native note: under this framework's data-parallel execution the
+    batch axis is a *sharded axis of one SPMD program*, so the plain
+    BatchNorm reduction already computes GLOBAL batch statistics (the
+    partitioner inserts the cross-replica all-reduce the reference
+    implements by hand with its Barrier/AllReduce pair).  This class
+    therefore IS BatchNorm; it exists so reference code porting over
+    keeps working, and ``num_devices``/``key`` are accepted and ignored.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        kwargs.pop("key", None)
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle2D(HybridBlock):
+    """Rearrange (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference
+    contrib PixelShuffle2D — the sub-pixel conv upsampler)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            f1, f2 = factor
+        except TypeError:
+            f1 = f2 = int(factor)
+        if f1 != f2:
+            raise ValueError("depth_to_space requires square factors; "
+                             "got %r" % (factor,))
+        self._factor = int(f1)
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, block_size=self._factor)

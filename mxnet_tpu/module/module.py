@@ -34,6 +34,12 @@ class Module(BaseModule):
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
+        # a context LIST requests data-parallel training: the executor
+        # shards the batch over a ("dp",) mesh of those devices
+        # (reference DataParallelExecutorGroup semantics, SPMD-style)
+        self._context_list = (list(context)
+                              if isinstance(context, (list, tuple))
+                              and len(context) > 1 else None)
         self._context = context if not isinstance(context, (list, tuple)) \
             else context[0]
         self._context = self._context or current_context()
@@ -79,10 +85,11 @@ class Module(BaseModule):
                 req[n] = "null"
             else:
                 req[n] = grad_req if for_training else "null"
-        self._exec = self._symbol.simple_bind(ctx=self._context,
-                                              grad_req=req,
-                                              group2ctx=self._group2ctxs,
-                                              **shapes)
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context_list or self._context, grad_req=req,
+            group2ctx=self._group2ctxs,
+            dp_args=tuple(self._data_names + self._label_names),
+            **shapes)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             ap, xp = shared_module.get_params()

@@ -270,12 +270,14 @@ class Symbol:
     # -- binding --------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, dp_args=None,
+                    **kwargs):
         from ..executor import Executor
 
         return Executor(self, ctx=ctx, grad_req=grad_req,
                         arg_shapes=kwargs, type_dict=type_dict,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        dp_args=dp_args)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

@@ -444,3 +444,46 @@ def test_activations_layers():
     prelu.initialize()
     out = prelu(x)
     np.testing.assert_allclose(out.asnumpy()[0, 0], -0.5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.nn layers
+# ---------------------------------------------------------------------------
+def test_contrib_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3), nn.Dense(3), cnn.Identity())
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 4))
+    out = net(x)
+    assert out.shape == (2, 10)  # 3 + 3 + 4
+    np.testing.assert_allclose(out.asnumpy()[:, 6:], x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_contrib_sync_batchnorm_is_batchnorm():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    sbn = cnn.SyncBatchNorm(in_channels=3, num_devices=8)
+    sbn.initialize(ctx=mx.cpu())
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 3, 5, 5) * 3 + 1)
+    with mx.autograd.record(train_mode=True):
+        out = sbn(x)
+    o = out.asnumpy()
+    # normalized over (N, H, W) per channel
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+
+
+def test_contrib_pixelshuffle2d():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    ps = cnn.PixelShuffle2D(2)
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 8, 3, 3))
+    out = ps(x)
+    assert out.shape == (1, 2, 6, 6)
+    # matches the depth_to_space op directly
+    np.testing.assert_allclose(
+        out.asnumpy(),
+        mx.nd.depth_to_space(x, block_size=2).asnumpy())
