@@ -1,40 +1,16 @@
 """ONNX interop (reference: ``python/mxnet/contrib/onnx/`` —
 ``mx2onnx.export_model`` and ``onnx2mx.import_model``).
 
-Gated: the ``onnx`` package is not part of this TPU image (zero-egress
-environment, no installs).  The entry points keep the reference call
-signatures and raise a clear error; the graph side of an export (what the
-converter would walk) is exactly ``Symbol.tojson()``'s nnvm-shaped node
-list, so a converter can be added without touching the core.
+Self-contained: the converters encode/decode the ONNX protobuf wire
+format directly (``_proto.py``), so they work without the ``onnx``
+package (zero-egress image).  Coverage is the serving-graph op set
+(Conv/Gemm/BatchNorm/Pooling/activations/Softmax/elementwise/Concat/
+Reshape/Dropout, opset 13); tests round-trip export -> import ->
+bit-equal predictions.
 """
 from __future__ import annotations
 
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import import_model  # noqa: F401
+
 __all__ = ["export_model", "import_model"]
-
-_MSG = ("the 'onnx' package is not available in this environment; "
-        "mxnet_tpu keeps the reference call signature but cannot %s. "
-        "Symbol.tojson() provides the graph in nnvm node-list form for "
-        "external conversion.")
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Export a Symbol + params to ONNX (reference mx2onnx.export_model)."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(_MSG % "serialize an ONNX protobuf") from e
-    raise NotImplementedError(
-        "onnx runtime found but the converter is not implemented in this "
-        "build; use Symbol.tojson() + save_checkpoint for interchange")
-
-
-def import_model(model_file):
-    """Import an ONNX model (reference onnx2mx.import_model)."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(_MSG % "parse an ONNX protobuf") from e
-    raise NotImplementedError(
-        "onnx runtime found but the converter is not implemented in this "
-        "build")

@@ -1,0 +1,124 @@
+"""Minimal protobuf wire codec for the ONNX subset this package
+emits/consumes.
+
+Zero-egress environment: the ``onnx`` package (and its generated
+protobuf classes) is not installed, so the converters encode and decode
+the wire format directly — the same approach as the TensorBoard event
+writer (``contrib/tensorboard.py``).  Only the message fields the
+converters use are modeled; unknown fields are skipped on decode, which
+is exactly protobuf's own compatibility rule.
+"""
+from __future__ import annotations
+
+import struct
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def tag(field, wire):
+    return varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    return tag(field, 0) + varint(int(value))
+
+
+def f_bytes(field, payload):
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def f_float(field, value):
+    return tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_packed_floats(field, values):
+    payload = b"".join(struct.pack("<f", float(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+def f_packed_varints(field, values):
+    payload = b"".join(varint(int(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+# ---------------------------------------------------------------------------
+# decoder: wire bytes -> {field: [values]}, values are ints (wire 0),
+# bytes (wire 2), or floats/fixed (wire 5/1 raw)
+# ---------------------------------------------------------------------------
+
+
+def read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decode(buf):
+    """Parse one message's fields: {field_number: [raw values]}."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = read_varint(buf, pos)
+            val = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def decode_packed_varints(payload):
+    out = []
+    pos = 0
+    while pos < len(payload):
+        v, pos = read_varint(payload, pos)
+        out.append(v)
+    return out
+
+
+def decode_packed_floats(payload):
+    return list(struct.unpack("<%df" % (len(payload) // 4), payload))
+
+
+def to_str(b):
+    return b.decode("utf-8")
+
+
+def signed(v, bits=64):
+    """Two's-complement reinterpretation of a decoded varint."""
+    if v >= 1 << (bits - 1):
+        v -= 1 << bits
+    return v

@@ -1,0 +1,303 @@
+"""Symbol + params -> ONNX ModelProto bytes.
+
+Reference: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` + its
+per-op converter registry (``_op_translations.py``).  Same shape here —
+a converter function per op walking ``Symbol._topo()`` — but the
+serialization is the hand-rolled wire codec in ``_proto.py`` (the onnx
+package is not installed in this image).  Emits opset 13.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+# ONNX enums
+TP_FLOAT = 1
+TP_INT64 = 7
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS = 6, 7
+
+
+def _attr(name, value):
+    body = P.f_bytes(1, name)
+    if isinstance(value, bool):
+        body += P.f_varint(3, int(value)) + P.f_varint(20, ATTR_INT)
+    elif isinstance(value, int):
+        body += P.f_varint(3, value) + P.f_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        body += P.f_float(2, value) + P.f_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        body += P.f_bytes(4, value) + P.f_varint(20, ATTR_STRING)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                body += P.f_float(7, v)
+            body += P.f_varint(20, ATTR_FLOATS)
+        else:
+            for v in value:
+                body += P.f_varint(8, int(v))
+            body += P.f_varint(20, ATTR_INTS)
+    else:
+        raise TypeError("unsupported attribute %r=%r" % (name, value))
+    return P.f_bytes(5, body)
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    body = b"".join(P.f_bytes(1, i) for i in inputs)
+    body += b"".join(P.f_bytes(2, o) for o in outputs)
+    body += P.f_bytes(3, name) + P.f_bytes(4, op_type)
+    for k, v in attrs.items():
+        body += _attr(k, v)
+    return P.f_bytes(1, body)  # GraphProto.node
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    body = b"".join(P.f_varint(1, d) for d in arr.shape)
+    if arr.dtype == np.int64:
+        body += P.f_varint(2, TP_INT64)
+    else:
+        arr = arr.astype(np.float32)
+        body += P.f_varint(2, TP_FLOAT)
+    body += P.f_bytes(8, name)
+    body += P.f_bytes(9, arr.tobytes())  # raw_data
+    return body
+
+
+def _value_info(name, shape, elem_type=TP_FLOAT):
+    dims = b"".join(
+        P.f_bytes(1, P.f_varint(1, int(d))) for d in shape)
+    shape_proto = P.f_bytes(2, dims)
+    tensor_type = P.f_varint(1, elem_type) + shape_proto
+    type_proto = P.f_bytes(1, tensor_type)
+    return P.f_bytes(1, name) + P.f_bytes(2, type_proto)
+
+
+# ---------------------------------------------------------------------------
+# per-op converters: (node, ins, out, ctx) -> [node bytes]
+# ctx: dict with "initializers" (list), "name_of" (node->tensor name)
+# ---------------------------------------------------------------------------
+
+
+def _ints(v, n=None):
+    if isinstance(v, str):
+        import ast
+
+        v = ast.literal_eval(v)  # attrs may arrive stringified
+    if isinstance(v, (int, np.integer)):
+        v = (int(v),) * (n or 1)
+    return [int(x) for x in v]
+
+
+def _conv(node, ins, out, ctx):
+    a = node.attrs
+    kernel = _ints(a.get("kernel", ()))
+    stride = _ints(a.get("stride", 1), len(kernel))
+    pad = _ints(a.get("pad", 0), len(kernel))
+    dilate = _ints(a.get("dilate", 1), len(kernel))
+    attrs = dict(kernel_shape=kernel, strides=stride,
+                 pads=pad + pad, dilations=dilate,
+                 group=int(a.get("num_group", 1)))
+    return [_node("Conv", ins, [out], node.name, **attrs)]
+
+
+def _fc(node, ins, out, ctx):
+    # reference exporter: Flatten + Gemm(transB=1)
+    flat = node.name + "_flat"
+    nodes = [_node("Flatten", [ins[0]], [flat], node.name + "_flatten",
+                   axis=1)]
+    gemm_in = [flat] + ins[1:]
+    if str(node.attrs.get("no_bias", False)).lower() in ("true", "1"):
+        # Gemm requires C; synthesize a zero bias
+        num_hidden = int(node.attrs.get("num_hidden"))
+        zname = node.name + "_zero_bias"
+        ctx["initializers"].append(
+            _tensor(zname, np.zeros(num_hidden, np.float32)))
+        gemm_in = [flat, ins[1], zname]
+    nodes.append(_node("Gemm", gemm_in, [out], node.name,
+                       alpha=1.0, beta=1.0, transB=1))
+    return nodes
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(node, ins, out, ctx):
+    return [_node(_ACT[str(node.attrs.get("act_type", "relu"))],
+                  [ins[0]], [out], node.name)]
+
+
+def _pooling(node, ins, out, ctx):
+    a = node.attrs
+    ptype = str(a.get("pool_type", "max"))
+    glob = str(a.get("global_pool", False)).lower() in ("true", "1")
+    if glob:
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [_node(op, [ins[0]], [out], node.name)]
+    kernel = _ints(a.get("kernel", ()))
+    stride = _ints(a.get("stride", 1), len(kernel))
+    pad = _ints(a.get("pad", 0), len(kernel))
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    attrs = dict(kernel_shape=kernel, strides=stride, pads=pad + pad)
+    if op == "AveragePool":
+        attrs["count_include_pad"] = int(
+            str(a.get("count_include_pad", True)).lower() in ("true", "1"))
+    return [_node(op, [ins[0]], [out], node.name, **attrs)]
+
+
+def _batchnorm(node, ins, out, ctx):
+    eps = float(node.attrs.get("eps", 1e-3))
+    mom = float(node.attrs.get("momentum", 0.9))
+    ins = list(ins)
+    # reference default fix_gamma=True pins scale to ones; ONNX has no
+    # such switch, so emit a literal ones scale initializer
+    if str(node.attrs.get("fix_gamma", True)).lower() in ("true", "1"):
+        gamma_shape = ctx["param_shapes"].get(ins[1])
+        if gamma_shape is not None:
+            oname = node.name + "_fixed_gamma"
+            ctx["initializers"].append(
+                _tensor(oname, np.ones(gamma_shape, np.float32)))
+            ins[1] = oname
+    return [_node("BatchNormalization", ins, [out], node.name,
+                  epsilon=eps, momentum=mom)]
+
+
+def _softmax_output(node, ins, out, ctx):
+    # serving graph: drop the label input, emit Softmax over axis -1
+    return [_node("Softmax", [ins[0]], [out], node.name, axis=-1)]
+
+
+def _flatten(node, ins, out, ctx):
+    return [_node("Flatten", [ins[0]], [out], node.name, axis=1)]
+
+
+def _concat(node, ins, out, ctx):
+    axis = int(node.attrs.get("dim", node.attrs.get("axis", 1)))
+    return [_node("Concat", ins, [out], node.name, axis=axis)]
+
+
+def _dropout(node, ins, out, ctx):
+    return [_node("Dropout", [ins[0]], [out], node.name)]
+
+
+def _leaky(node, ins, out, ctx):
+    slope = float(node.attrs.get("slope", 0.25))
+    return [_node("LeakyRelu", [ins[0]], [out], node.name, alpha=slope)]
+
+
+def _reshape(node, ins, out, ctx):
+    shape = _ints(node.attrs.get("shape", ()))
+    sname = node.name + "_shape"
+    ctx["initializers"].append(
+        _tensor(sname, np.asarray(shape, np.int64)))
+    return [_node("Reshape", [ins[0], sname], [out], node.name)]
+
+
+def _binop(onnx_op):
+    def conv(node, ins, out, ctx):
+        return [_node(onnx_op, ins, [out], node.name)]
+    return conv
+
+
+CONVERTERS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "BatchNorm": _batchnorm,
+    "SoftmaxOutput": _softmax_output,
+    "softmax": lambda n, i, o, c: [_node("Softmax", [i[0]], [o], n.name,
+                                         axis=int(n.attrs.get("axis",
+                                                              -1)))],
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "LeakyReLU": _leaky,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "elemwise_add": _binop("Add"),
+    "broadcast_add": _binop("Add"),
+    "elemwise_sub": _binop("Sub"),
+    "broadcast_sub": _binop("Sub"),
+    "elemwise_mul": _binop("Mul"),
+    "broadcast_mul": _binop("Mul"),
+    "elemwise_div": _binop("Div"),
+    "broadcast_div": _binop("Div"),
+    "relu": lambda n, i, o, c: [_node("Relu", [i[0]], [o], n.name)],
+    "sigmoid": lambda n, i, o, c: [_node("Sigmoid", [i[0]], [o], n.name)],
+    "tanh": lambda n, i, o, c: [_node("Tanh", [i[0]], [o], n.name)],
+}
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export Symbol + params to an ONNX file (reference
+    mx2onnx.export_model signature).  ``params`` maps arg/aux name ->
+    NDArray (``arg:``/``aux:`` prefixes accepted); ``input_shape`` is a
+    list of shapes for the data inputs in argument order."""
+    from ...ndarray import NDArray
+
+    clean = {}
+    for k, v in params.items():
+        name = k.split(":", 1)[1] if ":" in k else k
+        clean[name] = v.asnumpy() if isinstance(v, NDArray) else \
+            np.asarray(v)
+
+    topo = sym._topo()
+    ctx = {"initializers": [],
+           "param_shapes": {k: v.shape for k, v in clean.items()}}
+    nodes_bytes = []
+    data_inputs = []
+    shapes = list(input_shape)
+
+    name_of = {}
+    for node in topo:
+        if node.is_var:
+            name_of[id(node)] = node.name
+            if node.name not in clean and "label" not in node.name:
+                data_inputs.append(node.name)
+        else:
+            name_of[id(node)] = node.name + "_out"
+
+    graph = b""
+    for node in topo:
+        if node.is_var:
+            continue
+        op_name = node.op.name
+        conv = CONVERTERS.get(op_name)
+        if conv is None:
+            raise NotImplementedError(
+                "no ONNX converter for operator %r" % op_name)
+        ins = [name_of[id(src)] for src, _ in node.inputs
+               if not (src.is_var and "label" in src.name)]
+        nodes_bytes.extend(conv(node, ins, name_of[id(node)], ctx))
+
+    graph += b"".join(nodes_bytes)
+    graph += P.f_bytes(2, "mxnet_tpu")
+    for name, arr in clean.items():
+        graph += P.f_bytes(5, _tensor(name, arr))  # initializer
+    for init_bytes in ctx["initializers"]:
+        graph += P.f_bytes(5, init_bytes)
+    for name, shp in zip(data_inputs, shapes):
+        graph += P.f_bytes(11, _value_info(name, shp))
+    feed = {n: tuple(s) for n, s in zip(data_inputs, shapes)}
+    feed.update({n: a.shape for n, a in clean.items()})
+    _, out_shapes, _ = sym.infer_shape_partial(**feed)
+    out_node, _ = sym._outputs[0]
+    graph += P.f_bytes(12, _value_info(
+        name_of[id(out_node)],
+        out_shapes[0] if out_shapes and out_shapes[0] else ()))
+
+    model = P.f_varint(1, 8)                     # ir_version
+    model += P.f_bytes(2, "mxnet_tpu")           # producer_name
+    model += P.f_bytes(7, graph)                 # graph
+    opset = P.f_bytes(1, "") + P.f_varint(2, 13)
+    model += P.f_bytes(8, opset)                 # opset_import
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

@@ -1,0 +1,251 @@
+"""ONNX ModelProto bytes -> Symbol + params.
+
+Reference: ``python/mxnet/contrib/onnx/onnx2mx/import_model.py`` (+ its
+``_import_helper`` op table).  Returns ``(sym, arg_params, aux_params)``
+with the reference's signature; parsing is the wire codec in
+``_proto.py`` (no onnx package in this image).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+TP_FLOAT, TP_INT64 = 1, 7
+
+
+def _parse_tensor(buf):
+    f = P.decode(buf)
+    dims = [P.signed(v) for v in f.get(1, [])]
+    dtype = f.get(2, [TP_FLOAT])[0]
+    name = P.to_str(f.get(8, [b""])[0])
+    if 9 in f:  # raw_data
+        raw = f[9][0]
+        np_dt = np.float32 if dtype == TP_FLOAT else np.int64
+        arr = np.frombuffer(raw, np_dt).reshape(dims)
+    elif 4 in f:  # float_data (packed or repeated)
+        vals = []
+        for v in f[4]:
+            if isinstance(v, bytes):
+                vals.extend(P.decode_packed_floats(v))
+            else:
+                vals.append(v)
+        arr = np.asarray(vals, np.float32).reshape(dims)
+    elif 7 in f:  # int64_data
+        vals = []
+        for v in f[7]:
+            if isinstance(v, bytes):
+                vals.extend(P.decode_packed_varints(v))
+            else:
+                vals.append(v)
+        arr = np.asarray([P.signed(x) for x in vals], np.int64) \
+            .reshape(dims)
+    else:
+        arr = np.zeros(dims, np.float32)
+    return name, arr
+
+
+def _parse_attr(buf):
+    f = P.decode(buf)
+    name = P.to_str(f[1][0])
+    atype = f.get(20, [0])[0]
+    if atype == 1:                      # FLOAT
+        return name, f[2][0]
+    if atype == 2:                      # INT
+        return name, P.signed(f[3][0])
+    if atype == 3:                      # STRING
+        return name, P.to_str(f[4][0])
+    if atype == 4:                      # TENSOR
+        return name, _parse_tensor(f[5][0])[1]
+    if atype == 6:                      # FLOATS
+        return name, [v for v in f.get(7, [])]
+    if atype == 7:                      # INTS
+        vals = []
+        for v in f.get(8, []):
+            if isinstance(v, bytes):
+                vals.extend(P.signed(x) for x in
+                            P.decode_packed_varints(v))
+            else:
+                vals.append(P.signed(v))
+        return name, vals
+    return name, None
+
+
+def _parse_node(buf):
+    f = P.decode(buf)
+    return {
+        "inputs": [P.to_str(b) for b in f.get(1, [])],
+        "outputs": [P.to_str(b) for b in f.get(2, [])],
+        "name": P.to_str(f.get(3, [b""])[0]),
+        "op_type": P.to_str(f[4][0]),
+        "attrs": dict(_parse_attr(b) for b in f.get(5, [])),
+    }
+
+
+def _parse_value_info(buf):
+    f = P.decode(buf)
+    name = P.to_str(f[1][0])
+    shape = []
+    if 2 in f:
+        tp = P.decode(f[2][0])
+        if 1 in tp:  # tensor_type
+            tt = P.decode(tp[1][0])
+            if 2 in tt:
+                sh = P.decode(tt[2][0])
+                for dim_buf in sh.get(1, []):
+                    d = P.decode(dim_buf)
+                    shape.append(P.signed(d.get(1, [0])[0]))
+    return name, tuple(shape)
+
+
+def _parse_graph(buf):
+    f = P.decode(buf)
+    return {
+        "nodes": [_parse_node(b) for b in f.get(1, [])],
+        "initializers": dict(_parse_tensor(b) for b in f.get(5, [])),
+        "inputs": [_parse_value_info(b) for b in f.get(11, [])],
+        "outputs": [_parse_value_info(b) for b in f.get(12, [])],
+    }
+
+
+def parse_model(data):
+    f = P.decode(data)
+    return _parse_graph(f[7][0])
+
+
+# ---------------------------------------------------------------------------
+# op table: ONNX -> mx.sym
+# ---------------------------------------------------------------------------
+
+
+def _pads(attrs, default=0):
+    p = attrs.get("pads")
+    if not p:
+        return None
+    half = len(p) // 2
+    if list(p[:half]) != list(p[half:]):
+        raise NotImplementedError("asymmetric pads %r" % (p,))
+    return tuple(p[:half])
+
+
+def import_model(model_file):
+    """(sym, arg_params, aux_params) — reference import_model."""
+    import mxnet_tpu as mx
+
+    with open(model_file, "rb") as fh:
+        graph = parse_model(fh.read())
+
+    inits = graph["initializers"]
+    env = {}
+    arg_params, aux_params = {}, {}
+
+    def get(name):
+        if name in env:
+            return env[name]
+        if name in inits:
+            v = mx.sym.Variable(name)
+            env[name] = v
+            arg_params[name] = mx.nd.array(inits[name])
+            return v
+        v = mx.sym.Variable(name)
+        env[name] = v
+        return v
+
+    for node in graph["nodes"]:
+        op, a = node["op_type"], node["attrs"]
+        ins = node["inputs"]
+        name = node["name"] or node["outputs"][0]
+        if op == "Conv":
+            kernel = tuple(a["kernel_shape"])
+            kw = dict(kernel=kernel,
+                      num_filter=int(inits[ins[1]].shape[0]),
+                      num_group=int(a.get("group", 1)),
+                      stride=tuple(a.get("strides",
+                                         (1,) * len(kernel))),
+                      dilate=tuple(a.get("dilations",
+                                         (1,) * len(kernel))),
+                      no_bias=len(ins) < 3, name=name)
+            pads = _pads(a)
+            if pads:
+                kw["pad"] = pads
+            out = mx.sym.Convolution(*[get(i) for i in ins], **kw)
+        elif op == "Gemm":
+            if (a.get("transB", 0) != 1 or a.get("alpha", 1.0) != 1.0
+                    or a.get("transA", 0) != 0
+                    or a.get("beta", 1.0) != 1.0):
+                raise NotImplementedError("general Gemm")
+            w = inits[ins[1]]
+            out = mx.sym.FullyConnected(get(ins[0]), get(ins[1]),
+                                        *( [get(ins[2])]
+                                           if len(ins) > 2 else []),
+                                        num_hidden=int(w.shape[0]),
+                                        no_bias=len(ins) < 3, name=name)
+        elif op == "MatMul":
+            out = mx.sym.dot(get(ins[0]), get(ins[1]), name=name)
+        elif op == "BatchNormalization":
+            x, scale, bias, mean, var = (get(i) for i in ins)
+            aux_params[ins[3]] = mx.nd.array(inits.pop(ins[3]))
+            aux_params[ins[4]] = mx.nd.array(inits.pop(ins[4]))
+            arg_params.pop(ins[3], None)
+            arg_params.pop(ins[4], None)
+            out = mx.sym.BatchNorm(x, scale, bias, mean, var,
+                                   eps=float(a.get("epsilon", 1e-5)),
+                                   momentum=float(a.get("momentum",
+                                                        0.9)),
+                                   fix_gamma=False, name=name)
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softplus": "softrelu",
+                   "Softsign": "softsign"}[op]
+            out = mx.sym.Activation(get(ins[0]), act_type=act, name=name)
+        elif op == "LeakyRelu":
+            out = mx.sym.LeakyReLU(get(ins[0]),
+                                   slope=float(a.get("alpha", 0.01)),
+                                   name=name)
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = tuple(a["kernel_shape"])
+            kw = dict(kernel=kernel, pool_type="max"
+                      if op == "MaxPool" else "avg",
+                      stride=tuple(a.get("strides",
+                                         (1,) * len(kernel))),
+                      name=name)
+            pads = _pads(a)
+            if pads:
+                kw["pad"] = pads
+            if op == "AveragePool":
+                # ONNX spec default: exclude padding from the mean
+                kw["count_include_pad"] = bool(
+                    a.get("count_include_pad", 0))
+            out = mx.sym.Pooling(get(ins[0]), **kw)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = mx.sym.Pooling(get(ins[0]), global_pool=True,
+                                 kernel=(1, 1),
+                                 pool_type="max"
+                                 if op == "GlobalMaxPool" else "avg",
+                                 name=name)
+        elif op == "Softmax":
+            out = mx.sym.softmax(get(ins[0]),
+                                 axis=int(a.get("axis", -1)), name=name)
+        elif op == "Flatten":
+            out = mx.sym.Flatten(get(ins[0]), name=name)
+        elif op == "Concat":
+            out = mx.sym.concat(*[get(i) for i in ins],
+                                dim=int(a.get("axis", 1)), name=name)
+        elif op == "Dropout":
+            out = mx.sym.Dropout(get(ins[0]), name=name)
+        elif op == "Reshape":
+            shape = tuple(int(x) for x in inits[ins[1]])
+            arg_params.pop(ins[1], None)
+            out = mx.sym.reshape(get(ins[0]), shape=shape, name=name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": mx.sym.broadcast_add,
+                  "Sub": mx.sym.broadcast_sub,
+                  "Mul": mx.sym.broadcast_mul,
+                  "Div": mx.sym.broadcast_div}[op]
+            out = fn(get(ins[0]), get(ins[1]), name=name)
+        else:
+            raise NotImplementedError("no importer for ONNX op %r" % op)
+        env[node["outputs"][0]] = out
+
+    sym = env[graph["outputs"][0][0]]
+    return sym, arg_params, aux_params

@@ -78,7 +78,12 @@ class SyncBatchNorm(BatchNorm):
 
 class PixelShuffle2D(HybridBlock):
     """Rearrange (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference
-    contrib PixelShuffle2D — the sub-pixel conv upsampler)."""
+    contrib PixelShuffle2D — the sub-pixel conv upsampler).
+
+    Channel order is the reference's CRD convention:
+    ``out[n, c, h*f1+i, w*f2+j] = in[n, c*f1*f2 + i*f2 + j, h, w]`` —
+    NOT ``depth_to_space``'s DCR order, which would scramble trained
+    sub-pixel-conv weights whenever the output has >1 channel."""
 
     def __init__(self, factor, **kwargs):
         super().__init__(**kwargs)
@@ -86,10 +91,11 @@ class PixelShuffle2D(HybridBlock):
             f1, f2 = factor
         except TypeError:
             f1 = f2 = int(factor)
-        if f1 != f2:
-            raise ValueError("depth_to_space requires square factors; "
-                             "got %r" % (factor,))
-        self._factor = int(f1)
+        self._factors = (int(f1), int(f2))
 
     def hybrid_forward(self, F, x):
-        return F.depth_to_space(x, block_size=self._factor)
+        f1, f2 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(0, 0, -3, -3))

@@ -316,20 +316,43 @@ def _syrk(a, transpose=False, alpha=1.0):
 # ---------------------------------------------------------------------------
 @register("reshape", aliases=("Reshape",))
 def _reshape(x, shape=None, reverse=False):
-    # supports the reference's special codes 0 (keep) and -1 (infer)
-    shape = list(shape)
+    """Reference special-code grammar (src/operator/tensor/matrix_op.cc):
+    0 keep dim, -1 infer, -2 copy all remaining dims, -3 merge the next
+    two input dims, -4 split one input dim into the following two spec
+    values (one of which may be -1)."""
+    spec = list(shape)
     in_shape = list(x.shape)
     out = []
-    for i, s in enumerate(shape):
+    i = 0  # input-dim cursor
+    j = 0
+    while j < len(spec):
+        s = spec[j]
         if s == 0:
             out.append(in_shape[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
         elif s == -2:
             out.extend(in_shape[i:])
+            i = len(in_shape)
         elif s == -3:
             out.append(in_shape[i] * in_shape[i + 1])
-            in_shape = in_shape[:i] + [in_shape[i] * in_shape[i + 1]] + in_shape[i + 2:]
+            i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            dim = in_shape[i]
+            if d1 == -1:
+                d1 = dim // d2
+            if d2 == -1:
+                d2 = dim // d1
+            out.extend([d1, d2])
+            i += 1
+            j += 2
         else:
             out.append(s)
+            i += 1
+        j += 1
     return jnp.reshape(x, tuple(out))
 
 

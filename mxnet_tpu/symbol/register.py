@@ -12,10 +12,15 @@ import sys
 from ..ops.registry import OPS
 from .symbol import Symbol, Variable, _NameManager, _Node, _single
 
-# trailing inputs that are optional given a static param setting
+# trailing inputs that are optional given a static param setting;
+# predicates see (op_name, params)
 _SKIP_INPUT = {
-    ("bias", "no_bias"): lambda p: bool(p.get("no_bias")),
-    ("state_cell", "mode"): lambda p: p.get("mode", "lstm") != "lstm",
+    ("bias", "no_bias"): lambda op, p: bool(p.get("no_bias")),
+    ("state_cell", "mode"): lambda op, p: p.get("mode", "lstm") != "lstm",
+    # LeakyReLU's gamma is a learnable input only in prelu mode
+    # (reference leaky_relu.cc: ListArguments gated on act_type)
+    ("gamma", "act_type"): lambda op, p: (
+        op == "LeakyReLU" and p.get("act_type", "leaky") != "prelu"),
 }
 
 
@@ -46,7 +51,7 @@ def _make_wrapper(opdef):
             bound.update(sym_kwargs)
             inputs = []
             for i, in_name in enumerate(input_names):
-                skip = any(in_name == k[0] and fn(params)
+                skip = any(in_name == k[0] and fn(opdef.name, params)
                            for k, fn in _SKIP_INPUT.items())
                 if skip:
                     continue

@@ -480,10 +480,19 @@ def test_contrib_pixelshuffle2d():
     from mxnet_tpu.gluon.contrib import nn as cnn
 
     ps = cnn.PixelShuffle2D(2)
-    x = mx.nd.array(np.random.RandomState(0).rand(1, 8, 3, 3))
-    out = ps(x)
+    xn = np.random.RandomState(0).rand(1, 8, 3, 3).astype(np.float32)
+    out = ps(mx.nd.array(xn)).asnumpy()
     assert out.shape == (1, 2, 6, 6)
-    # matches the depth_to_space op directly
-    np.testing.assert_allclose(
-        out.asnumpy(),
-        mx.nd.depth_to_space(x, block_size=2).asnumpy())
+    # reference CRD semantics:
+    # out[n,c,h*f+i,w*f+j] = in[n, c*f*f + i*f + j, h, w]
+    f = 2
+    want = np.zeros((1, 2, 6, 6), np.float32)
+    for c in range(2):
+        for i in range(f):
+            for j in range(f):
+                want[0, c, i::f, j::f] = xn[0, c * f * f + i * f + j]
+    np.testing.assert_allclose(out, want)
+    # rectangular factors
+    ps2 = cnn.PixelShuffle2D((1, 2))
+    x2 = mx.nd.array(np.random.RandomState(1).rand(1, 4, 3, 3))
+    assert ps2(x2).shape == (1, 2, 3, 6)
