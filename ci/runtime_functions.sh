@@ -58,6 +58,14 @@ multichip_dryrun() {
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 }
 
+unittest_core_tpu() {
+    # rerun the operator corpus on the real chip (reference parity:
+    # tests/python/gpu/test_operator_gpu.py reruns the unittest corpus
+    # with default ctx = gpu); needs TPU hardware attached
+    MXTPU_TEST_ON_TPU=1 python -m pytest tests/test_operator.py \
+        tests/test_operator_extra.py tests/test_ndarray.py -q
+}
+
 all() {
     build_native
     sanity_check

@@ -1,0 +1,46 @@
+"""Shared protobuf wire-format primitives.
+
+Single source for the hand-rolled encoders used by the dependency-free
+TensorBoard event writer (``contrib/tensorboard.py``) and the ONNX
+converters (``contrib/onnx/_proto.py``) — this image ships neither the
+protobuf nor the onnx package (zero-egress), so both serialize the wire
+format directly.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["varint", "tag", "f_varint", "f_bytes", "f_float", "f_double"]
+
+
+def varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def tag(field, wire):
+    return varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    return tag(field, 0) + varint(int(value))
+
+
+def f_bytes(field, payload):
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def f_float(field, value):
+    return tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_double(field, value):
+    return tag(field, 1) + struct.pack("<d", float(value))

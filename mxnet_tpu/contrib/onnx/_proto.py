@@ -12,48 +12,9 @@ from __future__ import annotations
 
 import struct
 
-# ---------------------------------------------------------------------------
-# wire primitives
-# ---------------------------------------------------------------------------
-
-
-def varint(n):
-    out = bytearray()
-    n &= (1 << 64) - 1
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | (0x80 if n else 0))
-        if not n:
-            return bytes(out)
-
-
-def tag(field, wire):
-    return varint((field << 3) | wire)
-
-
-def f_varint(field, value):
-    return tag(field, 0) + varint(int(value))
-
-
-def f_bytes(field, payload):
-    if isinstance(payload, str):
-        payload = payload.encode("utf-8")
-    return tag(field, 2) + varint(len(payload)) + payload
-
-
-def f_float(field, value):
-    return tag(field, 5) + struct.pack("<f", float(value))
-
-
-def f_packed_floats(field, values):
-    payload = b"".join(struct.pack("<f", float(v)) for v in values)
-    return f_bytes(field, payload)
-
-
-def f_packed_varints(field, values):
-    payload = b"".join(varint(int(v)) for v in values)
-    return f_bytes(field, payload)
+# wire primitives shared with the TensorBoard writer
+from .._protowire import (varint, tag, f_varint, f_bytes,  # noqa: F401
+                          f_float)
 
 
 # ---------------------------------------------------------------------------

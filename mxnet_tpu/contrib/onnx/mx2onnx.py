@@ -132,6 +132,10 @@ def _activation(node, ins, out, ctx):
 def _pooling(node, ins, out, ctx):
     a = node.attrs
     ptype = str(a.get("pool_type", "max"))
+    if ptype not in ("max", "avg"):
+        raise NotImplementedError(
+            "ONNX export of pool_type=%r (sum/lp have no ONNX mapping)"
+            % ptype)
     glob = str(a.get("global_pool", False)).lower() in ("true", "1")
     if glob:
         op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
@@ -183,12 +187,25 @@ def _dropout(node, ins, out, ctx):
 
 
 def _leaky(node, ins, out, ctx):
+    act = str(node.attrs.get("act_type", "leaky"))
     slope = float(node.attrs.get("slope", 0.25))
-    return [_node("LeakyRelu", [ins[0]], [out], node.name, alpha=slope)]
+    if act == "leaky":
+        return [_node("LeakyRelu", [ins[0]], [out], node.name,
+                      alpha=slope)]
+    if act == "elu":
+        return [_node("Elu", [ins[0]], [out], node.name, alpha=slope)]
+    if act == "prelu":
+        return [_node("PRelu", ins, [out], node.name)]
+    raise NotImplementedError("ONNX export of LeakyReLU act_type=%r"
+                              % act)
 
 
 def _reshape(node, ins, out, ctx):
     shape = _ints(node.attrs.get("shape", ()))
+    if any(s < -1 for s in shape):
+        # -2/-3/-4 are MXNet-only grammar; ONNX Reshape knows 0 and -1
+        raise NotImplementedError(
+            "ONNX export of reshape special codes %r" % (shape,))
     sname = node.name + "_shape"
     ctx["initializers"].append(
         _tensor(sname, np.asarray(shape, np.int64)))

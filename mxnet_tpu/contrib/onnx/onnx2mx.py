@@ -16,7 +16,13 @@ TP_FLOAT, TP_INT64 = 1, 7
 
 def _parse_tensor(buf):
     f = P.decode(buf)
-    dims = [P.signed(v) for v in f.get(1, [])]
+    dims = []
+    for v in f.get(1, []):
+        # standard encoders pack repeated int64 dims (proto3 default)
+        if isinstance(v, bytes):
+            dims.extend(P.signed(x) for x in P.decode_packed_varints(v))
+        else:
+            dims.append(P.signed(v))
     dtype = f.get(2, [TP_FLOAT])[0]
     name = P.to_str(f.get(8, [b""])[0])
     if 9 in f:  # raw_data
@@ -58,7 +64,13 @@ def _parse_attr(buf):
     if atype == 4:                      # TENSOR
         return name, _parse_tensor(f[5][0])[1]
     if atype == 6:                      # FLOATS
-        return name, [v for v in f.get(7, [])]
+        vals = []
+        for v in f.get(7, []):
+            if isinstance(v, bytes):    # packed encoding
+                vals.extend(P.decode_packed_floats(v))
+            else:
+                vals.append(v)
+        return name, vals
     if atype == 7:                      # INTS
         vals = []
         for v in f.get(8, []):
@@ -202,6 +214,13 @@ def import_model(model_file):
             out = mx.sym.LeakyReLU(get(ins[0]),
                                    slope=float(a.get("alpha", 0.01)),
                                    name=name)
+        elif op == "Elu":
+            out = mx.sym.LeakyReLU(get(ins[0]), act_type="elu",
+                                   slope=float(a.get("alpha", 1.0)),
+                                   name=name)
+        elif op == "PRelu":
+            out = mx.sym.LeakyReLU(get(ins[0]), get(ins[1]),
+                                   act_type="prelu", name=name)
         elif op in ("MaxPool", "AveragePool"):
             kernel = tuple(a["kernel_shape"])
             kw = dict(kernel=kernel, pool_type="max"

@@ -17,43 +17,25 @@ import time
 __all__ = ["SummaryWriter", "LogMetricsCallback"]
 
 # ---------------------------------------------------------------------------
-# minimal protobuf wire encoding (varint + tagged fields) for:
+# protobuf encoding (shared wire primitives in contrib/_protowire) for:
 #   Event { double wall_time=1; int64 step=2; Summary summary=5; }
 #   Summary { repeated Value value=1; }  Value { string tag=1; float simple_value=2; }
 # ---------------------------------------------------------------------------
-
-
-def _varint(n):
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | (0x80 if n else 0))
-        if not n:
-            return bytes(out)
-
-
-def _tag(field, wire):
-    return _varint((field << 3) | wire)
-
-
-def _len_delim(field, payload):
-    return _tag(field, 2) + _varint(len(payload)) + payload
+from ._protowire import f_bytes, f_double, f_float, f_varint  # noqa: E402
 
 
 def _scalar_summary(tag, value):
-    val = (_len_delim(1, tag.encode("utf-8")) +
-           _tag(2, 5) + struct.pack("<f", float(value)))
-    return _len_delim(1, val)
+    val = f_bytes(1, tag) + f_float(2, value)
+    return f_bytes(1, val)
 
 
 def _event(wall_time, step, summary=None, file_version=None):
-    out = _tag(1, 1) + struct.pack("<d", wall_time)
-    out += _tag(2, 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)
+    out = f_double(1, wall_time)
+    out += f_varint(2, step)
     if file_version is not None:
-        out += _len_delim(3, file_version.encode("utf-8"))
+        out += f_bytes(3, file_version)
     if summary is not None:
-        out += _len_delim(5, summary)
+        out += f_bytes(5, summary)
     return out
 
 

@@ -6,6 +6,7 @@ graph) exercises both the encoder and decoder; prediction equality is
 the correctness bar, plus a structural check of the emitted protobuf.
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.contrib.onnx import export_model, import_model
@@ -135,3 +136,54 @@ def test_elementwise_and_reshape_roundtrip(tmp_path):
     np.testing.assert_allclose(exe.outputs[0].asnumpy(),
                                (X * X).reshape(-1, 6) + bias,
                                rtol=1e-6)
+
+
+def test_packed_wire_interop():
+    """Standard protobuf encoders PACK repeated numeric fields; the
+    decoder must accept both dialects (our exporter emits unpacked)."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+    from mxnet_tpu.contrib.onnx.onnx2mx import (_parse_attr,
+                                                _parse_tensor)
+    import struct
+
+    # TensorProto with PACKED dims [2, 3] + raw float data
+    raw = np.arange(6, dtype=np.float32).tobytes()
+    t = (P.f_bytes(1, P.varint(2) + P.varint(3))  # packed dims
+         + P.f_varint(2, 1)                       # FLOAT
+         + P.f_bytes(8, "w") + P.f_bytes(9, raw))
+    name, arr = _parse_tensor(t)
+    assert name == "w" and arr.shape == (2, 3)
+    np.testing.assert_allclose(arr.ravel(), np.arange(6))
+
+    # AttributeProto INTS, packed
+    a = (P.f_bytes(1, "kernel_shape")
+         + P.f_bytes(8, P.varint(3) + P.varint(3))
+         + P.f_varint(20, 7))
+    aname, vals = _parse_attr(a)
+    assert aname == "kernel_shape" and vals == [3, 3]
+
+    # AttributeProto FLOATS, packed
+    fl = struct.pack("<2f", 1.5, -2.5)
+    a = P.f_bytes(1, "scales") + P.f_bytes(7, fl) + P.f_varint(20, 6)
+    aname, vals = _parse_attr(a)
+    assert vals == [1.5, -2.5]
+
+
+def test_export_rejects_unsupported():
+    data = mx.sym.Variable("data")
+    X = np.zeros((2, 3, 8, 8), np.float32)
+    sum_pool = mx.sym.Pooling(data, kernel=(2, 2), pool_type="sum")
+    with pytest.raises(NotImplementedError):
+        export_model(sum_pool, {}, [X.shape],
+                     onnx_file_path="/tmp/never.onnx")
+    elu = mx.sym.LeakyReLU(data, act_type="elu", slope=0.5, name="elu")
+    path = "/tmp/elu_ok.onnx"
+    export_model(elu, {}, [X.shape], onnx_file_path=path)
+    sym2, arg2, aux2 = import_model(path)
+    exe = sym2.simple_bind(ctx=mx.cpu(), grad_req="null", data=X.shape)
+    Xr = np.random.RandomState(0).randn(*X.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = Xr
+    exe.forward(is_train=False)
+    want = np.where(Xr >= 0, Xr, 0.5 * np.expm1(Xr))
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), want,
+                               rtol=1e-5, atol=1e-6)
