@@ -63,7 +63,13 @@ def main():
     ap.add_argument("--mnist-dir", default=None,
                     help="directory with MNIST idx files")
     ap.add_argument("--save", default=None, help="save params path")
+    ap.add_argument("--seed", type=int, default=7)
     args = ap.parse_args()
+    # DataLoader shuffling + init draw from the global RNGs
+    np.random.seed(args.seed)
+    import mxnet_tpu as _mx
+
+    _mx.random.seed(args.seed)
 
     ctx = mx.cpu() if args.ctx == "cpu" else mx.tpu()
     if args.mnist_dir:

@@ -195,7 +195,13 @@ def _leaky(node, ins, out, ctx):
     if act == "elu":
         return [_node("Elu", [ins[0]], [out], node.name, alpha=slope)]
     if act == "prelu":
-        return [_node("PRelu", ins, [out], node.name)]
+        # ONNX PRelu broadcasts the slope against TRAILING dims, MXNet
+        # per-channel on axis 1; without shape propagation here the 1-D
+        # gamma cannot be re-laid-out correctly for ndim>2 inputs
+        raise NotImplementedError(
+            "ONNX export of prelu: slope axis conventions differ "
+            "(ONNX trailing-broadcast vs per-channel); reshape gamma "
+            "and use a custom converter")
     raise NotImplementedError("ONNX export of LeakyReLU act_type=%r"
                               % act)
 
@@ -206,6 +212,9 @@ def _reshape(node, ins, out, ctx):
         # -2/-3/-4 are MXNet-only grammar; ONNX Reshape knows 0 and -1
         raise NotImplementedError(
             "ONNX export of reshape special codes %r" % (shape,))
+    if str(node.attrs.get("reverse", False)).lower() in ("true", "1"):
+        # right-to-left matching has no ONNX equivalent
+        raise NotImplementedError("ONNX export of reshape reverse=True")
     sname = node.name + "_shape"
     ctx["initializers"].append(
         _tensor(sname, np.asarray(shape, np.int64)))

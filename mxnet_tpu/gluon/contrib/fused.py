@@ -96,15 +96,37 @@ class _OptimTap(_registry.invoke_tap):
 
 class FusedTrainStep:
     """Compile (forward + loss + backward + optimizer update) into one XLA
-    module with donated buffers.  Single-context training only (data-parallel
-    multi-device goes through KVStore/Trainer or pjit shardings)."""
+    module with donated buffers.
 
-    def __init__(self, net, loss_fn, trainer):
+    ``devices=[ctx, ...]`` turns the same module data-parallel the
+    SPMD way (the gluon counterpart of Module's context-list dp): the
+    batch is sharded over a ("dp",) mesh, params/optimizer state are
+    replicated, and the partitioner inserts the gradient all-reduce the
+    reference's Trainer routed through kvstore push/pull
+    (``gluon/trainer.py:353`` _allreduce_grads).  Parameters then LIVE
+    replicated across steps (no per-step broadcast); call :meth:`sync`
+    before single-device eager evaluation."""
+
+    def __init__(self, net, loss_fn, trainer, devices=None):
         for p in trainer._params:
             if p._data is not None and len(p.list_data()) > 1:
                 raise ValueError("FusedTrainStep supports single-context "
-                                 "training; use Trainer.step for "
-                                 "multi-device.")
+                                 "parameters; pass devices= for "
+                                 "data-parallel training.")
+        self._dp = None
+        self._primary_dev = None
+        # a 1-element list still goes through the mesh path so the
+        # caller's explicit placement is honored (not silently dropped)
+        if devices is not None and len(devices) >= 1:
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec)
+
+            devs = [d.jax_device() if hasattr(d, "jax_device") else d
+                    for d in devices]
+            mesh = Mesh(np.array(devs), ("dp",))
+            self._dp = (NamedSharding(mesh, PartitionSpec("dp")),
+                        NamedSharding(mesh, PartitionSpec()))
+            self._primary_dev = devs[0]
         self._net = net
         self._loss_fn = loss_fn
         self._trainer = trainer
@@ -249,9 +271,18 @@ class FusedTrainStep:
         adatas = tuple(a.list_data()[0].data for a in self._auxs)
         state_nds, _ = self._flat_states()
         sdatas = tuple(s.data for s in state_nds)
+        xd, yd = x.data, y.data
+        if self._dp is not None:
+            shard, repl = self._dp
+            xd = jax.device_put(xd, shard)
+            yd = jax.device_put(yd, shard)
+            # no-ops after the first step: params/state stay replicated
+            pdatas = tuple(jax.device_put(p, repl) for p in pdatas)
+            adatas = tuple(jax.device_put(a, repl) for a in adatas)
+            sdatas = tuple(jax.device_put(s, repl) for s in sdatas)
         rng = _random.next_key()
         lossvec, new_p, new_a, new_s = self._jitted(
-            rng, jnp.asarray(scalars), x.data, y.data, pdatas, adatas, sdatas)
+            rng, jnp.asarray(scalars), xd, yd, pdatas, adatas, sdatas)
         for p, d in zip(self._params, new_p):
             p.list_data()[0]._set_data(d)
         for a, d in zip(self._auxs, new_a):
@@ -259,3 +290,17 @@ class FusedTrainStep:
         for s, d in zip(state_nds, new_s):
             s._set_data(d)
         return _wrap(lossvec)
+
+    def sync(self):
+        """Devolve replicated parameters/aux/optimizer state to the
+        primary device (call before single-device eager evaluation or
+        when handing params to non-SPMD code).  No-op without
+        ``devices=``; replication makes this a local shard fetch."""
+        if self._dp is None:
+            return
+        arrays = [p.list_data()[0] for p in self._params]
+        arrays += [a.list_data()[0] for a in self._auxs]
+        state_nds, _ = self._flat_states()
+        arrays += list(state_nds)
+        for arr in arrays:
+            arr._set_data(jax.device_put(arr.data, self._primary_dev))

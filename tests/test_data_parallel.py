@@ -165,3 +165,65 @@ def test_dp_survives_reshape():
     new.arg_dict["data"][:] = np.zeros((16, 8), np.float32)
     new.forward(is_train=False)
     assert len(new.outputs[0].data.sharding.device_set) == 4
+
+
+# ---------------------------------------------------------------------------
+# Gluon dp: FusedTrainStep(devices=...)
+# ---------------------------------------------------------------------------
+def _gluon_train(devices, steps=8):
+    from mxnet_tpu import gluon, autograd  # noqa: F401
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = mx.nd.array(rng.randn(64, 10).astype(np.float32))
+    Y = mx.nd.array(rng.randint(0, 4, (64,)).astype(np.float32))
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    step = FusedTrainStep(net, loss_fn, trainer, devices=devices)
+    for _ in range(steps):
+        loss = step(X, Y)
+    step.sync()
+    # name counters differ between runs — return params positionally
+    return ([v.data().asnumpy()
+             for v in net.collect_params().values()],
+            float(loss.mean().asnumpy()))
+
+
+def test_gluon_fused_step_dp_matches_single():
+    _need_devices(4)
+    single, loss_s = _gluon_train(None)
+    multi, loss_m = _gluon_train([mx.cpu(i) for i in range(4)])
+    assert abs(loss_s - loss_m) < 1e-4
+    assert len(single) == len(multi)
+    for i, (m, s) in enumerate(zip(multi, single)):
+        np.testing.assert_allclose(m, s, rtol=1e-4, atol=1e-5,
+                                   err_msg="param %d" % i)
+
+
+def test_gluon_fused_step_dp_params_stay_replicated():
+    _need_devices(4)
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = FusedTrainStep(net, gluon.loss.L2Loss(), trainer,
+                          devices=[mx.cpu(i) for i in range(4)])
+    X = mx.nd.array(np.random.RandomState(0).randn(8, 5))
+    Y = mx.nd.array(np.random.RandomState(1).randn(8, 3))
+    step(X, Y)
+    w = net.collect_params()["dense0_weight" if "dense0_weight" in
+                             net.collect_params() else
+                             list(net.collect_params())[0]]
+    assert len(w.data().data.sharding.device_set) == 4
+    step.sync()
+    assert len(w.data().data.sharding.device_set) == 1

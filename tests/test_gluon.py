@@ -108,11 +108,13 @@ def test_hybrid_backward_matches_imperative():
         with autograd.record():
             y = net(x).sum()
         y.backward()
-        grads.append({k: v.grad(x.context).asnumpy()
+        # positional pairing: name counters depend on how many layers
+        # earlier tests created, and alphabetical sort misorders
+        # "dense10_*" vs "dense9_*" once the counter passes 10
+        grads.append([(k, v.grad(x.context).asnumpy())
                       for k, v in net.collect_params().items()
-                      if v.grad_req != "null"})
-    for (k1, g1), (k2, g2) in zip(sorted(grads[0].items()),
-                                  sorted(grads[1].items())):
+                      if v.grad_req != "null"])
+    for (k1, g1), (k2, g2) in zip(grads[0], grads[1]):
         np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-5,
                                    err_msg="%s vs %s" % (k1, k2))
 
