@@ -67,9 +67,7 @@ def main():
     args = ap.parse_args()
     # DataLoader shuffling + init draw from the global RNGs
     np.random.seed(args.seed)
-    import mxnet_tpu as _mx
-
-    _mx.random.seed(args.seed)
+    mx.random.seed(args.seed)
 
     ctx = mx.cpu() if args.ctx == "cpu" else mx.tpu()
     if args.mnist_dir:

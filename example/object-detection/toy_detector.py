@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Single-shot toy object detector (reference: ``example/ssd`` — the
+detection family of the acceptance suite, scaled to a synthetic task).
+
+A conv backbone predicts, per grid cell, an objectness score + box
+offsets (the SSD head shape); training uses smooth-L1 on boxes +
+sigmoid CE on objectness; inference decodes candidates and prunes them
+with the ``box_nms`` contrib op.  Synthetic scenes (bright rectangles
+on noise) keep it zero-egress; the smoke-test metric is mean IoU of the
+top detection against ground truth.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+S = 32      # image size
+G = 4       # grid cells per side (cell = 8 px)
+
+
+def synthetic_scene(rng, n):
+    """Images with ONE bright axis-aligned rectangle; boxes in corner
+    format (xmin, ymin, xmax, ymax), normalized to [0, 1]."""
+    imgs = rng.normal(0, 0.1, (n, 1, S, S)).astype(np.float32)
+    boxes = np.zeros((n, 4), np.float32)
+    for i in range(n):
+        w, h = rng.randint(8, 16, 2)
+        x0 = rng.randint(0, S - w)
+        y0 = rng.randint(0, S - h)
+        imgs[i, 0, y0:y0 + h, x0:x0 + w] += 1.0
+        boxes[i] = (x0 / S, y0 / S, (x0 + w) / S, (y0 + h) / S)
+    return imgs, boxes
+
+
+def targets_from_boxes(boxes):
+    """Assign each gt box to the grid cell containing its center;
+    offsets are (cx, cy) within the cell + (w, h) in image units."""
+    n = boxes.shape[0]
+    obj = np.zeros((n, G, G), np.float32)
+    off = np.zeros((n, 4, G, G), np.float32)
+    cx = (boxes[:, 0] + boxes[:, 2]) / 2
+    cy = (boxes[:, 1] + boxes[:, 3]) / 2
+    gx = np.minimum((cx * G).astype(int), G - 1)
+    gy = np.minimum((cy * G).astype(int), G - 1)
+    for i in range(n):
+        obj[i, gy[i], gx[i]] = 1
+        off[i, 0, gy[i], gx[i]] = cx[i] * G - gx[i]
+        off[i, 1, gy[i], gx[i]] = cy[i] * G - gy[i]
+        off[i, 2, gy[i], gx[i]] = boxes[i, 2] - boxes[i, 0]
+        off[i, 3, gy[i], gx[i]] = boxes[i, 3] - boxes[i, 1]
+    return obj, off
+
+
+def decode(scores, offs):
+    """(N,G,G) scores + (N,4,G,G) offsets -> (N, G*G, 5) candidates
+    [score, xmin, ymin, xmax, ymax] for box_nms."""
+    n = scores.shape[0]
+    gx, gy = np.meshgrid(np.arange(G), np.arange(G))
+    cx = (gx[None] + offs[:, 0]) / G
+    cy = (gy[None] + offs[:, 1]) / G
+    w = offs[:, 2]
+    h = offs[:, 3]
+    cand = np.stack([scores,
+                     cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    axis=1)          # (N, 5, G, G)
+    return cand.reshape(n, 5, -1).transpose(0, 2, 1)
+
+
+def iou(a, b):
+    x0 = np.maximum(a[0], b[0])
+    y0 = np.maximum(a[1], b[1])
+    x1 = np.minimum(a[2], b[2])
+    y1 = np.minimum(a[3], b[3])
+    inter = max(0.0, x1 - x0) * max(0.0, y1 - y0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+          (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--num-examples", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    imgs, boxes = synthetic_scene(rng, args.num_examples)
+    obj_t, off_t = targets_from_boxes(boxes)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),                       # 16
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),                       # 8
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),                       # 4 = G
+            gluon.nn.Conv2D(5, 1))                       # head: obj+4
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+    huber = gluon.loss.HuberLoss()
+
+    nb = args.num_examples // args.batch_size
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        for b in range(nb):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            x = mx.nd.array(imgs[sl])
+            to = mx.nd.array(obj_t[sl])
+            tf = mx.nd.array(off_t[sl])
+            with autograd.record():
+                out = net(x)                      # (N, 5, G, G)
+                s = mx.nd.slice_axis(out, axis=1, begin=0, end=1) \
+                    .reshape((-1, G, G))
+                o = mx.nd.slice_axis(out, axis=1, begin=1, end=5)
+                l_obj = bce(s, to).mean()
+                # box loss only on positive cells
+                mask = to.reshape((-1, 1, G, G))
+                l_box = huber(o * mask, tf * mask).mean()
+                loss = l_obj + 5.0 * l_box
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print("Epoch[%d] loss=%.4f" % (epoch, tot / nb), flush=True)
+
+    # ---- inference with box_nms ----
+    test_imgs, test_boxes = synthetic_scene(rng, 64)
+    out = net(mx.nd.array(test_imgs)).asnumpy()
+    scores = 1.0 / (1.0 + np.exp(-out[:, 0]))
+    cand = decode(scores, out[:, 1:5])
+    kept = mx.nd.box_nms(mx.nd.array(cand), overlap_thresh=0.5,
+                         valid_thresh=0.1, score_index=0,
+                         coord_start=1).asnumpy()
+    ious = []
+    for i in range(kept.shape[0]):
+        best = kept[i, 0]  # nms sorts by score
+        if best[0] <= 0:
+            ious.append(0.0)
+            continue
+        ious.append(iou(best[1:5], test_boxes[i]))
+    miou = float(np.mean(ious))
+    print("mean IoU of top detection: %.3f" % miou)
+    assert np.isfinite(miou)
+
+
+if __name__ == "__main__":
+    main()

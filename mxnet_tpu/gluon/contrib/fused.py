@@ -274,6 +274,12 @@ class FusedTrainStep:
         xd, yd = x.data, y.data
         if self._dp is not None:
             shard, repl = self._dp
+            n_dev = len(shard.mesh.devices.ravel())
+            if batch % n_dev:
+                raise ValueError(
+                    "data-parallel FusedTrainStep: batch size %d is not "
+                    "divisible by %d devices (pad or drop the ragged "
+                    "final batch)" % (batch, n_dev))
             xd = jax.device_put(xd, shard)
             yd = jax.device_put(yd, shard)
             # no-ops after the first step: params/state stay replicated
@@ -296,7 +302,8 @@ class FusedTrainStep:
         primary device (call before single-device eager evaluation or
         when handing params to non-SPMD code).  No-op without
         ``devices=``; replication makes this a local shard fetch."""
-        if self._dp is None:
+        if self._dp is None or self._jitted is None:
+            # before the first step everything is still single-device
             return
         arrays = [p.list_data()[0] for p in self._params]
         arrays += [a.list_data()[0] for a in self._auxs]

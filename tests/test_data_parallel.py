@@ -227,3 +227,23 @@ def test_gluon_fused_step_dp_params_stay_replicated():
     assert len(w.data().data.sharding.device_set) == 4
     step.sync()
     assert len(w.data().data.sharding.device_set) == 1
+
+
+def test_gluon_fused_step_dp_guards():
+    """Ragged batch raises a clear message; sync() before the first
+    step is a safe no-op."""
+    _need_devices(4)
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    net = gluon.nn.Dense(2, in_units=5)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = FusedTrainStep(net, gluon.loss.L2Loss(), trainer,
+                          devices=[mx.cpu(i) for i in range(4)])
+    step.sync()  # no-op before the first step
+    X = mx.nd.array(np.random.RandomState(0).randn(10, 5))
+    Y = mx.nd.array(np.random.RandomState(1).randn(10, 2))
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        step(X, Y)

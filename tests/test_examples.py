@@ -132,3 +132,10 @@ def test_gan_dcgan():
              "stat-dist=" in l]
     # generator distribution moves toward the real one
     assert dists and dists[-1] < dists[0], out
+
+
+def test_toy_detector():
+    out = _run([os.path.join(EX, "object-detection", "toy_detector.py"),
+                "--num-epochs", "6"], timeout=900)
+    miou = float(out.split("mean IoU of top detection: ")[1].split()[0])
+    assert miou > 0.4, out

@@ -498,3 +498,75 @@ def test_contrib_pixelshuffle2d():
     ps2 = cnn.PixelShuffle2D((1, 2))
     x2 = mx.nd.array(np.random.RandomState(1).rand(1, 4, 3, 3))
     assert ps2(x2).shape == (1, 2, 3, 6)
+
+
+# ---------------------------------------------------------------------------
+# RNN modifier / composite cells (reference rnn_cell.py:
+# Residual/Zoneout/Dropout/Bidirectional)
+# ---------------------------------------------------------------------------
+def test_residual_cell_adds_input():
+    from mxnet_tpu.gluon import rnn
+
+    base = rnn.RNNCell(5, activation="tanh")
+    cell = rnn.ResidualCell(base)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 4, 5))
+    out, _ = cell.unroll(4, x, merge_outputs=True)
+    # compare against the unmodified base over the same weights
+    base._modified = False
+    base.reset()
+    base_out, _ = base.unroll(4, x, merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               base_out.asnumpy() + x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_zoneout_cell_limits():
+    from mxnet_tpu.gluon import rnn
+
+    base = rnn.LSTMCell(6)
+    cell = rnn.ZoneoutCell(base, zoneout_outputs=0.0, zoneout_states=0.0)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(1).rand(3, 5, 4))
+    out, _ = cell.unroll(5, x, merge_outputs=True)
+    base._modified = False
+    base.reset()
+    want, _ = base.unroll(5, x, merge_outputs=True)
+    # zero zoneout == base cell exactly
+    np.testing.assert_allclose(out.asnumpy(), want.asnumpy(), rtol=1e-6)
+    assert out.shape == (3, 5, 6)
+
+
+def test_dropout_cell_identity_in_eval():
+    from mxnet_tpu.gluon import rnn
+
+    cell = rnn.DropoutCell(0.5)
+    x = mx.nd.array(np.random.RandomState(2).rand(2, 3, 4))
+    out, _ = cell.unroll(3, x, merge_outputs=True)
+    # inference mode: dropout is identity
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-6)
+
+
+def test_bidirectional_cell_concat_and_reverse():
+    from mxnet_tpu.gluon import rnn
+
+    l = rnn.RNNCell(3, activation="tanh")
+    r = rnn.RNNCell(3, activation="tanh")
+    cell = rnn.BidirectionalCell(l, r)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(3).rand(2, 4, 5))
+    out, _ = cell.unroll(4, x, merge_outputs=True)
+    assert out.shape == (2, 4, 6)  # l_dim + r_dim
+    # forward half equals the left cell alone over the same weights
+    l._modified = False
+    l.reset()
+    lout, _ = l.unroll(4, x, merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy()[:, :, :3], lout.asnumpy(),
+                               rtol=1e-5)
+    # backward half equals the right cell run on the reversed sequence
+    r._modified = False
+    r.reset()
+    xrev = mx.nd.array(x.asnumpy()[:, ::-1])
+    rout, _ = r.unroll(4, xrev, merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy()[:, :, 3:],
+                               rout.asnumpy()[:, ::-1], rtol=1e-5)
