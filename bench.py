@@ -73,27 +73,38 @@ def main():
         loss = step(x, y)
     loss.wait_to_read()
 
-    # best-of-3 repetitions: the remote-TPU tunnel adds run-to-run jitter;
-    # max throughput is the hardware number (standard MLPerf practice)
+    # best-of-3 repetitions (remote-tunnel jitter); every timed region
+    # ends with a HOST VALUE FETCH, not just a ready-barrier — the
+    # remote runtime can acknowledge un-materialized buffers, which
+    # makes barrier-only timings read impossibly fast.  The train loop
+    # is naturally serialized through the donated parameter chain.
+    def host_fetch(arr):
+        arr.asnumpy()  # materialize on host: the real execution barrier
+
     train_img_s = 0.0
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = step(x, y)
-        loss.wait_to_read()
+        host_fetch(loss)
         dt = time.perf_counter() - t0
         train_img_s = max(train_img_s, batch * steps / dt)
 
     # ---- inference ----
+    # chain iterations through a negligible input perturbation so the
+    # remote runtime cannot dedupe identical launches
     infer_img_s = 0.0
+    zero = mx.nd.zeros((1,), ctx=ctx).astype(dtype)  # hoisted off the clock
     with mx.autograd.pause(train_mode=False):
         out = net(x)
-        out.wait_to_read()
+        host_fetch(out)
         for _ in range(3):
+            xi = x
             t0 = time.perf_counter()
             for _ in range(steps):
-                out = net(x)
-            out.wait_to_read()
+                out = net(xi)
+                xi = xi + out[0, 0] * zero
+            host_fetch(out)
             dt = time.perf_counter() - t0
             infer_img_s = max(infer_img_s, batch * steps / dt)
 
@@ -109,6 +120,11 @@ def main():
             extra.update(transformer_bench())
         except Exception as e:  # secondary metric must not sink the run
             extra["transformer_error"] = "%s: %s" % (type(e).__name__, e)
+    if os.environ.get("BENCH_LONGCTX", "1") != "0":
+        try:
+            extra.update(long_context_bench())
+        except Exception as e:
+            extra["longctx_error"] = "%s: %s" % (type(e).__name__, e)
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_b%d_%s_%s"
@@ -118,6 +134,44 @@ def main():
         "vs_baseline": round(train_img_s / TRAIN_BASELINE_IMG_S, 4),
         "extra": extra,
     }))
+
+
+def long_context_bench(seq=8192, steps=5):
+    """Long-context metric: full training step at an 8k sequence on one
+    chip (flash attention keeps memory O(seq); the reference's
+    long-sequence story tops out at BucketingModule — this is net-new
+    capability, SURVEY §5).  Multi-chip sequence scaling (ring
+    attention over an "sp" mesh axis) is exercised by dryrun_multichip.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.models import TransformerLM, TransformerConfig
+    from mxnet_tpu.models.transformer import make_train_step
+
+    cfg = TransformerConfig(vocab_size=32000, d_model=1024, n_heads=16,
+                            n_layers=4, d_ff=4096, max_len=seq,
+                            dtype="bfloat16", remat=True)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
+    step = jax.jit(make_train_step(model))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, seq + 1), 0,
+                              cfg.vocab_size)
+    x, y = toks[:, :-1], toks[:, 1:]
+    params, velocity, loss = step(params, velocity, x, y)
+    float(loss)
+    best = 0.0
+    for _ in range(2):
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            params, velocity, loss = step(params, velocity, x, y)
+        float(np.asarray(loss))  # host fetch: real execution barrier
+        best = max(best, seq * steps / (_time.perf_counter() - t0))
+    return {"longctx_seq%d_tokens_per_sec" % seq: round(best, 1)}
 
 
 def transformer_bench(batch=8, seq=1024, steps=10):
@@ -159,7 +213,7 @@ def transformer_bench(batch=8, seq=1024, steps=10):
         t0 = _time.perf_counter()
         for _ in range(steps):
             params, velocity, loss = step(params, velocity, x, y)
-        loss.block_until_ready()
+        float(np.asarray(loss))  # host fetch: real execution barrier
         dt = _time.perf_counter() - t0
         best = max(best, batch * seq * steps / dt)
 

@@ -258,6 +258,15 @@ class FusedTrainStep:
         x = x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
         y = y if isinstance(y, NDArray) else _wrap(jnp.asarray(y))
         batch = x.shape[0]
+        if self._dp is not None:
+            # reject ragged batches BEFORE any side effect (rescale_grad,
+            # jit build, optimizer update-counter bumps)
+            n_dev = len(self._dp[0].mesh.devices.ravel())
+            if batch % n_dev:
+                raise ValueError(
+                    "data-parallel FusedTrainStep: batch size %d is not "
+                    "divisible by %d devices (pad or drop the ragged "
+                    "final batch)" % (batch, n_dev))
         # Trainer.step parity: normalize grads by batch size
         self._optimizer.rescale_grad = 1.0 / batch
         if self._jitted is None:
@@ -274,12 +283,6 @@ class FusedTrainStep:
         xd, yd = x.data, y.data
         if self._dp is not None:
             shard, repl = self._dp
-            n_dev = len(shard.mesh.devices.ravel())
-            if batch % n_dev:
-                raise ValueError(
-                    "data-parallel FusedTrainStep: batch size %d is not "
-                    "divisible by %d devices (pad or drop the ragged "
-                    "final batch)" % (batch, n_dev))
             xd = jax.device_put(xd, shard)
             yd = jax.device_put(yd, shard)
             # no-ops after the first step: params/state stay replicated
