@@ -103,13 +103,19 @@ class _Var:
 
 
 class _Node:
-    __slots__ = ("opdef", "static", "array_params", "rng", "train",
+    __slots__ = ("opdef", "impl", "static", "array_params", "rng", "train",
                  "in_entries", "in_consts", "n_out", "custom", "out_values",
                  "out_refs")
 
     def __init__(self, opdef, static, array_params, rng, train, in_entries,
                  in_consts, n_out, custom=None, out_values=None):
         self.opdef = opdef
+        # snapshot the ACTIVE kernel implementation at record time so a
+        # backward() after a registry.override scope exits still replays
+        # the same math the forward actually ran
+        from .ops.registry import active_impl
+
+        self.impl = active_impl(opdef) if opdef is not None else None
         self.static = static          # frozen static param items
         self.array_params = array_params  # [(name, value)]
         self.rng = rng
@@ -221,7 +227,11 @@ def _structure_key(nodes, vars_, head_entries, consts_shapes):
         return ("n", node_ids[id(e[0])], e[1])
 
     nk = tuple(
-        (n.opdef.name, n.static, tuple(k for k, _ in n.array_params),
+        # n.impl is part of the key: the same graph recorded under a
+        # registry.override must not hit a backward module compiled
+        # against a different kernel implementation
+        (n.opdef.name, n.impl, n.static,
+         tuple(k for k, _ in n.array_params),
          n.rng is not None, n.train, tuple(ekey(e) for e in n.in_entries),
          n.n_out)
         for n in nodes
@@ -257,7 +267,8 @@ def _build_replay(nodes, vars_, head_entries):
                 else:
                     ins.append(lookup(e))
             ci += local_const
-            fn = n.opdef.bind({k: v for k, v in n.static}, n.train)
+            fn = n.opdef.bind_impl(n.impl, {k: v for k, v in n.static},
+                                   n.train)
             ap_kw = {name: consts[ci + j]
                      for j, (name, _) in enumerate(n.array_params)}
             ci += len(n.array_params)
@@ -408,7 +419,8 @@ def _eager_backward(nodes, vars_, head_entries, head_grads):
             vjps[ni] = None
         else:
             ap_kw = {name: jnp.asarray(v) for name, v in n.array_params}
-            fn = n.opdef.bind({k_: v for k_, v in n.static}, n.train)
+            fn = n.opdef.bind_impl(n.impl, {k_: v for k_, v in n.static},
+                                   n.train)
             if n.rng is not None:
                 rng = n.rng
                 outs, vjp = jax.vjp(lambda *a: fn(rng, *a, **ap_kw), *ins)

@@ -115,12 +115,17 @@ class OpDef:
     # ------------------------------------------------------------------
     def bind(self, static_params, train):
         """Return ``fn`` with static params closed over (for jit/trace use)."""
+        return self.bind_impl(active_impl(self), static_params, train)
+
+    def bind_impl(self, impl, static_params, train):
+        """bind() with an explicit kernel implementation (autograd replay
+        passes its record-time snapshot here)."""
         if not self.cacheable:
             kw = dict(static_params)
             if self.train_aware:
                 kw["_train"] = train
-            return lambda *args: self.fn(*args, **kw)
-        return _bound_fn(self, _freeze(static_params), train)
+            return lambda *args: impl(*args, **kw)
+        return _bound_fn(self, impl, _freeze(static_params), train)
 
     def call(self, arrays, params, rng=None, train=False):
         """Eager compiled call: arrays are jax arrays, params a dict."""
@@ -138,7 +143,8 @@ class OpDef:
             if self.needs_rng:
                 return f(rng, *arrays, **kw)
             return f(*arrays, **kw)
-        f = _jitted(self, _freeze(static), tuple(k for k, _ in arrs), train)
+        f = _jitted(self, active_impl(self), _freeze(static),
+                    tuple(k for k, _ in arrs), train)
         args = list(arrays) + [v for _, v in arrs]
         if self.needs_rng:
             return f(rng, *args)
@@ -174,26 +180,124 @@ def _thaw(items):
     return {k: v for k, v in items}
 
 
-@functools.lru_cache(maxsize=None)
-def _bound_fn(opdef, static_items, train):
+# -- pluggable kernel overrides ---------------------------------------------
+# The reference's subgraph-property hook (src/operator/subgraph/
+# subgraph_property.h:93, MXNET_SUBGRAPH_BACKEND) lets a backend swap the
+# kernel behind an op without touching the graph.  TPU-native analogue:
+# replace the pure-jax implementation of a registered op — e.g. drop in a
+# hand-tuned Pallas kernel for one workload.  Overrides take effect for
+# newly compiled executables (imperative calls immediately — the jit
+# cache is keyed on the active implementation; already-built Executors
+# keep the kernels they compiled with, like the reference's partitioned
+# graphs).  The table is PROCESS-GLOBAL, like the reference's
+# MXNET_SUBGRAPH_BACKEND — do not toggle overrides while other threads
+# dispatch the same op (mutations themselves are lock-protected).
+_OVERRIDES: dict = {}
+_OVERRIDE_LOCK = threading.Lock()
+
+
+def active_impl(opdef):
+    return _OVERRIDES.get(opdef.name, opdef.fn)
+
+
+class override:
+    """Context manager / callable: substitute op ``name``'s kernel.
+
+    ``fn`` has the registered implementation's signature (jax arrays +
+    static params; ``rng`` first when the op needs_rng).  Use as::
+
+        with registry.override("relu", my_pallas_relu):
+            ...  # imperative calls + new traces use my_pallas_relu
+
+    or permanently via ``registry.override(name, fn).apply()``.
+    Removal is strictly LIFO: removing an override that is not the
+    currently active one raises instead of clobbering it.
+    """
+
+    _MISSING = object()
+
+    def __init__(self, name, fn):
+        if name not in OPS:
+            raise KeyError("operator %r is not registered" % name)
+        self._name = OPS[name].name  # canonical (aliases share one slot)
+        self._fn = fn
+        self._prev = self._MISSING
+        self._applied = False
+
+    def apply(self):
+        with _OVERRIDE_LOCK:
+            self._prev = _OVERRIDES.get(self._name, self._MISSING)
+            _OVERRIDES[self._name] = self._fn
+            self._applied = True
+        return self
+
+    def remove(self):
+        with _OVERRIDE_LOCK:
+            if not self._applied:
+                return
+            if _OVERRIDES.get(self._name) is not self._fn:
+                raise RuntimeError(
+                    "non-LIFO override removal for %r: another override "
+                    "is active" % self._name)
+            if self._prev is self._MISSING:
+                _OVERRIDES.pop(self._name, None)
+            else:
+                _OVERRIDES[self._name] = self._prev
+            self._applied = False
+            # evict executables compiled against this kernel so a churn
+            # of scoped overrides cannot grow the caches unboundedly.
+            # NOTE: this frees memory but also means autograd tapes that
+            # recorded under the override recompile (not re-resolve: the
+            # tape replays its snapshot impl) if replayed after exit.
+            _purge_impl_caches(self._fn)
+
+    def __enter__(self):
+        return self.apply()
+
+    def __exit__(self, *exc):
+        self.remove()
+
+
+# plain dict caches (not lru_cache): override.remove() purges the
+# entries compiled against a retired kernel, keeping churned scoped
+# overrides from pinning executables for process lifetime
+_BOUND_CACHE: dict = {}
+_JIT_CACHE: dict = {}
+
+
+def _purge_impl_caches(impl):
+    for cache in (_BOUND_CACHE, _JIT_CACHE):
+        for k in [k for k in cache if k[1] is impl]:
+            del cache[k]
+
+
+def _bound_fn(opdef, impl, static_items, train):
+    key = (opdef, impl, static_items, train)
+    cached = _BOUND_CACHE.get(key)
+    if cached is not None:
+        return cached
     kw = _thaw(static_items)
     if opdef.train_aware:
         kw["_train"] = train
-    fn = opdef.fn
+    fn = impl
 
     def call(*args, **extra):
         return fn(*args, **kw, **extra)
 
     call.__name__ = opdef.name
+    _BOUND_CACHE[key] = call
     return call
 
 
-@functools.lru_cache(maxsize=None)
-def _jitted(opdef, static_items, array_param_names, train):
+def _jitted(opdef, impl, static_items, array_param_names, train):
+    key = (opdef, impl, static_items, array_param_names, train)
+    cached = _JIT_CACHE.get(key)
+    if cached is not None:
+        return cached
     kw = _thaw(static_items)
     if opdef.train_aware:
         kw["_train"] = train
-    fn = opdef.fn
+    fn = impl
     n_ap = len(array_param_names)
 
     def call(*args):
@@ -205,7 +309,9 @@ def _jitted(opdef, static_items, array_param_names, train):
         return fn(*args, **kw)
 
     call.__name__ = opdef.name
-    return jax.jit(call)
+    jitted = jax.jit(call)
+    _JIT_CACHE[key] = jitted
+    return jitted
 
 
 def register(name, **opts):

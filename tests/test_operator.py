@@ -335,3 +335,104 @@ def test_gather_scatter():
     indices = mx.nd.array([[1, 0], [0, 1]])
     out = mx.nd.gather_nd(data, indices)
     assert_almost_equal(out, [3, 2])
+
+
+# ---------------------------------------------------------------------------
+# pluggable kernel override (reference subgraph-property hook analogue)
+# ---------------------------------------------------------------------------
+def test_kernel_override_scoped():
+    from mxnet_tpu.ops import registry
+
+    x = mx.nd.array(np.array([-1.0, 2.0], np.float32))
+    base = mx.nd.relu(x).asnumpy()
+    with registry.override("relu", lambda d: d * 0 + 7.0):
+        subbed = mx.nd.relu(x).asnumpy()
+        np.testing.assert_allclose(subbed, [7.0, 7.0])
+        # gradients trace THROUGH the override implementation
+        x.attach_grad()
+        with mx.autograd.record():
+            y = mx.nd.relu(x)
+        y.backward(mx.nd.ones((2,)))
+        np.testing.assert_allclose(x.grad.asnumpy(), [0.0, 0.0])
+    # scope exit restores the registered kernel
+    np.testing.assert_allclose(mx.nd.relu(x).asnumpy(), base)
+    # unknown name rejected
+    with pytest.raises(KeyError):
+        registry.override("not_an_op", lambda d: d)
+
+
+def test_kernel_override_backward_after_scope_exit():
+    """The tape snapshots the active kernel at record time: backward()
+    after the override scope exits replays the OVERRIDE math."""
+    from mxnet_tpu.ops import registry
+
+    x = mx.nd.array(np.array([1.0, -2.0], np.float32))
+    x.attach_grad()
+    with registry.override("relu", lambda d: d * 3.0):
+        with mx.autograd.record():
+            y = mx.nd.relu(x)
+        np.testing.assert_allclose(y.asnumpy(), [3.0, -6.0])
+    # scope exited; backward must still differentiate d*3
+    y.backward(mx.nd.ones((2,)))
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_kernel_override_lifo_and_cache_purge():
+    from mxnet_tpu.ops import registry
+
+    x = mx.nd.array(np.array([1.0], np.float32))
+    fa = lambda d: d + 10.0
+    fb = lambda d: d + 20.0
+    a = registry.override("relu", fa).apply()
+    b = registry.override("relu", fb).apply()
+    np.testing.assert_allclose(mx.nd.relu(x).asnumpy(), [21.0])
+    with pytest.raises(RuntimeError, match="non-LIFO"):
+        a.remove()
+    b.remove()  # back to fa
+    np.testing.assert_allclose(mx.nd.relu(x).asnumpy(), [11.0])
+    a.remove()  # back to base
+    np.testing.assert_allclose(mx.nd.relu(x).asnumpy(), [1.0])
+    # retired kernels are evicted from the executable caches
+    assert not any(k[1] is fb for k in registry._JIT_CACHE)
+    # removing twice / without apply is a no-op
+    a.remove()
+    registry.override("relu", fa).remove()
+    np.testing.assert_allclose(mx.nd.relu(x).asnumpy(), [1.0])
+
+
+def test_kernel_override_via_alias():
+    """Aliases canonicalize: overriding 'flatten' overrides 'Flatten'."""
+    from mxnet_tpu.ops import registry
+
+    x = mx.nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+    with registry.override("flatten", lambda d: d.reshape(1, -1) * 2):
+        got = mx.nd.Flatten(x).asnumpy()  # canonical name picks it up
+    np.testing.assert_allclose(got, np.arange(4, dtype=np.float32)
+                               .reshape(1, 4) * 2)
+    np.testing.assert_allclose(mx.nd.Flatten(x).asnumpy(),
+                               x.asnumpy().reshape(2, 2))
+
+
+def test_kernel_override_via_alias_and_hybrid():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.ops import registry
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(3, in_units=2, use_bias=False))
+    net.initialize(mx.init.Constant(1.0) if hasattr(mx.init, "Constant")
+                   else mx.init.One(), ctx=mx.cpu())
+    x = mx.nd.ones((1, 2))
+    want = net(x).asnumpy()
+    # FullyConnected override doubles output; a net hybridized inside
+    # the scope compiles with it
+    def doubled_fc(data, weight, bias=None, **kw):
+        import jax.numpy as jnp
+        y = jnp.matmul(data, weight.T) * 2
+        return y if bias is None else y + bias
+    with registry.override("FullyConnected", doubled_fc):
+        net2 = gluon.nn.HybridSequential()
+        net2.add(gluon.nn.Dense(3, in_units=2, use_bias=False))
+        net2.initialize(mx.init.One(), ctx=mx.cpu())
+        net2.hybridize()
+        got = net2(x).asnumpy()
+    np.testing.assert_allclose(got, want * 2, rtol=1e-6)
