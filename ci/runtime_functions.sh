@@ -59,11 +59,13 @@ multichip_dryrun() {
 }
 
 unittest_core_tpu() {
-    # rerun the operator corpus on the real chip (reference parity:
+    # rerun the corpus on the real chip (reference parity:
     # tests/python/gpu/test_operator_gpu.py reruns the unittest corpus
     # with default ctx = gpu); needs TPU hardware attached
     MXTPU_TEST_ON_TPU=1 python -m pytest tests/test_operator.py \
-        tests/test_operator_extra.py tests/test_ndarray.py -q
+        tests/test_operator_extra.py tests/test_ndarray.py \
+        tests/test_autograd.py tests/test_module.py \
+        tests/test_gluon.py -q
 }
 
 all() {
