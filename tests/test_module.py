@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.test_utils import default_context
 from mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
 
 
@@ -219,7 +220,7 @@ class TestModule:
     def test_fit_improves_accuracy(self):
         x, y = _toy_data()
         it = NDArrayIter(x, y, batch_size=32, shuffle=True)
-        mod = mx.mod.Module(_mlp_symbol(classes=4), context=mx.cpu())
+        mod = mx.mod.Module(_mlp_symbol(classes=4), context=default_context())
         mod.fit(it, num_epoch=5, optimizer="sgd",
                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
                 initializer=mx.init.Xavier())
@@ -230,7 +231,7 @@ class TestModule:
     def test_predict_shapes(self):
         x, y = _toy_data(n=64)
         it = NDArrayIter(x, y, batch_size=16)
-        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod = mx.mod.Module(_mlp_symbol(), context=default_context())
         mod.bind(data_shapes=it.provide_data,
                  label_shapes=it.provide_label)
         mod.init_params(mx.init.Xavier())
@@ -241,14 +242,14 @@ class TestModule:
         x, y = _toy_data(n=64)
         it = NDArrayIter(x, y, batch_size=16)
         prefix = str(tmp_path / "mlp")
-        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod = mx.mod.Module(_mlp_symbol(), context=default_context())
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params(mx.init.Xavier())
         mod.save_checkpoint(prefix, 3)
         assert os.path.exists(prefix + "-symbol.json")
         assert os.path.exists(prefix + "-0003.params")
 
-        mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+        mod2 = mx.mod.Module.load(prefix, 3, context=default_context())
         mod2.bind(data_shapes=it.provide_data,
                   label_shapes=it.provide_label)
         mod2.init_params()
@@ -278,7 +279,7 @@ class TestModule:
         net = mx.sym.Flatten(net)
         net = mx.sym.FullyConnected(net, num_hidden=2, name="fcout")
         net = mx.sym.SoftmaxOutput(net, name="softmax")
-        mod = mx.mod.Module(net, context=mx.cpu())
+        mod = mx.mod.Module(net, context=default_context())
         mod.fit(it, num_epoch=4, optimizer="adam",
                 optimizer_params={"learning_rate": 0.01},
                 initializer=mx.init.Xavier())
@@ -295,7 +296,7 @@ class TestBucketingModule:
             return out, ("data",), ("softmax_label",)
 
         mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
-                                     context=mx.cpu())
+                                     context=default_context())
         mod.bind(data_shapes=[DataDesc("data", (8, 10))],
                  label_shapes=[DataDesc("softmax_label", (8,))])
         mod.init_params(mx.init.Xavier())
