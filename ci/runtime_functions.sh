@@ -28,7 +28,8 @@ unittest_core() {
     python -m pytest tests/test_operator.py tests/test_operator_corpus.py \
         tests/test_operator_extra.py tests/test_random.py \
         tests/test_ndarray.py tests/test_autograd.py \
-        tests/test_higher_order.py tests/test_sparse.py -q
+        tests/test_higher_order.py tests/test_sparse.py \
+        tests/test_torch_oracle.py -q
 }
 
 unittest_frontend() {
@@ -41,13 +42,15 @@ unittest_frontend() {
 
 unittest_parallel() {
     python -m pytest tests/test_parallel.py tests/test_dist.py \
-        tests/test_fused_step.py tests/test_elastic.py -q
+        tests/test_fused_step.py tests/test_elastic.py \
+        tests/test_data_parallel.py tests/test_gradient_compression.py -q
 }
 
 unittest_serving() {
     python -m pytest tests/test_predict.py tests/test_native.py \
         tests/test_quantization.py tests/test_pallas.py \
-        tests/test_profiler.py tests/test_rtc.py tests/test_contrib.py -q
+        tests/test_profiler.py tests/test_rtc.py tests/test_contrib.py \
+        tests/test_onnx.py -q
 }
 
 integration_examples() {
