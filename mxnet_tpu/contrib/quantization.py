@@ -39,43 +39,63 @@ def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
                                         num_quantized_bins)
 
 
+def _smooth_distribution(p, eps=1e-4):
+    """Replace zeros with eps, taking the mass off the nonzero entries
+    (KL-smoothing per the reference's _smooth_distribution — uniform
+    mixing instead would fabricate probability where the clipped
+    distribution has none and wrecks the threshold choice for spiky
+    histograms, e.g. post-ReLU activations that are ~80% exact zeros)."""
+    is_zero = p == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = p.size - n_zero
+    if not n_nonzero:
+        return None
+    out = p.astype(np.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] *= 1.0 - eps * n_zero / n_nonzero
+    return out
+
+
 def _optimal_threshold_from_hist(hist, edges, num_quantized_bins=255):
     num_bins = len(hist)
     hist = hist.astype(np.float64)
     zero = num_bins // 2
     best_kl, best_thr = np.inf, float(edges[-1])
-    for i in range(num_quantized_bins // 2 + 1, zero + 1, 16):
-        thr = edges[zero + i]
-        sliced = hist[zero - i:zero + i].copy()
+    for i in range(num_quantized_bins // 2, zero + 1, 16):
+        p_start, p_stop = zero - i, zero + i + 1
+        thr = edges[p_stop]  # p_stop <= num_bins < len(edges) always
+        sliced = hist[p_start:p_stop].copy()
         # p: clipped distribution — outlier mass folds into the edge bins
         p = sliced.copy()
-        p[0] += hist[:zero - i].sum()
-        p[-1] += hist[zero + i:].sum()
+        p[0] += hist[:p_start].sum()
+        p[-1] += hist[p_stop:].sum()
         if p.sum() == 0:
             continue
-        # q: int8-quantized rendering of the in-range histogram only —
-        # the clipped mass is NOT representable, which is what makes
-        # aggressive clipping expensive (reference calibrate.cc /
-        # contrib/quantization.py _get_optimal_threshold)
-        n = len(sliced)
-        factor = n / num_quantized_bins
+        # q: int8-quantized rendering of the in-range histogram, with
+        # mass placed ONLY where p is nonzero (reference
+        # _get_optimal_threshold: `q[p == 0] = 0`) — without the mask a
+        # spiky histogram's empty bins make fine-grained (small-i)
+        # renderings look spuriously faithful
+        isnz = p != 0
+        n = sliced.size  # n = 2i+1 >= num_quantized_bins, so nm >= 1
+        nm = n // num_quantized_bins
         q = np.zeros(n)
         for j in range(num_quantized_bins):
-            lo = int(j * factor)
-            hi = max(int((j + 1) * factor), lo + 1)
-            seg = sliced[lo:hi]
-            nz = (seg > 0).sum()
-            if nz:
-                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
-        # smooth: spread tiny eps onto zero bins so KL stays finite
-        eps = 1e-4
-        pp = p / p.sum()
-        qq = q / q.sum() if q.sum() else q
-        pp = (1 - eps) * pp + eps / n
-        qq = (1 - eps) * qq + eps / n
-        kl = np.sum(pp * np.log(pp / qq))
+            s = j * nm
+            e = n if j == num_quantized_bins - 1 else s + nm
+            norm = isnz[s:e].sum()
+            if norm:
+                q[s:e] = sliced[s:e].sum() / norm
+        q[~isnz] = 0
+        pp = _smooth_distribution(p)
+        qq = _smooth_distribution(q)
+        if pp is None or qq is None:
+            continue
+        pp = pp / pp.sum()
+        qq = qq / qq.sum()
+        kl = float(np.sum(pp * np.log(pp / qq)))
         if kl < best_kl:
-            best_kl, best_thr = kl, thr
+            best_kl, best_thr = kl, float(thr)
     return best_thr
 
 

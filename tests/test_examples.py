@@ -85,7 +85,10 @@ def test_quantization_example(tmp_path):
     out = _run([os.path.join(EX, "quantization", "quantize_model.py"),
                 "--out-prefix", str(tmp_path / "qmodel"),
                 "--num-calib-examples", "64"])
-    assert "fp32 accuracy" in out and "int8 accuracy" in out
+    fp32 = float(out.split("fp32 accuracy: ")[1].split()[0])
+    int8 = float(out.split("int8 accuracy: ")[1].split()[0])
+    assert fp32 > 0.9, out          # the demo net actually trains
+    assert int8 >= fp32 - 0.05, out  # quantization parity
     assert (tmp_path / "qmodel-symbol.json").exists()
 
 

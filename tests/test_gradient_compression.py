@@ -38,22 +38,24 @@ def test_quantization_levels():
 
 def test_error_feedback_accumulates():
     """Sub-threshold gradients are not lost: residuals carry over until
-    they cross the threshold (reference error-feedback residual)."""
+    they cross the threshold (reference error-feedback residual).
+
+    Without an updater, push stores the QUANTIZED gradient, so each pull
+    reads exactly that push's emission.  With threshold 0.5 and pushes
+    of 0.2 each, the residual walk is:
+      r: 0.2, 0.4, (0.6->emit 0.5, r 0.1), 0.3, (0.5->emit 0.5, r 0.0)
+    """
     kv = _kv(threshold=0.5)
     kv.init("w", mx.nd.zeros((1,)))
-    total = mx.nd.zeros((1,))
-    # 0.2 per push: pushes 1-2 emit 0, push 3 (residual 0.6) emits 0.5
     emitted = []
     for _ in range(5):
         kv.push("w", mx.nd.array(np.array([0.2], np.float32)))
         out = mx.nd.zeros((1,))
         kv.pull("w", out)
-        emitted.append(float(out.asnumpy()[0]) - float(total.asnumpy()[0]))
-        total = out.copy()
-    # cumulative emitted quantized mass approaches the true sum (1.0)
-    assert abs(sum(emitted) - 1.0) <= 0.5  # within one threshold step
-    assert any(e == 0.0 for e in emitted)      # some pushes quantize to 0
-    assert any(abs(e - 0.5) < 1e-6 for e in emitted)  # ...then fire
+        emitted.append(float(out.asnumpy()[0]))
+    assert emitted == [0.0, 0.0, 0.5, 0.0, 0.5]
+    # total emitted quantized mass equals the true gradient sum exactly
+    assert abs(sum(emitted) - 5 * 0.2) < 1e-6
 
 
 def test_compressed_training_converges():
