@@ -280,14 +280,30 @@ def export_model(sym, params, input_shape, input_type=None,
     data_inputs = []
     shapes = list(input_shape)
 
+    # Label inputs are detected structurally (variables feeding the label
+    # slot of an Output-family head), not by name substring — a data input
+    # named e.g. 'labels_emb' must stay in the graph.
+    label_vars = set()
+    for node in topo:
+        if not node.is_var and node.op.name.endswith("Output"):
+            for src, _ in node.inputs[1:]:
+                if src.is_var:
+                    label_vars.add(id(src))
+
     name_of = {}
     for node in topo:
         if node.is_var:
             name_of[id(node)] = node.name
-            if node.name not in clean and "label" not in node.name:
-                data_inputs.append(node.name)
         else:
             name_of[id(node)] = node.name + "_out"
+
+    # Pair input_shape with data inputs in list_arguments() order (the
+    # documented contract), not topo-discovery order.
+    var_by_name = {n.name: n for n in topo if n.is_var}
+    for arg_name in sym.list_arguments():
+        node = var_by_name[arg_name]
+        if arg_name not in clean and id(node) not in label_vars:
+            data_inputs.append(arg_name)
 
     graph = b""
     for node in topo:
@@ -299,7 +315,7 @@ def export_model(sym, params, input_shape, input_type=None,
             raise NotImplementedError(
                 "no ONNX converter for operator %r" % op_name)
         ins = [name_of[id(src)] for src, _ in node.inputs
-               if not (src.is_var and "label" in src.name)]
+               if not (src.is_var and id(src) in label_vars)]
         nodes_bytes.extend(conv(node, ins, name_of[id(node)], ctx))
 
     graph += b"".join(nodes_bytes)

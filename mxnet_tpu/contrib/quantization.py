@@ -62,8 +62,10 @@ def _optimal_threshold_from_hist(hist, edges, num_quantized_bins=255):
     zero = num_bins // 2
     best_kl, best_thr = np.inf, float(edges[-1])
     for i in range(num_quantized_bins // 2, zero + 1, 16):
-        p_start, p_stop = zero - i, zero + i + 1
-        thr = edges[p_stop]  # p_stop <= num_bins < len(edges) always
+        # with odd num_bins p_stop <= num_bins always; clamp so an even
+        # bin count (i == zero makes p_stop = num_bins + 1) stays in range
+        p_start, p_stop = zero - i, min(zero + i + 1, num_bins)
+        thr = edges[p_stop]
         sliced = hist[p_start:p_stop].copy()
         # p: clipped distribution — outlier mass folds into the edge bins
         p = sliced.copy()

@@ -470,7 +470,7 @@ class LibSVMIter(DataIter):
         if hi <= self.num_data:
             return np.arange(lo, hi)
         return np.concatenate([np.arange(lo, self.num_data),
-                               np.arange(hi - self.num_data)])
+                               np.arange(hi - self.num_data) % self.num_data])
 
     def getdata(self):
         from ..ndarray import sparse as _sparse

@@ -45,6 +45,25 @@ int fail(const std::string& msg) {
   return -1;
 }
 
+// PyUnicode_AsUTF8 returns nullptr on non-UTF8 names; constructing a
+// std::string from nullptr is UB.  safe_utf8 is for diagnostic text only
+// (error messages); data paths returning names to the caller must use
+// utf8_or_null and propagate an error instead of renaming silently.
+const char* safe_utf8(PyObject* s) {
+  const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (!c) {
+    PyErr_Clear();
+    return "<non-utf8>";
+  }
+  return c;
+}
+
+const char* utf8_or_null(PyObject* s) {
+  const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (!c) PyErr_Clear();
+  return c;
+}
+
 int fail_py(const char* what) {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
@@ -53,7 +72,7 @@ int fail_py(const char* what) {
     PyObject* s = PyObject_Str(value);
     if (s) {
       msg += ": ";
-      msg += PyUnicode_AsUTF8(s);
+      msg += safe_utf8(s);
       Py_DECREF(s);
     }
   }
@@ -156,9 +175,11 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
     nelem = sz ? PyLong_AsSize_t(sz) : 0;
     Py_XDECREF(sz);
   }
-  if (size < nelem) {
+  if (size != nelem) {
+    // mirror the FromCPU contract exactly: the caller must pass the
+    // element count, not merely a large-enough buffer
     Py_DECREF(bytes);
-    return fail("destination buffer too small");
+    return fail("destination size must equal array element count");
   }
   std::memcpy(data, PyBytes_AsString(bytes), blen);
   Py_DECREF(bytes);
@@ -295,9 +316,14 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
     t->load_out.push_back(wrap(a));
   }
   Py_ssize_t nn = PyList_Size(names);
-  for (Py_ssize_t i = 0; i < nn; ++i)
-    t->load_str_store.push_back(
-        PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  for (Py_ssize_t i = 0; i < nn; ++i) {
+    const char* name = utf8_or_null(PyList_GET_ITEM(names, i));
+    if (!name) {
+      Py_DECREF(r);
+      return fail("non-UTF8 array name in file");
+    }
+    t->load_str_store.push_back(name);
+  }
   for (auto& s : t->load_str_store) t->load_cstr_out.push_back(s.c_str());
   Py_DECREF(r);
   *out_size = static_cast<mx_uint>(t->load_out.size());
@@ -319,8 +345,14 @@ int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
   t->op_str_store.clear();
   t->op_cstr_out.clear();
   Py_ssize_t n = PyList_Size(r);
-  for (Py_ssize_t i = 0; i < n; ++i)
-    t->op_str_store.push_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* name = utf8_or_null(PyList_GET_ITEM(r, i));
+    if (!name) {
+      Py_DECREF(r);
+      return fail("non-UTF8 op name");
+    }
+    t->op_str_store.push_back(name);
+  }
   Py_DECREF(r);
   for (auto& s : t->op_str_store) t->op_cstr_out.push_back(s.c_str());
   *out_size = static_cast<mx_uint>(t->op_cstr_out.size());

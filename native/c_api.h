@@ -40,7 +40,8 @@ int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t size);
 
-/* Synchronous device->host copy; size in elements. */
+/* Synchronous device->host copy; size in elements and must equal the
+ * array's element count (mirrors the FromCPU contract). */
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
 
 /* Shape of the array; pointers valid until the next call on this handle. */

@@ -23,7 +23,9 @@ from mxnet_tpu.test_utils import (assert_almost_equal,
 def test_unary_forward(op, npf):
     x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
     out = getattr(mx.nd, op)(mx.nd.array(x))
-    assert_almost_equal(out, npf(x), rtol=1e-5, atol=1e-6)
+    # default tolerances: the device floor applies (TPU transcendental
+    # units differ from host libm by up to ~4e-5 relative, e.g. tanh)
+    assert_almost_equal(out, npf(x))
 
 
 @pytest.mark.parametrize("op", ["exp", "tanh", "sigmoid", "square"])
@@ -160,8 +162,8 @@ def test_softmax_ops():
     x = np.random.randn(3, 5).astype(np.float32)
     sm = mx.nd.softmax(mx.nd.array(x)).asnumpy()
     e = np.exp(x - x.max(-1, keepdims=True))
-    assert_almost_equal(sm, e / e.sum(-1, keepdims=True), rtol=1e-5,
-                        atol=1e-6)
+    # defaults: device floor covers TPU exp-unit vs libm differences
+    assert_almost_equal(sm, e / e.sum(-1, keepdims=True))
     lsm = mx.nd.log_softmax(mx.nd.array(x))
     assert_almost_equal(lsm, np.log(sm + 1e-20), rtol=1e-4, atol=1e-5)
 
@@ -223,8 +225,8 @@ def test_activation_leakyrelu():
         mx.nd.LeakyReLU(mx.nd.array(x), act_type="leaky", slope=0.1),
         np.where(x >= 0, x, 0.1 * x), rtol=1e-5, atol=1e-6)
     elu = mx.nd.LeakyReLU(mx.nd.array(x), act_type="elu", slope=1.0)
-    assert_almost_equal(elu, np.where(x >= 0, x, np.expm1(x)), rtol=1e-5,
-                        atol=1e-6)
+    # defaults: device floor covers TPU expm1-unit vs libm differences
+    assert_almost_equal(elu, np.where(x >= 0, x, np.expm1(x)))
 
 
 def test_sequence_ops():
@@ -419,8 +421,9 @@ def test_kernel_override_via_alias_and_hybrid():
 
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Dense(3, in_units=2, use_bias=False))
+    ctx = mx.current_context()
     net.initialize(mx.init.Constant(1.0) if hasattr(mx.init, "Constant")
-                   else mx.init.One(), ctx=mx.cpu())
+                   else mx.init.One(), ctx=ctx)
     x = mx.nd.ones((1, 2))
     want = net(x).asnumpy()
     # FullyConnected override doubles output; a net hybridized inside
@@ -432,7 +435,7 @@ def test_kernel_override_via_alias_and_hybrid():
     with registry.override("FullyConnected", doubled_fc):
         net2 = gluon.nn.HybridSequential()
         net2.add(gluon.nn.Dense(3, in_units=2, use_bias=False))
-        net2.initialize(mx.init.One(), ctx=mx.cpu())
+        net2.initialize(mx.init.One(), ctx=ctx)
         net2.hybridize()
         got = net2(x).asnumpy()
     np.testing.assert_allclose(got, want * 2, rtol=1e-6)
