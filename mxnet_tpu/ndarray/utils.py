@@ -20,8 +20,32 @@ _MAGIC_KEY = "__mxnet_tpu_format__"
 _FORMAT_VERSION = "1"
 
 
-def save(fname, data):
-    """Save a list or str->NDArray dict to file (reference: mx.nd.save)."""
+def save(fname, data, format="npz"):
+    """Save a list or str->NDArray dict to file (reference: mx.nd.save).
+
+    ``format="npz"`` (default) writes the portable numpy container;
+    ``format="mxnet"`` writes the reference's dmlc binary layout
+    (``src/ndarray/ndarray.cc:1778`` NDArray::Save) so reference
+    installations can read the file.  ``load`` sniffs both.
+    """
+    if format == "mxnet":
+        from . import dmlc_serde
+
+        if isinstance(data, NDArray):
+            data = [data]
+        if isinstance(data, dict):
+            names = list(data.keys())
+            arrays = [data[k].asnumpy() for k in names]
+        else:
+            names, arrays = [], [v.asnumpy() for v in data]
+        blob = dmlc_serde.dumps(arrays, names)
+        tmp = fname + ".tmp%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, fname)
+        return
+    if format != "npz":
+        raise ValueError("unknown save format %r" % (format,))
     if isinstance(data, NDArray):
         data = [data]
     arrays = {}
@@ -47,22 +71,45 @@ def save(fname, data):
     os.replace(tmp, fname)
 
 
+def _load_dmlc(buf):
+    from . import dmlc_serde
+
+    arrays, names, _stypes = dmlc_serde.loads(buf)
+    if names:
+        return {n: array(a) for n, a in zip(names, arrays)}
+    return [array(a) for a in arrays]
+
+
 def load(fname):
-    """Load from file: returns a list or dict matching what was saved."""
+    """Load from file: returns a list or dict matching what was saved.
+
+    Accepts both this framework's ``.npz`` container and the reference's
+    dmlc binary NDArray file (including the legacy V0/V1 layouts), so
+    reference-written ``.params`` files load unchanged."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from . import dmlc_serde
+
+    if dmlc_serde.is_dmlc_format(head):
+        with open(fname, "rb") as f:
+            return _load_dmlc(f.read())
+    # npz path stays lazy: np.load memory-maps the zip members on demand
+    # instead of slurping the whole checkpoint into one buffer
     with np.load(fname, allow_pickle=False) as z:
         keys = [k for k in z.files if k != _MAGIC_KEY]
         if all(k.startswith("idx:") for k in keys):
             return [array(z[k]) for k in sorted(keys)]
-        out = {}
-        for k in keys:
-            name = k[5:] if k.startswith("name:") else k
-            out[name] = array(z[k])
-        return out
+        return {(k[5:] if k.startswith("name:") else k): array(z[k])
+                for k in keys}
 
 
 def load_frombuffer(buf):
     import io
 
+    from . import dmlc_serde
+
+    if dmlc_serde.is_dmlc_format(buf[:8]):
+        return _load_dmlc(bytes(buf))
     with np.load(io.BytesIO(buf), allow_pickle=False) as z:
         keys = [k for k in z.files if k != _MAGIC_KEY]
         if all(k.startswith("idx:") for k in keys):

@@ -291,10 +291,32 @@ class Symbol:
         ex = self.bind(ctx=ctx, args=kwargs, grad_req="null")
         return ex.forward()
 
-    def gradient(self, wrt):  # pragma: no cover - reference compat stub
-        raise NotImplementedError(
-            "use simple_bind(...).backward() — gradients are computed by "
-            "jax.vjp over the bound executor")
+    def gradient(self, wrt):
+        """Symbolic gradients of this (loss) symbol w.r.t. ``wrt`` args.
+
+        Reference parity: ``Symbol.gradient`` (python/mxnet/symbol/
+        symbol.py:1790) — whose backend hook ``MXSymbolGrad`` the reference
+        never implemented.  Here it returns a real Symbol: one graph node
+        that purely evaluates this graph and differentiates it with
+        ``jax.grad``; outputs follow ``wrt`` order.  Outputs of this symbol
+        are summed into the scalar that is differentiated (loss-symbol
+        contract from the reference docstring)."""
+        from . import grad_op  # noqa: F401  (registers _graph_grad)
+
+        if isinstance(wrt, str):
+            wrt = [wrt]
+        wrt = list(wrt)
+        var_names = self.list_arguments() + self.list_auxiliary_states()
+        missing = [w for w in wrt if w not in var_names]
+        if missing:
+            raise ValueError("gradient wrt unknown arguments: %s (have %s)"
+                             % (missing, var_names))
+        inputs = [Variable(n) for n in var_names]
+        return _apply("_graph_grad", inputs,
+                      {"graph_json": self.tojson(),
+                       "wrt": tuple(wrt),
+                       "var_names": tuple(var_names)},
+                      name=None)
 
     # -- arithmetic -----------------------------------------------------
     def _binop(self, other, op_name, scalar_op, rscalar_op=None, rev=False):
@@ -445,13 +467,42 @@ def load(fname):
         return load_json(f.read())
 
 
+def _parse_legacy_attr(value):
+    """Decode one reference-JSON attribute string.
+
+    The reference serializes every attr as an MXNet string — ``"(1, 1)"``,
+    ``"64"``, ``"True"``, ``"relu"`` (``src/nnvm/legacy_json_util.cc``); a
+    Python literal parse recovers the typed value, anything else stays a
+    string (op kwargs accept both for enums like ``act_type``)."""
+    import ast
+
+    if not isinstance(value, str):
+        return value
+    try:
+        return ast.literal_eval(value)
+    except (ValueError, SyntaxError):
+        return value
+
+
 def load_json(json_str):
-    """Rebuild a Symbol from graph JSON (inverse of tojson)."""
+    """Rebuild a Symbol from graph JSON (inverse of tojson).
+
+    Accepts both this framework's JSON (attrs are json-encoded; marked by
+    ``attrs.mxnet_tpu_format``) and the reference's nnvm JSON
+    (``src/nnvm/legacy_json_util.cc``): node attrs under ``attrs``/``attr``/
+    ``param`` as MXNet strings, 2- or 3-element input/head entries."""
     g = json.loads(json_str)
+    native = "mxnet_tpu_format" in g.get("attrs", {})
     nodes = []
     for entry in g["nodes"]:
-        attrs = {k: json.loads(v) for k, v in entry.get("attrs", {}).items()}
-        inputs = [(nodes[nid], oi) for nid, oi, _ in entry.get("inputs", [])]
+        raw = (entry.get("attrs") or entry.get("attr")
+               or entry.get("param") or {})
+        if native:
+            attrs = {k: json.loads(v) for k, v in raw.items()}
+        else:
+            attrs = {k: _parse_legacy_attr(v) for k, v in raw.items()}
+        inputs = [(nodes[e[0]], e[1])
+                  for e in entry.get("inputs", [])]
         if entry["op"] == "null":
             node = _Node(None, entry["name"],
                          shape_hint=tuple(entry["shape_hint"])
@@ -461,7 +512,7 @@ def load_json(json_str):
             node = _Node(get_op(entry["op"]), entry["name"], inputs, attrs,
                          user_attrs=entry.get("user_attrs"))
         nodes.append(node)
-    heads = [(nodes[nid], oi) for nid, oi, _ in g["heads"]]
+    heads = [(nodes[e[0]], e[1]) for e in g["heads"]]
     return Symbol(heads)
 
 
