@@ -140,6 +140,28 @@ def isinf(data):
 # -- registry-backed contrib ops -------------------------------------------
 # Expose every `_contrib_*` registry op under its short name, mirroring the
 # reference's codegen of mx.nd.contrib.* from the C op registry.
+def boolean_mask(data, index, axis=0):
+    """Select slices of ``data`` along ``axis`` where ``index != 0``
+    (reference src/operator/contrib/boolean_mask.cc).
+
+    The output shape is data-dependent, so the mask is resolved on the
+    host (eager only); the selection itself is a ``take``, which keeps
+    the gradient path — grads scatter back to the selected rows, zeros
+    elsewhere, matching BooleanMaskBackward."""
+    import numpy as np
+
+    from . import array
+    from .ndarray import NDArray
+
+    idx_np = np.flatnonzero(
+        index.asnumpy() if isinstance(index, NDArray)
+        else np.asarray(index))
+    from ..ops.registry import invoke
+
+    idx = array(idx_np.astype(np.int32))
+    return invoke("take", [data, idx], {"axis": axis, "mode": "clip"})
+
+
 def _attach_registry_ops():
     import sys
 

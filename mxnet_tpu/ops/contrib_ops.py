@@ -261,13 +261,15 @@ def _bilinear_resize(data, height=1, width=1, scale_height=None,
 @register("_contrib_boolean_mask", aliases=("boolean_mask",),
           cacheable=False, no_grad=True)
 def _boolean_mask(data, index, axis=0):
-    """Select rows where index != 0.  Output shape is data-dependent, so
-    this op is host-evaluated (XLA needs static shapes — the documented
-    dynamic-shape hard part, SURVEY.md §7(a))."""
+    """Select slices where index != 0.  Output shape is data-dependent, so
+    the mask resolves on the host (XLA needs static shapes — the
+    documented dynamic-shape hard part, SURVEY.md §7(a)); the gather is
+    the same take the differentiable frontend path
+    (``nd.contrib.boolean_mask``) records on the tape."""
     import numpy as np
 
-    mask = np.asarray(index) != 0
-    return jnp.asarray(np.compress(mask, np.asarray(data), axis=axis))
+    idx = jnp.asarray(np.flatnonzero(np.asarray(index)), jnp.int32)
+    return jnp.take(data, idx, axis=axis)
 
 
 @register("_contrib_index_copy", aliases=("index_copy",),

@@ -568,15 +568,6 @@ def _where(cond, x, y):
     return jnp.where(cond != 0, x, y)
 
 
-@register("boolean_mask", no_grad=True)
-def _boolean_mask(data, index, axis=0):
-    # dynamic-shape op: falls back to host (documented scope cut; XLA needs
-    # static shapes — reference src/operator/contrib/boolean_mask.cc)
-    raise NotImplementedError(
-        "boolean_mask has data-dependent shape; use `where` + reduction "
-        "or host-side numpy")
-
-
 # ---------------------------------------------------------------------------
 # init-like
 # ---------------------------------------------------------------------------

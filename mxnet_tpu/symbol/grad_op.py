@@ -1,0 +1,81 @@
+"""Symbolic gradient: the kernel behind ``Symbol.gradient``.
+
+Reference parity: ``Symbol.gradient`` (python/mxnet/symbol/symbol.py:1790)
+backed by ``MXSymbolGrad`` — which the reference backend never implemented
+(it aborts).  Here the capability is real: the gradient symbol is one graph
+node whose kernel purely evaluates the captured subgraph and differentiates
+it with ``jax.grad``, so the result composes, jits, and can itself be
+differentiated (higher-order via jax).
+
+The captured graph travels as its canonical JSON (a static param), so the
+jit cache keys on it; evaluation follows Executor._graph_fn's walk.
+"""
+from __future__ import annotations
+
+import jax
+import threading
+
+from ..ops.registry import register
+
+_SYM_CACHE: dict = {}
+_SYM_LOCK = threading.Lock()
+
+
+def _cached_symbol(graph_json):
+    with _SYM_LOCK:
+        sym = _SYM_CACHE.get(graph_json)
+        if sym is None:
+            from .symbol import load_json
+
+            sym = _SYM_CACHE[graph_json] = load_json(graph_json)
+        return sym
+
+
+def _pure_eval(sym, val_by_name, rng, train):
+    """Evaluate the graph as a pure jax function (Executor._graph_fn's
+    walk, minus device placement and aux write-back — gradients never
+    mutate state)."""
+    topo = sym._topo()
+    rng_ops = [n for n in topo if not n.is_var and n.op.needs_rng]
+    keys = list(jax.random.split(rng, len(rng_ops))) if rng_ops else []
+    ki = 0
+    env = {}
+    for node in topo:
+        if node.is_var:
+            env[id(node)] = (val_by_name[node.name],)
+            continue
+        ins = [env[id(src)][oi] for src, oi in node.inputs]
+        f = node.op.bind(dict(node.attrs), train)
+        if node.op.needs_rng:
+            res = f(keys[ki], *ins)
+            ki += 1
+        else:
+            res = f(*ins)
+        env[id(node)] = tuple(res) if isinstance(res, (tuple, list)) \
+            else (res,)
+    return tuple(env[id(n)][oi] for n, oi in sym._outputs)
+
+
+@register("_graph_grad", needs_rng=True, train_aware=True,
+          visible_out=lambda attrs: list(range(len(attrs["wrt"]))))
+def _graph_grad(rng, *vals, graph_json=None, wrt=(), var_names=(),
+                _train=False):
+    sym = _cached_symbol(graph_json)
+    var_names = list(var_names)
+    wrt = list(wrt)
+    wrt_pos = [var_names.index(w) for w in wrt]
+
+    def scalar_loss(wrt_vals):
+        full = list(vals)
+        for p, v in zip(wrt_pos, wrt_vals):
+            full[p] = v
+        outs = _pure_eval(sym, dict(zip(var_names, full)), rng, _train)
+        # loss-symbol contract (reference docstring: "can only be used if
+        # current symbol is a loss function"): reduce outputs by summation
+        total = 0.0
+        for o in outs:
+            total = total + o.sum()
+        return total
+
+    grads = jax.grad(scalar_loss)([vals[p] for p in wrt_pos])
+    return tuple(grads)
