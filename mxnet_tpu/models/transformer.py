@@ -197,7 +197,7 @@ class TransformerLM:
         return nll + self.cfg.moe_aux_weight * aux
 
 
-def make_train_step(model: TransformerLM, lr=1e-2, momentum=0.9):
+def make_train_step(model: TransformerLM, lr=1e-2, momentum=0.9, rules=None):
     """Build a jittable SGD-momentum train step:
     (params, velocity, tokens, targets) -> (params, velocity, loss).
 
@@ -206,14 +206,29 @@ def make_train_step(model: TransformerLM, lr=1e-2, momentum=0.9):
     tp, ring ppermutes for sp) — the TPU-native replacement for the
     reference's kvstore push/pull training loop (`gluon/trainer.py:302`,
     `kvstore_dist.h`).
+
+    With ``rules`` (a :class:`ShardingRules`), the updated params AND the
+    momentum state are constrained to the same per-name shardings — on a
+    mesh with an ``fsdp`` axis this is ZeRO-style sharded optimizer state
+    (SURVEY §2.4): each device stores only its 1/fsdp slice of every
+    parameter and its velocity, and XLA keeps the update math local to the
+    shard.
     """
+    from ..parallel.sharding import constraint
+
+    def pin(tree):
+        if rules is None:
+            return tree
+        return {k: constraint(v, *rules.spec_for(k))
+                for k, v in tree.items()}
 
     def step(params, velocity, tokens, targets):
         loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
-        new_v = jax.tree_util.tree_map(
-            lambda v, g: momentum * v + g.astype(v.dtype), velocity, grads)
-        new_p = jax.tree_util.tree_map(
-            lambda p, v: p - lr * v.astype(p.dtype), params, new_v)
+        grads = pin(grads)
+        new_v = pin(jax.tree_util.tree_map(
+            lambda v, g: momentum * v + g.astype(v.dtype), velocity, grads))
+        new_p = pin(jax.tree_util.tree_map(
+            lambda p, v: p - lr * v.astype(p.dtype), params, new_v))
         return new_p, new_v, loss
 
     return step
