@@ -1,0 +1,179 @@
+"""Barrier-free async parameter server: the ``dist_async`` backend.
+
+Reference parity: ``kvstore_dist_server.h:346-348`` — in async mode the
+server applies each worker's push to the stored weights IMMEDIATELY (per
+push, no all-worker aggregation barrier) and pulls return whatever state
+the server currently has; ``kvstore.cc:55-57`` documents the mode.
+
+TPU-native placement: synchronous ``dist_sync`` rides XLA collectives
+(everything is SPMD, see ``kvstore.py``), but async semantics are
+*host-side by nature* — there is no barrier, so there is no collective.
+The server is a thread in worker 0's process serving a length-prefixed
+pickle protocol over TCP (DCN); workers exchange the server address
+through the jax.distributed coordination KV, so no extra configuration is
+needed beyond the launcher's env.
+
+Protocol: request = (op, key, payload); reply = (ok, payload).
+  op ∈ {"init", "push", "pull", "set_optimizer"}
+* ``init``  — store-if-absent (all workers init identically; first wins).
+* ``push``  — if the server has an optimizer: ``updater(key, grad,
+  stored)`` in-place, per push (the async apply). Otherwise: assign, the
+  same no-updater semantics the local store has.
+* ``pull``  — returns the current stored value, never waits for anyone.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+_KV_KEY = "mxtpu/async_server_addr"
+
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(blob)) + blob)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _Handler)
+        self.store: dict = {}
+        self.updater = None
+        self.lock = threading.Lock()
+        self._str_idx: dict = {}
+
+    def key_index(self, key):
+        """Same int-index convention the worker-side store uses for
+        per-key optimizer state."""
+        if isinstance(key, int):
+            return key
+        if key not in self._str_idx:
+            self._str_idx[key] = len(self._str_idx)
+        return self._str_idx[key]
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: _Server = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                op, key, payload = _recv_msg(self.request)
+                with srv.lock:
+                    if op == "init":
+                        if key not in srv.store:
+                            srv.store[key] = np.array(payload)
+                        reply = None
+                    elif op == "push":
+                        grad = np.asarray(payload)
+                        cur = srv.store.get(key)
+                        if cur is None:
+                            reply = KeyError(key)
+                        elif srv.updater is not None:
+                            # per-push apply — THE async semantics: no
+                            # waiting for other workers' contributions
+                            srv.updater(key, grad, cur)
+                            reply = None
+                        else:
+                            # without a server-side optimizer there is no
+                            # meaningful async aggregation (the reference
+                            # requires update_on_kvstore in async mode)
+                            reply = RuntimeError(
+                                "dist_async push before set_optimizer: "
+                                "async mode requires the optimizer to run "
+                                "on the kvstore (update_on_kvstore=True)")
+                    elif op == "pull":
+                        cur = srv.store.get(key)
+                        reply = KeyError(key) if cur is None \
+                            else cur.copy()
+                    elif op == "set_optimizer":
+                        from . import optimizer as opt
+
+                        optimizer = pickle.loads(payload)
+                        updater = opt.get_updater(optimizer)
+
+                        def np_updater(k, g, stored, _u=updater,
+                                       _srv=srv):
+                            from .ndarray import array
+
+                            w = array(stored)
+                            _u(_srv.key_index(k), array(g), w)
+                            stored[...] = w.asnumpy()
+
+                        srv.updater = np_updater
+                        reply = None
+                    else:
+                        reply = ValueError("unknown op %r" % (op,))
+                _send_msg(self.request, reply)
+        except (ConnectionError, EOFError):
+            pass
+
+
+class AsyncKVClient:
+    """Worker-side handle; worker 0 also hosts the server thread."""
+
+    def __init__(self):
+        import jax
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        assert client is not None, \
+            "dist_async needs jax.distributed (use tools/launch.py)"
+        self._server = None
+        if jax.process_index() == 0:
+            self._server = _Server(("0.0.0.0", 0))
+            port = self._server.server_address[1]
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+            host = distributed.global_state.coordinator_address.split(":")[0]
+            client.key_value_set(_KV_KEY, "%s:%d" % (host, port))
+            addr = "%s:%d" % (host, port)
+        else:
+            addr = client.blocking_key_value_get(_KV_KEY, 60_000)
+        h, p = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((h, int(p)), timeout=60)
+        self._lock = threading.Lock()
+
+    def _call(self, op, key, payload=None):
+        with self._lock:
+            _send_msg(self._sock, (op, key, payload))
+            reply = _recv_msg(self._sock)
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+    def init(self, key, value_np):
+        self._call("init", key, value_np)
+
+    def push(self, key, grad_np):
+        self._call("push", key, grad_np)
+
+    def pull(self, key):
+        return self._call("pull", key)
+
+    def set_optimizer(self, pickled_optimizer):
+        self._call("set_optimizer", key=None, payload=pickled_optimizer)

@@ -75,15 +75,25 @@ class Trainer:
         config = self._kvstore_params
         kv = config["kvstore"]
         if isinstance(kv, str):
-            if kv and any(p.list_ctx() and len(p.list_ctx()) > 1
-                          for p in self._params):
+            # dist stores matter even with one local device per worker
+            # (cross-process reduce); local stores only with >1 device
+            if kv and (kv.startswith("dist")
+                       or any(p.list_ctx() and len(p.list_ctx()) > 1
+                              for p in self._params)):
                 kv = kvs.create(kv)
             else:
                 kv = None
         self._kvstore = kv
+        update_on_kvstore = config["update_on_kvstore"]
+        if kv is not None and kv.type == "dist_async":
+            # async semantics are defined by per-push server-side apply;
+            # reference trainer.py raises for update_on_kvstore=False too
+            if update_on_kvstore is False:
+                raise ValueError(
+                    "Please set update_on_kvstore=True for dist_async")
+            update_on_kvstore = True
         self._update_on_kvstore = bool(
-            config["update_on_kvstore"]) if config["update_on_kvstore"] \
-            is not None else False
+            update_on_kvstore) if update_on_kvstore is not None else False
         if self._kvstore is not None:
             for i, param in enumerate(self._params):
                 self._kvstore.init(i, param.list_data()[0])

@@ -46,6 +46,13 @@ class KVStore:
         self._update_on_kvstore_flag = False
         self._compression_params = None
         self._str_key_dict = {}
+        self._async = None
+        if kv_type == "dist_async" and self.num_workers > 1:
+            # barrier-free per-push apply on a host-side parameter server
+            # (reference kvstore_dist_server.h:346-348 async mode)
+            from .async_kv import AsyncKVClient
+
+            self._async = AsyncKVClient()
 
     # -- identity ---------------------------------------------------------
     @property
@@ -79,6 +86,8 @@ class KVStore:
             return
         value = value if isinstance(value, NDArray) else value[0]
         self._data[key] = value.copy()
+        if self._async is not None:
+            self._async.init(key, value.asnumpy())
 
     # -- push / pull ------------------------------------------------------
     def push(self, key, value, priority=0):
@@ -92,6 +101,20 @@ class KVStore:
             value = [value]
         assert key in self._data, \
             "please init \"%s\" before push" % str(key)
+        if self._async is not None:
+            # async: reduce THIS worker's device copies only, ship to the
+            # server, return without any cross-worker wait
+            if not self._update_on_kvstore_flag:
+                raise RuntimeError(
+                    "dist_async requires the optimizer to run on the "
+                    "kvstore: call set_optimizer(...) before push "
+                    "(update_on_kvstore=True; reference kvstore.cc:55-57 "
+                    "async semantics are defined per-push on the server)")
+            local = self._local_sum(value)
+            if self._compression_params is not None:
+                local = self._compress_decompress(key, local)
+            self._async.push(key, local.asnumpy())
+            return
         reduced = self._reduce(value)
         if self._compression_params is not None:
             reduced = self._compress_decompress(key, reduced)
@@ -111,7 +134,13 @@ class KVStore:
         assert key in self._data, \
             "please init \"%s\" before pull" % str(key)
         outs = out if isinstance(out, (list, tuple)) else [out]
-        src = self._data[key]
+        if self._async is not None:
+            # whatever the server has *right now* — no barrier
+            src = nd.array(self._async.pull(key),
+                           dtype=self._data[key].dtype)
+            self._data[key]._set_data(src.data)
+        else:
+            src = self._data[key]
         for o in outs:
             o._set_data(src.as_in_context(o.context).data)
 
@@ -132,6 +161,11 @@ class KVStore:
             return
         outs = out if isinstance(out, (list, tuple)) else [out]
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if self._async is not None:
+            # refresh from the server first — async state lives there
+            self._data[key]._set_data(
+                nd.array(self._async.pull(key),
+                         dtype=self._data[key].dtype).data)
         src = self._data[key]
         for o, r in zip(outs, rids):
             rows = nd.take(src, r.astype("int32"))
@@ -141,16 +175,19 @@ class KVStore:
             o._set_data(full.data)
 
     # -- reduce -----------------------------------------------------------
+    def _local_sum(self, values):
+        if len(values) == 1:
+            return values[0].copy()
+        ctx0 = values[0].context
+        total = values[0].as_in_context(ctx0).copy()
+        for v in values[1:]:
+            total += v.as_in_context(ctx0)
+        return total
+
     def _reduce(self, values):
         """Sum a list of per-device arrays.  Multi-host dist types add a
         cross-process psum (SPMD collective over ICI/DCN)."""
-        if len(values) == 1:
-            total = values[0].copy()
-        else:
-            ctx0 = values[0].context
-            total = values[0].as_in_context(ctx0).copy()
-            for v in values[1:]:
-                total += v.as_in_context(ctx0)
+        total = self._local_sum(values)
         if self._type.startswith("dist") and self.num_workers > 1:
             total = self._cross_process_sum(total)
         return total
@@ -188,7 +225,21 @@ class KVStore:
         optimizer via pickled controller, kvstore.py set_optimizer)."""
         # round-trip through pickle for reference parity (catches
         # unpicklable optimizers the same way the reference does)
-        optimizer = pickle.loads(pickle.dumps(optimizer))
+        blob = pickle.dumps(optimizer)
+        if self._async is not None:
+            # server-side optimizer, applied per push (async apply);
+            # only rank 0 sends, like the reference's
+            # _send_command_to_servers (kvstore.py set_optimizer)
+            if self.rank == 0:
+                self._async.set_optimizer(blob)
+            self._update_on_kvstore_flag = True
+            # all workers call set_optimizer (SPMD contract, same as the
+            # reference where every worker runs it and rank 0 sends the
+            # command); the barrier guarantees no worker's later push can
+            # reach the server before the updater is installed
+            self._barrier()
+            return
+        optimizer = pickle.loads(blob)
         self._updater = opt.get_updater(optimizer)
         self._update_on_kvstore_flag = True
 

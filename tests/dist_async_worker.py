@@ -1,0 +1,60 @@
+"""dist_async worker, run under ``mxnet_tpu.tools.launch``.
+
+Proves the barrier-free semantics of the async parameter server
+(reference ``kvstore_dist_server.h:346-348``): rank 0 completes a whole
+push→pull cycle repeatedly while every other worker is asleep — a
+collective (sync) path would deadlock there — and pushes apply to the
+server state per-push, so the final value is the order-independent total.
+Invoked by tests/test_dist.py.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main(out_dir):
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 3, "expected 3 workers, got %d" % nw
+    assert kv.type == "dist_async"
+
+    shape = (4,)
+    kv.init("w", mx.nd.zeros(shape))
+    # set_optimizer barriers internally: no worker's push can beat the
+    # updater to the server
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+
+    if rank == 0:
+        # barrier-free: full push+pull cycles while workers 1 and 2 sleep.
+        # Under dist_sync this would hang waiting for their contributions.
+        out = mx.nd.zeros(shape)
+        for i in range(3):
+            kv.push("w", mx.nd.ones(shape))
+            kv.pull("w", out=out)
+            # per-push apply with lr=1: after i+1 pushes of grad=1,
+            # w = -(i+1) — rank 0 sees its own updates immediately
+            np.testing.assert_allclose(out.asnumpy(), -(i + 1.0),
+                                       rtol=0, atol=1e-6)
+    else:
+        time.sleep(1.0)
+        for _ in range(3):
+            kv.push("w", mx.nd.ones(shape))
+
+    kv._barrier()  # all pushes done → total is deterministic
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -(3.0 * nw), rtol=0,
+                               atol=1e-6)
+
+    with open(os.path.join(out_dir, "worker_%d.ok" % rank), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
