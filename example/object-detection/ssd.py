@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Trainable SSD on synthetic scenes (reference: ``example/ssd`` —
+train.py/symbol/legacy_vgg16_ssd_300 scaled down to a zero-egress task).
+
+The real SSD machinery end to end:
+* anchors from ``MultiBoxPrior`` at two feature scales,
+* training targets (greedy bipartite match + hard-negative mining) from
+  ``MultiBoxTarget``,
+* softmax CE on mined classes + smooth-L1 on encoded offsets,
+* inference decode + per-class NMS via ``MultiBoxDetection``,
+* a small mAP-style matched-detection metric.
+
+Synthetic scenes are colored rectangles on noise; class = color.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+S = 64            # image size
+NUM_CLASSES = 3   # foreground classes (colors)
+MAX_OBJ = 2
+
+SIZES = [[0.25, 0.35], [0.55, 0.7]]   # anchor sizes per feature scale
+RATIOS = [[1.0, 2.0, 0.5]] * 2
+
+
+def synthetic_scene(rng, n):
+    """RGB noise + up to MAX_OBJ colored rectangles.  Labels [n, MAX_OBJ, 5]
+    rows of (class, xmin, ymin, xmax, ymax), -1 padded."""
+    imgs = rng.normal(0, 0.08, (n, 3, S, S)).astype(np.float32)
+    labels = np.full((n, MAX_OBJ, 5), -1.0, np.float32)
+    for i in range(n):
+        for j in range(rng.randint(1, MAX_OBJ + 1)):
+            cls = rng.randint(0, NUM_CLASSES)
+            w, h = rng.randint(14, 30, 2)
+            x0 = rng.randint(0, S - w)
+            y0 = rng.randint(0, S - h)
+            imgs[i, cls, y0:y0 + h, x0:x0 + w] += 1.0
+            labels[i, j] = (cls, x0 / S, y0 / S, (x0 + w) / S,
+                            (y0 + h) / S)
+    return imgs, labels
+
+
+class SSDNet(gluon.nn.HybridBlock):
+    """Tiny SSD: shared conv trunk, two detection scales with per-scale
+    class + box heads (reference symbol/symbol_builder.py shape)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        a = len(SIZES[0]) - 1 + len(RATIOS[0])  # anchors per position
+        self.num_anchors_per_pos = a
+        with self.name_scope():
+            self.trunk = gluon.nn.HybridSequential()
+            for ch in (16, 32):
+                self.trunk.add(gluon.nn.Conv2D(ch, 3, padding=1),
+                               gluon.nn.Activation("relu"),
+                               gluon.nn.MaxPool2D(2))
+            self.scale1 = gluon.nn.HybridSequential()  # 16x16
+            self.scale1.add(gluon.nn.Conv2D(32, 3, padding=1),
+                            gluon.nn.Activation("relu"))
+            self.down = gluon.nn.HybridSequential()    # -> 8x8
+            self.down.add(gluon.nn.Conv2D(32, 3, padding=1),
+                          gluon.nn.Activation("relu"),
+                          gluon.nn.MaxPool2D(2))
+            self.cls_heads = [gluon.nn.Conv2D(a * (NUM_CLASSES + 1), 3,
+                                              padding=1, prefix="cls%d_" % i)
+                              for i in range(2)]
+            self.box_heads = [gluon.nn.Conv2D(a * 4, 3, padding=1,
+                                              prefix="box%d_" % i)
+                              for i in range(2)]
+            for blk in self.cls_heads + self.box_heads:
+                self.register_child(blk)
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        x = self.trunk(x)
+        x = self.scale1(x)
+        feats.append(x)
+        feats.append(self.down(x))
+        anchors, cls_preds, box_preds = [], [], []
+        for i, feat in enumerate(feats):
+            anchors.append(F.contrib.MultiBoxPrior(
+                feat, sizes=SIZES[i], ratios=RATIOS[i]))
+            c = self.cls_heads[i](feat)          # [B, A*(C+1), H, W]
+            b = self.box_heads[i](feat)          # [B, A*4, H, W]
+            cls_preds.append(
+                F.reshape(F.transpose(c, (0, 2, 3, 1)),
+                          (0, -1, NUM_CLASSES + 1)))
+            box_preds.append(F.reshape(F.transpose(b, (0, 2, 3, 1)),
+                                       (0, -1)))
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+
+def train(epochs=8, batch_size=16, n_train=128, lr=0.2, seed=0,
+          verbose=True):
+    rng = np.random.RandomState(seed)
+    imgs, labels = synthetic_scene(rng, n_train)
+    net = SSDNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss(rho=1.0)
+
+    losses = []
+    for epoch in range(epochs):
+        ep_loss = 0.0
+        for s in range(0, n_train, batch_size):
+            x = mx.nd.array(imgs[s:s + batch_size])
+            y = mx.nd.array(labels[s:s + batch_size])
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                # targets are data, not graph: the reference computes them
+                # from detached predictions too (MultiBoxTarget has no
+                # gradient)
+                loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+                    anchors, y, cls_preds.transpose((0, 2, 1)),
+                    overlap_threshold=0.5, negative_mining_ratio=3,
+                    negative_mining_thresh=0.5)
+                # mask the ignore_label (-1) anchors out of the CE — a raw
+                # -1 index would wrap onto the last class
+                valid = cls_t >= 0
+                n_pos = loc_m.sum() / 4.0 + 1e-6
+                logp = mx.nd.log_softmax(cls_preds, axis=-1)
+                ce = -mx.nd.pick(logp, cls_t * valid, axis=-1)  # [B, N]
+                lc = (ce * valid).sum() / n_pos
+                lb = box_loss(box_preds * loc_m, loc_t * loc_m).sum() \
+                    / n_pos
+                loss = lc + lb
+            loss.backward()
+            trainer.step(x.shape[0])
+            ep_loss += float(loss.mean())
+        losses.append(ep_loss / max(1, n_train // batch_size))
+        if verbose:
+            print("epoch %d loss %.4f" % (epoch, losses[-1]))
+    return net, losses
+
+
+def evaluate(net, seed=99, n=32, iou_thresh=0.5):
+    """Matched-detection metric: fraction of gt boxes recovered by an
+    NMS-survivor of the right class with IoU > 0.5 (recall), plus mean
+    IoU of best matches."""
+    rng = np.random.RandomState(seed)
+    imgs, labels = synthetic_scene(rng, n)
+    x = mx.nd.array(imgs)
+    anchors, cls_preds, box_preds = net(x)
+    cls_prob = mx.nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    dets = mx.nd.contrib.MultiBoxDetection(
+        cls_prob, box_preds, anchors, nms_threshold=0.45,
+        force_suppress=False, nms_topk=50).asnumpy()
+
+    matched, total, ious = 0, 0, []
+    for i in range(n):
+        for row in labels[i]:
+            if row[0] < 0:
+                continue
+            total += 1
+            best = 0.0
+            for d in dets[i]:
+                if d[0] < 0 or d[1] < 0.3:
+                    continue
+                if int(d[0]) != int(row[0]):
+                    continue
+                ix1 = max(d[2], row[1])
+                iy1 = max(d[3], row[2])
+                ix2 = min(d[4], row[3])
+                iy2 = min(d[5], row[4])
+                iw, ih = max(0, ix2 - ix1), max(0, iy2 - iy1)
+                inter = iw * ih
+                union = ((d[4] - d[2]) * (d[5] - d[3])
+                         + (row[3] - row[1]) * (row[4] - row[2]) - inter)
+                best = max(best, inter / union if union > 0 else 0.0)
+            ious.append(best)
+            if best > iou_thresh:
+                matched += 1
+    recall = matched / max(1, total)
+    mean_iou = float(np.mean(ious)) if ious else 0.0
+    return recall, mean_iou
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny run + assertions for CI")
+    args = ap.parse_args()
+    if args.smoke:
+        net, losses = train(epochs=8, n_train=128, verbose=False)
+        assert losses[-1] < losses[0] * 0.6, \
+            "SSD loss did not fall: %s" % losses
+        recall, mean_iou = evaluate(net, n=16)
+        print("SMOKE ssd loss %.3f->%.3f recall %.2f mean_iou %.2f"
+              % (losses[0], losses[-1], recall, mean_iou))
+        assert recall > 0.5, "NMS-ed detections miss gt (recall %.2f)" \
+            % recall
+        assert mean_iou > 0.35, "detections don't overlap gt"
+        print("OK")
+        return
+    net, losses = train(epochs=args.epochs)
+    recall, mean_iou = evaluate(net)
+    print("recall@0.5 %.3f  mean IoU %.3f" % (recall, mean_iou))
+
+
+if __name__ == "__main__":
+    main()

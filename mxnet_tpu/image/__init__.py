@@ -5,5 +5,13 @@ Reference parity: ``python/mxnet/image/`` (pre-Gluon augmenter pipeline)
 """
 from .image import *  # noqa: F401,F403
 from .image import __all__ as _img_all
+from . import detection  # noqa: F401
+from . import detection as det  # noqa: F401  (reference alias mx.image.det)
+from .detection import (  # noqa: F401
+    CreateDetAugmenter, CreateMultiRandCropAugmenter, DetAugmenter,
+    DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    DetRandomSelectAug, ImageDetIter)
 
-__all__ = list(_img_all)
+from .detection import __all__ as _det_all
+
+__all__ = list(_img_all) + list(_det_all)

@@ -1,0 +1,504 @@
+"""Detection image pipeline: label-aware augmenters + ImageDetIter.
+
+Reference parity: ``python/mxnet/image/detection.py`` (DetAugmenter family,
+CreateDetAugmenter, ImageDetIter) over ``src/io/image_det_aug_default.cc`` /
+``iter_image_det_recordio.cc``.  Host-side numpy throughout — augmentation
+is IO-bound preprocessing, the TPU sees one device upload per batch.
+
+Label convention (same as the reference): per-image label is ``[N, 5+]``
+rows of (class_id, xmin, ymin, xmax, ymax, ...), coords normalized to
+[0, 1]; batches pad with -1 rows.  Raw record labels are
+``n k ... [id x1 y1 x2 y2 ...]*`` with an ``n``-wide header and ``k``-wide
+objects."""
+from __future__ import annotations
+
+import json
+import math
+import random as pyrandom
+
+import numpy as np
+
+from .. import io as _io
+from .. import ndarray as nd
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, HueJitterAug, ImageIter, LightingAug,
+                    RandomGrayAug, ResizeAug, _to_np, _wrap, copyMakeBorder,
+                    fixed_crop)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+def _box_areas(boxes):
+    """[N, 4+] corner boxes -> areas (clamped at 0)."""
+    return (np.maximum(0, boxes[:, 2] - boxes[:, 0])
+            * np.maximum(0, boxes[:, 3] - boxes[:, 1]))
+
+
+class DetAugmenter:
+    """Base: a callable ``(image, label) -> (image, label)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = {k: (np.asarray(_to_np(v)).tolist()
+                            if isinstance(v, (np.ndarray, nd.NDArray))
+                            else v)
+                        for k, v in kwargs.items()}
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a label-agnostic classification augmenter into the det
+    pipeline (color jitter, resize, ... leave boxes untouched)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("DetBorrowAug wraps an image Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply one randomly chosen augmenter, or none with ``skip_prob``."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("DetRandomSelectAug takes DetAugmenters")
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob if aug_list else 1
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [a.dumps() for a in self.aug_list]]
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and box x-coords with probability p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _wrap(_to_np(src)[:, ::-1])
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (SSD-style): the crop must cover at least
+    ``min_object_covered`` of some box, stay within ``area_range`` /
+    ``aspect_ratio_range``, and boxes keeping < ``min_eject_coverage`` of
+    their area are ejected.  After ``max_attempts`` failures the image
+    passes through unchanged."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = tuple(aspect_ratio_range)
+        self.area_range = tuple(area_range)
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < self.area_range[0] <= self.area_range[1]
+                        and 0 < self.aspect_ratio_range[0]
+                        <= self.aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        h, w = _to_np(src).shape[:2]
+        crop = self._propose(label, h, w)
+        if crop is not None:
+            x, y, cw, ch, label = crop
+            src = fixed_crop(src, x, y, cw, ch, None)
+        return src, label
+
+    def _covered_enough(self, label, x1, y1, x2, y2, w, h):
+        if (x2 - x1) * (y2 - y1) < 2:
+            return False
+        boxes = label[:, 1:5]
+        areas = _box_areas(boxes)
+        big = areas * w * h > 2
+        if not big.any():
+            return False
+        bb = boxes[big]
+        ix1 = np.maximum(bb[:, 0], x1 / w)
+        iy1 = np.maximum(bb[:, 1], y1 / h)
+        ix2 = np.minimum(bb[:, 2], x2 / w)
+        iy2 = np.minimum(bb[:, 3], y2 / h)
+        inter = np.maximum(0, ix2 - ix1) * np.maximum(0, iy2 - iy1)
+        cov = inter / areas[big]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _crop_labels(self, label, x, y, cw, ch, h, w):
+        """Re-express boxes in crop coords, clip, eject low coverage."""
+        fx, fy = x / w, y / h
+        fw, fh = cw / w, ch / h
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - fx) / fw
+        out[:, (2, 4)] = (out[:, (2, 4)] - fy) / fh
+        out[:, 1:5] = np.clip(out[:, 1:5], 0, 1)
+        cov = _box_areas(out[:, 1:]) * fw * fh / \
+            np.maximum(_box_areas(label[:, 1:]), 1e-12)
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) & \
+            (cov > self.min_eject_coverage)
+        if not keep.any():
+            return None
+        return out[keep]
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            ch = int(round(math.sqrt(min_area / ratio)))
+            max_h = int(round(math.sqrt(max_area / ratio)))
+            if round(max_h * ratio) > width:
+                max_h = int((width + 0.4999999) / ratio)
+            max_h = min(max_h, height)
+            ch = min(ch, max_h)
+            if ch < max_h:
+                ch = pyrandom.randint(ch, max_h)
+            cw = int(round(ch * ratio))
+            area = cw * ch
+            if area < min_area:
+                ch += 1
+                cw = int(round(ch * ratio))
+                area = cw * ch
+            if area > max_area:
+                ch -= 1
+                cw = int(round(ch * ratio))
+                area = cw * ch
+            if not (min_area <= area <= max_area and 0 <= cw <= width
+                    and 0 <= ch <= height):
+                continue
+            y = pyrandom.randint(0, max(0, height - ch))
+            x = pyrandom.randint(0, max(0, width - cw))
+            if self._covered_enough(label, x, y, x + cw, y + ch,
+                                    width, height):
+                new_label = self._crop_labels(label, x, y, cw, ch,
+                                              height, width)
+                if new_label is not None:
+                    return x, y, cw, ch, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand: place the image inside a larger canvas filled with
+    ``pad_val`` (the SSD zoom-out augmentation)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = tuple(pad_val)
+        self.aspect_ratio_range = tuple(aspect_ratio_range)
+        self.area_range = tuple(area_range)
+        self.max_attempts = max_attempts
+        self.enabled = (self.area_range[1] > 1.0
+                        and 0 < self.aspect_ratio_range[0]
+                        <= self.aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        h, w = _to_np(src).shape[:2]
+        pad = self._propose(label, h, w)
+        if pad is not None:
+            x, y, pw, ph, label = pad
+            src = copyMakeBorder(src, y, ph - y - h, x, pw - x - w,
+                                 0, values=self.pad_val)  # constant fill
+        return src, label
+
+    def _pad_labels(self, label, x, y, pw, ph, h, w):
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * w + x) / pw
+        out[:, (2, 4)] = (out[:, (2, 4)] * h + y) / ph
+        return out
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            ph = int(round(math.sqrt(min_area / ratio)))
+            max_h = int(round(math.sqrt(max_area / ratio)))
+            if round(ph * ratio) < width:
+                ph = int((width + 0.499999) / ratio)
+            ph = max(ph, height)
+            max_h = max(max_h, ph)
+            if ph < max_h:
+                ph = pyrandom.randint(ph, max_h)
+            pw = int(round(ph * ratio))
+            if not (height <= ph and width <= pw
+                    and min_area <= pw * ph <= max_area):
+                continue
+            y = pyrandom.randint(0, max(0, ph - height))
+            x = pyrandom.randint(0, max(0, pw - width))
+            return x, y, pw, ph, self._pad_labels(label, x, y, pw, ph,
+                                                  height, width)
+        return None
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """One DetRandomCropAug per aligned parameter combination, wrapped in
+    a random selector (reference: CreateMultiRandCropAugmenter)."""
+    params = [min_object_covered, aspect_ratio_range, area_range,
+              min_eject_coverage, max_attempts]
+    lists = [p if isinstance(p, list) else [p] for p in params]
+    n = max(len(p) for p in lists)
+    lists = [p * n if len(p) == 1 else p for p in lists]
+    for p in lists:
+        assert len(p) == n, "parameter lists must align"
+    augs = [DetRandomCropAug(min_object_covered=a, aspect_ratio_range=b,
+                             area_range=c, min_eject_coverage=d,
+                             max_attempts=e)
+            for a, b, c, d, e in zip(*lists)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Detection augmenter list (reference CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, area_range,
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(aspect_ratio_range,
+                                  (1.0, area_range[1]), max_attempts,
+                                  pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: decode + det augmentation + (B, max_obj, 5+)
+    labels padded with -1 rows (reference ImageDetIter /
+    iter_image_det_recordio.cc)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 part_index=0, num_parts=1, aug_list=None, imglist=None,
+                 data_name="data", label_name="label", **kwargs):
+        det_kwargs = {}
+        for key in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                    "rand_mirror", "mean", "std", "brightness", "contrast",
+                    "saturation", "pca_noise", "hue", "inter_method",
+                    "min_object_covered", "aspect_ratio_range",
+                    "area_range", "min_eject_coverage", "max_attempts",
+                    "pad_val"):
+            if key in kwargs:
+                det_kwargs[key] = kwargs.pop(key)
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[],
+                         imglist=imglist, data_name=data_name,
+                         label_name=label_name, **kwargs)
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **det_kwargs)
+        else:
+            self.auglist = aug_list
+        self.label_shape = self._estimate_label_shape()
+
+    # -- labels ---------------------------------------------------------
+    @staticmethod
+    def _parse_label(label):
+        """Flat raw label -> [N, obj_width] valid rows."""
+        raw = np.asarray(_to_np(label)).ravel()
+        if raw.size < 7:
+            raise RuntimeError("label too short for detection: %d"
+                               % raw.size)
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5 or (raw.size - header_width) % obj_width:
+            raise RuntimeError(
+                "label size %d inconsistent with header %d / object "
+                "width %d" % (raw.size, header_width, obj_width))
+        out = raw[header_width:].reshape(-1, obj_width).astype(np.float32)
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not keep.any():
+            raise RuntimeError("sample with no valid box")
+        return out[keep]
+
+    def _check_valid_label(self, label):
+        if label.ndim != 2 or label.shape[1] < 5:
+            raise RuntimeError("label must be (1+, 5+), got %s"
+                               % (label.shape,))
+        ok = (label[:, 0] >= 0) & (label[:, 3] > label[:, 1]) & \
+            (label[:, 4] > label[:, 2])
+        if not ok.any():
+            raise RuntimeError("no valid box after augmentation")
+
+    def _next_label(self):
+        """Next raw label WITHOUT decoding the image — the estimate pass
+        below must not JPEG-decode the whole dataset (the reference's
+        next_sample returns undecoded bytes for the same reason)."""
+        from .. import recordio
+
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                header, _ = recordio.unpack(self.imgrec.read_idx(idx))
+                return header.label
+            return self.imglist[idx][0]
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        return recordio.unpack(s)[0].label
+
+    def _estimate_label_shape(self):
+        max_count, obj_width = 0, 5
+        self.reset()
+        try:
+            while True:
+                parsed = self._parse_label(self._next_label())
+                max_count = max(max_count, parsed.shape[0])
+                obj_width = parsed.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, obj_width)
+
+    @property
+    def provide_label(self):
+        return [_io.DataDesc(
+            self.label_name,
+            (self.batch_size,) + tuple(self.label_shape), "float32")]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.label_shape = tuple(label_shape)
+
+    def sync_label_shape(self, it, verbose=False):
+        """Align label shapes between train/val iterators (reference
+        ImageDetIter.sync_label_shape)."""
+        assert isinstance(it, ImageDetIter)
+        shape = (max(self.label_shape[0], it.label_shape[0]),
+                 max(self.label_shape[1], it.label_shape[1]))
+        self.label_shape = shape
+        it.label_shape = shape
+        return it
+
+    # -- batching -------------------------------------------------------
+    def next(self):
+        c, h, w = self.data_shape
+        maxn, ow = self.label_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.full((self.batch_size, maxn, ow), -1.0,
+                              np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self.next_sample()
+                try:
+                    label = self._parse_label(raw_label)
+                    for aug in self.auglist:
+                        img, label = aug(img, label)
+                    self._check_valid_label(label)
+                except RuntimeError:
+                    continue  # skip invalid samples like the reference
+                img = _to_np(img)
+                batch_data[i] = img
+                n = min(label.shape[0], maxn)
+                batch_label[i, :n, :label.shape[1]] = label[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        data = nd.array(batch_data.transpose(0, 3, 1, 2))
+        return _io.DataBatch([data], [nd.array(batch_label)], pad=pad)

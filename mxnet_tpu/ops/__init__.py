@@ -12,5 +12,6 @@ from . import image_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import spatial  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import detection  # noqa: F401
 from . import quantization  # noqa: F401
 from . import misc  # noqa: F401

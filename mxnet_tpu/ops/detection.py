@@ -1,0 +1,527 @@
+"""Detection operators: SSD MultiBox*, RCNN Proposal/PSROIPooling,
+deformable convolution.
+
+Reference parity: ``src/operator/contrib/multibox_prior.cc`` /
+``multibox_target.cc`` / ``multibox_detection.cc`` / ``proposal.cc`` /
+``psroi_pooling.cc`` / ``deformable_convolution.cc``.
+
+TPU-native design: the reference runs these as sequential CPU/CUDA loops
+with dynamic counts (bipartite matching while-loops, NMS over a dynamic
+valid set).  Here every op is a static-shape jax program — matching runs
+as a ``lax.fori_loop`` with a compile-time trip count (max #labels) and
+masks standing in for the reference's dynamic early-exits, NMS is the
+O(N²) masked triangular suppression, and invalid slots carry the
+reference's -1 sentinels.  Everything jits, vmaps over the batch, and
+differentiates where the reference defines gradients (deformable conv via
+jax AD through the bilinear sampling; the target/NMS ops are labelled
+no-grad exactly like the reference's Backward-writes-zero)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (contrib/multibox_prior.cc MultiBoxPriorForward)
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          no_grad=True)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    sizes = tuple(float(s) for s in (sizes if hasattr(sizes, "__len__")
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if hasattr(ratios, "__len__")
+                                      else (ratios,)))
+    h, w = int(data.shape[2]), int(data.shape[3])
+    step_y = float(steps[0]) if steps[0] > 0 else 1.0 / h
+    step_x = float(steps[1]) if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[1])) * step_x
+    # anchor shapes at one location: all sizes at ratio 1, then ratios[1:]
+    # at sizes[0] (the reference's "num_sizes - 1 + num_ratios" layout)
+    half_w, half_h = [], []
+    for s in sizes:
+        half_w.append(s * h / w / 2.0)
+        half_h.append(s / 2.0)
+    for r in ratios[1:]:
+        sr = math.sqrt(r)
+        half_w.append(sizes[0] * h / w * sr / 2.0)
+        half_h.append(sizes[0] / sr / 2.0)
+    hw = jnp.asarray(half_w, jnp.float32)  # [A]
+    hh = jnp.asarray(half_h, jnp.float32)
+    CY, CX = jnp.meshgrid(cy, cx, indexing="ij")  # [H, W]
+    CX = CX[:, :, None]
+    CY = CY[:, :, None]
+    boxes = jnp.stack([CX - hw, CY - hh, CX + hw, CY + hh], axis=-1)
+    out = boxes.reshape(1, h * w * hw.shape[0], 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# IoU helper (corner format), broadcasting over trailing box dims
+# ---------------------------------------------------------------------------
+def _pair_iou(a, b):
+    """a: [..., N, 4], b: [..., M, 4] -> [..., N, M]"""
+    ax1, ay1, ax2, ay2 = jnp.split(a[..., :, None, :], 4, axis=-1)
+    bx1, by1, bx2, by2 = jnp.split(b[..., None, :, :], 4, axis=-1)
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = (iw * ih)[..., 0]
+    area_a = ((ax2 - ax1) * (ay2 - ay1))[..., 0]
+    area_b = ((bx2 - bx1) * (by2 - by1))[..., 0]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_loc(anchors, gt, variances):
+    """SSD box encoding (multibox_target.cc AssignLocTargets)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) * 0.5
+    ay = (anchors[..., 1] + anchors[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    eps = 1e-8
+    return jnp.stack([
+        (gx - ax) / (aw + eps) / vx,
+        (gy - ay) / (ah + eps) / vy,
+        jnp.log(jnp.maximum(gw / (aw + eps), eps)) / vw,
+        jnp.log(jnp.maximum(gh / (ah + eps), eps)) / vh,
+    ], axis=-1)
+
+
+def _decode_loc(anchors, pred, variances, clip):
+    """Inverse transform (multibox_detection.cc TransformLocations)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) * 0.5
+    ay = (anchors[..., 1] + anchors[..., 3]) * 0.5
+    ox = pred[..., 0] * vx * aw + ax
+    oy = pred[..., 1] * vy * ah + ay
+    ow = jnp.exp(pred[..., 2] * vw) * aw * 0.5
+    oh = jnp.exp(pred[..., 3] * vh) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (contrib/multibox_target.cc MultiBoxTargetForward)
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          input_names=("anchor", "label", "cls_pred"), no_grad=True,
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→gt matching + target encoding.
+
+    The reference's while-loop greedy bipartite match runs here as a
+    ``fori_loop`` with trip count = #labels (each iteration matches at
+    most one gt, exactly like one while-iteration); its dynamic
+    negative-mining sort becomes a masked ranking."""
+    anchors = anchor.reshape(-1, 4)                      # [N, 4]
+    N = anchors.shape[0]
+    M = label.shape[1]
+    variances = tuple(float(v) for v in variances)
+
+    def one_batch(lab, cls_p):
+        # lab: [M, W] (class, 4 box coords, ...); cls_p: [C, N]
+        gt_valid = lab[:, 0] >= 0                        # [M]
+        gt_boxes = lab[:, 1:5]
+        iou = _pair_iou(anchors, gt_boxes)               # [N, M]
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+        # ---- stage 1: greedy bipartite (gt-first) matching ------------
+        def body(_, carry):
+            a_matched, g_matched, match_gt, match_iou = carry
+            masked = jnp.where(a_matched[:, None] | g_matched[None, :],
+                               -1.0, iou)
+            flat = jnp.argmax(masked)
+            bi, bk = flat // M, flat % M
+            best = masked[bi, bk]
+            ok = best > 1e-6
+            a_matched = a_matched.at[bi].set(a_matched[bi] | ok)
+            g_matched = g_matched.at[bk].set(g_matched[bk] | ok)
+            match_gt = match_gt.at[bi].set(
+                jnp.where(ok, bk, match_gt[bi]))
+            match_iou = match_iou.at[bi].set(
+                jnp.where(ok, best, match_iou[bi]))
+            return a_matched, g_matched, match_gt, match_iou
+
+        carry = (jnp.zeros(N, bool), jnp.zeros(M, bool),
+                 jnp.full(N, -1, jnp.int32), jnp.full(N, -1.0))
+        a_pos, _, match_gt, match_iou = lax.fori_loop(0, M, body, carry)
+
+        # ---- stage 2: threshold matching for the rest -----------------
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # [N]
+        best_iou = jnp.max(iou, axis=1)
+        thr_pos = (~a_pos) & (best_iou > overlap_threshold) \
+            & (overlap_threshold > 0)
+        match_gt = jnp.where(a_pos, match_gt, best_gt)
+        match_iou = jnp.where(a_pos, match_iou, best_iou)
+        positive = a_pos | thr_pos
+        num_pos = positive.sum()
+
+        # ---- stage 3: negatives (mined or all) ------------------------
+        if negative_mining_ratio > 0:
+            # hardest negatives = highest max-class prob ⇒ lowest
+            # background prob (the reference sorts by -p(background))
+            logits = cls_p.T                              # [N, C]
+            m = logits.max(axis=1, keepdims=True)
+            prob_bg = jnp.exp(logits[:, 0] - m[:, 0]) / \
+                jnp.exp(logits - m).sum(axis=1)
+            cand = (~positive) & (match_iou < negative_mining_thresh)
+            score = jnp.where(cand, -prob_bg, -jnp.inf)
+            order = jnp.argsort(-score)                   # hardest first
+            rank = jnp.zeros(N, jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            num_neg = jnp.minimum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                N - num_pos)
+            num_neg = jnp.maximum(num_neg,
+                                  int(minimum_negative_samples))
+            negative = cand & (rank < num_neg)
+        else:
+            negative = ~positive
+
+        # ---- targets --------------------------------------------------
+        gt_cls = lab[:, 0][match_gt]                      # [N]
+        cls_target = jnp.where(
+            positive, gt_cls + 1.0,
+            jnp.where(negative, 0.0, float(ignore_label)))
+        gt_box = gt_boxes[match_gt]                       # [N, 4]
+        loc = _encode_loc(anchors, gt_box, variances)
+        loc_target = jnp.where(positive[:, None], loc, 0.0).reshape(-1)
+        loc_mask = jnp.where(positive[:, None],
+                             jnp.ones((N, 4)), 0.0).reshape(-1)
+        return loc_target, loc_mask, cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one_batch)(label, cls_pred)
+    dt = anchor.dtype
+    return loc_t.astype(dt), loc_m.astype(dt), cls_t.astype(dt)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          input_names=("cls_prob", "loc_pred", "anchor"), no_grad=True)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS.  Output [B, N, 6] rows of
+    (class_id, score, xmin, ymin, xmax, ymax); suppressed rows get
+    class_id -1, survivors sorted by score like the reference.
+
+    Only ``background_id=0`` is supported — the reference's kernel also
+    hard-codes class row 0 as background (multibox_detection.cc:113
+    iterates j from 1) despite accepting the parameter; we fail loudly
+    instead of silently mis-scoring."""
+    if int(background_id) != 0:
+        raise NotImplementedError(
+            "MultiBoxDetection: only background_id=0 is supported (the "
+            "reference kernel hard-codes it too)")
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    variances = tuple(float(v) for v in variances)
+
+    def one_batch(cp, lp):
+        # cp: [C, N]; lp: [N*4]
+        scores = cp[1:, :]                               # drop background
+        cid = jnp.argmax(scores, axis=0).astype(jnp.float32)  # [N] (0-based)
+        score = jnp.max(scores, axis=0)
+        keep = score >= threshold
+        cid = jnp.where(keep, cid, -1.0)
+        boxes = _decode_loc(anchors, lp.reshape(N, 4), variances, clip)
+        # sort by score descending (invalid rows sink)
+        order = jnp.argsort(-jnp.where(cid >= 0, score, -jnp.inf))
+        cid, score, boxes = cid[order], score[order], boxes[order]
+        if nms_topk > 0:
+            cid = jnp.where(jnp.arange(N) < nms_topk, cid, -1.0)
+
+        def nms_body(i, cid_cur):
+            me_valid = cid_cur[i] >= 0
+            same = force_suppress | (cid_cur == cid_cur[i])
+            iou = _pair_iou(boxes[i][None, :], boxes)[0]  # [N]
+            kill = me_valid & same & (iou >= nms_threshold) & \
+                (jnp.arange(N) > i) & (cid_cur >= 0)
+            return jnp.where(kill, -1.0, cid_cur)
+
+        if 0 < nms_threshold <= 1:
+            cid = lax.fori_loop(0, N, nms_body, cid)
+        return jnp.concatenate(
+            [cid[:, None], score[:, None], boxes], axis=1)
+
+    B = cls_prob.shape[0]
+    out = jax.vmap(one_batch)(cls_prob, loc_pred.reshape(B, -1))
+    return out.astype(cls_prob.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (contrib/proposal.cc — RPN region proposals)
+# ---------------------------------------------------------------------------
+def _gen_base_anchors(base_size, scales, ratios):
+    """proposal-inl.h GenerateAnchors: ratio enum then scale enum."""
+    px, py = (base_size - 1.0) * 0.5, (base_size - 1.0) * 0.5
+    out = []
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = round(math.sqrt(size))
+        hs = round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            out.append([px - 0.5 * (w - 1), py - 0.5 * (h - 1),
+                        px + 0.5 * (w - 1), py + 0.5 * (h - 1)])
+    return jnp.asarray(out, jnp.float32)
+
+
+@register("_contrib_Proposal", aliases=("Proposal",),
+          input_names=("cls_prob", "bbox_pred", "im_info"), no_grad=True)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals: anchor grid + bbox deltas + clip + min-size filter +
+    top-K + NMS + top-K.  Output [B*post_nms_top_n, 5] rows of
+    (batch_idx, x1, y1, x2, y2); short batches pad with the top box."""
+    B, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    base = _gen_base_anchors(float(feature_stride),
+                             [float(s) for s in scales],
+                             [float(r) for r in ratios])   # [A, 4]
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    SY, SX = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([SX, SY, SX, SY], axis=-1)           # [H, W, 4]
+    anchors = (shift[:, :, None, :] + base[None, None, :, :]
+               ).reshape(-1, 4)                            # [H*W*A, 4]
+
+    def one_batch(cp, bp, info):
+        # cp: [2A, H, W] (bg scores then fg scores); bp: [4A, H, W]
+        fg = cp[A:].transpose(1, 2, 0).reshape(-1)         # [H*W*A]
+        deltas = bp.transpose(1, 2, 0).reshape(H, W, A, 4).reshape(-1, 4)
+        if iou_loss:
+            # proposal-inl.h IoUTransformInv: additive corner offsets
+            x1 = anchors[:, 0] + deltas[:, 0]
+            y1 = anchors[:, 1] + deltas[:, 1]
+            x2 = anchors[:, 2] + deltas[:, 2]
+            y2 = anchors[:, 3] + deltas[:, 3]
+        else:
+            # proposal-inl.h BBoxTransformInv: centers + exp sizes
+            aw = anchors[:, 2] - anchors[:, 0] + 1.0
+            ah = anchors[:, 3] - anchors[:, 1] + 1.0
+            ax = anchors[:, 0] + aw * 0.5
+            ay = anchors[:, 1] + ah * 0.5
+            px = deltas[:, 0] * aw + ax
+            py = deltas[:, 1] * ah + ay
+            pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+            ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+            x1 = px - 0.5 * (pw - 1.0)
+            y1 = py - 0.5 * (ph - 1.0)
+            x2 = px + 0.5 * (pw - 1.0)
+            y2 = py + 0.5 * (ph - 1.0)
+        # clip to image (im_info = (height, width, scale))
+        x1 = jnp.clip(x1, 0, info[1] - 1.0)
+        y1 = jnp.clip(y1, 0, info[0] - 1.0)
+        x2 = jnp.clip(x2, 0, info[1] - 1.0)
+        y2 = jnp.clip(y2, 0, info[0] - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        # min-size filter in input-image scale
+        ms = rpn_min_size * info[2]
+        ok = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+        fg = jnp.where(ok, fg, -jnp.inf)
+        # pre-NMS top-K
+        K = min(int(rpn_pre_nms_top_n), boxes.shape[0])
+        fg_k, idx = lax.top_k(fg, K)
+        boxes_k = boxes[idx]
+
+        def nms_body(i, alive):
+            iou = _pair_iou(boxes_k[i][None, :], boxes_k)[0]
+            kill = alive[i] & (iou > threshold) & (jnp.arange(K) > i)
+            return alive & ~kill
+
+        alive = lax.fori_loop(0, K, nms_body,
+                              fg_k > -jnp.inf)
+        # post-NMS top-K of survivors; slots beyond the survivor count
+        # pad with the best surviving box (suppressed boxes never leak)
+        rank_score = jnp.where(alive, fg_k, -jnp.inf)
+        P = int(rpn_post_nms_top_n)
+        kept_scores, keep = lax.top_k(rank_score, min(P, K))
+        surv = kept_scores > -jnp.inf
+        out_boxes = jnp.where(surv[:, None], boxes_k[keep],
+                              boxes_k[keep[0]][None, :])
+        out_scores = jnp.where(surv, fg_k[keep], 0.0)
+        if P > K:
+            pad = P - K
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.tile(out_boxes[:1], (pad, 1))])
+            out_scores = jnp.concatenate(
+                [out_scores, jnp.zeros(pad)])
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    P = int(rpn_post_nms_top_n)
+    bidx = jnp.repeat(jnp.arange(B, dtype=jnp.float32), P)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(B * P, 4)], axis=1)
+    rois = rois.astype(cls_prob.dtype)
+    if output_score:
+        return rois, scores.reshape(B * P, 1).astype(cls_prob.dtype)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (contrib/psroi_pooling.cc — position-sensitive ROI pool)
+# ---------------------------------------------------------------------------
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
+          input_names=("data", "rois"))
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                   pooled_size=7, group_size=0):
+    """R-FCN position-sensitive average pooling: bin (i,j) of output
+    channel c averages input channel c*g²+i*g+j inside that bin."""
+    g = int(group_size) if group_size else int(pooled_size)
+    p = int(pooled_size)
+    od = int(output_dim)
+    Bc, C, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds the roi to the feature grid
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        img = data[b]                                     # [C, H, W]
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def one_bin(ci, i, j):
+            hstart = jnp.floor(y1 + i * bin_h)
+            hend = jnp.ceil(y1 + (i + 1) * bin_h)
+            wstart = jnp.floor(x1 + j * bin_w)
+            wend = jnp.ceil(x1 + (j + 1) * bin_w)
+            inside = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                      (xs[None, :] >= wstart) & (xs[None, :] < wend) &
+                      (ys[:, None] >= 0) & (ys[:, None] < H) &
+                      (xs[None, :] >= 0) & (xs[None, :] < W))
+            gi = (i * g) // p
+            gj = (j * g) // p
+            chan = ci * g * g + gi * g + gj
+            vals = jnp.where(inside, img[chan], 0.0)
+            cnt = inside.sum()
+            return jnp.where(cnt > 0, vals.sum() / cnt, 0.0)
+
+        ii, jj = jnp.meshgrid(jnp.arange(p), jnp.arange(p), indexing="ij")
+        out = jax.vmap(
+            lambda c: jax.vmap(
+                lambda i, j: one_bin(c, i, j))(ii.ravel(), jj.ravel())
+        )(jnp.arange(od))
+        return out.reshape(od, p, p)
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (contrib/deformable_convolution.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",),
+          input_names=("data", "offset", "weight", "bias"))
+def _deformable_conv(data, offset, weight, bias=None, kernel=(3, 3),
+                     stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                     num_filter=1, num_group=1, num_deformable_group=1,
+                     workspace=1024, no_bias=False, layout="NCHW"):
+    """Deformable conv v1: the im2col sampling grid is displaced by the
+    learned per-position offsets, sampled bilinearly, then the gathered
+    columns hit the MXU as one matmul per group.  Differentiable w.r.t.
+    data, offsets, and weight through jax AD — the reference needed three
+    hand-written CUDA kernels for those gradients
+    (deformable_im2col.cuh); here they are jax.vjp of this function."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    B, C, H, W = data.shape
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    DG = int(num_deformable_group)
+    x = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+
+    # base sampling grid: [OH, OW, kh, kw] in padded coords
+    oy = jnp.arange(OH, dtype=jnp.float32)[:, None, None, None] * sh
+    ox = jnp.arange(OW, dtype=jnp.float32)[None, :, None, None] * sw
+    ky = jnp.arange(kh, dtype=jnp.float32)[None, None, :, None] * dh
+    kx = jnp.arange(kw, dtype=jnp.float32)[None, None, None, :] * dw
+    base_y = jnp.broadcast_to(oy + ky, (OH, OW, kh, kw))
+    base_x = jnp.broadcast_to(ox + kx, (OH, OW, kh, kw))
+
+    # offsets: [B, 2*DG*kh*kw, OH, OW] — (y, x) interleaved per kernel pos
+    off = offset.reshape(B, DG, kh * kw, 2, OH, OW)
+    off_y = off[:, :, :, 0].reshape(B, DG, kh, kw, OH, OW)
+    off_x = off[:, :, :, 1].reshape(B, DG, kh, kw, OH, OW)
+    samp_y = base_y[None, None].transpose(0, 1, 4, 5, 2, 3) + off_y
+    samp_x = base_x[None, None].transpose(0, 1, 4, 5, 2, 3) + off_x
+    # -> [B, DG, kh, kw, OH, OW]
+
+    def bilinear(img, y, x):
+        """img: [Cg, Hp, Wp]; y/x: [...] -> [Cg, ...]"""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, Hp - 1)
+        y1i = jnp.clip(y0i + 1, 0, Hp - 1)
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, Wp - 1)
+        x1i = jnp.clip(x0i + 1, 0, Wp - 1)
+        inb = (y > -1.0) & (y < Hp) & (x > -1.0) & (x < Wp)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+               v10 * wy * (1 - wx) + v11 * wy * wx)
+        return jnp.where(inb, val, 0.0)
+
+    Cg = C // DG
+
+    def per_image(xi, sy, sx):
+        # xi: [C, Hp, Wp]; sy/sx: [DG, kh, kw, OH, OW]
+        def per_dg(img_g, y_g, x_g):
+            return bilinear(img_g, y_g, x_g)  # [Cg, kh, kw, OH, OW]
+
+        cols = jax.vmap(per_dg)(xi.reshape(DG, Cg, Hp, Wp), sy, sx)
+        return cols.reshape(C, kh, kw, OH, OW)
+
+    cols = jax.vmap(per_image)(x, samp_y, samp_x)  # [B, C, kh, kw, OH, OW]
+    w = weight.reshape(int(num_filter), -1)        # [F, C/g*kh*kw]
+    G = int(num_group)
+    F = int(num_filter)
+    cols = cols.reshape(B, G, (C // G) * kh * kw, OH * OW)
+    wg = w.reshape(G, F // G, (C // G) * kh * kw)
+    out = jnp.einsum("bgkp,gfk->bgfp", cols, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(B, F, OH, OW).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1).astype(out.dtype)
+    return out

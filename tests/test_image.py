@@ -268,3 +268,102 @@ def test_nd_image_ops():
     assert rf.shape == x.shape
     rl = mx.nd.image.random_lighting(x, 0.1)
     assert rl.shape == x.shape
+
+
+class TestDetectionPipeline:
+    """Detection augmenters + ImageDetIter (reference
+    python/mxnet/image/detection.py, src/io/iter_image_det_recordio.cc)."""
+
+    def _label(self):
+        return np.array([[0, 0.2, 0.3, 0.6, 0.7],
+                         [1, 0.5, 0.1, 0.9, 0.4]], np.float32)
+
+    def test_det_horizontal_flip(self):
+        from mxnet_tpu.image import DetHorizontalFlipAug
+
+        img = np.arange(4 * 6 * 3, dtype=np.uint8).reshape(4, 6, 3)
+        aug = DetHorizontalFlipAug(p=1.1)  # always flips
+        out, lab = aug(img, self._label())
+        np.testing.assert_array_equal(np.asarray(out)[0, :, 0],
+                                      img[0, ::-1, 0])
+        np.testing.assert_allclose(lab[0, 1:5], [0.4, 0.3, 0.8, 0.7],
+                                   rtol=1e-6)
+        # boxes remain well-formed
+        assert (lab[:, 3] > lab[:, 1]).all()
+
+    def test_det_random_crop_updates_labels(self):
+        import random as pyrandom
+
+        from mxnet_tpu.image import DetRandomCropAug
+
+        pyrandom.seed(0)
+        img = np.zeros((64, 64, 3), np.uint8)
+        aug = DetRandomCropAug(min_object_covered=0.5,
+                               area_range=(0.3, 0.9), max_attempts=200)
+        out, lab = aug(img, self._label())
+        assert lab.shape[1] == 5
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+        assert (lab[:, 3] > lab[:, 1]).all()
+
+    def test_det_random_pad_updates_labels(self):
+        import random as pyrandom
+
+        from mxnet_tpu.image import DetRandomPadAug
+
+        pyrandom.seed(0)
+        img = np.full((32, 32, 3), 200, np.uint8)
+        aug = DetRandomPadAug(area_range=(2.0, 3.0), max_attempts=100,
+                              pad_val=(1, 2, 3))
+        out, lab = aug(img, self._label())
+        out = np.asarray(out)
+        assert out.shape[0] > 32 and out.shape[1] > 32
+        # padded boxes shrink in normalized coords but stay ordered
+        assert (lab[:, 3] > lab[:, 1]).all() and \
+            (lab[:, 4] > lab[:, 2]).all()
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+
+    def test_create_det_augmenter_dumps(self):
+        from mxnet_tpu.image import CreateDetAugmenter
+
+        augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                  rand_pad=0.5, rand_mirror=True,
+                                  mean=True, std=True, brightness=0.1)
+        assert len(augs) >= 5
+        for a in augs:
+            assert a.dumps()  # serializable description
+
+    def test_image_det_iter(self, tmp_path):
+        import cv2
+
+        import mxnet_tpu as mx
+
+        rng = np.random.RandomState(0)
+        imglist = []
+        for i in range(5):
+            img = rng.randint(0, 255, (40, 50, 3), np.uint8)
+            cv2.imwrite(str(tmp_path / ("i%d.jpg" % i)), img)
+            # raw label: 2-wide header, 5-wide objects, i%2+1 objects
+            lab = [2, 5]
+            for j in range(i % 2 + 1):
+                lab += [j, 0.1, 0.2, 0.5 + 0.1 * j, 0.6]
+            imglist.append((lab, "i%d.jpg" % i))
+        it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                                   imglist=imglist,
+                                   path_root=str(tmp_path),
+                                   rand_mirror=True)
+        # label shape estimated from data: max 2 objects, width 5
+        assert it.provide_label[0].shape == (2, 2, 5)
+        batches = list(it)
+        assert len(batches) >= 2
+        lab = batches[0].label[0].asnumpy()
+        assert lab.shape == (2, 2, 5)
+        # -1 padding rows for images with fewer objects
+        assert (lab[:, :, 0] >= -1).all()
+        data = batches[0].data[0]
+        assert data.shape == (2, 3, 24, 24)
+        # sync_label_shape aligns two iterators
+        it2 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                                    imglist=imglist[:2],
+                                    path_root=str(tmp_path))
+        it2 = it.sync_label_shape(it2)
+        assert it2.label_shape == it.label_shape

@@ -553,6 +553,9 @@ TESTED_ELSEWHERE = {
     "linalg_makediag", "linalg_maketrian", "linalg_extracttrian",
     "_contrib_AdaptiveAvgPooling2D", "_contrib_BilinearResize2D",
     "_contrib_ROIAlign", "BilinearSampler", "SpatialTransformer",
+    # detection suite: dedicated value + gradient tests in
+    # tests/test_detection.py
+    "_contrib_DeformableConvolution", "_contrib_PSROIPooling",
 }
 
 
