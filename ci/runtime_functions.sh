@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 
 build_native() {
     make -C native
-    make -C native test_client cpp_example
+    make -C native test_client cpp_example cpp_train
 }
 
 sanity_check() {

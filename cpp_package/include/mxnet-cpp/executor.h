@@ -1,0 +1,83 @@
+/* Executor: bound computation graph with forward/backward.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/executor.h over
+ * MXExecutorBind/Forward/Backward/Outputs; the backend here compiles
+ * the whole graph (fwd+bwd) into one XLA module on first run. */
+#ifndef MXNET_CPP_EXECUTOR_H_
+#define MXNET_CPP_EXECUTOR_H_
+
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/symbol.h"
+
+namespace mxnet {
+namespace cpp {
+
+enum OpReqType { kNullOp = 0, kWriteTo = 1, kAddTo = 2 };
+
+class Executor {
+ public:
+  Executor(const Symbol& symbol, const Context& ctx,
+           const std::vector<NDArray>& in_args,
+           const std::vector<NDArray>& arg_grad_store,
+           const std::vector<OpReqType>& grad_req_type,
+           const std::vector<NDArray>& aux_states)
+      : arg_arrays(in_args), grad_arrays(arg_grad_store),
+        aux_arrays(aux_states) {
+    std::vector<NDArrayHandle> args, grads, auxs;
+    for (const auto& a : in_args) args.push_back(a.handle());
+    for (const auto& g : arg_grad_store)
+      grads.push_back(g.handle());  // default NDArray -> nullptr
+    std::vector<mx_uint> reqs;
+    for (auto r : grad_req_type)
+      reqs.push_back(static_cast<mx_uint>(r));
+    for (const auto& a : aux_states) auxs.push_back(a.handle());
+    Check(MXExecutorBind(symbol.handle(), ctx.dev_type(), ctx.dev_id(),
+                         static_cast<mx_uint>(args.size()), args.data(),
+                         grads.data(), reqs.data(),
+                         static_cast<mx_uint>(auxs.size()), auxs.data(),
+                         &handle_));
+    RefreshOutputs();
+  }
+
+  ~Executor() { MXExecutorFree(handle_); }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+    RefreshOutputs();
+  }
+
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (const auto& g : head_grads) hg.push_back(g.handle());
+    Check(MXExecutorBackward(handle_,
+                             static_cast<mx_uint>(hg.size()),
+                             hg.data()));
+  }
+
+  std::vector<NDArray> outputs;
+  std::vector<NDArray> arg_arrays;
+  std::vector<NDArray> grad_arrays;
+  std::vector<NDArray> aux_arrays;
+
+ private:
+  void RefreshOutputs() {
+    mx_uint n = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXExecutorOutputs(handle_, &n, &outs));
+    outputs.clear();
+    for (mx_uint i = 0; i < n; ++i)
+      outputs.push_back(NDArray::FromHandle(outs[i]));
+  }
+
+  ExecutorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_EXECUTOR_H_

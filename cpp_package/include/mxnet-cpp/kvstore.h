@@ -1,0 +1,60 @@
+/* KVStore: parameter synchronization over the C ABI.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/kvstore.h over the
+ * MXKVStore* functions; collectives here are XLA (single process) or
+ * jax.distributed (multi-worker). */
+#ifndef MXNET_CPP_KVSTORE_H_
+#define MXNET_CPP_KVSTORE_H_
+
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &handle_));
+  }
+  ~KVStore() { MXKVStoreFree(handle_); }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  void Init(int key, const NDArray& val) {
+    NDArrayHandle h = val.handle();
+    Check(MXKVStoreInit(handle_, 1, &key, &h));
+  }
+
+  void Push(int key, const NDArray& val, int priority = 0) {
+    NDArrayHandle h = val.handle();
+    Check(MXKVStorePush(handle_, 1, &key, &h, priority));
+  }
+
+  void Pull(int key, NDArray* out, int priority = 0) {
+    NDArrayHandle h = out->handle();
+    Check(MXKVStorePull(handle_, 1, &key, &h, priority));
+  }
+
+  int GetRank() const {
+    int rank = 0;
+    Check(MXKVStoreGetRank(handle_, &rank));
+    return rank;
+  }
+
+  int GetNumWorkers() const {
+    int size = 0;
+    Check(MXKVStoreGetGroupSize(handle_, &size));
+    return size;
+  }
+
+ private:
+  KVStoreHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_KVSTORE_H_

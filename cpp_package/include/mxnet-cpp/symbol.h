@@ -1,0 +1,157 @@
+/* Symbol: graph composition over the C ABI.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/symbol.h — there Symbol
+ * wraps nnvm handles with codegen'd per-op factories; here any
+ * registered op composes through MXSymbolCreateAtomicSymbol +
+ * MXSymbolCompose (the registry is enumerable via
+ * Operator::ListAllOpNames). */
+#ifndef MXNET_CPP_SYMBOL_H_
+#define MXNET_CPP_SYMBOL_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  /* Compose op(name, inputs..., params).  The one factory every
+   * registered operator shares. */
+  static Symbol Create(const std::string& op_name,
+                       const std::vector<Symbol>& inputs,
+                       const std::string& name = "",
+                       const std::map<std::string, std::string>& params =
+                           {}) {
+    std::vector<const char*> keys, vals;
+    for (const auto& kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(
+        op_name.c_str(), static_cast<mx_uint>(keys.size()), keys.data(),
+        vals.data(), &h));
+    // adopt the handle BEFORE compose so a throwing Check doesn't leak
+    // it (compose updates the handle in place)
+    Symbol result(h);
+    std::vector<SymbolHandle> arg_handles;
+    for (const auto& s : inputs) arg_handles.push_back(s.handle());
+    Check(MXSymbolCompose(h, name.empty() ? nullptr : name.c_str(),
+                          static_cast<mx_uint>(arg_handles.size()),
+                          nullptr, arg_handles.data()));
+    return result;
+  }
+
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXSymbolSaveToJSON(handle(), &js));
+    return std::string(js);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return List(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return List(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return List(&MXSymbolListAuxiliaryStates);
+  }
+
+  /* Infer all argument shapes from the named known ones. */
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>>& known,
+      std::vector<std::vector<mx_uint>>* arg_shapes,
+      std::vector<std::vector<mx_uint>>* out_shapes,
+      std::vector<std::vector<mx_uint>>* aux_shapes) const {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> ind_ptr{0};
+    std::vector<mx_uint> flat;
+    for (const auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) flat.push_back(d);
+      ind_ptr.push_back(static_cast<mx_uint>(flat.size()));
+    }
+    mx_uint sizes[3] = {0, 0, 0};
+    const mx_uint* ndims[3] = {nullptr, nullptr, nullptr};
+    const mx_uint** data[3] = {nullptr, nullptr, nullptr};
+    int complete = 0;
+    Check(MXSymbolInferShape(
+        handle(), static_cast<mx_uint>(keys.size()), keys.data(),
+        ind_ptr.data(), flat.data(), &sizes[0], &ndims[0], &data[0],
+        &sizes[1], &ndims[1], &data[1], &sizes[2], &ndims[2], &data[2],
+        &complete));
+    std::vector<std::vector<mx_uint>>* outs[3] = {arg_shapes, out_shapes,
+                                                  aux_shapes};
+    for (int g = 0; g < 3; ++g) {
+      if (!outs[g]) continue;
+      outs[g]->clear();
+      for (mx_uint i = 0; i < sizes[g]; ++i)
+        outs[g]->emplace_back(data[g][i], data[g][i] + ndims[g][i]);
+    }
+  }
+
+  SymbolHandle handle() const { return blob_ ? blob_->h : nullptr; }
+
+ private:
+  explicit Symbol(SymbolHandle h) : blob_(std::make_shared<Blob>(h)) {}
+
+  std::vector<std::string> List(
+      int (*fn)(SymbolHandle, mx_uint*, const char***)) const {
+    mx_uint n = 0;
+    const char** names = nullptr;
+    Check(fn(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  struct Blob {
+    explicit Blob(SymbolHandle handle) : h(handle) {}
+    ~Blob() { MXSymbolFree(h); }
+    SymbolHandle h;
+  };
+  std::shared_ptr<Blob> blob_;
+};
+
+/* The handful of fluent helpers the examples use; any other op goes
+ * through Symbol::Create directly. */
+inline Symbol FullyConnected(const std::string& name, Symbol data,
+                             Symbol weight, Symbol bias,
+                             int num_hidden) {
+  return Symbol::Create("FullyConnected", {data, weight, bias}, name,
+                        {{"num_hidden", std::to_string(num_hidden)}});
+}
+
+inline Symbol Activation(const std::string& name, Symbol data,
+                         const std::string& act_type) {
+  return Symbol::Create("Activation", {data}, name,
+                        {{"act_type", act_type}});
+}
+
+inline Symbol SoftmaxOutput(const std::string& name, Symbol data,
+                            Symbol label) {
+  return Symbol::Create("SoftmaxOutput", {data, label}, name);
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_SYMBOL_H_

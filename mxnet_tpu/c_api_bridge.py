@@ -129,3 +129,173 @@ def invoke(op_name, inputs, keys, vals):
     params = {k: _parse_value(v) for k, v in zip(keys, vals)}
     out = _registry.invoke(op_name, list(inputs), params)
     return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# ---------------------------------------------------------------------------
+# Symbol ABI (reference src/c_api/c_api_symbolic.cc)
+# ---------------------------------------------------------------------------
+class _PendingSymbol:
+    """MXSymbolCreateAtomicSymbol result: an op + attrs awaiting
+    MXSymbolCompose (the reference mutates the same handle on compose;
+    the native layer swaps the stored PyObject)."""
+
+    def __init__(self, op_name, attrs):
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def symbol_create_variable(name):
+    from .symbol import Variable
+
+    return Variable(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    _registry.get_op(op_name)  # fail fast on unknown ops
+    return _PendingSymbol(op_name,
+                          {k: _parse_value(v) for k, v in zip(keys, vals)})
+
+
+def symbol_compose(sym, name, keys, args):
+    """Compose an atomic symbol with inputs.  ``keys`` names the inputs
+    (may be empty for positional); returns the composed Symbol.
+
+    Reference MXSymbolCompose semantics for the named form: unknown
+    input names are an error, and inputs NOT supplied become free
+    variables named ``<node>_<input>`` (how every reference frontend
+    gets its auto-created ``fc1_weight``/``fc1_bias``)."""
+    from .symbol import Variable, symbol as _sym_mod
+
+    if not isinstance(sym, _PendingSymbol):
+        raise TypeError("MXSymbolCompose target was already composed")
+    args = list(args)
+    if keys:
+        opdef = _registry.get_op(sym.op_name)
+        order = list(opdef.input_names)
+        if not order:
+            raise ValueError(
+                "op %r does not declare input names; compose it "
+                "positionally" % (sym.op_name,))
+        unknown = [k for k in keys if k not in order]
+        if unknown:
+            raise ValueError("unknown input name(s) %s for op %r "
+                             "(inputs: %s)"
+                             % (unknown, sym.op_name, order))
+        by_name = dict(zip(keys, args))
+        node_name = name or _sym_mod._NameManager.get(
+            sym.op_name.lower().lstrip("_"))
+        args = [by_name.get(n) if n in by_name
+                else Variable("%s_%s" % (node_name, n)) for n in order]
+        name = node_name
+    return _sym_mod._apply(sym.op_name, args, sym.attrs,
+                           name=name or None)
+
+
+def symbol_from_json(json_str):
+    from .symbol import load_json
+
+    return load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_infer_shape(sym, keys, ndims, flat_dims):
+    """Flattened-CSR shape marshaling (reference MXSymbolInferShape):
+    keys name the known args, ndims[i] dims each, concatenated in
+    flat_dims.  Returns three (ndims, flat) pairs: args, outputs, aux."""
+    shapes = {}
+    pos = 0
+    for k, nd_ in zip(keys, ndims):
+        shapes[k] = tuple(int(d) for d in flat_dims[pos:pos + nd_])
+        pos += nd_
+    args, outs, auxs = sym.infer_shape_partial(**shapes)
+
+    def flatten(shps):
+        nds, flat = [], []
+        for s in shps:
+            s = s or ()
+            nds.append(len(s))
+            flat.extend(int(d) for d in s)
+        return nds, flat
+
+    return flatten(args) + flatten(outs) + flatten(auxs)
+
+
+# ---------------------------------------------------------------------------
+# Executor ABI (reference src/c_api/c_api_executor.cc)
+# ---------------------------------------------------------------------------
+_GRAD_REQ_FROM_CODE = {0: "null", 1: "write", 2: "add"}  # OpReqType
+
+
+def executor_bind(sym, dev_type, dev_id, args, grads, req_codes, aux):
+    names = sym.list_arguments()
+    if len(args) != len(names):
+        raise ValueError("bind got %d args for %d arguments %s"
+                         % (len(args), len(names), names))
+    reqs = [_GRAD_REQ_FROM_CODE.get(int(c), "null") for c in req_codes]
+    arg_dict = dict(zip(names, args))
+    grad_dict = {n: g for n, g, r in zip(names, grads, reqs)
+                 if g is not None and r != "null"}
+    req_dict = dict(zip(names, reqs))
+    aux_names = sym.list_auxiliary_states()
+    aux_dict = dict(zip(aux_names, aux)) if aux else None
+    return sym.bind(ctx=_ctx(dev_type, dev_id), args=arg_dict,
+                    args_grad=grad_dict or None, grad_req=req_dict,
+                    aux_states=aux_dict)
+
+
+def executor_forward(ex, is_train):
+    # outputs are fetched separately via executor_outputs; building the
+    # handle list here would be paid twice per step
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_backward(ex, out_grads):
+    ex.backward(out_grads=list(out_grads) if out_grads else None)
+
+
+# ---------------------------------------------------------------------------
+# KVStore ABI (reference src/c_api/c_api.cc MXKVStore*)
+# ---------------------------------------------------------------------------
+def kv_create(kv_type):
+    from . import kvstore
+
+    return kvstore.create(kv_type)
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_num_workers(kv):
+    return int(kv.num_workers)

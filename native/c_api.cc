@@ -402,3 +402,438 @@ int MXImperativeInvoke(const char* op_name, int num_inputs,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------
+// Symbol / Executor / KVStore ABI (reference c_api_symbolic.cc,
+// c_api_executor.cc, MXKVStore*).  Handles are PyObject* boxes; the
+// graph/executor logic lives in mxnet_tpu and is reached through the
+// same bridge module as the NDArray plane.
+// ---------------------------------------------------------------------
+
+namespace {
+
+struct PyHandle {
+  PyObject* obj = nullptr;
+};
+
+PyHandle* wrap_py(PyObject* obj) {
+  auto* h = new PyHandle();
+  h->obj = obj;  // steals the reference
+  return h;
+}
+
+// TLS stores for the symbol/executor plane
+struct SymTLS {
+  std::vector<std::string> str_store;
+  std::vector<const char*> cstr_out;
+  std::string json_store;
+  // MXSymbolInferShape backing: three groups of (ndim, flat rows, row
+  // pointers)
+  std::vector<mx_uint> ndims[3];
+  std::vector<std::vector<mx_uint>> rows[3];
+  std::vector<const mx_uint*> row_ptrs[3];
+  std::vector<NDArrayHandle> exec_out;
+};
+SymTLS* sym_tls() {
+  thread_local SymTLS t;
+  return &t;
+}
+
+// call bridge fn with pre-built args tuple; returns new ref or null
+PyObject* call_bridge(const char* name, PyObject* args) {
+  PyObject* fn = bridge_fn(name);
+  if (!fn) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject* str_list(mx_uint n, const char** strs) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(strs ? strs[i] : ""));
+  return lst;
+}
+
+// list of borrowed NDArray objects (NULL handles become None)
+PyObject* nd_list(mx_uint n, NDArrayHandle* arr) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject* a = arr && arr[i]
+        ? static_cast<NDArrayObj*>(arr[i])->array : Py_None;
+    Py_INCREF(a);
+    PyList_SET_ITEM(lst, i, a);
+  }
+  return lst;
+}
+
+int return_str_list(PyObject* r, mx_uint* out_size,
+                    const char*** out_array) {
+  SymTLS* t = sym_tls();
+  t->str_store.clear();
+  t->cstr_out.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = utf8_or_null(PyList_GET_ITEM(r, i));
+    if (!s) {
+      Py_DECREF(r);
+      return fail("non-UTF8 name");
+    }
+    t->str_store.push_back(s);
+  }
+  Py_DECREF(r);
+  for (auto& s : t->str_store) t->cstr_out.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(t->cstr_out.size());
+  *out_array = t->cstr_out.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("symbol_create_variable",
+                            Py_BuildValue("(s)", name));
+  if (!r) return fail_py("create variable failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char* op_name, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(op_name));
+  PyTuple_SET_ITEM(args, 1, str_list(num_param, keys));
+  PyTuple_SET_ITEM(args, 2, str_list(num_param, vals));
+  PyObject* r = call_bridge("symbol_create_atomic", args);
+  if (!r) return fail_py("create atomic symbol failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* sym_args) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* arg_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject* a = static_cast<PyHandle*>(sym_args[i])->obj;
+    Py_INCREF(a);
+    PyList_SET_ITEM(arg_list, i, a);
+  }
+  PyObject* args = PyTuple_New(4);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, name ? PyUnicode_FromString(name)
+                                 : (Py_INCREF(Py_None), Py_None));
+  PyTuple_SET_ITEM(args, 2, str_list(keys ? num_args : 0, keys));
+  PyTuple_SET_ITEM(args, 3, arg_list);
+  PyObject* r = call_bridge("symbol_compose", args);
+  if (!r) return fail_py("compose failed");
+  // reference semantics: compose updates the handle in place
+  Py_DECREF(h->obj);
+  h->obj = r;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("symbol_from_json",
+                            Py_BuildValue("(s)", json));
+  if (!r) return fail_py("symbol from json failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_to_json",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("symbol to json failed");
+  const char* s = utf8_or_null(r);
+  if (!s) {
+    Py_DECREF(r);
+    return fail("non-UTF8 json");
+  }
+  sym_tls()->json_store = s;
+  Py_DECREF(r);
+  *out_json = sym_tls()->json_store.c_str();
+  return 0;
+}
+
+#define MXTPU_SYM_LIST(fn_name, bridge_name)                            \
+  int fn_name(SymbolHandle sym, mx_uint* out_size,                      \
+              const char*** out_array) {                                \
+    ensure_python();                                                    \
+    Gil gil;                                                            \
+    auto* h = static_cast<PyHandle*>(sym);                              \
+    PyObject* r = call_bridge(bridge_name,                              \
+                              Py_BuildValue("(O)", h->obj));            \
+    if (!r) return fail_py(bridge_name " failed");                      \
+    return return_str_list(r, out_size, out_array);                     \
+  }
+
+MXTPU_SYM_LIST(MXSymbolListArguments, "symbol_list_arguments")
+MXTPU_SYM_LIST(MXSymbolListOutputs, "symbol_list_outputs")
+MXTPU_SYM_LIST(MXSymbolListAuxiliaryStates, "symbol_list_aux")
+#undef MXTPU_SYM_LIST
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* key_list = str_list(num_args, keys);
+  PyObject* ndims = PyList_New(num_args);
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  PyObject* flat = PyList_New(total);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(ndims, i, PyLong_FromUnsignedLong(
+        arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
+  for (mx_uint i = 0; i < total; ++i)
+    PyList_SET_ITEM(flat, i, PyLong_FromUnsignedLong(arg_shape_data[i]));
+  PyObject* args = PyTuple_New(4);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, key_list);
+  PyTuple_SET_ITEM(args, 2, ndims);
+  PyTuple_SET_ITEM(args, 3, flat);
+  PyObject* r = call_bridge("symbol_infer_shape", args);
+  if (!r) return fail_py("infer shape failed");
+  // r = (arg_ndims, arg_flat, out_ndims, out_flat, aux_ndims, aux_flat)
+  SymTLS* t = sym_tls();
+  int all_known = 1;
+  mx_uint* sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint** ndim_outs[3] = {in_shape_ndim, out_shape_ndim,
+                                  aux_shape_ndim};
+  const mx_uint*** data_outs[3] = {in_shape_data, out_shape_data,
+                                   aux_shape_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject* nd_l = PyTuple_GetItem(r, 2 * g);
+    PyObject* fl_l = PyTuple_GetItem(r, 2 * g + 1);
+    t->ndims[g].clear();
+    t->rows[g].clear();
+    t->row_ptrs[g].clear();
+    Py_ssize_t n = PyList_Size(nd_l);
+    Py_ssize_t pos = 0;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      mx_uint nd_i = static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyList_GET_ITEM(nd_l, i)));
+      t->ndims[g].push_back(nd_i);
+      std::vector<mx_uint> row;
+      for (mx_uint j = 0; j < nd_i; ++j, ++pos)
+        row.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyList_GET_ITEM(fl_l, pos))));
+      if (nd_i == 0) all_known = 0;
+      t->rows[g].push_back(std::move(row));
+    }
+    for (auto& row : t->rows[g]) t->row_ptrs[g].push_back(row.data());
+    *sizes[g] = static_cast<mx_uint>(t->ndims[g].size());
+    *ndim_outs[g] = t->ndims[g].data();
+    *data_outs[g] = t->row_ptrs[g].data();
+  }
+  Py_DECREF(r);
+  if (complete) *complete = all_known;
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  if (!sym) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   mx_uint num_args, NDArrayHandle* in_args,
+                   NDArrayHandle* arg_grad_store,
+                   const mx_uint* grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* reqs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(reqs, i,
+                    PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyObject* args = PyTuple_New(7);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(args, 3, nd_list(num_args, in_args));
+  PyTuple_SET_ITEM(args, 4, nd_list(num_args, arg_grad_store));
+  PyTuple_SET_ITEM(args, 5, reqs);
+  PyTuple_SET_ITEM(args, 6, nd_list(aux_states_len, aux_states));
+  PyObject* r = call_bridge("executor_bind", args);
+  if (!r) return fail_py("executor bind failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle ex, int is_train) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* r = call_bridge("executor_forward",
+                            Py_BuildValue("(Oi)", h->obj, is_train));
+  if (!r) return fail_py("executor forward failed");
+  Py_DECREF(r);  // outputs re-fetched via MXExecutorOutputs
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle ex, mx_uint len,
+                       NDArrayHandle* head_grads) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, nd_list(len, head_grads));
+  PyObject* r = call_bridge("executor_backward", args);
+  if (!r) return fail_py("executor backward failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle ex, mx_uint* out_size,
+                      NDArrayHandle** out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* r = call_bridge("executor_outputs",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("executor outputs failed");
+  SymTLS* t = sym_tls();
+  t->exec_out.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GET_ITEM(r, i);
+    Py_INCREF(a);
+    t->exec_out.push_back(wrap(a));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(t->exec_out.size());
+  *out = t->exec_out.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle ex) {
+  if (!ex) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("kv_create", Py_BuildValue("(s)", type));
+  if (!r) return fail_py("kvstore create failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+namespace {
+int kv_keyed_call(const char* bridge_name, KVStoreHandle kv, mx_uint num,
+                  const int* keys, NDArrayHandle* vals, int priority,
+                  bool with_priority) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* key_list = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SET_ITEM(key_list, i, PyLong_FromLong(keys[i]));
+  PyObject* args = PyTuple_New(with_priority ? 4 : 3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, key_list);
+  PyTuple_SET_ITEM(args, 2, nd_list(num, vals));
+  if (with_priority)
+    PyTuple_SET_ITEM(args, 3, PyLong_FromLong(priority));
+  PyObject* r = call_bridge(bridge_name, args);
+  if (!r) return fail_py("kvstore call failed");
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int* keys,
+                  NDArrayHandle* vals) {
+  return kv_keyed_call("kv_init", kv, num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  return kv_keyed_call("kv_push", kv, num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  return kv_keyed_call("kv_pull", kv, num, keys, vals, priority, true);
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int* rank) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_rank", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("kv rank failed");
+  *rank = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* size) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_num_workers",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("kv num_workers failed");
+  *size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle kv) {
+  if (!kv) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXNotifyShutdown(void) { return 0; }
+
+}  // extern "C"

@@ -88,6 +88,104 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        NDArrayHandle **outputs, int num_params,
                        const char **param_keys, const char **param_vals);
 
+/* ---------------------------------------------------------------------
+ * Symbol ABI (reference src/c_api/c_api_symbolic.cc).  Graph
+ * composition: atomic symbol + compose, JSON round trip, list
+ * arguments/outputs/aux, shape inference.
+ * ------------------------------------------------------------------ */
+typedef void *SymbolHandle;
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+
+/* An atomic symbol holds an op + stringified hyper-params and must be
+ * composed with inputs before use (MXSymbolCompose, which follows the
+ * reference in updating the handle in place). */
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+
+/* Compose with inputs; keys may be NULL for positional args. */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+/* JSON string owned by the library, valid until the next call on this
+ * thread. */
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+
+/* Name lists owned by the library, valid until the next symbol-list
+ * call on this thread. */
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array);
+
+/* CSR-style shape exchange like the reference: arg_ind_ptr[i] indexes
+ * into arg_shape_data for the i-th known arg; outputs come back in the
+ * same layout (pointers valid until the next call on this thread).
+ * complete is 1 when every returned shape is fully known. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---------------------------------------------------------------------
+ * Executor ABI (reference src/c_api/c_api_executor.cc).  grad_req codes
+ * (OpReqType): 0 = null, 1 = write, 2 = add.
+ * ------------------------------------------------------------------ */
+typedef void *ExecutorHandle;
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   mx_uint num_args, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store,
+                   const mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out);
+
+int MXExecutorForward(ExecutorHandle ex, int is_train);
+
+/* head gradients may be NULL/len 0 for loss heads */
+int MXExecutorBackward(ExecutorHandle ex, mx_uint len,
+                       NDArrayHandle *head_grads);
+
+/* Fresh handles per call (caller frees each with MXNDArrayFree; the
+ * array itself is reused by the next call on this thread). */
+int MXExecutorOutputs(ExecutorHandle ex, mx_uint *out_size,
+                      NDArrayHandle **out);
+
+int MXExecutorFree(ExecutorHandle ex);
+
+/* ---------------------------------------------------------------------
+ * KVStore ABI (reference src/c_api/c_api.cc MXKVStore*).
+ * ------------------------------------------------------------------ */
+typedef void *KVStoreHandle;
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreGetRank(KVStoreHandle kv, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *size);
+int MXKVStoreFree(KVStoreHandle kv);
+
+/* Reference-parity shutdown hook (engine teardown there; no-op here —
+ * XLA teardown happens at process exit). */
+int MXNotifyShutdown(void);
+
 #ifdef __cplusplus
 }
 #endif
