@@ -101,6 +101,13 @@ class Symbol {
         ind_ptr.data(), flat.data(), &sizes[0], &ndims[0], &data[0],
         &sizes[1], &ndims[1], &data[1], &sizes[2], &ndims[2], &data[2],
         &complete));
+    // the reference cpp-package CHECKs completeness here too — callers
+    // index the returned rows, so a partial result must be an error,
+    // not silently-empty vectors
+    if (!complete)
+      throw std::runtime_error(
+          "InferShape incomplete: some argument shapes could not be "
+          "inferred from the provided inputs");
     std::vector<std::vector<mx_uint>>* outs[3] = {arg_shapes, out_shapes,
                                                   aux_shapes};
     for (int g = 0; g < 3; ++g) {

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Per-kernel device profile of a training step (jax.profiler -> HLO
+category breakdown).
+
+The reference ships a per-op profiler (``src/profiler/profiler.cc``,
+``mx.profiler``) that we mirror at op granularity in
+``mxnet_tpu/profiler.py``; this tool goes one level deeper — the XLA
+kernel level — by parsing the chrome trace jax.profiler emits, with
+per-kernel HLO category, achieved FLOP/s, and HBM bytes.  It is how
+docs/PERF_RESNET.md's roofline numbers were produced.
+
+Usage:
+    python tools/profile_train.py [--model resnet50_v1] [--batch 128]
+                                  [--steps 5] [--out /tmp/jaxprof]
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def capture(model_name, batch, steps, outdir, dtype="bfloat16"):
+    import numpy as np
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.tpu() if jax.default_backend() != "cpu" else mx.cpu()
+    net = getattr(vision, model_name)(classes=1000)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize(static_alloc=True, static_shape=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x32 = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32),
+                      ctx=ctx)
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)), ctx=ctx)
+    with mx.autograd.pause():
+        net(x32)
+    if dtype != "float32":
+        net.cast(dtype)
+    x = x32.astype(dtype)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9,
+                             "multi_precision": dtype != "float32"})
+    step = FusedTrainStep(net, loss_fn, trainer)
+    for _ in range(3):
+        loss = step(x, y)
+    loss.asnumpy()
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            loss = step(x, y)
+        loss.asnumpy()
+
+
+def summarize(outdir, steps):
+    traces = sorted(glob.glob(
+        os.path.join(outdir, "plugins/profile/*/*.trace.json.gz")))
+    if not traces:
+        raise SystemExit("no trace found under %s" % outdir)
+    with gzip.open(traces[-1]) as f:
+        tr = json.load(f)
+    dev_pids = {e["pid"] for e in tr["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "device:" in e["args"].get("name", "").lower()}
+    evs = [e for e in tr["traceEvents"]
+           if e.get("ph") == "X" and e.get("pid") in dev_pids
+           and "hlo_category" in e.get("args", {})]
+    by_cat = collections.defaultdict(lambda: [0.0, 0, 0.0, 0.0])
+    for e in evs:
+        a = e["args"]
+        d = by_cat[a["hlo_category"]]
+        d[0] += e["dur"]
+        d[1] += 1
+        d[2] += float(a.get("model_flops", 0) or 0)
+        d[3] += float(a.get("raw_bytes_accessed", 0) or 0)
+    total = sum(d[0] for d in by_cat.values())
+    total_bytes = sum(d[3] for d in by_cat.values())
+    print("device time %.2f ms/step, %.2f GB/step touched"
+          % (total / 1e3 / steps, total_bytes / steps / 1e9))
+    print("%-24s %9s %6s %8s %9s %9s" % (
+        "hlo category", "ms/step", "pct", "kernels", "TFLOP/s", "GB/s"))
+    rows = []
+    for cat, (dur, n, fl, by) in sorted(by_cat.items(),
+                                        key=lambda kv: -kv[1][0]):
+        print("%-24s %9.2f %5.1f%% %8d %9.1f %9.0f"
+              % (cat, dur / 1e3 / steps, 100 * dur / total, n // steps,
+                 fl / (dur * 1e6) if dur else 0,
+                 by / (dur * 1e3) if dur else 0))
+        rows.append({"category": cat, "ms_per_step": dur / 1e3 / steps,
+                     "tflops": fl / (dur * 1e6) if dur else 0,
+                     "gb_s": by / (dur * 1e3) if dur else 0})
+    return {"ms_per_step": total / 1e3 / steps,
+            "gb_per_step": total_bytes / steps / 1e9,
+            "categories": rows}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--out", default="/tmp/jaxprof")
+    ap.add_argument("--summarize-only", action="store_true",
+                    help="parse an existing trace instead of capturing")
+    args = ap.parse_args()
+    if not args.summarize_only:
+        capture(args.model, args.batch, args.steps, args.out, args.dtype)
+    summarize(args.out, args.steps)
+
+
+if __name__ == "__main__":
+    main()
