@@ -92,13 +92,16 @@ def main():
 
     # ---- inference ----
     # chain iterations through a negligible input perturbation so the
-    # remote runtime cannot dedupe identical launches
-    infer_img_s = 0.0
+    # remote runtime cannot dedupe identical launches.  Tunnel load makes
+    # single draws fluctuate up to 2x, so the reported number is the
+    # MEDIAN of >= 5 timed repetitions with the spread published
+    # alongside (VERDICT r2 weak #5).
+    infer_draws = []
     zero = mx.nd.zeros((1,), ctx=ctx).astype(dtype)  # hoisted off the clock
     with mx.autograd.pause(train_mode=False):
         out = net(x)
         host_fetch(out)
-        for _ in range(3):
+        for _ in range(5):
             xi = x
             t0 = time.perf_counter()
             for _ in range(steps):
@@ -106,10 +109,13 @@ def main():
                 xi = xi + out[0, 0] * zero
             host_fetch(out)
             dt = time.perf_counter() - t0
-            infer_img_s = max(infer_img_s, batch * steps / dt)
+            infer_draws.append(batch * steps / dt)
+    infer_img_s = float(np.median(infer_draws))
 
     extra = {
         "inference_img_per_sec": round(infer_img_s, 2),
+        "inference_img_per_sec_spread": [round(min(infer_draws), 2),
+                                         round(max(infer_draws), 2)],
         "inference_vs_v100_fp16": round(
             infer_img_s / INFER_BASELINE_IMG_S, 4),
         "loss_final": float(np.asarray(
@@ -221,12 +227,47 @@ def transformer_bench(batch=8, seq=1024, steps=10):
                    for v in jax.tree_util.tree_leaves(params))
     flops_per_tok = 6 * n_params
     mfu = best * flops_per_tok / 197e12  # v5e bf16 peak
-    return {
+    out = {
         "transformer_train_tokens_per_sec": round(best, 1),
         "transformer_params_m": round(n_params / 1e6, 1),
         "transformer_mfu_vs_v5e_peak": round(mfu, 4),
         "transformer_loss": float(np.asarray(loss, np.float32)),
     }
+    try:
+        out["transformer_kernel_breakdown_ms"] = _kernel_breakdown(
+            step, (params, velocity), (x, y), steps=3)
+    except Exception as e:  # diagnostics must not sink the bench
+        out["transformer_kernel_breakdown_error"] = str(e)
+    return out
+
+
+def _kernel_breakdown(step, state, data, steps=3):
+    """Per-HLO-category device ms/step from a short jax.profiler trace
+    (VERDICT r2 next #6 'publish a per-kernel breakdown in BENCH
+    extras').  State threads through the loop — identical launches can
+    be deduped by the remote runtime (same rule as the timed loops)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.profiler import hlo_category_breakdown
+
+    outdir = tempfile.mkdtemp(prefix="benchprof")
+    try:
+        with jax.profiler.trace(outdir):
+            params, velocity = state
+            for _ in range(steps):
+                params, velocity, loss = step(params, velocity, *data)
+            float(np.asarray(loss))
+        cats = hlo_category_breakdown(outdir, steps=steps)
+    finally:
+        shutil.rmtree(outdir, ignore_errors=True)
+    return {cat: round(d["ms_per_step"], 3)
+            for cat, d in sorted(cats.items(),
+                                 key=lambda kv: -kv[1]["ms_per_step"])
+            if d["ms_per_step"] >= 0.01}
 
 
 if __name__ == "__main__":

@@ -10,8 +10,11 @@ expert parallel MoE (``ep``, `parallel/moe.py`), and a GPipe pipeline variant
 
 Design notes (TPU-first):
 * parameters are a flat ``{name: jax.Array}`` dict; layer stacks use a leading
-  ``L`` dim + ``lax.scan`` over blocks (one compiled block body, fast compiles,
-  remat-friendly) — not L separately-traced python layers;
+  ``L`` dim + ``lax.scan`` over blocks (ONE traced block body, remat-friendly)
+  — not L separately-traced python layers.  By default the scan is UNROLLED
+  at compile time (``scan_unroll=True``: XLA overlaps across layers, measured
+  47.4%→53.7% MFU) at a compile-time cost ~ n_layers; deep configs can set
+  ``scan_unroll=False`` to regain one-body compiles;
 * compute dtype bf16, accumulation f32 (MXU-native);
 * causal LM loss is computed from sharded logits; everything is static-shaped.
 """
@@ -46,6 +49,18 @@ class TransformerConfig:
     n_experts: int = 8
     moe_aux_weight: float = 0.01
     remat: bool = True
+    # Unroll the layer scan: one traced body, unrolled execution — XLA
+    # overlaps/fuses across layers (measured on v5e: 47.4% -> 53.7% MFU
+    # for the d2048x4 flagship; scan bodies ran at ~22 TF/s vs 120-190
+    # for the same kernels unrolled).  Costs compile time ~ n_layers.
+    scan_unroll: bool = True
+    # Small attention problems use plain dense attention (scores
+    # materialize, but the fused matmul+softmax runs at full MXU rate:
+    # measured 60.0% vs 53.7% MFU with the Pallas flash kernel at
+    # B=8/H=16/T=1024); bigger ones switch to flash so memory stays
+    # O(T).  The gate is the f32 score-tensor size B*H*T^2*4 bytes —
+    # gating on T alone would let large batches OOM.
+    dense_attn_max_score_mb: int = 768
 
     @property
     def head_dim(self):
@@ -68,6 +83,26 @@ def default_rules() -> ShardingRules:
         (r"unembed",      P("fsdp", "tp")),
         (r".*",           P()),
     ])
+
+
+def _dense_self_attention(q, k, v, causal=True):
+    """Plain materialized attention for short sequences: on TPU the fused
+    QK^T -> softmax -> PV chain runs at full MXU rate (measured 60% MFU
+    for the flagship at T=1024 vs 53.7% with the flash kernel); memory is
+    O(T^2) so the caller gates it by ``dense_attn_max_t``."""
+    B, T, H, D = q.shape
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / math.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    return o.transpose(0, 2, 1, 3)
 
 
 class TransformerLM:
@@ -131,8 +166,11 @@ class TransformerLM:
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
+        score_mb = B * H * T * T * 4 / 1e6
         if use_ring:
             attn = ring_self_attention(q, k, v, causal=True)
+        elif score_mb <= cfg.dense_attn_max_score_mb:
+            attn = _dense_self_attention(q, k, v, causal=True)
         elif jax.default_backend() == "tpu":
             from ..ops.pallas import flash_self_attention
             attn = flash_self_attention(q, k, v, causal=True)
@@ -175,7 +213,8 @@ class TransformerLM:
             return (x, aux + a), None
 
         body_fn = jax.checkpoint(body) if cfg.remat else body
-        (x, aux), _ = lax.scan(body_fn, (x, jnp.float32(0.0)), stacked)
+        (x, aux), _ = lax.scan(body_fn, (x, jnp.float32(0.0)), stacked,
+                               unroll=bool(cfg.scan_unroll))
 
         x = self._rmsnorm(x, params["final_ln_scale"])
         logits = jnp.einsum("bte,ev->btv", x, params["unembed"],

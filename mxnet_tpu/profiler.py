@@ -332,3 +332,50 @@ class Marker:
         _events.append({"name": "%s::%s" % (self.domain.name, self.name),
                         "cat": "marker", "ph": "i", "ts": _now_us(),
                         "pid": os.getpid(), "s": scope[0]})
+
+
+# ---------------------------------------------------------------------------
+# XLA kernel-level attribution (below the op spans above): parse the
+# chrome trace jax.profiler emits into per-HLO-category device time.
+# Shared by bench.py's published breakdown and tools/profile_train.py.
+# ---------------------------------------------------------------------------
+def device_trace_events(trace_dir):
+    """Device-lane events (with args) from the newest jax.profiler trace
+    under ``trace_dir``."""
+    import glob
+    import gzip
+    import json as _json
+
+    traces = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not traces:
+        raise FileNotFoundError("no jax.profiler trace under %s"
+                                % trace_dir)
+    with gzip.open(traces[-1]) as f:
+        tr = _json.load(f)
+    dev_pids = {e["pid"] for e in tr["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "device:" in e["args"].get("name", "").lower()}
+    return [e for e in tr["traceEvents"]
+            if e.get("ph") == "X" and e.get("pid") in dev_pids
+            and "args" in e]
+
+
+def hlo_category_breakdown(trace_dir, steps=1):
+    """{hlo_category: {ms_per_step, kernels, tflops, gb_s}} from a
+    trace capturing ``steps`` executions."""
+    agg = {}
+    for e in device_trace_events(trace_dir):
+        cat = e["args"].get("hlo_category")
+        if not cat:
+            continue
+        d = agg.setdefault(cat, [0.0, 0, 0.0, 0.0])
+        d[0] += e["dur"]
+        d[1] += 1
+        d[2] += float(e["args"].get("model_flops", 0) or 0)
+        d[3] += float(e["args"].get("raw_bytes_accessed", 0) or 0)
+    return {cat: {"ms_per_step": dur / 1e3 / steps,
+                  "kernels": n // steps,
+                  "tflops": dur and fl / (dur * 1e6) or 0.0,
+                  "gb_s": dur and by / (dur * 1e3) or 0.0}
+            for cat, (dur, n, fl, by) in agg.items()}

@@ -293,3 +293,18 @@ class TestFSDP:
                     assert d0 * fsdp <= v.nbytes * 1.01, (
                         "%s[%s]: device0 has %d of %d bytes — not sharded"
                         % (what, k, d0, v.nbytes))
+
+
+def test_dense_attention_matches_blockwise():
+    """The short-sequence dense-attention path (dense_attn_max_t) must
+    agree with the blockwise/flash implementations it replaces."""
+    from mxnet_tpu.models.transformer import _dense_self_attention
+
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 32, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 4, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 4, 16), jnp.float32)
+    dense = _dense_self_attention(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               rtol=2e-4, atol=2e-4)

@@ -14,10 +14,6 @@ Usage:
                                   [--steps 5] [--out /tmp/jaxprof]
 """
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
 
@@ -61,45 +57,23 @@ def capture(model_name, batch, steps, outdir, dtype="bfloat16"):
 
 
 def summarize(outdir, steps):
-    traces = sorted(glob.glob(
-        os.path.join(outdir, "plugins/profile/*/*.trace.json.gz")))
-    if not traces:
-        raise SystemExit("no trace found under %s" % outdir)
-    with gzip.open(traces[-1]) as f:
-        tr = json.load(f)
-    dev_pids = {e["pid"] for e in tr["traceEvents"]
-                if e.get("ph") == "M" and e.get("name") == "process_name"
-                and "device:" in e["args"].get("name", "").lower()}
-    evs = [e for e in tr["traceEvents"]
-           if e.get("ph") == "X" and e.get("pid") in dev_pids
-           and "hlo_category" in e.get("args", {})]
-    by_cat = collections.defaultdict(lambda: [0.0, 0, 0.0, 0.0])
-    for e in evs:
-        a = e["args"]
-        d = by_cat[a["hlo_category"]]
-        d[0] += e["dur"]
-        d[1] += 1
-        d[2] += float(a.get("model_flops", 0) or 0)
-        d[3] += float(a.get("raw_bytes_accessed", 0) or 0)
-    total = sum(d[0] for d in by_cat.values())
-    total_bytes = sum(d[3] for d in by_cat.values())
+    from mxnet_tpu.profiler import hlo_category_breakdown
+
+    cats = hlo_category_breakdown(outdir, steps=steps)
+    total = sum(d["ms_per_step"] for d in cats.values())
+    total_gb = sum(d["gb_s"] * d["ms_per_step"] / 1e3
+                   for d in cats.values())
     print("device time %.2f ms/step, %.2f GB/step touched"
-          % (total / 1e3 / steps, total_bytes / steps / 1e9))
+          % (total, total_gb))
     print("%-24s %9s %6s %8s %9s %9s" % (
         "hlo category", "ms/step", "pct", "kernels", "TFLOP/s", "GB/s"))
-    rows = []
-    for cat, (dur, n, fl, by) in sorted(by_cat.items(),
-                                        key=lambda kv: -kv[1][0]):
+    for cat, d in sorted(cats.items(),
+                         key=lambda kv: -kv[1]["ms_per_step"]):
         print("%-24s %9.2f %5.1f%% %8d %9.1f %9.0f"
-              % (cat, dur / 1e3 / steps, 100 * dur / total, n // steps,
-                 fl / (dur * 1e6) if dur else 0,
-                 by / (dur * 1e3) if dur else 0))
-        rows.append({"category": cat, "ms_per_step": dur / 1e3 / steps,
-                     "tflops": fl / (dur * 1e6) if dur else 0,
-                     "gb_s": by / (dur * 1e3) if dur else 0})
-    return {"ms_per_step": total / 1e3 / steps,
-            "gb_per_step": total_bytes / steps / 1e9,
-            "categories": rows}
+              % (cat, d["ms_per_step"],
+                 100 * d["ms_per_step"] / total if total else 0,
+                 d["kernels"], d["tflops"], d["gb_s"]))
+    return cats
 
 
 def main():
