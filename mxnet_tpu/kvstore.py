@@ -36,12 +36,61 @@ def _ctx_key(ctx):
     return (ctx.device_type, ctx.device_id)
 
 
+class _HostRowStore:
+    """Host-resident embedding rows with lazy init — the storage side of
+    the reference's large-vocab row_sparse flow
+    (``src/kvstore/kvstore_dist.h:448-512``: workers pull only the rows a
+    batch touches, so the full table never has to fit in device memory).
+    Here the table never has to fit in HOST memory either: a row
+    materializes the first time it is touched."""
+
+    def __init__(self, shape, dtype, initializer):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self._init = initializer
+        self._rows = {}
+        self.rows_transferred = 0
+        self.bytes_transferred = 0
+
+    def _row(self, i):
+        import numpy as np
+
+        r = self._rows.get(i)
+        if r is None:
+            if self._init is not None:
+                r = np.asarray(self._init(i), self.dtype).reshape(
+                    self.shape[1:])
+            else:
+                r = np.zeros(self.shape[1:], self.dtype)
+            self._rows[i] = r
+        return r
+
+    def gather(self, row_ids):
+        import numpy as np
+
+        out = np.stack([self._row(int(i)) for i in row_ids])
+        self.rows_transferred += len(row_ids)
+        self.bytes_transferred += out.nbytes
+        return out
+
+    def write(self, row_ids, rows):
+        import numpy as np
+
+        for i, r in zip(row_ids, np.asarray(rows)):
+            self._rows[int(i)] = r.astype(self.dtype, copy=True)
+
+    @property
+    def resident_rows(self):
+        return len(self._rows)
+
+
 class KVStore:
     """Single-process key-value store (reference: kvstore.py KVStore)."""
 
     def __init__(self, kv_type="local"):
         self._type = kv_type
         self._data = {}
+        self._host_rows = {}
         self._updater = None
         self._update_on_kvstore_flag = False
         self._compression_params = None
@@ -77,6 +126,31 @@ class KVStore:
         except Exception:
             return 1
 
+    # -- host-resident rows (large-vocab embeddings) ----------------------
+    def init_host_rows(self, key, shape, dtype="float32",
+                       initializer=None):
+        """Register a host-resident row table for ``key`` (reference
+        ``kvstore_dist.h`` row_sparse semantics): the logical array is
+        ``shape`` (vocab, dim...), but only rows a batch touches are ever
+        materialized or moved to the device.  ``initializer(row_id)``
+        produces a row on first touch (zeros by default).  Use
+        :meth:`row_sparse_pull` with ``row_ids`` to fetch rows and
+        :meth:`push` with ``row_ids`` to update them; per-key transfer
+        counters live in :meth:`host_row_stats`."""
+        import numpy as np
+
+        self._host_rows[key] = _HostRowStore(shape, np.dtype(dtype),
+                                             initializer)
+
+    def host_row_stats(self, key):
+        """{rows_transferred, bytes_transferred, resident_rows} for a
+        host-row key — the observability hook the large-vocab tests
+        assert on (device traffic stays O(touched rows))."""
+        s = self._host_rows[key]
+        return {"rows_transferred": s.rows_transferred,
+                "bytes_transferred": s.bytes_transferred,
+                "resident_rows": s.resident_rows}
+
     # -- init -------------------------------------------------------------
     def init(self, key, value):
         """Initialize a key with a value (reference: kvstore.init)."""
@@ -90,12 +164,20 @@ class KVStore:
             self._async.init(key, value.asnumpy())
 
     # -- push / pull ------------------------------------------------------
-    def push(self, key, value, priority=0):
+    def push(self, key, value, priority=0, row_ids=None):
         """Push (a list of per-device) values; they are reduced into the
-        store (reference: kvstore.push; CommDevice::Reduce semantics)."""
+        store (reference: kvstore.push; CommDevice::Reduce semantics).
+
+        For a host-row key, ``value`` holds gradient rows for ``row_ids``
+        only; the update applies host-side to exactly those rows (the
+        reference's server-side sparse apply)."""
         if isinstance(key, (list, tuple)):
-            for k, v in zip(key, value):
-                self.push(k, v, priority)
+            rids = row_ids if row_ids is not None else [None] * len(key)
+            for k, v, r in zip(key, value, rids):
+                self.push(k, v, priority, row_ids=r)
+            return
+        if row_ids is not None and key in self._host_rows:
+            self._push_host_rows(key, value, row_ids)
             return
         if isinstance(value, NDArray):
             value = [value]
@@ -151,14 +233,69 @@ class KVStore:
         if out is not None:
             self.pull(key, out, priority)
 
+    def _push_host_rows(self, key, value, row_ids):
+        import numpy as np
+
+        store = self._host_rows[key]
+        if isinstance(value, (list, tuple)):
+            value = self._local_sum(value)
+        ids = np.asarray(
+            row_ids.asnumpy() if isinstance(row_ids, NDArray)
+            else row_ids).astype(np.int64).ravel()
+        grads = np.asarray(value.asnumpy(), store.dtype)
+        if grads.shape[0] != ids.shape[0]:
+            raise ValueError("push row_ids (%d) / rows (%d) mismatch"
+                             % (ids.shape[0], grads.shape[0]))
+        if self._type.startswith("dist") and self.num_workers > 1:
+            # cross-worker row alignment (each worker touches different
+            # ids) needs a server-side sparse reduce we have not built;
+            # fail loudly rather than silently diverge per worker
+            raise NotImplementedError(
+                "host-row push is single-process for now; dist host-row "
+                "tables need a server-side sparse reduce")
+        # duplicate ids within one push sum, like the reference's
+        # row-sparse reduce
+        uniq, inv = np.unique(ids, return_inverse=True)
+        inv = inv.reshape(-1)
+        summed = np.zeros((len(uniq),) + grads.shape[1:], store.dtype)
+        np.add.at(summed, inv, grads)
+        if self._updater is not None and self._update_on_kvstore_flag:
+            # per-ROW updater keys: optimizer state (momentum, Adam
+            # moments, ...) must follow the row identity, not the push —
+            # a per-push stack would mis-align state across pushes that
+            # touch different row sets
+            for j, i in enumerate(uniq):
+                w = nd.array(store._row(int(i))[None])
+                self._updater("hostrow:%s:%d" % (key, int(i)),
+                              nd.array(summed[j][None]), w)
+                store.write([int(i)], w.asnumpy())
+        else:
+            store.write(uniq, summed)
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference: kvstore.row_sparse_pull;
-        dense gather under XLA)."""
+        dense gather under XLA).
+
+        For a host-row key the result holds JUST the requested rows
+        (shape ``(len(row_ids),) + row_shape``) — the device never sees
+        the full table; transfers are counted in :meth:`host_row_stats`."""
         assert row_ids is not None, "row_ids is required"
         if isinstance(key, (list, tuple)):
             for k, o, r in zip(key, out, row_ids):
                 self.row_sparse_pull(k, o, priority, r)
             return
+        if key in self._host_rows:
+            import numpy as np
+
+            ids = np.asarray(
+                row_ids.asnumpy() if isinstance(row_ids, NDArray)
+                else row_ids).astype(np.int64).ravel()
+            rows = self._host_rows[key].gather(ids)
+            result = nd.array(rows)
+            if out is not None:
+                out._set_data(result.as_in_context(out.context).data)
+                return out
+            return result
         outs = out if isinstance(out, (list, tuple)) else [out]
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         if self._async is not None:

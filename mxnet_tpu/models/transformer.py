@@ -89,7 +89,7 @@ def _dense_self_attention(q, k, v, causal=True):
     """Plain materialized attention for short sequences: on TPU the fused
     QK^T -> softmax -> PV chain runs at full MXU rate (measured 60% MFU
     for the flagship at T=1024 vs 53.7% with the flash kernel); memory is
-    O(T^2) so the caller gates it by ``dense_attn_max_t``."""
+    O(T^2) so the caller gates it by ``dense_attn_max_score_mb``."""
     B, T, H, D = q.shape
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)

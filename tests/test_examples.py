@@ -150,3 +150,11 @@ def test_ssd_example():
     out = _run([os.path.join(EX, "object-detection", "ssd.py"),
                 "--smoke"], timeout=540)
     assert "OK" in out, out
+
+
+def test_large_vocab_embedding():
+    """Host-resident 16GB-logical embedding trains with O(touched rows)
+    device traffic (VERDICT r2 missing #5 / next #8)."""
+    out = _run([os.path.join(EX, "sparse", "large_vocab_embedding.py"),
+                "--smoke"], timeout=540)
+    assert "OK" in out, out
