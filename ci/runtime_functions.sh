@@ -71,6 +71,25 @@ unittest_core_tpu() {
         tests/test_gluon.py -q
 }
 
+unittest_dtype_sweep() {
+    # ctx x dtype cross-product of the op corpus (reference
+    # test_operator_gpu.py check_consistency type_dict sweep): fp32
+    # interpreted-vs-jit oracle + bf16 legs
+    python -m pytest tests/test_dtype_sweep.py tests/test_large_tensor.py -q
+}
+
+unittest_dtype_sweep_tpu() {
+    # same sweep on the real chip (run with hardware attached, like
+    # unittest_core_tpu — NOT part of all())
+    MXTPU_TEST_ON_TPU=1 python -m pytest tests/test_dtype_sweep.py -q
+}
+
+nightly_large_tensor() {
+    # reference tests/nightly/test_large_array.py analogue:
+    # MXNET_INT64_TENSOR_SIZE=1 subprocess crossing 2^31 elements
+    MXTPU_TEST_NIGHTLY=1 python -m pytest tests/test_large_tensor.py -q
+}
+
 all() {
     build_native
     sanity_check
@@ -78,6 +97,7 @@ all() {
     unittest_frontend
     unittest_parallel
     unittest_serving
+    unittest_dtype_sweep
     integration_examples
     multichip_dryrun
 }

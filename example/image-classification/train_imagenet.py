@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""ImageNet-shaped training driver (reference:
+``example/image-classification/train_imagenet.py``).
+
+The reference's baseline perf/accuracy script: ResNet over 3x224x224
+records with the full augmentation pipeline (random crop + mirror +
+mean subtraction through ImageRecordIter), stepped-lr multi-epoch
+training, checkpoint-every-epoch, and resume via ``--load-epoch``.
+
+Zero-egress default: ``--synthetic-rec`` builds a small JPEG RecordIO
+set with the same shape (class-tinted photos, im2rec wire format), so
+the WHOLE pipeline — record decode, augmenters, module fit, resume —
+runs exactly as it would on real ImageNet .rec files; point
+``--data-train`` at a real im2rec output to train for real.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np  # noqa: E402
+
+from common import data, fit  # noqa: E402
+
+
+def make_synthetic_rec(path, n=128, classes=8, size=256, seed=0):
+    """Class-tinted JPEGs in im2rec wire format (learnable, aug-friendly:
+    the tint survives crops/flips)."""
+    import cv2
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(seed)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    idx_path = os.path.splitext(path)[0] + ".idx"  # im2rec convention
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    tints = rng.uniform(40, 215, (classes, 3))
+    for i in range(n):
+        cls = i % classes
+        img = rng.normal(0, 18, (size, size, 3))
+        img += tints[cls][None, None, :]
+        ok, buf = cv2.imencode(".jpg",
+                               np.clip(img, 0, 255).astype(np.uint8))
+        assert ok
+        header = recordio.IRHeader(0, float(cls), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+    return path
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train on imagenet-shaped records",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.add_argument("--synthetic-rec", type=str, default=None,
+                        help="build a synthetic .rec at this path and "
+                             "train on it (zero-egress default when no "
+                             "--data-train)")
+    parser.add_argument("--synthetic-examples", type=int, default=128)
+    parser.add_argument("--num-layers", type=int, default=50)
+    # reference train_imagenet defaults: resnet-50, 3x224x224, 1000
+    # classes, stepped lr, full augmentation
+    parser.set_defaults(network="resnet", num_layers=50,
+                        image_shape="3,224,224", num_classes=1000,
+                        num_examples=1281167, batch_size=32,
+                        num_epochs=80, lr=0.1, lr_factor=0.1,
+                        lr_step_epochs="30,60", rand_crop=True,
+                        rand_mirror=True)
+    args = parser.parse_args()
+
+    if not args.data_train:
+        path = args.synthetic_rec or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "synthetic_imagenet.rec")
+        make_synthetic_rec(path, n=args.synthetic_examples,
+                           classes=args.num_classes)
+        args.data_train = path
+        args.num_examples = args.synthetic_examples
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "symbols"))
+    net_mod = __import__(args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers,
+                             image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Neural style transfer by image optimization (reference:
+``example/neural-style/`` — nstyle.py: backprop into the IMAGE through
+a frozen conv feature pyramid, matching content activations and style
+Gram matrices).
+
+Zero-egress: the feature pyramid is a fixed randomly-initialized conv
+stack (random shallow conv features carry enough texture statistics for
+toy style transfer), content is a synthetic shape image and style a
+synthetic stripe texture.  The mechanics are exactly the reference's:
+autograd w.r.t. the input tensor, Adam on pixels, content + weighted
+Gram-matrix style losses, total-variation smoothing.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+S = 64
+
+
+def make_images(seed=0):
+    rng = np.random.RandomState(seed)
+    content = np.zeros((1, 3, S, S), np.float32)
+    content[0, :, 16:48, 16:48] = 1.0           # a bright square
+    content += rng.normal(0, 0.02, content.shape)
+    style = np.zeros((1, 3, S, S), np.float32)
+    for i in range(0, S, 8):                    # diagonal stripes
+        for j in range(S):
+            style[0, :, (i + j) % S, j] = (i // 8) % 2
+    style += rng.normal(0, 0.02, style.shape)
+    return content, style
+
+
+class FeaturePyramid(gluon.nn.Block):
+    """Frozen random conv stack; returns activations at three depths."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = gluon.nn.Conv2D(16, 3, padding=1)
+            self.c2 = gluon.nn.Conv2D(32, 3, padding=1, strides=2)
+            self.c3 = gluon.nn.Conv2D(64, 3, padding=1, strides=2)
+
+    def forward(self, x):
+        f1 = mx.nd.relu(self.c1(x))
+        f2 = mx.nd.relu(self.c2(f1))
+        f3 = mx.nd.relu(self.c3(f2))
+        return [f1, f2, f3]
+
+
+def gram(f):
+    B, C, H, W = f.shape
+    m = f.reshape((C, H * W))
+    return mx.nd.dot(m, m.transpose((1, 0))) / (C * H * W)
+
+
+def transfer(steps=60, lr=0.1, style_weight=50.0, tv_weight=1e-3,
+             seed=0, verbose=True):
+    content_np, style_np = make_images(seed)
+    net = FeaturePyramid()
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    content_feats = [f.detach() for f in net(mx.nd.array(content_np))]
+    style_grams = [gram(f).detach() for f in net(mx.nd.array(style_np))]
+
+    img = mx.nd.array(content_np.copy())
+    img.attach_grad()
+    # Adam state on the pixel tensor (reference uses an lr-decayed
+    # optimizer on the image too)
+    m_t = mx.nd.zeros(img.shape)
+    v_t = mx.nd.zeros(img.shape)
+
+    losses = []
+    for t in range(steps):
+        with autograd.record():
+            feats = net(img)
+            c_loss = ((feats[1] - content_feats[1]) ** 2).mean()
+            s_loss = sum(((gram(f) - g) ** 2).sum()
+                         for f, g in zip(feats, style_grams))
+            tv = ((img[:, :, 1:, :] - img[:, :, :-1, :]) ** 2).mean() \
+                + ((img[:, :, :, 1:] - img[:, :, :, :-1]) ** 2).mean()
+            loss = c_loss + style_weight * s_loss + tv_weight * tv
+        loss.backward()
+        mx.nd.adam_update(img, img.grad, m_t, v_t, lr=lr, out=img)
+        losses.append(float(loss))
+        if verbose and t % 20 == 0:
+            print("step %d loss %.4f (content %.4f style %.4f)"
+                  % (t, losses[-1], float(c_loss), float(s_loss)))
+    return img, losses
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--output", type=str, default=None)
+    args = ap.parse_args()
+    img, losses = transfer(steps=args.steps, verbose=not args.smoke)
+    print("style-transfer loss %.4f -> %.4f" % (losses[0], losses[-1]))
+    if args.output:
+        import cv2
+
+        arr = np.asarray(img.asnumpy()[0].transpose(1, 2, 0))
+        arr = np.clip(arr * 255, 0, 255).astype(np.uint8)
+        cv2.imwrite(args.output, arr)
+    if args.smoke:
+        assert losses[-1] < losses[0] * 0.5, losses
+        print("OK")
+
+
+if __name__ == "__main__":
+    main()

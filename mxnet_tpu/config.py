@@ -65,6 +65,13 @@ class _Config:
         Knob("MXNET_SUBGRAPH_BACKEND", str, "",
              "Reference subgraph-fusion backend selector. Inert: XLA "
              "fusion replaces subgraph properties.", inert=True),
+        Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
+             "Opt into int64 tensor sizes/indices (arrays past 2^31 "
+             "elements) by enabling jax x64 mode at import — the "
+             "analogue of the reference's MXNET_USE_INT64_TENSOR_SIZE "
+             "build flag (its large-tensor support is a special build "
+             "too). Changes jnp weak-type promotion; use for host-side "
+             "large-array jobs, not the TPU hot path."),
     ]
 
     def __init__(self):
@@ -89,6 +96,13 @@ class _Config:
 
 
 config = _Config()
+
+if config.int64_tensor_size:
+    # must happen before any jax computation: index dtypes are chosen at
+    # trace time and silently truncate to int32 without x64
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
 
 
 def describe():

@@ -176,7 +176,13 @@ class KVStore:
             for k, v, r in zip(key, value, rids):
                 self.push(k, v, priority, row_ids=r)
             return
-        if row_ids is not None and key in self._host_rows:
+        if row_ids is not None:
+            if key not in self._host_rows:
+                # silently taking the dense path would swap the full
+                # table for a rows-only grad slab
+                raise ValueError(
+                    "push(row_ids=...) requires a host-row key; %r was "
+                    "not registered via init_host_rows" % (key,))
             self._push_host_rows(key, value, row_ids)
             return
         if isinstance(value, NDArray):
@@ -260,17 +266,86 @@ class KVStore:
         summed = np.zeros((len(uniq),) + grads.shape[1:], store.dtype)
         np.add.at(summed, inv, grads)
         if self._updater is not None and self._update_on_kvstore_flag:
-            # per-ROW updater keys: optimizer state (momentum, Adam
-            # moments, ...) must follow the row identity, not the push —
-            # a per-push stack would mis-align state across pushes that
-            # touch different row sets
+            self._apply_host_update(key, store, uniq, summed)
+        else:
+            store.write(uniq, summed)
+
+    def _apply_host_update(self, key, store, uniq, summed):
+        """One batched optimizer step over the touched rows.
+
+        Optimizer state (momentum, Adam moments, ...) must follow the
+        ROW identity, not the push — so per-row state lives host-side in
+        the store and is stacked/unstacked around a single batched
+        ``optimizer.update`` call (one jitted kernel per push, not one
+        per row)."""
+        import numpy as np
+
+        opt_obj = getattr(self._updater, "optimizer", None)
+        if opt_obj is None:  # custom updater fn: per-row calls
             for j, i in enumerate(uniq):
                 w = nd.array(store._row(int(i))[None])
                 self._updater("hostrow:%s:%d" % (key, int(i)),
                               nd.array(summed[j][None]), w)
                 store.write([int(i)], w.asnumpy())
-        else:
-            store.write(uniq, summed)
+            return
+        states = getattr(store, "opt_state_rows", None)
+        if states is None:
+            states = store.opt_state_rows = {}
+        counts = getattr(store, "row_update_count", None)
+        if counts is None:
+            counts = store.row_update_count = {}
+
+        def to_np(tree):
+            if tree is None:
+                return None
+            if isinstance(tree, (list, tuple)):
+                return type(tree)(to_np(t) for t in tree)
+            return tree.asnumpy()
+
+        def stack(trees):
+            if trees[0] is None:
+                return None
+            if isinstance(trees[0], (list, tuple)):
+                return type(trees[0])(
+                    stack([t[j] for t in trees])
+                    for j in range(len(trees[0])))
+            return nd.array(np.concatenate(trees))
+
+        def unstack(tree, j):
+            if tree is None:
+                return None
+            if isinstance(tree, (list, tuple)):
+                return type(tree)(unstack(t, j) for t in tree)
+            return tree.asnumpy()[j:j + 1]
+
+        w_all = np.stack([store._row(int(i)) for i in uniq])
+        for j, i in enumerate(uniq):
+            if int(i) not in states:
+                states[int(i)] = to_np(
+                    opt_obj.create_state_multi_precision(
+                        "hostrow:%s:%d" % (key, int(i)),
+                        nd.array(w_all[j:j + 1])))
+        # group rows by their own update count: Adam/FTML bias
+        # correction reads t per index, and a row first touched on push
+        # 100 must see t=1, not t=100 — so one batched call per distinct
+        # per-row count, with the synthetic key's counter pinned to it
+        by_count = {}
+        for j, i in enumerate(uniq):
+            by_count.setdefault(counts.get(int(i), 0), []).append(j)
+        for t0, rows_j in sorted(by_count.items()):
+            sel = np.asarray(rows_j)
+            w_block = nd.array(w_all[sel])
+            state_block = stack([states[int(uniq[j])] for j in rows_j])
+            syn = "hostrow:%s:t%d" % (key, t0)
+            opt_obj._index_update_count[syn] = t0
+            opt_obj.update_multi_precision(
+                syn, w_block, nd.array(summed[sel]), state_block)
+            w_new = w_block.asnumpy()
+            for jj, j in enumerate(rows_j):
+                i = int(uniq[j])
+                store.write([i], w_new[jj:jj + 1])
+                states[i] = unstack(state_block, jj)
+                counts[i] = t0 + 1
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference: kvstore.row_sparse_pull;
@@ -412,13 +487,33 @@ class KVStore:
     # -- barrier / misc ---------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
+        payload = {"updater": self._updater.get_states(dump_optimizer)}
+        # host-row tables keep per-row optimizer state outside the
+        # Updater; resume must not silently reset momentum/moments
+        host = {k: {"states": getattr(s, "opt_state_rows", {}),
+                    "counts": getattr(s, "row_update_count", {})}
+                for k, s in self._host_rows.items()}
+        if host:
+            payload["host_rows"] = host
         with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states(dump_optimizer))
+            fout.write(pickle.dumps(payload))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for distributed training"
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            raw = f.read()
+        try:
+            payload = pickle.loads(raw)
+        except Exception:
+            payload = None
+        if not isinstance(payload, dict) or "updater" not in payload:
+            self._updater.set_states(raw)  # legacy plain-updater file
+            return
+        self._updater.set_states(payload["updater"])
+        for k, d in payload.get("host_rows", {}).items():
+            if k in self._host_rows:
+                self._host_rows[k].opt_state_rows = d["states"]
+                self._host_rows[k].row_update_count = d["counts"]
 
     def _barrier(self):
         if self.num_workers > 1:

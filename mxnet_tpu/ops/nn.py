@@ -455,7 +455,8 @@ def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
     scatter-add that XLA handles natively (no row_sparse grad needed —
     sparse_grad accepted for API parity).
     """
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    from .tensor import _index_dtype
+    return jnp.take(weight, data.astype(_index_dtype()), axis=0)
 
 
 # ---------------------------------------------------------------------------

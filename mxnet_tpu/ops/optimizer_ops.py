@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .registry import register
+from .tensor import _index_dtype
 
 _AP = ("lr", "wd", "rescale_grad")
 
@@ -303,7 +304,7 @@ def _lamb_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
           mutate={0: 0}, array_params=_AP, no_grad=True)
 def _sparse_sgd_update(weight, grad, indices, lr=0.01, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0):
-    idx = indices.astype(jnp.int32)
+    idx = indices.astype(_index_dtype())
     g = _prep(grad[idx], rescale_grad, clip_gradient)
     rows = weight[idx]
     return weight.at[idx].set(rows - lr * (g + wd * rows))
@@ -315,7 +316,7 @@ def _sparse_sgd_update(weight, grad, indices, lr=0.01, wd=0.0,
 def _sparse_sgd_mom_update(weight, grad, indices, mom, lr=0.01,
                            momentum=0.0, wd=0.0, rescale_grad=1.0,
                            clip_gradient=-1.0):
-    idx = indices.astype(jnp.int32)
+    idx = indices.astype(_index_dtype())
     g = _prep(grad[idx], rescale_grad, clip_gradient)
     rows = weight[idx]
     new_mom_rows = momentum * mom[idx] - lr * (g + wd * rows)
@@ -330,7 +331,7 @@ def _sparse_adam_update(weight, grad, indices, mean, var, lr=0.001,
                         beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                         rescale_grad=1.0, clip_gradient=-1.0):
     # lr arrives with bias correction pre-folded, like dense adam_update
-    idx = indices.astype(jnp.int32)
+    idx = indices.astype(_index_dtype())
     rows = weight[idx]
     g = _prep(grad[idx], rescale_grad, clip_gradient) + wd * rows
     m = beta1 * mean[idx] + (1 - beta1) * g

@@ -522,22 +522,31 @@ def _slice_like(x, like, axes=()):
 # ---------------------------------------------------------------------------
 # indexing
 # ---------------------------------------------------------------------------
+def _index_dtype():
+    """int32 normally; int64 under MXNET_INT64_TENSOR_SIZE (x64 mode) so
+    indices into >2^31-element arrays don't truncate."""
+    import jax
+
+    return jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+
+
 @register("take")
 def _take(a, indices, axis=0, mode="clip"):
     # mode="raise" cannot raise inside a compiled XLA program (no
     # data-dependent errors); it degrades to "clip" — documented deviation.
     jmode = "wrap" if mode == "wrap" else "clip"
-    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+    return jnp.take(a, indices.astype(_index_dtype()), axis=axis,
+                    mode=jmode)
 
 
 @register("batch_take")
 def _batch_take(a, indices):
-    return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+    return a[jnp.arange(a.shape[0]), indices.astype(_index_dtype())]
 
 
 @register("pick")
 def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    idx = jnp.expand_dims(index.astype(_index_dtype()), axis=axis)
     out = jnp.take_along_axis(data, idx, axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
@@ -546,14 +555,14 @@ def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
 
 @register("gather_nd")
 def _gather_nd(data, indices):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_index_dtype()))
     return data[idx]
 
 
 @register("scatter_nd")
 def _scatter_nd(data, indices, shape=None):
     out = jnp.zeros(shape, data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_index_dtype()))
     return out.at[idx].set(data)
 
 

@@ -1,12 +1,13 @@
 """Expert parallelism — mixture-of-experts layer over mesh axis ``ep``.
 
 Net-new vs the reference (SURVEY.md §2.4 lists expert parallelism/MoE as
-absent).  TPU-native design: GShard-style einsum dispatch.  Routing is top-1
-with an auxiliary load-balancing loss; dispatch/combine are dense einsums over
-a one-hot [token, expert] mask, so the whole layer is static-shaped and GSPMD
-shards the expert dimension over ``ep`` (the all-to-all is inserted by XLA
-from the sharding constraints — no hand-written NCCL-style routing as the
-reference would have needed).
+absent).  TPU-native design: GShard-style einsum dispatch.  Routing is
+top-k (k=1 Switch-style or k>=2 GShard-style) with an auxiliary
+load-balancing loss; dispatch/combine are dense einsums over one-hot
+[token, slot, expert, capacity] masks, so the whole layer is
+static-shaped and GSPMD shards the expert dimension over ``ep`` (the
+all-to-all is inserted by XLA from the sharding constraints — no
+hand-written NCCL-style routing as the reference would have needed).
 """
 from __future__ import annotations
 
@@ -18,48 +19,69 @@ from .sharding import constraint
 __all__ = ["moe_layer"]
 
 
-def moe_layer(x, gate_w, w_up, w_down, ep_axis="ep", capacity_factor=1.25):
-    """Top-1 routed MoE feed-forward.
+def moe_layer(x, gate_w, w_up, w_down, ep_axis="ep", capacity_factor=1.25,
+              top_k=1, renormalize=True):
+    """Top-k routed MoE feed-forward.
 
-    x: [B, T, E]; gate_w: [E, n_exp]; w_up: [n_exp, E, H]; w_down: [n_exp, H, E].
-    Returns (y [B, T, E], aux_loss scalar).
+    x: [B, T, E]; gate_w: [E, n_exp]; w_up: [n_exp, E, H];
+    w_down: [n_exp, H, E].  Returns (y [B, T, E], aux_loss scalar).
+
+    ``top_k=1`` is the Switch Transformer router; ``top_k>=2`` the
+    GShard router (each token dispatches to its k best experts; with
+    ``renormalize`` the kept gate values are rescaled to sum to 1).
+    Tokens overflowing an expert's capacity are dropped for that slot —
+    the standard static-shape MoE contract.
     """
     B, T, E = x.shape
     n_exp = gate_w.shape[1]
+    k = int(top_k)
+    assert 1 <= k <= n_exp, "top_k must be in [1, n_experts]"
     S = B * T
-    capacity = max(1, int(capacity_factor * S / n_exp))
+    capacity = max(1, int(capacity_factor * k * S / n_exp))
 
     tokens = x.reshape(S, E)
     logits = jnp.einsum("se,en->sn", tokens, gate_w,
                         preferred_element_type=jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)                       # [S, n]
-    expert = jnp.argmax(gates, axis=-1)                           # [S]
-    onehot = jax.nn.one_hot(expert, n_exp, dtype=gates.dtype)     # [S, n]
+    topg, tope = jax.lax.top_k(gates, k)                          # [S, k]
+    if renormalize and k > 1:
+        topg = topg / jnp.maximum(topg.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(tope, n_exp, dtype=gates.dtype)       # [S,k,n]
 
-    # load-balancing aux loss (Switch Transformer eq. 4)
-    density = onehot.mean(axis=0)
+    # load-balancing aux loss (Switch Transformer eq. 4, over the
+    # primary expert choice)
+    density = onehot[:, 0, :].mean(axis=0)
     density_proxy = gates.mean(axis=0)
     aux_loss = n_exp * jnp.sum(density * density_proxy)
 
-    # capacity: position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot                     # [S, n]
-    keep = (pos <= capacity) & (onehot > 0)
-    pos_idx = jnp.clip(pos.sum(axis=-1).astype(jnp.int32) - 1, 0, capacity - 1)
+    # capacity: queue position of each (token, slot) inside its expert,
+    # counted in (slot-major, token) order so primary routes win slots
+    flat = onehot.transpose(1, 0, 2).reshape(k * S, n_exp)        # [kS, n]
+    pos = jnp.cumsum(flat, axis=0) * flat                         # [kS, n]
+    keep = (pos <= capacity) & (flat > 0)
+    pos_idx = jnp.clip(pos.sum(-1).astype(jnp.int32) - 1, 0,
+                       capacity - 1)                              # [kS]
 
-    # dispatch: [n, capacity, E] expert inputs (dense one-hot scatter)
+    # dispatch mask [kS, n, c] -> expert inputs [n, c, E]
     disp = (keep.astype(tokens.dtype)[:, :, None]
-            * jax.nn.one_hot(pos_idx, capacity, dtype=tokens.dtype)[:, None, :])
-    expert_in = jnp.einsum("snc,se->nce", disp, tokens)
+            * jax.nn.one_hot(pos_idx, capacity,
+                             dtype=tokens.dtype)[:, None, :])
+    tokens_k = jnp.broadcast_to(tokens[None], (k, S, E)).reshape(
+        k * S, E)
+    expert_in = jnp.einsum("znc,ze->nce", disp, tokens_k)
     expert_in = constraint(expert_in, ep_axis, None, None)
 
     h = jnp.einsum("nce,neh->nch", expert_in, w_up,
                    preferred_element_type=jnp.float32)
     h = jax.nn.relu(h).astype(x.dtype)
     expert_out = jnp.einsum("nch,nhe->nce", h, w_down,
-                            preferred_element_type=jnp.float32).astype(x.dtype)
+                            preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
     expert_out = constraint(expert_out, ep_axis, None, None)
 
-    # combine, weighted by the (top-1) gate value
-    gate_val = (gates * onehot).sum(axis=-1)                      # [S]
-    y = jnp.einsum("snc,nce->se", disp, expert_out) * gate_val[:, None]
+    # combine: per-slot gather weighted by the kept gate value
+    gate_flat = topg.transpose(1, 0).reshape(k * S)               # [kS]
+    y_flat = jnp.einsum("znc,nce->ze", disp, expert_out) \
+        * gate_flat[:, None].astype(x.dtype)
+    y = y_flat.reshape(k, S, E).sum(axis=0)
     return y.reshape(B, T, E), aux_loss

@@ -24,7 +24,25 @@ from jax import lax
 from .collectives import shard_map
 from .mesh import current_mesh
 
-__all__ = ["pipeline_spmd"]
+__all__ = ["pipeline_spmd", "pipeline_train_1f1b", "bubble_fraction"]
+
+
+def bubble_fraction(n_stages, num_microbatches, schedule="1f1b"):
+    """Idle-slot fraction of the schedule (textbook definitions).
+
+    GPipe: fwd and bwd run as separate waves — (P-1)/(M+P-1) idle per
+    wave, 2(M+P-1) total steps.  1F1B: interleaved — a stage has 2
+    compute slots (one F, one B) per step over M+2P-2 steps, of which
+    2M are used: bubble (2P-2)/(M+2P-2).  The schedules' real trade on
+    SPMD hardware: 1F1B's critical path is M+2P-2 steps (< 2(M+P-1))
+    and its saved-activation memory is O(P) (``_make_1f1b_worker``
+    recomputes fwd in bwd), while GPipe-via-AD stores O(M) residuals."""
+    P, M = n_stages, num_microbatches
+    if schedule == "gpipe":
+        return (P - 1) / (M + P - 1)
+    if schedule == "1f1b":
+        return (2 * P - 2) / (M + 2 * P - 2)
+    raise ValueError("unknown schedule %r" % (schedule,))
 
 
 def _make_worker(stage_fn, num_microbatches, n_stages, pp_axis):
@@ -97,3 +115,132 @@ def pipeline_spmd(stage_fn, stacked_params, x, num_microbatches, mesh=None,
     return shard_map(worker, mesh=mesh.mesh,
                      in_specs=(pspec, Pspec()), out_specs=Pspec(),
                      check_vma=False)(stacked_params, x)
+
+
+def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
+    """One SPMD worker running the interleaved 1F1B schedule.
+
+    Timeline (global step t): stage p runs the FORWARD of microbatch
+    ``t - p`` and the BACKWARD of microbatch ``t - (2P-2-p)``; the last
+    stage turns a finished forward straight into its loss gradient, so
+    fwd and bwd of a microbatch coincide there.  Total steps M + 2P - 2
+    vs GPipe's 2(M + P - 1); a stage stores at most 2P-1 saved inputs
+    (O(P), the 1F1B memory property) instead of AD's O(M) residuals —
+    backward recomputes the stage forward from the saved input."""
+    from .collectives import ppermute_shift
+
+    Q = 2 * P - 1  # saved-input slots: inputs live < 2P-2 steps
+
+    def worker(params, x, targets):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        my = lax.axis_index(pp_axis)
+        mb_shape = x.shape[1:]
+        zero_dp = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def fwd(p_, xx):
+            return stage_fn(p_, xx)
+
+        def step(carry, t):
+            send_f, send_b, queue, dp_acc, loss_acc, outbuf = carry
+            recv_f = ppermute_shift(send_f, pp_axis, 1)
+            recv_b = ppermute_shift(send_b, pp_axis, -1)
+
+            # ---- forward of microbatch fm = t - my -----------------
+            fm = t - my
+            active_f = (fm >= 0) & (fm < M)
+            fmc = jnp.clip(fm, 0, M - 1)
+            x_in = jnp.where(my == 0, x[fmc], recv_f)
+            queue = jnp.where(
+                active_f,
+                lax.dynamic_update_index_in_dim(queue, x_in, fm % Q, 0),
+                queue)
+            y = fwd(params, x_in)
+            # last stage: loss + its gradient, immediately
+            tgt = targets[fmc]
+            loss_m, dloss = jax.value_and_grad(
+                lambda yy: loss_fn(yy, tgt))(y)
+            is_last = my == P - 1
+            loss_acc = loss_acc + jnp.where(active_f & is_last,
+                                            loss_m, 0.0)
+            outbuf = jnp.where(
+                active_f & is_last,
+                lax.dynamic_update_index_in_dim(outbuf, y, fmc, 0),
+                outbuf)
+
+            # ---- backward of microbatch bm = t - (2P-2-my) ---------
+            bm = t - (2 * P - 2 - my)
+            active_b = (bm >= 0) & (bm < M)
+            bmc = jnp.clip(bm, 0, M - 1)
+            x_saved = queue[bmc % Q]
+            g_in = jnp.where(is_last, dloss, recv_b)
+            _, vjp = jax.vjp(fwd, params, x_saved)
+            dp, dx = vjp(g_in)
+            dp_acc = jax.tree_util.tree_map(
+                lambda acc, d: acc + jnp.where(active_b, d, 0.0),
+                dp_acc, dp)
+            return (y, dx, queue, dp_acc, loss_acc, outbuf), None
+
+        init = (jnp.zeros(mb_shape, x.dtype),
+                jnp.zeros(mb_shape, x.dtype),
+                jnp.zeros((Q,) + mb_shape, x.dtype),
+                zero_dp,
+                jnp.float32(0.0),
+                jnp.zeros((M,) + mb_shape, x.dtype))
+        carry, _ = lax.scan(step, init, jnp.arange(M + 2 * P - 2))
+        _, _, _, dp_acc, loss_acc, outbuf = carry
+        my = lax.axis_index(pp_axis)
+        loss_total = lax.psum(jnp.where(my == P - 1, loss_acc, 0.0),
+                              pp_axis)
+        outbuf = lax.psum(jnp.where(my == P - 1, outbuf,
+                                    jnp.zeros_like(outbuf)), pp_axis)
+        # each rank keeps ITS stage's grads; re-add the stage dim so the
+        # out_spec stacks them back to [P, ...]
+        dp_out = jax.tree_util.tree_map(lambda d: d[None], dp_acc)
+        return loss_total, outbuf, dp_out
+
+    return worker
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, targets,
+                        num_microbatches, mesh=None, pp_axis="pp"):
+    """Interleaved one-forward-one-backward pipeline TRAINING step.
+
+    ``stage_fn(params, act) -> act`` (homogeneous stages),
+    ``loss_fn(final_act, target) -> scalar`` applied per microbatch at
+    the last stage.  ``stacked_params`` leaves have leading dim P;
+    ``x``/``targets`` are [M, mb, ...].  Returns
+    ``(total_loss, outputs [M, mb, ...], dparams stacked [P, ...])``.
+
+    Without a pp mesh axis the same math runs sequentially via jax AD —
+    the parity oracle the tests diff against."""
+    from jax.sharding import PartitionSpec as Pspec
+
+    mesh = mesh or current_mesh()
+    P_sz = 1 if mesh is None else mesh.size(pp_axis)
+    if P_sz == 1:
+        n = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+        def whole(params, mb, tgt):
+            h = mb
+            for i in range(n):
+                pi = jax.tree_util.tree_map(lambda p: p[i], params)
+                h = stage_fn(pi, h)
+            return loss_fn(h, tgt), h
+
+        def total(params):
+            (losses, outs) = jax.vmap(
+                lambda mb, tgt: whole(params, mb, tgt))(x, targets)
+            return losses.sum(), outs
+
+        (loss, outs), grads = jax.value_and_grad(
+            total, has_aux=True)(stacked_params)
+        return loss, outs, grads
+
+    worker = _make_1f1b_worker(stage_fn, loss_fn, num_microbatches,
+                               P_sz, pp_axis)
+    pspec = jax.tree_util.tree_map(lambda _: Pspec(pp_axis),
+                                   stacked_params)
+    return shard_map(worker, mesh=mesh.mesh,
+                     in_specs=(pspec, Pspec(), Pspec()),
+                     out_specs=(Pspec(), Pspec(), pspec),
+                     check_vma=False)(stacked_params, x, targets)

@@ -100,36 +100,84 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
                                    err_msg="grad of input %d" % i)
 
 
-def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4, atol=1e-5):
-    """Run the same computation across backends and cross-check outputs
-    (reference: test_utils.py:1224 — CPU is the oracle for the
-    accelerator).
+# per-dtype comparison tolerances vs the fp32 oracle (reference
+# test_utils.py:1224 check_consistency tolerance map: fp16-class types
+# get 1e-2-class tolerances)
+DTYPE_TOLS = {
+    "float32": (1e-4, 1e-5),
+    "float64": (1e-4, 1e-5),
+    "bfloat16": (4e-2, 2e-2),
+    "float16": (1e-2, 2e-3),
+}
+
+
+def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4,
+                      atol=1e-5, dtypes=("float32",), scale=1.0):
+    """Run the same computation across backends AND dtypes, cross-check
+    outputs (reference: test_utils.py:1224 — the ctx_list x type_dict
+    cross-product with the CPU/fp32 leg as the oracle).
 
     When the ctx_list spans distinct devices (cpu vs tpu), each context
     runs for real.  When every context resolves to the SAME device (the
     CPU-only CI case that used to make this check vacuous), the oracle
     leg instead runs with jit disabled — interpreted (op-by-op) vs
-    XLA-compiled is a genuine two-implementation comparison."""
+    XLA-compiled is a genuine two-implementation comparison.
+
+    ``dtypes`` sweeps reduced-precision legs: inputs are cast from the
+    same fp32 draw, outputs are compared to the fp32 oracle with
+    per-dtype tolerances (DTYPE_TOLS)."""
     import jax
 
     ctx_list = ctx_list or [cpu(0), current_context()]
-    datas = [np.random.uniform(-1, 1, s).astype(np.float32)
+    datas = [np.random.uniform(-scale, scale, s).astype(np.float32)
              for s in input_shapes]
     devices = {c.jax_device() for c in ctx_list}
+
+    def run(ctx, dtype, jit=True):
+        args = [nd.array(d, ctx=ctx).astype(dtype) for d in datas]
+        if jit:
+            r = f(*args)
+        else:
+            with jax.disable_jit():
+                r = f(*args)
+        if isinstance(r, (list, tuple)):  # multi-output ops: first out
+            r = r[0]
+        return np.asarray(r.astype("float32").data)
+
     outs = []
     if len(devices) == 1:
-        with jax.disable_jit():  # interpreted oracle
-            r = f(*[nd.array(d, ctx=ctx_list[0]) for d in datas])
-            outs.append(np.asarray(r.data))
-        r = f(*[nd.array(d, ctx=ctx_list[0]) for d in datas])
-        outs.append(r.asnumpy())
+        outs.append(run(ctx_list[0], "float32", jit=False))  # oracle
+        outs.append(run(ctx_list[0], "float32"))
+        fp32_r, fp32_a = rtol, atol
     else:
         for ctx in ctx_list:
             with ctx:
-                r = f(*[nd.array(d, ctx=ctx) for d in datas])
-                outs.append(r.asnumpy())
+                outs.append(run(ctx, "float32"))
+        # cross-DEVICE fp32 legs differ by the accelerator's
+        # transcendental-unit error; apply the device floor
+        floor_r, floor_a = _device_tolerance_floor()
+        fp32_r, fp32_a = max(rtol, floor_r), max(atol, floor_a)
     for o in outs[1:]:
-        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+        np.testing.assert_allclose(outs[0], o, rtol=fp32_r, atol=fp32_a)
+
+    # one reduced-precision leg per DISTINCT device (same-device ctx
+    # entries would just repeat identical work)
+    seen_devices = set()
+    dtype_ctxs = []
+    for ctx in ctx_list:
+        if ctx.jax_device() not in seen_devices:
+            seen_devices.add(ctx.jax_device())
+            dtype_ctxs.append(ctx)
+    for dtype in dtypes:
+        if dtype == "float32":
+            continue
+        dr, da = DTYPE_TOLS.get(dtype, (rtol, atol))
+        for ctx in dtype_ctxs:
+            with ctx:
+                got = run(ctx, dtype)
+            np.testing.assert_allclose(
+                outs[0], got, rtol=max(dr, rtol), atol=max(da, atol),
+                err_msg="dtype %s on %r vs fp32 oracle" % (dtype, ctx))
 
 
 def same(a, b):

@@ -1,0 +1,67 @@
+"""Worker for the large-tensor suite: runs with
+MXNET_INT64_TENSOR_SIZE=1 (jax x64) in a fresh process — index dtypes
+are fixed at trace time, so the flag must precede the first jax use.
+Invoked by tests/test_large_tensor.py."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+LARGE = 2**31 + 8
+
+
+def check_flat():
+    ctx = mx.cpu()
+    # host-built buffer: one 2.1 GB allocation, no giant XLA temporaries
+    host = np.zeros(LARGE, np.int8)
+    host[2**31 + 3] = 7
+    host[LARGE - 1] = 9
+    a = mx.nd.array(host, ctx=ctx, dtype="int8")
+    assert a.size == LARGE and a.size > 2**31 - 1
+    # element reads across the 2^31 boundary (int64 indexing)
+    assert int(a[2**31 + 3].asnumpy()) == 7
+    assert int(a[LARGE - 1].asnumpy()) == 9
+    # functional write past the boundary
+    a[2**31 + 5] = 4
+    assert int(a[2**31 + 5].asnumpy()) == 4
+    # slice spanning the boundary
+    s = a[2**31 - 2:2**31 + 5].asnumpy()
+    assert s.shape == (7,) and s[5] == 7
+    # reduce over the boundary-spanning slice (full-array reduce in
+    # int32 would materialize an 8.6 GB temporary — out of scope here)
+    assert int(a[2**31:2**31 + 8].sum().asnumpy()) == 7 + 4 + 9
+    # int64 index gather
+    idx = mx.nd.array(np.array([2**31 + 3, LARGE - 1], np.int64),
+                      ctx=ctx, dtype="int64")
+    assert mx.nd.take(a, idx).asnumpy().tolist() == [7, 9]
+
+
+def check_2d():
+    rows, cols = 2**27 + 3, 17  # flat size > int32
+    ctx = mx.cpu()
+    m = mx.nd.zeros((rows, cols), ctx=ctx, dtype="int8")
+    assert m.size > 2**31 - 1
+    m[rows - 1] = mx.nd.ones((cols,), ctx=ctx, dtype="int8")
+    assert int(m[rows - 1].sum().asnumpy()) == cols
+    assert int(m[rows - 2].sum().asnumpy()) == 0
+
+
+def check_int64_values():
+    big = np.array([2**62 - 1, -(2**61), 2**53 + 1], np.int64)
+    a = mx.nd.array(big, dtype="int64")
+    assert a.asnumpy().tolist() == big.tolist()
+    b = (a - mx.nd.array(np.array([1, 0, 1]), dtype="int64")).asnumpy()
+    assert b.tolist() == [2**62 - 2, -(2**61), 2**53]
+
+
+if __name__ == "__main__":
+    assert os.environ.get("MXNET_INT64_TENSOR_SIZE") == "1"
+    check_int64_values()
+    check_flat()
+    if os.environ.get("MXTPU_TEST_NIGHTLY") == "1":
+        check_2d()  # second multi-GB allocation: nightly shard only
+    print("LARGE_TENSOR_OK")

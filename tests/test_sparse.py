@@ -253,3 +253,50 @@ def test_kvstore_host_rows_roundtrip():
     out = mx.nd.zeros((2, 4))
     kv.row_sparse_pull("emb", out=out, row_ids=np.array([3, 42]))
     np.testing.assert_allclose(out.asnumpy()[1], 0.5)
+
+
+def test_host_rows_adam_bias_correction_and_state_resume(tmp_path):
+    """Adam bias correction must track the ROW's own update count, and
+    host-row optimizer state must survive save/load_optimizer_states
+    (round-3 review findings)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def fresh(with_opt=True):
+        kv = mx.kv.create("local")
+        kv.init_host_rows("e", (1000, 3), "float32")
+        if with_opt:
+            kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+        return kv
+
+    g = mx.nd.array(np.ones((1, 3), np.float32))
+    kv = fresh()
+    # row 5 updated 3 times first; row 9 first touched afterwards
+    for _ in range(3):
+        kv.push("e", g, row_ids=np.array([5]))
+    kv.push("e", g, row_ids=np.array([9]))
+    # a row's FIRST Adam step has bias correction ~1: step size ~= lr
+    first9 = kv.row_sparse_pull("e", row_ids=np.array([9])).asnumpy()
+    ref = fresh()
+    ref.push("e", g, row_ids=np.array([9]))
+    want9 = ref.row_sparse_pull("e", row_ids=np.array([9])).asnumpy()
+    np.testing.assert_allclose(first9, want9, rtol=1e-6)
+
+    # state resume: save, rebuild, load, continue — matches continuing
+    # without the round trip
+    f = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(f)
+    cont = kv.row_sparse_pull("e", row_ids=np.array([5])).asnumpy()
+    kv.push("e", g, row_ids=np.array([5]))
+    direct = kv.row_sparse_pull("e", row_ids=np.array([5])).asnumpy()
+
+    kv2 = fresh()
+    # replay the weights (host rows save weights via nd/save path in a
+    # real checkpoint; here we copy them over directly)
+    kv2._host_rows["e"]._rows = {
+        k: v.copy() for k, v in kv._host_rows["e"]._rows.items()}
+    kv2._host_rows["e"]._rows[5] = cont[0].copy()
+    kv2.load_optimizer_states(f)
+    kv2.push("e", g, row_ids=np.array([5]))
+    resumed = kv2.row_sparse_pull("e", row_ids=np.array([5])).asnumpy()
+    np.testing.assert_allclose(resumed, direct, rtol=1e-6)
