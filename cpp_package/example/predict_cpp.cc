@@ -1,0 +1,97 @@
+/* Second consumer of the predict-only ABI (reference proves its predict
+ * ABI with TWO independent frontends — matlab/ and amalgamation/; here
+ * the C test client (native/test_client.c) and this C++ RAII wrapper
+ * play those roles).  Loads an exported symbol.json + .params through
+ * c_predict_api.h, runs a batch, and prints each row's argmax
+ * (output-shape and format sanity; numeric parity with the exporter is
+ * covered by the predict-ABI python tests).
+ *
+ * Usage: predict_cpp <symbol.json> <model.params> <batch> <dim>
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace {
+
+std::string slurp(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+class Predictor {
+ public:
+  Predictor(const std::string& json, const std::string& params,
+            const char* input_key, const std::vector<mx_uint>& shape) {
+    mx_uint ind[2] = {0, static_cast<mx_uint>(shape.size())};
+    const char* keys[1] = {input_key};
+    if (MXPredCreate(json.c_str(), params.data(),
+                     static_cast<int>(params.size()), 1, 0, 1, keys,
+                     ind, shape.data(), &h_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+  ~Predictor() { MXPredFree(h_); }
+
+  std::vector<float> Run(const char* key,
+                         const std::vector<float>& in) {
+    if (MXPredSetInput(h_, key, in.data(),
+                       static_cast<mx_uint>(in.size())) != 0 ||
+        MXPredForward(h_) != 0)
+      throw std::runtime_error(MXGetLastError());
+    mx_uint* oshape = nullptr;
+    mx_uint ondim = 0;
+    if (MXPredGetOutputShape(h_, 0, &oshape, &ondim) != 0)
+      throw std::runtime_error(MXGetLastError());
+    size_t n = 1;
+    for (mx_uint i = 0; i < ondim; ++i) n *= oshape[i];
+    std::vector<float> out(n);
+    if (MXPredGetOutput(h_, 0, out.data(),
+                        static_cast<mx_uint>(n)) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return out;
+  }
+
+ private:
+  PredictorHandle h_ = nullptr;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: predict_cpp <json> <params> <batch> <dim>\n");
+    return 2;
+  }
+  const mx_uint batch = static_cast<mx_uint>(atoi(argv[3]));
+  const mx_uint dim = static_cast<mx_uint>(atoi(argv[4]));
+  try {
+    Predictor pred(slurp(argv[1]), slurp(argv[2]), "data",
+                   {batch, dim});
+    std::vector<float> x(batch * dim);
+    for (size_t i = 0; i < x.size(); ++i)
+      x[i] = static_cast<float>(i % dim) / dim - 0.5f;
+    std::vector<float> out = pred.Run("data", x);
+    const size_t classes = out.size() / batch;
+    for (mx_uint b = 0; b < batch; ++b) {
+      size_t best = 0;
+      for (size_t c = 1; c < classes; ++c)
+        if (out[b * classes + c] > out[b * classes + best]) best = c;
+      std::printf("row %u argmax %zu\n", b, best);
+    }
+    std::printf("PREDICT_CPP_OK\n");
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -204,3 +204,19 @@ def test_actor_critic():
                              "actor_critic.py"), "--smoke"],
                timeout=540)
     assert "OK" in out, out
+
+
+def test_ctc_speech():
+    """DeepSpeech-style CTC acoustic model (reference
+    example/speech_recognition): greedy-decode label error collapses."""
+    out = _run([os.path.join(EX, "speech_recognition", "ctc_speech.py"),
+                "--smoke"], timeout=540)
+    assert "OK" in out, out
+
+
+def test_vae():
+    """Variational autoencoder (reference example/autoencoder): ELBO
+    halves and class-mean latents decode to the right prototypes."""
+    out = _run([os.path.join(EX, "autoencoder", "vae.py"), "--smoke"],
+               timeout=540)
+    assert "OK" in out, out
