@@ -1,0 +1,63 @@
+/* Autograd: imperative differentiation over the C ABI.
+ *
+ * Reference: cpp-package had no autograd (its imperative story was
+ * python-only); the grown ABI exposes MXAutograd*, so compiled
+ * frontends can train without composing a symbol graph. */
+#ifndef MXNET_CPP_AUTOGRAD_H_
+#define MXNET_CPP_AUTOGRAD_H_
+
+#include <vector>
+
+#include "c_api.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+namespace autograd {
+
+/* RAII recording scope: `{ RecordScope rec; ... }` */
+class RecordScope {
+ public:
+  explicit RecordScope(bool train_mode = true) {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    Check(MXAutogradSetIsTraining(train_mode ? 1 : 0, &prev_train_));
+  }
+  ~RecordScope() {
+    int ignore = 0;
+    MXAutogradSetIsRecording(prev_rec_, &ignore);
+    MXAutogradSetIsTraining(prev_train_, &ignore);
+  }
+  RecordScope(const RecordScope&) = delete;
+  RecordScope& operator=(const RecordScope&) = delete;
+
+ private:
+  int prev_rec_ = 0;
+  int prev_train_ = 0;
+};
+
+inline void MarkVariables(const std::vector<NDArray>& vars,
+                          const std::vector<NDArray>& grads) {
+  std::vector<NDArrayHandle> vh, gh;
+  for (const auto& v : vars) vh.push_back(v.handle());
+  for (const auto& g : grads) gh.push_back(g.handle());
+  Check(MXAutogradMarkVariables(static_cast<mx_uint>(vh.size()),
+                                vh.data(), gh.data()));
+}
+
+inline void Backward(const std::vector<NDArray>& outputs) {
+  std::vector<NDArrayHandle> oh;
+  for (const auto& o : outputs) oh.push_back(o.handle());
+  Check(MXAutogradBackward(static_cast<mx_uint>(oh.size()), oh.data(),
+                           nullptr, 0));
+}
+
+inline NDArray Grad(const NDArray& var) {
+  NDArrayHandle h = nullptr;
+  Check(MXNDArrayGetGrad(var.handle(), &h));
+  return NDArray::FromHandle(h);
+}
+
+}  // namespace autograd
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_AUTOGRAD_H_

@@ -299,3 +299,40 @@ def kv_rank(kv):
 
 def kv_num_workers(kv):
     return int(kv.num_workers)
+
+
+# ---------------------------------------------------------------------------
+# Autograd ABI (reference src/c_api/c_api_ndarray.cc MXAutograd*)
+# ---------------------------------------------------------------------------
+def autograd_set_recording(flag):
+    from . import autograd
+
+    return int(bool(autograd.set_recording(bool(flag))))
+
+
+def autograd_set_training(flag):
+    from . import autograd
+
+    return int(bool(autograd.set_training(bool(flag))))
+
+
+def autograd_mark_variables(arrays, grads):
+    from . import autograd
+
+    autograd.mark_variables(list(arrays), list(grads))
+
+
+def autograd_backward(outputs, head_grads, retain_graph, train_mode):
+    from . import autograd
+
+    hg = list(head_grads) if head_grads else None
+    autograd.backward(list(outputs), head_grads=hg,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def ndarray_get_grad(arr):
+    if arr.grad is None:
+        raise ValueError("array has no gradient buffer; call "
+                         "MXAutogradMarkVariables first")
+    return arr.grad

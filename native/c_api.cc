@@ -834,6 +834,69 @@ int MXKVStoreFree(KVStoreHandle kv) {
   return 0;
 }
 
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("autograd_set_recording",
+                            Py_BuildValue("(i)", is_recording));
+  if (!r) return fail_py("set recording failed");
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("autograd_set_training",
+                            Py_BuildValue("(i)", is_training));
+  if (!r) return fail_py("set training failed");
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            NDArrayHandle* grad_handles) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, nd_list(num_var, var_handles));
+  PyTuple_SET_ITEM(args, 1, nd_list(num_var, grad_handles));
+  PyObject* r = call_bridge("autograd_mark_variables", args);
+  if (!r) return fail_py("mark variables failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, nd_list(num_output, output_handles));
+  PyTuple_SET_ITEM(args, 1,
+                   nd_list(ograd_handles ? num_output : 0,
+                           ograd_handles));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(retain_graph));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(1));
+  PyObject* r = call_bridge("autograd_backward", args);
+  if (!r) return fail_py("backward failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("ndarray_get_grad",
+                            Py_BuildValue("(O)", obj->array));
+  if (!r) return fail_py("get grad failed");
+  *out = wrap(r);
+  return 0;
+}
+
 int MXNotifyShutdown(void) { return 0; }
 
 }  // extern "C"

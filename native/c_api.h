@@ -182,6 +182,27 @@ int MXKVStoreGetRank(KVStoreHandle kv, int *rank);
 int MXKVStoreGetGroupSize(KVStoreHandle kv, int *size);
 int MXKVStoreFree(KVStoreHandle kv);
 
+/* ---------------------------------------------------------------------
+ * Autograd ABI (reference src/c_api/c_api_ndarray.cc MXAutograd*):
+ * imperative training without the executor — record, backward, read
+ * grads.
+ * ------------------------------------------------------------------ */
+/* Returns the previous flag in *prev. */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+
+/* Attach gradient buffers to variables (grad_reqs: write). */
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            NDArrayHandle *grad_handles);
+
+/* Backward from outputs; head gradients may be NULL (loss heads). */
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+
+/* Fresh handle for the gradient attached to this array (caller frees
+ * with MXNDArrayFree). */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
 /* Reference-parity shutdown hook (engine teardown there; no-op here —
  * XLA teardown happens at process exit). */
 int MXNotifyShutdown(void);

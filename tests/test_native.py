@@ -245,3 +245,19 @@ def test_predict_abi_second_consumer(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PREDICT_CPP_OK" in r.stdout, r.stdout
     assert r.stdout.count("argmax") == 3, r.stdout
+
+
+def test_cpp_autograd_imperative_training(tmp_path):
+    """Imperative training from C++ through the autograd ABI
+    (MXAutogradMarkVariables/Backward + fused sgd_update) — the
+    gluon-style loop from compiled code, which the reference cpp-package
+    never had."""
+    r = subprocess.run(["make", "-C", NATIVE, "autograd_cpp"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    env = subprocess_env()
+    r = subprocess.run([os.path.join(NATIVE, "autograd_cpp")], env=env,
+                       cwd=str(tmp_path), capture_output=True,
+                       text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "AUTOGRAD_CPP_OK" in r.stdout, r.stdout
