@@ -37,6 +37,8 @@ class RecordScope {
 
 inline void MarkVariables(const std::vector<NDArray>& vars,
                           const std::vector<NDArray>& grads) {
+  if (vars.size() != grads.size())
+    throw std::runtime_error("MarkVariables: vars/grads size mismatch");
   std::vector<NDArrayHandle> vh, gh;
   for (const auto& v : vars) vh.push_back(v.handle());
   for (const auto& g : grads) gh.push_back(g.handle());

@@ -202,7 +202,7 @@ def test_actor_critic():
     example/reinforcement-learning): mean return doubles."""
     out = _run([os.path.join(EX, "reinforcement-learning",
                              "actor_critic.py"), "--smoke"],
-               timeout=540)
+               timeout=1200)  # worst case trains 3 seeds
     assert "OK" in out, out
 
 
