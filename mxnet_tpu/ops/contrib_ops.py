@@ -337,3 +337,20 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
             n *= s
         return seq(n).reshape(data.shape)
     return seq(data.shape[axis])
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",),
+          input_names=("data", "h", "s"))
+def _count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    """Count-sketch projection (contrib/count_sketch.cu:82 —
+    out[n, h[i]] += s[i] * data[n, i]; compact bilinear pooling's
+    building block).  One scatter-add on the MXU-friendly flattened
+    layout; the input gradient out_grad[h[i]] * s[i] is exactly the
+    jax AD of this expression."""
+    lead = data.shape[:-1]
+    d = data.reshape((-1, data.shape[-1]))
+    idx = h.reshape(-1).astype(jnp.int32)
+    sg = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((d.shape[0], int(out_dim)), data.dtype)
+    out = out.at[:, idx].add(d * sg[None, :])
+    return out.reshape(lead + (int(out_dim),))

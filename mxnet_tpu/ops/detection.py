@@ -66,6 +66,24 @@ def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     return out.astype(data.dtype)
 
 
+def _lerp2d(plane, y, x):
+    """4-tap bilinear read of a [H, W] plane at float coords (shared by
+    the ROI-pooling family; clip-to-edge semantics)."""
+    H, W = plane.shape
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    y0 = jnp.clip(y0, 0, H - 1)
+    x0 = jnp.clip(x0, 0, W - 1)
+    dy = y - jnp.floor(y)
+    dx = x - jnp.floor(x)
+    return (plane[y0, x0] * (1 - dy) * (1 - dx)
+            + plane[y0, x1] * (1 - dy) * dx
+            + plane[y1, x0] * dy * (1 - dx)
+            + plane[y1, x1] * dy * dx)
+
+
 # ---------------------------------------------------------------------------
 # IoU helper (corner format), broadcasting over trailing box dims
 # ---------------------------------------------------------------------------
@@ -293,7 +311,9 @@ def _gen_base_anchors(base_size, scales, ratios):
     return jnp.asarray(out, jnp.float32)
 
 
-@register("_contrib_Proposal", aliases=("Proposal",),
+@register("_contrib_Proposal",
+          aliases=("Proposal", "_contrib_MultiProposal",
+                   "MultiProposal"),
           input_names=("cls_prob", "bbox_pred", "im_info"), no_grad=True)
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
               rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
@@ -429,7 +449,9 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
             chan = ci * g * g + gi * g + gj
             vals = jnp.where(inside, img[chan], 0.0)
             cnt = inside.sum()
-            return jnp.where(cnt > 0, vals.sum() / cnt, 0.0)
+            # max(cnt, 1) keeps the VJP finite for empty bins
+            mean = vals.sum() / jnp.maximum(cnt, 1)
+            return jnp.where(cnt > 0, mean, 0.0)
 
         ii, jj = jnp.meshgrid(jnp.arange(p), jnp.arange(p), indexing="ij")
         out = jax.vmap(
@@ -525,3 +547,93 @@ def _deformable_conv(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1).astype(out.dtype)
     return out
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling (contrib/deformable_psroi_pooling.cu — the
+# reference has no CPU kernel at all; this jax version runs everywhere)
+# ---------------------------------------------------------------------------
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",),
+          input_names=("data", "rois", "trans"))
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False):
+    """R-FCN deformable position-sensitive pooling: each bin's sampling
+    window is displaced by a learned per-part offset (trans), averaged
+    over sample_per_part^2 bilinear taps.  Gradients w.r.t. data AND
+    trans come from jax AD (the reference ships CUDA-only kernels)."""
+    p = int(pooled_size)
+    ps = int(part_size) or p
+    sp = int(sample_per_part)
+    od = int(output_dim)
+    g = int(group_size)
+    Bc, C, H, W = data.shape
+    if no_trans or trans is None:
+        n_cls = 1
+        trans_arr = None
+    else:
+        n_cls = trans.shape[1] // 2
+        trans_arr = trans
+    ch_each = od // n_cls
+
+    def one_roi(roi, r_idx):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        sub_w, sub_h = bin_w / sp, bin_h / sp
+        img = data[b]
+
+        ii, jj = jnp.meshgrid(jnp.arange(p), jnp.arange(p),
+                              indexing="ij")           # bin coords
+
+        part_h = jnp.floor(ii / p * ps).astype(jnp.int32)
+        part_w = jnp.floor(jj / p * ps).astype(jnp.int32)
+        gh = jnp.clip((ii * g) // p, 0, g - 1)
+        gw = jnp.clip((jj * g) // p, 0, g - 1)
+
+        def bin_val(c, i, j):
+            cls = c // ch_each
+            if trans_arr is None:
+                tx = ty = 0.0
+            else:
+                tx = trans_arr[r_idx, cls * 2, part_h[i, j],
+                               part_w[i, j]] * trans_std
+                ty = trans_arr[r_idx, cls * 2 + 1, part_h[i, j],
+                               part_w[i, j]] * trans_std
+            ws = j * bin_w + x1 + tx * rw
+            hs = i * bin_h + y1 + ty * rh
+            sw = ws + jnp.arange(sp) * sub_w                 # [sp]
+            sh = hs + jnp.arange(sp) * sub_h
+            WW, HH = jnp.meshgrid(sw, sh, indexing="xy")
+            # inclusive at exactly +-0.5, like the reference kernel
+            # (it skips only w < -0.5 or w > width-0.5) — a clipped
+            # ROI's first edge tap lands exactly on -0.5
+            ok = (WW >= -0.5) & (WW <= W - 0.5) & \
+                (HH >= -0.5) & (HH <= H - 0.5)
+            wq = jnp.clip(WW, 0.0, W - 1.0)
+            hq = jnp.clip(HH, 0.0, H - 1.0)
+            chan = (c * g + gh[i, j]) * g + gw[i, j]
+            val = _lerp2d(img[chan], hq, wq)
+            cnt = ok.sum()
+            # divide by max(cnt, 1) BEFORE masking: where(cnt>0, x/cnt)
+            # still differentiates the 1/0 branch (0 * inf = NaN in the
+            # VJP) for fully out-of-image ROIs
+            mean = jnp.where(ok, val, 0.0).sum() / jnp.maximum(cnt, 1)
+            return jnp.where(cnt > 0, mean, 0.0)
+
+        flat = jax.vmap(
+            lambda c: jax.vmap(
+                lambda i, j: bin_val(c, i, j))(ii.ravel(), jj.ravel())
+        )(jnp.arange(od))
+        return flat.reshape(od, p, p)
+
+    R = rois.shape[0]
+    out = jax.vmap(one_roi)(rois, jnp.arange(R))
+    return out.astype(data.dtype)

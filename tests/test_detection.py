@@ -261,3 +261,101 @@ def test_proposal_iou_loss_decode():
     base = np.array([3.5 - 7.5, 3.5 - 7.5, 3.5 + 7.5, 3.5 + 7.5])
     want = np.clip(base + 2.0, 0, 63)
     np.testing.assert_allclose(r[0][1:], want, rtol=1e-5)
+
+
+def test_multiproposal_alias():
+    """MultiProposal == the batch form of Proposal (ours vmaps, so the
+    same kernel serves both reference op names)."""
+    rng = np.random.RandomState(3)
+    B, A, H, W = 2, 3, 6, 6
+    cls_prob = mx.nd.array(rng.uniform(0, 1, (B, 2 * A, H, W)))
+    bbox_pred = mx.nd.array(rng.uniform(-0.1, 0.1, (B, 4 * A, H, W)))
+    im_info = mx.nd.array([[96, 96, 1.0]] * B)
+    kw = dict(rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+              scales=(4,), ratios=(0.5, 1, 2))
+    a = mx.nd.contrib.MultiProposal(cls_prob, bbox_pred, im_info, **kw)
+    b = mx.nd.contrib.Proposal(cls_prob, bbox_pred, im_info, **kw)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    assert a.shape == (B * 10, 5)
+
+
+def test_count_sketch_values_and_grad():
+    """out[n, h[i]] += s[i]*x[n, i] (contrib/count_sketch.cu:82-83) and
+    the AD gradient out_grad[h[i]]*s[i]."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    d = mx.nd.array(np.array([[1., 2., 3.], [4., 5., 6.]]))
+    h = mx.nd.array(np.array([0, 2, 0]))
+    s = mx.nd.array(np.array([1., -1., 1.]))
+    out = mx.nd.contrib.count_sketch(d, h, s, out_dim=3)
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[4., 0., -2.], [10., 0., -5.]])
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8).astype(np.float32)
+    hh = mx.nd.array(rng.randint(0, 4, 8).astype(np.float32))
+    ss = mx.nd.array(rng.choice([-1.0, 1.0], 8).astype(np.float32))
+    check_numeric_gradient(
+        lambda a: mx.nd.contrib.count_sketch(a, hh, ss, out_dim=4).sum(),
+        [x])
+
+
+def test_deformable_psroi_pooling():
+    """Zero offsets reduce to plain PSROI semantics on uniform-channel
+    data; nonzero offsets shift the sampled window (reference ships
+    CUDA-only kernels — deformable_psroi_pooling.cu)."""
+    od, p, g = 2, 2, 2
+    C = od * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = mx.nd.array([[0, 0, 0, 7, 7]])
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), rois, None, spatial_scale=1.0, output_dim=od,
+        group_size=g, pooled_size=p, sample_per_part=2, no_trans=True)
+    assert out.shape == (1, od, p, p)
+    o = out.asnumpy()[0]
+    for c in range(od):
+        for i in range(p):
+            for j in range(p):
+                assert abs(o[c, i, j] - (c * g * g + i * g + j)) < 1e-5
+
+    # gradient flows to data AND trans offsets
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(1)
+    d = mx.nd.array(rng.randn(1, C, 8, 8).astype(np.float32))
+    trans = mx.nd.array(0.1 * rng.randn(1, 2, p, p).astype(np.float32))
+    d.attach_grad()
+    trans.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.DeformablePSROIPooling(
+            d, rois, trans, spatial_scale=1.0, output_dim=od,
+            group_size=g, pooled_size=p, sample_per_part=2,
+            trans_std=0.5)
+        loss = y.sum()
+    loss.backward()
+    assert float(mx.nd.abs(d.grad).sum()) > 0
+    assert float(mx.nd.abs(trans.grad).sum()) > 0
+
+
+def test_deformable_psroi_out_of_image_roi_finite_grads():
+    """Fully out-of-image ROIs (routine from RPN early in training) must
+    yield zero bins with FINITE gradients — the 0/0 guard must sit
+    before the where, or its VJP manufactures NaN."""
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    d = mx.nd.array(rng.randn(1, 8, 8, 8).astype(np.float32))
+    trans = mx.nd.array(np.zeros((1, 2, 2, 2), np.float32))
+    rois = mx.nd.array([[0, 500, 500, 600, 600]])
+    d.attach_grad()
+    trans.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.DeformablePSROIPooling(
+            d, rois, trans, spatial_scale=1.0, output_dim=2,
+            group_size=2, pooled_size=2, sample_per_part=2,
+            trans_std=0.5)
+        y.sum().backward()
+    assert np.allclose(y.asnumpy(), 0.0)
+    assert np.isfinite(d.grad.asnumpy()).all()
+    assert np.isfinite(trans.grad.asnumpy()).all()

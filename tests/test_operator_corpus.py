@@ -556,6 +556,7 @@ TESTED_ELSEWHERE = {
     # detection suite: dedicated value + gradient tests in
     # tests/test_detection.py
     "_contrib_DeformableConvolution", "_contrib_PSROIPooling",
+    "_contrib_DeformablePSROIPooling", "_contrib_count_sketch",
     # Symbol.gradient's kernel (registered lazily on first use);
     # value-tested in tests/test_fixes_r3.py::test_symbol_gradient
     "_graph_grad",
