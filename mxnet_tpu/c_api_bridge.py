@@ -336,3 +336,74 @@ def ndarray_get_grad(arr):
         raise ValueError("array has no gradient buffer; call "
                          "MXAutogradMarkVariables first")
     return arr.grad
+
+
+# ---------------------------------------------------------------------------
+# DataIter ABI (reference src/c_api/c_api.cc MXDataIter* / MXListDataIters)
+# ---------------------------------------------------------------------------
+_DATA_ITERS = ("NDArrayIter", "CSVIter", "LibSVMIter", "MNISTIter",
+               "ImageRecordIter")
+
+
+def dataiter_list():
+    return list(_DATA_ITERS)
+
+
+class _DataIterHandle:
+    """Iterator + current batch (the reference's DataIterHandle carries
+    the same cursor semantics: Next() advances, Get*() read the current
+    batch)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = next(self.it_iter)
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+    def reset(self):
+        self.it.reset()
+        self.it_iter = iter(self.it)
+
+
+def dataiter_create(name, keys, vals):
+    from . import io as _io
+
+    if name not in _DATA_ITERS:
+        raise ValueError("unknown data iter %r (have %s)"
+                         % (name, _DATA_ITERS))
+    params = {k: _parse_value(v) for k, v in zip(keys, vals)}
+    h = _DataIterHandle(getattr(_io, name)(**params))
+    h.it_iter = iter(h.it)
+    return h
+
+
+def dataiter_next(h):
+    return int(h.next())
+
+
+def dataiter_before_first(h):
+    h.reset()
+
+
+def _current_batch(h):
+    if h.batch is None:
+        raise ValueError("no current batch: call MXDataIterNext first")
+    return h.batch
+
+
+def dataiter_get_data(h):
+    return _current_batch(h).data[0]
+
+
+def dataiter_get_label(h):
+    return _current_batch(h).label[0]
+
+
+def dataiter_get_pad(h):
+    return int(_current_batch(h).pad or 0)

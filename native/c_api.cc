@@ -897,6 +897,94 @@ int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
   return 0;
 }
 
+int MXListDataIters(mx_uint* out_size, const char*** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("dataiter_list", PyTuple_New(0));
+  if (!r) return fail_py("list data iters failed");
+  return return_str_list(r, out_size, out_array);
+}
+
+int MXDataIterCreateIter(const char* name, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(name));
+  PyTuple_SET_ITEM(args, 1, str_list(num_param, keys));
+  PyTuple_SET_ITEM(args, 2, str_list(num_param, vals));
+  PyObject* r = call_bridge("dataiter_create", args);
+  if (!r) return fail_py("create data iter failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("dataiter_next",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("iter next failed");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("dataiter_before_first",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("iter reset failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int iter_get_array(const char* fn, DataIterHandle handle,
+                   NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge(fn, Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("iter get failed");
+  *out = wrap(r);
+  return 0;
+}
+}  // namespace
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_get_array("dataiter_get_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_get_array("dataiter_get_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("dataiter_get_pad",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("iter pad failed");
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
 int MXNotifyShutdown(void) { return 0; }
 
 }  // extern "C"

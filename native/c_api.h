@@ -203,6 +203,29 @@ int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
  * with MXNDArrayFree). */
 int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
 
+/* ---------------------------------------------------------------------
+ * DataIter ABI (reference MXDataIter*): file-backed iterators created
+ * by name with string params; Next() advances the cursor, Get*() read
+ * the current batch.
+ * ------------------------------------------------------------------ */
+typedef void *DataIterHandle;
+
+/* Names of creatable iterators (library-owned, valid until the next
+ * call on this thread). */
+int MXListDataIters(mx_uint *out_size, const char ***out_array);
+
+int MXDataIterCreateIter(const char *name, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+/* *out = 1 while a batch is available. */
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+/* Fresh NDArray handles for the current batch (caller frees each). */
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterFree(DataIterHandle handle);
+
 /* Reference-parity shutdown hook (engine teardown there; no-op here —
  * XLA teardown happens at process exit). */
 int MXNotifyShutdown(void);
