@@ -220,3 +220,11 @@ def test_vae():
     out = _run([os.path.join(EX, "autoencoder", "vae.py"), "--smoke"],
                timeout=540)
     assert "OK" in out, out
+
+
+def test_bi_lstm_sort():
+    """BiLSTM digit-sequence sorting (reference example/bi-lstm-sort):
+    per-position accuracy > 0.9 and most sequences sort exactly."""
+    out = _run([os.path.join(EX, "bi-lstm-sort", "sort_io.py"),
+                "--smoke"], timeout=540)
+    assert "OK" in out, out
