@@ -485,30 +485,53 @@ class KVStore:
         return nd.NDArray(q, ctx=grad.context)
 
     # -- barrier / misc ---------------------------------------------------
+    # A foreign (reference-installation) load_optimizer_states unpickles
+    # whatever bytes it is given; without a marker it would silently
+    # install a wrapper dict as optimizer states.  So: files with no
+    # host-row state are written as the RAW updater blob (foreign-
+    # compatible), and files that need the wrapper carry a magic header
+    # no unpickler accepts, making foreign readers fail loudly.
+    _STATES_MAGIC = b"MXTPU_KV_STATES\x00"
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
-        payload = {"updater": self._updater.get_states(dump_optimizer)}
         # host-row tables keep per-row optimizer state outside the
         # Updater; resume must not silently reset momentum/moments
-        host = {k: {"states": getattr(s, "opt_state_rows", {}),
-                    "counts": getattr(s, "row_update_count", {})}
-                for k, s in self._host_rows.items()}
-        if host:
-            payload["host_rows"] = host
+        # only tables that actually hold per-row state force the wrapper;
+        # an untouched host-row table must not make the file foreign-
+        # unreadable for nothing
+        host = {k: d for k, d in
+                ((k, {"states": getattr(s, "opt_state_rows", {}),
+                      "counts": getattr(s, "row_update_count", {})})
+                 for k, s in self._host_rows.items())
+                if d["states"] or d["counts"]}
+        blob = self._updater.get_states(dump_optimizer)
         with open(fname, "wb") as fout:
-            fout.write(pickle.dumps(payload))
+            if host:
+                fout.write(self._STATES_MAGIC)
+                fout.write(pickle.dumps({"updater": blob, "host_rows": host}))
+            else:
+                fout.write(blob)
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for distributed training"
         with open(fname, "rb") as f:
             raw = f.read()
-        try:
-            payload = pickle.loads(raw)
-        except Exception:
-            payload = None
-        if not isinstance(payload, dict) or "updater" not in payload:
-            self._updater.set_states(raw)  # legacy plain-updater file
-            return
+        if not raw.startswith(self._STATES_MAGIC):
+            # either a plain updater blob, or a wrapper dict written by
+            # an earlier revision (pre-magic-header); the literal
+            # "updater" key is the discriminator — real updater state
+            # dicts are keyed by parameter index
+            try:
+                maybe = pickle.loads(raw)
+            except Exception:
+                maybe = None
+            if not (isinstance(maybe, dict) and "updater" in maybe):
+                self._updater.set_states(raw)  # plain updater blob
+                return
+            payload = maybe
+        else:
+            payload = pickle.loads(raw[len(self._STATES_MAGIC):])
         self._updater.set_states(payload["updater"])
         for k, d in payload.get("host_rows", {}).items():
             if k in self._host_rows:

@@ -32,6 +32,7 @@ are wrapped by the caller.
 from __future__ import annotations
 
 import struct
+import warnings
 
 import numpy as np
 
@@ -131,6 +132,9 @@ def _read_one(r):
     aux_meta = []
     for _ in range(_NUM_AUX[stype]):
         aux_flag = r.i32()
+        if aux_flag not in _FLAG_TO_DTYPE:
+            raise ValueError("invalid NDArray file format: aux dtype "
+                             "flag %d" % aux_flag)
         aux_meta.append((_FLAG_TO_DTYPE[aux_flag], r.shape()))
     data = r.raw(dtype, sshape if sshape is not None else shape)
     aux = [r.raw(adt, ashape) for adt, ashape in aux_meta]
@@ -194,7 +198,11 @@ def _write_one(out, arr):
     """Write one dense numpy array as a V2 record."""
     arr = np.ascontiguousarray(arr)
     if arr.dtype not in _DTYPE_TO_FLAG:
-        # bfloat16 etc. have no reference type flag; widen to float32
+        # bfloat16 etc. have no reference type flag; the round-trip
+        # changes dtype, so make that visible instead of silent
+        warnings.warn("dtype %s has no reference NDArray type flag; "
+                      "saving as float32 (round-trip will not restore "
+                      "the original dtype)" % arr.dtype, stacklevel=3)
         arr = arr.astype(np.float32)
     if arr.ndim == 0:
         # a 0-dim shape means "none" in the reference format; a scalar

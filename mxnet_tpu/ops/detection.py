@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+from .spatial import c_round
 
 __all__ = []
 
@@ -423,11 +424,12 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
-        # reference rounds the roi to the feature grid
-        x1 = jnp.round(roi[1]) * spatial_scale
-        y1 = jnp.round(roi[2]) * spatial_scale
-        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
-        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        # reference rounds the roi to the feature grid with C round()
+        # and adds 1 AFTER rounding the far edge
+        x1 = c_round(roi[1]) * spatial_scale
+        y1 = c_round(roi[2]) * spatial_scale
+        x2 = (c_round(roi[3]) + 1.0) * spatial_scale
+        y2 = (c_round(roi[4]) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w, bin_h = rw / p, rh / p
@@ -580,10 +582,10 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
 
     def one_roi(roi, r_idx):
         b = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
-        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
-        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
-        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        x1 = c_round(roi[1]) * spatial_scale - 0.5
+        y1 = c_round(roi[2]) * spatial_scale - 0.5
+        x2 = (c_round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (c_round(roi[4]) + 1.0) * spatial_scale - 0.5
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w, bin_h = rw / p, rh / p

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register
+from .tensor import c_round
 
 __all__ = []
 
@@ -121,10 +122,10 @@ def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
 
     def one_roi(roi):
         bi = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale)
-        y1 = jnp.round(roi[2] * spatial_scale)
-        x2 = jnp.round(roi[3] * spatial_scale)
-        y2 = jnp.round(roi[4] * spatial_scale)
+        x1 = c_round(roi[1] * spatial_scale)
+        y1 = c_round(roi[2] * spatial_scale)
+        x2 = c_round(roi[3] * spatial_scale)
+        y2 = c_round(roi[4] * spatial_scale)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         img = data[bi]  # (C, H, W)

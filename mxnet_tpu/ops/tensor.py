@@ -15,6 +15,18 @@ from jax import lax
 
 from .registry import register
 
+
+def c_round(x):
+    """C ``round()`` — half away from zero, exact for either sign.
+
+    The reference rounds with C semantics (``mshadow_op.h`` ``round``,
+    ROI-op coordinate snapping); numpy/jnp ``round`` is half-to-even,
+    which differs exactly at halves: C gives 1.5 -> 2, 2.5 -> 3,
+    -1.5 -> -2 while jnp gives 2, 2, -2.
+    """
+    return jnp.trunc(x + jnp.where(x >= 0, 0.5, -0.5))
+
+
 # ---------------------------------------------------------------------------
 # unary elementwise
 # ---------------------------------------------------------------------------
@@ -48,7 +60,9 @@ _UNARY = {
     "arctanh": jnp.arctanh,
     "floor": jnp.floor,
     "ceil": jnp.ceil,
-    "round": jnp.round,
+    # reference round is C round() (half away from zero, mshadow_op.h);
+    # rint keeps half-to-even — the two differ exactly at halves
+    "round": lambda x: c_round(x),
     "rint": jnp.rint,
     "trunc": jnp.trunc,
     "fix": jnp.trunc,

@@ -155,11 +155,21 @@ def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
                 lax.dynamic_update_index_in_dim(queue, x_in, fm % Q, 0),
                 queue)
             y = fwd(params, x_in)
-            # last stage: loss + its gradient, immediately
+            # last stage: loss + its gradient, immediately.  Gated with
+            # lax.cond so the P-1 non-last stages skip the loss+grad
+            # computation at runtime instead of computing and discarding
+            # it every step.
             tgt = targets[fmc]
-            loss_m, dloss = jax.value_and_grad(
-                lambda yy: loss_fn(yy, tgt))(y)
             is_last = my == P - 1
+
+            def _loss_and_dloss(yy):
+                l, d = jax.value_and_grad(
+                    lambda q: loss_fn(q, tgt))(yy)
+                return jnp.float32(l), d
+
+            loss_m, dloss = lax.cond(
+                is_last, _loss_and_dloss,
+                lambda yy: (jnp.float32(0.0), jnp.zeros_like(yy)), y)
             loss_acc = loss_acc + jnp.where(active_f & is_last,
                                             loss_m, 0.0)
             outbuf = jnp.where(

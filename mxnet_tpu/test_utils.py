@@ -140,9 +140,11 @@ def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4,
         else:
             with jax.disable_jit():
                 r = f(*args)
-        if isinstance(r, (list, tuple)):  # multi-output ops: first out
-            r = r[0]
-        return np.asarray(r.astype("float32").data)
+        if not isinstance(r, (list, tuple)):
+            r = [r]
+        # every output participates in the cross-check (secondary
+        # outputs — masks, indices — regress independently of the first)
+        return [np.asarray(o.astype("float32").data) for o in r]
 
     outs = []
     if len(devices) == 1:
@@ -158,7 +160,11 @@ def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4,
         floor_r, floor_a = _device_tolerance_floor()
         fp32_r, fp32_a = max(rtol, floor_r), max(atol, floor_a)
     for o in outs[1:]:
-        np.testing.assert_allclose(outs[0], o, rtol=fp32_r, atol=fp32_a)
+        assert len(o) == len(outs[0]), "output arity mismatch across legs"
+        for k, (ref_k, got_k) in enumerate(zip(outs[0], o)):
+            np.testing.assert_allclose(ref_k, got_k, rtol=fp32_r,
+                                       atol=fp32_a,
+                                       err_msg="output %d" % k)
 
     # one reduced-precision leg per DISTINCT device (same-device ctx
     # entries would just repeat identical work)
@@ -175,9 +181,11 @@ def check_consistency(f, input_shapes, ctx_list=None, rtol=1e-4,
         for ctx in dtype_ctxs:
             with ctx:
                 got = run(ctx, dtype)
-            np.testing.assert_allclose(
-                outs[0], got, rtol=max(dr, rtol), atol=max(da, atol),
-                err_msg="dtype %s on %r vs fp32 oracle" % (dtype, ctx))
+            for k, (ref_k, got_k) in enumerate(zip(outs[0], got)):
+                np.testing.assert_allclose(
+                    ref_k, got_k, rtol=max(dr, rtol), atol=max(da, atol),
+                    err_msg="output %d dtype %s on %r vs fp32 oracle"
+                            % (k, dtype, ctx))
 
 
 def same(a, b):

@@ -1,0 +1,143 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md round 3)."""
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_check_consistency_checks_all_outputs():
+    """Multi-output ops must cross-check EVERY output; a regression in
+    a secondary output (e.g. a mask) must be caught (ADVICE round 3,
+    test_utils.py)."""
+    from mxnet_tpu import test_utils as tu
+
+    # healthy multi-output function passes
+    tu.check_consistency(lambda x: (x + 1, x * 2), [(3, 4)])
+
+    # a function whose SECOND output drifts between legs must fail
+    calls = {"n": 0}
+
+    def drifting(x):
+        calls["n"] += 1
+        return x + 1, x * 0 + calls["n"]
+
+    with pytest.raises(AssertionError):
+        tu.check_consistency(drifting, [(3, 4)])
+
+
+def test_save_optimizer_states_raw_blob_when_no_host_rows(tmp_path):
+    """With no host-row tables the states file must be the RAW updater
+    blob (foreign-readable); with host rows it must carry a magic header
+    so foreign unpicklers fail loudly (ADVICE round 3, kvstore.py)."""
+    kv = mx.kv.create("local")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    kv.set_optimizer(opt)
+    kv.init(3, nd.zeros((4,)))
+    kv.push(3, nd.ones((4,)))
+    out = nd.zeros((4,))
+    kv.pull(3, out=out)
+
+    path = str(tmp_path / "states")
+    kv.save_optimizer_states(path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    # exactly the updater blob — what a reference installation expects
+    assert raw == kv._updater.get_states(False)
+    assert not raw.startswith(kv._STATES_MAGIC)
+    kv.load_optimizer_states(path)  # round-trips
+
+    # an UNTOUCHED host-row table holds no per-row state: file must stay
+    # a raw (foreign-readable) blob
+    kv.init_host_rows("emb", shape=(100, 8))
+    kv.save_optimizer_states(path)
+    with open(path, "rb") as f:
+        assert not f.read().startswith(kv._STATES_MAGIC)
+
+    # once rows carry optimizer state -> wrapper with magic header
+    kv.push("emb", nd.ones((1, 8)), row_ids=np.array([3]))
+    kv.save_optimizer_states(path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    assert raw.startswith(kv._STATES_MAGIC)
+    with pytest.raises(Exception):
+        pickle.loads(raw)  # foreign reader fails loudly, not silently
+    kv.load_optimizer_states(path)
+
+
+def test_legacy_wrapper_states_file_loads(tmp_path):
+    """A states file written by the previous revision (pickled wrapper
+    dict, no magic header) must still load its updater blob — not
+    install the wrapper itself as optimizer state."""
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.init(7, nd.zeros((4,)))
+    kv.push(7, nd.ones((4,)))
+    out = nd.zeros((4,))
+    kv.pull(7, out=out)
+    blob = kv._updater.get_states(False)
+    path = str(tmp_path / "legacy.states")
+    with open(path, "wb") as f:
+        f.write(pickle.dumps({"updater": blob}))
+    kv.load_optimizer_states(path)
+    assert kv._updater.get_states(False) == blob
+
+
+def test_round_op_c_semantics():
+    """mx.nd.round must follow C round (half away from zero) like the
+    reference's mshadow_op round; rint stays half-to-even."""
+    x = nd.array(np.array([0.5, 1.5, 2.5, -0.5, -1.5, -2.5], np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.round(x).asnumpy(), [1, 2, 3, -1, -2, -3])
+    np.testing.assert_array_equal(
+        mx.nd.rint(x).asnumpy(), [0, 2, 2, -0, -2, -2])
+
+
+def test_psroi_pooling_half_integer_roi_c_round():
+    """ROI edges at half-integer coords must follow the reference's
+    round(x)+1 with C round-half-away semantics — not round(x+1) with
+    numpy round-half-even (ADVICE round 3, detection.py)."""
+    H = W = 5
+    data = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    # x2 = y2 = 1.5: C round -> 2, +1 -> 3  => bin covers rows/cols 0..2
+    rois = np.array([[0, 0, 0, 1.5, 1.5]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=1, pooled_size=1).asnumpy()
+    expected = data[0, 0, :3, :3].mean()
+    np.testing.assert_allclose(out[0, 0, 0, 0], expected, rtol=1e-6)
+
+
+def test_dmlc_serde_bad_aux_flag_raises_format_error():
+    """Corrupt aux dtype flags must raise the module's loud format error,
+    not a bare KeyError (ADVICE round 3, dmlc_serde.py)."""
+    from mxnet_tpu.ndarray import dmlc_serde as serde
+
+    # hand-craft a V2 row_sparse record with an invalid aux type flag
+    out = [struct.pack("<QQQ", serde.LIST_MAGIC, 0, 1)]
+    out.append(struct.pack("<I", serde.V2_MAGIC))
+    out.append(struct.pack("<i", 1))            # stype row_sparse
+    out.append(struct.pack("<Iq", 1, 1))        # storage shape (1,)
+    out.append(struct.pack("<Iqq", 2, 4, 2))    # logical shape (4, 2)
+    out.append(struct.pack("<ii", 1, 0))        # ctx
+    out.append(struct.pack("<i", 0))            # float32 data
+    out.append(struct.pack("<i", 99))           # INVALID aux flag
+    buf = b"".join(out)
+    with pytest.raises(ValueError, match="invalid NDArray file format"):
+        serde.loads(buf)
+
+
+def test_dmlc_serde_dumps_warns_on_flagless_dtype():
+    """Saving a dtype with no reference type flag must warn — the
+    round-trip changes dtype (ADVICE round 3, dmlc_serde.py)."""
+    from mxnet_tpu.ndarray import dmlc_serde as serde
+    import jax.numpy as jnp
+
+    arr = np.asarray(jnp.ones((2, 2), jnp.bfloat16))
+    with pytest.warns(UserWarning, match="no reference NDArray type flag"):
+        buf = serde.dumps([arr])
+    arrays, _, _ = serde.loads(buf)
+    assert arrays[0].dtype == np.float32
