@@ -128,16 +128,23 @@ class _Node:
         self.out_refs = ()            # weakrefs to output NDArrays
 
 
-def _record(opdef, inputs, params, rng, train, outputs):
-    """Called by registry.invoke after an op executed while recording."""
+def _record(opdef, inputs, params, rng, train, outputs, in_datas=None):
+    """Called by registry.invoke after an op executed while recording.
+
+    ``in_datas``: the input device arrays AS CONSUMED by the op.  The
+    dispatcher's mutate write-back runs before recording, so re-reading
+    ``x.data`` here would snapshot post-mutation values and replay the
+    op against its own output (e.g. a mutated aux state applied twice).
+    """
     from .ops.registry import split_params, _freeze
     from .ndarray.ndarray import NDArray
 
     static, arrs = split_params(opdef, params)
     entries, consts = [], []
     tracked = False
-    for x in inputs:
+    for i, x in enumerate(inputs):
         if isinstance(x, NDArray):
+            data = in_datas[i] if in_datas is not None else x.data
             e = x._tape_entry
             if e is not None:
                 entries.append(e)
@@ -145,13 +152,13 @@ def _record(opdef, inputs, params, rng, train, outputs):
                 continue
             if x._grad_req is not None and x._grad_req != "null":
                 if x._tape_var is None:
-                    x._tape_var = _Var(x.data, x._grad_req, owner=x)
+                    x._tape_var = _Var(data, x._grad_req, owner=x)
                 else:
-                    x._tape_var.array = x.data
+                    x._tape_var.array = data
                 entries.append(("var", x._tape_var))
                 tracked = True
                 continue
-            consts.append(x.data)
+            consts.append(data)
             entries.append(("const", len(consts) - 1))
         else:
             consts.append(jnp.asarray(x))

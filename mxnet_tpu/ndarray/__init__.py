@@ -90,6 +90,27 @@ def imdecode(buf, **kwargs):  # pragma: no cover - host-side opencv-free decode
     return array(img)
 
 
+def cast_storage(data, stype="default", out=None):
+    """Storage-type cast (reference op ``cast_storage``): returns ``data``
+    re-wrapped as the requested stype.  Dense-backed sparse storage means
+    the device buffer is reused — only the wrapper (and its cached
+    indices/indptr view) changes.  Dispatches through the registered
+    identity op so the autograd tape records it (the reference op is a
+    differentiable identity)."""
+    res = _invoke("cast_storage", [data], {"stype": stype})
+    wrapped = res.tostype(stype)
+    if wrapped is not res:
+        wrapped._tape_entry = res._tape_entry  # keep the recorded node
+    if out is not None:
+        if out.stype != wrapped.stype:
+            raise ValueError(
+                "cast_storage: out has stype %r but %r was requested"
+                % (out.stype, stype))
+        out._set_data(wrapped.data)
+        return out
+    return wrapped
+
+
 def onehot_encode(indices, out):
     depth = out.shape[1]
     res = _invoke("one_hot", [indices], {"depth": depth})

@@ -303,10 +303,14 @@ class NDArray:
         return _wrap(jnp.ones(self.shape, self.dtype), self._ctx)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage is handled by mxnet_tpu.ndarray.sparse")
-        return self
+        if stype == "default":
+            return self
+        from .sparse import CSRNDArray, RowSparseNDArray
+        if stype == "row_sparse":
+            return RowSparseNDArray(self._data, ctx=self._ctx)
+        if stype == "csr":
+            return CSRNDArray(self._data, ctx=self._ctx)
+        raise ValueError("unknown storage type %r" % (stype,))
 
     # -- arithmetic -----------------------------------------------------
     def _binop(self, op, other, reverse=False):

@@ -57,3 +57,50 @@ def _unravel_index(data, shape=None):
     for s, dim in zip(strides, shape):
         out.append((rem // int(s)) % int(dim))
     return jnp.stack(out).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (identity_attach_KL_sparse_reg.cc)
+# ---------------------------------------------------------------------------
+@register("IdentityAttachKLSparseReg", input_names=("data", "moving_avg"),
+          train_aware=True, num_outputs=2, mutate={1: 1},
+          visible_out=lambda attrs: [0])
+def _identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9,
+                                   _train=False):
+    """Identity forward that attaches a KL sparseness penalty to the
+    gradient (reference ``identity_attach_KL_sparse_reg-inl.h``: pair it
+    with sigmoid activations; ``moving_avg`` is the aux running mean of
+    each unit's activation).
+
+    TPU-native timing note: the reference folds the moving-average update
+    into the BACKWARD pass; functionally we update it in the forward when
+    training (like BatchNorm's moving stats) and the backward reads the
+    updated value — identical state after any fwd+bwd step, and inference
+    (``_train=False``) leaves the aux untouched either way.
+    """
+    t = float(sparseness_target)
+    pen = float(penalty)
+    mom = float(momentum)
+    d2 = data.reshape(data.shape[0], -1)            # (batch, units)
+    if _train:
+        avg = d2.mean(axis=0)
+        new_mavg = mom * moving_avg + (1 - mom) * avg
+    else:
+        new_mavg = moving_avg
+    new_mavg = jax.lax.stop_gradient(new_mavg)
+
+    @jax.custom_vjp
+    def attach(x, m):
+        return x
+
+    def attach_fwd(x, m):
+        return x, m
+
+    def attach_bwd(m, g):
+        kl = pen * (-t / m + (1 - t) / (1 - m))     # dKL/d(unit mean)
+        g2 = g.reshape(g.shape[0], -1) + kl[None, :]
+        return g2.reshape(g.shape), jnp.zeros_like(m)
+
+    attach.defvjp(attach_fwd, attach_bwd)
+    return attach(data, new_mavg), new_mavg

@@ -339,3 +339,110 @@ def _sparse_adam_update(weight, grad, indices, mean, var, lr=0.001,
     new_rows = rows - lr * m / (jnp.sqrt(v) + epsilon)
     return (weight.at[idx].set(new_rows), mean.at[idx].set(m),
             var.at[idx].set(v))
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused updates (reference: src/operator/optimizer_op.cc
+# multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_update /
+# multi_mp_sgd_mom_update — one kernel updating MANY small params).
+# TPU-native: one jitted XLA module over the whole interleaved list —
+# exactly the per-dispatch-overhead case FusedTrainStep exists for, now
+# available to Trainer/Module without buying the full fused step.
+# Inputs are interleaved per weight ((w, g[, state...]) * num_weights);
+# outputs are all new weights, then all new states, and the dispatcher
+# writes every one back in place via the dynamic mutate map.
+# ---------------------------------------------------------------------------
+_MULTI_AP = ("lrs", "wds", "rescale_grad")
+
+
+def _multi_mutate(stride, state_slots):
+    def mut(params, n_inputs):
+        n = int(params.get("num_weights", n_inputs // stride))
+        m = {i: stride * i for i in range(n)}
+        for si, slot in enumerate(state_slots):
+            for i in range(n):
+                m[(si + 1) * n + i] = stride * i + slot
+        return m
+    return mut
+
+
+def _multi_groups(arrays, stride, num_weights, lrs, wds):
+    n = int(num_weights)
+    assert len(arrays) == n * stride, (
+        "multi-update expects %d interleaved arrays for num_weights=%d, "
+        "got %d" % (n * stride, n, len(arrays)))
+    # lrs/wds are traced vectors with STATIC length — a short list would
+    # otherwise clamp-index and silently train with the wrong lr/wd
+    assert lrs.shape[0] == n, \
+        "multi-update: %d lrs for num_weights=%d" % (lrs.shape[0], n)
+    assert wds.shape[0] == n, \
+        "multi-update: %d wds for num_weights=%d" % (wds.shape[0], n)
+    return [arrays[i::stride] for i in range(stride)]
+
+
+def _multi_visible(attrs):
+    # reference parity: only the updated weights are visible outputs;
+    # momentum/master-copy states write back through the mutate map
+    return list(range(int(attrs.get("num_weights", 1))))
+
+
+@register("multi_sgd_update", mutate=_multi_mutate(2, ()),
+          array_params=_MULTI_AP, no_grad=True,
+          visible_out=_multi_visible)
+def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    ws, gs = _multi_groups(arrays, 2, num_weights, lrs, wds)
+    outs = []
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        g = _prep(g, rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", mutate=_multi_mutate(3, (2,)),
+          array_params=_MULTI_AP, no_grad=True,
+          visible_out=_multi_visible)
+def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    ws, gs, moms = _multi_groups(arrays, 3, num_weights, lrs, wds)
+    new_ws, new_moms = [], []
+    for i, (w, g, m) in enumerate(zip(ws, gs, moms)):
+        g = _prep(g, rescale_grad, clip_gradient)
+        nm = momentum * m - lrs[i] * (g + wds[i] * w)
+        new_ws.append(w + nm)
+        new_moms.append(nm)
+    return tuple(new_ws) + tuple(new_moms)
+
+
+@register("multi_mp_sgd_update", mutate=_multi_mutate(3, (2,)),
+          array_params=_MULTI_AP, no_grad=True,
+          visible_out=_multi_visible)
+def _multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    ws, gs, w32s = _multi_groups(arrays, 3, num_weights, lrs, wds)
+    new_ws, new_w32s = [], []
+    for i, (w, g, w32) in enumerate(zip(ws, gs, w32s)):
+        g = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        n32 = w32 - lrs[i] * (g + wds[i] * w32)
+        new_ws.append(n32.astype(w.dtype))
+        new_w32s.append(n32)
+    return tuple(new_ws) + tuple(new_w32s)
+
+
+@register("multi_mp_sgd_mom_update", mutate=_multi_mutate(4, (2, 3)),
+          array_params=_MULTI_AP, no_grad=True,
+          visible_out=_multi_visible)
+def _multi_mp_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1):
+    ws, gs, moms, w32s = _multi_groups(arrays, 4, num_weights, lrs, wds)
+    new_ws, new_moms, new_w32s = [], [], []
+    for i, (w, g, m, w32) in enumerate(zip(ws, gs, moms, w32s)):
+        g = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        nm = momentum * m - lrs[i] * (g + wds[i] * w32)
+        n32 = w32 + nm
+        new_ws.append(n32.astype(w.dtype))
+        new_moms.append(nm)
+        new_w32s.append(n32)
+    return tuple(new_ws) + tuple(new_moms) + tuple(new_w32s)

@@ -75,6 +75,7 @@ class OpDef:
         "train_aware",
         "array_params",
         "mutate",
+        "mutate_fn",
         "num_outputs",
         "no_grad",
         "aliases",
@@ -92,7 +93,16 @@ class OpDef:
         self.needs_rng = needs_rng
         self.train_aware = train_aware
         self.array_params = tuple(array_params)
-        self.mutate = dict(mutate or {})
+        # variadic ops (multi_sgd_*) don't know their write-back map
+        # until invocation: a callable ``mutate(params, n_inputs)``
+        # computes it per call; graph paths see an empty static map
+        # (these update kernels are imperative-only, like the reference)
+        if callable(mutate):
+            self.mutate_fn = mutate
+            self.mutate = {}
+        else:
+            self.mutate_fn = None
+            self.mutate = dict(mutate or {})
         self.num_outputs = num_outputs
         self.no_grad = no_grad
         self.aliases = tuple(aliases)
@@ -158,7 +168,10 @@ def split_params(opdef, params):
         if v is None:
             continue
         if k in opdef.array_params:
-            arrs.append((k, v if hasattr(v, "dtype") else np.float32(v)))
+            # tuples/lists (multi-tensor lrs/wds) become traced f32
+            # vectors; scalars become traced f32 scalars
+            arrs.append((k, v if hasattr(v, "dtype")
+                         else np.asarray(v, dtype=np.float32)))
         else:
             static[k] = v
     return static, arrs
@@ -333,6 +346,17 @@ def get_op(name):
     return OPS[name]
 
 
+def list_ops(distinct=True):
+    """Sorted op names: canonical distinct ops by default, every
+    registered name (aliases included) with ``distinct=False``.
+
+    This is the source of truth for any published op count (reference:
+    MXListAllOpNames, ``src/c_api/c_api_symbolic.cc``)."""
+    if distinct:
+        return sorted({op.name for op in OPS.values()})
+    return sorted(OPS.keys())
+
+
 def invoke(op_name, ndarray_inputs, params=None, out=None):
     """Imperative dispatch of a registered op on NDArray inputs.
 
@@ -373,14 +397,22 @@ def _invoke_impl(op_name, ndarray_inputs, params=None, out=None):
     if not isinstance(results, (tuple, list)):
         results = (results,)
 
+    mut = (opdef.mutate_fn(params, len(inputs)) if opdef.mutate_fn
+           else opdef.mutate)
     outputs = []
     for i, r in enumerate(results):
-        if i in opdef.mutate:
-            tgt = inputs[opdef.mutate[i]]
+        if i in mut:
+            tgt = inputs[mut[i]]
             tgt._set_data(r)
             outputs.append(tgt)
         else:
             outputs.append(_wrap(r, ctx=inputs[0].context if inputs and isinstance(inputs[0], NDArray) else None))
+
+    if opdef.mutate_fn is not None and opdef.visible_out is not None:
+        # variadic update kernels: state outputs already wrote back via
+        # the mutate map; only the reference-visible outputs (the
+        # updated weights) surface to the caller
+        outputs = [outputs[j] for j in opdef.visible_out(params)]
 
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -390,6 +422,7 @@ def _invoke_impl(op_name, ndarray_inputs, params=None, out=None):
         outputs = list(outs)
 
     if autograd.is_recording() and not opdef.no_grad:
-        autograd._record(opdef, inputs, params, rng, train, outputs)
+        autograd._record(opdef, inputs, params, rng, train, outputs,
+                         in_datas=datas)
 
     return outputs[0] if len(outputs) == 1 else outputs

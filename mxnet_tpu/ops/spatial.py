@@ -222,3 +222,57 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
 
     f.defvjp(fwd, bwd)
     return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (correlation.cc — FlowNet cost-volume layer)
+# ---------------------------------------------------------------------------
+@register("Correlation", input_names=("data1", "data2"))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """Cross-correlation cost volume between two feature maps (reference
+    ``src/operator/correlation.cc`` CorrelationForward).
+
+    TPU-native: instead of the reference's per-output-pixel gather loops,
+    each of the (2r+1)^2 displacements is one fused elementwise-product +
+    channel-sum + ``reduce_window`` box filter over the whole map — all
+    MXU/VPU-friendly static-shape dataflow; the displacement loop is
+    unrolled at trace time.  Backward comes from jax AD, which matches the
+    reference's hand-written CorrelationBackward (linear ops + abs).
+    """
+    k = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2, p = int(stride1), int(stride2), int(pad_size)
+    mult = str(is_multiply).lower() in ("true", "1")
+    assert k % 2 == 1, "kernel size should be odd"
+    B, C, H, W = data1.shape
+    rad = md // s2                       # neighborhood_grid_radius_
+    gw = 2 * rad + 1                     # neighborhood_grid_width_
+    kr = (k - 1) // 2
+    border = md + kr
+    ph, pw = H + 2 * p, W + 2 * p
+    top_h = -(-(ph - 2 * border) // s1)  # ceil-div, like the reference
+    top_w = -(-(pw - 2 * border) // s1)
+    assert top_h >= 1 and top_w >= 1, \
+        "Correlation: input too small for max_displacement/kernel"
+    sumelems = k * k * C
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    if mult:
+        pointwise = lambda a, b: (a * b).sum(axis=1)          # noqa: E731
+    else:
+        pointwise = lambda a, b: jnp.abs(a - b).sum(axis=1)   # noqa: E731
+    outs = []
+    for tc in range(gw * gw):
+        s2o = (tc % gw - rad) * s2       # x-displacement
+        s2p = (tc // gw - rad) * s2      # y-displacement
+        # p2 shifted so index (y, x) reads p2[y + s2p, x + s2o]; sampled
+        # windows never reach the wrapped region (border >= |s2p|+kr)
+        shifted = jnp.roll(p2, (-s2p, -s2o), axis=(2, 3))
+        corr = pointwise(p1, shifted)                # (B, ph, pw)
+        win = jax.lax.reduce_window(
+            corr, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "valid")
+        sl = win[:, md:md + top_h * s1:s1, md:md + top_w * s1:s1]
+        outs.append(sl / sumelems)
+    return jnp.stack(outs, axis=1)                   # (B, gw*gw, th, tw)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register
@@ -76,6 +77,8 @@ _UNARY = {
     "logical_not": lambda x: (x == 0).astype(x.dtype),
     "isnan": jnp.isnan,
     "isinf": jnp.isinf,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
 }
 
 for _name, _f in _UNARY.items():
@@ -404,6 +407,86 @@ def _broadcast_axis(x, axis=None, size=None):
     for a, s in zip(axes, sizes):
         tgt[a] = s
     return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("shape_array", no_grad=True)
+def _shape_array(x):
+    """Shape of the input as a 1-D integer tensor (reference
+    ``src/operator/tensor/matrix_op.cc`` shape_array — int64 there;
+    int64 here under MXNET_INT64_TENSOR_SIZE, else device int32)."""
+    return jnp.asarray(np.array(x.shape, np.int64), dtype=_index_dtype())
+
+
+@register("size_array", no_grad=True)
+def _size_array(x):
+    """Number of elements as a (1,) integer tensor (reference
+    size_array; dtype policy as shape_array)."""
+    return jnp.asarray(np.array([int(np.prod(x.shape, dtype=np.int64))],
+                                np.int64), dtype=_index_dtype())
+
+
+@register("reshape_like", input_names=("lhs", "rhs"))
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None):
+    """Reshape ``lhs`` to ``rhs``'s shape, optionally splicing only the
+    [begin, end) dim ranges (reference matrix_op.cc reshape_like).  Only
+    ``lhs``'s VALUES flow through; ``rhs`` contributes shape alone, so
+    its gradient is zero — which jax AD produces for free."""
+    def _rng(begin, end, ndim):
+        b = 0 if begin is None else int(begin)
+        e = ndim if end is None else int(end)
+        b += ndim if b < 0 else 0
+        e += ndim if e < 0 else 0
+        return b, e
+    lb, le = _rng(lhs_begin, lhs_end, len(lhs.shape))
+    rb, re = _rng(rhs_begin, rhs_end, len(rhs.shape))
+    tgt = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, tgt)
+
+
+@register("broadcast_like", input_names=("lhs", "rhs"))
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast ``lhs`` to ``rhs``'s shape (reference matrix_op.cc
+    broadcast_like); with axis lists only those dims take ``rhs``'s
+    extent.  ``rhs`` is shape-only, so its gradient is zero."""
+    if lhs_axes is None and rhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    la = tuple(lhs_axes or ())
+    ra = tuple(rhs_axes or ())
+    assert len(la) == len(ra) and la, \
+        "broadcast_like: lhs_axes and rhs_axes must pair up"
+    tgt = list(lhs.shape)
+    for a, b in zip(la, ra):
+        a += len(lhs.shape) if a < 0 else 0
+        b += len(rhs.shape) if b < 0 else 0
+        assert lhs.shape[a] == 1, \
+            "broadcast_like: lhs dim %d must be 1, got %d" % (a, lhs.shape[a])
+        tgt[a] = rhs.shape[b]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats, num_args=None):
+    """Column-wise Khatri-Rao product (reference contrib/krprod.cc):
+    column k of the output is kron(A1[:, k], ..., An[:, k]); shapes
+    (M1, N) x ... x (Mn, N) -> (M1*...*Mn, N)."""
+    out = mats[0]
+    for m in mats[1:]:
+        assert m.shape[1] == out.shape[1], \
+            "khatri_rao: all matrices need the same number of columns"
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("cast_storage")
+def _cast_storage(x, stype="default"):
+    """Storage-type cast (reference cast_storage-inl.h).  Dense-backed
+    sparse means the device values are IDENTICAL across stypes — the
+    graph-level op is identity compute; the NDArray frontend re-wraps
+    the result in the requested stype (ndarray/__init__.py
+    cast_storage)."""
+    assert stype in ("default", "row_sparse", "csr"), stype
+    return x
 
 
 @register("tile")

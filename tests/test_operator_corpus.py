@@ -34,6 +34,8 @@ UNARY = {
     "ceil": (np.ceil, 0.1, 2.9, False),
     "cos": (np.cos, -2.0, 2.0, True),
     "cosh": (np.cosh, -2.0, 2.0, True),
+    "degrees": (np.degrees, -2.0, 2.0, True),
+    "radians": (np.radians, -2.0, 2.0, True),
     "digamma": (None, 0.5, 3.0, True),
     "erf": (None, -2.0, 2.0, True),
     "erfinv": (None, -0.8, 0.8, True),
@@ -560,6 +562,10 @@ TESTED_ELSEWHERE = {
     # Symbol.gradient's kernel (registered lazily on first use);
     # value-tested in tests/test_fixes_r3.py::test_symbol_gradient
     "_graph_grad",
+    # round-4 op batch: dedicated oracle + gradient tests in
+    # tests/test_ops_r4.py
+    "reshape_like", "broadcast_like", "khatri_rao", "Correlation",
+    "cast_storage", "IdentityAttachKLSparseReg",
 }
 
 
