@@ -627,8 +627,7 @@ class HybridBlock(Block):
     def _ensure_init(self, ctx, x, *args):
         try:
             for v in self.collect_params().values():
-                if v._data is None:
-                    v._check_and_get(v._data, ctx)
+                v._require_init()
         except DeferredInitializationError:
             # one imperative dry run resolves every deferred param
             self._call_imperative_once(ctx, x, *args)

@@ -109,7 +109,7 @@ class FusedTrainStep:
 
     def __init__(self, net, loss_fn, trainer, devices=None):
         for p in trainer._params:
-            if p._data is not None and len(p.list_data()) > 1:
+            if p._replicas is not None and len(p.list_data()) > 1:
                 raise ValueError("FusedTrainStep supports single-context "
                                  "parameters; pass devices= for "
                                  "data-parallel training.")

@@ -2,10 +2,13 @@
 
 Reference parity: ``python/mxnet/gluon/parameter.py`` (Parameter:43 with
 deferred init, grad_req, lr_mult/wd_mult; ParameterDict:632 with prefix
-namespacing, sharing, save/load).  TPU-native: a Parameter holds one NDArray
-per context; under sharded execution the data lives as one ``jax.Array`` with
-a ``NamedSharding`` instead of per-device replicas (list_ctx then reports the
-mesh devices).
+namespacing, sharing, save/load).  The public surface matches the
+reference; the internals are repo-idiom: per-context replicas live in
+``_Replica`` records (not parallel lists), and deferred initialization is
+a ``_PendingInit`` object rather than a positional tuple.  TPU-native: a
+Parameter holds one NDArray per context; under sharded execution the data
+lives as one ``jax.Array`` with a ``NamedSharding`` instead of per-device
+replicas (list_ctx then reports the mesh devices).
 """
 from __future__ import annotations
 
@@ -24,6 +27,38 @@ class DeferredInitializationError(Exception):
     """Error for unfinished deferred initialization."""
 
 
+class _PendingInit:
+    """A deferred initialization request: everything needed to realize
+    the parameter once its shape is known (first forward pass)."""
+
+    __slots__ = ("init", "ctx_list", "default_init", "data")
+
+    def __init__(self, init, ctx_list, default_init, data=None):
+        self.init = init
+        self.ctx_list = list(ctx_list)
+        self.default_init = default_init
+        self.data = data
+
+
+class _Replica:
+    """One per-context copy of a parameter: data plus its grad buffer."""
+
+    __slots__ = ("ctx", "data", "grad")
+
+    def __init__(self, ctx, data, grad=None):
+        self.ctx = ctx
+        self.data = data
+        self.grad = grad
+
+
+def _as_ctx_list(ctx):
+    if ctx is None:
+        return [current_context()]
+    if isinstance(ctx, Context):
+        return [ctx]
+    return list(ctx)
+
+
 class Parameter:
     """A Container holding parameters (weights) of Blocks
     (reference: gluon/parameter.py:43)."""
@@ -31,31 +66,25 @@ class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
                  differentiable=True, stype="default", grad_stype="default"):
-        self._var = None
-        self._data = None
-        self._grad = None
-        self._ctx_list = None
-        self._deferred_init = ()
-        self.name = name
-        self._grad_req = None
-        if isinstance(shape, int):
-            shape = (shape,)
-        self._shape = tuple(shape) if shape is not None else None
-        self.dtype = dtype
-        self.lr_mult = lr_mult
-        self.wd_mult = wd_mult
-        self.init = init
-        self.allow_deferred_init = allow_deferred_init
-        self._differentiable = differentiable
         if stype not in ("default", "row_sparse", "csr"):
             raise ValueError("invalid stype %s" % stype)
-        self._stype = stype
-        self._grad_stype = grad_stype
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.name, self.dtype, self.init = name, dtype, init
+        self.lr_mult, self.wd_mult = lr_mult, wd_mult
+        self.allow_deferred_init = allow_deferred_init
+        self._var = None
+        self._replicas = None        # list[_Replica] once initialized
+        self._pending = None         # _PendingInit while deferred
+        self._shape = tuple(shape) if shape is not None else None
+        self._stype, self._grad_stype = stype, grad_stype
+        self._differentiable = differentiable
+        self._grad_req = None
         self.grad_req = grad_req
 
     def __repr__(self):
-        s = "Parameter {name} (shape={shape}, dtype={dtype})"
-        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+        return "Parameter {} (shape={}, dtype={})".format(
+            self.name, self.shape, self.dtype)
 
     # -- properties -------------------------------------------------------
     @property
@@ -71,13 +100,14 @@ class Parameter:
         if self._grad_req == req:
             return
         self._grad_req = req
-        if req == "null" and self._grad is not None:
-            self._grad = None
-            if self._data is not None:
-                for d in self._data:
-                    autograd.mark_variables([d], [None], "null")
-        elif self._data is not None:
-            self._init_grad()
+        if self._replicas is None:
+            return
+        if req == "null":
+            for r in self._replicas:
+                r.grad = None
+                autograd.mark_variables([r.data], [None], "null")
+        else:
+            self._attach_grads()
 
     @property
     def shape(self):
@@ -85,112 +115,93 @@ class Parameter:
 
     @shape.setter
     def shape(self, new_shape):
-        if self._shape is None:
-            self._shape = tuple(new_shape)
-            return
-        assert len(self._shape) == len(new_shape) and \
-            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
-            "Expected shape %s is incompatible with given shape %s." % (
-                str(new_shape), str(self._shape))
+        if self._shape is not None:
+            ok = len(self._shape) == len(new_shape) and all(
+                known in (0, given)
+                for given, known in zip(new_shape, self._shape))
+            assert ok, \
+                "Expected shape %s is incompatible with given shape %s." % (
+                    str(new_shape), str(self._shape))
         self._shape = tuple(new_shape)
 
     @property
     def stype(self):
         return self._stype
 
+    def _shape_is_known(self):
+        return self._shape is not None and np.prod(self._shape) > 0
+
     # -- init -------------------------------------------------------------
     def initialize(self, init=None, ctx=None, default_init=None,
                    force_reinit=False):
         if default_init is None:
             default_init = initializer.Uniform()
-        if self._data is not None and not force_reinit:
+        if self._replicas is not None and not force_reinit:
             return
-        self._deferred_init = ()
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
         if init is None:
-            init = default_init if self.init is None else self.init
-        if self._shape is None or np.prod(self._shape) <= 0:
-            if self.allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
+            init = self.init if self.init is not None else default_init
+        self._pending = _PendingInit(init, _as_ctx_list(ctx), default_init)
+        if self._shape_is_known():
+            self._finish_deferred_init()
+        elif not self.allow_deferred_init:
+            self._pending = None
             raise ValueError(
                 "Cannot initialize Parameter '%s' because it has invalid "
                 "shape: %s." % (self.name, str(self._shape)))
-        self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
 
     def _finish_deferred_init(self):
-        if not self._deferred_init:
+        pending, self._pending = self._pending, None
+        if pending is None:
             return
-        init, ctx, default_init, data = self._deferred_init
-        self._deferred_init = ()
-        assert self._shape is not None and np.prod(self._shape) > 0, \
+        assert self._shape_is_known(), \
             "Cannot initialize Parameter '%s' because it has invalid shape: " \
             "%s. Please specify in_units, in_channels, etc for `Block`s." % (
                 self.name, str(self._shape))
         with autograd.pause():
+            data = pending.data
             if data is None:
                 data = nd.zeros(self._shape, dtype=self.dtype, ctx=cpu())
-                # reference semantics (_finish_deferred_init): a param-specific
-                # init goes into the InitDesc and bypasses name dispatch; the
-                # global/default init dispatches by name pattern
+                # reference semantics (_finish_deferred_init): a
+                # param-specific init goes into the InitDesc and bypasses
+                # name dispatch; the global/default init dispatches by
+                # name pattern
+                specific = (pending.init is not None
+                            and pending.init is not pending.default_init)
                 desc = initializer.InitDesc(
-                    self.name, {"__init__": init} if init is not default_init
-                    and init is not None else {})
-                default_init(desc, data)
-            self._init_impl(data, ctx)
+                    self.name, {"__init__": pending.init} if specific else {})
+                pending.default_init(desc, data)
+            self._place(data, pending.ctx_list)
 
-    def _init_impl(self, data, ctx_list):
-        self._ctx_list = list(ctx_list)
-        self._data = [data.as_in_context(c) for c in self._ctx_list]
-        self._init_grad()
+    def _place(self, data, ctx_list):
+        """Materialize replicas of ``data`` on each context."""
+        self._replicas = [_Replica(c, data.as_in_context(c))
+                          for c in ctx_list]
+        self._attach_grads()
 
-    def _init_grad(self):
+    def _attach_grads(self):
         if self.grad_req == "null":
-            self._grad = None
+            for r in self._replicas:
+                r.grad = None
             return
-        self._grad = [nd.zeros(d.shape, ctx=d.context, dtype=d.dtype)
-                      for d in self._data]
-        for d, g in zip(self._data, self._grad):
-            autograd.mark_variables([d], [g], self.grad_req)
+        for r in self._replicas:
+            r.grad = nd.zeros(r.data.shape, ctx=r.ctx, dtype=r.data.dtype)
+            autograd.mark_variables([r.data], [r.grad], self.grad_req)
 
     def _reduce(self):
         """Average data across contexts (for save)."""
-        if self._stype == "default":
-            block = self.list_data()
-            if len(block) == 1:
-                return block[0].copyto(cpu())
-            out = block[0].copyto(cpu())
-            for b in block[1:]:
-                out += b.as_in_context(cpu())
-            return out / len(block)
-        return self.list_data()[0]
+        replicas = self.list_data()
+        if self._stype != "default":
+            return replicas[0]
+        acc = replicas[0].copyto(cpu())
+        for extra in replicas[1:]:
+            acc += extra.as_in_context(cpu())
+        return acc / len(replicas) if len(replicas) > 1 else acc
 
     # -- data access ------------------------------------------------------
-    def _check_and_get(self, arr_list, ctx):
-        if arr_list is not None:
-            if ctx is list:
-                return arr_list
-            if ctx is None:
-                if len(arr_list) == 1:
-                    return arr_list[0]
-                ctx = current_context()
-            ctx_list = self._ctx_list or []
-            for a, c in zip(arr_list, ctx_list):
-                if c == ctx:
-                    return a
-            # device-type match (tpu(0) vs gpu(0) alias)
-            for a, c in zip(arr_list, ctx_list):
-                if c.device_id == ctx.device_id:
-                    return a
-            raise RuntimeError(
-                "Parameter '%s' was not initialized on context %s. It was "
-                "only initialized on %s." % (self.name, str(ctx),
-                                             str(self._ctx_list)))
-        if self._deferred_init:
+    def _require_init(self):
+        if self._replicas is not None:
+            return
+        if self._pending is not None:
             raise DeferredInitializationError(
                 "Parameter '%s' has not been initialized yet because "
                 "initialization was deferred. Actual initialization happens "
@@ -203,75 +214,113 @@ class Parameter:
             "instead of Block.params because the later does not include "
             "Parameters of nested child Blocks" % self.name)
 
+    def _replica_for(self, ctx):
+        self._require_init()
+        if ctx is None:
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            ctx = current_context()
+        for r in self._replicas:
+            if r.ctx == ctx:
+                return r
+        # device-type alias match (tpu(0) vs gpu(0))
+        for r in self._replicas:
+            if r.ctx.device_id == ctx.device_id:
+                return r
+        raise RuntimeError(
+            "Parameter '%s' was not initialized on context %s. It was "
+            "only initialized on %s." % (self.name, str(ctx),
+                                         str([r.ctx for r in self._replicas])))
+
     def data(self, ctx=None):
-        return self._check_and_get(self._data, ctx)
+        return self._replica_for(ctx).data
 
     def list_data(self):
-        return self._check_and_get(self._data, list)
+        self._require_init()
+        return [r.data for r in self._replicas]
+
+    def _require_grad(self):
+        if self._replicas is not None and self.grad_req == "null":
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
 
     def grad(self, ctx=None):
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter '%s' because "
-                "grad_req='null'" % self.name)
-        return self._check_and_get(self._grad, ctx)
+        self._require_grad()
+        return self._replica_for(ctx).grad
 
     def list_grad(self):
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter '%s' because "
-                "grad_req='null'" % self.name)
-        return self._check_and_get(self._grad, list)
+        self._require_grad()
+        self._require_init()
+        return [r.grad for r in self._replicas]
 
     def list_ctx(self):
-        if self._data is None:
-            if self._deferred_init:
-                return self._deferred_init[1]
-            raise RuntimeError("Parameter '%s' has not been initialized"
-                               % self.name)
-        return self._ctx_list
+        if self._replicas is not None:
+            return [r.ctx for r in self._replicas]
+        if self._pending is not None:
+            return self._pending.ctx_list
+        raise RuntimeError("Parameter '%s' has not been initialized"
+                           % self.name)
 
     def zero_grad(self):
-        if self._grad is None:
+        if self._replicas is None:
             return
-        for g in self._grad:
-            g[:] = 0
+        for r in self._replicas:
+            if r.grad is not None:
+                r.grad[:] = 0
 
     def set_data(self, data):
         self.shape = data.shape
-        if self._data is None:
-            assert self._deferred_init, \
+        if self._replicas is None:
+            assert self._pending is not None, \
                 "Parameter '%s' has not been initialized" % self.name
-            self._deferred_init = self._deferred_init[:3] + (data,)
+            self._pending.data = data
             self._finish_deferred_init()
             return
         if not isinstance(data, nd.NDArray):
             data = nd.array(data, dtype=self.dtype)
-        for d in self._data:
-            d._set_data(data.as_in_context(d.context).astype(d.dtype).data)
+        for r in self._replicas:
+            r.data._set_data(
+                data.as_in_context(r.ctx).astype(r.data.dtype).data)
 
     def reset_ctx(self, ctx):
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if self._data:
+        ctx = _as_ctx_list(ctx)
+        if self._replicas is not None:
             data = self._reduce()
             with autograd.pause():
-                self._init_impl(data, ctx)
-        elif self._deferred_init:
-            init, _, default_init, data = self._deferred_init
-            self._deferred_init = (init, ctx, default_init, data)
+                self._place(data, ctx)
+        elif self._pending is not None:
+            self._pending.ctx_list = ctx
         else:
             raise ValueError("Cannot reset context for Parameter '%s' because "
                              "it has not been initialized." % self.name)
 
     def cast(self, dtype):
         self.dtype = dtype
-        if self._data is None:
+        if self._replicas is None:
             return
         with autograd.pause():
-            self._data = [i.astype(dtype) for i in self._data]
-            if self._grad is not None:
-                self._init_grad()
+            for r in self._replicas:
+                r.data = r.data.astype(dtype)
+            if self.grad_req != "null":
+                self._attach_grads()
+
+    def _load_init_data(self, data, ctx):
+        """Install loaded data (ParameterDict.load / Block load path)."""
+        if self._shape is not None:
+            known = all(s != 0 for s in self._shape)
+            if known and tuple(self._shape) != tuple(data.shape):
+                raise ValueError(
+                    "Failed loading Parameter '%s' from saved params: shape "
+                    "incompatible expected %s vs saved %s" % (
+                        self.name, str(self._shape), str(data.shape)))
+        if self._replicas is not None:
+            self.set_data(data)
+            return
+        self._shape = tuple(data.shape)
+        with autograd.pause():
+            self._place(data, _as_ctx_list(ctx))
+        self._pending = None
 
     # -- symbolic bridge --------------------------------------------------
     def var(self):
@@ -296,6 +345,22 @@ class Constant(Parameter):
                          init=initializer.Constant(value))
 
 
+def _merge_shapes(requested, stored):
+    """Unify a requested shape with a stored one, treating 0 as unknown.
+    Returns the merged tuple or None when they conflict."""
+    if requested is None or len(requested) != len(stored):
+        return None
+    merged = []
+    for want, have in zip(requested, stored):
+        if want == have or have == 0:
+            merged.append(want)
+        elif want == 0:
+            merged.append(have)
+        else:
+            return None
+    return tuple(merged)
+
+
 class ParameterDict:
     """A dictionary managing a set of Parameters with prefix namespacing
     (reference: gluon/parameter.py:632)."""
@@ -309,9 +374,8 @@ class ParameterDict:
         return self._params[key]
 
     def __repr__(self):
-        s = "{name}(\n{content}\n)"
         name = self._prefix + " " if self._prefix else ""
-        return s.format(name=name, content="\n".join(
+        return "{}(\n{}\n)".format(name, "\n".join(
             "  " + repr(v) for v in self.values()))
 
     def __iter__(self):
@@ -330,53 +394,49 @@ class ParameterDict:
     def prefix(self):
         return self._prefix
 
-    def _get_impl(self, name):
-        if name in self._params:
-            return self._params[name]
-        if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._params[name]
-        return None
+    def _find(self, name):
+        """Look up ``name`` here, then in the shared dict (adopting a
+        shared hit into this dict, reference sharing semantics)."""
+        hit = self._params.get(name)
+        if hit is None and self._shared is not None:
+            hit = self._shared._params.get(name)
+            if hit is not None:
+                self._params[name] = hit
+        return hit
+
+    def _reconcile(self, param, kwargs):
+        """Check requested attributes against an existing Parameter,
+        filling in attributes it does not have yet."""
+        for k, v in kwargs.items():
+            stored = getattr(param, k, None)
+            if stored is None:
+                setattr(param, k, v)
+                continue
+            if k == "shape" and v is not None:
+                merged = _merge_shapes(tuple(v), tuple(stored))
+                if merged is not None:
+                    param._shape = merged
+                    continue
+            assert v is None or str(v) == str(stored), \
+                "Cannot retrieve Parameter '%s' because desired " \
+                "attribute does not match with stored for attribute " \
+                "'%s': desired '%s' vs stored '%s'." % (
+                    param.name, k, str(v), str(stored))
 
     def get(self, name, **kwargs):
         """Get or create a Parameter named prefix+name."""
         name = self._prefix + name
-        param = self._get_impl(name)
+        param = self._find(name)
         if param is None:
             param = Parameter(name, **kwargs)
             self._params[name] = param
         else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == "shape" and v is not None and len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 * dim2 != 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 == 0:
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param._shape = tuple(inferred_shape)
-                            continue
-                    assert v is None or str(v) == str(existing), \
-                        "Cannot retrieve Parameter '%s' because desired " \
-                        "attribute does not match with stored for attribute " \
-                        "'%s': desired '%s' vs stored '%s'." % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
+            self._reconcile(param, kwargs)
         return param
 
     def get_constant(self, name, value=None):
         name = self._prefix + name
-        param = self._get_impl(name)
+        param = self._find(name)
         if param is None:
             if value is None:
                 raise KeyError("No constant named '{}'. Please specify value "
@@ -390,12 +450,11 @@ class ParameterDict:
 
     def update(self, other):
         for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    "Cannot update self with other because they have different " \
-                    "Parameters with the same name '%s'" % k
-            else:
-                self._params[k] = v
+            mine = self._params.get(k)
+            assert mine is None or mine is v, \
+                "Cannot update self with other because they have different " \
+                "Parameters with the same name '%s'" % k
+            self._params[k] = v
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -405,28 +464,27 @@ class ParameterDict:
             v.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for i in self.values():
-            i.zero_grad()
+        for p in self.values():
+            p.zero_grad()
 
     def reset_ctx(self, ctx):
-        for i in self.values():
-            i.reset_ctx(ctx)
+        for p in self.values():
+            p.reset_ctx(ctx)
 
     def setattr(self, name, value):
-        for i in self.values():
-            setattr(i, name, value)
+        for p in self.values():
+            setattr(p, name, value)
 
     # -- serialization ----------------------------------------------------
     def save(self, filename, strip_prefix=""):
         arg_dict = {}
         for param in self.values():
-            weight = param._reduce()
             if not param.name.startswith(strip_prefix):
                 raise ValueError(
                     "Prefix '%s' is to be striped before saving, but "
                     "Parameter's name '%s' does not start with '%s'." % (
                         strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
+            arg_dict[param.name[len(strip_prefix):]] = param._reduce()
         from ..ndarray import utils as nd_utils
         nd_utils.save(filename, arg_dict)
 
@@ -447,34 +505,10 @@ class ParameterDict:
                 assert name in arg_dict, \
                     "Parameter '%s' is missing in file '%s'" % (
                         name[lprefix:], filename)
-        for name in arg_dict:
+        for name, data in arg_dict.items():
             if name not in self._params:
                 assert ignore_extra, \
                     "Parameter '%s' loaded from file '%s' is not present in " \
                     "ParameterDict" % (name[lprefix:], filename)
                 continue
-            self[name]._load_init_data(arg_dict[name], ctx)
-
-
-def _load_init_data(param, data, ctx):
-    if param.shape is not None:
-        unknown = any(s == 0 for s in param.shape)
-        if not unknown and tuple(param.shape) != tuple(data.shape):
-            raise ValueError(
-                "Failed loading Parameter '%s' from saved params: shape "
-                "incompatible expected %s vs saved %s" % (
-                    param.name, str(param.shape), str(data.shape)))
-    if ctx is None:
-        ctx = [current_context()]
-    if isinstance(ctx, Context):
-        ctx = [ctx]
-    if param._data is None:
-        param._shape = tuple(data.shape)
-        with autograd.pause():
-            param._init_impl(data, ctx)
-        param._deferred_init = ()
-    else:
-        param.set_data(data)
-
-
-Parameter._load_init_data = _load_init_data
+            self[name]._load_init_data(data, ctx)

@@ -145,16 +145,24 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        # one list-valued updater call per device slot so SGD-family
+        # optimizers can fuse the whole step into multi_sgd_* kernels;
+        # indices stay unique within a call (device replicas of a param
+        # go to different calls, preserving sequential state application)
+        batched = {}
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
             if self._kvstore is not None and self._update_on_kvstore:
                 self._kvstore.pull(i, param.list_data(), priority=-i)
                 continue
-            for upd, arr, grad in zip(
-                    self._updaters * len(param.list_data()),
-                    param.list_data(), param.list_grad()):
-                upd(i, grad, arr)
+            for dev, (arr, grad) in enumerate(
+                    zip(param.list_data(), param.list_grad())):
+                batched.setdefault(dev, []).append((i, grad, arr))
+        for dev in sorted(batched):
+            upd = self._updaters[dev % len(self._updaters)]
+            idxs, grads, arrs = (list(t) for t in zip(*batched[dev]))
+            upd(idxs, grads, arrs)
 
     def save_states(self, fname):
         """Save optimizer (updater) states (reference: trainer.save_states)."""

@@ -4,13 +4,18 @@ Reference parity: ``python/mxnet/metric.py`` (EvalMetric:68 + registry;
 Accuracy:440, TopKAccuracy:513, F1:751, MCC:845, Perplexity:960,
 MAE/MSE/RMSE:1084-1213, CrossEntropy:1278, NegativeLogLikelihood:1350,
 PearsonCorrelation, Loss, CustomMetric, CompositeEvalMetric, np() wrapper).
-Metric math runs on host numpy — metrics consume already-synced outputs and
-must not pollute the device program.
+
+The public classes, names, and accumulated numbers match the reference;
+the internals are repo-idiom: most metrics are a one-method ``_measure``
+hook on a pairwise template, binary-classification stats are a 2x2
+confusion matrix filled by ``bincount``, and the regression / log-loss
+families share vectorized bases.  Metric math runs on host numpy —
+metrics consume already-synced outputs and must not pollute the device
+program.
 """
 from __future__ import annotations
 
 import math
-from collections import OrderedDict
 
 import numpy
 
@@ -58,13 +63,11 @@ def _as_numpy(x):
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Reference-compatible shape guard (metric.check_label_shapes)."""
+    got = (labels.shape, preds.shape) if shape else (len(labels), len(preds))
+    if got[0] != got[1]:
         raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+                         "predictions {}".format(*got))
     if wrap:
         if isinstance(labels, nd.NDArray):
             labels = [labels]
@@ -73,9 +76,21 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
     return labels, preds
 
 
+def _pairs(labels, preds):
+    """Normalize to aligned (label, pred) array pairs."""
+    labels, preds = check_label_shapes(labels, preds, wrap=True)
+    for lab, pr in zip(labels, preds):
+        yield lab, pr
+
+
 class EvalMetric:
     """Base metric: accumulates (sum_metric, num_inst) over update() calls
-    (reference: metric.py:68)."""
+    (reference: metric.py:68).
+
+    Subclasses either override ``update`` wholesale or implement the
+    pairwise hook ``_measure(label, pred) -> (metric_sum, count)`` which
+    this base accumulates per (label, pred) array pair.
+    """
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
@@ -88,27 +103,29 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
-        return config
+        return dict(self._kwargs,
+                    metric=self.__class__.__name__,
+                    name=self.name,
+                    output_names=self.output_names,
+                    label_names=self.label_names)
+
+    def _select(self, table, wanted):
+        if wanted is None:
+            return list(table.values())
+        return [table[n] for n in wanted if n in table]
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names if name in pred]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names if name in label]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        self.update(self._select(label, self.label_names),
+                    self._select(pred, self.output_names))
+
+    def _measure(self, label, pred):
+        raise NotImplementedError()
 
     def update(self, labels, preds):
-        raise NotImplementedError()
+        for lab, pr in _pairs(labels, preds):
+            s, n = self._measure(_as_numpy(lab), _as_numpy(pr))
+            self.sum_metric += s
+            self.num_inst += n
 
     def reset(self):
         self.num_inst = 0
@@ -121,11 +138,9 @@ class EvalMetric:
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
 
 class CompositeEvalMetric(EvalMetric):
@@ -135,9 +150,7 @@ class CompositeEvalMetric(EvalMetric):
                  label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -146,16 +159,16 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+            return ValueError("Metric index {} is out of range 0 and {}"
+                              .format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = OrderedDict([i for i in labels.items()
-                                  if i[0] in self.label_names])
+            labels = {k: v for k, v in labels.items()
+                      if k in self.label_names}
         if self.output_names is not None:
-            preds = OrderedDict([i for i in preds.items()
-                                 if i[0] in self.output_names])
+            preds = {k: v for k, v in preds.items()
+                     if k in self.output_names}
         for metric in self.metrics:
             metric.update_dict(labels, preds)
 
@@ -164,28 +177,25 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for metric in getattr(self, "metrics", ()):
+            metric.reset()
 
     def get(self):
         names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+            names.extend(name if isinstance(name, list) else [name])
+            values.extend(value if isinstance(value, list) else [value])
         return (names, values)
 
     def get_config(self):
-        config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
-        return config
+        return dict(super().get_config(),
+                    metrics=[m.get_config() for m in self.metrics])
+
+
+def _hard_labels(pred, axis):
+    """Class predictions from scores (argmax) or pass-through labels."""
+    return pred.argmax(axis=axis) if pred.ndim > 1 else pred
 
 
 @register
@@ -198,18 +208,13 @@ class Accuracy(EvalMetric):
                          label_names=label_names)
         self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _as_numpy(pred_label)
-            label = _as_numpy(label)
-            if pred_label.ndim > label.ndim:
-                pred_label = numpy.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.astype("int32").ravel()
-            label = label.astype("int32").ravel()
-            check_label_shapes(label, pred_label)
-            self.sum_metric += (pred_label == label).sum()
-            self.num_inst += len(pred_label)
+    def _measure(self, label, pred):
+        if pred.ndim > label.ndim:
+            pred = pred.argmax(axis=self.axis)
+        hits = (pred.astype("int32").ravel()
+                == label.astype("int32").ravel())
+        check_label_shapes(label.ravel(), pred.ravel())
+        return hits.sum(), hits.size
 
 
 @register
@@ -224,154 +229,139 @@ class TopKAccuracy(EvalMetric):
         assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
         self.name += "_%d" % self.top_k
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(_as_numpy(pred_label).astype("float32"),
-                                    axis=-1)
-            label = _as_numpy(label).astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.ravel() == label.ravel()).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].ravel()
-                        == label.ravel()).sum()
-            self.num_inst += num_samples
+    def _measure(self, label, pred):
+        assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+        # full argsort (not argpartition) keeps the reference's exact
+        # tie-breaking order
+        order = numpy.argsort(pred.astype("float32"), axis=-1)
+        label = label.astype("int32")
+        check_label_shapes(label, order)
+        if order.ndim == 1:
+            return (order.ravel() == label.ravel()).sum(), order.shape[0]
+        k = min(order.shape[1], self.top_k)
+        in_topk = order[:, order.shape[1] - k:] == label.reshape(-1, 1)
+        return in_topk.sum(), order.shape[0]
 
 
 class _BinaryClassificationMetrics:
-    """Running TP/FP/TN/FN tallies shared by F1 and MCC."""
+    """2x2 confusion tally shared by F1 and MCC (reference keeps four
+    scalar counters; one bincount'd matrix is equivalent)."""
 
     def __init__(self):
         self.reset_stats()
 
+    def reset_stats(self):
+        self._cm = numpy.zeros((2, 2), numpy.int64)  # [label, pred]
+
     def update_binary_stats(self, label, pred):
         pred = _as_numpy(pred)
-        label = _as_numpy(label).astype("int32")
-        pred_label = numpy.argmax(pred, axis=1) if pred.ndim > 1 else (pred > 0.5)
-        pred_label = pred_label.astype("int32").ravel()
-        label = label.ravel()
-        check_label_shapes(label, pred_label)
-        if len(numpy.unique(label)) > 2:
-            raise ValueError("%s currently only supports binary classification."
-                             % self.__class__.__name__)
-        pred_true = pred_label == 1
-        pred_false = 1 - pred_true
-        label_true = label == 1
-        label_false = 1 - label_true
-        self.true_positives += (pred_true * label_true).sum()
-        self.false_positives += (pred_true * label_false).sum()
-        self.false_negatives += (pred_false * label_true).sum()
-        self.true_negatives += (pred_false * label_false).sum()
+        label = _as_numpy(label).astype("int32").ravel()
+        hard = _hard_labels(pred, axis=1) if pred.ndim > 1 else (pred > 0.5)
+        hard = hard.astype("int32").ravel()
+        check_label_shapes(label, hard)
+        if numpy.unique(label).size > 2:
+            raise ValueError("%s currently only supports binary "
+                             "classification." % self.__class__.__name__)
+        # positive class is the value 1; any other encoding ({-1, 1},
+        # {0, 2}, ...) counts as negative, like the reference
+        lab_pos = (label == 1).astype(numpy.int64)
+        hard_pos = (hard == 1).astype(numpy.int64)
+        self._cm += numpy.bincount(
+            lab_pos * 2 + hard_pos, minlength=4).reshape(2, 2)
+
+    true_negatives = property(lambda self: int(self._cm[0, 0]))
+    false_positives = property(lambda self: int(self._cm[0, 1]))
+    false_negatives = property(lambda self: int(self._cm[1, 0]))
+    true_positives = property(lambda self: int(self._cm[1, 1]))
 
     @property
     def precision(self):
-        tp_fp = self.true_positives + self.false_positives
-        return self.true_positives / tp_fp if tp_fp > 0 else 0.0
+        predicted_pos = self._cm[:, 1].sum()
+        return self.true_positives / predicted_pos if predicted_pos else 0.0
 
     @property
     def recall(self):
-        tp_fn = self.true_positives + self.false_negatives
-        return self.true_positives / tp_fn if tp_fn > 0 else 0.0
+        actual_pos = self._cm[1, :].sum()
+        return self.true_positives / actual_pos if actual_pos else 0.0
 
     @property
     def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (self.precision + self.recall)
-        return 0.0
+        pr = self.precision + self.recall
+        return 2 * self.precision * self.recall / pr if pr > 0 else 0.0
 
     @property
     def matthewscc(self):
         if not self.total_examples:
             return 0.0
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
+        tp, fp = float(self.true_positives), float(self.false_positives)
+        fn, tn = float(self.false_negatives), float(self.true_negatives)
         denom = 1.0
-        for t in filter(lambda t: t != 0.0, terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+        for t in (tp + fp, tp + fn, tn + fp, tn + fn):
+            if t != 0.0:
+                denom *= t
+        return (tp * tn - fp * fn) / math.sqrt(denom)
 
     @property
     def total_examples(self):
-        return (self.false_negatives + self.false_positives
-                + self.true_negatives + self.true_positives)
+        return int(self._cm.sum())
 
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+
+class _BinaryScoreMetric(EvalMetric):
+    """Shared macro/micro accumulation over a confusion tally; the
+    subclass names which tally statistic it reports."""
+
+    _stat_name = None
+
+    def __init__(self, name, average, output_names=None, label_names=None):
+        self.average = average
+        self._tally = _BinaryClassificationMetrics()
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        for lab, pr in _pairs(labels, preds):
+            self._tally.update_binary_stats(lab, pr)
+        stat = getattr(self._tally, self._stat_name)
+        if self.average == "macro":
+            # per-batch statistic, averaged over batches
+            self.sum_metric += stat
+            self.num_inst += 1
+            self._tally.reset_stats()
+        else:
+            # running statistic over all examples seen
+            self.sum_metric = stat * self._tally.total_examples
+            self.num_inst = self._tally.total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+        if hasattr(self, "_tally"):
+            self._tally.reset_stats()
 
 
 @register
-class F1(EvalMetric):
+class F1(_BinaryScoreMetric):
     """Binary F1 score (reference: metric.py:751)."""
+
+    _stat_name = "fscore"
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        super().__init__(name=name, output_names=output_names,
-                         label_names=label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.num_inst += 1
-            self.metrics.reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.num_inst = self.metrics.total_examples
-
-    def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0
-        if hasattr(self, "metrics"):
-            self.metrics.reset_stats()
+        super().__init__(name, average, output_names, label_names)
+        self.metrics = self._tally  # reference-compatible attribute
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_BinaryScoreMetric):
     """Matthews correlation coefficient (reference: metric.py:845)."""
+
+    _stat_name = "matthewscc"
 
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        super().__init__(name=name, output_names=output_names,
-                         label_names=label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
-            self.num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = self._metrics.matthewscc * self._metrics.total_examples
-            self.num_inst = self._metrics.total_examples
-
-    def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0
-        if hasattr(self, "_metrics"):
-            self._metrics.reset_stats()
+        super().__init__(name, average, output_names, label_names)
+        self._average = average          # reference-compatible attributes
+        self._metrics = self._tally
 
 
 @register
@@ -385,25 +375,18 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[numpy.arange(label.size), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= int(ignore.sum())
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        self.sum_metric += loss
-        self.num_inst += num
+    def _measure(self, label, pred):
+        assert label.size == pred.size // pred.shape[-1], \
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+        flat = label.ravel().astype("int64")
+        probs = pred.reshape(-1, pred.shape[-1])[
+            numpy.arange(flat.size), flat]
+        count = flat.size
+        if self.ignore_label is not None:
+            keep = flat != self.ignore_label
+            count -= int((~keep).sum())
+            probs = numpy.where(keep, probs, 1.0)
+        return -numpy.log(numpy.maximum(1e-10, probs)).sum(), count
 
     def get(self):
         if self.num_inst == 0:
@@ -411,112 +394,90 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
+class _RegressionMetric(EvalMetric):
+    """Per-batch-mean regression error; subclass supplies the error
+    functional over (label - pred)."""
+
+    def __init__(self, name, output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    @staticmethod
+    def _err(diff):
+        raise NotImplementedError()
+
+    def _measure(self, label, pred):
+        # a 1-D side is a column vector (reference reshapes to (n, 1));
+        # without this, (n,) - (n, 1) would broadcast to (n, n)
+        if label.ndim == 1:
+            label = label[:, None]
+        if pred.ndim == 1:
+            pred = pred[:, None]
+        return self._err(label - pred), 1
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     """Mean absolute error (reference: metric.py:1084)."""
 
     def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    _err = staticmethod(lambda diff: numpy.abs(diff).mean())
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
     """Mean squared error (reference: metric.py:1147)."""
 
     def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    _err = staticmethod(lambda diff: numpy.square(diff).mean())
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_RegressionMetric):
     """Root mean squared error (reference: metric.py:1213)."""
 
     def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    _err = staticmethod(lambda diff: math.sqrt(numpy.square(diff).mean()))
+
+
+class _LogLossMetric(EvalMetric):
+    """-log p(label) summed over examples (CrossEntropy and NLL share the
+    math; they differ only in default name, like the reference)."""
+
+    def __init__(self, eps, name, output_names=None, label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def _measure(self, label, pred):
+        flat = label.ravel().astype("int64")
+        assert flat.shape[0] == pred.shape[0], (flat.shape[0], pred.shape[0])
+        probs = pred[numpy.arange(flat.shape[0]), flat]
+        return -numpy.log(probs + self.eps).sum(), flat.shape[0]
 
 
 @register
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_LogLossMetric):
     """Cross entropy against class-index labels (reference: metric.py:1278)."""
 
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label).ravel()
-            pred = _as_numpy(pred)
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_LogLossMetric):
     """NLL (reference: metric.py:1350)."""
 
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label).ravel()
-            pred = _as_numpy(pred)
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
@@ -527,14 +488,9 @@ class PearsonCorrelation(EvalMetric):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, False, True)
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            self.sum_metric += numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def _measure(self, label, pred):
+        check_label_shapes(label, pred, False, True)
+        return numpy.corrcoef(pred.ravel(), label.ravel())[0, 1], 1
 
 
 @register
@@ -550,8 +506,7 @@ class Loss(EvalMetric):
         if isinstance(preds, nd.NDArray):
             preds = [preds]
         for pred in preds:
-            loss = _as_numpy(pred).sum()
-            self.sum_metric += loss
+            self.sum_metric += _as_numpy(pred).sum()
             self.num_inst += pred.size
 
 
@@ -579,7 +534,7 @@ class CustomMetric(EvalMetric):
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
@@ -591,16 +546,10 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+            got = self._feval(_as_numpy(label), _as_numpy(pred))
+            s, n = got if isinstance(got, tuple) else (got, 1)
+            self.sum_metric += s
+            self.num_inst += n
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")

@@ -228,11 +228,18 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        # one list-valued updater call: SGD-family optimizers fuse the
+        # whole step into multi_sgd_* multi-tensor kernels
+        idxs, grads, weights = [], [], []
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
-            self._updater(i, grad, self._exec.arg_dict[name])
+            idxs.append(i)
+            grads.append(grad)
+            weights.append(self._exec.arg_dict[name])
+        if idxs:
+            self._updater(idxs, grads, weights)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

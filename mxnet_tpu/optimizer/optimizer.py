@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import pickle
 
 import numpy as np
@@ -76,39 +77,39 @@ def _is_low_precision(dtype):
 
 
 class Optimizer:
-    """Base optimizer (reference: ``optimizer.py`` class Optimizer).
+    """Base optimizer (public surface of the reference ``optimizer.py``
+    Optimizer class; internals are repo-idiom).
 
     Tracks per-parameter update counts (for time-dependent rules), lr/wd
-    multipliers resolved from parameter attributes, and optional fp16
-    multi-precision master weights.
+    multipliers resolved from parameter attributes, and optional fp16/bf16
+    multi-precision master weights.  ``aggregate_num > 0`` (a class
+    attribute subclasses may set) tells the Updater this optimizer can
+    batch that many parameters into one fused multi-tensor update call.
     """
 
     opt_registry: dict = {}
+    aggregate_num = 0
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
                  param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
-        self.lr_scheduler = lr_scheduler
-        if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
-        self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        self.multi_precision = multi_precision
         if param_idx2name is None:
             param_idx2name = {}
         assert isinstance(param_idx2name, dict), \
             "param_idx2name should be a dict of param indexes to names."
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
-        self.param_dict = param_dict if param_dict else {}
+        self.rescale_grad, self.wd = rescale_grad, wd
+        self.lr, self.lr_scheduler = learning_rate, lr_scheduler
+        if lr_scheduler is not None:
+            lr_scheduler.base_lr = learning_rate
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = self.begin_num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name)
+        self.sym_info = ((sym.attr_dict(), sym.list_arguments())
+                         if sym is not None else ())
+        self.param_dict = dict(param_dict) if param_dict else {}
         self.set_lr_mult({})
         self.set_wd_mult({})
 
@@ -134,29 +135,33 @@ class Optimizer:
         """Create optimizer state (momentum etc.) for one parameter."""
         return None
 
-    def create_state_multi_precision(self, index, weight):
-        weight_master_copy = None
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            weight_master_copy = weight.astype(np.float32)
-            return (self.create_state(index, weight_master_copy),
-                    weight_master_copy)
-        if _is_low_precision(weight.dtype) and not self.multi_precision:
+    def _wants_master_copy(self, weight):
+        low = _is_low_precision(weight.dtype)
+        if low and not self.multi_precision:
             logging.warning("Accumulating with float16 in optimizer can lead "
                             "to poor accuracy or slow convergence. Consider "
                             "using multi_precision=True option.")
-        return self.create_state(index, weight)
+        return low and self.multi_precision
+
+    def create_state_multi_precision(self, index, weight):
+        """State plus fp32 master copy for low-precision weights; the
+        master copy rides in the state tuple (reference convention:
+        ``(state, weight32)``)."""
+        if not self._wants_master_copy(weight):
+            return self.create_state(index, weight)
+        master = weight.astype(np.float32)
+        return (self.create_state(index, master), master)
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            original_state, weight_master_copy = state
-            grad32 = grad.astype(np.float32)
-            self.update(index, weight_master_copy, grad32, original_state)
-            weight._set_data(weight_master_copy.astype(weight.dtype).data)
-        else:
+        if not (self.multi_precision and _is_low_precision(weight.dtype)):
             self.update(index, weight, grad, state)
+            return
+        inner_state, master = state
+        self.update(index, master, grad.astype(np.float32), inner_state)
+        weight._set_data(master.astype(weight.dtype).data)
 
     # -- lr / wd resolution ----------------------------------------------
     def set_learning_rate(self, lr):
@@ -168,67 +173,61 @@ class Optimizer:
                               "undefined.")
         self.lr = lr
 
+    def _mults_from_sym(self, attr_key):
+        """Per-arg-name multipliers declared as symbol attributes
+        (``__lr_mult__``/``__wd_mult__``, reference attr convention)."""
+        if not self.sym_info:
+            return {}
+        attrs, arg_names = self.sym_info
+        return {n: float(attrs[n][attr_key]) for n in arg_names
+                if attr_key in attrs.get(n, ())}
+
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
-        self.lr_mult.update(args_lr_mult)
+        self.lr_mult = {**self._mults_from_sym("__lr_mult__"),
+                        **args_lr_mult}
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            is_weight = n.endswith("_weight")
-            if not is_weight:
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
-        self.wd_mult.update(args_wd_mult)
+        # non-weight params (biases, norm gammas/betas, ...) default to
+        # no weight decay, identified by name suffix like the reference
+        no_decay = {n: 0.0 for n in self.idx2name.values()
+                    if not n.endswith("_weight")}
+        self.wd_mult = {**no_decay, **self._mults_from_sym("__wd_mult__"),
+                        **args_wd_mult}
 
     def _update_count(self, index):
-        if not isinstance(index, (list, tuple)):
-            index = [index]
-        for idx in index:
-            if idx not in self._index_update_count:
-                self._index_update_count[idx] = self.begin_num_update
-            self._index_update_count[idx] += 1
-            self.num_update = max(self._index_update_count[idx], self.num_update)
+        for idx in index if isinstance(index, (list, tuple)) else (index,):
+            count = self._index_update_count.get(
+                idx, self.begin_num_update) + 1
+            self._index_update_count[idx] = count
+            if count > self.num_update:
+                self.num_update = count
+
+    def _mult_of(self, index, table, attr):
+        """Multiplier for one param: Parameter attribute wins, then an
+        entry keyed by index, then one keyed by the param's name."""
+        if index in self.param_dict:
+            return getattr(self.param_dict[index], attr)
+        if index in table:
+            return table[index]
+        name = self.idx2name.get(index)
+        return table.get(name, 1.0) if name is not None else 1.0
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        base = (self.lr_scheduler(self.num_update)
+                if self.lr_scheduler is not None else self.lr)
+        return base * self._mult_of(index, self.lr_mult, "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._mult_of(index, self.wd_mult, "wd_mult")
 
     def __getstate__(self):
-        ret = self.__dict__.copy()
-        del ret["param_dict"]
-        return ret
+        # param_dict holds live Parameter objects — never pickled; the
+        # loader re-attaches it (Trainer.load_states)
+        return {k: v for k, v in self.__dict__.items()
+                if k != "param_dict"}
 
     def __setstate__(self, state):
-        self.__dict__ = state
+        self.__dict__.update(state)
         self.param_dict = {}
 
     # -- op dispatch helper ----------------------------------------------
@@ -239,70 +238,122 @@ class Optimizer:
             kwargs["clip_gradient"] = self.clip_gradient
         return kwargs
 
+    def _begin_update(self, index):
+        """Bump the update counter and hand back the shared op kwargs —
+        the preamble every fused-update dispatch shares."""
+        self._update_count(index)
+        return self._common_kwargs(index)
+
+    def _step_of(self, index):
+        return self._index_update_count[index]
+
 
 register = Optimizer.register  # pylint: disable=invalid-name
 
 
+def _state_buf(weight):
+    """A zero state buffer matching one weight (momentum, moments...)."""
+    return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+
 @register
 class SGD(Optimizer):
-    """SGD with momentum and optional fp16 master weights
+    """SGD with momentum and optional fp16/bf16 master weights
     (reference: optimizer.py:498, fused ops sgd_update/sgd_mom_update/
-    mp_sgd_update)."""
+    mp_sgd_update; list-valued updates use the multi_sgd_* multi-tensor
+    kernels from src/operator/optimizer_op.cc — one XLA dispatch updating
+    every aggregated parameter)."""
+
+    aggregate_num = int(os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+                                       "4"))
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self.momentum, self.lazy_update = momentum, lazy_update
 
     def create_state_multi_precision(self, index, weight):
-        weight_master_copy = None
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            weight_master_copy = weight.astype(np.float32)
-            return (self.create_state(index, weight_master_copy),
-                    weight_master_copy)
-        return self.create_state(index, weight)
+        if not (self.multi_precision and _is_low_precision(weight.dtype)):
+            return self.create_state(index, weight)
+        master = weight.astype(np.float32)
+        return (self.create_state(index, master), master)
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
-            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+            return _state_buf(weight)
         return None
 
-    def _update_impl(self, index, weight, grad, state, multi_precision=False):
-        self._update_count(index)
+    def _update_one(self, index, weight, grad, state, multi_precision):
         kwargs = self._common_kwargs(index)
-        if not multi_precision:
-            idx = _row_sparse_indices(grad) if self.lazy_update else None
-            if idx is _NO_ROWS:
-                return
-            if idx is not None:
-                # lazy row-sparse update: only rows present in the
-                # gradient are touched (reference optimizer_op.cc
-                # row_sparse sgd kernels)
-                if state is not None:
-                    invoke("_sparse_sgd_mom_update",
-                           [weight, grad, idx, state],
-                           dict(momentum=self.momentum, **kwargs))
-                else:
-                    invoke("_sparse_sgd_update", [weight, grad, idx],
-                           kwargs)
-            elif state is not None:
-                invoke("sgd_mom_update", [weight, grad, state],
-                       dict(momentum=self.momentum, **kwargs))
-            else:
-                invoke("sgd_update", [weight, grad], kwargs)
-        else:
+        if multi_precision:
             mom, weight32 = state
             if mom is not None:
                 invoke("mp_sgd_mom_update", [weight, grad, mom, weight32],
                        dict(momentum=self.momentum, **kwargs))
             else:
                 invoke("mp_sgd_update", [weight, grad, weight32], kwargs)
+            return
+        idx = _row_sparse_indices(grad) if self.lazy_update else None
+        if idx is _NO_ROWS:
+            return
+        if idx is not None:
+            # lazy row-sparse update: only rows present in the gradient
+            # are touched (reference optimizer_op.cc row_sparse kernels)
+            if state is not None:
+                invoke("_sparse_sgd_mom_update", [weight, grad, idx, state],
+                       dict(momentum=self.momentum, **kwargs))
+            else:
+                invoke("_sparse_sgd_update", [weight, grad, idx], kwargs)
+        elif state is not None:
+            invoke("sgd_mom_update", [weight, grad, state],
+                   dict(momentum=self.momentum, **kwargs))
+        else:
+            invoke("sgd_update", [weight, grad], kwargs)
+
+    def _update_fused(self, indices, weights, grads, states,
+                      multi_precision):
+        """One multi-tensor kernel over the whole aggregated group."""
+        params = dict(num_weights=len(indices),
+                      lrs=tuple(self._get_lr(i) for i in indices),
+                      wds=tuple(self._get_wd(i) for i in indices),
+                      rescale_grad=self.rescale_grad)
+        if self.clip_gradient:
+            params["clip_gradient"] = self.clip_gradient
+        use_mom = self.momentum > 0
+        if use_mom:
+            params["momentum"] = self.momentum
+        inter = []
+        if multi_precision:
+            op = ("multi_mp_sgd_mom_update" if use_mom
+                  else "multi_mp_sgd_update")
+            for w, g, (mom, w32) in zip(weights, grads, states):
+                inter += [w, g, mom, w32] if use_mom else [w, g, w32]
+        else:
+            op = "multi_sgd_mom_update" if use_mom else "multi_sgd_update"
+            for w, g, s in zip(weights, grads, states):
+                inter += [w, g, s] if use_mom else [w, g]
+        invoke(op, inter, params, out=list(weights))
+
+    def _update_impl(self, index, weight, grad, state,
+                     multi_precision=False):
+        if not isinstance(index, (list, tuple)):
+            index, weight, grad, state = \
+                [index], [weight], [grad], [state]
+        self._update_count(index)
+        dense = all(getattr(w, "stype", "default") == "default"
+                    and getattr(g, "stype", "default") == "default"
+                    for w, g in zip(weight, grad))
+        if dense and len(index) > 1:
+            self._update_fused(index, weight, grad, state, multi_precision)
+            return
+        for i, w, g, s in zip(index, weight, grad, state):
+            self._update_one(i, w, g, s, multi_precision)
 
     def update(self, index, weight, grad, state):
         self._update_impl(index, weight, grad, state, multi_precision=False)
 
     def update_multi_precision(self, index, weight, grad, state):
-        use_mp = self.multi_precision and _is_low_precision(weight.dtype)
+        w0 = weight[0] if isinstance(weight, (list, tuple)) else weight
+        use_mp = self.multi_precision and _is_low_precision(w0.dtype)
         self._update_impl(index, weight, grad, state, multi_precision=use_mp)
 
 
@@ -312,17 +363,15 @@ class Signum(Optimizer):
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
-            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+            return _state_buf(weight)
         return None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
         if state is not None:
             invoke("signum_update", [weight, grad, state],
                    dict(momentum=self.momentum, wd_lh=self.wd_lh, **kwargs))
@@ -343,19 +392,16 @@ class FTML(Optimizer):
 
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # d
-                zeros(weight.shape, weight.context, dtype=weight.dtype),  # v
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # z
+        return (_state_buf(weight),  # d
+                _state_buf(weight),  # v
+                _state_buf(weight))  # z
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
+        t = self._step_of(index)
         clip = kwargs.pop("clip_gradient", None)
         d, v, z = state
         invoke("ftml_update", [weight, grad, d, v, z],
@@ -369,15 +415,13 @@ class DCASGD(Optimizer):
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lamda = lamda
+        self.momentum, self.lamda = momentum, lamda
 
     def create_state(self, index, weight):
         return weight.copy()  # previous weight
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
         invoke("dcasgd_update", [weight, grad, state],
                dict(lamda=self.lamda, **kwargs))
 
@@ -392,12 +436,11 @@ class NAG(Optimizer):
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
-            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+            return _state_buf(weight)
         return None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
         if state is not None:
             invoke("nag_mom_update", [weight, grad, state],
                    dict(momentum=self.momentum, **kwargs))
@@ -410,8 +453,7 @@ class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference: optimizer.py:1070)."""
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        invoke("sgld_update", [weight, grad], self._common_kwargs(index))
+        invoke("sgld_update", [weight, grad], self._begin_update(index))
 
 
 @register
@@ -421,19 +463,16 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
+        t = self._step_of(index)
         # bias correction folded into lr (reference does the same)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
@@ -461,13 +500,12 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return _state_buf(weight)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
         invoke("adagrad_update", [weight, grad, state],
                dict(epsilon=self.float_stable_eps,
-                    **self._common_kwargs(index)))
+                    **self._begin_update(index)))
 
 
 @register
@@ -478,23 +516,19 @@ class RMSProp(Optimizer):
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
-        self.clip_weights = clip_weights
+        self.gamma1, self.gamma2, self.centered = gamma1, gamma2, centered
+        self.epsilon, self.clip_weights = epsilon, clip_weights
 
     def create_state(self, index, weight):
         if self.centered:
-            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
-                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
-                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+            return (_state_buf(weight),  # n
+                    _state_buf(weight),  # g
+                    _state_buf(weight))  # delta
+        return _state_buf(weight)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
         kwargs = dict(gamma1=self.gamma1, epsilon=self.epsilon,
-                      **self._common_kwargs(index))
+                      **self._begin_update(index))
         if self.clip_weights:
             kwargs["clip_weights"] = self.clip_weights
         if not self.centered:
@@ -511,17 +545,15 @@ class AdaDelta(Optimizer):
 
     def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
         acc_g, acc_d = state
-        kwargs = self._common_kwargs(index)
+        kwargs = self._begin_update(index)
         kwargs.pop("lr")
         invoke("adadelta_update", [weight, grad, acc_g, acc_d],
                dict(lr=1.0, rho=self.rho, epsilon=self.epsilon, **kwargs))
@@ -533,19 +565,17 @@ class Ftrl(Optimizer):
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
+        self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # z
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+        return (_state_buf(weight),  # z
+                _state_buf(weight))  # n
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
         z, n = state
         invoke("ftrl_update", [weight, grad, z, n],
                dict(lamda1=self.lamda1, beta=self.beta,
-                    **self._common_kwargs(index)))
+                    **self._begin_update(index)))
 
 
 @register
@@ -554,20 +584,19 @@ class Adamax(Optimizer):
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+        self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
+        kwargs = self._begin_update(index)
+        t = self._step_of(index)
         mean, var = state
         invoke("adamax_update", [weight, grad, mean, var],
                dict(beta1=self.beta1, beta2=self.beta2, t=float(t),
-                    **self._common_kwargs(index)))
+                    **kwargs))
 
 
 @register
@@ -577,26 +606,23 @@ class Nadam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-        self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay, self.m_schedule = schedule_decay, 1.0
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
+        kwargs = self._begin_update(index)
+        t = self._step_of(index)
         momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         mean, var = state
         invoke("nadam_update", [weight, grad, mean, var],
                dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
                     t=float(t), m_schedule=self.m_schedule,
                     schedule_decay=self.schedule_decay,
-                    **self._common_kwargs(index)))
+                    **kwargs))
         self.m_schedule *= momentum_t
 
 
@@ -608,21 +634,18 @@ class AdamW(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, eta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.eta = eta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
         mean, var = state
         invoke("adamw_update", [weight, grad, mean, var],
                dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-                    eta=self.eta, **self._common_kwargs(index)))
+                    eta=self.eta, **self._begin_update(index)))
 
 
 @register
@@ -648,23 +671,21 @@ class LAMB(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, bias_correction=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (_state_buf(weight),
+                _state_buf(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
+        kwargs = self._begin_update(index)
+        t = self._step_of(index)
         mean, var = state
         invoke("lamb_update", [weight, grad, mean, var],
                dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
                     t=float(t), bias_correction=self.bias_correction,
-                    **self._common_kwargs(index)))
+                    **kwargs))
 
 
 @register
@@ -684,27 +705,65 @@ create = Optimizer.create_optimizer  # pylint: disable=invalid-name
 
 class Updater:
     """Applies an optimizer to (index, grad, weight) triples, owning state
-    (reference: optimizer.py:1608; fp16 master weights in states)."""
+    (public surface of the reference optimizer.py Updater).
+
+    When the optimizer declares ``aggregate_num > 0``, list-valued calls
+    are chunked into same-dtype groups of dense parameters and handed to
+    the optimizer as lists, which the SGD family turns into one
+    ``multi_sgd_*`` multi-tensor kernel per chunk — the TPU answer to
+    per-small-param dispatch overhead."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
         self.states_synced = {}
-        self.aggregate_updates = False
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def _state_of(self, index, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(
+                self.states[index], weight.context)
+            self.states_synced[index] = True
+        return self.states[index]
+
+    def _aggregate_chunks(self, indices, grads, weights):
+        """Yield (idx_list, grad_list, weight_list) chunks: runs of
+        dense, same-dtype params up to aggregate_num long; sparse or
+        odd-dtype params come through as singleton chunks."""
+        cap = max(int(self.optimizer.aggregate_num), 1)
+        run = []
+        for i, g, w in zip(indices, grads, weights):
+            dense = (getattr(w, "stype", "default") == "default"
+                     and getattr(g, "stype", "default") == "default")
+            if not dense:
+                if run:
+                    yield tuple(zip(*run))
+                    run = []
+                yield ([i], [g], [w])
+                continue
+            if run and (len(run) >= cap or run[-1][2].dtype != w.dtype):
+                yield tuple(zip(*run))
+                run = []
+            run.append((i, g, w))
+        if run:
+            yield tuple(zip(*run))
 
     def __call__(self, index, grad, weight):
         if not isinstance(index, (list, tuple)):
-            indices, grads, weights = [index], [grad], [weight]
-        else:
-            indices, grads, weights = index, grad, weight
-        for i, g, w in zip(indices, grads, weights):
-            if i not in self.states:
-                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
-                self.states_synced[i] = True
-            elif not self.states_synced[i]:
-                self.states[i] = self.sync_state_context(self.states[i], w.context)
-                self.states_synced[i] = True
-            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+            index, grad, weight = [index], [grad], [weight]
+        if self.aggregate_updates and len(index) > 1:
+            for idxs, gs, ws in self._aggregate_chunks(index, grad, weight):
+                states = [self._state_of(i, w) for i, w in zip(idxs, ws)]
+                self.optimizer.update_multi_precision(
+                    list(idxs), list(ws), list(gs), states)
+            return
+        for i, g, w in zip(index, grad, weight):
+            self.optimizer.update_multi_precision(
+                i, w, g, self._state_of(i, w))
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):

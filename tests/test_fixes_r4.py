@@ -141,3 +141,31 @@ def test_dmlc_serde_dumps_warns_on_flagless_dtype():
         buf = serde.dumps([arr])
     arrays, _, _ = serde.loads(buf)
     assert arrays[0].dtype == np.float32
+
+
+def test_regression_metrics_mixed_rank_no_broadcast():
+    """(n,) labels against (n, 1) preds must not broadcast to (n, n)
+    (regression guard for the metric rewrite)."""
+    lab = nd.array(np.array([1.0, 2.0, 3.0], np.float32))      # (3,)
+    pred = nd.array(np.array([[1.5], [2.5], [3.5]], np.float32))  # (3,1)
+    for name, want in (("mae", 0.5), ("mse", 0.25), ("rmse", 0.5)):
+        m = mx.metric.create(name)
+        m.update([lab], [pred])
+        assert abs(m.get()[1] - want) < 1e-6, (name, m.get())
+
+
+def test_f1_mcc_accept_any_binary_label_encoding():
+    """{-1, 1} and {0, 2} label encodings are valid binary problems;
+    value 1 is the positive class, everything else negative."""
+    preds = nd.array(np.array([0.9, 0.1, 0.8, 0.2], np.float32))
+    # SVM-style {-1, 1}: the 1s are the positives -> perfect score
+    for metric_name, want in (("f1", 1.0), ("mcc", 1.0)):
+        m = mx.metric.create(metric_name)
+        m.update([nd.array(np.array([1, -1, 1, -1], np.float32))],
+                 [preds])
+        assert m.get()[1] == want, (metric_name, m.get())
+    # {0, 2} encoding: no label equals 1, so no true positives -> 0.0,
+    # not a bincount crash
+    f1 = mx.metric.F1()
+    f1.update([nd.array(np.array([2, 0, 2, 0], np.float32))], [preds])
+    assert f1.get()[1] == 0.0

@@ -357,3 +357,38 @@ def test_published_op_count_matches_registry():
         assert claim in text, (
             "%s op-count claim is stale: registry has %d distinct ops / "
             "%d registered names" % (doc, distinct, names))
+
+
+# ---------------------------------------------------------------------------
+# Trainer wiring: the gluon non-kvstore step fuses into multi_sgd kernels
+# ---------------------------------------------------------------------------
+def test_trainer_uses_multi_tensor_kernels():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.ops import registry
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=None)
+    x = nd.array(R(20).rand(2, 6).astype(np.float32))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+
+    before = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    seen = []
+
+    def tap(opdef, inputs, params, out):
+        seen.append(opdef.name)
+        return registry._invoke_impl(opdef, inputs, params, out)
+
+    with registry.invoke_tap(tap):
+        trainer.step(1)
+
+    assert "multi_sgd_mom_update" in seen, seen
+    assert "sgd_mom_update" not in seen  # no per-param dispatches
+    for k, v in net.collect_params().items():
+        assert not np.allclose(v.data().asnumpy(), before[k]), k
