@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 
 build_native() {
     make -C native
-    make -C native test_client cpp_example cpp_train
+    make -C native test_client cpp_example cpp_train autograd_cpp predict_cpp abi_extras
 }
 
 sanity_check() {

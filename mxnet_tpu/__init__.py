@@ -20,6 +20,7 @@ from .attribute import AttrScope  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus,  # noqa: F401
                       num_tpus, current_context)
 from . import ops  # noqa: F401  (registers the op corpus)
+from . import operator  # noqa: F401  (registers 'Custom' before nd codegen)
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import autograd  # noqa: F401

@@ -44,8 +44,20 @@ def create(shape, dev_type, dev_id, dtype_code):
     dtype = _DTYPE_FROM_CODE.get(int(dtype_code))
     if dtype is None:
         raise ValueError("unknown dtype code %r" % (dtype_code,))
-    return _nd.zeros(tuple(int(s) for s in shape),
-                     ctx=_ctx(dev_type, dev_id), dtype=dtype)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # we fail loudly below instead
+        arr = _nd.zeros(tuple(int(s) for s in shape),
+                        ctx=_ctx(dev_type, dev_id), dtype=dtype)
+    if str(arr.dtype) != dtype:
+        # silent truncation (int64 -> int32 under x32) would corrupt the
+        # byte-copy ABI whose layout contract is the REQUESTED dtype
+        raise ValueError(
+            "dtype %s is unavailable on this runtime (got %s); set "
+            "MXNET_INT64_TENSOR_SIZE=1 to enable 64-bit tensors"
+            % (dtype, arr.dtype))
+    return arr
 
 
 def dtype_code(arr):
@@ -407,3 +419,495 @@ def dataiter_get_label(h):
 
 def dataiter_get_pad(h):
     return int(_current_batch(h).pad or 0)
+
+
+# ---------------------------------------------------------------------------
+# NDArray extras (reference src/c_api/c_api.cc slice/at/reshape/raw-bytes,
+# storage type, detach/grad-state, sparse accessors)
+# ---------------------------------------------------------------------------
+def create_none():
+    from .ndarray import NDArray
+    import jax.numpy as jnp
+
+    # the reference's "None" array is a deferred-alloc placeholder; a
+    # zero-size handle serves the same slot-filling role
+    return NDArray(jnp.zeros((0,), jnp.float32))
+
+
+def nd_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def nd_at(arr, idx):
+    return arr[int(idx)]
+
+
+def nd_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def storage_type_code(arr):
+    # reference storage type codes: 0 undefined, 1 default, 2 row_sparse,
+    # 3 csr (include/mxnet/ndarray.h NDArrayStorageType)
+    return {"default": 1, "row_sparse": 2, "csr": 3}.get(
+        getattr(arr, "stype", "default"), 0)
+
+
+def nd_detach(arr):
+    from .ndarray.ndarray import _wrap
+
+    out = _wrap(arr.data, arr.context)
+    return out
+
+
+def nd_set_grad_state(arr, state):
+    arr._grad_req = "write" if state else None
+
+
+def nd_get_grad_state(arr):
+    return int(arr._grad_req is not None and arr._grad_req != "null")
+
+
+def nd_save_raw_bytes(arr):
+    from .ndarray import dmlc_serde
+    import numpy as np
+
+    return dmlc_serde.dumps([np.asarray(arr.asnumpy())])
+
+
+def nd_load_from_raw_bytes(buf):
+    from .ndarray import dmlc_serde, array
+
+    arrays, _names, _stypes = dmlc_serde.loads(bytes(buf))
+    if len(arrays) != 1:
+        raise ValueError("raw bytes must contain exactly one NDArray")
+    return array(arrays[0])
+
+
+def nd_data_ndarray(arr):
+    from .ndarray import array
+
+    return array(arr.values.asnumpy()) if hasattr(arr, "values") else arr
+
+
+def nd_aux_ndarray(arr, i):
+    i = int(i)
+    stype = getattr(arr, "stype", "default")
+    if stype == "row_sparse":
+        if i != 0:
+            raise IndexError("row_sparse has one aux array (indices)")
+        return arr.indices
+    if stype == "csr":
+        if i == 0:
+            return arr.indptr
+        if i == 1:
+            return arr.indices
+        raise IndexError("csr has two aux arrays (indptr, indices)")
+    raise ValueError("dense NDArray has no aux arrays")
+
+
+def nd_aux_type_code(arr, i):
+    aux = nd_aux_ndarray(arr, i)
+    return dtype_code(aux)
+
+
+def to_numpy_retained(arr):
+    import numpy as np
+
+    # a fresh writable copy: DLPack (pre-1.0) cannot signal read-only
+    # buffers, and jax's asnumpy view is read-only
+    out = np.empty(arr.shape, dtype=np.dtype(arr.asnumpy().dtype))
+    np.copyto(out, arr.asnumpy())
+    return out
+
+
+class _CapsuleDLPack:
+    """Shim giving a raw DLPack capsule the __dlpack__ protocol numpy
+    expects (MXNDArrayFromDLPack marshalling)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack_capsule(capsule):
+    import numpy as np
+
+    from .ndarray import array
+
+    host = np.from_dlpack(_CapsuleDLPack(capsule))
+    return array(np.ascontiguousarray(host))
+
+
+def invoke_ex(op_name, inputs, keys, vals):
+    outs = invoke(op_name, inputs, keys, vals)
+    if not isinstance(outs, list):
+        outs = [outs]
+    return outs, [storage_type_code(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# CachedOp plane (reference src/c_api/c_api_ndarray.cc:235 MXCreateCachedOp /
+# MXInvokeCachedOpEx over imperative/cached_op.cc)
+# ---------------------------------------------------------------------------
+class _CachedOpHandle:
+    """A bound symbol whose executor is cached per input-shape set —
+    the reference CachedOp's trace-once-run-many contract, realized as
+    the registry's cached jit under a rebindable executor."""
+
+    def __init__(self, sym, flags):
+        self.sym = sym
+        self.flags = dict(flags)
+        self._ex = None
+        self._sig = None
+
+    def __call__(self, inputs):
+        names = self.sym.list_arguments()
+        if len(inputs) != len(names):
+            raise ValueError("CachedOp expects %d inputs (%s), got %d"
+                             % (len(names), names, len(inputs)))
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        if self._ex is None or sig != self._sig:
+            self._ex = self.sym.bind(
+                ctx=inputs[0].context if inputs else None,
+                args=dict(zip(names, inputs)))
+            self._sig = sig
+        else:
+            for n, a in zip(names, inputs):
+                self._ex.arg_dict[n]._set_data(a.data)
+        self._ex.forward(is_train=False)
+        return list(self._ex.outputs)
+
+
+def cached_op_create(sym, keys, vals):
+    return _CachedOpHandle(sym, zip(keys, vals))
+
+
+def cached_op_invoke(op, inputs):
+    outs = op(list(inputs))
+    return outs, [storage_type_code(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# KVStore extras (reference src/c_api/c_api.cc updater/barrier/row-sparse,
+# string keys, node-role predicates, server commands)
+# ---------------------------------------------------------------------------
+def kv_init_str(kv, keys, vals):
+    kv.init([str(k) for k in keys], list(vals))
+
+
+def kv_push_str(kv, keys, vals, priority):
+    kv.push([str(k) for k in keys], list(vals), priority=priority)
+
+
+def kv_pull_str(kv, keys, outs, priority):
+    kv.pull([str(k) for k in keys], out=list(outs), priority=priority)
+
+
+def kv_set_updater(kv, py_cb):
+    """py_cb(key:int, recv:NDArray, local:NDArray) -> None; the C shim
+    wraps the user's C function pointer into py_cb."""
+    kv.set_updater(py_cb)
+
+
+def kv_barrier(kv):
+    kv._barrier()
+
+
+def kv_pull_row_sparse(kv, keys, outs, row_id_arrays, priority):
+    kv.row_sparse_pull(list(keys), out=list(outs), priority=priority,
+                       row_ids=list(row_id_arrays))
+
+
+def kv_is_worker_node():
+    import os
+
+    return int(os.environ.get("DMLC_ROLE", "worker") == "worker")
+
+
+def kv_is_server_node():
+    import os
+
+    return int(os.environ.get("DMLC_ROLE", "worker") == "server")
+
+
+def kv_is_scheduler_node():
+    import os
+
+    return int(os.environ.get("DMLC_ROLE", "worker") == "scheduler")
+
+
+def kv_send_command_to_servers(kv, cmd_id, cmd_body):
+    """Reference MXKVStoreSendCommmandToServers: the controller channel
+    workers use to push an optimizer/config to the server.  Command 0
+    carries a pickled optimizer (kvstore_dist_server.h kController)."""
+    if getattr(kv, "_async", None) is not None and int(cmd_id) == 0:
+        if kv.rank == 0:
+            kv._async.set_optimizer(
+                cmd_body if isinstance(cmd_body, bytes)
+                else str(cmd_body).encode("latin-1"))
+        return
+    raise ValueError("kvstore type %r has no server command channel for "
+                     "cmd %d" % (kv.type, int(cmd_id)))
+
+
+def kv_type(kv):
+    return str(kv.type)
+
+
+# ---------------------------------------------------------------------------
+# RecordIO ABI (reference src/c_api/c_api.cc MXRecordIO*)
+# ---------------------------------------------------------------------------
+def recordio_writer_create(uri):
+    from . import recordio
+
+    return recordio.MXRecordIO(str(uri), "w")
+
+
+def recordio_reader_create(uri):
+    from . import recordio
+
+    return recordio.MXRecordIO(str(uri), "r")
+
+
+def recordio_close(rec):
+    rec.close()
+
+
+def recordio_write_record(rec, buf):
+    rec.write(bytes(buf))
+
+
+def recordio_read_record(rec):
+    return rec.read()  # None at EOF
+
+
+def recordio_writer_tell(rec):
+    return int(rec.tell())
+
+
+def recordio_reader_seek(rec, pos):
+    rec.seek(int(pos))
+
+
+def recordio_reader_tell(rec):
+    return int(rec.tell())
+
+
+# ---------------------------------------------------------------------------
+# Profiler ABI (reference src/c_api/c_api_profile.cc)
+# ---------------------------------------------------------------------------
+def profiler_set_config(keys, vals):
+    from . import profiler
+
+    profiler.set_config(**{k: _parse_value(v)
+                           for k, v in zip(keys, vals)})
+
+
+def profiler_set_state(state):
+    from . import profiler
+
+    profiler.set_state({0: "stop", 1: "run"}.get(int(state), "stop"))
+
+
+def profiler_dump(finished):
+    from . import profiler
+
+    profiler.dump(bool(finished))
+
+
+def profiler_aggregate_stats(reset):
+    from . import profiler
+
+    return profiler.dumps(reset=bool(reset))
+
+
+def profiler_pause(paused):
+    from . import profiler
+
+    if paused:
+        profiler.pause()
+    else:
+        profiler.resume()
+
+
+# ---------------------------------------------------------------------------
+# Symbol extras (reference src/c_api/c_api_symbolic.cc attr/type/internals
+# and the op-introspection surface frontends codegen from)
+# ---------------------------------------------------------------------------
+def symbol_infer_type(sym, keys, type_codes):
+    """(arg_codes, out_codes, aux_codes, complete) — CSR-free dtype
+    inference (reference MXSymbolInferType, c_api_symbolic.cc)."""
+    known = {}
+    codes = list(type_codes)
+    names = list(keys)
+    if names:
+        for k, c in zip(names, codes):
+            if int(c) >= 0:
+                known[str(k)] = _DTYPE_FROM_CODE[int(c)]
+        arg_t, out_t, aux_t = sym.infer_type(**known)
+    else:
+        arg_t, out_t, aux_t = sym.infer_type(
+            *[_DTYPE_FROM_CODE[int(c)] if int(c) >= 0 else None
+              for c in codes])
+
+    def enc(ts):
+        return [_CODE_FROM_DTYPE[np.dtype(t).name] if t is not None
+                else -1 for t in ts]
+
+    complete = int(arg_t is not None and all(t is not None for t in arg_t))
+    if not complete:
+        return [], [], [], 0
+    return enc(arg_t), enc(out_t), enc(aux_t), complete
+
+
+def symbol_copy(sym):
+    import copy
+
+    return copy.deepcopy(sym)
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(str(key))
+    return None if v is None else str(v)
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{str(key): str(value)})
+
+
+def symbol_list_attr(sym):
+    out = []
+    for k, v in (sym.list_attr() or {}).items():
+        out.append(str(k))
+        out.append(str(v))
+    return out
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_num_outputs(sym):
+    return len(sym.list_outputs())
+
+
+def symbol_save_file(sym, fname):
+    sym.save(str(fname))
+
+
+def symbol_load_file(fname):
+    from . import symbol
+
+    return symbol.load(str(fname))
+
+
+def op_names_sorted():
+    return list_ops()
+
+
+def op_info(op_name):
+    """(name, description, arg_names, arg_types, arg_descs, return_type)
+    for MXSymbolGetAtomicSymbolInfo."""
+    import inspect
+
+    from .ops.registry import get_op
+
+    opdef = get_op(op_name)
+    doc = inspect.getdoc(opdef.fn) or ""
+    try:
+        sig = inspect.signature(opdef.fn)
+        params = [p.name for p in sig.parameters.values()
+                  if p.default is not p.empty]
+    except (TypeError, ValueError):
+        params = []
+    return (opdef.name, doc, params,
+            ["string"] * len(params), [""] * len(params), "NDArray")
+
+
+# ---------------------------------------------------------------------------
+# Executor monitor callback (reference graph_executor.cc:1295)
+# ---------------------------------------------------------------------------
+def executor_set_monitor(ex, py_cb, monitor_all):
+    """py_cb(name:str, arr:NDArray) -> None per monitored tensor.
+
+    The executor's tap hands (node_name, output_tuple) of raw device
+    arrays; the ABI contract is one callback per tensor (reference
+    ExecuteMonOutputCallback, graph_executor.cc:1295)."""
+    from .ndarray.ndarray import _wrap
+
+    def tap(name, res):
+        outs = res if isinstance(res, (list, tuple)) else [res]
+        for i, r in enumerate(outs):
+            nm = name if len(outs) == 1 else "%s_output%d" % (name, i)
+            py_cb(str(nm), _wrap(r))
+
+    ex.set_monitor_callback(tap, monitor_all=bool(monitor_all))
+
+
+# ---------------------------------------------------------------------------
+# Autograd extras
+# ---------------------------------------------------------------------------
+def autograd_is_recording():
+    from . import autograd
+
+    return int(autograd.is_recording())
+
+
+def autograd_is_training():
+    from . import autograd
+
+    return int(autograd.is_training())
+
+
+def autograd_backward_ex(outputs, head_grads, variables, retain_graph,
+                         create_graph, is_train):
+    from . import autograd
+
+    hg = list(head_grads) if head_grads else None
+    if create_graph:
+        raise ValueError("create_graph through the C ABI is not "
+                         "supported; use the python frontend")
+    autograd.backward(list(outputs), head_grads=hg,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(is_train))
+    if not variables:
+        return []
+    grads = []
+    for v in variables:
+        grads.append(ndarray_get_grad(v))
+    return grads
+
+
+# ---------------------------------------------------------------------------
+# Misc runtime
+# ---------------------------------------------------------------------------
+def get_version():
+    # mirrors the reference MXNET_VERSION numbering scheme (major*10000 +
+    # minor*100 + patch); this framework tracks reference 1.x capability
+    return 10600
+
+
+def random_seed(seed):
+    from . import random
+
+    random.seed(int(seed))
+
+
+def device_count():
+    import jax
+
+    try:
+        return int(len([d for d in jax.devices()
+                        if d.platform != "cpu"]))
+    except RuntimeError:
+        return 0

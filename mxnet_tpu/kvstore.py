@@ -455,6 +455,17 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
         self._update_on_kvstore_flag = True
 
+    def set_updater(self, updater):
+        """Install a custom updater ``updater(key, recv_grad, local)``
+        applied on the store for every push (reference: kvstore.py
+        ``_set_updater`` / MXKVStoreSetUpdater — the mechanism frontends
+        use to run their own update rule store-side)."""
+        self._updater = updater
+        self._update_on_kvstore_flag = True
+
+    # reference-private spelling kept for drop-in compatibility
+    _set_updater = set_updater
+
     def _str_index(self, key):
         if key not in self._str_key_dict:
             self._str_key_dict[key] = len(self._str_key_dict)

@@ -83,11 +83,44 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    __slots__ = ()
+    __slots__ = ("_parts",)
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx=ctx)
+        self._parts = None  # cached (values, indptr, indices)
 
     @property
     def stype(self):
         return "csr"
+
+    def _set_data(self, value):
+        super()._set_data(value)
+        self._parts = None  # mutation invalidates the derived views
+
+    def _csr_parts(self):
+        """(values, indptr, indices) recovered from the dense backing —
+        computed once per value (one host sync), like RowSparseNDArray's
+        cached indices."""
+        if self._parts is None:
+            dense = np.asarray(self.asnumpy())
+            mask = dense != 0
+            indptr = np.zeros(dense.shape[0] + 1, np.int64)
+            np.cumsum(mask.sum(axis=1), out=indptr[1:])
+            cols = np.nonzero(mask)[1]
+            self._parts = (dense[mask], indptr, cols.astype(np.int64))
+        return self._parts
+
+    @property
+    def indptr(self):
+        return _dense_array(self._csr_parts()[1], dtype="int64")
+
+    @property
+    def indices(self):
+        return _dense_array(self._csr_parts()[2], dtype="int64")
+
+    @property
+    def values(self):
+        return _wrap(jnp.asarray(self._csr_parts()[0]))
 
     def tostype(self, stype):
         if stype == "default":

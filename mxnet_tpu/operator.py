@@ -1,0 +1,225 @@
+"""User-defined operators: CustomOp / CustomOpProp / register.
+
+Reference parity: ``python/mxnet/operator.py`` (CustomOp:426,
+CustomOpProp:472, register:692) over ``src/operator/custom/custom-inl.h`` —
+user Python ops with shape/type inference, usable imperatively
+(``mx.nd.Custom``) and inside Symbol graphs / Module training
+(``mx.sym.Custom``).
+
+TPU-native design: the reference runs custom-op callbacks on a dedicated
+worker thread pool woven into the dependency engine
+(``custom-inl.h:50-60`` CustomOperator::Push).  Here the op body is a
+``jax.pure_callback`` — the XLA runtime calls back into Python at the
+right point of the compiled program, which is the same contract (compute
+happens outside the compiler, scheduling inside) without a hand-built
+thread pool.  The gradient is a ``jax.custom_vjp`` whose backward is a
+second callback into the user's ``backward``.  Shapes/dtypes come from
+``CustomOpProp.infer_shape``/``infer_type`` at trace time, so the op
+composes with ``jax.eval_shape`` — which is exactly what
+``symbol/infer.py`` uses, making Symbol-graph integration automatic.
+
+Auxiliary states (``list_auxiliary_states``) are trailing inputs; their
+updated values are extra (hidden) outputs that the dispatcher writes back
+in place via the registry's dynamic mutate map — BatchNorm-style.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+
+class CustomOp:
+    """Base class for user operators (reference: operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write request."""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError("unknown req %r" % (req,))
+
+
+class CustomOpProp:
+    """Operator properties: shapes, types, and the operator factory
+    (reference: operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else np.float32
+        return in_type, [t] * len(self.list_outputs()), \
+            [t] * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_PROP_REGISTRY: dict = {}
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (reference: operator.py:692)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register must be applied to a CustomOpProp "
+                            "subclass, got %r" % (prop_cls,))
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_PROP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# The framework-side 'Custom' operator
+# ---------------------------------------------------------------------------
+def _instantiate_prop(op_type, user_kwargs):
+    if op_type not in _PROP_REGISTRY:
+        raise KeyError(
+            "Custom op type %r is not registered; use "
+            "@mx.operator.register(%r) on a CustomOpProp subclass"
+            % (op_type, op_type))
+    # reference marshals every hyper-parameter as a string through the C
+    # boundary; props are written to parse strings, so match that
+    kwargs = {k: str(v) for k, v in user_kwargs.items()}
+    return _PROP_REGISTRY[op_type](**kwargs)
+
+
+def _custom_plan(params, n_inputs):
+    """(n_args, n_out, n_aux) for a Custom invocation's params."""
+    prop = _instantiate_prop(
+        params["op_type"],
+        {k: v for k, v in params.items() if k != "op_type"})
+    return (len(prop.list_arguments()), len(prop.list_outputs()),
+            len(prop.list_auxiliary_states()))
+
+
+def _custom_mutate(params, n_inputs):
+    n_args, n_out, n_aux = _custom_plan(params, n_inputs)
+    return {n_out + j: n_args + j for j in range(n_aux)}
+
+
+def _custom_visible(attrs):
+    n_args, n_out, n_aux = _custom_plan(dict(attrs), -1)
+    return list(range(n_out))
+
+
+def _register_custom_op():
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import register as reg_op
+
+    @reg_op("Custom", train_aware=True, mutate=_custom_mutate,
+            visible_out=_custom_visible, cacheable=True)
+    def _custom(*arrays, op_type=None, _train=False, **user_kwargs):
+        from . import ndarray as nd
+
+        prop = _instantiate_prop(op_type, user_kwargs)
+        arg_names = prop.list_arguments()
+        out_names = prop.list_outputs()
+        aux_names = prop.list_auxiliary_states()
+        n_args, n_out, n_aux = len(arg_names), len(out_names), len(aux_names)
+        assert len(arrays) == n_args + n_aux, (
+            "Custom op %r expects %d inputs (%d args + %d aux), got %d"
+            % (op_type, n_args + n_aux, n_args, n_aux, len(arrays)))
+
+        in_shapes = [tuple(a.shape) for a in arrays[:n_args]]
+        in_types = [np.dtype(a.dtype) for a in arrays[:n_args]]
+        arg_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+        _, out_types, aux_types = prop.infer_type(in_types)
+        op = prop.create_operator("cpu", arg_shapes, in_types)
+
+        result_spec = tuple(
+            jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+            for s, t in zip(list(out_shapes) + list(aux_shapes),
+                            list(out_types) + list(aux_types)))
+
+        def host_forward(*host_in):
+            in_nd = [nd.array(np.asarray(a)) for a in host_in[:n_args]]
+            aux_nd = [nd.array(np.asarray(a)) for a in host_in[n_args:]]
+            out_nd = [nd.zeros(tuple(s), dtype=np.dtype(t))
+                      for s, t in zip(out_shapes, out_types)]
+            op.forward(is_train=_train, req=["write"] * n_out,
+                       in_data=in_nd, out_data=out_nd, aux=aux_nd)
+            return tuple(o.asnumpy() for o in out_nd) \
+                + tuple(a.asnumpy() for a in aux_nd)
+
+        def host_backward(*host_all):
+            # layout: out_grads, in_data, out_data, aux (POST-forward
+            # values — the reference's backward reads live aux state)
+            gouts = [nd.array(np.asarray(a)) for a in host_all[:n_out]]
+            rest = host_all[n_out:]
+            in_nd = [nd.array(np.asarray(a)) for a in rest[:n_args]]
+            out_nd = [nd.array(np.asarray(a))
+                      for a in rest[n_args:n_args + n_out]]
+            aux_nd = [nd.array(np.asarray(a))
+                      for a in rest[n_args + n_out:]]
+            grad_nd = [nd.zeros(a.shape, dtype=a.dtype) for a in in_nd]
+            op.backward(req=["write"] * n_args, out_grad=gouts,
+                        in_data=in_nd, out_data=out_nd, in_grad=grad_nd,
+                        aux=aux_nd)
+            return tuple(g.asnumpy() for g in grad_nd)
+
+        @jax.custom_vjp
+        def run(*xs):
+            return jax.pure_callback(host_forward, result_spec, *xs)
+
+        def run_fwd(*xs):
+            res = jax.pure_callback(host_forward, result_spec, *xs)
+            return res, (xs, res)
+
+        def run_bwd(saved, gs):
+            xs, res = saved
+            grad_spec = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                              for x in xs[:n_args])
+            gouts = gs[:n_out]
+            grads = jax.pure_callback(
+                host_backward, grad_spec,
+                *(tuple(gouts) + tuple(xs[:n_args]) + tuple(res)))
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return tuple(grads) + tuple(
+                jnp.zeros_like(x) for x in xs[n_args:])
+
+        run.defvjp(run_fwd, run_bwd)
+        results = run(*arrays)
+        return results if len(results) > 1 else results[0]
+
+    return _custom
+
+
+_register_custom_op()

@@ -1,0 +1,451 @@
+// Round-4 ABI client: exercises the planes a full language frontend
+// needs beyond basic NDArray/symbol/executor calls — CachedOp inference
+// (reference cpp-package inference idiom), an updater-driven KVStore
+// training step (reference kvstore custom-updater idiom), DLPack
+// interop, RecordIO, raw-byte serde, executor monitor callbacks, symbol
+// attributes/type inference/introspection, profiler control, and the
+// autograd extras.  Prints ABI_EXTRAS_OK when every check passes.
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+#define CHECK_OK(call)                                             \
+  do {                                                             \
+    if ((call) != 0) {                                             \
+      std::fprintf(stderr, "FAILED %s: %s\n", #call,               \
+                   MXGetLastError());                              \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+#define EXPECT(cond)                                               \
+  do {                                                             \
+    if (!(cond)) {                                                 \
+      std::fprintf(stderr, "EXPECT failed: %s\n", #cond);          \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+namespace {
+
+NDArrayHandle make_filled(const std::vector<mx_uint>& shape, float v) {
+  NDArrayHandle h = nullptr;
+  if (MXNDArrayCreate(shape.data(), (mx_uint)shape.size(), 1, 0, 0, &h))
+    return nullptr;
+  size_t n = 1;
+  for (mx_uint s : shape) n *= s;
+  std::vector<float> buf(n, v);
+  if (MXNDArraySyncCopyFromCPU(h, buf.data(), n)) return nullptr;
+  return h;
+}
+
+int read_floats(NDArrayHandle h, std::vector<float>* out) {
+  mx_uint ndim = 0;
+  const mx_uint* dims = nullptr;
+  if (MXNDArrayGetShape(h, &ndim, &dims)) return -1;
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  out->resize(n);
+  return MXNDArraySyncCopyToCPU(h, out->data(), n);
+}
+
+// KVStore updater: local -= 0.5 * recv, through ABI invokes only
+int g_updater_calls = 0;
+void sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                 void* handle) {
+  (void)key;
+  (void)handle;
+  ++g_updater_calls;
+  NDArrayHandle ins[2] = {local, recv};
+  int nout = 1;
+  NDArrayHandle out_arr[1] = {local};
+  NDArrayHandle* outs = out_arr;
+  const char* keys[] = {"lr", "wd"};
+  const char* vals[] = {"0.5", "0.0"};
+  if (MXImperativeInvoke("sgd_update", 2, ins, &nout, &outs, 2, keys,
+                         vals) != 0)
+    std::fprintf(stderr, "updater invoke failed: %s\n", MXGetLastError());
+}
+
+int g_monitor_calls = 0;
+void monitor_cb(const char* name, NDArrayHandle arr, void* handle) {
+  (void)name;
+  (void)arr;
+  (void)handle;
+  ++g_monitor_calls;
+}
+
+}  // namespace
+
+int main() {
+  // ---- NDArray extras ------------------------------------------------
+  NDArrayHandle a = make_filled({4, 3}, 2.0f);
+  EXPECT(a != nullptr);
+  int stype = -1;
+  CHECK_OK(MXNDArrayGetStorageType(a, &stype));
+  EXPECT(stype == 1);
+
+  NDArrayHandle sl = nullptr;
+  CHECK_OK(MXNDArraySlice(a, 1, 3, &sl));
+  mx_uint ndim = 0;
+  const mx_uint* dims = nullptr;
+  CHECK_OK(MXNDArrayGetShape(sl, &ndim, &dims));
+  EXPECT(ndim == 2 && dims[0] == 2 && dims[1] == 3);
+
+  NDArrayHandle row = nullptr;
+  CHECK_OK(MXNDArrayAt(a, 0, &row));
+  CHECK_OK(MXNDArrayGetShape(row, &ndim, &dims));
+  EXPECT(ndim == 1 && dims[0] == 3);
+
+  int rdims[2] = {3, 4};
+  NDArrayHandle rs = nullptr;
+  CHECK_OK(MXNDArrayReshape(a, 2, rdims, &rs));
+  CHECK_OK(MXNDArrayGetShape(rs, &ndim, &dims));
+  EXPECT(dims[0] == 3 && dims[1] == 4);
+
+  // raw-byte serde round trip
+  size_t raw_size = 0;
+  const char* raw = nullptr;
+  CHECK_OK(MXNDArraySaveRawBytes(a, &raw_size, &raw));
+  EXPECT(raw_size > 0);
+  NDArrayHandle a2 = nullptr;
+  CHECK_OK(MXNDArrayLoadFromRawBytes(raw, raw_size, &a2));
+  std::vector<float> va, va2;
+  EXPECT(read_floats(a, &va) == 0 && read_floats(a2, &va2) == 0);
+  EXPECT(va == va2);
+
+  // DLPack round trip
+  DLManagedTensorHandle dl = nullptr;
+  CHECK_OK(MXNDArrayToDLPack(a, &dl));
+  NDArrayHandle a3 = nullptr;
+  CHECK_OK(MXNDArrayFromDLPack(dl, &a3));
+  std::vector<float> va3;
+  EXPECT(read_floats(a3, &va3) == 0);
+  EXPECT(va3 == va);
+
+  // InvokeEx surfaces storage types
+  {
+    NDArrayHandle ins[1] = {a};
+    int nout = 0;
+    NDArrayHandle* outs = nullptr;
+    const int* stypes = nullptr;
+    CHECK_OK(MXImperativeInvokeEx("relu", 1, ins, &nout, &outs, 0,
+                                  nullptr, nullptr, &stypes));
+    EXPECT(nout == 1 && stypes[0] == 1);
+    CHECK_OK(MXNDArrayFree(outs[0]));
+  }
+
+  // ---- CachedOp inference (reference cpp-package idiom) --------------
+  SymbolHandle x = nullptr;
+  CHECK_OK(MXSymbolCreateVariable("x", &x));
+  SymbolHandle relu_op = nullptr;
+  CHECK_OK(MXSymbolCreateAtomicSymbol("relu", 0, nullptr, nullptr,
+                                      &relu_op));
+  SymbolHandle args1[1] = {x};
+  CHECK_OK(MXSymbolCompose(relu_op, "act", 1, nullptr, args1));
+
+  CachedOpHandle cop = nullptr;
+  CHECK_OK(MXCreateCachedOp(relu_op, &cop));
+  {
+    NDArrayHandle neg = make_filled({2, 2}, -1.5f);
+    EXPECT(neg != nullptr);
+    for (int rep = 0; rep < 2; ++rep) {  // second call = cache hit
+      NDArrayHandle ins[1] = {neg};
+      int nout = 0;
+      NDArrayHandle* outs = nullptr;
+      const int* stypes = nullptr;
+      CHECK_OK(MXInvokeCachedOpEx(cop, 1, ins, &nout, &outs, &stypes));
+      EXPECT(nout == 1 && stypes[0] == 1);
+      std::vector<float> vo;
+      EXPECT(read_floats(outs[0], &vo) == 0);
+      for (float f : vo) EXPECT(f == 0.0f);
+      CHECK_OK(MXNDArrayFree(outs[0]));
+    }
+    CHECK_OK(MXNDArrayFree(neg));
+  }
+  CHECK_OK(MXFreeCachedOp(cop));
+
+  // ---- updater-driven KVStore (reference custom-updater idiom) ------
+  KVStoreHandle kv = nullptr;
+  CHECK_OK(MXKVStoreCreate("local", &kv));
+  const char* kv_type = nullptr;
+  CHECK_OK(MXKVStoreGetType(kv, &kv_type));
+  EXPECT(std::string(kv_type) == "local");
+  CHECK_OK(MXKVStoreSetUpdater(kv, sgd_updater, nullptr));
+
+  {
+    int key = 9;
+    NDArrayHandle w0 = make_filled({4}, 1.0f);
+    CHECK_OK(MXKVStoreInit(kv, 1, &key, &w0));
+    NDArrayHandle g = make_filled({4}, 1.0f);
+    CHECK_OK(MXKVStorePush(kv, 1, &key, &g, 0));
+    NDArrayHandle got = make_filled({4}, 0.0f);
+    CHECK_OK(MXKVStorePull(kv, 1, &key, &got, 0));
+    std::vector<float> vw;
+    EXPECT(read_floats(got, &vw) == 0);
+    for (float f : vw) EXPECT(std::fabs(f - 0.5f) < 1e-6f);  // 1 - 0.5*1
+    EXPECT(g_updater_calls == 1);
+    CHECK_OK(MXNDArrayFree(w0));
+    CHECK_OK(MXNDArrayFree(g));
+    CHECK_OK(MXNDArrayFree(got));
+  }
+
+  // string keys
+  {
+    const char* skey = "emb_weight";
+    NDArrayHandle w0 = make_filled({3}, 2.0f);
+    CHECK_OK(MXKVStoreInitEx(kv, 1, &skey, &w0));
+    NDArrayHandle got = make_filled({3}, 0.0f);
+    CHECK_OK(MXKVStorePullEx(kv, 1, &skey, &got, 0));
+    std::vector<float> vw;
+    EXPECT(read_floats(got, &vw) == 0);
+    for (float f : vw) EXPECT(f == 2.0f);
+    CHECK_OK(MXNDArrayFree(w0));
+    CHECK_OK(MXNDArrayFree(got));
+  }
+
+  CHECK_OK(MXKVStoreBarrier(kv));
+  int is_worker = -1;
+  CHECK_OK(MXKVStoreIsWorkerNode(&is_worker));
+  EXPECT(is_worker == 1);
+
+  // row-sparse pull
+  {
+    int key = 21;
+    NDArrayHandle table = nullptr;
+    mx_uint tshape[2] = {6, 2};
+    CHECK_OK(MXNDArrayCreate(tshape, 2, 1, 0, 0, &table));
+    std::vector<float> tv(12);
+    for (int i = 0; i < 12; ++i) tv[i] = (float)i;
+    CHECK_OK(MXNDArraySyncCopyFromCPU(table, tv.data(), 12));
+    CHECK_OK(MXKVStoreInit(kv, 1, &key, &table));
+    NDArrayHandle dst = make_filled({6, 2}, 0.0f);
+    NDArrayHandle rows = nullptr;
+    mx_uint rshape[1] = {2};
+    // int32 row ids: int64 (code 6) needs MXNET_INT64_TENSOR_SIZE=1,
+    // and MXNDArrayCreate fails loudly rather than truncating silently
+    CHECK_OK(MXNDArrayCreate(rshape, 1, 1, 0, 4 /*int32*/, &rows));
+    int32_t ridx[2] = {1, 4};
+    CHECK_OK(MXNDArraySyncCopyFromCPU(rows, ridx, 2));
+    NDArrayHandle rlist[1] = {rows};
+    CHECK_OK(MXKVStorePullRowSparse(kv, 1, &key, &dst, rlist, 0));
+    std::vector<float> vd;
+    EXPECT(read_floats(dst, &vd) == 0);
+    EXPECT(vd[2] == 2.0f && vd[3] == 3.0f);   // row 1
+    EXPECT(vd[8] == 8.0f && vd[9] == 9.0f);   // row 4
+    EXPECT(vd[0] == 0.0f);                    // untouched row zeroed
+    CHECK_OK(MXNDArrayFree(table));
+    CHECK_OK(MXNDArrayFree(dst));
+    CHECK_OK(MXNDArrayFree(rows));
+  }
+  CHECK_OK(MXKVStoreFree(kv));
+
+  // ---- RecordIO ------------------------------------------------------
+  {
+    const char* path = "abi_extras_test.rec";
+    RecordIOHandle w = nullptr;
+    CHECK_OK(MXRecordIOWriterCreate(path, &w));
+    CHECK_OK(MXRecordIOWriterWriteRecord(w, "hello", 5));
+    CHECK_OK(MXRecordIOWriterWriteRecord(w, "worlds", 6));
+    size_t pos = 0;
+    CHECK_OK(MXRecordIOWriterTell(w, &pos));
+    EXPECT(pos > 0);
+    CHECK_OK(MXRecordIOWriterFree(w));
+
+    RecordIOHandle r = nullptr;
+    CHECK_OK(MXRecordIOReaderCreate(path, &r));
+    const char* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &size));
+    EXPECT(size == 5 && std::memcmp(buf, "hello", 5) == 0);
+    CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &size));
+    EXPECT(size == 6 && std::memcmp(buf, "worlds", 6) == 0);
+    CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &size));
+    EXPECT(buf == nullptr && size == 0);  // EOF
+    CHECK_OK(MXRecordIOReaderFree(r));
+    std::remove(path);
+  }
+
+  // ---- Symbol extras -------------------------------------------------
+  {
+    CHECK_OK(MXSymbolSetAttr(x, "__lr_mult__", "2.5"));
+    const char* av = nullptr;
+    int ok = 0;
+    CHECK_OK(MXSymbolGetAttr(x, "__lr_mult__", &av, &ok));
+    EXPECT(ok == 1 && std::string(av) == "2.5");
+
+    mx_uint nout = 0;
+    CHECK_OK(MXSymbolGetNumOutputs(relu_op, &nout));
+    EXPECT(nout == 1);
+
+    SymbolHandle cp = nullptr;
+    CHECK_OK(MXSymbolCopy(relu_op, &cp));
+    const char* j1 = nullptr;
+    CHECK_OK(MXSymbolSaveToJSON(cp, &j1));
+    std::string json1(j1);
+    const char* j2 = nullptr;
+    CHECK_OK(MXSymbolSaveToJSON(relu_op, &j2));
+    EXPECT(json1 == std::string(j2));
+    CHECK_OK(MXSymbolFree(cp));
+
+    // type inference: fp32 in -> fp32 out
+    const char* tkeys[1] = {"x"};
+    int tcodes[1] = {0};
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const int *in_t = nullptr, *out_t = nullptr, *aux_t = nullptr;
+    int complete = 0;
+    CHECK_OK(MXSymbolInferType(relu_op, 1, tkeys, tcodes, &in_n, &in_t,
+                               &out_n, &out_t, &aux_n, &aux_t,
+                               &complete));
+    EXPECT(complete == 1 && out_n == 1 && out_t[0] == 0);
+
+    // file round trip
+    CHECK_OK(MXSymbolSaveToFile(relu_op, "abi_extras_sym.json"));
+    SymbolHandle loaded = nullptr;
+    CHECK_OK(MXSymbolCreateFromFile("abi_extras_sym.json", &loaded));
+    mx_uint n2 = 0;
+    CHECK_OK(MXSymbolGetNumOutputs(loaded, &n2));
+    EXPECT(n2 == 1);
+    CHECK_OK(MXSymbolFree(loaded));
+    std::remove("abi_extras_sym.json");
+
+    // op introspection (frontend-codegen surface)
+    mx_uint n_ops = 0;
+    AtomicSymbolCreator* creators = nullptr;
+    CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_ops, &creators));
+    EXPECT(n_ops > 250);
+    bool found_conv = false;
+    for (mx_uint i = 0; i < n_ops; ++i) {
+      const char* nm = nullptr;
+      CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &nm));
+      if (std::string(nm) == "Convolution") {
+        const char *name = nullptr, *desc = nullptr, *kv = nullptr,
+                   *rt = nullptr;
+        mx_uint nargs = 0;
+        const char **anames = nullptr, **atypes = nullptr,
+                   **adescs = nullptr;
+        CHECK_OK(MXSymbolGetAtomicSymbolInfo(
+            creators[i], &name, &desc, &nargs, &anames, &atypes, &adescs,
+            &kv, &rt));
+        EXPECT(nargs > 0);
+        bool has_kernel = false;
+        for (mx_uint k = 0; k < nargs; ++k)
+          if (std::string(anames[k]) == "kernel") has_kernel = true;
+        EXPECT(has_kernel);
+        found_conv = true;
+        break;
+      }
+    }
+    EXPECT(found_conv);
+  }
+
+  // ---- Executor monitor callback ------------------------------------
+  {
+    // y = relu(w); bind and watch intermediates
+    SymbolHandle w = nullptr;
+    CHECK_OK(MXSymbolCreateVariable("w", &w));
+    SymbolHandle net = nullptr;
+    CHECK_OK(MXSymbolCreateAtomicSymbol("relu", 0, nullptr, nullptr,
+                                        &net));
+    SymbolHandle cargs[1] = {w};
+    CHECK_OK(MXSymbolCompose(net, "mon", 1, nullptr, cargs));
+    NDArrayHandle warr = make_filled({2, 2}, -1.0f);
+    NDArrayHandle grads[1] = {nullptr};
+    mx_uint reqs[1] = {0};
+    ExecutorHandle ex = nullptr;
+    CHECK_OK(MXExecutorBind(net, 1, 0, 1, &warr, grads, reqs, 0, nullptr,
+                            &ex));
+    CHECK_OK(MXExecutorSetMonitorCallback(ex, monitor_cb, nullptr));
+    CHECK_OK(MXExecutorForward(ex, 0));
+    EXPECT(g_monitor_calls > 0);
+    CHECK_OK(MXExecutorFree(ex));
+    CHECK_OK(MXNDArrayFree(warr));
+    CHECK_OK(MXSymbolFree(net));
+    CHECK_OK(MXSymbolFree(w));
+  }
+
+  // ---- Profiler ------------------------------------------------------
+  {
+    const char* pkeys[1] = {"filename"};
+    const char* pvals[1] = {"abi_extras_profile.json"};
+    CHECK_OK(MXSetProfilerConfig(1, pkeys, pvals));
+    CHECK_OK(MXSetProfilerState(1));
+    NDArrayHandle t1 = make_filled({8}, 1.0f);
+    NDArrayHandle ins[1] = {t1};
+    int nout = 0;
+    NDArrayHandle* outs = nullptr;
+    CHECK_OK(MXImperativeInvoke("relu", 1, ins, &nout, &outs, 0, nullptr,
+                                nullptr));
+    CHECK_OK(MXNDArrayFree(outs[0]));
+    CHECK_OK(MXNDArrayFree(t1));
+    CHECK_OK(MXSetProfilerState(0));
+    const char* stats = nullptr;
+    CHECK_OK(MXAggregateProfileStatsPrint(&stats, 0));
+    EXPECT(stats != nullptr);
+    CHECK_OK(MXDumpProfile(1));
+    std::remove("abi_extras_profile.json");
+  }
+
+  // ---- Autograd extras ----------------------------------------------
+  {
+    unsigned char rec = 9;
+    CHECK_OK(MXAutogradIsRecording(&rec));
+    EXPECT(rec == 0);
+    NDArrayHandle v = make_filled({3}, 1.0f);
+    NDArrayHandle vgrad = make_filled({3}, 0.0f);
+    NDArrayHandle vars[1] = {v};
+    NDArrayHandle gbufs[1] = {vgrad};
+    CHECK_OK(MXAutogradMarkVariables(1, vars, gbufs));
+    int prev = 0;
+    CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+    NDArrayHandle ins[1] = {v};
+    int nout = 0;
+    NDArrayHandle* outs = nullptr;
+    const char* keys[] = {"scalar"};
+    const char* vals[] = {"3.0"};
+    CHECK_OK(MXImperativeInvoke("_mul_scalar", 1, ins, &nout, &outs, 1,
+                                keys, vals));
+    NDArrayHandle y = outs[0];
+    CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+    NDArrayHandle* grad_out = nullptr;
+    const int* gstypes = nullptr;
+    NDArrayHandle heads[1] = {y};
+    CHECK_OK(MXAutogradBackwardEx(1, heads, nullptr, 1, vars, 0, 0, 1,
+                                  &grad_out, &gstypes));
+    std::vector<float> gv;
+    EXPECT(read_floats(grad_out[0], &gv) == 0);
+    for (float f : gv) EXPECT(std::fabs(f - 3.0f) < 1e-6f);
+    EXPECT(gstypes[0] == 1);
+    CHECK_OK(MXNDArrayFree(grad_out[0]));
+    CHECK_OK(MXNDArrayFree(y));
+    CHECK_OK(MXNDArrayFree(v));
+    CHECK_OK(MXNDArrayFree(vgrad));
+  }
+
+  // ---- Runtime misc --------------------------------------------------
+  int version = 0;
+  CHECK_OK(MXGetVersion(&version));
+  EXPECT(version >= 10000);
+  CHECK_OK(MXRandomSeed(42));
+  int ndev = -1;
+  CHECK_OK(MXGetGPUCount(&ndev));
+  EXPECT(ndev >= 0);
+
+  CHECK_OK(MXNDArrayFree(a));
+  CHECK_OK(MXNDArrayFree(a2));
+  CHECK_OK(MXNDArrayFree(a3));
+  CHECK_OK(MXNDArrayFree(sl));
+  CHECK_OK(MXNDArrayFree(row));
+  CHECK_OK(MXNDArrayFree(rs));
+  CHECK_OK(MXSymbolFree(relu_op));
+  CHECK_OK(MXSymbolFree(x));
+
+  std::printf("ABI_EXTRAS_OK\n");
+  return 0;
+}

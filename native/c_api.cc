@@ -988,3 +988,1171 @@ int MXDataIterFree(DataIterHandle handle) {
 int MXNotifyShutdown(void) { return 0; }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------
+// Round-4 planes: NDArray extras, DLPack, CachedOp, KVStore extras,
+// RecordIO, profiler, symbol extras, executor monitor, autograd extras,
+// runtime misc (reference: c_api.cc / c_api_ndarray.cc:235 /
+// c_api_symbolic.cc / c_api_profile.cc).
+// ---------------------------------------------------------------------
+
+namespace {
+
+// extra thread-local result stores for the round-4 planes
+struct ExtTLS {
+  std::vector<int> stypes;
+  std::vector<NDArrayHandle> cached_out;
+  std::vector<NDArrayHandle> grad_out;
+  std::vector<int> grad_stypes;
+  std::string raw_bytes;
+  std::string record_buf;
+  std::string agg_stats;
+  std::string attr_value;
+  std::string kv_type;
+  // op-introspection backing (MXSymbolGetAtomicSymbolInfo)
+  std::vector<std::string> op_doc_store;
+  std::vector<const char*> op_doc_ptrs[3];
+  std::string op_name, op_desc;
+  std::vector<void*> creators;
+};
+ExtTLS* ext_tls() {
+  thread_local ExtTLS t;
+  return &t;
+}
+
+// take a bridge-returned ([outputs...], [stypes...]) pair into TLS
+int unpack_outs_stypes(PyObject* r, std::vector<NDArrayHandle>* out_store,
+                       int* num_outputs, NDArrayHandle** outputs,
+                       const int** out_stypes) {
+  PyObject* outs = PyTuple_GET_ITEM(r, 0);
+  PyObject* sts = PyTuple_GET_ITEM(r, 1);
+  out_store->clear();
+  ExtTLS* e = ext_tls();
+  e->stypes.clear();
+  Py_ssize_t n = PyList_Size(outs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GET_ITEM(outs, i);
+    Py_INCREF(a);
+    out_store->push_back(wrap(a));
+    e->stypes.push_back(
+        static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(sts, i))));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(out_store->size());
+  *outputs = out_store->data();
+  if (out_stypes) *out_stypes = e->stypes.data();
+  return 0;
+}
+
+// minimal DLPack 0.x ABI structs (standard layout)
+struct DLDevice_ {
+  int32_t device_type;
+  int32_t device_id;
+};
+struct DLDataType_ {
+  uint8_t code;
+  uint8_t bits;
+  uint16_t lanes;
+};
+struct DLTensor_ {
+  void* data;
+  DLDevice_ device;
+  int32_t ndim;
+  DLDataType_ dtype;
+  int64_t* shape;
+  int64_t* strides;
+  uint64_t byte_offset;
+};
+struct DLManagedTensor_ {
+  DLTensor_ dl_tensor;
+  void* manager_ctx;
+  void (*deleter)(DLManagedTensor_*);
+};
+
+// C-callback trampolines: a PyCFunction whose self is a capsule holding
+// the user's function pointer + closure handle
+struct UpdaterCtx {
+  MXKVStoreUpdater fn = nullptr;
+  MXKVStoreStrUpdater str_fn = nullptr;
+  void* handle = nullptr;
+};
+
+PyObject* updater_trampoline(PyObject* self, PyObject* args) {
+  auto* ctx = static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.updater"));
+  PyObject *key, *recv, *local;
+  if (!ctx || !PyArg_ParseTuple(args, "OOO", &key, &recv, &local))
+    return nullptr;
+  // handles are owned by this call; the user callback must not free them
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  NDArrayObj* r = wrap(recv);
+  NDArrayObj* l = wrap(local);
+  if (PyLong_Check(key)) {
+    int k = static_cast<int>(PyLong_AsLong(key));
+    if (ctx->fn) ctx->fn(k, r, l, ctx->handle);
+  } else {
+    const char* k = utf8_or_null(key);
+    if (ctx->str_fn && k) {
+      ctx->str_fn(k, r, l, ctx->handle);
+    } else if (ctx->fn && k) {
+      // integer-updater fallback for the "hostrow:..."-style keys
+      ctx->fn(static_cast<int>(std::hash<std::string>()(k) & 0x7fffffff),
+              r, l, ctx->handle);
+    }
+  }
+  MXNDArrayFree(r);
+  MXNDArrayFree(l);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef updater_def = {"mxtpu_updater", updater_trampoline,
+                           METH_VARARGS, nullptr};
+
+struct MonitorCtx {
+  ExecutorMonitorCallback fn = nullptr;
+  void* handle = nullptr;
+};
+
+PyObject* monitor_trampoline(PyObject* self, PyObject* args) {
+  auto* ctx = static_cast<MonitorCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.monitor"));
+  PyObject *name, *arr;
+  if (!ctx || !PyArg_ParseTuple(args, "OO", &name, &arr)) return nullptr;
+  const char* n = utf8_or_null(name);
+  if (n && ctx->fn) {
+    Py_INCREF(arr);
+    NDArrayObj* a = wrap(arr);
+    ctx->fn(n, a, ctx->handle);
+    MXNDArrayFree(a);
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef monitor_def = {"mxtpu_monitor", monitor_trampoline,
+                           METH_VARARGS, nullptr};
+
+void capsule_free_updater(PyObject* cap) {
+  delete static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(cap, "mxtpu.updater"));
+}
+
+void capsule_free_monitor(PyObject* cap) {
+  delete static_cast<MonitorCtx*>(
+      PyCapsule_GetPointer(cap, "mxtpu.monitor"));
+}
+
+// fresh NDArray handle from a bridge call returning one array
+int return_one_array(PyObject* r, const char* what, NDArrayHandle* out) {
+  if (!r) return fail_py(what);
+  *out = wrap(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- NDArray extras ----------------------------------------------------
+
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  return return_one_array(call_bridge("create_none", PyTuple_New(0)),
+                          "create none failed", out);
+}
+
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA owns allocation
+  return MXNDArrayCreate(shape, ndim, dev_type, dev_id, dtype, out);
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge(
+      "nd_slice", Py_BuildValue("(OII)", obj->array, begin, end));
+  return return_one_array(r, "slice failed", out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r =
+      call_bridge("nd_at", Py_BuildValue("(OI)", obj->array, idx));
+  return return_one_array(r, "at failed", out);
+}
+
+static int reshape_impl(NDArrayHandle handle, int ndim,
+                        const long long* dims, NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* dl = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(dl, i, PyLong_FromLongLong(dims[i]));
+  PyObject* r =
+      call_bridge("nd_reshape", Py_BuildValue("(OO)", obj->array, dl));
+  Py_DECREF(dl);
+  return return_one_array(r, "reshape failed", out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int* dims,
+                     NDArrayHandle* out) {
+  std::vector<long long> d(dims, dims + ndim);
+  return reshape_impl(handle, ndim, d.data(), out);
+}
+
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim,
+                       const long long* dims, NDArrayHandle* out) {
+  return reshape_impl(handle, ndim, dims, out);
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("storage_type_code",
+                            Py_BuildValue("(O)", obj->array));
+  if (!r) return fail_py("storage type failed");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r =
+      call_bridge("nd_detach", Py_BuildValue("(O)", obj->array));
+  return return_one_array(r, "detach failed", out);
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("nd_set_grad_state",
+                            Py_BuildValue("(Oi)", obj->array, state));
+  if (!r) return fail_py("set grad state failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("nd_get_grad_state",
+                            Py_BuildValue("(O)", obj->array));
+  if (!r) return fail_py("get grad state failed");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("nd_save_raw_bytes",
+                            Py_BuildValue("(O)", obj->array));
+  if (!r) return fail_py("save raw bytes failed");
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return fail_py("raw bytes not bytes");
+  }
+  ExtTLS* e = ext_tls();
+  e->raw_bytes.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *out_size = e->raw_bytes.size();
+  *out_buf = e->raw_bytes.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* bytes =
+      PyBytes_FromStringAndSize(static_cast<const char*>(buf), size);
+  PyObject* r = call_bridge("nd_load_from_raw_bytes",
+                            Py_BuildValue("(N)", bytes));
+  return return_one_array(r, "load raw bytes failed", out);
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r =
+      call_bridge("nd_data_ndarray", Py_BuildValue("(O)", obj->array));
+  return return_one_array(r, "data ndarray failed", out);
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("nd_aux_ndarray",
+                            Py_BuildValue("(OI)", obj->array, i));
+  return return_one_array(r, "aux ndarray failed", out);
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int* out_type) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge("nd_aux_type_code",
+                            Py_BuildValue("(OI)", obj->array, i));
+  if (!r) return fail_py("aux type failed");
+  *out_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXImperativeInvokeEx(const char* op_name, int num_inputs,
+                         NDArrayHandle* inputs, int* num_outputs,
+                         NDArrayHandle** outputs, int num_params,
+                         const char** param_keys, const char** param_vals,
+                         const int** out_stypes) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(op_name));
+  PyTuple_SET_ITEM(args, 1,
+                   nd_list(static_cast<mx_uint>(num_inputs), inputs));
+  PyTuple_SET_ITEM(args, 2, str_list(num_params, param_keys));
+  PyTuple_SET_ITEM(args, 3, str_list(num_params, param_vals));
+  PyObject* r = call_bridge("invoke_ex", args);
+  if (!r) return fail_py("invoke failed");
+  return unpack_outs_stypes(r, &tls()->invoke_out, num_outputs, outputs,
+                            out_stypes);
+}
+
+// -- DLPack ------------------------------------------------------------
+
+int MXNDArrayToDLPack(NDArrayHandle handle, DLManagedTensorHandle* out) {
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* np = call_bridge("to_numpy_retained",
+                             Py_BuildValue("(O)", obj->array));
+  if (!np) return fail_py("to numpy failed");
+  PyObject* cap = PyObject_CallMethod(np, "__dlpack__", nullptr);
+  Py_DECREF(np);  // the capsule's manager_ctx keeps the buffer alive
+  if (!cap) return fail_py("__dlpack__ failed");
+  void* dl = PyCapsule_GetPointer(cap, "dltensor");
+  if (!dl) {
+    Py_DECREF(cap);
+    return fail_py("not a dltensor capsule");
+  }
+  // consume the capsule (standard protocol): ownership moves to caller
+  PyCapsule_SetName(cap, "used_dltensor");
+  PyCapsule_SetDestructor(cap, nullptr);
+  Py_DECREF(cap);
+  *out = dl;
+  return 0;
+}
+
+int MXNDArrayFromDLPack(DLManagedTensorHandle dlpack, NDArrayHandle* out) {
+  return MXNDArrayFromDLPackEx(dlpack, 0, out);
+}
+
+int MXNDArrayFromDLPackEx(DLManagedTensorHandle dlpack,
+                          const int transient_handle, NDArrayHandle* out) {
+  (void)transient_handle;
+  ensure_python();
+  Gil gil;
+  PyObject* cap = PyCapsule_New(dlpack, "dltensor", nullptr);
+  if (!cap) return fail_py("capsule failed");
+  PyObject* r =
+      call_bridge("from_dlpack_capsule", Py_BuildValue("(N)", cap));
+  return return_one_array(r, "from dlpack failed", out);
+}
+
+int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlpack) {
+  if (!dlpack) return 0;
+  auto* dl = static_cast<DLManagedTensor_*>(dlpack);
+  if (dl->deleter) dl->deleter(dl);
+  return 0;
+}
+
+// -- CachedOp ----------------------------------------------------------
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  return MXCreateCachedOpEx(sym, 0, nullptr, nullptr, out);
+}
+
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char** keys,
+                       const char** vals, CachedOpHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num_flags, keys));
+  PyTuple_SET_ITEM(args, 2, str_list(num_flags, vals));
+  PyObject* r = call_bridge("cached_op_create", args);
+  if (!r) return fail_py("cached op create failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs) {
+  return MXInvokeCachedOpEx(handle, num_inputs, inputs, num_outputs,
+                            outputs, nullptr);
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1,
+                   nd_list(static_cast<mx_uint>(num_inputs), inputs));
+  PyObject* r = call_bridge("cached_op_invoke", args);
+  if (!r) return fail_py("cached op invoke failed");
+  return unpack_outs_stypes(r, &ext_tls()->cached_out, num_outputs,
+                            outputs, out_stypes);
+}
+
+// -- KVStore extras ----------------------------------------------------
+
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void* updater_handle) {
+  return MXKVStoreSetUpdaterEx(kv, updater, nullptr, updater_handle);
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  auto* ctx = new UpdaterCtx{updater, str_updater, updater_handle};
+  PyObject* cap =
+      PyCapsule_New(ctx, "mxtpu.updater", capsule_free_updater);
+  PyObject* cb = PyCFunction_New(&updater_def, cap);
+  Py_DECREF(cap);  // cb owns it now
+  if (!cb) {
+    return fail_py("updater trampoline failed");
+  }
+  PyObject* r = call_bridge("kv_set_updater",
+                            Py_BuildValue("(ON)", h->obj, cb));
+  if (!r) return fail_py("set updater failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_barrier", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("barrier failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+static int kv_str_call(const char* fn, KVStoreHandle kv, mx_uint num,
+                       const char** keys, NDArrayHandle* vals,
+                       int priority, int with_priority) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* args = PyTuple_New(with_priority ? 4 : 3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num, keys));
+  PyTuple_SET_ITEM(args, 2, nd_list(num, vals));
+  if (with_priority)
+    PyTuple_SET_ITEM(args, 3, PyLong_FromLong(priority));
+  PyObject* r = call_bridge(fn, args);
+  if (!r) return fail_py("kvstore string-key call failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* vals) {
+  return kv_str_call("kv_init_str", kv, num, keys, vals, 0, 0);
+}
+
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_str_call("kv_push_str", kv, num, keys, vals, priority, 1);
+}
+
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_str_call("kv_pull_str", kv, num, keys, vals, priority, 1);
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num, const int* keys,
+                           NDArrayHandle* vals,
+                           const NDArrayHandle* row_ids, int priority) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* key_list = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SET_ITEM(key_list, i, PyLong_FromLong(keys[i]));
+  PyObject* args = PyTuple_New(5);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, key_list);
+  PyTuple_SET_ITEM(args, 2, nd_list(num, vals));
+  PyTuple_SET_ITEM(
+      args, 3,
+      nd_list(num, const_cast<NDArrayHandle*>(row_ids)));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(priority));
+  PyObject* r = call_bridge("kv_pull_row_sparse", args);
+  if (!r) return fail_py("pull row sparse failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+static int role_predicate(const char* fn, int* ret) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge(fn, PyTuple_New(0));
+  if (!r) return fail_py("role predicate failed");
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int* ret) {
+  return role_predicate("kv_is_worker_node", ret);
+}
+
+int MXKVStoreIsServerNode(int* ret) {
+  return role_predicate("kv_is_server_node", ret);
+}
+
+int MXKVStoreIsSchedulerNode(int* ret) {
+  return role_predicate("kv_is_scheduler_node", ret);
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                   const char* cmd_body) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge(
+      "kv_send_command_to_servers",
+      Py_BuildValue("(Ois)", h->obj, cmd_id, cmd_body));
+  if (!r) return fail_py("send command failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle kv, const char** type) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_type", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("kv type failed");
+  const char* s = utf8_or_null(r);
+  if (!s) {
+    Py_DECREF(r);
+    return fail("non-UTF8 kv type");
+  }
+  ExtTLS* e = ext_tls();
+  e->kv_type = s;
+  Py_DECREF(r);
+  *type = e->kv_type.c_str();
+  return 0;
+}
+
+// -- RecordIO ----------------------------------------------------------
+
+static int recordio_create(const char* bridge_name, const char* uri,
+                           RecordIOHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge(bridge_name, Py_BuildValue("(s)", uri));
+  if (!r) return fail_py("recordio create failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+static int recordio_free(RecordIOHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("recordio_close", Py_BuildValue("(O)", h->obj));
+  Py_XDECREF(r);
+  Py_XDECREF(h->obj);
+  delete h;
+  return r ? 0 : fail_py("recordio close failed");
+}
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  return recordio_create("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* bytes = PyBytes_FromStringAndSize(buf, size);
+  PyObject* r = call_bridge("recordio_write_record",
+                            Py_BuildValue("(ON)", h->obj, bytes));
+  if (!r) return fail_py("write record failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r =
+      call_bridge("recordio_writer_tell", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("writer tell failed");
+  *pos = PyLong_AsSize_t(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  return recordio_create("recordio_reader_create", uri, out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char** buf,
+                               size_t* size) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r =
+      call_bridge("recordio_read_record", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("read record failed");
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char* data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    Py_DECREF(r);
+    return fail_py("record not bytes");
+  }
+  ExtTLS* e = ext_tls();
+  e->record_buf.assign(data, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *buf = e->record_buf.data();
+  *size = e->record_buf.size();
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("recordio_reader_seek",
+                            Py_BuildValue("(On)", h->obj,
+                                          static_cast<Py_ssize_t>(pos)));
+  if (!r) return fail_py("seek failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t* pos) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r =
+      call_bridge("recordio_reader_tell", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("reader tell failed");
+  *pos = PyLong_AsSize_t(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- Profiler ----------------------------------------------------------
+
+int MXSetProcessProfilerConfig(int num_params, const char* const* keys,
+                               const char* const* vals,
+                               KVStoreHandle kv_handle) {
+  (void)kv_handle;
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0,
+                   str_list(num_params, const_cast<const char**>(keys)));
+  PyTuple_SET_ITEM(args, 1,
+                   str_list(num_params, const_cast<const char**>(vals)));
+  PyObject* r = call_bridge("profiler_set_config", args);
+  if (!r) return fail_py("profiler config failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerConfig(int num_params, const char* const* keys,
+                        const char* const* vals) {
+  return MXSetProcessProfilerConfig(num_params, keys, vals, nullptr);
+}
+
+int MXSetProcessProfilerState(int state, int profile_process,
+                              KVStoreHandle kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  ensure_python();
+  Gil gil;
+  PyObject* r =
+      call_bridge("profiler_set_state", Py_BuildValue("(i)", state));
+  if (!r) return fail_py("profiler state failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  return MXSetProcessProfilerState(state, 0, nullptr);
+}
+
+int MXDumpProcessProfile(int finished, int profile_process,
+                         KVStoreHandle kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  ensure_python();
+  Gil gil;
+  PyObject* r =
+      call_bridge("profiler_dump", Py_BuildValue("(i)", finished));
+  if (!r) return fail_py("profiler dump failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProfile(int finished) {
+  return MXDumpProcessProfile(finished, 0, nullptr);
+}
+
+int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("profiler_aggregate_stats",
+                            Py_BuildValue("(i)", reset));
+  if (!r) return fail_py("profiler stats failed");
+  const char* s = utf8_or_null(r);
+  ExtTLS* e = ext_tls();
+  e->agg_stats = s ? s : "";
+  Py_DECREF(r);
+  *out_str = e->agg_stats.c_str();
+  return 0;
+}
+
+int MXProcessProfilePause(int paused, int profile_process,
+                          KVStoreHandle kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  ensure_python();
+  Gil gil;
+  PyObject* r =
+      call_bridge("profiler_pause", Py_BuildValue("(i)", paused));
+  if (!r) return fail_py("profiler pause failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXProfilePause(int paused) {
+  return MXProcessProfilePause(paused, 0, nullptr);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Symbol extras, executor monitor, autograd extras, runtime misc.
+// ---------------------------------------------------------------------
+
+extern "C" {
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                      const char** keys, const int* arg_type_data,
+                      mx_uint* in_type_size, const int** in_type_data,
+                      mx_uint* out_type_size, const int** out_type_data,
+                      mx_uint* aux_type_size, const int** aux_type_data,
+                      int* complete) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* codes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(codes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(keys ? num_args : 0, keys));
+  PyTuple_SET_ITEM(args, 2, codes);
+  PyObject* r = call_bridge("symbol_infer_type", args);
+  if (!r) return fail_py("infer type failed");
+  // (arg_codes, out_codes, aux_codes, complete)
+  static thread_local std::vector<int> stores[3];
+  const int* outs[3];
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GET_ITEM(r, g);
+    stores[g].clear();
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      stores[g].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+    outs[g] = stores[g].data();
+  }
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  Py_DECREF(r);
+  *in_type_size = static_cast<mx_uint>(stores[0].size());
+  *in_type_data = outs[0];
+  *out_type_size = static_cast<mx_uint>(stores[1].size());
+  *out_type_data = outs[1];
+  *aux_type_size = static_cast<mx_uint>(stores[2].size());
+  *aux_type_data = outs[2];
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_get_attr",
+                            Py_BuildValue("(Os)", h->obj, key));
+  if (!r) return fail_py("get attr failed");
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    *success = 0;
+    return 0;
+  }
+  const char* s = utf8_or_null(r);
+  if (!s) {
+    Py_DECREF(r);
+    return fail("non-UTF8 attr value");
+  }
+  ExtTLS* e = ext_tls();
+  e->attr_value = s;
+  Py_DECREF(r);
+  *out = e->attr_value.c_str();
+  *success = 1;
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_set_attr",
+                            Py_BuildValue("(Oss)", h->obj, key, value));
+  if (!r) return fail_py("set attr failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle sym, mx_uint* out_size,
+                     const char*** out) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r =
+      call_bridge("symbol_list_attr", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("list attr failed");
+  return return_str_list(r, out_size, out);
+}
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_copy", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("copy failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r =
+      call_bridge("symbol_get_internals", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("get internals failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle* out) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_get_output",
+                            Py_BuildValue("(OI)", h->obj, index));
+  if (!r) return fail_py("get output failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle sym, mx_uint* out) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r =
+      call_bridge("symbol_num_outputs", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("num outputs failed");
+  *out = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_save_file",
+                            Py_BuildValue("(Os)", h->obj, fname));
+  if (!r) return fail_py("save to file failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r =
+      call_bridge("symbol_load_file", Py_BuildValue("(s)", fname));
+  if (!r) return fail_py("load from file failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("op_names_sorted", PyTuple_New(0));
+  if (!r) return fail_py("op list failed");
+  ExtTLS* e = ext_tls();
+  // a creator is 1 + the op's index in the sorted name list (0 would be
+  // indistinguishable from NULL)
+  Py_ssize_t n = PyList_Size(r);
+  Py_DECREF(r);
+  e->creators.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    e->creators.push_back(reinterpret_cast<void*>(i + 1));
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = e->creators.data();
+  return 0;
+}
+
+static PyObject* creator_name(AtomicSymbolCreator creator) {
+  // re-derive the name from the sorted list; stable across calls since
+  // the registry is append-only and the list is sorted
+  PyObject* r = call_bridge("op_names_sorted", PyTuple_New(0));
+  if (!r) return nullptr;
+  Py_ssize_t idx = reinterpret_cast<Py_ssize_t>(creator) - 1;
+  if (idx < 0 || idx >= PyList_Size(r)) {
+    Py_DECREF(r);
+    PyErr_SetString(PyExc_IndexError, "bad AtomicSymbolCreator");
+    return nullptr;
+  }
+  PyObject* name = PyList_GET_ITEM(r, idx);
+  Py_INCREF(name);
+  Py_DECREF(r);
+  return name;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  ensure_python();
+  Gil gil;
+  PyObject* n = creator_name(creator);
+  if (!n) return fail_py("creator name failed");
+  const char* s = utf8_or_null(n);
+  if (!s) {
+    Py_DECREF(n);
+    return fail("non-UTF8 op name");
+  }
+  ExtTLS* e = ext_tls();
+  e->op_name = s;
+  Py_DECREF(n);
+  *name = e->op_name.c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                mx_uint* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type) {
+  ensure_python();
+  Gil gil;
+  PyObject* n = creator_name(creator);
+  if (!n) return fail_py("creator name failed");
+  PyObject* r = call_bridge("op_info", Py_BuildValue("(N)", n));
+  if (!r) return fail_py("op info failed");
+  // (name, doc, arg_names, arg_types, arg_descs, return_type)
+  ExtTLS* e = ext_tls();
+  e->op_name = safe_utf8(PyTuple_GET_ITEM(r, 0));
+  e->op_desc = safe_utf8(PyTuple_GET_ITEM(r, 1));
+  e->op_doc_store.clear();
+  for (int g = 0; g < 3; ++g) e->op_doc_ptrs[g].clear();
+  PyObject* groups[3] = {PyTuple_GET_ITEM(r, 2), PyTuple_GET_ITEM(r, 3),
+                         PyTuple_GET_ITEM(r, 4)};
+  // collect all strings first (vector growth would invalidate c_str())
+  std::vector<size_t> counts;
+  for (int g = 0; g < 3; ++g) {
+    Py_ssize_t cnt = PyList_Size(groups[g]);
+    counts.push_back(static_cast<size_t>(cnt));
+    for (Py_ssize_t i = 0; i < cnt; ++i)
+      e->op_doc_store.push_back(safe_utf8(PyList_GET_ITEM(groups[g], i)));
+  }
+  size_t off = 0;
+  for (int g = 0; g < 3; ++g) {
+    for (size_t i = 0; i < counts[g]; ++i)
+      e->op_doc_ptrs[g].push_back(e->op_doc_store[off + i].c_str());
+    off += counts[g];
+  }
+  static const char* kEmpty = "";
+  static thread_local std::string ret_type_store;
+  ret_type_store = safe_utf8(PyTuple_GET_ITEM(r, 5));
+  Py_DECREF(r);
+  *name = e->op_name.c_str();
+  *description = e->op_desc.c_str();
+  *num_args = static_cast<mx_uint>(counts[0]);
+  *arg_names = e->op_doc_ptrs[0].data();
+  *arg_type_infos = e->op_doc_ptrs[1].data();
+  *arg_descriptions = e->op_doc_ptrs[2].data();
+  if (key_var_num_args) *key_var_num_args = kEmpty;
+  if (return_type) *return_type = ret_type_store.c_str();
+  return 0;
+}
+
+// -- Executor monitor --------------------------------------------------
+
+int MXExecutorSetMonitorCallback(ExecutorHandle ex,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle) {
+  return MXExecutorSetMonitorCallbackEX(ex, callback, callback_handle, 0);
+}
+
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle ex,
+                                   ExecutorMonitorCallback callback,
+                                   void* callback_handle, int monitor_all) {
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  auto* ctx = new MonitorCtx{callback, callback_handle};
+  PyObject* cap =
+      PyCapsule_New(ctx, "mxtpu.monitor", capsule_free_monitor);
+  PyObject* cb = PyCFunction_New(&monitor_def, cap);
+  Py_DECREF(cap);
+  if (!cb) return fail_py("monitor trampoline failed");
+  PyObject* r = call_bridge(
+      "executor_set_monitor",
+      Py_BuildValue("(ONi)", h->obj, cb, monitor_all));
+  if (!r) return fail_py("set monitor failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- Autograd extras ---------------------------------------------------
+
+int MXAutogradIsRecording(unsigned char* curr) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("autograd_is_recording", PyTuple_New(0));
+  if (!r) return fail_py("is recording failed");
+  *curr = static_cast<unsigned char>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsTraining(unsigned char* curr) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("autograd_is_training", PyTuple_New(0));
+  if (!r) return fail_py("is training failed");
+  *curr = static_cast<unsigned char>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, mx_uint num_variables,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle** grad_handles,
+                         const int** grad_stypes) {
+  Gil gil;
+  PyObject* args = PyTuple_New(6);
+  PyTuple_SET_ITEM(args, 0, nd_list(num_output, output_handles));
+  if (ograd_handles) {
+    PyTuple_SET_ITEM(args, 1, nd_list(num_output, ograd_handles));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(args, 1, Py_None);
+  }
+  PyTuple_SET_ITEM(args, 2, nd_list(num_variables, var_handles));
+  PyTuple_SET_ITEM(args, 3, PyBool_FromLong(retain_graph));
+  PyTuple_SET_ITEM(args, 4, PyBool_FromLong(create_graph));
+  PyTuple_SET_ITEM(args, 5, PyBool_FromLong(is_train));
+  PyObject* r = call_bridge("autograd_backward_ex", args);
+  if (!r) return fail_py("backward failed");
+  ExtTLS* e = ext_tls();
+  e->grad_out.clear();
+  e->grad_stypes.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GET_ITEM(r, i);
+    Py_INCREF(a);
+    e->grad_out.push_back(wrap(a));
+    e->grad_stypes.push_back(1);  // dense-backed
+  }
+  Py_DECREF(r);
+  if (grad_handles) *grad_handles = e->grad_out.data();
+  if (grad_stypes) *grad_stypes = e->grad_stypes.data();
+  return 0;
+}
+
+// -- Runtime misc ------------------------------------------------------
+
+int MXGetVersion(int* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("get_version", PyTuple_New(0));
+  if (!r) return fail_py("version failed");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("random_seed", Py_BuildValue("(i)", seed));
+  if (!r) return fail_py("seed failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;  // one RNG stream serves every device (jax key model)
+  return MXRandomSeed(seed);
+}
+
+int MXGetGPUCount(int* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("device_count", PyTuple_New(0));
+  if (!r) return fail_py("device count failed");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -230,6 +230,269 @@ int MXDataIterFree(DataIterHandle handle);
  * XLA teardown happens at process exit). */
 int MXNotifyShutdown(void);
 
+/* ---------------------------------------------------------------------
+ * NDArray extras (reference c_api.cc): views, raw-byte serde, storage
+ * type, grad state, sparse accessors.
+ * ------------------------------------------------------------------ */
+/* Placeholder array (deferred-alloc slot filler). */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+
+/* Like MXNDArrayCreate; delay_alloc accepted for ABI parity (XLA owns
+ * allocation, so it has no effect). */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+
+/* Fresh handle viewing rows [begin, end) / row `idx`.  Dense-backed:
+ * the result is a copy, not an aliasing view (XLA arrays are
+ * immutable); the reference's mutate-through-view idiom is not
+ * supported through this ABI. */
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim,
+                       const long long *dims, NDArrayHandle *out);
+
+/* Storage type codes (reference NDArrayStorageType): 0 undefined,
+ * 1 default, 2 row_sparse, 3 csr. */
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out);
+
+/* Fresh handle sharing the value but detached from the autograd tape. */
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+
+/* Single-array dmlc-format serde.  Buffer owned by the library, valid
+ * until the next SaveRawBytes on this thread. */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+
+/* Sparse accessors: values / aux (row_sparse: indices; csr: indptr,
+ * indices).  Fresh handles (caller frees). */
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
+
+/* Invoke with output storage types (reference MXImperativeInvokeEx);
+ * *out_stypes points at a thread-local array. */
+int MXImperativeInvokeEx(const char *op_name, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes);
+
+/* ---------------------------------------------------------------------
+ * DLPack interop (reference MXNDArrayToDLPack/FromDLPack).  The host
+ * buffer is exported/imported (kDLCPU); device memory stays owned by
+ * XLA.  Struct layout is the standard DLPack 0.x ABI.
+ * ------------------------------------------------------------------ */
+typedef void *DLManagedTensorHandle;
+
+int MXNDArrayToDLPack(NDArrayHandle handle, DLManagedTensorHandle *out);
+int MXNDArrayFromDLPack(DLManagedTensorHandle dlpack, NDArrayHandle *out);
+/* transient_handle accepted for reference signature parity. */
+int MXNDArrayFromDLPackEx(DLManagedTensorHandle dlpack,
+                          const int transient_handle, NDArrayHandle *out);
+int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlpack);
+
+/* ---------------------------------------------------------------------
+ * CachedOp plane (reference c_api_ndarray.cc:235, imperative/cached_op):
+ * bind-once-run-many graph handle for frontend inference loops.
+ * ------------------------------------------------------------------ */
+typedef void *CachedOpHandle;
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out);
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char **keys,
+                       const char **vals, CachedOpHandle *out);
+int MXFreeCachedOp(CachedOpHandle handle);
+/* Fresh output handles (caller frees each; array reused per thread). */
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes);
+
+/* ---------------------------------------------------------------------
+ * KVStore extras (reference c_api.cc): custom updaters, barrier,
+ * string keys, row-sparse pull, node roles, server commands.
+ * ------------------------------------------------------------------ */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+typedef void (*MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *handle);
+
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle);
+
+int MXKVStoreBarrier(KVStoreHandle kv);
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+
+/* Pull only the rows listed in row_ids[i] (int64 1-D arrays); vals[i]
+ * receives the full-shaped table with zeros off the requested rows
+ * (dense-backed row_sparse). */
+int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num, const int *keys,
+                           NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority);
+
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+
+/* Reference spelling preserved (the triple-m typo is ABI). */
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                   const char *cmd_body);
+
+/* Store type string, library-owned. */
+int MXKVStoreGetType(KVStoreHandle kv, const char **type);
+
+/* ---------------------------------------------------------------------
+ * RecordIO ABI (reference MXRecordIO*): the container im2rec produces.
+ * ------------------------------------------------------------------ */
+typedef void *RecordIOHandle;
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/* *buf -> thread-local copy of the record, *size its length; *buf NULL
+ * and *size 0 at end of file. */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* ---------------------------------------------------------------------
+ * Profiler ABI (reference src/c_api/c_api_profile.cc).
+ * ------------------------------------------------------------------ */
+int MXSetProcessProfilerConfig(int num_params, const char *const *keys,
+                               const char *const *vals,
+                               KVStoreHandle kv_handle);
+int MXSetProfilerConfig(int num_params, const char *const *keys,
+                        const char *const *vals);
+/* state: 0 = stop, 1 = run. */
+int MXSetProcessProfilerState(int state, int profile_process,
+                              KVStoreHandle kv_handle);
+int MXSetProfilerState(int state);
+int MXDumpProcessProfile(int finished, int profile_process,
+                         KVStoreHandle kv_handle);
+int MXDumpProfile(int finished);
+/* Aggregate stats table; string owned by the library, valid until the
+ * next call on this thread. */
+int MXAggregateProfileStatsPrint(const char **out_str, int reset);
+int MXProcessProfilePause(int paused, int profile_process,
+                          KVStoreHandle kv_handle);
+int MXProfilePause(int paused);
+
+/* ---------------------------------------------------------------------
+ * Symbol extras (reference c_api_symbolic.cc): attributes, dtype
+ * inference, internals/outputs, file round trip, op introspection.
+ * ------------------------------------------------------------------ */
+/* dtype codes as in the NDArray plane; -1 marks unknown. */
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                      const char **keys, const int *arg_type_data,
+                      mx_uint *in_type_size, const int **in_type_data,
+                      mx_uint *out_type_size, const int **out_type_data,
+                      mx_uint *aux_type_size, const int **aux_type_data,
+                      int *complete);
+
+/* *success = 1 and *out -> library-owned string when the attr exists. */
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+/* Flat [key0, val0, key1, val1, ...] listing, library-owned. */
+int MXSymbolListAttr(SymbolHandle sym, mx_uint *out_size,
+                     const char ***out);
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out);
+int MXSymbolGetNumOutputs(SymbolHandle sym, mx_uint *out);
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+
+/* Op introspection for frontend codegen (reference
+ * MXSymbolListAtomicSymbolCreators/GetAtomicSymbolInfo): a creator is an
+ * opaque id for one registered op. */
+typedef void *AtomicSymbolCreator;
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+/* Strings/arrays library-owned, valid until the next call on this
+ * thread.  key_var_num_args/return_type may be empty strings. */
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
+
+/* ---------------------------------------------------------------------
+ * Executor monitor callback (reference graph_executor.cc:1295): while
+ * installed, forward runs the graph observably and the callback fires
+ * per intermediate tensor.  The NDArray handle passed to the callback
+ * is owned by the library for the duration of the call.
+ * ------------------------------------------------------------------ */
+typedef void (*ExecutorMonitorCallback)(const char *name,
+                                        NDArrayHandle arr, void *cb_handle);
+
+int MXExecutorSetMonitorCallback(ExecutorHandle ex,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle ex,
+                                   ExecutorMonitorCallback callback,
+                                   void *callback_handle, int monitor_all);
+
+/* ---------------------------------------------------------------------
+ * Autograd extras.
+ * ------------------------------------------------------------------ */
+int MXAutogradIsRecording(unsigned char *curr);
+int MXAutogradIsTraining(unsigned char *curr);
+
+/* Backward with explicit variables: *grad_handles receives fresh grad
+ * handles for the listed variables (thread-local array; caller frees
+ * each handle), *grad_stypes their storage codes.  create_graph is not
+ * supported through the ABI and must be 0. */
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, mx_uint num_variables,
+                         NDArrayHandle *var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles,
+                         const int **grad_stypes);
+
+/* ---------------------------------------------------------------------
+ * Runtime misc.
+ * ------------------------------------------------------------------ */
+int MXGetVersion(int *out);
+int MXRandomSeed(int seed);
+int MXRandomSeedContext(int seed, int dev_type, int dev_id);
+/* Accelerator device count (TPU chips here; the reference counts GPUs). */
+int MXGetGPUCount(int *out);
+
 #ifdef __cplusplus
 }
 #endif

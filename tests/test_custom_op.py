@@ -1,0 +1,219 @@
+"""Custom op (user-defined Python operators) — parity with the
+reference's test_operator.py::test_custom_op family
+(ref: python/mxnet/operator.py CustomOp/CustomOpProp/register,
+src/operator/custom/custom-inl.h:50-60)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class _Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        if aux:
+            aux[0][:] = 1
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+        if aux:
+            assert (aux[0].asnumpy() == 1).all()
+
+
+@mx.operator.register("test_sqr")
+class _SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return ["aux"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [in_shape[0]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sqr()
+
+
+def test_custom_op_imperative_forward_backward_aux():
+    x = nd.array(np.random.RandomState(0)
+                 .uniform(-1, 1, (4, 10)).astype(np.float32))
+    aux = nd.zeros_like(x)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, aux, op_type="test_sqr")
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+    # aux state mutated in place by the forward
+    assert (aux.asnumpy() == 1).all()
+
+
+def test_custom_op_symbolic_executor_grad():
+    rs = np.random.RandomState(1)
+    x_np = rs.uniform(-1, 1, (3, 5)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    auxv = mx.sym.Variable("aux")
+    op = mx.sym.Custom(data=data, aux=auxv, name="sqr",
+                       op_type="test_sqr")
+    loss = mx.sym.make_loss(mx.sym.sum(op))
+    x = nd.array(x_np)
+    ex = loss.bind(mx.cpu(), {"data": x, "aux": nd.zeros_like(x)},
+                   args_grad={"data": nd.zeros_like(x)})
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 2 * x_np,
+                               rtol=1e-5)
+
+
+class _Mult(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], in_data[1] * out_grad[0])
+        self.assign(in_grad[1], req[1], in_data[0] * out_grad[0])
+
+
+@mx.operator.register("test_mult")
+class _MultProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Mult()
+
+
+def test_custom_op_two_inputs_grad():
+    rs = np.random.RandomState(2)
+    lhs = nd.array(rs.uniform(1, 2, (3, 4)).astype(np.float32))
+    rhs = nd.array(rs.uniform(1, 2, (3, 4)).astype(np.float32))
+    lhs.attach_grad()
+    rhs.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(lhs, rhs, op_type="test_mult")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(),
+                               lhs.asnumpy() * rhs.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(lhs.grad.asnumpy(), rhs.asnumpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(rhs.grad.asnumpy(), lhs.asnumpy(),
+                               rtol=1e-6)
+
+
+class _NoInput(mx.operator.CustomOp):
+    def __init__(self, length, depth):
+        super().__init__()
+        self.length = length
+        self.depth = depth
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    nd.array(np.eye(self.length, self.depth,
+                                    dtype=np.float32)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        pass
+
+
+@mx.operator.register("test_no_input_op")
+class _NoInputProp(mx.operator.CustomOpProp):
+    def __init__(self, length, depth):
+        super().__init__(need_top_grad=False)
+        self.length = int(length)
+        self.depth = int(depth)
+
+    def list_arguments(self):
+        return []
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [], [(self.length, self.depth)], []
+
+    def infer_type(self, in_type):
+        return [], [np.float32], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _NoInput(self.length, self.depth)
+
+
+def test_custom_op_no_inputs():
+    """Reference test_operator.py NoInputOp: a Custom op with zero
+    inputs whose params arrive as strings."""
+    out = nd.Custom(length=10, depth=10, op_type="test_no_input_op")
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.eye(10, 10, dtype=np.float32))
+
+
+class _ScaledGrad(mx.operator.CustomOp):
+    """Exercises string-marshalled hyper-parameters in backward."""
+
+    def __init__(self, scale):
+        super().__init__()
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], self.scale * out_grad[0])
+
+
+@mx.operator.register("test_scaled_grad")
+class _ScaledGradProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)  # hyper-params arrive as strings
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _ScaledGrad(self.scale)
+
+
+def test_custom_op_module_training():
+    """A Module trains end to end with a Custom op in its Symbol graph —
+    the reference's op-extensibility contract."""
+    rs = np.random.RandomState(3)
+    X = rs.uniform(-1, 1, (64, 8)).astype(np.float32)
+    w_true = rs.uniform(-1, 1, (1, 8)).astype(np.float32)
+    Y = X @ w_true.T
+
+    data = mx.sym.Variable("data")
+    custom = mx.sym.Custom(data=data, op_type="test_scaled_grad",
+                           scale=1.0)
+    fc = mx.sym.FullyConnected(custom, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("lin_label"),
+                                        name="lin")
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="lin_label")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("lin_label",), context=mx.cpu())
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    it.reset()
+    mse = dict(mod.score(it, "mse"))["mse"]
+    assert mse < 1e-2, mse
+
+
+def test_custom_op_unregistered_type_raises():
+    with pytest.raises(KeyError, match="not registered"):
+        nd.Custom(nd.zeros((2, 2)), op_type="nope_never_registered")

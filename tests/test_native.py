@@ -287,3 +287,33 @@ def test_dataiter_abi(tmp_path):
     assert cb.dataiter_get_pad(h) in (0, 2)
     with pytest.raises(ValueError):
         cb.dataiter_create("NoSuchIter", [], [])
+
+
+def test_abi_extras_client():
+    """Round-4 ABI planes exercised from compiled C++ (reference frontend
+    idioms): CachedOp inference, updater-driven KVStore, DLPack round
+    trip, RecordIO, raw-byte serde, monitor callback, symbol attrs/type
+    inference/op introspection, profiler, autograd extras."""
+    r = subprocess.run(["make", "-C", NATIVE, "abi_extras"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    env = subprocess_env()
+    r = subprocess.run([os.path.join(NATIVE, "abi_extras")], env=env,
+                       cwd=NATIVE, capture_output=True, text=True,
+                       timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ABI_EXTRAS_OK" in r.stdout, r.stdout
+
+
+def test_abi_function_count():
+    """The frontend scope ruling (docs/FRONTENDS.md) is premised on an
+    ABI broad enough to build a binding on; keep the declared-function
+    count from regressing."""
+    import re
+
+    decls = set()
+    for header in ("c_api.h", "c_predict_api.h"):
+        with open(os.path.join(NATIVE, header)) as f:
+            decls |= set(re.findall(r"^int (MX[A-Za-z0-9]+)\(",
+                                    f.read(), re.M))
+    assert len(decls) >= 120, sorted(decls)

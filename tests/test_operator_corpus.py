@@ -566,6 +566,8 @@ TESTED_ELSEWHERE = {
     # tests/test_ops_r4.py
     "reshape_like", "broadcast_like", "khatri_rao", "Correlation",
     "cast_storage", "IdentityAttachKLSparseReg",
+    # user-defined ops: tests/test_custom_op.py
+    "Custom",
 }
 
 

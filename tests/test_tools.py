@@ -45,16 +45,25 @@ def test_parse_log(tmp_path):
 def test_bandwidth_measure():
     r = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "bandwidth", "measure.py"),
-         "--sizes", "1e4,1e5", "--iters", "2"],
+         "--sizes", "1e4,1e5", "--iters", "2", "--mesh", "4,2",
+         "--axes", "dp,tp"],
         env=ENV, capture_output=True, text=True, timeout=540)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "devices: 8 x cpu" in r.stdout
-    # one row per size with finite bandwidth numbers
-    rows = [l for l in r.stdout.splitlines()
-            if l.strip() and l.lstrip()[0].isdigit()]
-    assert len(rows) == 2
-    vals = [float(x) for x in rows[0].split()]
-    assert all(v > 0 for v in vals), r.stdout
+    # host<->device rows: one per size, positive bandwidths
+    hd = [l for l in r.stdout.splitlines()
+          if l.strip() and l.lstrip()[0].isdigit()]
+    assert len(hd) == 2
+    assert all(float(x) > 0 for x in hd[0].split())
+    # collective sweep: per axis x size rows with every collective column
+    assert "psum(GB/s)" in r.stdout and "ppermute(GB/s)" in r.stdout
+    for axis in ("dp", "tp"):
+        rows = [l for l in r.stdout.splitlines()
+                if l.split() and l.split()[0] == axis]
+        assert len(rows) == 2, r.stdout  # one per size
+        for row in rows:
+            vals = [float(x) for x in row.split()[1:]]
+            assert len(vals) == 5 and all(v > 0 for v in vals), row
 
 
 def test_flakiness_checker_stable(tmp_path):
