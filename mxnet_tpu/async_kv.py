@@ -14,12 +14,24 @@ through the jax.distributed coordination KV, so no extra configuration is
 needed beyond the launcher's env.
 
 Protocol: request = (op, key, payload); reply = (ok, payload).
-  op ∈ {"init", "push", "pull", "set_optimizer"}
+  op ∈ {"init", "push", "pull", "set_optimizer",
+        "init_rows", "push_rows", "pull_rows"}
 * ``init``  — store-if-absent (all workers init identically; first wins).
 * ``push``  — if the server has an optimizer: ``updater(key, grad,
   stored)`` in-place, per push (the async apply). Otherwise: assign, the
   same no-updater semantics the local store has.
 * ``pull``  — returns the current stored value, never waits for anyone.
+
+Row-table ops (the server-side sparse reduce of the reference's
+row-sparse ``DataHandleEx`` branch, ``kvstore_dist_server.h``): the
+server owns a lazily-materialized row table per key; ``push_rows``
+applies the optimizer per ROW (each row gets its own updater index, so
+per-row update counts — Adam bias correction — are preserved across
+workers) or assigns when no optimizer is installed; ``pull_rows``
+gathers the requested rows only.  The host server IS the TPU-native
+placement for this: host-row tables are host-resident by design, so
+cross-worker consistency comes from one authoritative host copy, not
+from device collectives.
 """
 from __future__ import annotations
 
@@ -63,6 +75,7 @@ class _Server(socketserver.ThreadingTCPServer):
     def __init__(self, addr):
         super().__init__(addr, _Handler)
         self.store: dict = {}
+        self.row_tables: dict = {}
         self.updater = None
         self.lock = threading.Lock()
         self._str_idx: dict = {}
@@ -75,6 +88,19 @@ class _Server(socketserver.ThreadingTCPServer):
         if key not in self._str_idx:
             self._str_idx[key] = len(self._str_idx)
         return self._str_idx[key]
+
+
+def _row_of(tbl, i):
+    """Lazily materialize row ``i`` of a server-side row table."""
+    row = tbl["rows"].get(i)
+    if row is None:
+        if tbl["init"] is not None:
+            row = np.asarray(tbl["init"](i),
+                             tbl["dtype"]).reshape(tbl["shape"][1:])
+        else:
+            row = np.zeros(tbl["shape"][1:], tbl["dtype"])
+        tbl["rows"][i] = row
+    return row
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -110,6 +136,53 @@ class _Handler(socketserver.BaseRequestHandler):
                         cur = srv.store.get(key)
                         reply = KeyError(key) if cur is None \
                             else cur.copy()
+                    elif op == "init_rows":
+                        if key not in srv.row_tables:
+                            shape, dtype, init_blob = payload
+                            srv.row_tables[key] = {
+                                "shape": tuple(shape),
+                                "dtype": np.dtype(dtype),
+                                "init": (pickle.loads(init_blob)
+                                         if init_blob is not None
+                                         else None),
+                                "rows": {},
+                            }
+                        reply = None
+                    elif op == "push_rows":
+                        tbl = srv.row_tables.get(key)
+                        if tbl is None:
+                            reply = KeyError(key)
+                        elif srv.updater is None:
+                            # assigning per-worker grads would resolve
+                            # overlapping ids last-writer-wins — the
+                            # silent divergence this server exists to
+                            # prevent; same contract as dense push
+                            reply = RuntimeError(
+                                "dist host-row push before "
+                                "set_optimizer: the server-side sparse "
+                                "reduce needs the optimizer on the "
+                                "kvstore (update_on_kvstore=True)")
+                        else:
+                            ids, grads = payload
+                            grads = np.asarray(grads)
+                            for j, i in enumerate(np.asarray(ids)):
+                                i = int(i)
+                                # per-row updater index: per-row state
+                                # AND update counts
+                                srv.updater("hostrow:%s:%d" % (key, i),
+                                            grads[j], _row_of(tbl, i))
+                            reply = None
+                    elif op == "pull_rows":
+                        tbl = srv.row_tables.get(key)
+                        if tbl is None:
+                            reply = KeyError(key)
+                        else:
+                            ids = np.asarray(payload)
+                            reply = np.stack(
+                                [_row_of(tbl, int(i)).copy()
+                                 for i in ids]) if len(ids) else \
+                                np.zeros((0,) + tbl["shape"][1:],
+                                         tbl["dtype"])
                     elif op == "set_optimizer":
                         from . import optimizer as opt
 
@@ -177,3 +250,14 @@ class AsyncKVClient:
 
     def set_optimizer(self, pickled_optimizer):
         self._call("set_optimizer", key=None, payload=pickled_optimizer)
+
+    # -- row tables (server-side sparse reduce) -------------------------
+    def init_rows(self, key, shape, dtype, pickled_initializer):
+        self._call("init_rows", key,
+                   (tuple(shape), str(dtype), pickled_initializer))
+
+    def push_rows(self, key, ids_np, grads_np):
+        self._call("push_rows", key, (ids_np, grads_np))
+
+    def pull_rows(self, key, ids_np):
+        return self._call("pull_rows", key, ids_np)

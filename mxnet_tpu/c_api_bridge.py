@@ -514,11 +514,10 @@ def nd_aux_type_code(arr, i):
 def to_numpy_retained(arr):
     import numpy as np
 
-    # a fresh writable copy: DLPack (pre-1.0) cannot signal read-only
-    # buffers, and jax's asnumpy view is read-only
-    out = np.empty(arr.shape, dtype=np.dtype(arr.asnumpy().dtype))
-    np.copyto(out, arr.asnumpy())
-    return out
+    # a fresh writable copy (ONE device->host sync): DLPack (pre-1.0)
+    # cannot signal read-only buffers, and jax's asnumpy view is
+    # read-only
+    return np.array(arr.asnumpy(), copy=True)
 
 
 class _CapsuleDLPack:
@@ -645,15 +644,32 @@ def kv_is_scheduler_node():
 def kv_send_command_to_servers(kv, cmd_id, cmd_body):
     """Reference MXKVStoreSendCommmandToServers: the controller channel
     workers use to push an optimizer/config to the server.  Command 0
-    carries a pickled optimizer (kvstore_dist_server.h kController)."""
-    if getattr(kv, "_async", None) is not None and int(cmd_id) == 0:
+    carries a PROTOCOL-0 (ASCII) pickled optimizer — the reference's own
+    convention (kvstore.py ``pickle.dumps(optimizer, 0)`` through a
+    ``const char*``), which survives the C string boundary; binary
+    protocols cannot cross a NUL-terminated ABI.  Installs the optimizer
+    on whichever host server the store runs (dist_async main server or
+    the dist host-row server)."""
+    if int(cmd_id) != 0:
+        raise ValueError("kvstore %r: unknown server command %d"
+                         % (kv.type, int(cmd_id)))
+    blob = (cmd_body if isinstance(cmd_body, bytes)
+            else str(cmd_body).encode("latin-1"))
+    try:
+        import pickle
+
+        pickle.loads(blob)
+    except Exception as e:
+        raise ValueError(
+            "command 0 payload is not a loadable pickle (use "
+            "pickle.dumps(optimizer, 0) — protocol 0 survives the C "
+            "string boundary): %s" % e) from e
+    kv._server_opt_blob = blob
+    target = kv._row_client if kv._row_client is not None else kv._async
+    if target is not None:
         if kv.rank == 0:
-            kv._async.set_optimizer(
-                cmd_body if isinstance(cmd_body, bytes)
-                else str(cmd_body).encode("latin-1"))
-        return
-    raise ValueError("kvstore type %r has no server command channel for "
-                     "cmd %d" % (kv.type, int(cmd_id)))
+            target.set_optimizer(blob)
+        kv._barrier()
 
 
 def kv_type(kv):

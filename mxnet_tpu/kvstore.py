@@ -96,12 +96,15 @@ class KVStore:
         self._compression_params = None
         self._str_key_dict = {}
         self._async = None
+        self._row_client = None     # host PS for dist host-row tables
+        self._server_opt_blob = None
         if kv_type == "dist_async" and self.num_workers > 1:
             # barrier-free per-push apply on a host-side parameter server
             # (reference kvstore_dist_server.h:346-348 async mode)
             from .async_kv import AsyncKVClient
 
             self._async = AsyncKVClient()
+            self._row_client = self._async  # rows share the server
 
     # -- identity ---------------------------------------------------------
     @property
@@ -127,6 +130,22 @@ class KVStore:
             return 1
 
     # -- host-resident rows (large-vocab embeddings) ----------------------
+    def _row_server(self):
+        """The host parameter server holding dist row tables.  dist_sync
+        creates it lazily on first host-row use — dense keys keep riding
+        XLA collectives; host-row tables are host-side by design, so one
+        authoritative host copy (reference kvstore_dist_server.h) is the
+        natural cross-worker store for them."""
+        if self._row_client is None:
+            from .async_kv import AsyncKVClient
+
+            self._row_client = AsyncKVClient()
+            if self._server_opt_blob is not None:
+                if self.rank == 0:
+                    self._row_client.set_optimizer(self._server_opt_blob)
+                self._barrier()
+        return self._row_client
+
     def init_host_rows(self, key, shape, dtype="float32",
                        initializer=None):
         """Register a host-resident row table for ``key`` (reference
@@ -141,6 +160,17 @@ class KVStore:
 
         self._host_rows[key] = _HostRowStore(shape, np.dtype(dtype),
                                              initializer)
+        if self._type.startswith("dist") and self.num_workers > 1:
+            try:
+                init_blob = (pickle.dumps(initializer)
+                             if initializer is not None else None)
+            except Exception as e:
+                raise ValueError(
+                    "dist host-row tables need a picklable initializer "
+                    "(module-level function) or None, got %r" %
+                    (initializer,)) from e
+            self._row_server().init_rows(key, shape, dtype, init_blob)
+            self._barrier()  # table exists everywhere before any push
 
     def host_row_stats(self, key):
         """{rows_transferred, bytes_transferred, resident_rows} for a
@@ -252,19 +282,23 @@ class KVStore:
         if grads.shape[0] != ids.shape[0]:
             raise ValueError("push row_ids (%d) / rows (%d) mismatch"
                              % (ids.shape[0], grads.shape[0]))
-        if self._type.startswith("dist") and self.num_workers > 1:
-            # cross-worker row alignment (each worker touches different
-            # ids) needs a server-side sparse reduce we have not built;
-            # fail loudly rather than silently diverge per worker
-            raise NotImplementedError(
-                "host-row push is single-process for now; dist host-row "
-                "tables need a server-side sparse reduce")
         # duplicate ids within one push sum, like the reference's
         # row-sparse reduce
         uniq, inv = np.unique(ids, return_inverse=True)
         inv = inv.reshape(-1)
         summed = np.zeros((len(uniq),) + grads.shape[1:], store.dtype)
         np.add.at(summed, inv, grads)
+        if self._type.startswith("dist") and self.num_workers > 1:
+            # server-side sparse reduce (reference kvstore_dist_server.h
+            # row-sparse DataHandleEx): one authoritative host table;
+            # each worker's deduped rows apply there per row.  dist_sync
+            # barriers after the push so pulls observe every worker's
+            # contribution (with linear updaters the per-push applies
+            # compose to exactly the batched update)
+            self._row_server().push_rows(key, uniq, summed)
+            if self._type != "dist_async":
+                self._barrier()
+            return
         if self._updater is not None and self._update_on_kvstore_flag:
             self._apply_host_update(key, store, uniq, summed)
         else:
@@ -362,10 +396,18 @@ class KVStore:
         if key in self._host_rows:
             import numpy as np
 
+            store = self._host_rows[key]
             ids = np.asarray(
                 row_ids.asnumpy() if isinstance(row_ids, NDArray)
                 else row_ids).astype(np.int64).ravel()
-            rows = self._host_rows[key].gather(ids)
+            if self._type.startswith("dist") and self.num_workers > 1:
+                # authoritative rows live on the host PS; count the
+                # transfer against the local stats like the local path
+                rows = self._row_server().pull_rows(key, ids)
+                store.rows_transferred += len(ids)
+                store.bytes_transferred += rows.nbytes
+            else:
+                rows = store.gather(ids)
             result = nd.array(rows)
             if out is not None:
                 out._set_data(result.as_in_context(out.context).data)
@@ -454,6 +496,14 @@ class KVStore:
         optimizer = pickle.loads(blob)
         self._updater = opt.get_updater(optimizer)
         self._update_on_kvstore_flag = True
+        # dist_sync with host-row tables: the row server runs the
+        # optimizer too (server-side sparse reduce); remember the blob
+        # for a server created after set_optimizer
+        self._server_opt_blob = blob
+        if self._row_client is not None and self.num_workers > 1:
+            if self.rank == 0:
+                self._row_client.set_optimizer(blob)
+            self._barrier()
 
     def set_updater(self, updater):
         """Install a custom updater ``updater(key, recv_grad, local)``

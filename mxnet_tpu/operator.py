@@ -94,6 +94,7 @@ def register(reg_name):
             raise TypeError("register must be applied to a CustomOpProp "
                             "subclass, got %r" % (prop_cls,))
         _PROP_REGISTRY[reg_name] = prop_cls
+        _PLAN_CACHE.clear()  # arities may change on re-registration
         return prop_cls
 
     return deco
@@ -118,13 +119,23 @@ def _instantiate_prop(op_type, user_kwargs):
     return _PROP_REGISTRY[op_type](**kwargs)
 
 
+_PLAN_CACHE: dict = {}
+
+
 def _custom_plan(params, n_inputs):
-    """(n_args, n_out, n_aux) for a Custom invocation's params."""
-    prop = _instantiate_prop(
-        params["op_type"],
-        {k: v for k, v in params.items() if k != "op_type"})
-    return (len(prop.list_arguments()), len(prop.list_outputs()),
-            len(prop.list_auxiliary_states()))
+    """(n_args, n_out, n_aux) for a Custom invocation's params — memoized
+    so the mutate/visible hooks don't re-instantiate the user prop on
+    every dispatch."""
+    key = tuple(sorted((str(k), str(v)) for k, v in params.items()))
+    plan = _PLAN_CACHE.get(key)
+    if plan is None:
+        prop = _instantiate_prop(
+            params["op_type"],
+            {k: v for k, v in params.items() if k != "op_type"})
+        plan = (len(prop.list_arguments()), len(prop.list_outputs()),
+                len(prop.list_auxiliary_states()))
+        _PLAN_CACHE[key] = plan
+    return plan
 
 
 def _custom_mutate(params, n_inputs):

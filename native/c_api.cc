@@ -1014,6 +1014,7 @@ struct ExtTLS {
   std::vector<const char*> op_doc_ptrs[3];
   std::string op_name, op_desc;
   std::vector<void*> creators;
+  std::vector<std::string> creator_names;  // filled with creators
 };
 ExtTLS* ext_tls() {
   thread_local ExtTLS t;
@@ -1088,21 +1089,36 @@ PyObject* updater_trampoline(PyObject* self, PyObject* args) {
   Py_INCREF(local);
   NDArrayObj* r = wrap(recv);
   NDArrayObj* l = wrap(local);
+  bool handled = false;
   if (PyLong_Check(key)) {
     int k = static_cast<int>(PyLong_AsLong(key));
-    if (ctx->fn) ctx->fn(k, r, l, ctx->handle);
+    if (ctx->fn) {
+      ctx->fn(k, r, l, ctx->handle);
+      handled = true;
+    } else if (ctx->str_fn) {
+      // string-only updaters still see every key (stringified int)
+      std::string ks = std::to_string(k);
+      ctx->str_fn(ks.c_str(), r, l, ctx->handle);
+      handled = true;
+    }
   } else {
     const char* k = utf8_or_null(key);
     if (ctx->str_fn && k) {
       ctx->str_fn(k, r, l, ctx->handle);
-    } else if (ctx->fn && k) {
-      // integer-updater fallback for the "hostrow:..."-style keys
-      ctx->fn(static_cast<int>(std::hash<std::string>()(k) & 0x7fffffff),
-              r, l, ctx->handle);
+      handled = true;
     }
+    // an int-only updater CANNOT consume a string key faithfully —
+    // hashing would alias per-key optimizer state; fail loudly below
   }
   MXNDArrayFree(r);
   MXNDArrayFree(l);
+  if (!handled) {
+    PyErr_SetString(
+        PyExc_ValueError,
+        "kvstore updater cannot handle this key kind: install a string "
+        "updater (MXKVStoreSetUpdaterEx) for string/host-row keys");
+    return nullptr;
+  }
   Py_RETURN_NONE;
 }
 
@@ -1936,32 +1952,43 @@ int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
   if (!r) return fail_py("op list failed");
   ExtTLS* e = ext_tls();
   // a creator is 1 + the op's index in the sorted name list (0 would be
-  // indistinguishable from NULL)
+  // indistinguishable from NULL); names cache alongside so the
+  // per-creator lookups a codegen loop makes stay O(1)
   Py_ssize_t n = PyList_Size(r);
-  Py_DECREF(r);
   e->creators.clear();
-  for (Py_ssize_t i = 0; i < n; ++i)
+  e->creator_names.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = utf8_or_null(PyList_GET_ITEM(r, i));
+    if (!s) {
+      Py_DECREF(r);
+      return fail("non-UTF8 op name");
+    }
     e->creators.push_back(reinterpret_cast<void*>(i + 1));
+    e->creator_names.push_back(s);
+  }
+  Py_DECREF(r);
   *out_size = static_cast<mx_uint>(n);
   *out_array = e->creators.data();
   return 0;
 }
 
 static PyObject* creator_name(AtomicSymbolCreator creator) {
-  // re-derive the name from the sorted list; stable across calls since
-  // the registry is append-only and the list is sorted
-  PyObject* r = call_bridge("op_names_sorted", PyTuple_New(0));
-  if (!r) return nullptr;
-  Py_ssize_t idx = reinterpret_cast<Py_ssize_t>(creator) - 1;
-  if (idx < 0 || idx >= PyList_Size(r)) {
-    Py_DECREF(r);
+  // serve from the cache filled by ListAtomicSymbolCreators (stable:
+  // the registry is append-only and the list is sorted); fill it on
+  // first use for callers that skipped the List call
+  ExtTLS* e = ext_tls();
+  if (e->creator_names.empty()) {
+    mx_uint n = 0;
+    AtomicSymbolCreator* unused = nullptr;
+    if (MXSymbolListAtomicSymbolCreators(&n, &unused) != 0)
+      return nullptr;
+  }
+  size_t idx = reinterpret_cast<size_t>(creator) - 1;
+  if (idx >= e->creator_names.size()) {
     PyErr_SetString(PyExc_IndexError, "bad AtomicSymbolCreator");
     return nullptr;
   }
-  PyObject* name = PyList_GET_ITEM(r, idx);
-  Py_INCREF(name);
-  Py_DECREF(r);
-  return name;
+  return PyUnicode_FromString(e->creator_names[idx].c_str());
 }
 
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,

@@ -355,7 +355,11 @@ int MXKVStoreIsWorkerNode(int *ret);
 int MXKVStoreIsServerNode(int *ret);
 int MXKVStoreIsSchedulerNode(int *ret);
 
-/* Reference spelling preserved (the triple-m typo is ABI). */
+/* Reference spelling preserved (the triple-m typo is ABI).  Command 0
+ * installs a server-side optimizer; its body must be a PROTOCOL-0
+ * (ASCII) pickle — the reference's own convention
+ * (pickle.dumps(optimizer, 0)), since binary pickles cannot cross a
+ * NUL-terminated char* boundary. */
 int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
                                    const char *cmd_body);
 

@@ -1,0 +1,65 @@
+"""dist host-row worker, run under ``mxnet_tpu.tools.launch``.
+
+Proves the server-side sparse reduce (reference
+``kvstore_dist_server.h`` row-sparse ``DataHandleEx``): workers pushing
+DISJOINT row ids all land on one authoritative host table, and workers
+pushing the SAME row compose exactly (SGD is linear, so per-push server
+application equals the batched update bit-for-bit in fp32).
+Invoked by tests/test_dist.py.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main(out_dir):
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw >= 2, "expected >=2 workers, got %d" % nw
+
+    dim = 4
+    kv.init_host_rows("emb", (100, dim))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+
+    # -- disjoint ids: worker r owns rows {2r, 2r+1}, grad value r+1 ----
+    ids = np.array([2 * rank, 2 * rank + 1], np.int64)
+    kv.push("emb", mx.nd.array(np.full((2, dim), rank + 1.0, np.float32)),
+            row_ids=ids)
+
+    all_ids = np.arange(2 * nw, dtype=np.int64)
+    got = kv.row_sparse_pull("emb", row_ids=all_ids).asnumpy()
+    for r in range(nw):
+        want = -(r + 1.0)  # 0 - lr * grad, exact
+        assert (got[2 * r] == want).all(), (rank, r, got[2 * r])
+        assert (got[2 * r + 1] == want).all(), (rank, r, got[2 * r + 1])
+
+    # -- overlapping id: every worker pushes ones into row 50 -----------
+    kv.push("emb", mx.nd.ones((1, dim)), row_ids=np.array([50], np.int64))
+    got50 = kv.row_sparse_pull(
+        "emb", row_ids=np.array([50], np.int64)).asnumpy()[0]
+    # nw sequential SGD applies == one batched apply of the summed grad
+    assert (got50 == -float(nw)).all(), (rank, got50)
+
+    # -- duplicate ids inside ONE push still sum before the apply --------
+    kv.push("emb", mx.nd.ones((2, dim)),
+            row_ids=np.array([60, 60], np.int64))
+    kv._barrier()
+    got60 = kv.row_sparse_pull(
+        "emb", row_ids=np.array([60], np.int64)).asnumpy()[0]
+    assert (got60 == -2.0 * nw).all(), (rank, got60)
+
+    # transfers are counted per worker, O(touched rows)
+    stats = kv.host_row_stats("emb")
+    assert stats["rows_transferred"] >= 2 * nw + 2
+
+    with open(os.path.join(out_dir, "hostrow_%d.ok" % rank), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
