@@ -1,0 +1,86 @@
+"""Model-zoo weight files: locate (and verify) pretrained ``.params``.
+
+Reference parity: ``python/mxnet/gluon/model_zoo/model_store.py``
+(get_model_file:63 resolves ``<root>/<name>-<hash>.params``, verifying
+the sha1 and downloading on miss).  This environment has no network
+egress, so the download leg is replaced by a loud, actionable error; the
+local-resolution and integrity-check halves keep the reference shape:
+
+* ``get_model_file(name, root)`` returns ``<root>/<name>.params`` when
+  present (also accepting the reference's ``<name>-<8hex>.params``
+  naming), verifying it against an optional ``<name>.sha256`` sidecar.
+* Files are the reference dmlc binary format — a checkpoint converted
+  from a reference installation (``mx.gluon.Block.save_parameters`` /
+  ``mx.nd.save`` there) loads here unchanged, because
+  ``ndarray/dmlc_serde.py`` reads that format bit-compatibly.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def _default_root():
+    return os.environ.get(
+        "MXNET_HOME",
+        os.path.join(os.path.expanduser("~"), ".mxnet", "models"))
+
+
+def _candidates(name, root):
+    exact = os.path.join(root, name + ".params")
+    hashed = sorted(glob.glob(os.path.join(root, name + "-*.params")))
+    return ([exact] if os.path.exists(exact) else []) + hashed
+
+
+def _verify_sidecar(path, name, root):
+    sidecar = os.path.join(root, name + ".sha256")
+    if not os.path.exists(sidecar):
+        return
+    with open(sidecar) as f:
+        fields = f.read().split()
+    if not fields:
+        raise ValueError(
+            "sha256 sidecar %s is empty; put the expected hex digest in "
+            "it or delete it to skip verification" % sidecar)
+    want = fields[0].strip().lower()
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != want:
+        raise ValueError(
+            "model file %s fails its sha256 check (%s sidecar): the "
+            "file is corrupt or was replaced" % (path, sidecar))
+
+
+def get_model_file(name, root=None):
+    """Path of the pretrained weights for model ``name``.
+
+    Looks for ``<root>/<name>.params`` (or the reference's hashed
+    ``<name>-xxxxxxxx.params`` spelling) and verifies an optional
+    ``<name>.sha256`` sidecar.  There is no download leg in this
+    environment; missing files raise with conversion instructions."""
+    root = os.path.expanduser(root) if root else _default_root()
+    found = _candidates(name, root)
+    if found:
+        _verify_sidecar(found[0], name, root)
+        return found[0]
+    raise RuntimeError(
+        "Pretrained weights for %r not found under %s and this "
+        "environment has no network egress to download them. Convert a "
+        "reference checkpoint instead: the reference's "
+        "'%s-<hash>.params' file (python/mxnet/gluon/model_zoo/"
+        "model_store.py) is the dmlc binary format this framework reads "
+        "bit-compatibly — copy it to %s" % (
+            name, root, name, os.path.join(root, name + ".params")))
+
+
+def purge(root=None):
+    """Remove cached model files (reference: model_store.purge)."""
+    root = os.path.expanduser(root) if root else _default_root()
+    for pattern in ("*.params", "*.sha256"):  # stale sidecars would
+        for f in glob.glob(os.path.join(root, pattern)):  # reject new files
+            os.remove(f)

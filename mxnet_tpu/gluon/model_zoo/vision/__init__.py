@@ -1,7 +1,9 @@
 """Vision model zoo (reference:
-``python/mxnet/gluon/model_zoo/vision/__init__.py``; pretrained download via
-model_store is unavailable — no network egress — so ``pretrained=True``
-raises)."""
+``python/mxnet/gluon/model_zoo/vision/__init__.py``).  ``pretrained=True``
+loads reference-format ``.params`` resolved through
+``model_zoo.model_store.get_model_file`` (local files with optional
+sha256 sidecar verification; there is no network egress, so the download
+leg raises with conversion instructions instead)."""
 from .alexnet import alexnet, AlexNet  # noqa: F401
 from .densenet import (densenet121, densenet161, densenet169, densenet201,  # noqa: F401
                        DenseNet)

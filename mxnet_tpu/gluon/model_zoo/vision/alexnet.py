@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -42,5 +43,6 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(get_model_file("alexnet", root=root),
+                            ctx=ctx)
     return net

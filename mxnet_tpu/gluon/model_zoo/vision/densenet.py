@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
@@ -87,7 +88,8 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(
+            get_model_file("densenet%d" % num_layers, root=root), ctx=ctx)
     return net
 
 

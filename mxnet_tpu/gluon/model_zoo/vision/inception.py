@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -171,5 +172,6 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(get_model_file("inceptionv3", root=root),
+                            ctx=ctx)
     return net

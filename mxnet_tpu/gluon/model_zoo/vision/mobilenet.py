@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
@@ -122,10 +123,17 @@ class MobileNetV2(HybridBlock):
         return x
 
 
+def _multiplier_suffix(multiplier):
+    suffix = "%.2f" % multiplier
+    return suffix[:-1] if suffix.endswith("0") else suffix
+
+
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(
+            get_model_file("mobilenet%s" % _multiplier_suffix(multiplier),
+                           root=root), ctx=ctx)
     return net
 
 
@@ -133,7 +141,10 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(
+            get_model_file(
+                "mobilenetv2_%s" % _multiplier_suffix(multiplier),
+                root=root), ctx=ctx)
     return net
 
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
@@ -258,8 +259,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights are unavailable in this "
-                           "environment (no network egress)")
+        net.load_parameters(
+            get_model_file("resnet%d_v%d" % (num_layers, version),
+                           root=root), ctx=ctx)
     return net
 
 

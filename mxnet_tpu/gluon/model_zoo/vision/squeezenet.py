@@ -5,6 +5,7 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 from ...nn.basic_layers import HybridSequential
+from ..model_store import get_model_file
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "get_squeezenet"]
 
@@ -91,7 +92,8 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        net.load_parameters(
+            get_model_file("squeezenet%s" % version, root=root), ctx=ctx)
     return net
 
 

