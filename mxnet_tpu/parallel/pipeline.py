@@ -117,7 +117,7 @@ def pipeline_spmd(stage_fn, stacked_params, x, num_microbatches, mesh=None,
                      check_vma=False)(stacked_params, x)
 
 
-def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
+def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis, dp_axis=None):
     """One SPMD worker running the interleaved 1F1B schedule.
 
     Timeline (global step t): stage p runs the FORWARD of microbatch
@@ -126,7 +126,11 @@ def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
     fwd and bwd of a microbatch coincide there.  Total steps M + 2P - 2
     vs GPipe's 2(M + P - 1); a stage stores at most 2P-1 saved inputs
     (O(P), the 1F1B memory property) instead of AD's O(M) residuals —
-    backward recomputes the stage forward from the saved input."""
+    backward recomputes the stage forward from the saved input.
+
+    With ``dp_axis`` the worker's x/targets are the dp shard of each
+    microbatch; loss and per-stage grads psum over dp at the end, so pp
+    and dp compose in one mesh."""
     from .collectives import ppermute_shift
 
     Q = 2 * P - 1  # saved-input slots: inputs live < 2P-2 steps
@@ -203,6 +207,14 @@ def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
                               pp_axis)
         outbuf = lax.psum(jnp.where(my == P - 1, outbuf,
                                     jnp.zeros_like(outbuf)), pp_axis)
+        if dp_axis is not None:
+            # data-parallel composition: every dp replica processed its
+            # own shard of each microbatch — total loss and per-stage
+            # grads sum across the dp axis (outbuf stays the local
+            # shard; the out_spec reassembles the batch dim)
+            loss_total = lax.psum(loss_total, dp_axis)
+            dp_acc = jax.tree_util.tree_map(
+                lambda d: lax.psum(d, dp_axis), dp_acc)
         # each rank keeps ITS stage's grads; re-add the stage dim so the
         # out_spec stacks them back to [P, ...]
         dp_out = jax.tree_util.tree_map(lambda d: d[None], dp_acc)
@@ -212,7 +224,8 @@ def _make_1f1b_worker(stage_fn, loss_fn, M, P, pp_axis):
 
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, targets,
-                        num_microbatches, mesh=None, pp_axis="pp"):
+                        num_microbatches, mesh=None, pp_axis="pp",
+                        dp_axis=None):
     """Interleaved one-forward-one-backward pipeline TRAINING step.
 
     ``stage_fn(params, act) -> act`` (homogeneous stages),
@@ -220,6 +233,13 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, targets,
     the last stage.  ``stacked_params`` leaves have leading dim P;
     ``x``/``targets`` are [M, mb, ...].  Returns
     ``(total_loss, outputs [M, mb, ...], dparams stacked [P, ...])``.
+
+    With ``dp_axis`` the per-microbatch dim shards over that mesh axis
+    (pp x dp in one mesh): each dp replica pipelines its batch shard and
+    loss/grads psum across dp.  This REQUIRES ``loss_fn`` to be additive
+    over the batch dim (sum reduction, like the sequential oracle's
+    sum-over-microbatches): a mean-reduction loss would compute per-shard
+    means and psum them, scaling loss and grads by the dp size.
 
     Without a pp mesh axis the same math runs sequentially via jax AD —
     the parity oracle the tests diff against."""
@@ -246,11 +266,14 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, targets,
             total, has_aux=True)(stacked_params)
         return loss, outs, grads
 
+    dp_sz = mesh.size(dp_axis) if dp_axis is not None else 1
+    use_dp = dp_axis if dp_sz > 1 else None
     worker = _make_1f1b_worker(stage_fn, loss_fn, num_microbatches,
-                               P_sz, pp_axis)
+                               P_sz, pp_axis, dp_axis=use_dp)
     pspec = jax.tree_util.tree_map(lambda _: Pspec(pp_axis),
                                    stacked_params)
+    data_spec = Pspec(None, use_dp) if use_dp else Pspec()
     return shard_map(worker, mesh=mesh.mesh,
-                     in_specs=(pspec, Pspec(), Pspec()),
-                     out_specs=(Pspec(), Pspec(), pspec),
+                     in_specs=(pspec, data_spec, data_spec),
+                     out_specs=(Pspec(), data_spec, pspec),
                      check_vma=False)(stacked_params, x, targets)
