@@ -60,5 +60,9 @@ from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import elastic  # noqa: F401
 
+# everything registered up to here is the shipped op corpus; later
+# registrations are user ops (operator.register / rtc.PallasModule)
+ops.registry.freeze_builtins()
+
 if config.profiler_autostart:
     profiler.start()

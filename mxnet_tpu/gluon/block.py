@@ -423,7 +423,7 @@ class _CachedOp:
         # aux outputs — indices are appended after the real outputs
         self._opdef = OpDef("_CachedOp_%s" % block.name, pure_fn,
                             needs_rng=True, train_aware=True, mutate=mutate,
-                            no_grad=False)
+                            no_grad=False, aux_mutate=True)
         self._n_inputs = n_inputs
 
     def __call__(self, *flat_args_and_fmt):

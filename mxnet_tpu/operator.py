@@ -155,7 +155,7 @@ def _register_custom_op():
     from .ops.registry import register as reg_op
 
     @reg_op("Custom", train_aware=True, mutate=_custom_mutate,
-            visible_out=_custom_visible, cacheable=True)
+            visible_out=_custom_visible, cacheable=True, aux_mutate=True)
     def _custom(*arrays, op_type=None, _train=False, **user_kwargs):
         from . import ndarray as nd
 

@@ -15,3 +15,7 @@ from . import contrib_ops  # noqa: F401
 from . import detection  # noqa: F401
 from . import quantization  # noqa: F401
 from . import misc  # noqa: F401
+
+# provisional freeze; mxnet_tpu/__init__ re-freezes after the shipped
+# modules that register ops outside this package (operator.Custom) load
+registry.freeze_builtins()

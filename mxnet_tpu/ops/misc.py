@@ -63,7 +63,7 @@ def _unravel_index(data, shape=None):
 # IdentityAttachKLSparseReg (identity_attach_KL_sparse_reg.cc)
 # ---------------------------------------------------------------------------
 @register("IdentityAttachKLSparseReg", input_names=("data", "moving_avg"),
-          train_aware=True, num_outputs=2, mutate={1: 1},
+          train_aware=True, num_outputs=2, mutate={1: 1}, aux_mutate=True,
           visible_out=lambda attrs: [0])
 def _identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
                                    penalty=0.001, momentum=0.9,

@@ -183,7 +183,8 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
 # ---------------------------------------------------------------------------
 @register("BatchNorm", input_names=("data", "gamma", "beta", "moving_mean",
                                     "moving_var"),
-          train_aware=True, mutate={3: 3, 4: 4}, num_outputs=5,
+          train_aware=True, mutate={3: 3, 4: 4}, aux_mutate=True,
+          num_outputs=5,
           visible_out=lambda attrs: [0, 1, 2]
           if str(attrs.get("output_mean_var", False)).lower()
           in ("true", "1") else [0])

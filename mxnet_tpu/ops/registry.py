@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import warnings
 
 import jax
 import numpy as np
@@ -82,12 +83,13 @@ class OpDef:
         "input_names",
         "cacheable",
         "visible_out",
+        "aux_mutate",
     )
 
     def __init__(self, name, fn, needs_rng=False, train_aware=False,
                  array_params=(), mutate=None, num_outputs=1, no_grad=False,
                  aliases=(), input_names=(), cacheable=True,
-                 visible_out=None):
+                 visible_out=None, aux_mutate=False):
         self.name = name
         self.fn = fn
         self.needs_rng = needs_rng
@@ -108,6 +110,12 @@ class OpDef:
         self.aliases = tuple(aliases)
         self.input_names = tuple(input_names)
         self.cacheable = cacheable
+        # aux_mutate: the mutations are running statistics (BN moving
+        # mean/var, CachedOp aux state) that are frozen in eval mode —
+        # safe to leave unwritten when the op runs inside an enclosing
+        # trace.  False means mutation IS the op's contract (optimizer
+        # updates): dropping it would silently no-op, so raise instead.
+        self.aux_mutate = aux_mutate
         # optional callable attrs -> list of symbol-visible output indices
         # (reference FNumVisibleOutputs, e.g. BatchNorm shows 1 output
         # unless output_mean_var)
@@ -346,12 +354,29 @@ def get_op(name):
     return OPS[name]
 
 
-def list_ops(distinct=True):
+#: names present when the builtin op corpus finished importing (set by
+#: ``ops/__init__``); user registrations (Custom ops, PallasModule
+#: kernels) land in OPS but not here
+BUILTIN_OPS = frozenset()      # canonical op names
+BUILTIN_NAMES = frozenset()    # every registered name incl. aliases
+
+
+def freeze_builtins():
+    global BUILTIN_OPS, BUILTIN_NAMES
+    BUILTIN_OPS = frozenset(op.name for op in OPS.values())
+    BUILTIN_NAMES = frozenset(OPS.keys())
+
+
+def list_ops(distinct=True, builtin_only=False):
     """Sorted op names: canonical distinct ops by default, every
-    registered name (aliases included) with ``distinct=False``.
+    registered name (aliases included) with ``distinct=False``;
+    ``builtin_only`` restricts to the shipped corpus (excludes Custom /
+    user-registered ops added after import).
 
     This is the source of truth for any published op count (reference:
     MXListAllOpNames, ``src/c_api/c_api_symbolic.cc``)."""
+    if builtin_only:
+        return sorted(BUILTIN_OPS if distinct else BUILTIN_NAMES)
     if distinct:
         return sorted({op.name for op in OPS.values()})
     return sorted(OPS.keys())
@@ -403,8 +428,32 @@ def _invoke_impl(op_name, ndarray_inputs, params=None, out=None):
     for i, r in enumerate(results):
         if i in mut:
             tgt = inputs[mut[i]]
-            tgt._set_data(r)
-            outputs.append(tgt)
+            if isinstance(r, jax.core.Tracer) and \
+                    not isinstance(tgt.data, jax.core.Tracer):
+                # op invoked inside an enclosing trace (e.g. under
+                # contrib.foreach/while_loop): XLA state is explicit, so
+                # a mutation cannot escape the compiled region into
+                # concrete storage — writing the tracer would poison the
+                # array for every later call.
+                if not opdef.aux_mutate:
+                    raise ValueError(
+                        "%s mutates its inputs in place, which cannot "
+                        "escape a compiled (traced) region; inside "
+                        "control-flow bodies carry the state explicitly "
+                        "and use the op's return value" % opdef.name)
+                if train:
+                    warnings.warn(
+                        "%s: aux-state update inside a compiled control-"
+                        "flow body does not write back to the concrete "
+                        "arrays; carry the state explicitly if the "
+                        "running statistics matter" % opdef.name,
+                        stacklevel=3)
+                # eval mode: aux state is frozen by definition — keep the
+                # concrete value; the traced result is caller-visible only
+                outputs.append(_wrap(r, ctx=tgt.context))
+            else:
+                tgt._set_data(r)
+                outputs.append(tgt)
         else:
             outputs.append(_wrap(r, ctx=inputs[0].context if inputs and isinstance(inputs[0], NDArray) else None))
 
@@ -418,6 +467,13 @@ def _invoke_impl(op_name, ndarray_inputs, params=None, out=None):
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o, r in zip(outs, outputs):
             if o is not None and o is not r:
+                if isinstance(r.data, jax.core.Tracer) and \
+                        not isinstance(o.data, jax.core.Tracer):
+                    raise ValueError(
+                        "out= targets a concrete NDArray from inside a "
+                        "compiled (traced) region; results cannot escape "
+                        "the trace — return them from the loop body "
+                        "instead")
                 o._set_data(r.data)
         outputs = list(outs)
 

@@ -207,3 +207,57 @@ def test_foreach_in_hybrid_block():
     net.hybridize()
     y1 = net(x).asnumpy()
     np.testing.assert_allclose(y1, np.cumsum(x.asnumpy(), 0), rtol=1e-6)
+
+
+def test_stateful_block_in_foreach_does_not_leak_tracers():
+    """A BN-bearing hybridized block called inside contrib.foreach must not
+    write traced aux-state back into the Parameters' concrete storage
+    (regression: second foreach call raised UnexpectedTracerError and BN
+    running stats were poisoned for every later eager call)."""
+    import jax
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    with mx.autograd.pause(train_mode=False):
+        net(x)  # finish deferred init / first trace
+
+    mean_before = net.collect_params()
+    aux = [p for p in mean_before.values() if p.grad_req == "null"]
+    assert aux, "BatchNorm should contribute aux (running stat) params"
+    snap = [p.data().asnumpy().copy() for p in aux]
+
+    def body(_, state):
+        out = net(state)
+        return out, state + out[0, 0] * mx.nd.zeros((1,))
+
+    dummy = mx.nd.zeros((3, 1))
+    with mx.autograd.pause(train_mode=False):
+        out1, _ = mx.nd.contrib.foreach(body, dummy, x)
+        out2, _ = mx.nd.contrib.foreach(body, dummy, x)  # would leak before
+        eager = net(x)  # concrete path must still work afterwards
+
+    for p, s in zip(aux, snap):
+        d = p.data()
+        assert not isinstance(d.data, jax.core.Tracer)
+        np.testing.assert_array_equal(d.asnumpy(), s)
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(out1[0].asnumpy(), eager.asnumpy(), rtol=1e-5)
+
+
+def test_contract_mutation_in_trace_raises():
+    """Optimizer update ops mutate their inputs as their contract; inside
+    a compiled control-flow body that write cannot happen, and dropping
+    it would silently no-op the update — so it must raise."""
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.ones((3,), np.float32))
+
+    def body(_, state):
+        mx.nd.sgd_update(w, g, lr=0.1)
+        return state, state
+
+    dummy = mx.nd.zeros((2, 1))
+    with pytest.raises(ValueError, match="mutates its inputs in place"):
+        mx.nd.contrib.foreach(body, dummy, mx.nd.zeros((3,)))
