@@ -59,6 +59,7 @@ from .config import config  # noqa: F401  (mx.config = the knob registry;
 from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import elastic  # noqa: F401
+from . import benchmark  # noqa: F401
 
 # everything registered up to here is the shipped op corpus; later
 # registrations are user ops (operator.register / rtc.PallasModule)

@@ -56,3 +56,21 @@ def np_dtype(dtype):
 
 def dtype_code(dtype):
     return _DTYPE_TO_CODE[np.dtype(dtype)]
+
+
+try:  # private but stable across the jax versions we support; resolved
+    # at import so a relocation fails LOUDLY here instead of silently
+    # disabling every tracer-poisoning guard built on in_user_trace()
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError as _e:  # pragma: no cover - depends on jax version
+    raise ImportError(
+        "jax._src.core.trace_state_clean moved in this jax version; "
+        "update mxnet_tpu.base.in_user_trace for the new location "
+        "(the trace-escape guards in registry/random/SymbolBlock "
+        "depend on it): %s" % _e)
+
+
+def in_user_trace():
+    """True when user-level jax is tracing (jit/scan/grad over framework
+    calls).  Imperative caching/mutation must not capture tracers then."""
+    return not _trace_state_clean()

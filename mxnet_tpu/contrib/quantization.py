@@ -218,21 +218,62 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                                       calib_mode, num_calib_examples, ctx)
 
     # -- graph rewrite ----------------------------------------------------
-    from ..ops.registry import get_op
-
     qarg_params = dict(arg_params)
-    mapped = {}   # id(old node) -> new node
-    q_cache = {}  # entry key -> (qsym_node, min_node, max_node)
-
-    def map_entry(e):
-        node, oi = e
-        return (mapped[id(node)], oi)
 
     def const_var(name, value):
         qarg_params[name] = nd.array(np.float32(value).reshape(1))
         return Variable(name, shape=(1,))._outputs[0][0]
 
-    for node in topo:
+    def weight_entries(node, w_entry, tag, map_entry):
+        # offline-quantize the param; quantized values live under fresh
+        # `_quantize` names so an fp32 consumer sharing the original
+        # Variable (weight tying, excluded twin layer) keeps its fp32
+        # values
+        w_name = w_entry[0].name
+        qw, wmin, wmax = _quantize_weight(arg_params[w_name])
+        qw_name = w_name + "_quantize"
+        qarg_params[qw_name] = nd.array(qw)
+        qw_var = Variable(qw_name, shape=qw.shape)._outputs[0][0]
+        return [(qw_var, 0),
+                (const_var("%s_%smin" % (node.name, tag), wmin), 0),
+                (const_var("%s_%smax" % (node.name, tag), wmax), 0)]
+
+    def data_attrs(node):
+        key = _node_key(node.inputs[0][0], node.inputs[0][1])
+        if key in ranges:
+            mn, mx = ranges[key]
+            return {"min_calib_range": float(mn),
+                    "max_calib_range": float(mx)}
+        return {}
+
+    qsym = _rewrite_quantized_graph(sym, quant_nodes, data_attrs,
+                                    weight_entries)
+    logger.info("quantized %d nodes (%s calibration)",
+                len(quant_nodes), calib_mode)
+    return qsym, qarg_params, aux_params
+
+
+def _rewrite_quantized_graph(sym, quant_nodes, data_attrs, weight_entries):
+    """Shared rewrite behind ``quantize_model`` and
+    ``quantize_symbol_only``: replace each node in ``quant_nodes`` with
+    quantize_v2 -> int8 kernel -> dequantize.
+
+    ``data_attrs(node)`` supplies the activation quantize node's attrs
+    (calib ranges or empty); ``weight_entries(node, w_entry, tag,
+    map_entry)`` supplies the (qweight, min, max) graph entries for one
+    weight input — offline-quantized Variables, in-graph quantize
+    nodes, whatever the caller's mode needs.
+    """
+    from ..ops.registry import get_op
+
+    mapped = {}   # id(old node) -> new node
+    q_cache = {}  # entry key -> activation quantize node
+
+    def map_entry(e):
+        node, oi = e
+        return (mapped[id(node)], oi)
+
+    for node in sym._topo():
         if node.is_var:
             mapped[id(node)] = node
             continue
@@ -241,44 +282,21 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             key = _node_key(data_e[0], data_e[1])
             # quantize the activation input (cached across consumers)
             if key not in q_cache:
-                if key in ranges:
-                    mn, mx = ranges[key]
-                    attrs = {"min_calib_range": float(mn),
-                             "max_calib_range": float(mx)}
-                else:
-                    attrs = {}
-                qn = _Node(get_op("_contrib_quantize_v2"),
-                           node.name + "_data_quantize",
-                           [map_entry(data_e)], attrs)
-                q_cache[key] = qn
+                q_cache[key] = _Node(get_op("_contrib_quantize_v2"),
+                                     node.name + "_data_quantize",
+                                     [map_entry(data_e)],
+                                     data_attrs(node))
             qn = q_cache[key]
-            # offline-quantize the weight (and bias) params
-            # offline-quantized params live under fresh `_quantize` names
-            # so an fp32 consumer sharing the original Variable (weight
-            # tying, excluded twin layer) keeps its fp32 values
-            w_name = node.inputs[1][0].name
-            qw, wmin, wmax = _quantize_weight(arg_params[w_name])
-            qw_name = w_name + "_quantize"
-            qarg_params[qw_name] = nd.array(qw)
-            qw_var = Variable(qw_name, shape=qw.shape)._outputs[0][0]
             # input layout of the quantized ops:
             # (data, weight, min_data, max_data, min_w, max_w[, bias,
             #  min_b, max_b]) — bias group last so no_bias stays positional
-            ins = [(qn, 0), (qw_var, 0),
-                   (qn, 1), (qn, 2),
-                   (const_var(node.name + "_wmin", wmin), 0),
-                   (const_var(node.name + "_wmax", wmax), 0)]
+            w_group = weight_entries(node, node.inputs[1], "w", map_entry)
+            ins = [(qn, 0), w_group[0], (qn, 1), (qn, 2),
+                   w_group[1], w_group[2]]
             no_bias = len(node.inputs) < 3 or \
                 str(node.attrs.get("no_bias", False)) in ("True", "1")
             if not no_bias:
-                b_name = node.inputs[2][0].name
-                qb, bmin, bmax = _quantize_weight(arg_params[b_name])
-                qb_name = b_name + "_quantize"
-                qarg_params[qb_name] = nd.array(qb)
-                qb_var = Variable(qb_name, shape=qb.shape)._outputs[0][0]
-                ins += [(qb_var, 0),
-                        (const_var(node.name + "_bmin", bmin), 0),
-                        (const_var(node.name + "_bmax", bmax), 0)]
+                ins += weight_entries(node, node.inputs[2], "b", map_entry)
             qop = "_contrib_quantized_conv" if node.op.name == \
                 "Convolution" else "_contrib_quantized_fully_connected"
             attrs = dict(node.attrs)
@@ -298,8 +316,86 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             mapped[id(node)] = new
 
     replaced = {id(n) for n in quant_nodes}
-    qsym = Symbol([(mapped[id(n)], 0 if id(n) in replaced else oi)
+    return Symbol([(mapped[id(n)], 0 if id(n) in replaced else oi)
                    for n, oi in sym._outputs])
-    logger.info("quantized %d nodes (%s calibration)",
-                len(quant_nodes), calib_mode)
-    return qsym, qarg_params, aux_params
+
+
+def quantize_symbol_only(sym, excluded_names=(), offline_params=(),
+                         quantized_dtype="int8"):
+    """Graph-only quantization pass (reference MXQuantizeSymbol,
+    ``src/c_api/c_api_symbolic.cc`` -> ``quantize_graph.cc``): no
+    concrete params needed.
+
+    Weights named in ``offline_params`` are replaced by fresh
+    ``<name>_quantize`` / ``<node>_wmin`` / ``<node>_wmax`` Variables
+    whose values the caller supplies at load time (the convention
+    ``quantize_model`` fills with its returned qarg_params); other
+    weights get an in-graph ``quantize_v2`` node, so the symbol stays
+    runnable against original fp32 params.  Activation inputs get
+    uncalibrated ``quantize_v2`` nodes — attach ranges afterwards with
+    :func:`set_calib_table_to_symbol`.
+    """
+    from ..ops.registry import get_op
+
+    if quantized_dtype != "int8":
+        raise ValueError("only int8 is supported")
+    excluded = set(excluded_names)
+    offline = set(offline_params)
+
+    def _quantizable(n):
+        if n.is_var or n.op.name not in _QUANTIZABLE \
+                or n.name in excluded:
+            return False
+        return all(e[0].is_var for e in n.inputs[1:])
+
+    quant_nodes = [n for n in sym._topo() if _quantizable(n)]
+
+    def weight_entries(node, w_entry, tag, map_entry):
+        w_name = w_entry[0].name
+        if w_name in offline:
+            qv = Variable(w_name + "_quantize")._outputs[0][0]
+            mn = Variable("%s_%smin" % (node.name, tag),
+                          shape=(1,))._outputs[0][0]
+            mx_ = Variable("%s_%smax" % (node.name, tag),
+                           shape=(1,))._outputs[0][0]
+            return [(qv, 0), (mn, 0), (mx_, 0)]
+        qn = _Node(get_op("_contrib_quantize_v2"),
+                   "%s_%squantize" % (node.name, tag),
+                   [map_entry(w_entry)], {})
+        return [(qn, 0), (qn, 1), (qn, 2)]
+
+    return _rewrite_quantized_graph(sym, quant_nodes, lambda node: {},
+                                    weight_entries)
+
+
+def set_calib_table_to_symbol(qsym, table):
+    """Attach calibrated min/max ranges to a quantized symbol's
+    ``quantize_v2`` nodes (reference MXSetCalibTableToQuantizedSymbol).
+
+    ``table`` maps names to ``(min, max)``; a quantize node matches on
+    its own name or its input node's name.  Returns a new Symbol; nodes
+    with no table entry keep runtime min/max.
+    """
+    topo = qsym._topo()
+    mapped = {}
+    n_set = 0
+    for node in topo:
+        if node.is_var:
+            mapped[id(node)] = node
+            continue
+        ins = [(mapped[id(s)], oi) for s, oi in node.inputs]
+        attrs = dict(node.attrs)
+        if node.op.name == "_contrib_quantize_v2":
+            entry = table.get(node.name)
+            if entry is None and node.inputs:
+                entry = table.get(node.inputs[0][0].name)
+            if entry is not None:
+                attrs["min_calib_range"] = float(entry[0])
+                attrs["max_calib_range"] = float(entry[1])
+                n_set += 1
+        mapped[id(node)] = _Node(node.op, node.name, ins, attrs,
+                                 user_attrs=dict(node.user_attrs)
+                                 if node.user_attrs else None)
+    logging.getLogger(__name__).info(
+        "set calib ranges on %d quantize nodes", n_set)
+    return Symbol([(mapped[id(n)], oi) for n, oi in qsym._outputs])

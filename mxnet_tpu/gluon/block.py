@@ -503,6 +503,29 @@ class _ParamSubstitution:
         Parameter.list_data = list_data
 
 
+class params_as_trace_inputs:
+    """Scope for user-level jax tracing of framework calls: make
+    ``Parameter.data()`` return the given stand-in NDArrays so the
+    compiled program receives parameters as explicit inputs instead of
+    multi-hundred-MB embedded constants (which bloat the serialized HLO
+    past remote-compile request limits).  Used by
+    ``mxnet_tpu.benchmark.compiled_throughput``; the same mechanism
+    FusedTrainStep and CachedOp use internally."""
+
+    def __init__(self, params, stand_ins):
+        self._sub = _ParamSubstitution(list(params), list(stand_ins),
+                                       [], [])
+
+    def __enter__(self):
+        _trace_state.active = getattr(_trace_state, "active", 0) + 1
+        self._sub.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        self._sub.__exit__()
+        _trace_state.active -= 1
+
+
 class HybridBlock(Block):
     """A Block that can be compiled ("hybridized") into one XLA module
     (reference: gluon/block.py:671)."""
@@ -770,10 +793,14 @@ class SymbolBlock(HybridBlock):
         for name, p in self.params.items():
             (aux_dict if name in aux_names else arg_dict)[name] = p.data(ctx)
 
-        if _ag.is_recording():
-            # imperative interpretation so the tape sees every op and
-            # gradients reach this block's parameters (fine-tuning an
-            # imported model, reference SymbolBlock backward support)
+        from ..base import in_user_trace
+        if _ag.is_recording() or in_user_trace():
+            # imperative interpretation: (a) when recording, so the tape
+            # sees every op and gradients reach this block's parameters
+            # (fine-tuning an imported model, reference SymbolBlock
+            # backward support); (b) under a user-level jax trace, where
+            # binding/caching an executor would capture tracers — the
+            # node walk is pure and inlines into the enclosing trace
             env = {}
             all_feed = dict(arg_dict)
             all_feed.update(aux_dict)

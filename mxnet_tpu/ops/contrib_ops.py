@@ -224,16 +224,25 @@ def _adaptive_avg_pool(data, output_size=(1, 1)):
 @register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
 def _bilinear_resize(data, height=1, width=1, scale_height=None,
                      scale_width=None, mode="size"):
+    """Reference param surface is height/width + optional scale_height/
+    scale_width (``contrib/bilinear_resize-inl.h:50-63``); the ``mode``
+    knob is a later-MXNet addition kept for API compatibility with
+    "size" semantics only."""
     if mode != "size":
         raise NotImplementedError(
-            "BilinearResize2D mode=%r is not supported (only 'size'); "
+            "BilinearResize2D mode=%r: the reference version exposes "
+            "only the size/scale surface (bilinear_resize-inl.h:50-63); "
             "compute the target size explicitly" % mode)
     n, c, h, w = data.shape
     if scale_height is not None:
+        # truncating shape rule, matching the reference's static_cast<int>
+        # (bilinear_resize-inl.h:138-146; width uses scale_width — the
+        # reference checks scale_height.has_value() for both, a quirk we
+        # do not reproduce)
         if scale_width is None:
             scale_width = scale_height
-        height = int(round(h * scale_height))
-        width = int(round(w * scale_width))
+        height = int(h * float(scale_height))
+        width = int(w * float(scale_width))
     oh, ow = int(height), int(width)
     # align_corners=True coordinate map (reference/PyTorch convention)
     ys = jnp.linspace(0, h - 1, oh, dtype=data.dtype)

@@ -250,23 +250,25 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     (class_id, score, xmin, ymin, xmax, ymax); suppressed rows get
     class_id -1, survivors sorted by score like the reference.
 
-    Only ``background_id=0`` is supported — the reference's kernel also
-    hard-codes class row 0 as background (multibox_detection.cc:113
-    iterates j from 1) despite accepting the parameter; we fail loudly
-    instead of silently mis-scoring."""
-    if int(background_id) != 0:
-        raise NotImplementedError(
-            "MultiBoxDetection: only background_id=0 is supported (the "
-            "reference kernel hard-codes it too)")
+    ``background_id`` selects which class-probability row is background
+    (``multibox_detection-inl.h:51,62``).  The reference declares the
+    parameter but its kernel hard-codes row 0; we implement the declared
+    semantics, so non-zero background ids actually work — output class
+    ids are positions among the non-background rows (identical to the
+    reference for the default 0)."""
+    bg = int(background_id)
     anchors = anchor.reshape(-1, 4)
     N = anchors.shape[0]
     variances = tuple(float(v) for v in variances)
 
     def one_batch(cp, lp):
         # cp: [C, N]; lp: [N*4]
-        scores = cp[1:, :]                               # drop background
-        cid = jnp.argmax(scores, axis=0).astype(jnp.float32)  # [N] (0-based)
-        score = jnp.max(scores, axis=0)
+        C = cp.shape[0]
+        nonbg = (jnp.arange(C) != bg)[:, None]
+        scores_all = jnp.where(nonbg, cp, -jnp.inf)
+        row = jnp.argmax(scores_all, axis=0)             # [N] raw row
+        cid = (row - (row > bg)).astype(jnp.float32)     # 0-based class id
+        score = jnp.max(scores_all, axis=0)
         keep = score >= threshold
         cid = jnp.where(keep, cid, -1.0)
         boxes = _decode_loc(anchors, lp.reshape(N, 4), variances, clip)

@@ -37,6 +37,16 @@ def next_key():
         src[0], sub = jax.random.split(src[0])
         return sub
     k = _key_state()
+    from .base import in_user_trace
+    if in_user_trace():
+        # a random op is being traced by user-level jax (jit/scan over a
+        # framework call) with no explicit key source: splitting would
+        # store a traced key into the global chain, poisoning every
+        # later eager call.  Leave the chain untouched and derive a
+        # distinct constant-rooted key per traced call instead.
+        n = getattr(_state, "trace_folds", 0) + 1
+        _state.trace_folds = n
+        return jax.random.fold_in(k, n)
     _state.key, sub = jax.random.split(k)
     return sub
 

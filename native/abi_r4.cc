@@ -1,0 +1,493 @@
+// Round-4b ABI client: the completion planes — symbol extras (group,
+// children, grad, partial inference, print), SimpleBind/Reshape/BindX
+// executor flows, KVStore sparse/compression/server surface, NDArray
+// data/copy/sparse-format extras, the profile object ABI, the
+// quantization passes, the legacy Function registry, and runtime
+// feature introspection.  Prints ABI_R4_OK when every check passes.
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+#define CHECK_OK(call)                                             \
+  do {                                                             \
+    if ((call) != 0) {                                             \
+      std::fprintf(stderr, "FAILED %s: %s\n", #call,               \
+                   MXGetLastError());                              \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+#define EXPECT(cond)                                               \
+  do {                                                             \
+    if (!(cond)) {                                                 \
+      std::fprintf(stderr, "EXPECT failed: %s\n", #cond);          \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+#define EXPECT_FAIL(call)                                          \
+  do {                                                             \
+    if ((call) == 0) {                                             \
+      std::fprintf(stderr, "expected failure: %s\n", #call);       \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+namespace {
+
+NDArrayHandle make_filled(const std::vector<mx_uint>& shape, float v) {
+  NDArrayHandle h = nullptr;
+  if (MXNDArrayCreate(shape.data(), (mx_uint)shape.size(), 1, 0, 0, &h))
+    return nullptr;
+  size_t n = 1;
+  for (mx_uint s : shape) n *= s;
+  std::vector<float> buf(n, v);
+  if (MXNDArraySyncCopyFromCPU(h, buf.data(), n)) return nullptr;
+  return h;
+}
+
+// data -> FullyConnected(num_hidden) with auto-created weight/bias
+SymbolHandle make_fc(const char* name, const char* hidden) {
+  SymbolHandle data = nullptr;
+  if (MXSymbolCreateVariable("data", &data)) {
+    std::fprintf(stderr, "make_fc variable: %s\n", MXGetLastError());
+    return nullptr;
+  }
+  const char* pk[] = {"num_hidden"};
+  const char* pv[] = {hidden};
+  SymbolHandle fc = nullptr;
+  if (MXSymbolCreateAtomicSymbol("FullyConnected", 1, pk, pv, &fc)) {
+    std::fprintf(stderr, "make_fc atomic: %s\n", MXGetLastError());
+    return nullptr;
+  }
+  const char* ik[] = {"data"};
+  SymbolHandle ins[] = {data};
+  if (MXSymbolCompose(fc, name, 1, ik, ins)) {
+    std::fprintf(stderr, "make_fc compose: %s\n", MXGetLastError());
+    return nullptr;
+  }
+  return fc;
+}
+
+}  // namespace
+
+int main() {
+  // ---- symbol extras -------------------------------------------------
+  SymbolHandle fc = make_fc("fc1", "4");
+  EXPECT(fc != nullptr);
+
+  const char* name = nullptr;
+  int success = 0;
+  CHECK_OK(MXSymbolGetName(fc, &name, &success));
+  EXPECT(success == 1 && std::string(name) == "fc1");
+
+  SymbolHandle grp = nullptr;
+  SymbolHandle two[] = {fc, fc};
+  CHECK_OK(MXSymbolCreateGroup(2, two, &grp));
+  mx_uint n_out = 0;
+  const char** out_names = nullptr;
+  CHECK_OK(MXSymbolListOutputs(grp, &n_out, &out_names));
+  EXPECT(n_out == 2);
+
+  SymbolHandle children = nullptr;
+  CHECK_OK(MXSymbolGetChildren(fc, &children));
+  EXPECT(children != nullptr);
+  CHECK_OK(MXSymbolListOutputs(children, &n_out, &out_names));
+  EXPECT(n_out == 3);  // data, fc1_weight, fc1_bias
+
+  SymbolHandle* input_syms = nullptr;
+  int n_inputs = 0;
+  CHECK_OK(MXSymbolGetInputSymbols(fc, &input_syms, &n_inputs));
+  EXPECT(n_inputs == 3);
+
+  // partial shape inference: only data known -> weight rows known
+  const char* sk[] = {"data"};
+  mx_uint ind_ptr[] = {0, 2};
+  mx_uint sdata[] = {8, 5};
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete = -1;
+  CHECK_OK(MXSymbolInferShapePartial(fc, 1, sk, ind_ptr, sdata, &in_sz,
+                                     &in_nd, &in_sh, &out_sz, &out_nd,
+                                     &out_sh, &aux_sz, &aux_nd, &aux_sh,
+                                     &complete));
+  EXPECT(in_sz == 3 && out_sz == 1);
+  EXPECT(out_sh[0][0] == 8 && out_sh[0][1] == 4);
+
+  int tk[] = {0};  // data: float32
+  mx_uint it_sz, ot_sz, at_sz;
+  const int *it_d, *ot_d, *at_d;
+  CHECK_OK(MXSymbolInferTypePartial(fc, 1, sk, tk, &it_sz, &it_d, &ot_sz,
+                                    &ot_d, &at_sz, &at_d, &complete));
+  EXPECT(ot_sz == 1 && ot_d[0] == 0 && complete == 1);
+
+  mx_uint n_attr = 0;
+  const char** attrs = nullptr;
+  CHECK_OK(MXSymbolListAttrShallow(fc, &n_attr, &attrs));
+  // flat key/value pairs; fc has num_hidden
+  EXPECT(n_attr >= 2 && n_attr % 2 == 0);
+
+  const char* pstr = nullptr;
+  CHECK_OK(MXSymbolPrint(fc, &pstr));
+  EXPECT(std::strstr(pstr, "FullyConnected") != nullptr);
+
+  SymbolHandle* cut = nullptr;
+  int cut_n = -1;
+  CHECK_OK(MXSymbolCutSubgraph(fc, &cut, &cut_n));
+  EXPECT(cut_n == 0);
+
+  SymbolHandle gsym = nullptr;
+  const char* wrt[] = {"fc1_weight"};
+  CHECK_OK(MXSymbolGrad(fc, 1, wrt, &gsym));
+  EXPECT(gsym != nullptr);
+
+  // ---- SimpleBind / Reshape / BackwardEx / OptimizedSymbol ----------
+  mx_uint n_args = 0, n_aux = 0;
+  NDArrayHandle *arg_arr = nullptr, *grad_arr = nullptr, *aux_arr = nullptr;
+  ExecutorHandle ex = nullptr;
+  CHECK_OK(MXExecutorSimpleBind(fc, 1, 0, /*grad_req=*/1, 1, sk, ind_ptr,
+                                sdata, &n_args, &arg_arr, &grad_arr,
+                                &n_aux, &aux_arr, &ex));
+  EXPECT(n_args == 3 && n_aux == 0);
+  EXPECT(arg_arr[0] && arg_arr[1] && arg_arr[2]);
+  EXPECT(grad_arr[0] && grad_arr[1] && grad_arr[2]);
+
+  // fill data/weight/bias, forward, backward
+  {
+    size_t sizes[3] = {8 * 5, 4 * 5, 4};
+    for (int i = 0; i < 3; ++i) {
+      std::vector<float> buf(sizes[i], 0.1f);
+      CHECK_OK(MXNDArraySyncCopyFromCPU(arg_arr[i], buf.data(), sizes[i]));
+    }
+  }
+  CHECK_OK(MXExecutorForward(ex, 1));
+  mx_uint n_outs = 0;
+  NDArrayHandle* outs = nullptr;
+  CHECK_OK(MXExecutorOutputs(ex, &n_outs, &outs));
+  EXPECT(n_outs == 1);
+  NDArrayHandle og = make_filled({8, 4}, 1.0f);
+  NDArrayHandle ogs[] = {og};
+  CHECK_OK(MXExecutorBackwardEx(ex, 1, ogs, 1));
+  {
+    std::vector<float> g(4 * 5, 0.f);
+    CHECK_OK(MXNDArraySyncCopyToCPU(grad_arr[1], g.data(), g.size()));
+    // dW = og^T x = 8 rows of 0.1 summed -> 0.8 each
+    EXPECT(std::fabs(g[0] - 0.8f) < 1e-4);
+  }
+
+  const char* exstr = nullptr;
+  CHECK_OK(MXExecutorPrint(ex, &exstr));
+  EXPECT(std::strlen(exstr) > 0);
+
+  SymbolHandle opt = nullptr;
+  CHECK_OK(MXExecutorGetOptimizedSymbol(ex, &opt));
+  EXPECT(opt != nullptr);
+
+  mx_uint rs_ind[] = {0, 2};
+  mx_uint rs_data[] = {16, 5};
+  mx_uint rn_args = 0, rn_aux = 0;
+  NDArrayHandle *r_args = nullptr, *r_grads = nullptr, *r_aux = nullptr;
+  ExecutorHandle ex2 = nullptr;
+  CHECK_OK(MXExecutorReshape(0, 1, ex, 1, sk, rs_ind, rs_data, &rn_args,
+                             &r_args, &r_grads, &rn_aux, &r_aux, &ex2));
+  EXPECT(rn_args == 3);
+  {
+    mx_uint nd2 = 0;
+    const mx_uint* d2 = nullptr;
+    CHECK_OK(MXNDArrayGetShape(r_args[0], &nd2, &d2));
+    EXPECT(nd2 == 2 && d2[0] == 16 && d2[1] == 5);
+  }
+
+  // ---- BindX (empty group2ctx map == plain bind) ---------------------
+  {
+    NDArrayHandle bx_args[3];
+    bx_args[0] = make_filled({8, 5}, 0.5f);
+    bx_args[1] = make_filled({4, 5}, 0.5f);
+    bx_args[2] = make_filled({4}, 0.0f);
+    NDArrayHandle bx_grads[3] = {nullptr, nullptr, nullptr};
+    mx_uint reqs[3] = {0, 0, 0};
+    ExecutorHandle bex = nullptr;
+    CHECK_OK(MXExecutorBindX(fc, 1, 0, 0, nullptr, nullptr, nullptr, 3,
+                             bx_args, bx_grads, reqs, 0, nullptr, &bex));
+    CHECK_OK(MXExecutorForward(bex, 0));
+    CHECK_OK(MXExecutorFree(bex));
+    for (auto h : bx_args) CHECK_OK(MXNDArrayFree(h));
+  }
+
+  // ---- NDArray extras ------------------------------------------------
+  NDArrayHandle d1 = make_filled({2, 3}, 3.5f);
+  void* pdata = nullptr;
+  CHECK_OK(MXNDArrayGetData(d1, &pdata));
+  EXPECT(pdata && static_cast<float*>(pdata)[0] == 3.5f);
+  CHECK_OK(MXNDArrayWaitToWrite(d1));
+
+  NDArrayHandle d2 = make_filled({2, 3}, 0.0f);
+  CHECK_OK(MXNDArraySyncCopyFromNDArray(d2, d1, -1));
+  {
+    std::vector<float> buf(6, 0.f);
+    CHECK_OK(MXNDArraySyncCopyToCPU(d2, buf.data(), 6));
+    EXPECT(buf[5] == 3.5f);
+  }
+  NDArrayHandle d3 = make_filled({3}, 0.0f);
+  CHECK_OK(MXNDArraySyncCopyFromNDArray(d3, d1, 1));  // row 1
+
+  // save -> read file -> LoadFromBuffer round trip
+  {
+    NDArrayHandle pair[] = {d1, d2};
+    const char* keys[] = {"alpha", "beta"};
+    CHECK_OK(MXNDArraySave("/tmp/abi_r4_save.params", 2, pair, keys));
+    FILE* f = std::fopen("/tmp/abi_r4_save.params", "rb");
+    EXPECT(f != nullptr);
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    std::vector<char> blob(sz);
+    EXPECT(std::fread(blob.data(), 1, sz, f) == (size_t)sz);
+    std::fclose(f);
+    mx_uint n_loaded = 0, n_names = 0;
+    NDArrayHandle* loaded = nullptr;
+    const char** lnames = nullptr;
+    CHECK_OK(MXNDArrayLoadFromBuffer(blob.data(), (size_t)sz, &n_loaded,
+                                     &loaded, &n_names, &lnames));
+    EXPECT(n_loaded == 2 && n_names == 2);
+    EXPECT(std::string(lnames[0]) == "alpha");
+    std::vector<float> buf(6, 0.f);
+    CHECK_OK(MXNDArraySyncCopyToCPU(loaded[0], buf.data(), 6));
+    EXPECT(buf[0] == 3.5f);
+    for (mx_uint i = 0; i < n_loaded; ++i) CHECK_OK(MXNDArrayFree(loaded[i]));
+  }
+
+  // sparse create + format check; shared-mem must fail descriptively
+  {
+    mx_uint shp[] = {6, 4};
+    int aux_t[] = {6};
+    mx_uint aux_nd2[] = {1};
+    mx_uint aux_shp[] = {0};
+    NDArrayHandle rsp = nullptr;
+    CHECK_OK(MXNDArrayCreateSparseEx(1, shp, 2, 1, 0, 0, 0, 1, aux_t,
+                                     aux_nd2, aux_shp, &rsp));
+    int st = -1;
+    CHECK_OK(MXNDArrayGetStorageType(rsp, &st));
+    EXPECT(st == 2);  // row_sparse code
+    CHECK_OK(MXNDArraySyncCheckFormat(rsp, 1));
+    int pid = 0, sid = 0;
+    EXPECT_FAIL(MXNDArrayGetSharedMemHandle(rsp, &pid, &sid));
+    EXPECT(std::strlen(MXGetLastError()) > 0);
+    CHECK_OK(MXNDArrayFree(rsp));
+  }
+
+  // ---- KVStore extras ------------------------------------------------
+  {
+    KVStoreHandle kv = nullptr;
+    CHECK_OK(MXKVStoreCreate("local", &kv));
+    const char* kkeys[] = {"w0"};
+    NDArrayHandle init_v[] = {make_filled({4}, 1.0f)};
+    CHECK_OK(MXKVStoreInitEx(kv, 1, kkeys, init_v));
+    NDArrayHandle pull_v[] = {make_filled({4}, 0.0f)};
+    CHECK_OK(MXKVStorePullWithSparseEx(kv, 1, kkeys, pull_v, 0, 1));
+    {
+      std::vector<float> buf(4, 0.f);
+      CHECK_OK(MXNDArraySyncCopyToCPU(pull_v[0], buf.data(), 4));
+      EXPECT(buf[0] == 1.0f);
+    }
+    const char* ck[] = {"type", "threshold"};
+    const char* cv[] = {"2bit", "0.5"};
+    CHECK_OK(MXKVStoreSetGradientCompression(kv, 2, ck, cv));
+    EXPECT_FAIL(MXKVStoreRunServer(kv, nullptr, nullptr));  // local store
+    CHECK_OK(MXKVStoreSetBarrierBeforeExit(kv, 1));
+    int dead = -1;
+    CHECK_OK(MXKVStoreGetNumDeadNode(kv, -1, &dead));
+    EXPECT(dead == 0);
+    const char* ek[] = {"MXTPU_ABI_R4_TEST_ENV"};
+    const char* ev[] = {"1"};
+    CHECK_OK(MXInitPSEnv(1, ek, ev));
+    CHECK_OK(MXNDArrayFree(init_v[0]));
+    CHECK_OK(MXNDArrayFree(pull_v[0]));
+    CHECK_OK(MXKVStoreFree(kv));
+  }
+
+  // ---- autograd extras ----------------------------------------------
+  {
+    NDArrayHandle w = make_filled({3}, 2.0f);
+    NDArrayHandle g = make_filled({3}, 0.0f);
+    NDArrayHandle vars[] = {w};
+    NDArrayHandle grads[] = {g};
+    CHECK_OK(MXAutogradMarkVariables(1, vars, grads));
+    CHECK_OK(MXAutogradSetIsRecording(1, nullptr));
+    NDArrayHandle sq = nullptr;
+    {
+      int n_out2 = 1;
+      NDArrayHandle* outp = nullptr;
+      NDArrayHandle ins[] = {w, w};
+      CHECK_OK(MXImperativeInvoke("elemwise_mul", 2, ins, &n_out2, &outp,
+                                  0, nullptr, nullptr));
+      sq = outp[0];
+    }
+    CHECK_OK(MXAutogradSetIsRecording(0, nullptr));
+    CHECK_OK(MXAutogradComputeGradient(1, &sq));
+    {
+      std::vector<float> buf(3, 0.f);
+      CHECK_OK(MXNDArraySyncCopyToCPU(g, buf.data(), 3));
+      EXPECT(std::fabs(buf[0] - 4.0f) < 1e-5);  // d(w*w)/dw = 2w
+    }
+    SymbolHandle as = nullptr;
+    EXPECT_FAIL(MXAutogradGetSymbol(sq, &as));
+    CHECK_OK(MXNDArrayFree(sq));
+    CHECK_OK(MXNDArrayFree(w));
+    CHECK_OK(MXNDArrayFree(g));
+  }
+
+  // ---- data-iter extras ----------------------------------------------
+  {
+    FILE* f = std::fopen("/tmp/abi_r4_data.csv", "w");
+    EXPECT(f != nullptr);
+    for (int i = 0; i < 8; ++i) std::fprintf(f, "%d.0,%d.0\n", i, i + 1);
+    std::fclose(f);
+    const char* dk[] = {"data_csv", "data_shape", "batch_size"};
+    const char* dv[] = {"/tmp/abi_r4_data.csv", "(2,)", "4"};
+    DataIterHandle it = nullptr;
+    CHECK_OK(MXDataIterCreateIter("CSVIter", 3, dk, dv, &it));
+    int has = 0;
+    CHECK_OK(MXDataIterNext(it, &has));
+    EXPECT(has == 1);
+    uint64_t* idx = nullptr;
+    uint64_t idx_n = 0;
+    CHECK_OK(MXDataIterGetIndex(it, &idx, &idx_n));
+    EXPECT(idx_n == 4 && idx[0] == 0);
+    CHECK_OK(MXDataIterFree(it));
+
+    const char* iname = nullptr;
+    const char* idesc = nullptr;
+    mx_uint inarg = 0;
+    const char **inames, **itypes, **idescs;
+    CHECK_OK(MXDataIterGetIterInfo("CSVIter", &iname, &idesc, &inarg,
+                                   &inames, &itypes, &idescs));
+    EXPECT(std::string(iname) == "CSVIter" && inarg > 0);
+  }
+
+  // ---- profile object ABI --------------------------------------------
+  {
+    ProfileHandle dom = nullptr, task = nullptr, frame = nullptr,
+                  event = nullptr, counter = nullptr;
+    CHECK_OK(MXProfileCreateDomain("abi_r4", &dom));
+    CHECK_OK(MXProfileCreateTask(dom, "t", &task));
+    CHECK_OK(MXProfileCreateFrame(dom, "f", &frame));
+    CHECK_OK(MXProfileCreateEvent("e", &event));
+    CHECK_OK(MXProfileCreateCounter(dom, "c", &counter));
+    CHECK_OK(MXProfileDurationStart(task));
+    CHECK_OK(MXProfileDurationStop(task));
+    CHECK_OK(MXProfileDurationStart(event));
+    CHECK_OK(MXProfileDurationStop(event));
+    CHECK_OK(MXProfileSetCounter(counter, 7));
+    CHECK_OK(MXProfileAdjustCounter(counter, -2));
+    CHECK_OK(MXProfileSetMarker(dom, "mark", "process"));
+    CHECK_OK(MXProfileDestroyHandle(task));
+    CHECK_OK(MXProfileDestroyHandle(frame));
+    CHECK_OK(MXProfileDestroyHandle(event));
+    CHECK_OK(MXProfileDestroyHandle(counter));
+    CHECK_OK(MXProfileDestroyHandle(dom));
+  }
+
+  // ---- quantization ABI ----------------------------------------------
+  {
+    SymbolHandle qsym = nullptr;
+    CHECK_OK(MXQuantizeSymbol(fc, &qsym, 0, nullptr, 0, nullptr, "int8"));
+    mx_uint qn = 0;
+    const char** qargs = nullptr;
+    CHECK_OK(MXSymbolListArguments(qsym, &qn, &qargs));
+    bool has_q = false;
+    for (mx_uint i = 0; i < qn; ++i)
+      if (std::strstr(qargs[i], "weight")) has_q = true;
+    EXPECT(has_q);
+    const char* layer = "fc1_data_quantize";
+    float mn = -1.0f, mx2 = 1.0f;
+    SymbolHandle qsym2 = nullptr;
+    CHECK_OK(MXSetCalibTableToQuantizedSymbol(qsym, 1, &layer, &mn, &mx2,
+                                              &qsym2));
+    EXPECT(qsym2 != nullptr);
+    SymbolHandle backend_sym = nullptr;
+    CHECK_OK(MXGenBackendSubgraph(fc, "MKLDNN", &backend_sym));
+    CHECK_OK(MXSymbolFree(qsym));
+    CHECK_OK(MXSymbolFree(qsym2));
+    CHECK_OK(MXSymbolFree(backend_sym));
+  }
+
+  // ---- legacy Function registry --------------------------------------
+  {
+    mx_uint nf = 0;
+    FunctionHandle* funcs = nullptr;
+    CHECK_OK(MXListFunctions(&nf, &funcs));
+    EXPECT(nf > 250);
+    FunctionHandle relu = nullptr;
+    CHECK_OK(MXGetFunction("relu", &relu));
+    const char *fname, *fdesc, *rtype;
+    mx_uint fnarg = 0;
+    const char **fargn, **fargt, **fargd;
+    CHECK_OK(MXFuncGetInfo(relu, &fname, &fdesc, &fnarg, &fargn, &fargt,
+                           &fargd, &rtype));
+    EXPECT(std::string(fname) == "relu");
+    mx_uint nuse = 0, nsc = 0, nmut = 0;
+    int mask = 0;
+    CHECK_OK(MXFuncDescribe(relu, &nuse, &nsc, &nmut, &mask));
+    EXPECT(nuse == 1 && nsc == 0 && nmut == 1);
+    NDArrayHandle in = make_filled({4}, -1.5f);
+    NDArrayHandle out = make_filled({4}, 9.0f);
+    NDArrayHandle use_vars[] = {in};
+    NDArrayHandle mut_vars[] = {out};
+    CHECK_OK(MXFuncInvoke(relu, use_vars, nullptr, mut_vars));
+    {
+      std::vector<float> buf(4, 1.f);
+      CHECK_OK(MXNDArraySyncCopyToCPU(out, buf.data(), 4));
+      EXPECT(buf[0] == 0.0f);  // relu(-1.5) == 0
+    }
+    CHECK_OK(MXNDArrayFree(in));
+    CHECK_OK(MXNDArrayFree(out));
+  }
+
+  // ---- runtime misc ---------------------------------------------------
+  {
+    const LibFeature* feats = nullptr;
+    size_t nfeat = 0;
+    CHECK_OK(MXLibInfoFeatures(&feats, &nfeat));
+    bool has_cpu = false;
+    for (size_t i = 0; i < nfeat; ++i)
+      if (std::string(feats[i].name) == "CPU" && feats[i].enabled)
+        has_cpu = true;
+    EXPECT(has_cpu);
+    CHECK_OK(MXSetNumOMPThreads(4));
+    int prev = -1;
+    CHECK_OK(MXEngineSetBulkSize(16, &prev));
+    EXPECT(prev == 0);
+    int fm = -1, tm = -1;
+    CHECK_OK(MXGetGPUMemoryInformation(0, &fm, &tm));
+    EXPECT(fm == 0 && tm == 0);
+    uint64_t fm64 = 1, tm64 = 1;
+    CHECK_OK(MXGetGPUMemoryInformation64(0, &fm64, &tm64));
+    EXPECT(fm64 == 0 && tm64 == 0);
+    void* rtc = nullptr;
+    EXPECT_FAIL(MXRtcCudaModuleCreate("", 0, nullptr, 0, nullptr, &rtc));
+    EXPECT(std::strstr(MXGetLastError(), "Pallas") != nullptr);
+  }
+
+  CHECK_OK(MXNDArrayFree(d1));
+  CHECK_OK(MXNDArrayFree(d2));
+  CHECK_OK(MXNDArrayFree(d3));
+  CHECK_OK(MXNDArrayFree(og));
+  CHECK_OK(MXExecutorFree(ex));
+  CHECK_OK(MXExecutorFree(ex2));
+  CHECK_OK(MXSymbolFree(grp));
+  CHECK_OK(MXSymbolFree(children));
+  CHECK_OK(MXSymbolFree(gsym));
+  CHECK_OK(MXSymbolFree(opt));
+  CHECK_OK(MXSymbolFree(fc));
+
+  std::printf("ABI_R4_OK\n");
+  return 0;
+}

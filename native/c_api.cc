@@ -20,6 +20,7 @@ thread_local std::string g_error;
 struct NDArrayObj {
   PyObject* array = nullptr;        // mxnet_tpu.ndarray.NDArray
   std::vector<mx_uint> shape_buf;   // backing for MXNDArrayGetShape
+  std::vector<char> host_data;      // backing for MXNDArrayGetData
 };
 
 // thread-local result buffers (reference MXAPIThreadLocalEntry pattern:
@@ -2180,6 +2181,1256 @@ int MXGetGPUCount(int* out) {
   *out = static_cast<int>(PyLong_AsLong(r));
   Py_DECREF(r);
   return 0;
+}
+
+
+/* =====================================================================
+ * Round-4 completion planes (see c_api.h) — same bridge conventions.
+ * ===================================================================== */
+
+// ---- symbol extras ---------------------------------------------------
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* lst = PyList_New(num_symbols);
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject* o = static_cast<PyHandle*>(symbols[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, lst);
+  PyObject* r = call_bridge("symbol_create_group", args);
+  if (!r) return fail_py("create group failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle sym, const char** out, int* success) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_get_name",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("get name failed");
+  // r = (name-or-None, success)
+  PyObject* name = PyTuple_GET_ITEM(r, 0);
+  *success = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  ExtTLS* e = ext_tls();
+  e->attr_value = (*success && name != Py_None) ? safe_utf8(name) : "";
+  Py_DECREF(r);
+  *out = e->attr_value.c_str();
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_get_children",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("get children failed");
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    return 0;
+  }
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle** inputs,
+                            int* input_size) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_get_input_symbols",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("get input symbols failed");
+  static thread_local std::vector<SymbolHandle> store;
+  store.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GET_ITEM(r, i);
+    Py_INCREF(s);
+    store.push_back(wrap_py(s));
+  }
+  Py_DECREF(r);
+  *inputs = store.data();
+  *input_size = static_cast<int>(n);
+  return 0;
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char** wrt,
+                 SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num_wrt, wrt));
+  PyObject* r = call_bridge("symbol_grad", args);
+  if (!r) return fail_py("symbol grad failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+    mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+    const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+    const mx_uint** out_shape_ndim, const mx_uint*** out_shape_data,
+    mx_uint* aux_shape_size, const mx_uint** aux_shape_ndim,
+    const mx_uint*** aux_shape_data, int* complete) {
+  // the shape-inference bridge is already partial (unknown -> ndim 0)
+  return MXSymbolInferShape(sym, num_args, keys, arg_ind_ptr,
+                            arg_shape_data, in_shape_size, in_shape_ndim,
+                            in_shape_data, out_shape_size, out_shape_ndim,
+                            out_shape_data, aux_shape_size, aux_shape_ndim,
+                            aux_shape_data, complete);
+}
+
+int MXSymbolInferTypePartial(SymbolHandle sym, mx_uint num_args,
+                             const char** keys, const int* arg_type_data,
+                             mx_uint* in_type_size, const int** in_type_data,
+                             mx_uint* out_type_size,
+                             const int** out_type_data,
+                             mx_uint* aux_type_size,
+                             const int** aux_type_data, int* complete) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* codes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(codes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(keys ? num_args : 0, keys));
+  PyTuple_SET_ITEM(args, 2, codes);
+  PyObject* r = call_bridge("symbol_infer_type_partial", args);
+  if (!r) return fail_py("infer type partial failed");
+  static thread_local std::vector<int> stores[3];
+  const int* outs[3];
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GET_ITEM(r, g);
+    stores[g].clear();
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      stores[g].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+    outs[g] = stores[g].data();
+  }
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  Py_DECREF(r);
+  *in_type_size = static_cast<mx_uint>(stores[0].size());
+  *in_type_data = outs[0];
+  *out_type_size = static_cast<mx_uint>(stores[1].size());
+  *out_type_data = outs[1];
+  *aux_type_size = static_cast<mx_uint>(stores[2].size());
+  *aux_type_data = outs[2];
+  return 0;
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint* out_size,
+                            const char*** out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_list_attr_shallow",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("list attr shallow failed");
+  return return_str_list(r, out_size, out);
+}
+
+int MXSymbolPrint(SymbolHandle sym, const char** out_str) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* r = call_bridge("symbol_print", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("symbol print failed");
+  ExtTLS* e = ext_tls();
+  e->attr_value = safe_utf8(r);
+  Py_DECREF(r);
+  *out_str = e->attr_value.c_str();
+  return 0;
+}
+
+int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle** inputs,
+                        int* input_size) {
+  (void)sym;
+  *inputs = nullptr;
+  *input_size = 0;  // control-flow subgraphs are explicit attributes here
+  return 0;
+}
+
+// ---- executor extras -------------------------------------------------
+
+namespace {
+
+// shared result unpacking for simple-bind/reshape: r = (executor,
+// args, grads, auxs); fills thread-local handle arrays
+int unpack_bind_result(PyObject* r, mx_uint* num_in_args,
+                       NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+                       mx_uint* num_aux_states, NDArrayHandle** aux_states,
+                       ExecutorHandle* out) {
+  static thread_local std::vector<NDArrayHandle> args_store, grads_store,
+      aux_store;
+  args_store.clear();
+  grads_store.clear();
+  aux_store.clear();
+  PyObject* ex = PyTuple_GET_ITEM(r, 0);
+  PyObject* argl = PyTuple_GET_ITEM(r, 1);
+  PyObject* gradl = PyTuple_GET_ITEM(r, 2);
+  PyObject* auxl = PyTuple_GET_ITEM(r, 3);
+  for (Py_ssize_t i = 0; i < PyList_Size(argl); ++i) {
+    PyObject* a = PyList_GET_ITEM(argl, i);
+    Py_INCREF(a);
+    args_store.push_back(wrap(a));
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(gradl); ++i) {
+    PyObject* g = PyList_GET_ITEM(gradl, i);
+    if (g == Py_None) {
+      grads_store.push_back(nullptr);
+    } else {
+      Py_INCREF(g);
+      grads_store.push_back(wrap(g));
+    }
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(auxl); ++i) {
+    PyObject* a = PyList_GET_ITEM(auxl, i);
+    Py_INCREF(a);
+    aux_store.push_back(wrap(a));
+  }
+  Py_INCREF(ex);
+  Py_DECREF(r);
+  *num_in_args = static_cast<mx_uint>(args_store.size());
+  *in_args = args_store.data();
+  *arg_grads = grads_store.data();
+  *num_aux_states = static_cast<mx_uint>(aux_store.size());
+  *aux_states = aux_store.data();
+  *out = wrap_py(ex);
+  return 0;
+}
+
+PyObject* shape_csr_args(mx_uint num, const char** names,
+                         const mx_uint* ind_ptr, const mx_uint* data,
+                         PyObject** ndims_out, PyObject** flat_out) {
+  PyObject* keys = str_list(num, names);
+  PyObject* ndims = PyList_New(num);
+  mx_uint total = num ? ind_ptr[num] : 0;
+  PyObject* flat = PyList_New(total);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SET_ITEM(ndims, i, PyLong_FromUnsignedLong(
+        ind_ptr[i + 1] - ind_ptr[i]));
+  for (mx_uint i = 0; i < total; ++i)
+    PyList_SET_ITEM(flat, i, PyLong_FromUnsignedLong(data[i]));
+  *ndims_out = ndims;
+  *flat_out = flat;
+  return keys;
+}
+
+}  // namespace
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         mx_uint grad_req_type, mx_uint num_provided_args,
+                         const char** provided_arg_shape_names,
+                         const mx_uint* provided_arg_shape_ind_ptr,
+                         const mx_uint* provided_arg_shape_data,
+                         mx_uint* num_in_args, NDArrayHandle** in_args,
+                         NDArrayHandle** arg_grads, mx_uint* num_aux_states,
+                         NDArrayHandle** aux_states, ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject *ndims, *flat;
+  PyObject* keys = shape_csr_args(num_provided_args,
+                                  provided_arg_shape_names,
+                                  provided_arg_shape_ind_ptr,
+                                  provided_arg_shape_data, &ndims, &flat);
+  PyObject* args = PyTuple_New(7);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromUnsignedLong(grad_req_type));
+  PyTuple_SET_ITEM(args, 4, keys);
+  PyTuple_SET_ITEM(args, 5, ndims);
+  PyTuple_SET_ITEM(args, 6, flat);
+  PyObject* r = call_bridge("executor_simple_bind", args);
+  if (!r) return fail_py("simple bind failed");
+  return unpack_bind_result(r, num_in_args, in_args, arg_grads,
+                            num_aux_states, aux_states, out);
+}
+
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      ExecutorHandle ex, mx_uint num_provided_args,
+                      const char** provided_arg_shape_names,
+                      const mx_uint* provided_arg_shape_ind_ptr,
+                      const mx_uint* provided_arg_shape_data,
+                      mx_uint* num_in_args, NDArrayHandle** in_args,
+                      NDArrayHandle** arg_grads, mx_uint* num_aux_states,
+                      NDArrayHandle** aux_states, ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject *ndims, *flat;
+  PyObject* keys = shape_csr_args(num_provided_args,
+                                  provided_arg_shape_names,
+                                  provided_arg_shape_ind_ptr,
+                                  provided_arg_shape_data, &ndims, &flat);
+  PyObject* args = PyTuple_New(6);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(partial_shaping));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(allow_up_sizing));
+  PyTuple_SET_ITEM(args, 3, keys);
+  PyTuple_SET_ITEM(args, 4, ndims);
+  PyTuple_SET_ITEM(args, 5, flat);
+  PyObject* r = call_bridge("executor_reshape", args);
+  if (!r) return fail_py("executor reshape failed");
+  return unpack_bind_result(r, num_in_args, in_args, arg_grads,
+                            num_aux_states, aux_states, out);
+}
+
+int MXExecutorPrint(ExecutorHandle ex, const char** out_str) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* r = call_bridge("executor_print", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("executor print failed");
+  ExtTLS* e = ext_tls();
+  e->attr_value = safe_utf8(r);
+  Py_DECREF(r);
+  *out_str = e->attr_value.c_str();
+  return 0;
+}
+
+int MXExecutorBackwardEx(ExecutorHandle ex, mx_uint len,
+                         NDArrayHandle* head_grads, int is_train) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, nd_list(len, head_grads));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(is_train));
+  PyObject* r = call_bridge("executor_backward_ex", args);
+  if (!r) return fail_py("executor backward ex failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+int bind_x_impl(SymbolHandle sym, int dev_type, int dev_id,
+                mx_uint num_map_keys, const char** map_keys,
+                const int* map_dev_types, const int* map_dev_ids,
+                mx_uint num_args, NDArrayHandle* in_args,
+                NDArrayHandle* arg_grad_store,
+                const mx_uint* grad_req_type, mx_uint aux_states_len,
+                NDArrayHandle* aux_states, ExecutorHandle shared_exec,
+                ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym);
+  PyObject* map_types = PyList_New(num_map_keys);
+  PyObject* map_ids = PyList_New(num_map_keys);
+  for (mx_uint i = 0; i < num_map_keys; ++i) {
+    PyList_SET_ITEM(map_types, i, PyLong_FromLong(map_dev_types[i]));
+    PyList_SET_ITEM(map_ids, i, PyLong_FromLong(map_dev_ids[i]));
+  }
+  PyObject* reqs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyObject* args = PyTuple_New(11);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(args, 3, str_list(num_map_keys, map_keys));
+  PyTuple_SET_ITEM(args, 4, map_types);
+  PyTuple_SET_ITEM(args, 5, map_ids);
+  PyTuple_SET_ITEM(args, 6, nd_list(num_args, in_args));
+  PyTuple_SET_ITEM(args, 7, nd_list(num_args, arg_grad_store));
+  PyTuple_SET_ITEM(args, 8, reqs);
+  PyTuple_SET_ITEM(args, 9, nd_list(aux_states_len, aux_states));
+  if (shared_exec) {
+    PyObject* se = static_cast<PyHandle*>(shared_exec)->obj;
+    Py_INCREF(se);
+    PyTuple_SET_ITEM(args, 10, se);
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(args, 10, Py_None);
+  }
+  PyObject* r = call_bridge("executor_bind_x", args);
+  if (!r) return fail_py("executor bind x failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char** map_keys,
+                    const int* map_dev_types, const int* map_dev_ids,
+                    mx_uint num_args, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store,
+                    const mx_uint* grad_req_type, mx_uint aux_states_len,
+                    NDArrayHandle* aux_states, ExecutorHandle* out) {
+  return bind_x_impl(sym, dev_type, dev_id, num_map_keys, map_keys,
+                     map_dev_types, map_dev_ids, num_args, in_args,
+                     arg_grad_store, grad_req_type, aux_states_len,
+                     aux_states, nullptr, out);
+}
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     mx_uint num_args, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store,
+                     const mx_uint* grad_req_type, mx_uint aux_states_len,
+                     NDArrayHandle* aux_states, ExecutorHandle shared_exec,
+                     ExecutorHandle* out) {
+  return bind_x_impl(sym, dev_type, dev_id, num_map_keys, map_keys,
+                     map_dev_types, map_dev_ids, num_args, in_args,
+                     arg_grad_store, grad_req_type, aux_states_len,
+                     aux_states, shared_exec, out);
+}
+
+int MXExecutorGetOptimizedSymbol(ExecutorHandle ex, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(ex);
+  PyObject* r = call_bridge("executor_optimized_symbol",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("optimized symbol failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+// ---- KVStore extras --------------------------------------------------
+
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                             const char** keys, NDArrayHandle* vals,
+                             const NDArrayHandle* row_ids, int priority) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* args = PyTuple_New(5);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num, keys));
+  PyTuple_SET_ITEM(args, 2, nd_list(num, vals));
+  PyTuple_SET_ITEM(args, 3,
+                   nd_list(num, const_cast<NDArrayHandle*>(row_ids)));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(priority));
+  PyObject* r = call_bridge("kv_pull_row_sparse_str", args);
+  if (!r) return fail_py("pull row sparse failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+int kv_pull_sparse_impl(KVStoreHandle kv, PyObject* keys,
+                        mx_uint num, NDArrayHandle* vals, int priority,
+                        unsigned char ignore_sparse) {
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* args = PyTuple_New(5);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, keys);
+  PyTuple_SET_ITEM(args, 2, nd_list(num, vals));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(priority));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(ignore_sparse));
+  PyObject* r = call_bridge("kv_pull_with_sparse", args);
+  if (!r) return fail_py("pull with sparse failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXKVStorePullWithSparse(KVStoreHandle kv, mx_uint num, const int* keys,
+                            NDArrayHandle* vals, int priority,
+                            unsigned char ignore_sparse) {
+  ensure_python();
+  Gil gil;
+  PyObject* key_list = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SET_ITEM(key_list, i, PyLong_FromLong(keys[i]));
+  return kv_pull_sparse_impl(kv, key_list, num, vals, priority,
+                             ignore_sparse);
+}
+
+int MXKVStorePullWithSparseEx(KVStoreHandle kv, mx_uint num,
+                              const char** keys, NDArrayHandle* vals,
+                              int priority, unsigned char ignore_sparse) {
+  ensure_python();
+  Gil gil;
+  return kv_pull_sparse_impl(kv, str_list(num, keys), num, vals, priority,
+                             ignore_sparse);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
+                                    const char** keys, const char** vals) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num_params, keys));
+  PyTuple_SET_ITEM(args, 2, str_list(num_params, vals));
+  PyObject* r = call_bridge("kv_set_gradient_compression", args);
+  if (!r) return fail_py("set gradient compression failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle kv, MXKVStoreServerController controller,
+                       void* controller_handle) {
+  (void)controller;
+  (void)controller_handle;  // in-process server: no controller loop to run
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_run_server", Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("run server failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv, int do_barrier) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_set_barrier_before_exit",
+                            Py_BuildValue("(Oi)", h->obj, do_barrier));
+  if (!r) return fail_py("set barrier before exit failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle kv, int node_id, int* number) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(kv);
+  PyObject* r = call_bridge("kv_num_dead_node",
+                            Py_BuildValue("(Oi)", h->obj, node_id));
+  if (!r) return fail_py("num dead node failed");
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, str_list(num_vars, keys));
+  PyTuple_SET_ITEM(args, 1, str_list(num_vars, vals));
+  PyObject* r = call_bridge("init_ps_env", args);
+  if (!r) return fail_py("init ps env failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---- NDArray extras --------------------------------------------------
+
+int MXNDArrayGetData(NDArrayHandle handle, void** out_pdata) {
+  ensure_python();
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* fn = bridge_fn("to_bytes");
+  if (!fn) return fail_py("bridge missing");
+  PyObject* bytes = PyObject_CallFunction(fn, "O", obj->array);
+  Py_DECREF(fn);
+  if (!bytes) return fail_py("data sync failed");
+  // host mirror lives on the handle, valid until the next call on it
+  obj->host_data.assign(PyBytes_AsString(bytes),
+                        PyBytes_AsString(bytes) + PyBytes_Size(bytes));
+  Py_DECREF(bytes);
+  *out_pdata = obj->host_data.data();
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, int i) {
+  ensure_python();
+  Gil gil;
+  auto* dst = static_cast<NDArrayObj*>(handle_dst);
+  auto* src = static_cast<NDArrayObj*>(const_cast<void*>(handle_src));
+  PyObject* r = call_bridge(
+      "nd_sync_copy_from_ndarray",
+      Py_BuildValue("(OOi)", dst->array, src->array, i));
+  if (!r) return fail_py("sync copy from ndarray failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoadFromBuffer(const void* ndarray_buffer, size_t size,
+                            mx_uint* out_size, NDArrayHandle** out_arr,
+                            mx_uint* out_name_size,
+                            const char*** out_names) {
+  ensure_python();
+  Gil gil;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(ndarray_buffer),
+      static_cast<Py_ssize_t>(size));
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, buf);
+  PyObject* r = call_bridge("nd_load_from_buffer", args);
+  if (!r) return fail_py("load from buffer failed");
+  // r = (arrays, names)
+  TLS* t = tls();
+  t->load_out.clear();
+  t->load_str_store.clear();
+  t->load_cstr_out.clear();
+  PyObject* arrays = PyTuple_GET_ITEM(r, 0);
+  PyObject* names = PyTuple_GET_ITEM(r, 1);
+  for (Py_ssize_t i = 0; i < PyList_Size(arrays); ++i) {
+    PyObject* a = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(a);
+    t->load_out.push_back(wrap(a));
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char* s = utf8_or_null(PyList_GET_ITEM(names, i));
+    if (!s) {
+      Py_DECREF(r);
+      return fail("non-UTF8 name in buffer");
+    }
+    t->load_str_store.push_back(s);
+  }
+  Py_DECREF(r);
+  for (auto& s : t->load_str_store)
+    t->load_cstr_out.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(t->load_out.size());
+  *out_arr = t->load_out.data();
+  *out_name_size = static_cast<mx_uint>(t->load_cstr_out.size());
+  *out_names = t->load_cstr_out.data();
+  return 0;
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const int full_check) {
+  ensure_python();
+  Gil gil;
+  auto* obj = static_cast<NDArrayObj*>(handle);
+  PyObject* r = call_bridge(
+      "nd_sync_check_format",
+      Py_BuildValue("(Oi)", obj->array, full_check));
+  if (!r) return fail_py("format check failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int* aux_type, mx_uint* aux_ndims,
+                            const mx_uint* aux_shape, NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA allocates on materialization anyway
+  ensure_python();
+  Gil gil;
+  const char* stype = storage_type == 1 ? "row_sparse"
+                      : storage_type == 2 ? "csr" : nullptr;
+  if (!stype) return fail("storage_type must be 1 (row_sparse) or 2 (csr)");
+  PyObject* shp = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* atypes = PyList_New(num_aux);
+  PyObject* andims = PyList_New(num_aux);
+  mx_uint aux_total = 0;
+  for (mx_uint i = 0; i < num_aux; ++i) {
+    PyList_SET_ITEM(atypes, i, PyLong_FromLong(aux_type ? aux_type[i] : 6));
+    PyList_SET_ITEM(andims, i,
+                    PyLong_FromUnsignedLong(aux_ndims ? aux_ndims[i] : 0));
+    aux_total += aux_ndims ? aux_ndims[i] : 0;
+  }
+  PyObject* aflat = PyList_New(aux_total);
+  for (mx_uint i = 0; i < aux_total; ++i)
+    PyList_SET_ITEM(aflat, i,
+                    PyLong_FromUnsignedLong(aux_shape ? aux_shape[i] : 0));
+  PyObject* args = PyTuple_New(8);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(stype));
+  PyTuple_SET_ITEM(args, 1, shp);
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(dtype));
+  PyTuple_SET_ITEM(args, 5, atypes);
+  PyTuple_SET_ITEM(args, 6, andims);
+  PyTuple_SET_ITEM(args, 7, aflat);
+  PyObject* r = call_bridge("nd_create_sparse", args);
+  if (!r) return fail_py("create sparse failed");
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int* shared_pid,
+                                int* shared_id) {
+  (void)handle;
+  (void)shared_pid;
+  (void)shared_id;
+  return fail(
+      "shared-memory NDArrays are a CPU-engine IPC mechanism with no TPU "
+      "analogue (device buffers are not shm-shareable; the DataLoader "
+      "uses its own IPC)");
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint* shape, mx_uint ndim,
+                                 int dtype, NDArrayHandle* out) {
+  (void)shared_pid;
+  (void)shared_id;
+  (void)shape;
+  (void)ndim;
+  (void)dtype;
+  (void)out;
+  return fail(
+      "shared-memory NDArrays are a CPU-engine IPC mechanism with no TPU "
+      "analogue (device buffers are not shm-shareable; the DataLoader "
+      "uses its own IPC)");
+}
+
+// ---- autograd / custom extras ----------------------------------------
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle* output_handles) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, nd_list(num_output, output_handles));
+  PyObject* r = call_bridge("autograd_compute_gradient", args);
+  if (!r) return fail_py("compute gradient failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle* out) {
+  (void)handle;
+  (void)out;
+  return fail(
+      "the imperative tape records jax VJPs, not Symbol graphs; trace a "
+      "HybridBlock and export it to obtain a serving graph");
+}
+
+int MXCustomOpRegister(const char* op_type, void* creator) {
+  (void)op_type;
+  (void)creator;
+  return fail(
+      "C-side custom ops are not supported; register custom operators in "
+      "Python (mx.operator.register / autograd.Function) or as Pallas "
+      "kernels (mx.rtc.PallasModule)");
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
+                           int num_outputs, NDArrayHandle* outputs,
+                           void* callbacks) {
+  (void)num_inputs;
+  (void)inputs;
+  (void)num_outputs;
+  (void)outputs;
+  (void)callbacks;
+  return fail(
+      "C-side custom autograd functions are not supported; use "
+      "mx.autograd.Function in Python");
+}
+
+// ---- data-iter extras ------------------------------------------------
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t** out_index,
+                       uint64_t* out_size) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(handle);
+  PyObject* r = call_bridge("dataiter_get_index",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("get index failed");
+  static thread_local std::vector<uint64_t> store;
+  store.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    store.push_back(PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *out_index = store.data();
+  *out_size = static_cast<uint64_t>(n);
+  return 0;
+}
+
+int MXDataIterGetIterInfo(const char* name, const char** out_name,
+                          const char** description, mx_uint* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("dataiter_get_info",
+                            Py_BuildValue("(s)", name));
+  if (!r) return fail_py("get iter info failed");
+  // r = (name, doc, names, types, descs)
+  ExtTLS* e = ext_tls();
+  e->op_name = safe_utf8(PyTuple_GET_ITEM(r, 0));
+  e->op_desc = safe_utf8(PyTuple_GET_ITEM(r, 1));
+  e->op_doc_store.clear();
+  size_t counts[3];
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GET_ITEM(r, 2 + g);
+    counts[g] = static_cast<size_t>(PyList_Size(lst));
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+      e->op_doc_store.push_back(safe_utf8(PyList_GET_ITEM(lst, i)));
+  }
+  Py_DECREF(r);
+  size_t off = 0;
+  for (int g = 0; g < 3; ++g) {
+    e->op_doc_ptrs[g].clear();
+    for (size_t i = 0; i < counts[g]; ++i)
+      e->op_doc_ptrs[g].push_back(e->op_doc_store[off + i].c_str());
+    off += counts[g];
+  }
+  *out_name = e->op_name.c_str();
+  *description = e->op_desc.c_str();
+  *num_args = static_cast<mx_uint>(counts[0]);
+  *arg_names = e->op_doc_ptrs[0].data();
+  *arg_type_infos = e->op_doc_ptrs[1].data();
+  *arg_descriptions = e->op_doc_ptrs[2].data();
+  return 0;
+}
+
+// ---- profile object ABI ----------------------------------------------
+
+namespace {
+
+int profile_create(const char* bridge_name, PyObject* args,
+                   ProfileHandle* out) {
+  PyObject* r = call_bridge(bridge_name, args);
+  if (!r) return fail_py("profile create failed");
+  *out = wrap_py(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXProfileCreateDomain(const char* domain, ProfileHandle* out) {
+  ensure_python();
+  Gil gil;
+  return profile_create("profile_create_domain",
+                        Py_BuildValue("(s)", domain), out);
+}
+
+int MXProfileCreateTask(ProfileHandle domain, const char* task_name,
+                        ProfileHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* d = static_cast<PyHandle*>(domain);
+  return profile_create("profile_create_task",
+                        Py_BuildValue("(Os)", d->obj, task_name), out);
+}
+
+int MXProfileCreateFrame(ProfileHandle domain, const char* frame_name,
+                         ProfileHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* d = static_cast<PyHandle*>(domain);
+  return profile_create("profile_create_frame",
+                        Py_BuildValue("(Os)", d->obj, frame_name), out);
+}
+
+int MXProfileCreateEvent(const char* event_name, ProfileHandle* out) {
+  ensure_python();
+  Gil gil;
+  return profile_create("profile_create_event",
+                        Py_BuildValue("(s)", event_name), out);
+}
+
+int MXProfileCreateCounter(ProfileHandle domain, const char* counter_name,
+                           ProfileHandle* out) {
+  ensure_python();
+  Gil gil;
+  auto* d = static_cast<PyHandle*>(domain);
+  return profile_create("profile_create_counter",
+                        Py_BuildValue("(Os)", d->obj, counter_name), out);
+}
+
+int MXProfileDestroyHandle(ProfileHandle frame_handle) {
+  if (!frame_handle) return 0;
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(frame_handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXProfileDurationStart(ProfileHandle duration_handle) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(duration_handle);
+  PyObject* r = call_bridge("profile_duration_start",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("duration start failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXProfileDurationStop(ProfileHandle duration_handle) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(duration_handle);
+  PyObject* r = call_bridge("profile_duration_stop",
+                            Py_BuildValue("(O)", h->obj));
+  if (!r) return fail_py("duration stop failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(counter_handle);
+  PyObject* r = call_bridge(
+      "profile_set_counter",
+      Py_BuildValue("(OK)", h->obj,
+                    static_cast<unsigned long long>(value)));
+  if (!r) return fail_py("set counter failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t value) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(counter_handle);
+  PyObject* r = call_bridge(
+      "profile_adjust_counter",
+      Py_BuildValue("(OL)", h->obj, static_cast<long long>(value)));
+  if (!r) return fail_py("adjust counter failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXProfileSetMarker(ProfileHandle domain, const char* instant_marker_name,
+                       const char* scope) {
+  ensure_python();
+  Gil gil;
+  auto* d = static_cast<PyHandle*>(domain);
+  PyObject* r = call_bridge(
+      "profile_set_marker",
+      Py_BuildValue("(Oss)", d->obj, instant_marker_name, scope));
+  if (!r) return fail_py("set marker failed");
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---- quantization ABI ------------------------------------------------
+
+int MXQuantizeSymbol(SymbolHandle sym_handle, SymbolHandle* ret_sym_handle,
+                     mx_uint num_excluded_symbols,
+                     const char** excluded_symbols, mx_uint num_offline,
+                     const char** offline_params,
+                     const char* quantized_dtype) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym_handle);
+  PyObject* args = PyTuple_New(4);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num_excluded_symbols,
+                                     excluded_symbols));
+  PyTuple_SET_ITEM(args, 2, str_list(num_offline, offline_params));
+  PyTuple_SET_ITEM(args, 3, PyUnicode_FromString(
+      quantized_dtype ? quantized_dtype : "int8"));
+  PyObject* r = call_bridge("quantize_symbol", args);
+  if (!r) return fail_py("quantize symbol failed");
+  *ret_sym_handle = wrap_py(r);
+  return 0;
+}
+
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym_handle,
+                                     mx_uint num_layers,
+                                     const char** layer_names,
+                                     const float* min_ranges,
+                                     const float* max_ranges,
+                                     SymbolHandle* ret_sym_handle) {
+  ensure_python();
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(qsym_handle);
+  PyObject* mins = PyList_New(num_layers);
+  PyObject* maxs = PyList_New(num_layers);
+  for (mx_uint i = 0; i < num_layers; ++i) {
+    PyList_SET_ITEM(mins, i, PyFloat_FromDouble(min_ranges[i]));
+    PyList_SET_ITEM(maxs, i, PyFloat_FromDouble(max_ranges[i]));
+  }
+  PyObject* args = PyTuple_New(4);
+  Py_INCREF(h->obj);
+  PyTuple_SET_ITEM(args, 0, h->obj);
+  PyTuple_SET_ITEM(args, 1, str_list(num_layers, layer_names));
+  PyTuple_SET_ITEM(args, 2, mins);
+  PyTuple_SET_ITEM(args, 3, maxs);
+  PyObject* r = call_bridge("set_calib_table", args);
+  if (!r) return fail_py("set calib table failed");
+  *ret_sym_handle = wrap_py(r);
+  return 0;
+}
+
+int MXGenBackendSubgraph(SymbolHandle sym_handle, const char* backend,
+                         SymbolHandle* ret_sym_handle) {
+  (void)backend;  // XLA fuses whole graphs internally: identity pass
+  Gil gil;
+  auto* h = static_cast<PyHandle*>(sym_handle);
+  Py_INCREF(h->obj);
+  *ret_sym_handle = wrap_py(h->obj);
+  return 0;
+}
+
+// ---- legacy Function registry ----------------------------------------
+
+namespace {
+
+// FunctionHandle = 1 + index into the sorted op-name cache (the same
+// creator cache MXSymbolListAtomicSymbolCreators fills)
+int ensure_creator_cache() {
+  ExtTLS* e = ext_tls();
+  if (!e->creator_names.empty()) return 0;
+  mx_uint n = 0;
+  AtomicSymbolCreator* unused = nullptr;
+  return MXSymbolListAtomicSymbolCreators(&n, &unused);
+}
+
+}  // namespace
+
+int MXListFunctions(mx_uint* out_size, FunctionHandle** out_array) {
+  ensure_python();
+  Gil gil;
+  if (ensure_creator_cache() != 0) return -1;
+  ExtTLS* e = ext_tls();
+  *out_size = static_cast<mx_uint>(e->creators.size());
+  *out_array = e->creators.data();
+  return 0;
+}
+
+int MXGetFunction(const char* name, FunctionHandle* out) {
+  ensure_python();
+  Gil gil;
+  if (ensure_creator_cache() != 0) return -1;
+  ExtTLS* e = ext_tls();
+  for (size_t i = 0; i < e->creator_names.size(); ++i) {
+    if (e->creator_names[i] == name) {
+      *out = e->creators[i];
+      return 0;
+    }
+  }
+  return fail(std::string("no function named ") + name);
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char** name,
+                  const char** description, mx_uint* num_args,
+                  const char*** arg_names, const char*** arg_type_infos,
+                  const char*** arg_descriptions,
+                  const char** return_type) {
+  const char* key_var = nullptr;
+  return MXSymbolGetAtomicSymbolInfo(fun, name, description, num_args,
+                                     arg_names, arg_type_infos,
+                                     arg_descriptions, &key_var,
+                                     return_type);
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint* num_use_vars,
+                   mx_uint* num_scalars, mx_uint* num_mutate_vars,
+                   int* type_mask) {
+  ensure_python();
+  Gil gil;
+  if (ensure_creator_cache() != 0) return -1;
+  ExtTLS* e = ext_tls();
+  size_t idx = reinterpret_cast<size_t>(fun);
+  if (idx == 0 || idx > e->creator_names.size())
+    return fail("invalid function handle");
+  PyObject* r = call_bridge(
+      "func_describe",
+      Py_BuildValue("(s)", e->creator_names[idx - 1].c_str()));
+  if (!r) return fail_py("func describe failed");
+  *num_use_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 0)));
+  *num_scalars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 1)));
+  *num_mutate_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle* use_vars,
+                 float* scalar_args, NDArrayHandle* mutate_vars) {
+  return MXFuncInvokeEx(fun, use_vars, scalar_args, mutate_vars, 0,
+                        nullptr, nullptr);
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle* use_vars,
+                   float* scalar_args, NDArrayHandle* mutate_vars,
+                   int num_params, char** param_keys, char** param_vals) {
+  (void)scalar_args;  // described as 0 scalars: all params are keyworded
+  ensure_python();
+  Gil gil;
+  if (ensure_creator_cache() != 0) return -1;
+  ExtTLS* e = ext_tls();
+  size_t idx = reinterpret_cast<size_t>(fun);
+  if (idx == 0 || idx > e->creator_names.size())
+    return fail("invalid function handle");
+  const std::string& op = e->creator_names[idx - 1];
+  // describe to learn the input arity
+  mx_uint nin = 0, nsc = 0, nmut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &nin, &nsc, &nmut, &mask) != 0) return -1;
+  NDArrayHandle* out_ptr = nullptr;
+  int num_out_int = static_cast<int>(nmut ? nmut : 1);
+  // route through the modern invoke (keyworded params); write results
+  // into mutate_vars
+  int rc = MXImperativeInvoke(
+      const_cast<char*>(op.c_str()), static_cast<int>(nin), use_vars,
+      &num_out_int, &out_ptr, num_params,
+      const_cast<const char**>(param_keys),
+      const_cast<const char**>(param_vals));
+  if (rc != 0) return rc;
+  // every invoked output handle is freed exactly once, copy or not
+  int copy_rc = 0;
+  for (int i = 0; i < num_out_int; ++i) {
+    if (copy_rc == 0 && mutate_vars && mutate_vars[i])
+      copy_rc = MXNDArraySyncCopyFromNDArray(mutate_vars[i], out_ptr[i], -1);
+    MXNDArrayFree(out_ptr[i]);
+  }
+  return copy_rc;
+}
+
+// ---- runtime misc completion -----------------------------------------
+
+int MXLibInfoFeatures(const LibFeature** lib_features, size_t* size) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call_bridge("lib_features", PyTuple_New(0));
+  if (!r) return fail_py("lib features failed");
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<LibFeature> feat_store;
+  name_store.clear();
+  feat_store.clear();
+  Py_ssize_t n = PyList_Size(r);
+  name_store.reserve(n);  // no reallocation: LibFeature keeps pointers
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* pair = PyList_GET_ITEM(r, i);
+    name_store.push_back(safe_utf8(PyTuple_GET_ITEM(pair, 0)));
+    unsigned char enabled = static_cast<unsigned char>(
+        PyLong_AsLong(PyTuple_GET_ITEM(pair, 1)));
+    feat_store.push_back(LibFeature{name_store.back().c_str(), enabled});
+  }
+  Py_DECREF(r);
+  *lib_features = feat_store.data();
+  *size = feat_store.size();
+  return 0;
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  (void)thread_num;  // XLA manages host threading
+  return 0;
+}
+
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  (void)bulk_size;  // XLA's dispatch queue has no bulk-size knob
+  if (prev_bulk_size) *prev_bulk_size = 0;
+  return 0;
+}
+
+int MXGetGPUMemoryInformation(int dev, int* free_mem, int* total_mem) {
+  (void)dev;
+  if (free_mem) *free_mem = 0;
+  if (total_mem) *total_mem = 0;  // no CUDA devices in the TPU runtime
+  return 0;
+}
+
+int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
+                                uint64_t* total_mem) {
+  (void)dev;
+  if (free_mem) *free_mem = 0;
+  if (total_mem) *total_mem = 0;
+  return 0;
+}
+
+namespace {
+
+int rtc_unavailable() {
+  return fail(
+      "CUDA RTC has no TPU analogue; write user kernels in Pallas "
+      "(mxnet_tpu.rtc.PallasModule)");
+}
+
+}  // namespace
+
+int MXRtcCreate(char* name, mx_uint num_input, mx_uint num_output,
+                char** input_names, char** output_names,
+                NDArrayHandle* inputs, NDArrayHandle* outputs, char* kernel,
+                void** out) {
+  (void)name; (void)num_input; (void)num_output; (void)input_names;
+  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
+  return rtc_unavailable();
+}
+
+int MXRtcPush(void* handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle* inputs, NDArrayHandle* outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  (void)handle; (void)num_input; (void)num_output; (void)inputs;
+  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  return rtc_unavailable();
+}
+
+int MXRtcFree(void* handle) {
+  (void)handle;
+  return rtc_unavailable();
+}
+
+int MXRtcCudaModuleCreate(const char* source, int num_options,
+                          const char** options, int num_exports,
+                          const char** exports, void** out) {
+  (void)source; (void)num_options; (void)options; (void)num_exports;
+  (void)exports; (void)out;
+  return rtc_unavailable();
+}
+
+int MXRtcCudaModuleFree(void* handle) {
+  (void)handle;
+  return rtc_unavailable();
+}
+
+int MXRtcCudaKernelCreate(void* handle, const char* name, int num_args,
+                          int* is_ndarray, int* is_const, int* arg_types,
+                          void** out) {
+  (void)handle; (void)name; (void)num_args; (void)is_ndarray;
+  (void)is_const; (void)arg_types; (void)out;
+  return rtc_unavailable();
+}
+
+int MXRtcCudaKernelFree(void* handle) {
+  (void)handle;
+  return rtc_unavailable();
+}
+
+int MXRtcCudaKernelCall(void* handle, int dev_id, void** args,
+                        mx_uint grid_dim_x, mx_uint grid_dim_y,
+                        mx_uint grid_dim_z, mx_uint block_dim_x,
+                        mx_uint block_dim_y, mx_uint block_dim_z,
+                        mx_uint shared_mem) {
+  (void)handle; (void)dev_id; (void)args; (void)grid_dim_x;
+  (void)grid_dim_y; (void)grid_dim_z; (void)block_dim_x;
+  (void)block_dim_y; (void)block_dim_z; (void)shared_mem;
+  return rtc_unavailable();
 }
 
 }  // extern "C"

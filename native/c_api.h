@@ -17,6 +17,7 @@
 #define MXTPU_C_API_H_
 
 #include <stddef.h>
+#include <stdint.h>
 
 #ifdef __cplusplus
 extern "C" {
@@ -496,6 +497,294 @@ int MXRandomSeed(int seed);
 int MXRandomSeedContext(int seed, int dev_type, int dev_id);
 /* Accelerator device count (TPU chips here; the reference counts GPUs). */
 int MXGetGPUCount(int *out);
+
+/* =====================================================================
+ * Round-4 completion planes — the remainder of the reference's
+ * include/mxnet/c_api.h surface.  Same conventions throughout: 0/-1
+ * return, MXGetLastError, thread-local result buffers.
+ * ===================================================================== */
+
+/* ---- symbol extras (reference c_api_symbolic.cc) -------------------- */
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+/* *success = 0 and *out = "" when the symbol has no single name. */
+int MXSymbolGetName(SymbolHandle sym, const char **out, int *success);
+/* *out = NULL when the node has no children (a leaf variable). */
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle **inputs,
+                            int *input_size);
+/* Symbolic gradient of this symbol's outputs w.r.t. the named args. */
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+/* Same marshalling as MXSymbolInferShape; unknown entries come back
+ * with ndim 0 instead of failing. */
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys,
+                              const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete);
+/* Unknown dtypes come back as -1 instead of failing. */
+int MXSymbolInferTypePartial(SymbolHandle sym, mx_uint num_args,
+                             const char **keys, const int *arg_type_data,
+                             mx_uint *in_type_size, const int **in_type_data,
+                             mx_uint *out_type_size,
+                             const int **out_type_data,
+                             mx_uint *aux_type_size,
+                             const int **aux_type_data, int *complete);
+/* Flat [key0, val0, key1, val1, ...] of this node's own attrs. */
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolPrint(SymbolHandle sym, const char **out_str);
+/* Control-flow subgraph extraction: this framework's control-flow ops
+ * carry subgraphs explicitly, so there is never an implicit subgraph to
+ * cut; always returns *input_size = 0 (the reference's answer for
+ * graphs without subgraph markers). */
+int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle **inputs,
+                        int *input_size);
+
+/* ---- executor extras (reference c_api_executor.cc) ------------------ */
+/* Shape-driven bind: allocates arg/grad/aux arrays.  Handle arrays are
+ * thread-local (valid until the next simple-bind/reshape on this
+ * thread); grad entries are NULL under grad_req 0. */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         mx_uint grad_req_type, mx_uint num_provided_args,
+                         const char **provided_arg_shape_names,
+                         const mx_uint *provided_arg_shape_ind_ptr,
+                         const mx_uint *provided_arg_shape_data,
+                         mx_uint *num_in_args, NDArrayHandle **in_args,
+                         NDArrayHandle **arg_grads, mx_uint *num_aux_states,
+                         NDArrayHandle **aux_states, ExecutorHandle *out);
+/* Rebind to new shapes; the old executor stays valid (reference
+ * MXExecutorReshape semantics with partial_shaping/allow_up_sizing). */
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      ExecutorHandle ex, mx_uint num_provided_args,
+                      const char **provided_arg_shape_names,
+                      const mx_uint *provided_arg_shape_ind_ptr,
+                      const mx_uint *provided_arg_shape_data,
+                      mx_uint *num_in_args, NDArrayHandle **in_args,
+                      NDArrayHandle **arg_grads, mx_uint *num_aux_states,
+                      NDArrayHandle **aux_states, ExecutorHandle *out);
+int MXExecutorPrint(ExecutorHandle ex, const char **out_str);
+int MXExecutorBackwardEx(ExecutorHandle ex, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train);
+/* Bind with a group->context map; the TPU executor places group2ctx
+ * groups across the context list (model parallelism). */
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint num_args, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store,
+                    const mx_uint *grad_req_type, mx_uint aux_states_len,
+                    NDArrayHandle *aux_states, ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint num_args, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store,
+                     const mx_uint *grad_req_type, mx_uint aux_states_len,
+                     NDArrayHandle *aux_states, ExecutorHandle shared_exec,
+                     ExecutorHandle *out);
+/* Operator fusion happens inside XLA after tracing, so the symbol-level
+ * graph IS the optimized graph this ABI can expose. */
+int MXExecutorGetOptimizedSymbol(ExecutorHandle ex, SymbolHandle *out);
+
+/* ---- KVStore extras ------------------------------------------------- */
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority);
+int MXKVStorePullWithSparse(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *vals, int priority,
+                            unsigned char ignore_sparse);
+int MXKVStorePullWithSparseEx(KVStoreHandle kv, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority, unsigned char ignore_sparse);
+int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
+                                    const char **keys, const char **vals);
+/* Blocks a dist server role in the reference; the dist_async host
+ * parameter server here runs in-process, so this validates the kvstore
+ * type and returns (an error for local stores). */
+int MXKVStoreRunServer(KVStoreHandle kv, MXKVStoreServerController controller,
+                       void *controller_handle);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv, int do_barrier);
+/* Node liveness lives in elastic.py's Watchdog; the kvstore layer never
+ * declares nodes dead, so the count is always 0. */
+int MXKVStoreGetNumDeadNode(KVStoreHandle kv, int node_id, int *number);
+/* Seeds coordinator environment variables (reference ps-lite env). */
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+
+/* ---- NDArray extras ------------------------------------------------- */
+/* Host pointer to the array's data: syncs device->host into a buffer
+ * owned by the handle, valid until the next call on the same handle.
+ * Writes through the pointer do NOT propagate back to the device. */
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+/* XLA buffers are immutable; readable == writable, so this is
+ * WaitToRead (kept for ABI parity). */
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayWaitAll(void);
+/* dst = src (i == -1) or dst = src[i]; dtype-converting device copy. */
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, int i);
+/* In-memory .params parse (same dmlc format as MXNDArrayLoad). */
+int MXNDArrayLoadFromBuffer(const void *ndarray_buffer, size_t size,
+                            mx_uint *out_size, NDArrayHandle **out_arr,
+                            mx_uint *out_name_size, const char ***out_names);
+/* Validates sparse-format invariants (sorted row ids, monotone indptr);
+ * full_check also range-checks csr column indices. */
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const int full_check);
+/* Create an empty row_sparse/csr array.  num_aux/aux type/shape arrays
+ * describe the index buffers (reference layout). */
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out);
+/* Shared-memory NDArrays are a CPU-engine IPC mechanism with no TPU
+ * analogue (device buffers are not shareable via shm; the DataLoader
+ * uses its own IPC) — both fail with a descriptive error. */
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int *shared_pid,
+                                int *shared_id);
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint *shape, mx_uint ndim,
+                                 int dtype, NDArrayHandle *out);
+
+/* ---- autograd / custom extras --------------------------------------- */
+/* Deprecated reference alias for backward() over the given outputs. */
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
+/* The imperative tape does not rebuild Symbol graphs (records jax VJPs
+ * instead) — fails with a descriptive error like the reference does for
+ * unsupported graphs. */
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+/* C-side custom-op registration: the supported extension points are
+ * Python (mx.operator.register / autograd.Function) and Pallas
+ * (rtc.PallasModule); both fail with a descriptive error. */
+int MXCustomOpRegister(const char *op_type, void *creator);
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           void *callbacks);
+
+/* ---- data-iter extras ----------------------------------------------- */
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetIterInfo(const char *name, const char **out_name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+
+/* ---- profile object ABI (reference c_api_profile.cc) ---------------- */
+typedef void *ProfileHandle;
+
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out);
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out);
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out);
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out);
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out);
+int MXProfileDestroyHandle(ProfileHandle frame_handle);
+int MXProfileDurationStart(ProfileHandle duration_handle);
+int MXProfileDurationStop(ProfileHandle duration_handle);
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value);
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t value);
+int MXProfileSetMarker(ProfileHandle domain, const char *instant_marker_name,
+                       const char *scope);
+
+/* ---- quantization ABI (reference c_api_symbolic.cc) ----------------- */
+/* Graph-only int8 pass: offline params become <name>_quantize
+ * Variables, other weights quantize in-graph; attach calibration with
+ * MXSetCalibTableToQuantizedSymbol. */
+int MXQuantizeSymbol(SymbolHandle sym_handle, SymbolHandle *ret_sym_handle,
+                     mx_uint num_excluded_symbols,
+                     const char **excluded_symbols,
+                     mx_uint num_offline, const char **offline_params,
+                     const char *quantized_dtype);
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym_handle,
+                                     mx_uint num_layers,
+                                     const char **layer_names,
+                                     const float *min_ranges,
+                                     const float *max_ranges,
+                                     SymbolHandle *ret_sym_handle);
+/* Subgraph-backend pass: XLA does whole-graph fusion internally, so the
+ * pass is the identity (a fresh handle to the same graph). */
+int MXGenBackendSubgraph(SymbolHandle sym_handle, const char *backend,
+                         SymbolHandle *ret_sym_handle);
+
+/* ---- legacy Function registry (deprecated in the reference) --------- */
+typedef void *FunctionHandle;
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+/* Positional invoke: use_vars are inputs, mutate_vars receive outputs.
+ * Scalar args are not representable without names — pass them through
+ * MXImperativeInvoke instead; num_scalars must be 0 here. */
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+
+/* ---- runtime misc completion ---------------------------------------- */
+typedef struct {
+  const char *name;
+  const unsigned char enabled;
+} LibFeature;
+
+/* Build/runtime feature flags (reference MXLibInfoFeatures). */
+int MXLibInfoFeatures(const LibFeature **lib_features, size_t *size);
+/* XLA manages host threading; accepted and ignored. */
+int MXSetNumOMPThreads(int thread_num);
+/* The XLA dispatch queue has no bulk-size knob; reports previous 0. */
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size);
+/* No CUDA devices in the TPU runtime: free = total = 0. */
+int MXGetGPUMemoryInformation(int dev, int *free_mem, int *total_mem);
+int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                uint64_t *total_mem);
+/* CUDA RTC has no TPU analogue (user kernels are Pallas:
+ * mxnet_tpu.rtc.PallasModule); all fail with a descriptive error. */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                void **out);
+int MXRtcPush(void *handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(void *handle);
+int MXRtcCudaModuleCreate(const char *source, int num_options,
+                          const char **options, int num_exports,
+                          const char **exports, void **out);
+int MXRtcCudaModuleFree(void *handle);
+int MXRtcCudaKernelCreate(void *handle, const char *name, int num_args,
+                          int *is_ndarray, int *is_const, int *arg_types,
+                          void **out);
+int MXRtcCudaKernelFree(void *handle);
+int MXRtcCudaKernelCall(void *handle, int dev_id, void **args,
+                        mx_uint grid_dim_x, mx_uint grid_dim_y,
+                        mx_uint grid_dim_z, mx_uint block_dim_x,
+                        mx_uint block_dim_y, mx_uint block_dim_z,
+                        mx_uint shared_mem);
 
 #ifdef __cplusplus
 }

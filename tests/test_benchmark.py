@@ -1,0 +1,39 @@
+"""mxnet_tpu.benchmark measurement disciplines (the machinery behind
+bench.py and example/image-classification/benchmark_score.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.benchmark import compiled_throughput, percall_throughput
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm(), gluon.nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_compiled_throughput_shape_and_stability():
+    net = _net()
+    x = mx.nd.array(np.random.RandomState(0).rand(16, 8).astype(np.float32))
+    with mx.autograd.pause():
+        net(x)
+    r = compiled_throughput(net, x, steps=4, draws=3)
+    assert set(r) == {"median", "min", "max", "draws"}
+    assert 0 < r["min"] <= r["median"] <= r["max"]
+    assert r["draws"] == 3
+    # the BN-bearing net must stay usable eagerly afterwards (no leaked
+    # tracers in parameters or the RNG chain)
+    net(x).asnumpy()
+    mx.nd.random.uniform(shape=(2,)).asnumpy()
+
+
+def test_percall_throughput_runs():
+    net = _net()
+    x = mx.nd.array(np.random.RandomState(0).rand(16, 8).astype(np.float32))
+    with mx.autograd.pause():
+        net(x)
+    r = percall_throughput(net, x, steps=2, draws=2)
+    assert 0 < r["min"] <= r["median"] <= r["max"]

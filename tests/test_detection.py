@@ -359,3 +359,29 @@ def test_deformable_psroi_out_of_image_roi_finite_grads():
     assert np.allclose(y.asnumpy(), 0.0)
     assert np.isfinite(d.grad.asnumpy()).all()
     assert np.isfinite(trans.grad.asnumpy()).all()
+
+
+def test_multibox_detection_nonzero_background_id():
+    """background_id selects the background row; results must be the
+    permutation-equivalent of background_id=0 with reordered class rows
+    (the reference declares the param, multibox_detection-inl.h:51)."""
+    r = np.random.RandomState(11)
+    N, C = 6, 4  # 3 real classes + background
+    anchor = np.sort(r.uniform(0.05, 0.95, (1, N, 4)).astype(np.float32),
+                     axis=-1)
+    cls0 = r.uniform(0, 1, (1, C, N)).astype(np.float32)
+    loc = (r.uniform(-0.2, 0.2, (1, N * 4))).astype(np.float32)
+
+    out0 = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls0), mx.nd.array(loc), mx.nd.array(anchor),
+        background_id=0, nms_threshold=0.45).asnumpy()
+
+    # move background row 0 to row 2; real classes (old rows 1,2,3)
+    # become rows (0,1,3) -> their 0-based ids under bg=2 stay (0,1,2)
+    perm = [1, 2, 0, 3]
+    cls2 = cls0[:, perm, :]
+    out2 = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls2), mx.nd.array(loc), mx.nd.array(anchor),
+        background_id=2, nms_threshold=0.45).asnumpy()
+
+    np.testing.assert_allclose(out0, out2, rtol=1e-5, atol=1e-6)

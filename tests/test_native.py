@@ -316,4 +316,21 @@ def test_abi_function_count():
         with open(os.path.join(NATIVE, header)) as f:
             decls |= set(re.findall(r"^int (MX[A-Za-z0-9]+)\(",
                                     f.read(), re.M))
-    assert len(decls) >= 120, sorted(decls)
+    assert len(decls) >= 190, sorted(decls)
+
+
+def test_abi_r4_client():
+    """Round-4 completion planes from compiled C++: symbol extras
+    (group/children/grad/partial inference/print), SimpleBind/Reshape/
+    BindX, KVStore sparse+compression surface, NDArray data/copy/sparse
+    extras, profile object ABI, quantization passes, the legacy Function
+    registry, and feature introspection."""
+    r = subprocess.run(["make", "-C", NATIVE, "abi_r4"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    env = subprocess_env()
+    r = subprocess.run([os.path.join(NATIVE, "abi_r4")], env=env,
+                       cwd=NATIVE, capture_output=True, text=True,
+                       timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ABI_R4_OK" in r.stdout, r.stdout

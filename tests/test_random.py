@@ -152,3 +152,34 @@ def test_registry_random_ops_shapes(op, kw):
     out = getattr(mx.nd, op)(shape=(3, 4), **kw)
     assert out.shape == (3, 4)
     assert np.isfinite(out.asnumpy()).all()
+
+
+def test_next_key_inside_user_trace_does_not_poison_global_chain():
+    """Tracing a random-consuming framework call with user-level jax (jit,
+    fori_loop, scan) must not store a traced key into the global RNG chain
+    (regression: every eager random op after such a trace raised
+    UnexpectedTracerError)."""
+    import jax
+
+    from mxnet_tpu import random as mxrand
+
+    mx.random.seed(7)
+
+    def f(xd):
+        # dropout consumes an RNG key through registry.invoke
+        out = mx.nd.Dropout(mx.nd.NDArray(xd), p=0.5)
+        return out.data
+
+    with mx.autograd.record(train_mode=True):
+        pass  # ensure nothing funny is recorded; trace below is inference
+    r = jax.jit(f)(np.ones((4, 4), np.float32))
+    np.asarray(r)
+
+    key = mxrand._key_state()
+    assert not isinstance(key, jax.core.Tracer)
+    # eager random path still works and is reproducible from seed()
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(3,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(3,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
