@@ -75,8 +75,9 @@ def _value_info(name, shape, elem_type=TP_FLOAT):
 
 
 # ---------------------------------------------------------------------------
-# per-op converters: (node, ins, out, ctx) -> [node bytes]
-# ctx: dict with "initializers" (list), "name_of" (node->tensor name)
+# per-op converters: (node, ins, outs, ctx) -> [node bytes]
+# ``outs`` is the list of output tensor names (one per visible output);
+# ctx: dict with "initializers" (list), "param_shapes"
 # ---------------------------------------------------------------------------
 
 
@@ -90,7 +91,7 @@ def _ints(v, n=None):
     return [int(x) for x in v]
 
 
-def _conv(node, ins, out, ctx):
+def _conv(node, ins, outs, ctx):
     a = node.attrs
     kernel = _ints(a.get("kernel", ()))
     stride = _ints(a.get("stride", 1), len(kernel))
@@ -99,10 +100,10 @@ def _conv(node, ins, out, ctx):
     attrs = dict(kernel_shape=kernel, strides=stride,
                  pads=pad + pad, dilations=dilate,
                  group=int(a.get("num_group", 1)))
-    return [_node("Conv", ins, [out], node.name, **attrs)]
+    return [_node("Conv", ins, outs, node.name, **attrs)]
 
 
-def _fc(node, ins, out, ctx):
+def _fc(node, ins, outs, ctx):
     # reference exporter: Flatten + Gemm(transB=1)
     flat = node.name + "_flat"
     nodes = [_node("Flatten", [ins[0]], [flat], node.name + "_flatten",
@@ -115,7 +116,7 @@ def _fc(node, ins, out, ctx):
         ctx["initializers"].append(
             _tensor(zname, np.zeros(num_hidden, np.float32)))
         gemm_in = [flat, ins[1], zname]
-    nodes.append(_node("Gemm", gemm_in, [out], node.name,
+    nodes.append(_node("Gemm", gemm_in, outs, node.name,
                        alpha=1.0, beta=1.0, transB=1))
     return nodes
 
@@ -124,12 +125,12 @@ _ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
         "softrelu": "Softplus", "softsign": "Softsign"}
 
 
-def _activation(node, ins, out, ctx):
+def _activation(node, ins, outs, ctx):
     return [_node(_ACT[str(node.attrs.get("act_type", "relu"))],
-                  [ins[0]], [out], node.name)]
+                  [ins[0]], outs, node.name)]
 
 
-def _pooling(node, ins, out, ctx):
+def _pooling(node, ins, outs, ctx):
     a = node.attrs
     ptype = str(a.get("pool_type", "max"))
     if ptype not in ("max", "avg"):
@@ -139,7 +140,7 @@ def _pooling(node, ins, out, ctx):
     glob = str(a.get("global_pool", False)).lower() in ("true", "1")
     if glob:
         op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
-        return [_node(op, [ins[0]], [out], node.name)]
+        return [_node(op, [ins[0]], outs, node.name)]
     kernel = _ints(a.get("kernel", ()))
     stride = _ints(a.get("stride", 1), len(kernel))
     pad = _ints(a.get("pad", 0), len(kernel))
@@ -148,10 +149,10 @@ def _pooling(node, ins, out, ctx):
     if op == "AveragePool":
         attrs["count_include_pad"] = int(
             str(a.get("count_include_pad", True)).lower() in ("true", "1"))
-    return [_node(op, [ins[0]], [out], node.name, **attrs)]
+    return [_node(op, [ins[0]], outs, node.name, **attrs)]
 
 
-def _batchnorm(node, ins, out, ctx):
+def _batchnorm(node, ins, outs, ctx):
     eps = float(node.attrs.get("eps", 1e-3))
     mom = float(node.attrs.get("momentum", 0.9))
     ins = list(ins)
@@ -164,36 +165,36 @@ def _batchnorm(node, ins, out, ctx):
             ctx["initializers"].append(
                 _tensor(oname, np.ones(gamma_shape, np.float32)))
             ins[1] = oname
-    return [_node("BatchNormalization", ins, [out], node.name,
+    return [_node("BatchNormalization", ins, [outs[0]], node.name,
                   epsilon=eps, momentum=mom)]
 
 
-def _softmax_output(node, ins, out, ctx):
+def _softmax_output(node, ins, outs, ctx):
     # serving graph: drop the label input, emit Softmax over axis -1
-    return [_node("Softmax", [ins[0]], [out], node.name, axis=-1)]
+    return [_node("Softmax", [ins[0]], [outs[0]], node.name, axis=-1)]
 
 
-def _flatten(node, ins, out, ctx):
-    return [_node("Flatten", [ins[0]], [out], node.name, axis=1)]
+def _flatten(node, ins, outs, ctx):
+    return [_node("Flatten", [ins[0]], outs, node.name, axis=1)]
 
 
-def _concat(node, ins, out, ctx):
+def _concat(node, ins, outs, ctx):
     axis = int(node.attrs.get("dim", node.attrs.get("axis", 1)))
-    return [_node("Concat", ins, [out], node.name, axis=axis)]
+    return [_node("Concat", ins, outs, node.name, axis=axis)]
 
 
-def _dropout(node, ins, out, ctx):
-    return [_node("Dropout", [ins[0]], [out], node.name)]
+def _dropout(node, ins, outs, ctx):
+    return [_node("Dropout", [ins[0]], [outs[0]], node.name)]
 
 
-def _leaky(node, ins, out, ctx):
+def _leaky(node, ins, outs, ctx):
     act = str(node.attrs.get("act_type", "leaky"))
     slope = float(node.attrs.get("slope", 0.25))
     if act == "leaky":
-        return [_node("LeakyRelu", [ins[0]], [out], node.name,
+        return [_node("LeakyRelu", [ins[0]], outs, node.name,
                       alpha=slope)]
     if act == "elu":
-        return [_node("Elu", [ins[0]], [out], node.name, alpha=slope)]
+        return [_node("Elu", [ins[0]], outs, node.name, alpha=slope)]
     if act == "prelu":
         # ONNX PRelu broadcasts the slope against TRAILING dims, MXNet
         # per-channel on axis 1; without shape propagation here the 1-D
@@ -206,7 +207,7 @@ def _leaky(node, ins, out, ctx):
                               % act)
 
 
-def _reshape(node, ins, out, ctx):
+def _reshape(node, ins, outs, ctx):
     shape = _ints(node.attrs.get("shape", ()))
     if any(s < -1 for s in shape):
         # -2/-3/-4 are MXNet-only grammar; ONNX Reshape knows 0 and -1
@@ -218,13 +219,233 @@ def _reshape(node, ins, out, ctx):
     sname = node.name + "_shape"
     ctx["initializers"].append(
         _tensor(sname, np.asarray(shape, np.int64)))
-    return [_node("Reshape", [ins[0], sname], [out], node.name)]
+    return [_node("Reshape", [ins[0], sname], outs, node.name)]
 
 
 def _binop(onnx_op):
-    def conv(node, ins, out, ctx):
-        return [_node(onnx_op, ins, [out], node.name)]
+    def conv(node, ins, outs, ctx):
+        return [_node(onnx_op, ins, outs, node.name)]
     return conv
+
+
+def _unary(onnx_op):
+    def conv(node, ins, outs, ctx):
+        return [_node(onnx_op, [ins[0]], outs, node.name)]
+    return conv
+
+
+def _int64_init(ctx, name, values):
+    ctx["initializers"].append(
+        _tensor(name, np.asarray(list(values), np.int64)))
+    return name
+
+
+def _scalar_op(onnx_op, reverse=False):
+    def conv(node, ins, outs, ctx):
+        sname = node.name + "_scalar"
+        ctx["initializers"].append(_tensor(
+            sname,
+            np.float32(float(node.attrs.get("scalar", 0.0))).reshape(())))
+        inputs = [sname, ins[0]] if reverse else [ins[0], sname]
+        return [_node(onnx_op, inputs, outs, node.name)]
+    return conv
+
+
+def _transpose(node, ins, outs, ctx):
+    axes = _ints(node.attrs.get("axes", ()))
+    attrs = {"perm": axes} if axes else {}
+    return [_node("Transpose", [ins[0]], outs, node.name, **attrs)]
+
+
+def _clip(node, ins, outs, ctx):
+    # opset 13: min/max ride as tensor inputs
+    mn = float(node.attrs.get("a_min", node.attrs.get("min", 0.0)))
+    mx_ = float(node.attrs.get("a_max", node.attrs.get("max", 0.0)))
+    mname, xname = node.name + "_min", node.name + "_max"
+    ctx["initializers"].append(_tensor(mname, np.float32(mn).reshape(())))
+    ctx["initializers"].append(_tensor(xname, np.float32(mx_).reshape(())))
+    return [_node("Clip", [ins[0], mname, xname], outs, node.name)]
+
+
+def _pad(node, ins, outs, ctx):
+    import ast
+
+    pw = node.attrs.get("pad_width", ())
+    if isinstance(pw, str):
+        pw = ast.literal_eval(pw)
+    pw = [int(x) for x in pw]
+    mode = str(node.attrs.get("mode", "constant"))
+    onnx_mode = {"constant": "constant", "edge": "edge",
+                 "reflect": "reflect"}[mode]
+    # mx pad_width interleaves (b0,e0,b1,e1,...); ONNX wants all begins
+    # then all ends
+    begins, ends = pw[0::2], pw[1::2]
+    pname = _int64_init(ctx, node.name + "_pads", begins + ends)
+    inputs = [ins[0], pname]
+    if onnx_mode == "constant":
+        vname = node.name + "_cval"
+        ctx["initializers"].append(_tensor(
+            vname, np.float32(float(node.attrs.get("constant_value",
+                                                   0.0))).reshape(())))
+        inputs.append(vname)
+    return [_node("Pad", inputs, outs, node.name, mode=onnx_mode)]
+
+
+def _reduce(onnx_op, axes_as_input=False):
+    def conv(node, ins, outs, ctx):
+        import ast
+
+        ax = node.attrs.get("axis", None)
+        if isinstance(ax, str):
+            ax = ast.literal_eval(ax)
+        if isinstance(ax, (int, np.integer)):
+            ax = [int(ax)]
+        keep = int(str(node.attrs.get("keepdims", False)).lower()
+                   in ("true", "1"))
+        inputs = [ins[0]]
+        attrs = {"keepdims": keep}
+        if ax is not None:
+            if axes_as_input:  # ReduceSum moved axes to an input in 13
+                inputs.append(_int64_init(ctx, node.name + "_axes",
+                                          [int(a) for a in ax]))
+            else:
+                attrs["axes"] = [int(a) for a in ax]
+        return [_node(onnx_op, inputs, outs, node.name, **attrs)]
+    return conv
+
+
+def _squeeze_unsqueeze(onnx_op):
+    def conv(node, ins, outs, ctx):
+        import ast
+
+        ax = node.attrs.get("axis", None)
+        if isinstance(ax, str):
+            ax = ast.literal_eval(ax)
+        if isinstance(ax, (int, np.integer)):
+            ax = [int(ax)]
+        inputs = [ins[0]]
+        if ax is not None:
+            # opset 13: axes are a tensor input
+            inputs.append(_int64_init(ctx, node.name + "_axes",
+                                      [int(a) for a in ax]))
+        return [_node(onnx_op, inputs, outs, node.name)]
+    return conv
+
+
+def _slice(node, ins, outs, ctx):
+    import ast
+
+    def tup(key):
+        v = node.attrs.get(key)
+        if isinstance(v, str):
+            v = ast.literal_eval(v)
+        return v
+
+    begin, end, step = tup("begin"), tup("end"), tup("step")
+    if begin is None:
+        raise NotImplementedError("slice without begin/end attrs")
+    n = len(begin)
+    BIG = 2**31 - 1
+    starts = [0 if b is None else int(b) for b in begin]
+    ends = [BIG if e is None else int(e) for e in (end or (None,) * n)]
+    steps = [1 if s is None else int(s) for s in (step or (1,) * n)]
+    inputs = [ins[0],
+              _int64_init(ctx, node.name + "_starts", starts),
+              _int64_init(ctx, node.name + "_ends", ends),
+              _int64_init(ctx, node.name + "_axes", list(range(n))),
+              _int64_init(ctx, node.name + "_steps", steps)]
+    return [_node("Slice", inputs, outs, node.name)]
+
+
+def _split(node, ins, outs, ctx):
+    axis = int(node.attrs.get("axis", 1))
+    if str(node.attrs.get("squeeze_axis", False)).lower() in ("true",
+                                                              "1"):
+        raise NotImplementedError(
+            "ONNX export of split squeeze_axis=True (wrap outputs in "
+            "squeeze instead)")
+    return [_node("Split", [ins[0]], outs, node.name, axis=axis)]
+
+
+def _cast(node, ins, outs, ctx):
+    to = {"float32": 1, "float16": 10, "float64": 11, "uint8": 2,
+          "int8": 3, "int32": 6, "int64": 7, "bool": 9}[
+              str(node.attrs.get("dtype", "float32"))]
+    return [_node("Cast", [ins[0]], outs, node.name, to=to)]
+
+
+def _arg_reduce(onnx_op):
+    def conv(node, ins, outs, ctx):
+        axis = node.attrs.get("axis", None)
+        if axis is None:
+            raise NotImplementedError(
+                "ONNX export of %s over the flattened array (axis=None)"
+                % onnx_op)
+        keep = int(str(node.attrs.get("keepdims", False)).lower()
+                   in ("true", "1"))
+        # mx argmax returns float32; ONNX returns int64 — bridge back
+        tmp = node.name + "_i64"
+        return [_node(onnx_op, [ins[0]], [tmp], node.name,
+                      axis=int(axis), keepdims=keep),
+                _node("Cast", [tmp], outs, node.name + "_cast", to=1)]
+    return conv
+
+
+def _lrn(node, ins, outs, ctx):
+    a = node.attrs
+    return [_node("LRN", [ins[0]], outs, node.name,
+                  alpha=float(a.get("alpha", 1e-4)),
+                  beta=float(a.get("beta", 0.75)),
+                  bias=float(a.get("knorm", 2.0)),
+                  size=int(a.get("nsize", 5)))]
+
+
+def _upsampling(node, ins, outs, ctx):
+    a = node.attrs
+    if str(a.get("sample_type", "nearest")) != "nearest":
+        raise NotImplementedError(
+            "ONNX export of bilinear UpSampling (use BilinearResize2D)")
+    s = float(a.get("scale", 2))
+    rname = node.name + "_scales"
+    ctx["initializers"].append(
+        _tensor(rname, np.asarray([1.0, 1.0, s, s], np.float32)))
+    # Resize(X, roi='', scales) — nearest matches UpSampling semantics
+    return [_node("Resize", [ins[0], "", rname], outs, node.name,
+                  mode="nearest")]
+
+
+def _tile(node, ins, outs, ctx):
+    import ast
+
+    reps = node.attrs.get("reps", ())
+    if isinstance(reps, str):
+        reps = ast.literal_eval(reps)
+    rname = _int64_init(ctx, node.name + "_reps",
+                        [int(r) for r in reps])
+    return [_node("Tile", [ins[0], rname], outs, node.name)]
+
+
+def _take(node, ins, outs, ctx):
+    axis = int(node.attrs.get("axis", 0))
+    if str(node.attrs.get("mode", "clip")) == "wrap":
+        raise NotImplementedError("ONNX export of take mode='wrap'")
+    # mx take(data, indices); ONNX Gather(data, indices) — indices must
+    # be integral, mx accepts float indices: Cast first
+    iname = node.name + "_idx_i64"
+    return [_node("Cast", [ins[1]], [iname], node.name + "_cast", to=7),
+            _node("Gather", [ins[0], iname], outs, node.name,
+                  axis=axis)]
+
+
+def _embedding(node, ins, outs, ctx):
+    iname = node.name + "_idx_i64"
+    return [_node("Cast", [ins[0]], [iname], node.name + "_cast", to=7),
+            _node("Gather", [ins[1], iname], outs, node.name, axis=0)]
+
+
+def _instancenorm(node, ins, outs, ctx):
+    return [_node("InstanceNormalization", ins, outs, node.name,
+                  epsilon=float(node.attrs.get("eps", 1e-3)))]
 
 
 CONVERTERS = {
@@ -234,7 +455,7 @@ CONVERTERS = {
     "Pooling": _pooling,
     "BatchNorm": _batchnorm,
     "SoftmaxOutput": _softmax_output,
-    "softmax": lambda n, i, o, c: [_node("Softmax", [i[0]], [o], n.name,
+    "softmax": lambda n, i, o, c: [_node("Softmax", [i[0]], o, n.name,
                                          axis=int(n.attrs.get("axis",
                                                               -1)))],
     "Flatten": _flatten,
@@ -253,9 +474,58 @@ CONVERTERS = {
     "broadcast_mul": _binop("Mul"),
     "elemwise_div": _binop("Div"),
     "broadcast_div": _binop("Div"),
-    "relu": lambda n, i, o, c: [_node("Relu", [i[0]], [o], n.name)],
-    "sigmoid": lambda n, i, o, c: [_node("Sigmoid", [i[0]], [o], n.name)],
-    "tanh": lambda n, i, o, c: [_node("Tanh", [i[0]], [o], n.name)],
+    "relu": lambda n, i, o, c: [_node("Relu", [i[0]], o, n.name)],
+    "sigmoid": lambda n, i, o, c: [_node("Sigmoid", [i[0]], o, n.name)],
+    "tanh": lambda n, i, o, c: [_node("Tanh", [i[0]], o, n.name)],
+    # round-4 surface expansion
+    "_plus_scalar": _scalar_op("Add"),
+    "_minus_scalar": _scalar_op("Sub"),
+    "_rminus_scalar": _scalar_op("Sub", reverse=True),
+    "_mul_scalar": _scalar_op("Mul"),
+    "_div_scalar": _scalar_op("Div"),
+    "_rdiv_scalar": _scalar_op("Div", reverse=True),
+    "_power_scalar": _scalar_op("Pow"),
+    "_rpower_scalar": _scalar_op("Pow", reverse=True),
+    "_maximum_scalar": _scalar_op("Max"),
+    "_minimum_scalar": _scalar_op("Min"),
+    "transpose": _transpose,
+    "Pad": _pad,
+    "pad": _pad,
+    "clip": _clip,
+    "exp": _unary("Exp"),
+    "log": _unary("Log"),
+    "abs": _unary("Abs"),
+    "negative": _unary("Neg"),
+    "sqrt": _unary("Sqrt"),
+    "floor": _unary("Floor"),
+    "ceil": _unary("Ceil"),
+    "round": _unary("Round"),
+    "broadcast_power": _binop("Pow"),
+    "broadcast_maximum": _binop("Max"),
+    "broadcast_minimum": _binop("Min"),
+    "add_n": lambda n, i, o, c: [_node("Sum", i, o, n.name)],
+    "ElementWiseSum": lambda n, i, o, c: [_node("Sum", i, o, n.name)],
+    "sum": _reduce("ReduceSum", axes_as_input=True),
+    "mean": _reduce("ReduceMean"),
+    "max": _reduce("ReduceMax"),
+    "min": _reduce("ReduceMin"),
+    "prod": _reduce("ReduceProd"),
+    "squeeze": _squeeze_unsqueeze("Squeeze"),
+    "expand_dims": _squeeze_unsqueeze("Unsqueeze"),
+    "slice": _slice,
+    "SliceChannel": _split,
+    "split": _split,
+    "Cast": _cast,
+    "cast": _cast,
+    "argmax": _arg_reduce("ArgMax"),
+    "argmin": _arg_reduce("ArgMin"),
+    "LRN": _lrn,
+    "UpSampling": _upsampling,
+    "tile": _tile,
+    "take": _take,
+    "Embedding": _embedding,
+    "InstanceNorm": _instancenorm,
+    "dot": _binop("MatMul"),
 }
 
 
@@ -305,6 +575,10 @@ def export_model(sym, params, input_shape, input_type=None,
         if arg_name not in clean and id(node) not in label_vars:
             data_inputs.append(arg_name)
 
+    def out_name(n, oi):
+        base = name_of[id(n)]
+        return base if oi == 0 or n.is_var else "%s%d" % (base, oi)
+
     graph = b""
     for node in topo:
         if node.is_var:
@@ -314,9 +588,11 @@ def export_model(sym, params, input_shape, input_type=None,
         if conv is None:
             raise NotImplementedError(
                 "no ONNX converter for operator %r" % op_name)
-        ins = [name_of[id(src)] for src, _ in node.inputs
+        ins = [out_name(src, oi) for src, oi in node.inputs
                if not (src.is_var and id(src) in label_vars)]
-        nodes_bytes.extend(conv(node, ins, name_of[id(node)], ctx))
+        outs = [out_name(node, i)
+                for i in node.visible_output_indices()]
+        nodes_bytes.extend(conv(node, ins, outs, ctx))
 
     graph += b"".join(nodes_bytes)
     graph += P.f_bytes(2, "mxnet_tpu")
@@ -329,9 +605,9 @@ def export_model(sym, params, input_shape, input_type=None,
     feed = {n: tuple(s) for n, s in zip(data_inputs, shapes)}
     feed.update({n: a.shape for n, a in clean.items()})
     _, out_shapes, _ = sym.infer_shape_partial(**feed)
-    out_node, _ = sym._outputs[0]
+    out_node, out_oi = sym._outputs[0]
     graph += P.f_bytes(12, _value_info(
-        name_of[id(out_node)],
+        out_name(out_node, out_oi),
         out_shapes[0] if out_shapes and out_shapes[0] else ()))
 
     model = P.f_varint(1, 8)                     # ir_version

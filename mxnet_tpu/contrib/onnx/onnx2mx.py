@@ -163,6 +163,11 @@ def import_model(model_file):
         env[name] = v
         return v
 
+    def consumed(name):
+        # initializer consumed as a structural constant (shape, pads,
+        # axes, ...): it must not surface as a model parameter
+        arg_params.pop(name, None)
+
     for node in graph["nodes"]:
         op, a = node["op_type"], node["attrs"]
         ins = node["inputs"]
@@ -262,9 +267,172 @@ def import_model(model_file):
                   "Mul": mx.sym.broadcast_mul,
                   "Div": mx.sym.broadcast_div}[op]
             out = fn(get(ins[0]), get(ins[1]), name=name)
+        elif op == "Pow":
+            out = mx.sym.broadcast_power(get(ins[0]), get(ins[1]),
+                                         name=name)
+        elif op in ("Max", "Min") and len(ins) >= 2:
+            fn = mx.sym.broadcast_maximum if op == "Max" \
+                else mx.sym.broadcast_minimum
+            out = get(ins[0])
+            for extra in ins[1:]:
+                out = fn(out, get(extra))
+        elif op == "Sum":
+            out = get(ins[0])
+            if len(ins) > 1:
+                out = mx.sym.add_n(*[get(i) for i in ins], name=name)
+        elif op in ("Exp", "Log", "Abs", "Neg", "Sqrt", "Floor", "Ceil",
+                    "Round"):
+            fn = {"Exp": mx.sym.exp, "Log": mx.sym.log,
+                  "Abs": mx.sym.abs, "Neg": mx.sym.negative,
+                  "Sqrt": mx.sym.sqrt, "Floor": mx.sym.floor,
+                  "Ceil": mx.sym.ceil, "Round": mx.sym.round}[op]
+            out = fn(get(ins[0]), name=name)
+        elif op == "Transpose":
+            kw = {}
+            if a.get("perm"):
+                kw["axes"] = tuple(int(x) for x in a["perm"])
+            out = mx.sym.transpose(get(ins[0]), name=name, **kw)
+        elif op == "Clip":
+            if len(ins) >= 3:  # opset >= 11: min/max as tensor inputs
+                mn = float(np.asarray(inits[ins[1]]).reshape(()))
+                mx_v = float(np.asarray(inits[ins[2]]).reshape(()))
+                consumed(ins[1]), consumed(ins[2])
+            else:
+                mn = float(a.get("min", -np.inf))
+                mx_v = float(a.get("max", np.inf))
+            out = mx.sym.clip(get(ins[0]), a_min=mn, a_max=mx_v,
+                              name=name)
+        elif op == "Pad":
+            if len(ins) >= 2:  # opset >= 11: pads as tensor input
+                pads = [int(x) for x in inits[ins[1]]]
+                consumed(ins[1])
+            else:
+                pads = [int(x) for x in a.get("pads", ())]
+            half = len(pads) // 2
+            pw = []
+            for b, e in zip(pads[:half], pads[half:]):
+                pw += [b, e]
+            cval = 0.0
+            if len(ins) >= 3 and ins[2]:
+                cval = float(np.asarray(inits[ins[2]]).reshape(()))
+                consumed(ins[2])
+            mode = str(a.get("mode", "constant"))
+            out = mx.sym.pad(get(ins[0]), mode=mode,
+                             pad_width=tuple(pw), constant_value=cval,
+                             name=name)
+        elif op in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin",
+                    "ReduceProd"):
+            fn = {"ReduceSum": mx.sym.sum, "ReduceMean": mx.sym.mean,
+                  "ReduceMax": mx.sym.max, "ReduceMin": mx.sym.min,
+                  "ReduceProd": mx.sym.prod}[op]
+            if len(ins) >= 2:  # ReduceSum opset 13: axes input
+                ax = tuple(int(x) for x in inits[ins[1]])
+                consumed(ins[1])
+            else:
+                ax = tuple(int(x) for x in a.get("axes", ())) or None
+            out = fn(get(ins[0]), axis=ax,
+                     keepdims=bool(a.get("keepdims", 1)), name=name)
+        elif op in ("Squeeze", "Unsqueeze"):
+            if len(ins) >= 2:  # opset 13: axes input
+                ax = [int(x) for x in inits[ins[1]]]
+                consumed(ins[1])
+            else:
+                ax = [int(x) for x in a.get("axes", ())]
+            if op == "Squeeze":
+                out = mx.sym.squeeze(get(ins[0]),
+                                     axis=tuple(ax) if ax else None,
+                                     name=name)
+            else:
+                out = get(ins[0])
+                for axis in sorted(ax):
+                    out = mx.sym.expand_dims(out, axis=axis)
+        elif op == "Slice":
+            if len(ins) >= 3:  # opset >= 10: starts/ends[/axes/steps]
+                starts = [int(x) for x in inits[ins[1]]]
+                ends = [int(x) for x in inits[ins[2]]]
+                consumed(ins[1]), consumed(ins[2])
+                axes = list(range(len(starts)))
+                steps = [1] * len(starts)
+                if len(ins) >= 4 and ins[3]:
+                    axes = [int(x) for x in inits[ins[3]]]
+                    consumed(ins[3])
+                if len(ins) >= 5 and ins[4]:
+                    steps = [int(x) for x in inits[ins[4]]]
+                    consumed(ins[4])
+            else:
+                starts = [int(x) for x in a.get("starts", ())]
+                ends = [int(x) for x in a.get("ends", ())]
+                axes = [int(x) for x in
+                        a.get("axes", range(len(starts)))]
+                steps = [1] * len(starts)
+            out = get(ins[0])
+            for axis, b, e, st in zip(axes, starts, ends, steps):
+                if st != 1:
+                    raise NotImplementedError("Slice steps != 1")
+                e_arg = None if e >= 2**31 - 1 else e
+                out = mx.sym.slice_axis(out, axis=axis, begin=b,
+                                        end=e_arg)
+        elif op == "Split":
+            axis = int(a.get("axis", 0))
+            n_out = len(node["outputs"])
+            out = mx.sym.SliceChannel(get(ins[0]), num_outputs=n_out,
+                                      axis=axis, name=name)
+        elif op == "Cast":
+            to = {1: "float32", 2: "uint8", 3: "int8", 6: "int32",
+                  7: "int64", 9: "bool", 10: "float16",
+                  11: "float64"}[int(a.get("to", 1))]
+            out = mx.sym.cast(get(ins[0]), dtype=to, name=name)
+        elif op in ("ArgMax", "ArgMin"):
+            fn = mx.sym.argmax if op == "ArgMax" else mx.sym.argmin
+            out = fn(get(ins[0]), axis=int(a.get("axis", 0)),
+                     keepdims=bool(a.get("keepdims", 1)), name=name)
+        elif op == "Identity":
+            out = get(ins[0])
+        elif op == "Constant":
+            val = a.get("value")
+            cname = node["outputs"][0]
+            inits[cname] = np.asarray(val)
+            out = get(cname)
+        elif op == "LRN":
+            out = mx.sym.LRN(get(ins[0]),
+                             alpha=float(a.get("alpha", 1e-4)),
+                             beta=float(a.get("beta", 0.75)),
+                             knorm=float(a.get("bias", 2.0)),
+                             nsize=int(a.get("size", 5)), name=name)
+        elif op in ("Upsample", "Resize"):
+            mode = str(a.get("mode", "nearest"))
+            if "nearest" not in mode:
+                raise NotImplementedError("Resize mode %r" % mode)
+            sidx = 2 if op == "Resize" else 1
+            if len(ins) > sidx and ins[sidx]:
+                scales = [float(x) for x in inits[ins[sidx]]]
+                consumed(ins[sidx])
+            else:
+                scales = [float(x) for x in a.get("scales", ())]
+            s = int(scales[2]) if len(scales) >= 3 else 2
+            out = mx.sym.UpSampling(get(ins[0]), scale=s,
+                                    sample_type="nearest", name=name)
+        elif op == "Tile":
+            reps = tuple(int(x) for x in inits[ins[1]])
+            consumed(ins[1])
+            out = mx.sym.tile(get(ins[0]), reps=reps, name=name)
+        elif op == "Gather":
+            axis = int(a.get("axis", 0))
+            out = mx.sym.take(get(ins[0]), get(ins[1]), axis=axis,
+                              name=name)
+        elif op == "InstanceNormalization":
+            out = mx.sym.InstanceNorm(get(ins[0]), get(ins[1]),
+                                      get(ins[2]),
+                                      eps=float(a.get("epsilon", 1e-5)),
+                                      name=name)
         else:
             raise NotImplementedError("no importer for ONNX op %r" % op)
-        env[node["outputs"][0]] = out
+        if isinstance(out, mx.sym.Symbol) and len(node["outputs"]) > 1 \
+                and len(out) == len(node["outputs"]):
+            for i, oname in enumerate(node["outputs"]):
+                env[oname] = out[i]
+        else:
+            env[node["outputs"][0]] = out
 
     sym = env[graph["outputs"][0][0]]
     return sym, arg_params, aux_params

@@ -549,7 +549,9 @@ def _stack(*xs, axis=0, num_args=None):
     return jnp.stack(xs, axis=axis)
 
 
-@register("split", aliases=("SliceChannel",))
+@register("split", aliases=("SliceChannel",),
+          visible_out=lambda attrs: list(range(int(
+              attrs.get("num_outputs", 1)))))
 def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
     parts = jnp.split(x, num_outputs, axis=axis)
     if squeeze_axis:

@@ -187,3 +187,99 @@ def test_export_rejects_unsupported():
     want = np.where(Xr >= 0, Xr, 0.5 * np.expm1(Xr))
     np.testing.assert_allclose(exe.outputs[0].asnumpy(), want,
                                rtol=1e-5, atol=1e-6)
+
+
+def _roundtrip_eval(sym, params, X, tmp_path, fname):
+    """Export sym(+params) -> parse -> compare eager eval of both graphs."""
+    path = str(tmp_path / fname)
+    export_model(sym, params, [X.shape], onnx_file_path=path)
+    sym2, arg2, aux2 = import_model(path)
+
+    def run(s, args):
+        shapes = {"data": X.shape}
+        shapes.update({k: v.shape for k, v in args.items()})
+        ex = s.simple_bind(ctx=mx.cpu(), grad_req="null", **shapes)
+        ex.copy_params_from(args, {}, allow_extra_params=True)
+        return ex.forward(is_train=False, data=X)[0].asnumpy()
+
+    want = run(sym, {k: mx.nd.array(v) if isinstance(v, np.ndarray)
+                     else v for k, v in params.items()})
+    got = run(sym2, arg2)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_unary_elementwise_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.exp(mx.sym.clip(data, a_min=-2.0, a_max=2.0))
+    net = mx.sym.log(net + 1.5)
+    net = mx.sym.sqrt(mx.sym.abs(net) + 1.0) - mx.sym.negative(net)
+    net = mx.sym.floor(net * 3.0) + mx.sym.ceil(net) + mx.sym.round(net)
+    X = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    _roundtrip_eval(net + data * 0, {}, X, tmp_path, "unary.onnx")
+
+
+def test_structural_ops_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    t = mx.sym.transpose(data, axes=(0, 2, 1))
+    p = mx.sym.pad(mx.sym.reshape(t, shape=(2, 1, 6, 3)),
+                   mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                   constant_value=0.5)
+    s = mx.sym.slice(p, begin=(0, 0, 1, 0), end=(2, 1, 7, 5))
+    sq = mx.sym.squeeze(s, axis=(1,))
+    u = mx.sym.expand_dims(sq, axis=1)
+    net = mx.sym.tile(u, reps=(1, 2, 1, 1))
+    X = np.random.RandomState(1).randn(2, 3, 6).astype(np.float32)
+    _roundtrip_eval(net, {}, X, tmp_path, "structural.onnx")
+
+
+def test_reduce_ops_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.sum(data, axis=(1,), keepdims=True) \
+        + mx.sym.mean(data, axis=(2,), keepdims=True) \
+        + mx.sym.max(data, axis=(1, 2), keepdims=True) \
+        + mx.sym.min(data, axis=(1,), keepdims=True) \
+        + mx.sym.prod(mx.sym.abs(data) + 0.5, axis=(2,), keepdims=True)
+    X = np.random.RandomState(2).randn(3, 4, 5).astype(np.float32)
+    _roundtrip_eval(net, {}, X, tmp_path, "reduce.onnx")
+
+
+def test_split_cast_argmax_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1,
+                                name="split0")
+    am = mx.sym.argmax(parts[0], axis=1, keepdims=True)
+    net = mx.sym.cast(am, dtype="float32") + mx.sym.sum(
+        parts[1], axis=(1,), keepdims=True)
+    X = np.random.RandomState(3).randn(3, 4, 5).astype(np.float32)
+    _roundtrip_eval(net, {}, X, tmp_path, "split.onnx")
+
+
+def test_embedding_lrn_upsampling_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    data = mx.sym.Variable("data")
+    W = rng.randn(10, 6).astype(np.float32)
+    emb = mx.sym.Embedding(data, mx.sym.Variable("emb_w"),
+                           input_dim=10, output_dim=6, name="emb0")
+    net = mx.sym.sum(emb, axis=(2,))  # [B, T]
+    X = rng.randint(0, 10, (2, 7)).astype(np.float32)
+    _roundtrip_eval(net, {"emb_w": W}, X, tmp_path, "emb.onnx")
+
+    img = mx.sym.Variable("data")
+    net2 = mx.sym.UpSampling(mx.sym.LRN(img, nsize=3, name="lrn0"),
+                             scale=2, sample_type="nearest", name="up0")
+    X2 = rng.rand(1, 3, 5, 5).astype(np.float32)
+    _roundtrip_eval(net2, {}, X2, tmp_path, "lrnup.onnx")
+
+
+def test_matmul_pow_take_roundtrip(tmp_path):
+    rng = np.random.RandomState(5)
+    data = mx.sym.Variable("data")
+    W = rng.randn(6, 4).astype(np.float32)
+    net = mx.sym.dot(data, mx.sym.Variable("w0"))
+    net = mx.sym.broadcast_power(mx.sym.abs(net) + 1.0,
+                                 mx.sym.Variable("p0"))
+    X = rng.randn(3, 6).astype(np.float32)
+    _roundtrip_eval(net, {"w0": W,
+                          "p0": np.asarray([2.0], np.float32)},
+                    X, tmp_path, "matmul.onnx")
