@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples (reference:
+``example/adversary/adversary_generation.ipynb``): train a small
+classifier, then perturb inputs along the sign of the input gradient
+and measure the accuracy drop.
+
+Demonstrates gradients with respect to INPUTS through the autograd
+tape (``x.attach_grad()`` + ``autograd.record``), the piece the
+training loop never touches.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def make_data(rng, n, side=8, n_cls=4):
+    X = rng.uniform(0, 1, (n, 1, side, side)).astype(np.float32)
+    Y = rng.randint(0, n_cls, (n,)).astype(np.float32)
+    X += 0.8 * Y[:, None, None, None] / n_cls  # separable mean shift
+    return X, Y
+
+
+def accuracy(net, X, Y, batch=64):
+    correct = 0
+    for i in range(0, len(X), batch):
+        out = net(mx.nd.array(X[i:i + batch]))
+        correct += int((out.asnumpy().argmax(1) ==
+                        Y[i:i + batch]).sum())
+    return correct / len(X)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epsilon", type=float, default=0.15,
+                    help="L-inf perturbation budget")
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    Xtr, Ytr = make_data(rng, 512)
+    Xte, Yte = make_data(rng, 256)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        total = 0.0
+        for i in range(0, len(Xtr), 64):
+            sel = perm[i:i + 64]
+            x = mx.nd.array(Xtr[sel])
+            y = mx.nd.array(Ytr[sel])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(len(sel))
+            total += float(loss.mean().asnumpy())
+        print("epoch %d loss %.4f" % (epoch, total / (len(Xtr) // 64)),
+              flush=True)
+
+    clean_acc = accuracy(net, Xte, Yte)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    adv = []
+    for i in range(0, len(Xte), 64):
+        x = mx.nd.array(Xte[i:i + 64])
+        y = mx.nd.array(Yte[i:i + 64])
+        x.attach_grad()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        x_adv = x + args.epsilon * mx.nd.sign(x.grad)
+        adv.append(mx.nd.clip(x_adv, 0, 2).asnumpy())
+    Xadv = np.concatenate(adv, axis=0)
+    adv_acc = accuracy(net, Xadv, Yte)
+
+    print("clean accuracy: %.3f" % clean_acc, flush=True)
+    print("adversarial accuracy (eps=%.2f): %.3f"
+          % (args.epsilon, adv_acc), flush=True)
+    if adv_acc >= clean_acc:
+        raise SystemExit("FGSM failed to reduce accuracy")
+    print("FGSM_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

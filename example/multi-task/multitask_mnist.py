@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Multi-task training (reference: ``example/multi-task/example_multi_task.py``):
+one trunk, two heads (digit class + parity), a Module with TWO label
+inputs, and a custom composite metric reading both outputs.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy over (digit, parity) outputs."""
+
+    HEADS = ("digit-acc", "parity-acc")
+
+    def __init__(self):
+        super().__init__("multi-accuracy")
+
+    def reset(self):
+        self.correct = [0, 0]
+        self.total = [0, 0]
+
+    def update(self, labels, preds):
+        for i, (l, p) in enumerate(zip(labels, preds)):
+            pred = p.asnumpy().argmax(1)
+            lab = l.asnumpy().astype(int)
+            self.correct[i] += int((pred == lab).sum())
+            self.total[i] += len(lab)
+
+    def get(self):
+        return list(self.HEADS), [c / max(1, t) for c, t in
+                                  zip(self.correct, self.total)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n, side, n_cls = 512, 8, 4
+    X = rng.uniform(0, 1, (n, 1, side, side)).astype(np.float32)
+    Yd = rng.randint(0, n_cls, (n,)).astype(np.float32)
+    X += 0.8 * Yd[:, None, None, None] / n_cls
+    Yp = (Yd % 2).astype(np.float32)
+    # parity leaves its own spatial signature (top-row stripe), so both
+    # heads have learnable signal of comparable difficulty
+    X[Yp == 1, :, 0, :] += 0.6
+
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                               name="conv1")
+    trunk = mx.sym.Activation(trunk, act_type="relu")
+    trunk = mx.sym.Flatten(trunk)
+    fc_digit = mx.sym.FullyConnected(trunk, num_hidden=n_cls,
+                                     name="fc_digit")
+    fc_par = mx.sym.FullyConnected(trunk, num_hidden=2, name="fc_par")
+    head_d = mx.sym.SoftmaxOutput(fc_digit, name="digit")
+    head_p = mx.sym.SoftmaxOutput(fc_par, name="parity")
+    net = mx.sym.Group([head_d, head_p])
+
+    it = mx.io.NDArrayIter(
+        X, {"digit_label": Yd, "parity_label": Yp}, batch_size=64,
+        shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("digit_label", "parity_label"))
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric=MultiAccuracy())
+
+    it.reset()
+    metric = MultiAccuracy()
+    mod.score(it, metric)
+    names, vals_list = metric.get()
+    for nm, v in zip(names, vals_list):
+        print("%s: %.3f" % (nm, v), flush=True)
+    if min(vals_list) < 0.8:
+        raise SystemExit("multi-task training failed to converge")
+    print("MULTITASK_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Profiler walkthrough (reference: ``example/profiler/profiler_matmul.py``):
+profile a training loop, annotate phases with the object API
+(Domain/Task/Frame/Counter/Marker), dump a chrome trace and print the
+aggregate per-op table.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, profiler  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--out", type=str, default=None,
+                    help="trace json path (default: temp file)")
+    args = ap.parse_args()
+    out = args.out or os.path.join(tempfile.mkdtemp(), "profile.json")
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(256, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(64, 32).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 10, (64,)))
+
+    profiler.set_config(profile_all=True, filename=out)
+    profiler.set_state("run")
+
+    domain = profiler.ProfileDomain("train_demo")
+    frame = profiler.Frame(domain, "iteration")
+    counter = profiler.Counter(domain, "steps_done")
+
+    for i in range(args.steps):
+        with frame:
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(64)
+        counter.increment()
+    loss.wait_to_read()
+    profiler.Marker(domain, "train_done").mark("process")
+
+    print(profiler.dumps(format="table"), flush=True)
+    profiler.set_state("stop")
+    profiler.dump()
+
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    cats = {e.get("cat") for e in events}
+    n_ops = sum(1 for e in events if e.get("cat") == "operator")
+    print("trace: %s (%d events, %d operator spans, cats=%s)"
+          % (out, len(events), n_ops, sorted(c for c in cats if c)),
+          flush=True)
+    assert n_ops > 0 and "frame" in cats and "counter" in cats
+    print("PROFILER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

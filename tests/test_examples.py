@@ -248,3 +248,27 @@ def test_bi_lstm_sort():
     out = _run([os.path.join(EX, "bi-lstm-sort", "sort_io.py"),
                 "--smoke"], timeout=540)
     assert "OK" in out, out
+
+
+def test_adversary_fgsm():
+    out = _run([os.path.join(EX, "adversary", "fgsm.py"),
+                "--epochs", "4"])
+    assert "FGSM_OK" in out
+
+
+def test_numpy_ops_custom_softmax():
+    out = _run([os.path.join(EX, "numpy-ops", "custom_softmax.py"),
+                "--epochs", "6"])
+    assert "CUSTOM_OP_OK" in out
+
+
+def test_multitask():
+    out = _run([os.path.join(EX, "multi-task", "multitask_mnist.py"),
+                "--epochs", "6"])
+    assert "MULTITASK_OK" in out
+
+
+def test_profiler_demo(tmp_path):
+    out = _run([os.path.join(EX, "profiler", "profiler_demo.py"),
+                "--steps", "5", "--out", str(tmp_path / "prof.json")])
+    assert "PROFILER_OK" in out
