@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 
 build_native() {
     make -C native
-    make -C native test_client cpp_example cpp_train autograd_cpp predict_cpp abi_extras
+    make -C native test_client cpp_example cpp_train autograd_cpp predict_cpp abi_extras abi_r4
 }
 
 sanity_check() {
@@ -36,7 +36,10 @@ unittest_frontend() {
     python -m pytest tests/test_gluon.py tests/test_module.py \
         tests/test_optimizer.py tests/test_monitor_viz.py \
         tests/test_runtime_config.py tests/test_fixes_r2.py \
+        tests/test_fixes_r3.py tests/test_fixes_r4.py \
         tests/test_image.py tests/test_control_flow.py \
+        tests/test_custom_op.py tests/test_ops_r4.py \
+        tests/test_model_zoo_pretrained.py tests/test_benchmark.py \
         tests/test_io.py -q
 }
 
@@ -50,6 +53,7 @@ unittest_serving() {
     python -m pytest tests/test_predict.py tests/test_native.py \
         tests/test_quantization.py tests/test_pallas.py \
         tests/test_profiler.py tests/test_rtc.py tests/test_contrib.py \
+        tests/test_detection.py tests/test_serde_interop.py \
         tests/test_onnx.py -q
 }
 

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Low-level Module API walkthrough (reference:
+``example/module/sequential_module.py`` + ``mnist_mlp.py``): drive
+bind / init_params / init_optimizer / forward / backward / update by
+hand instead of ``fit``, checkpoint with ``save_checkpoint``, and
+resume with ``set_params`` — the under-the-hood loop every higher-level
+trainer wraps.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_net(n_cls):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=n_cls, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n, n_cls = 512, 4
+    X = rng.uniform(0, 1, (n, 16)).astype(np.float32)
+    Y = rng.randint(0, n_cls, (n,)).astype(np.float32)
+    X[np.arange(n), Y.astype(int)] += 2.0
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(make_net(n_cls), context=mx.cpu())
+
+    # the manual loop fit() wraps
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d %s" % (epoch, dict(metric.get_name_value())),
+              flush=True)
+
+    # checkpoint -> fresh module -> resume scoring
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "seqmod")
+        mod.save_checkpoint(prefix, args.epochs)
+        sym, arg, aux = mx.model.load_checkpoint(prefix, args.epochs)
+        mod2 = mx.mod.Module(sym, context=mx.cpu())
+        mod2.bind(data_shapes=it.provide_data,
+                  label_shapes=it.provide_label, for_training=False)
+        mod2.set_params(arg, aux)
+        it.reset()
+        metric2 = mx.metric.Accuracy()
+        mod2.score(it, metric2)
+        acc = dict(metric2.get_name_value())["accuracy"]
+    print("restored accuracy: %.3f" % acc, flush=True)
+    if acc < 0.9:
+        raise SystemExit("manual module loop failed to converge")
+    print("MODULE_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

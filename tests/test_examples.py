@@ -272,3 +272,15 @@ def test_profiler_demo(tmp_path):
     out = _run([os.path.join(EX, "profiler", "profiler_demo.py"),
                 "--steps", "5", "--out", str(tmp_path / "prof.json")])
     assert "PROFILER_OK" in out
+
+
+def test_module_manual_loop():
+    out = _run([os.path.join(EX, "module", "sequential_module.py"),
+                "--epochs", "6"])
+    assert "MODULE_OK" in out
+
+
+def test_tools_diagnose():
+    out = _run([os.path.join(REPO, "tools", "diagnose.py")])
+    assert "DIAGNOSE_OK" in out
+    assert "features" in out

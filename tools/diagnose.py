@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Environment diagnostic (reference: ``tools/diagnose.py`` — the
+"paste this into your issue" script).  Reports OS/hardware, Python,
+framework version + build features, device inventory, and a tiny
+compile-and-run latency probe per backend.  No network checks: the TPU
+runtime has zero egress by design.
+"""
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def section(title):
+    print("-" * 18 + " %s " % title + "-" * 18, flush=True)
+
+
+def main():
+    section("Platform")
+    print("Platform  :", platform.platform())
+    print("machine   :", platform.machine())
+    print("processor :", platform.processor() or "n/a")
+    try:
+        with open("/proc/meminfo") as f:
+            total = [l for l in f if l.startswith("MemTotal")][0].split()
+        print("memory    : %.1f GB" % (int(total[1]) / 1e6))
+    except OSError:
+        pass
+
+    section("Python")
+    print("Version   :", sys.version.replace("\n", " "))
+
+    section("Environment")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "MXTPU_", "JAX_", "XLA_")):
+            print("%s=%s" % (k, v))
+
+    section("Framework")
+    t0 = time.time()
+    import mxnet_tpu as mx
+    from mxnet_tpu import runtime
+
+    print("import mxnet_tpu: %.3fs" % (time.time() - t0))
+    print("version   : %s" % getattr(mx, "__version__", "dev"))
+    feats = runtime.Features()
+    on = sorted(n for n, f in feats.items() if f.enabled)
+    off = sorted(n for n, f in feats.items() if not f.enabled)
+    print("features  : ON  %s" % " ".join(on))
+    print("            OFF %s" % " ".join(off))
+
+    section("Devices")
+    import jax
+
+    print("backend   :", jax.default_backend())
+    for d in jax.devices():
+        print("device    :", d)
+
+    section("Compute probe")
+    import numpy as np
+
+    for ctx in ([mx.cpu()] + ([mx.tpu()] if feats["TPU"].enabled
+                              else [])):
+        x = mx.nd.array(np.random.rand(256, 256).astype(np.float32),
+                        ctx=ctx)
+        t0 = time.time()
+        y = mx.nd.dot(x, x)
+        y.wait_to_read()
+        cold = time.time() - t0
+        t0 = time.time()
+        for _ in range(10):
+            y = mx.nd.dot(y * 0 + x, x)
+        float(y[0, 0].asnumpy())
+        warm = (time.time() - t0) / 10
+        print("%s: dot(256x256) cold %.3fs warm %.4fs"
+              % (ctx, cold, warm))
+    print("DIAGNOSE_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
