@@ -160,6 +160,10 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
     model_name = _os.environ.get("BENCH_INT8_MODEL", "resnet50_v1")
     size = int(_os.environ.get("BENCH_INT8_SIZE", "224"))
     n_calib = int(_os.environ.get("BENCH_INT8_CALIB", "32"))
+    # fold conv+BN and fuse int8 chains (requantize + quantized relu /
+    # pool) — the best int8 configuration measured in docs/PERF_INT8.md;
+    # BENCH_INT8_FUSE=0 measures the reference-shaped per-layer graph
+    fuse = _os.environ.get("BENCH_INT8_FUSE", "1") != "0"
 
     rng = np.random.RandomState(0)
     net = getattr(vision, model_name)(classes=1000)
@@ -177,7 +181,7 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
             np.zeros((n_calib,)), max(1, n_calib // 2))
         qsym, qargs, qauxs = quantize_model(
             sym, args, auxs, calib_mode="naive", calib_data=calib,
-            num_calib_examples=n_calib)
+            num_calib_examples=n_calib, fold_bn=fuse, fuse_int8=fuse)
         qprefix = _os.path.join(d, "q")
         mx.model.save_checkpoint(qprefix, 0, qsym, qargs, qauxs)
         qnet = SymbolBlock.imports(qprefix + "-symbol.json", ["data"],

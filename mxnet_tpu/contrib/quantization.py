@@ -26,7 +26,9 @@ from ..symbol.symbol import Symbol, Variable, _Node
 
 _QUANTIZABLE = {"Convolution", "FullyConnected"}
 
-__all__ = ["quantize_model", "_get_optimal_threshold"]
+__all__ = ["quantize_model", "fold_bn", "fuse_int8_chains",
+           "quantize_symbol_only", "set_calib_table_to_symbol",
+           "_get_optimal_threshold"]
 
 
 def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
@@ -171,8 +173,15 @@ def _quantize_weight(w):
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    ctx=None, excluded_sym_names=(), calib_mode="entropy",
                    calib_data=None, num_calib_examples=None,
-                   quantized_dtype="int8", logger=None):
+                   quantized_dtype="int8", logger=None,
+                   fold_bn=False, fuse_int8=False):
     """Quantize a model (reference contrib/quantization.py:quantize_model).
+
+    ``fold_bn`` folds inference-mode BatchNorm into the preceding convs
+    first (see :func:`fold_bn`); ``fuse_int8`` runs the int8
+    chain-fusion peephole on the result (:func:`fuse_int8_chains`) so
+    adjacent quantized layers talk int8 instead of round-tripping
+    through fp32 — the perf path measured in docs/PERF_INT8.md.
 
     Returns ``(qsym, qarg_params, aux_params)``.
     """
@@ -181,6 +190,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         raise ValueError("only int8 is supported")
     if calib_mode not in ("none", "naive", "entropy"):
         raise ValueError("calib_mode must be none/naive/entropy")
+    if fold_bn:
+        sym, arg_params, aux_params = _fold_bn_inference(
+            sym, arg_params, aux_params)
     excluded = set(excluded_sym_names)
 
     topo = sym._topo()
@@ -248,6 +260,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     qsym = _rewrite_quantized_graph(sym, quant_nodes, data_attrs,
                                     weight_entries)
+    if fuse_int8:
+        qsym, _n = fuse_int8_chains(qsym)
     logger.info("quantized %d nodes (%s calibration)",
                 len(quant_nodes), calib_mode)
     return qsym, qarg_params, aux_params
@@ -399,3 +413,231 @@ def set_calib_table_to_symbol(qsym, table):
     logging.getLogger(__name__).info(
         "set calib ranges on %d quantize nodes", n_set)
     return Symbol([(mapped[id(n)], oi) for n, oi in qsym._outputs])
+
+
+def fold_bn(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into the preceding Convolution
+    (the standard int8 preparation pass; reference quantization flows
+    do the same so conv chains stay unbroken).
+
+    For each ``BatchNorm(conv(x, W, b), gamma, beta, mean, var)`` whose
+    conv output has no other consumer:
+    ``W' = W * s[:,None,..]``, ``b' = beta - mean*s (+ b*s)`` with
+    ``s = gamma / sqrt(var + eps)`` — exactly BN applied to the conv
+    output using the RUNNING statistics, i.e. inference semantics.
+    Returns ``(folded_sym, folded_args, remaining_auxs)``.
+    """
+    topo = sym._topo()
+    consumers = {}
+    for n in topo:
+        if n.is_var:
+            continue
+        for src, _ in n.inputs:
+            consumers[id(src)] = consumers.get(id(src), 0) + 1
+    # a node that IS a graph output has an extra (external) consumer —
+    # folding a conv that the caller also reads pre-BN would silently
+    # hand them post-BN values
+    for n, _oi in sym._outputs:
+        consumers[id(n)] = consumers.get(id(n), 0) + 1
+
+    def _attr_bool(attrs, key, default=False):
+        return str(attrs.get(key, default)).lower() in ("true", "1")
+
+    foldable = {}  # id(bn node) -> conv node
+    for n in topo:
+        if n.is_var or n.op.name != "BatchNorm":
+            continue
+        if int(n.attrs.get("axis", 1)) != 1:
+            continue
+        src = n.inputs[0][0]
+        if src.is_var or src.op.name != "Convolution":
+            continue
+        if consumers.get(id(src), 0) != 1:
+            continue  # conv output used elsewhere: cannot rewrite it
+        # all bn params must be plain Variables with known values
+        names = [e[0].name for e in n.inputs[1:]]
+        if not all(e[0].is_var for e in n.inputs[1:]):
+            continue
+        if not (names[0] in arg_params and names[1] in arg_params
+                and names[2] in aux_params and names[3] in aux_params):
+            continue
+        w_name = src.inputs[1][0].name
+        if w_name not in arg_params:
+            continue
+        foldable[id(n)] = src
+    folded_conv_ids = {id(c) for c in foldable.values()}
+
+    args = dict(arg_params)
+    auxs = dict(aux_params)
+    mapped = {}
+
+    def map_entry(e):
+        return (mapped[id(e[0])], e[1])
+
+    def _pop_if_sole(store, var_node):
+        # a param Variable shared with another node (weight tying, a
+        # sibling BN) must survive in the param dict
+        if consumers.get(id(var_node), 0) <= 1:
+            store.pop(var_node.name, None)
+
+    n_folded = 0
+    for node in topo:
+        if node.is_var:
+            mapped[id(node)] = node
+            continue
+        if id(node) in foldable:
+            conv = foldable[id(node)]
+            bn_vars = [e[0] for e in node.inputs[1:]]
+            g_name, b_name, m_name, v_name = [v.name for v in bn_vars]
+            if not (g_name in args and b_name in args
+                    and m_name in auxs and v_name in auxs):
+                # params consumed by an earlier fold: keep this pair
+                # unfolded rather than corrupt it (the conv was skipped
+                # on its own visit, so materialize its copy first)
+                if id(conv) not in mapped:
+                    mapped[id(conv)] = _Node(
+                        conv.op, conv.name,
+                        [map_entry(e) for e in conv.inputs],
+                        dict(conv.attrs))
+                folded_conv_ids.discard(id(conv))
+                mapped[id(node)] = _Node(
+                    node.op, node.name,
+                    [map_entry(e) for e in node.inputs],
+                    dict(node.attrs))
+                continue
+            eps = float(node.attrs.get("eps", 1e-3))
+            gamma = args[g_name].asnumpy()
+            beta = args[b_name].asnumpy()
+            mean = auxs[m_name].asnumpy()
+            var = auxs[v_name].asnumpy()
+            for v, store in zip(bn_vars, (args, args, auxs, auxs)):
+                _pop_if_sole(store, v)
+            if _attr_bool(node.attrs, "fix_gamma", True):
+                gamma = np.ones_like(gamma)
+            s = gamma / np.sqrt(var + eps)
+
+            w_var = conv.inputs[1][0]
+            W = args[w_var.name].asnumpy()
+            # fresh names keyed by the (unique) BN node name: shared
+            # conv weights fold independently per consumer pair
+            w_new = node.name + "_bnfold_weight"
+            args[w_new] = nd.array(
+                W * s.reshape((-1,) + (1,) * (W.ndim - 1)))
+            bias = beta - mean * s
+            conv_no_bias = len(conv.inputs) < 3 or \
+                _attr_bool(conv.attrs, "no_bias")
+            if not conv_no_bias:
+                b0_var = conv.inputs[2][0]
+                bias = bias + args[b0_var.name].asnumpy() * s
+                _pop_if_sole(args, b0_var)
+            _pop_if_sole(args, w_var)
+            b_new = node.name + "_bnfold_bias"
+            args[b_new] = nd.array(bias.astype(np.float32))
+
+            attrs = dict(conv.attrs)
+            attrs["no_bias"] = False
+            ins = [map_entry(conv.inputs[0]),
+                   (Variable(w_new, shape=W.shape)._outputs[0][0], 0),
+                   (Variable(b_new, shape=bias.shape)._outputs[0][0], 0)]
+            fused = _Node(conv.op, node.name + "_bnfold", ins, attrs)
+            mapped[id(node)] = fused
+            mapped[id(conv)] = fused  # nothing else consumes it
+            n_folded += 1
+        elif id(node) in folded_conv_ids:
+            continue  # handled with its BN
+        else:
+            mapped[id(node)] = _Node(
+                node.op, node.name,
+                [map_entry(e) for e in node.inputs], dict(node.attrs),
+                user_attrs=dict(node.user_attrs)
+                if node.user_attrs else None)
+
+    out_sym = Symbol([(mapped[id(n)], oi) for n, oi in sym._outputs])
+    logging.getLogger(__name__).info("folded %d BatchNorm nodes",
+                                     n_folded)
+    return out_sym, args, auxs
+
+
+_fold_bn_inference = fold_bn  # callable under quantize_model's kwarg shadow
+
+
+#: fp32 Pooling attrs the quantized kernel understands; anything else
+#: (layout, p_value, ...) must keep the node out of the int8 chain
+_QPOOL_ATTRS = ("kernel", "pool_type", "stride", "pad", "global_pool",
+                "pooling_convention", "count_include_pad", "cudnn_off")
+
+
+def fuse_int8_chains(qsym):
+    """Peephole over a quantized graph: re-express
+    ``quantize_v2( chain( dequantize(x_q) ) )`` — where ``chain`` is a
+    (possibly empty) sequence of relu / max-pool / flatten — entirely in
+    the quantized domain:
+    ``chain_q( requantize(x_q) )`` using ``_contrib_quantized_act`` /
+    ``quantized_pooling`` / ``quantized_flatten``.  Calibrated ranges on
+    the quantize node ride on the requantize.  Kills the fp32 round
+    trip between adjacent quantized layers (docs/PERF_INT8.md).
+    """
+    from ..ops.registry import get_op
+
+    def _chain_ok(node):
+        if node.op.name == "Activation":
+            return str(node.attrs.get("act_type", "relu")) == "relu"
+        if node.op.name == "Pooling":
+            return str(node.attrs.get("pool_type", "max")) == "max" \
+                and not _attr_truthy(node.attrs.get("global_pool")) \
+                and all(k in _QPOOL_ATTRS for k in node.attrs)
+        return node.op.name in ("Flatten", "flatten")
+
+    def _attr_truthy(v):
+        return str(v).lower() in ("true", "1")
+
+    topo = qsym._topo()
+    mapped = {}
+    n_fused = 0
+
+    def map_entry(e):
+        return (mapped[id(e[0])], e[1])
+
+    for node in topo:
+        if node.is_var:
+            mapped[id(node)] = node
+            continue
+        if node.op.name == "_contrib_quantize_v2":
+            # walk down through the fp32 chain to a dequantize
+            chain = []
+            cur, oi = node.inputs[0]
+            while not cur.is_var and _chain_ok(cur):
+                chain.append(cur)
+                cur, oi = cur.inputs[0]
+            if not cur.is_var and cur.op.name == "_contrib_dequantize":
+                src = [map_entry(e) for e in cur.inputs]  # (q, mn, mx)
+                rq = _Node(get_op("_contrib_requantize"),
+                           node.name + "_requant", src,
+                           dict(node.attrs))  # calib ranges if any
+                triple = [(rq, 0), (rq, 1), (rq, 2)]
+                for link in reversed(chain):
+                    qop, attrs = {
+                        "Activation": ("_contrib_quantized_act",
+                                       {"act_type": "relu"}),
+                        "Pooling": ("_contrib_quantized_pooling",
+                                    dict(link.attrs)),
+                        "Flatten": ("_contrib_quantized_flatten", {}),
+                        "flatten": ("_contrib_quantized_flatten", {}),
+                    }[link.op.name]
+                    qn = _Node(get_op(qop), link.name + "_q", triple,
+                               attrs)
+                    triple = [(qn, 0), (qn, 1), (qn, 2)]
+                # map the quantize node to the chain tail: consumers
+                # read outputs 0..2, which every quantized op exposes
+                mapped[id(node)] = triple[0][0]
+                n_fused += 1
+                continue
+        mapped[id(node)] = _Node(node.op, node.name,
+                                 [map_entry(e) for e in node.inputs],
+                                 dict(node.attrs),
+                                 user_attrs=dict(node.user_attrs)
+                                 if node.user_attrs else None)
+
+    logging.getLogger(__name__).info("fused %d int8 chains", n_fused)
+    return Symbol([(mapped[id(n)], oi) for n, oi in qsym._outputs]), \
+        n_fused

@@ -170,6 +170,20 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     return out, min_data, max_data
 
 
+@register("_contrib_quantized_act", aliases=("quantized_act",),
+          no_grad=True, num_outputs=3,
+          input_names=("data", "min_data", "max_data"))
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    """ReLU in the quantized domain: max(q, 0) under a symmetric scale
+    is exactly relu of the dequantized value.  The representable range
+    is kept unchanged so the scale (and therefore the int values)
+    stays bit-identical — clipping the range to [0, max] would
+    re-derive a different scale and silently re-bin every value."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports relu only")
+    return jnp.maximum(data, 0), min_data, max_data
+
+
 @register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
           no_grad=True, num_outputs=3,
           input_names=("data", "min_data", "max_data"))

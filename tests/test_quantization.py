@@ -161,3 +161,77 @@ def test_quantize_model_excludes():
     names = [n.name for n in qsym._topo() if not n.is_var]
     assert not any(n.startswith("conv1_quantized") for n in names)
     assert any(n.startswith("fc1_quantized") for n in names)
+
+
+def test_fold_bn_numerically_equivalent():
+    """fold_bn must reproduce the inference-mode conv+BN output exactly
+    up to fp32 reassociation drift, and remove every foldable BN."""
+    from mxnet_tpu.contrib.quantization import fold_bn
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+    with mx.autograd.pause():
+        want = net(x).asnumpy()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = d + "/m"
+        net.export(prefix, 0)
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+    fsym, fargs, fauxs = fold_bn(sym, args, auxs)
+    assert not any(n.op.name == "BatchNorm" for n in fsym._topo()
+                   if not n.is_var)
+    ex = fsym.bind(ctx=mx.cpu(), args={**fargs, "data": x},
+                   grad_req="null", aux_states=fauxs)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=2e-3)
+
+
+def test_quantize_fold_fuse_int8_chains():
+    """fold_bn=True + fuse_int8=True: the quantized graph carries int8
+    between adjacent layers (requantize/quantized_act present, fewer
+    quantize_v2 than quantized convs) and stays numerically faithful."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.gluon import SymbolBlock
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(1)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+    with mx.autograd.pause():
+        want = net(x).asnumpy()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = d + "/m"
+        net.export(prefix, 0)
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+        calib = mx.io.NDArrayIter(
+            rng.rand(8, 3, 32, 32).astype(np.float32),
+            np.zeros((8,)), 4)
+        qsym, qargs, qauxs = quantize_model(
+            sym, args, auxs, calib_mode="naive", calib_data=calib,
+            num_calib_examples=8, fold_bn=True, fuse_int8=True)
+        ops = {}
+        for n in qsym._topo():
+            if not n.is_var:
+                ops[n.op.name] = ops.get(n.op.name, 0) + 1
+        assert ops.get("_contrib_requantize", 0) > 0
+        assert ops.get("_contrib_quantized_act", 0) > 0
+        assert ops.get("_contrib_quantize_v2", 0) < \
+            ops["_contrib_quantized_conv"]
+        mx.model.save_checkpoint(d + "/q", 0, qsym, qargs, qauxs)
+        qnet = SymbolBlock.imports(d + "/q-symbol.json", ["data"],
+                                   d + "/q-0000.params")
+        with mx.autograd.pause():
+            got = qnet(x).asnumpy()
+    corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+    assert corr > 0.98, corr
