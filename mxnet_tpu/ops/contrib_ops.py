@@ -326,6 +326,36 @@ def _ifft(data, compute_size=128):
     return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
 
 
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def _div_sqrt_dim(x):
+    """Scale by 1/sqrt(last dim) — the attention-logit scaling helper
+    (reference: contrib/transformer.cc ``_contrib_div_sqrt_dim``)."""
+    return x * (1.0 / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype)))
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",),
+          array_params=("scalar",))
+def _gradient_multiplier(x, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar`` on the way back
+    (reference: contrib/gradient_multiplier_op.cc — the gradient-reversal
+    trick when ``scalar`` is negative, e.g. domain-adversarial nets).
+    TPU-native: one ``custom_vjp`` instead of a forward/backward op pair."""
+
+    @jax.custom_vjp
+    def _gm(v, s):
+        return v
+
+    def _fwd(v, s):
+        return v, s
+
+    def _bwd(s, g):
+        s = jnp.asarray(s)
+        return (g * s.astype(g.dtype), jnp.zeros_like(s))
+
+    _gm.defvjp(_fwd, _bwd)
+    return _gm(x, scalar)
+
+
 @register("_contrib_allclose", aliases=("allclose",), no_grad=True)
 def _allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True):
     return jnp.allclose(a, b, rtol=rtol, atol=atol,

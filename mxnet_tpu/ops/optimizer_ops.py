@@ -97,6 +97,34 @@ def _adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return w, m, v
 
 
+@register("_contrib_mp_adamw_update",
+          input_names=("weight", "grad", "mean", "var", "weight32",
+                       "rescale_grad"),
+          mutate={0: 0, 1: 2, 2: 3, 3: 4},
+          array_params=("lr", "wd", "eta"), no_grad=True)
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                     lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     wd=0.0, eta=1.0, clip_gradient=-1.0):
+    """Multi-precision AdamW (reference: src/operator/contrib/adamw.cc
+    ``_contrib_mp_adamw_update``): low-precision weight + fp32 master copy;
+    ``rescale_grad`` rides as a TENSOR so loss-scaling loops stay jittable,
+    and a non-finite or zero scale skips the whole update (the reference
+    checks this on host; here it's a lax-friendly ``where``)."""
+    scale = rescale_grad.astype(jnp.float32).reshape(())
+    ok = jnp.isfinite(scale) & (scale != 0)
+    g = grad.astype(jnp.float32) * scale
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon)
+                            + wd * weight32)
+    m = jnp.where(ok, m, mean)
+    v = jnp.where(ok, v, var)
+    w32 = jnp.where(ok, w32, weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
 @register("rmsprop_update", input_names=("weight", "grad", "n"),
           mutate={0: 0, 1: 2}, array_params=_AP, no_grad=True)
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
@@ -339,6 +367,29 @@ def _sparse_adam_update(weight, grad, indices, mean, var, lr=0.001,
     new_rows = rows - lr * m / (jnp.sqrt(v) + epsilon)
     return (weight.at[idx].set(new_rows), mean.at[idx].set(m),
             var.at[idx].set(v))
+
+
+@register("_sparse_adagrad_update",
+          input_names=("weight", "grad", "indices", "history"),
+          mutate={0: 0, 1: 3}, array_params=("lr", "rescale_grad"),
+          no_grad=True)
+def _sparse_adagrad_update(weight, grad, indices, history, lr=0.01,
+                           epsilon=1e-7, wd=0.0, rescale_grad=1.0,
+                           clip_gradient=-1.0):
+    """Lazy AdaGrad on embedding rows (reference: optimizer_op.cc
+    ``_sparse_adagrad_update`` — row_sparse grad touches only its rows, so
+    untouched rows keep their accumulated history).  ``wd`` is rejected
+    when nonzero, matching the reference's CHECK_EQ(param.wd, 0) rather
+    than silently training unregularized."""
+    if wd:
+        raise ValueError(
+            "_sparse_adagrad_update does not support weight decay "
+            "(reference parity: optimizer_op-inl.h CHECK_EQ(wd, 0))")
+    idx = indices.astype(_index_dtype())
+    g = _prep(grad[idx], rescale_grad, clip_gradient)
+    h = history[idx] + jnp.square(g)
+    rows = weight[idx] - lr * g / (jnp.sqrt(h) + epsilon)
+    return weight.at[idx].set(rows), history.at[idx].set(h)
 
 
 # ---------------------------------------------------------------------------

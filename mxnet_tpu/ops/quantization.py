@@ -189,3 +189,34 @@ def _quantized_act(data, min_data, max_data, act_type="relu"):
           input_names=("data", "min_data", "max_data"))
 def _quantized_flatten(data, min_data, max_data):
     return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          no_grad=True, num_outputs=3)
+def _quantized_concat(*args, dim=1, num_args=None):
+    """Concat int8 tensors that may carry DIFFERENT scales (reference:
+    quantization/quantized_concat.cc — the op inception-style branches
+    need so the merge stays int8).  Input layout follows the reference:
+    ``(data_0..data_{n-1}, min_0, max_0, min_1, max_1, ...)``.  Each
+    branch is re-binned onto the widest represented range, then
+    concatenated; output range is that common range.  XLA fuses the
+    per-branch rescale into the concat's consumers, so unlike the
+    fp32-seam path there is no dequant->requant HBM round-trip."""
+    n = int(num_args) if num_args else len(args) // 3
+    data = args[:n]
+    mins = args[n::2]
+    maxs = args[n + 1::2]
+    # widest represented magnitude across branches -> common scale
+    mags = [jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+            for mn, mx in zip(mins, maxs)]
+    common = mags[0]
+    for m in mags[1:]:
+        common = jnp.maximum(common, m)
+    out_scale = jnp.maximum(common, 1e-10) / INT8_MAX
+    rebinned = []
+    for d, mn, mx in zip(data, mins, maxs):
+        s = _scale(mn, mx)
+        q = jnp.round(d.astype(jnp.float32) * (s / out_scale))
+        rebinned.append(
+            jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8))
+    return (jnp.concatenate(rebinned, axis=dim), -common, common)

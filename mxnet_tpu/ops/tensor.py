@@ -182,6 +182,13 @@ register("_full", no_grad=True)(
         jnp.full(tuple(shape), value, dtype))
 
 
+@register("_eye", aliases=("eye",), no_grad=True)
+def _eye_op(N=0, M=0, k=0, dtype="float32"):
+    """Identity-band matrix (reference: tensor/init_op.cc ``_eye``;
+    ``M == 0`` means square)."""
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=dtype)
+
+
 @register("_arange", no_grad=True)
 def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
     out = jnp.arange(start, stop, step, dtype=dtype)
@@ -235,6 +242,45 @@ def _norm(x, ord=2, axis=None, keepdims=False):
     if ord == 1:
         return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
     return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+def _square_sum_core(x, axis=None, keepdims=False):
+    """Fused sum-of-squares reduce (reference: tensor/square_sum.cc — a
+    row_sparse-specialised ``sum(square(x))``).  Dense here; the sparse
+    NDArray path hands this the compacted row data, which preserves the
+    reference's only-nonzero-rows arithmetic.  XLA fuses square into the
+    reduction, which was the point of the fused kernel."""
+    return jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)
+
+
+_square_sum = _reduce(_square_sum_core)
+_square_sum.__doc__ = _square_sum_core.__doc__
+register("_square_sum", aliases=("square_sum",))(_square_sum)
+
+
+@register("_histogram", aliases=("histogram",), no_grad=True,
+          num_outputs=2)
+def _histogram(data, bins=None, bin_cnt=None, range=None):
+    """Histogram (reference: tensor/histogram.cc ``_histogram``): either a
+    uniform grid from ``bin_cnt``+``range`` or explicit ``bins`` edges as a
+    second array input.  Returns (counts, edges); out-of-range values are
+    dropped, matching numpy/reference semantics."""
+    if bins is not None and bins.ndim > 0:
+        edges = bins
+        cnt, _ = jnp.histogram(data, bins=edges)
+    else:
+        if bin_cnt is None:
+            raise ValueError(
+                "histogram needs either a bins array or bin_cnt + range")
+        lo, hi = ((float(range[0]), float(range[1])) if range is not None
+                  else (None, None))
+        if lo is None:
+            cnt, edges = jnp.histogram(data, bins=int(bin_cnt))
+        else:
+            cnt, edges = jnp.histogram(data, bins=int(bin_cnt),
+                                       range=(lo, hi))
+    # int64 counts like the reference; canonicalized so x32 mode doesn't warn
+    return cnt.astype(jax.dtypes.canonicalize_dtype(jnp.int64)), edges
 
 
 @register("argmax", no_grad=True)
@@ -547,6 +593,43 @@ def _concat(*xs, dim=1, num_args=None):
 @register("stack")
 def _stack(*xs, axis=0, num_args=None):
     return jnp.stack(xs, axis=axis)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*xs, dim=0, num_args=None):
+    """Concat variant used when flattening RNN parameter blocks (reference:
+    src/operator/nn/concat.cc ``_rnn_param_concat`` — same kernel as Concat,
+    different shape inference for partially-known RNN param shapes; JAX
+    shapes are always concrete so the kernel alone suffices)."""
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("_split_v2", aliases=("split_v2",),
+          visible_out=lambda attrs: list(range(
+              int(attrs["sections"]) if int(attrs.get("sections", 0)) > 0
+              else len(attrs.get("indices", ())))))
+def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0):
+    """Split at explicit indices OR into equal sections (reference:
+    matrix_op.cc ``_split_v2``).  NOTE the reference's convention: with
+    ``sections == 0``, ``indices`` lists each piece's START (a leading 0
+    included), so the output count is ``len(indices)`` — not numpy's
+    ``len+1``.  Piece i spans [indices[i], indices[i+1]) and the last runs
+    to the end of the axis."""
+    ax = axis if axis >= 0 else axis + x.ndim
+    size = x.shape[ax]
+    if sections > 0:
+        parts = jnp.split(x, sections, axis=ax)
+    else:
+        starts = [int(i) for i in indices]
+        ends = starts[1:] + [size]
+        sl = [slice(None)] * x.ndim
+        parts = []
+        for b, e in zip(starts, ends):
+            sl[ax] = slice(b, e)
+            parts.append(x[tuple(sl)])
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=ax) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
 
 
 @register("split", aliases=("SliceChannel",),

@@ -568,6 +568,9 @@ TESTED_ELSEWHERE = {
     "cast_storage", "IdentityAttachKLSparseReg",
     # user-defined ops: tests/test_custom_op.py
     "Custom",
+    # round-5 op-tail batch: oracle + gradient tests in tests/test_ops_r5.py
+    "_split_v2", "_rnn_param_concat", "_square_sum",
+    "_contrib_div_sqrt_dim", "_contrib_gradientmultiplier",
 }
 
 

@@ -1,0 +1,210 @@
+"""Round-5 op-tail batch (VERDICT round 4 "what's missing" #1):
+_eye, _histogram, _split_v2, _square_sum, _sparse_adagrad_update,
+_contrib_mp_adamw_update, _contrib_quantized_concat, _contrib_div_sqrt_dim,
+_contrib_gradientmultiplier, _rnn_param_concat."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+R = np.random.RandomState
+
+
+def test_eye():
+    np.testing.assert_array_equal(nd.eye(4).asnumpy(), np.eye(4, dtype=np.float32))
+    np.testing.assert_array_equal(nd.eye(3, 5, 1).asnumpy(),
+                                  np.eye(3, 5, k=1, dtype=np.float32))
+    assert nd.eye(2, dtype="int32").asnumpy().dtype == np.int32
+
+
+def test_histogram_uniform_bins():
+    x = R(0).uniform(0, 10, (3, 37)).astype(np.float32)
+    cnt, edges = nd.histogram(nd.array(x), bin_cnt=10, range=(0.0, 10.0))
+    ref_cnt, ref_edges = np.histogram(x, bins=10, range=(0, 10))
+    np.testing.assert_array_equal(cnt.asnumpy(), ref_cnt)
+    np.testing.assert_allclose(edges.asnumpy(), ref_edges, rtol=1e-6)
+
+
+def test_histogram_explicit_edges_and_outliers():
+    x = np.array([-5.0, 0.1, 0.9, 1.5, 2.5, 99.0], np.float32)
+    bins = np.array([0.0, 1.0, 2.0, 3.0], np.float32)
+    cnt, edges = nd.histogram(nd.array(x), nd.array(bins))
+    ref_cnt, _ = np.histogram(x, bins=bins)
+    np.testing.assert_array_equal(cnt.asnumpy(), ref_cnt)  # outliers dropped
+    np.testing.assert_allclose(edges.asnumpy(), bins)
+
+
+def test_split_v2_indices_convention():
+    """Reference convention: indices list each piece's START (leading 0
+    included) and the output count is len(indices)."""
+    x = R(1).uniform(size=(10, 3)).astype(np.float32)
+    parts = nd.split_v2(nd.array(x), indices=(0, 2, 5), axis=0)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[0].asnumpy(), x[0:2])
+    np.testing.assert_allclose(parts[1].asnumpy(), x[2:5])
+    np.testing.assert_allclose(parts[2].asnumpy(), x[5:])
+    # dropped leading rows when indices[0] != 0
+    parts = nd.split_v2(nd.array(x), indices=(3, 7), axis=0)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+
+
+def test_split_v2_sections_and_squeeze():
+    x = R(2).uniform(size=(4, 6)).astype(np.float32)
+    parts = nd.split_v2(nd.array(x), sections=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (4, 2)
+    np.testing.assert_allclose(parts[1].asnumpy(), x[:, 2:4])
+    sq = nd.split_v2(nd.array(x), sections=4, axis=0, squeeze_axis=True)
+    assert sq[0].shape == (6,)
+
+
+def test_split_v2_gradient():
+    def head(x):
+        return mx.nd.split_v2(x, indices=(0, 2), axis=0)[0]
+    check_numeric_gradient(head, [R(3).uniform(size=(5, 4)).astype(np.float32)])
+
+
+def test_square_sum():
+    x = R(4).uniform(-1, 1, (6, 5)).astype(np.float32)
+    out = nd.square_sum(nd.array(x), axis=1)
+    np.testing.assert_allclose(out.asnumpy(), (x * x).sum(1), rtol=1e-5)
+    keep = nd.square_sum(nd.array(x), axis=0, keepdims=True)
+    assert keep.shape == (1, 5)
+    check_numeric_gradient(lambda a: mx.nd.square_sum(a, axis=1),
+                           [x.astype(np.float64).astype(np.float32)])
+
+
+def test_square_sum_exclude_negative_axis():
+    x = R(11).uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    out = nd.square_sum(nd.array(x), axis=-1, exclude=True)
+    np.testing.assert_allclose(out.asnumpy(), (x * x).sum((0, 1)), rtol=1e-5)
+
+
+def test_sparse_adagrad_rejects_weight_decay():
+    w = nd.ones((2, 2))
+    with pytest.raises(ValueError, match="weight decay"):
+        nd._sparse_adagrad_update(w, nd.ones((2, 2)),
+                                  nd.array(np.array([0], np.int64)),
+                                  nd.zeros((2, 2)), lr=0.1, wd=0.5,
+                                  out=(w, nd.zeros((2, 2))))
+
+
+def test_square_sum_row_sparse_semantics():
+    """The fused kernel's reason to exist: sum-of-squares over a row_sparse
+    array touches only the stored rows."""
+    dense = np.zeros((8, 3), np.float32)
+    dense[[1, 5]] = R(5).uniform(1, 2, (2, 3))
+    rs = nd.array(dense).tostype("row_sparse")
+    out = nd.square_sum(rs.values, axis=1)
+    np.testing.assert_allclose(out.asnumpy(), (dense[[1, 5]] ** 2).sum(1),
+                               rtol=1e-5)
+
+
+def test_rnn_param_concat():
+    a, b = _pair = [R(6).uniform(size=s).astype(np.float32)
+                    for s in [(4, 3), (8, 3)]]
+    out = nd._rnn_param_concat(nd.array(a), nd.array(b), dim=0)
+    np.testing.assert_allclose(out.asnumpy(), np.concatenate([a, b], 0))
+    check_numeric_gradient(
+        lambda x, y: nd._rnn_param_concat(x, y, dim=0), _pair)
+
+
+def test_div_sqrt_dim():
+    x = R(7).uniform(-1, 1, (2, 3, 16)).astype(np.float32)
+    out = nd.contrib.div_sqrt_dim(nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), x / 4.0, rtol=1e-6)
+    check_numeric_gradient(mx.nd.contrib.div_sqrt_dim, [x])
+
+
+def test_gradientmultiplier_scales_only_the_gradient():
+    x_np = R(8).uniform(-1, 1, (3, 4)).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.5)
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x_np, rtol=1e-6)  # identity fwd
+    np.testing.assert_allclose(x.grad.asnumpy(), -0.5 * 2 * x_np, rtol=1e-5)
+
+
+def test_sparse_adagrad_update():
+    r = R(9)
+    w = r.uniform(-1, 1, (6, 4)).astype(np.float32)
+    h = r.uniform(0, 1, (6, 4)).astype(np.float32)
+    rows = np.array([1, 4], np.int64)
+    # convention matches _sparse_sgd_update: grad rides as the row_sparse
+    # array's full-size dense backing; `rows` carries the touched indices
+    g = np.zeros((6, 4), np.float32)
+    g[rows] = r.uniform(-1, 1, (2, 4))
+
+    wn, hn = nd.array(w), nd.array(h)
+    nd._sparse_adagrad_update(wn, nd.array(g), nd.array(rows), hn,
+                              lr=0.1, epsilon=1e-7, out=(wn, hn))
+    exp_w, exp_h = w.copy(), h.copy()
+    exp_h[rows] += g[rows] * g[rows]
+    exp_w[rows] -= 0.1 * g[rows] / (np.sqrt(exp_h[rows]) + 1e-7)
+    np.testing.assert_allclose(wn.asnumpy(), exp_w, rtol=1e-5)
+    np.testing.assert_allclose(hn.asnumpy(), exp_h, rtol=1e-5)
+    # untouched rows: bit-identical (the lazy-update contract)
+    untouched = [i for i in range(6) if i not in rows]
+    np.testing.assert_array_equal(wn.asnumpy()[untouched], w[untouched])
+
+
+def test_mp_adamw_update_and_skip_on_bad_scale():
+    r = R(10)
+    w32 = r.uniform(-1, 1, (5, 3)).astype(np.float32)
+    w16 = w32.astype(np.float16)
+    g = r.uniform(-1, 1, (5, 3)).astype(np.float16)
+    m = np.zeros((5, 3), np.float32)
+    v = np.zeros((5, 3), np.float32)
+
+    def run(scale):
+        aw, am, av, a32 = (nd.array(w16), nd.array(m), nd.array(v),
+                           nd.array(w32))
+        nd.contrib.mp_adamw_update(
+            aw, nd.array(g), am, av, a32, nd.array([scale], dtype="float32"),
+            lr=0.01, eta=1.0, wd=0.1, out=(aw, am, av, a32))
+        return aw, am, av, a32
+
+    aw, am, av, a32 = run(1.0)
+    gm = g.astype(np.float32)
+    em = 0.1 * gm
+    ev = 0.001 * gm * gm
+    e32 = w32 - 1.0 * (0.01 * em / (np.sqrt(ev) + 1e-8) + 0.1 * w32)
+    np.testing.assert_allclose(a32.asnumpy(), e32, rtol=1e-5)
+    np.testing.assert_allclose(aw.asnumpy(), e32.astype(np.float16),
+                               rtol=1e-3)
+    # non-finite / zero loss-scale skips the update entirely
+    for bad in (np.nan, np.inf, 0.0):
+        aw, am, av, a32 = run(bad)
+        np.testing.assert_array_equal(a32.asnumpy(), w32)
+        np.testing.assert_array_equal(am.asnumpy(), m)
+
+
+def test_quantized_concat_unifies_scales():
+    qa = nd.array(np.array([[100, -50]], np.int8), dtype="int8")
+    qb = nd.array(np.array([[20, 30]], np.int8), dtype="int8")
+    # branch a represents +/-1.0, branch b +/-4.0 -> common range +/-4.0
+    out, omin, omax = nd.contrib.quantized_concat(
+        qa, qb, nd.array([-1.0]), nd.array([1.0]),
+        nd.array([-4.0]), nd.array([4.0]), dim=1, num_args=2)
+    assert out.asnumpy().dtype == np.int8
+    np.testing.assert_allclose(float(omax.asnumpy()[0]), 4.0, rtol=1e-6)
+    # dequantized values must be preserved through the re-binning
+    s_common = 4.0 / 127
+    deq = out.asnumpy().astype(np.float32) * s_common
+    exp = np.concatenate([
+        np.array([[100, -50]], np.float32) * (1.0 / 127),
+        np.array([[20, 30]], np.float32) * (4.0 / 127)], axis=1)
+    np.testing.assert_allclose(deq, exp, atol=s_common)
+
+
+def test_round5_ops_registered_with_reference_names():
+    from mxnet_tpu.ops.registry import OPS
+    for name in ["_eye", "_histogram", "_split_v2", "_square_sum",
+                 "_sparse_adagrad_update", "_contrib_mp_adamw_update",
+                 "_contrib_quantized_concat", "_contrib_div_sqrt_dim",
+                 "_contrib_gradientmultiplier", "_rnn_param_concat"]:
+        assert name in OPS, name
