@@ -1,12 +1,24 @@
-"""Learning-rate schedulers.
+"""Learning-rate schedules.
 
 Reference parity: ``python/mxnet/lr_scheduler.py`` (FactorScheduler,
-MultiFactorScheduler, PolyScheduler, CosineScheduler, warmup support).
-Schedulers are host-side (an ``lr`` is handed to the fused update op as a
-traced scalar, so changing it never recompiles — SURVEY.md §7 design note).
+MultiFactorScheduler, PolyScheduler, CosineScheduler, linear/constant
+warmup — same class and constructor surface).
+
+TPU-native redesign: every schedule here is a pure CLOSED-FORM map
+``num_update -> lr`` instead of the reference's step-walking state machine
+(mutable ``count`` / ``cur_step_ind`` cursors).  Two reasons:
+
+* the consuming update ops take ``lr`` as a traced scalar (SURVEY.md §7),
+  so the schedule is evaluated fresh every step anyway — closed form makes
+  that evaluation order-independent: probing lr at an arbitrary step
+  (resume, profiling, plotting a schedule) cannot corrupt hidden cursors;
+* ``Optimizer`` assigns ``scheduler.base_lr = learning_rate`` after
+  construction; anchoring each call on the CURRENT ``base_lr`` honours
+  that assignment without init-order footguns.
 """
 from __future__ import annotations
 
+import bisect
 import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
@@ -14,138 +26,142 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
-    """Base scheduler: maps num_update -> learning rate, with linear/constant
-    warmup (reference: ``lr_scheduler.py`` LRScheduler)."""
+    """Base class: warmup handling + the ``__call__(num_update) -> lr``
+    contract.  Subclasses implement ``_decayed_lr(num_update)`` for the
+    post-warmup regime."""
+
+    _WARMUP_MODES = ("linear", "constant")
 
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
-        self.base_lr = base_lr
         if warmup_steps < 0:
-            raise ValueError("warmup_steps should be positive or 0")
+            raise ValueError("warmup_steps must be >= 0")
+        if warmup_begin_lr > base_lr:
+            raise ValueError("warmup must ramp UP: warmup_begin_lr (%s) "
+                             "exceeds base_lr (%s)"
+                             % (warmup_begin_lr, base_lr))
+        if warmup_mode not in self._WARMUP_MODES:
+            raise ValueError("warmup_mode must be one of %s"
+                             % (self._WARMUP_MODES,))
+        self.base_lr = base_lr
         self.warmup_steps = warmup_steps
-        self.warmup_final_lr = base_lr
         self.warmup_begin_lr = warmup_begin_lr
-        if self.warmup_begin_lr > self.warmup_final_lr:
-            raise ValueError("warmup_begin_lr should be smaller than base_lr")
-        if warmup_mode not in ("linear", "constant"):
-            raise ValueError("Supports only linear and constant modes of warmup")
         self.warmup_mode = warmup_mode
+
+    @property
+    def warmup_final_lr(self):
+        # tracks base_lr so Optimizer's post-construction
+        # ``scheduler.base_lr = learning_rate`` also re-anchors the warmup
+        # target — the ramp always lands exactly on the post-warmup lr
+        # (the reference froze this at init, leaving a jump at warmup end)
+        return self.base_lr
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = ((self.warmup_final_lr - self.warmup_begin_lr)
-                        * float(num_update) / float(self.warmup_steps))
-            return self.warmup_begin_lr + increase
-        return self.warmup_begin_lr
+        if self.warmup_mode == "constant":
+            return self.warmup_begin_lr
+        span = self.warmup_final_lr - self.warmup_begin_lr
+        return self.warmup_begin_lr + span * (num_update
+                                              / float(self.warmup_steps))
+
+    def _decayed_lr(self, num_update):
+        raise NotImplementedError(
+            "%s must implement _decayed_lr" % type(self).__name__)
 
     def __call__(self, num_update):
-        raise NotImplementedError("must override this")
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decayed_lr(int(num_update))
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every ``step`` updates (reference: FactorScheduler)."""
+    """``lr = base_lr * factor ** k`` where ``k`` grows by one each
+    ``step`` updates, floored at ``stop_factor_lr``."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor > 1 would grow the lr")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-        return self.base_lr
+    def _decayed_lr(self, num_update):
+        # the k-th decay lands after update k*step (strictly greater, the
+        # reference's boundary), so k = floor((t-1)/step) for t >= 1
+        k = max(num_update - 1, 0) // self.step
+        return max(self.base_lr * self.factor ** k, self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each milestone in ``step`` (reference:
-    MultiFactorScheduler)."""
+    """``lr *= factor`` once per milestone passed; milestones are a sorted
+    list of update counts."""
 
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("milestones must be >= 1")
+        if sorted(set(step)) != step:
+            raise ValueError("milestones must be strictly increasing")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor > 1 would grow the lr")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decayed_lr(self, num_update):
+        # milestone m has fired once num_update > m; bisect counts them
+        fired = bisect.bisect_left(self.step, num_update)
+        return self.base_lr * self.factor ** fired
 
 
-class PolyScheduler(LRScheduler):
-    """Polynomial decay to final_lr over max_update steps."""
-
-    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
-                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
-
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                      self.power)
-        return self.base_lr
-
-
-class CosineScheduler(LRScheduler):
-    """Cosine decay to final_lr over max_update steps."""
+class _RampScheduler(LRScheduler):
+    """Shared shape for poly/cosine: interpolate base_lr -> final_lr over
+    ``max_update - warmup_steps`` post-warmup updates via ``_ramp(p)``,
+    p in [0, 1]."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int")
+        if max_update <= warmup_steps:
+            raise ValueError(
+                "max_update (%d) must exceed warmup_steps (%d) or the "
+                "schedule has no decay regime" % (max_update, warmup_steps))
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * (1 + math.cos(math.pi * (num_update - self.warmup_steps)
-                                / self.max_steps)) / 2
-        return self.base_lr
+    def _ramp(self, p):
+        raise NotImplementedError
+
+    def _decayed_lr(self, num_update):
+        p = (num_update - self.warmup_steps) / float(self.max_steps)
+        p = min(max(p, 0.0), 1.0)
+        return self.final_lr + (self.base_lr - self.final_lr) * self._ramp(p)
+
+
+class PolyScheduler(_RampScheduler):
+    """Polynomial ramp ``(1 - p) ** pwr`` down to ``final_lr``."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
+        self.power = pwr
+
+    def _ramp(self, p):
+        return (1.0 - p) ** self.power
+
+
+class CosineScheduler(_RampScheduler):
+    """Half-cosine ramp down to ``final_lr``."""
+
+    def _ramp(self, p):
+        return (1.0 + math.cos(math.pi * p)) / 2.0

@@ -104,6 +104,38 @@ def test_lr_in_optimizer_applies_schedule():
     assert opt._get_lr(0) < 0.5
 
 
+def test_lr_scheduler_closed_form_is_order_independent():
+    """The rewrite's contract: schedules are pure maps num_update -> lr,
+    so probing out of order (resume, plotting) can't corrupt state."""
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert abs(s(21) - 0.25) < 1e-12
+    assert s(1) == 1.0  # probing backwards still exact
+    assert abs(s(11) - 0.5) < 1e-12
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=1.0)
+    assert abs(m(20) - 0.01) < 1e-12 and m(3) == 1.0
+
+
+def test_lr_scheduler_warmup_lands_on_post_assignment_lr():
+    """Optimizer assigns scheduler.base_lr AFTER construction; the warmup
+    ramp must target that value with no jump at warmup end."""
+    sched = mx.lr_scheduler.FactorScheduler(step=1000, warmup_steps=10)
+    opt = mx.optimizer.SGD(learning_rate=0.1, lr_scheduler=sched)
+    assert abs(sched(9) - 0.09) < 1e-12
+    assert sched(10) == 0.1
+    del opt
+
+
+def test_ramp_scheduler_rejects_degenerate_regime():
+    import pytest
+    with pytest.raises(ValueError, match="warmup_steps"):
+        mx.lr_scheduler.CosineScheduler(max_update=10, warmup_steps=10)
+    # past-end probing clamps to final_lr instead of going negative
+    c = mx.lr_scheduler.CosineScheduler(max_update=10, base_lr=1.0,
+                                        final_lr=0.1)
+    assert abs(c(50) - 0.1) < 1e-12
+
+
 def test_multi_precision_sgd():
     opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
                            multi_precision=True)
