@@ -36,10 +36,6 @@ class LRScheduler:
                  warmup_mode="linear"):
         if warmup_steps < 0:
             raise ValueError("warmup_steps must be >= 0")
-        if warmup_begin_lr > base_lr:
-            raise ValueError("warmup must ramp UP: warmup_begin_lr (%s) "
-                             "exceeds base_lr (%s)"
-                             % (warmup_begin_lr, base_lr))
         if warmup_mode not in self._WARMUP_MODES:
             raise ValueError("warmup_mode must be one of %s"
                              % (self._WARMUP_MODES,))
@@ -58,6 +54,13 @@ class LRScheduler:
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
+        # validated at call time, against the CURRENT anchor — Optimizer
+        # re-assigns base_lr after construction, so an init-time check
+        # would test a value that may never be used
+        if self.warmup_begin_lr > self.warmup_final_lr:
+            raise ValueError("warmup must ramp UP: warmup_begin_lr (%s) "
+                             "exceeds base_lr (%s)"
+                             % (self.warmup_begin_lr, self.warmup_final_lr))
         if self.warmup_mode == "constant":
             return self.warmup_begin_lr
         span = self.warmup_final_lr - self.warmup_begin_lr

@@ -95,6 +95,21 @@ def constraint(x, *spec_entries, mesh=None):
     mesh = mesh or current_mesh()
     if mesh is None:
         return x
+    # inside a shard_map body the mesh axes being mapped are "manual":
+    # GSPMD constraints over them are both illegal and meaningless (the
+    # body already sees its per-device shard), so drop those entries —
+    # this is what lets mesh-aware model code (e.g. transformer blocks
+    # with dp/sp/tp activation constraints) run unchanged as a pipeline
+    # stage under shard_map
+    try:
+        manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+    except AttributeError:  # pragma: no cover - older jax
+        manual = set()
+    if manual:
+        spec_entries = tuple(
+            None if e in manual or (
+                isinstance(e, (tuple, list)) and set(e) & manual) else e
+            for e in spec_entries)
     spec = _filter_spec(PartitionSpec(*spec_entries), mesh, shape=x.shape)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh.mesh, spec))
