@@ -80,6 +80,8 @@ def _parse_attr(buf):
             else:
                 vals.append(P.signed(v))
         return name, vals
+    if atype == 8:                      # STRINGS
+        return name, [P.to_str(b) for b in f.get(9, [])]
     return name, None
 
 
@@ -138,6 +140,83 @@ def _pads(attrs, default=0):
     if list(p[:half]) != list(p[half:]):
         raise NotImplementedError("asymmetric pads %r" % (p,))
     return tuple(p[:half])
+
+
+def _import_rnn(mx, op, node, a, ins, inits, get, consumed, name):
+    """One ONNX LSTM/GRU/RNN node -> a single-layer mx RNN with the packed
+    parameter layout (reference rnn-inl.h), gate order mapped back from
+    ONNX (iofc->ifgo, zrh->rzn).  Returns [Y, Y_h(, Y_c)] with Y in ONNX's
+    [T, dirs, N, H] layout so downstream Transpose/Reshape nodes (which an
+    exported graph always carries) import unchanged."""
+    H = int(a["hidden_size"])
+    direction = str(a.get("direction", "forward"))
+    if direction == "reverse":
+        raise NotImplementedError("ONNX RNN direction='reverse'")
+    dirs = 2 if direction == "bidirectional" else 1
+    if op == "LSTM":
+        mode, g, inv = "lstm", 4, [0, 2, 3, 1]       # iofc -> ifgo
+    elif op == "GRU":
+        if int(a.get("linear_before_reset", 0)) != 1:
+            raise NotImplementedError(
+                "ONNX GRU linear_before_reset=0 (mx/cuDNN semantics "
+                "need 1)")
+        mode, g, inv = "gru", 3, [1, 0, 2]           # zrh -> rzn
+    else:
+        acts = a.get("activations") or ["Tanh"] * dirs
+        mode = "rnn_relu" if str(acts[0]).lower() == "relu" \
+            else "rnn_tanh"
+        g, inv = 1, [0]
+    if len(ins) < 6 or not ins[5]:
+        raise NotImplementedError("ONNX %s without initial_h" % op)
+    if len(ins) > 4 and ins[4]:
+        raise NotImplementedError(
+            "ONNX %s with sequence_lens (padded variable-length "
+            "batches): the mx RNN scan runs full length, which would "
+            "silently produce wrong states past each true length" % op)
+    if ins[1] not in inits or ins[2] not in inits:
+        raise NotImplementedError(
+            "ONNX %s with computed (non-initializer) W/R weights %r/%r "
+            "— only initializer-bound recurrent weights can be repacked "
+            "into the mx parameter vector" % (op, ins[1], ins[2]))
+
+    W = np.asarray(inits[ins[1]], np.float32)
+    R = np.asarray(inits[ins[2]], np.float32)
+    if len(ins) > 3 and ins[3]:
+        B = np.asarray(inits[ins[3]], np.float32)
+        consumed(ins[3])
+    else:
+        B = np.zeros((dirs, 2 * g * H), np.float32)
+    consumed(ins[1]), consumed(ins[2])
+
+    def reorder(w):
+        return np.concatenate([w[j * H:(j + 1) * H] for j in inv], 0)
+
+    chunks = []
+    for d in range(dirs):
+        chunks += [reorder(W[d]).ravel(), reorder(R[d]).ravel()]
+    for d in range(dirs):
+        chunks += [reorder(B[d][:g * H, None])[:, 0],
+                   reorder(B[d][g * H:, None])[:, 0]]
+    pname = name + "_parameters"
+    inits[pname] = np.concatenate(chunks)
+    args = [get(ins[0]), get(pname), get(ins[5])]
+    if mode == "lstm":
+        # initial_c is optional in ONNX (defaults to zeros); mirror that
+        # with zeros shaped like initial_h
+        if len(ins) > 6 and ins[6]:
+            args.append(get(ins[6]))
+        else:
+            args.append(mx.sym.zeros_like(get(ins[5])))
+    out = mx.sym.RNN(*args, mode=mode, state_size=H, num_layers=1,
+                     bidirectional=(dirs == 2), state_outputs=True,
+                     name=name)
+    # mx Y: [T, N, dirs*H] -> ONNX Y: [T, dirs, N, H]
+    y = mx.sym.reshape(out[0], shape=(0, 0, dirs, H))
+    y = mx.sym.transpose(y, axes=(0, 2, 1, 3))
+    res = [y, out[1]]
+    if mode == "lstm":
+        res.append(out[2])
+    return res
 
 
 def import_model(model_file):
@@ -425,9 +504,95 @@ def import_model(model_file):
                                       get(ins[2]),
                                       eps=float(a.get("epsilon", 1e-5)),
                                       name=name)
+        elif op in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan",
+                    "Reciprocal", "Sign", "Erf"):
+            fn = {"Sin": mx.sym.sin, "Cos": mx.sym.cos,
+                  "Tan": mx.sym.tan, "Asin": mx.sym.arcsin,
+                  "Acos": mx.sym.arccos, "Atan": mx.sym.arctan,
+                  "Reciprocal": mx.sym.reciprocal,
+                  "Sign": mx.sym.sign, "Erf": mx.sym.erf}[op]
+            out = fn(get(ins[0]), name=name)
+        elif op == "LogSoftmax":
+            out = mx.sym.log_softmax(get(ins[0]),
+                                     axis=int(a.get("axis", -1)),
+                                     name=name)
+        elif op == "HardSigmoid":
+            out = mx.sym.hard_sigmoid(get(ins[0]),
+                                      alpha=float(a.get("alpha", 0.2)),
+                                      beta=float(a.get("beta", 0.5)),
+                                      name=name)
+        elif op in ("Equal", "Greater", "Less", "GreaterOrEqual",
+                    "LessOrEqual"):
+            fn = {"Equal": mx.sym.broadcast_equal,
+                  "Greater": mx.sym.broadcast_greater,
+                  "Less": mx.sym.broadcast_lesser,
+                  "GreaterOrEqual": mx.sym.broadcast_greater_equal,
+                  "LessOrEqual": mx.sym.broadcast_lesser_equal}[op]
+            out = fn(get(ins[0]), get(ins[1]), name=name)
+        elif op in ("And", "Or", "Xor"):
+            fn = {"And": mx.sym.broadcast_logical_and,
+                  "Or": mx.sym.broadcast_logical_or,
+                  "Xor": mx.sym.broadcast_logical_xor}[op]
+            out = fn(get(ins[0]), get(ins[1]), name=name)
+        elif op == "Not":
+            out = mx.sym.logical_not(get(ins[0]), name=name)
+        elif op == "Expand":
+            shape = tuple(int(x) for x in inits[ins[1]])
+            consumed(ins[1])
+            out = mx.sym.broadcast_to(get(ins[0]), shape=shape, name=name)
+        elif op in ("DepthToSpace", "SpaceToDepth"):
+            fn = mx.sym.depth_to_space if op == "DepthToSpace" \
+                else mx.sym.space_to_depth
+            out = fn(get(ins[0]), block_size=int(a["blocksize"]),
+                     name=name)
+        elif op == "Shape":
+            out = mx.sym.shape_array(get(ins[0]), name=name)
+        elif op == "Size":
+            out = mx.sym.size_array(get(ins[0]), name=name)
+        elif op in ("ReduceL1", "ReduceL2"):
+            ax = tuple(int(x) for x in a.get("axes", ())) or None
+            out = mx.sym.norm(get(ins[0]), ord=1 if op == "ReduceL1"
+                              else 2, axis=ax,
+                              keepdims=bool(a.get("keepdims", 1)),
+                              name=name)
+        elif op == "LpNormalization":
+            if int(a.get("p", 2)) != 2 or int(a.get("axis", -1)) != 1:
+                raise NotImplementedError("LpNormalization p!=2/axis!=1")
+            out = mx.sym.L2Normalization(get(ins[0]), mode="channel",
+                                         name=name)
+        elif op == "ConvTranspose":
+            kernel = tuple(a["kernel_shape"])
+            kw = dict(kernel=kernel,
+                      num_filter=int(inits[ins[1]].shape[1]) *
+                      int(a.get("group", 1)),
+                      num_group=int(a.get("group", 1)),
+                      stride=tuple(a.get("strides",
+                                         (1,) * len(kernel))),
+                      dilate=tuple(a.get("dilations",
+                                         (1,) * len(kernel))),
+                      no_bias=len(ins) < 3, name=name)
+            pads = _pads(a)
+            if pads:
+                kw["pad"] = pads
+            if a.get("output_padding"):
+                kw["adj"] = tuple(a["output_padding"])
+            out = mx.sym.Deconvolution(*[get(i) for i in ins], **kw)
+        elif op == "MaxRoiPool":
+            out = mx.sym.ROIPooling(
+                get(ins[0]), get(ins[1]),
+                pooled_size=tuple(a["pooled_shape"]),
+                spatial_scale=float(a.get("spatial_scale", 1.0)),
+                name=name)
+        elif op in ("LSTM", "GRU", "RNN"):
+            out = _import_rnn(mx, op, node, a, ins, inits, get,
+                              consumed, name)
         else:
             raise NotImplementedError("no importer for ONNX op %r" % op)
-        if isinstance(out, mx.sym.Symbol) and len(node["outputs"]) > 1 \
+        if isinstance(out, list):
+            for i, oname in enumerate(node["outputs"]):
+                if i < len(out):
+                    env[oname] = out[i]
+        elif isinstance(out, mx.sym.Symbol) and len(node["outputs"]) > 1 \
                 and len(out) == len(node["outputs"]):
             for i, oname in enumerate(node["outputs"]):
                 env[oname] = out[i]

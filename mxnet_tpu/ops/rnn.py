@@ -115,8 +115,17 @@ def _layer_scan(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
     return ys, hT, cT
 
 
+def _rnn_visible(attrs):
+    """Symbol-visible outputs: (out[, hy[, cy]]) when state_outputs."""
+    so = str(attrs.get("state_outputs", "True")).lower() in ("true", "1")
+    if not so:
+        return [0]
+    return [0, 1, 2] if str(attrs.get("mode", "lstm")) == "lstm" \
+        else [0, 1]
+
+
 @register("RNN", input_names=("data", "parameters", "state", "state_cell"),
-          needs_rng=True, train_aware=True)
+          needs_rng=True, train_aware=True, visible_out=_rnn_visible)
 def _rnn(rng, data, parameters, state, state_cell=None, mode="lstm",
          state_size=0, num_layers=1, bidirectional=False, p=0.0,
          state_outputs=True, lstm_state_clip_min=None,

@@ -106,10 +106,12 @@ def constraint(x, *spec_entries, mesh=None):
     except AttributeError:  # pragma: no cover - older jax
         manual = set()
     if manual:
-        spec_entries = tuple(
-            None if e in manual or (
-                isinstance(e, (tuple, list)) and set(e) & manual) else e
-            for e in spec_entries)
+        def strip(e):
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a not in manual)
+                return kept if kept else None
+            return None if e in manual else e
+        spec_entries = tuple(strip(e) for e in spec_entries)
     spec = _filter_spec(PartitionSpec(*spec_entries), mesh, shape=x.shape)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh.mesh, spec))
