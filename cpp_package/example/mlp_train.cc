@@ -118,9 +118,9 @@ int main() {
       kv.Push(key, exe.grad_arrays[i]);
       NDArray g(arg_shapes[i], ctx);
       kv.Pull(key, &g);
-      Operator("sgd_update")(in_args[i])(g)
-          .SetParam("lr", 0.1f)
-          .Invoke();
+      // generated typed wrapper (op.h) — same ABI as the fluent
+      // Operator("sgd_update") builder, emitted from the registry
+      op::sgd_update(in_args[i], g, /*lr=*/0.1);
     }
     // accuracy from the softmax output
     std::vector<float> probs = exe.outputs[0].ToVector();

@@ -1,0 +1,3935 @@
+/* GENERATED FILE — do not edit.
+ * Produced by cpp_package/scripts/generate_op_wrappers.py from the live
+ * op registry (mxnet_tpu/ops/registry.py), the TPU analogue of the
+ * reference's OpWrapperGenerator.py output.  One typed inline function
+ * per operator, lowering onto Operator(...)/MXImperativeInvoke.
+ */
+#ifndef MXNET_CPP_OP_H_
+#define MXNET_CPP_OP_H_
+
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/operator.h"
+
+namespace mxnet {
+namespace cpp {
+namespace op {
+
+inline std::vector<NDArray> Activation(const NDArray& data,
+    const std::string& act_type = "relu") {
+  Operator op_("Activation");
+  op_.SetParam("act_type", act_type);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> BatchNorm(const NDArray& data,
+    const NDArray& gamma,
+    const NDArray& beta,
+    const NDArray& moving_mean,
+    const NDArray& moving_var,
+    double eps = 0.001,
+    double momentum = 0.9,
+    bool fix_gamma = true,
+    bool use_global_stats = false,
+    bool output_mean_var = false,
+    int axis = 1,
+    bool cudnn_off = false) {
+  Operator op_("BatchNorm");
+  op_.SetParam("eps", eps);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("fix_gamma", fix_gamma);
+  op_.SetParam("use_global_stats", use_global_stats);
+  op_.SetParam("output_mean_var", output_mean_var);
+  op_.SetParam("axis", axis);
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.PushInput(data);
+  op_.PushInput(gamma);
+  op_.PushInput(beta);
+  op_.PushInput(moving_mean);
+  op_.PushInput(moving_var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> BilinearSampler(const NDArray& data,
+    const NDArray& grid,
+    const std::string& cudnn_off = "__default__") {
+  Operator op_("BilinearSampler");
+  if (cudnn_off != "__default__") {
+    op_.SetParam("cudnn_off", cudnn_off);
+  }
+  op_.PushInput(data);
+  op_.PushInput(grid);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> BlockGrad(const NDArray& x) {
+  Operator op_("BlockGrad");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> CTCLoss(const NDArray& data,
+    const NDArray& label,
+    const std::string& data_lengths = "__default__",
+    const std::string& label_lengths = "__default__",
+    bool use_data_lengths = false,
+    bool use_label_lengths = false,
+    const std::string& blank_label = "last") {
+  Operator op_("CTCLoss");
+  if (data_lengths != "__default__") {
+    op_.SetParam("data_lengths", data_lengths);
+  }
+  if (label_lengths != "__default__") {
+    op_.SetParam("label_lengths", label_lengths);
+  }
+  op_.SetParam("use_data_lengths", use_data_lengths);
+  op_.SetParam("use_label_lengths", use_label_lengths);
+  op_.SetParam("blank_label", blank_label);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Concat(const std::vector<NDArray>& inputs,
+    int dim = 1,
+    const std::string& num_args = "__default__") {
+  Operator op_("Concat");
+  op_.SetParam("dim", dim);
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Convolution(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& bias,
+    const std::string& kernel = "()",
+    const std::string& stride = "()",
+    const std::string& dilate = "()",
+    const std::string& pad = "()",
+    int num_filter = 1,
+    int num_group = 1,
+    bool no_bias = false,
+    const std::string& cudnn_tune = "__default__",
+    bool cudnn_off = false,
+    int workspace = 1024,
+    const std::string& layout = "__default__") {
+  Operator op_("Convolution");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("stride", stride);
+  op_.SetParam("dilate", dilate);
+  op_.SetParam("pad", pad);
+  op_.SetParam("num_filter", num_filter);
+  op_.SetParam("num_group", num_group);
+  op_.SetParam("no_bias", no_bias);
+  if (cudnn_tune != "__default__") {
+    op_.SetParam("cudnn_tune", cudnn_tune);
+  }
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.SetParam("workspace", workspace);
+  if (layout != "__default__") {
+    op_.SetParam("layout", layout);
+  }
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Correlation(const NDArray& data1,
+    const NDArray& data2,
+    int kernel_size = 1,
+    int max_displacement = 1,
+    int stride1 = 1,
+    int stride2 = 1,
+    int pad_size = 0,
+    bool is_multiply = true) {
+  Operator op_("Correlation");
+  op_.SetParam("kernel_size", kernel_size);
+  op_.SetParam("max_displacement", max_displacement);
+  op_.SetParam("stride1", stride1);
+  op_.SetParam("stride2", stride2);
+  op_.SetParam("pad_size", pad_size);
+  op_.SetParam("is_multiply", is_multiply);
+  op_.PushInput(data1);
+  op_.PushInput(data2);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Crop(const NDArray& data,
+    const NDArray& crop_like,
+    const std::string& offset = "(0, 0)",
+    const std::string& h_w = "(0, 0)",
+    int num_args = 1,
+    bool center_crop = false) {
+  Operator op_("Crop");
+  op_.SetParam("offset", offset);
+  op_.SetParam("h_w", h_w);
+  op_.SetParam("num_args", num_args);
+  op_.SetParam("center_crop", center_crop);
+  op_.PushInput(data);
+  op_.PushInput(crop_like);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Deconvolution(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& bias,
+    const std::string& kernel = "()",
+    const std::string& stride = "()",
+    const std::string& dilate = "()",
+    const std::string& pad = "()",
+    const std::string& adj = "()",
+    int num_filter = 1,
+    int num_group = 1,
+    bool no_bias = true,
+    const std::string& target_shape = "__default__",
+    const std::string& cudnn_tune = "__default__",
+    bool cudnn_off = false,
+    int workspace = 1024,
+    const std::string& layout = "__default__") {
+  Operator op_("Deconvolution");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("stride", stride);
+  op_.SetParam("dilate", dilate);
+  op_.SetParam("pad", pad);
+  op_.SetParam("adj", adj);
+  op_.SetParam("num_filter", num_filter);
+  op_.SetParam("num_group", num_group);
+  op_.SetParam("no_bias", no_bias);
+  if (target_shape != "__default__") {
+    op_.SetParam("target_shape", target_shape);
+  }
+  if (cudnn_tune != "__default__") {
+    op_.SetParam("cudnn_tune", cudnn_tune);
+  }
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.SetParam("workspace", workspace);
+  if (layout != "__default__") {
+    op_.SetParam("layout", layout);
+  }
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Dropout(const NDArray& data,
+    double p = 0.5,
+    const std::string& mode = "training",
+    const std::string& axes = "()",
+    bool cudnn_off = false) {
+  Operator op_("Dropout");
+  op_.SetParam("p", p);
+  op_.SetParam("mode", mode);
+  op_.SetParam("axes", axes);
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Embedding(const NDArray& data,
+    const NDArray& weight,
+    const std::string& input_dim = "__default__",
+    const std::string& output_dim = "__default__",
+    const std::string& dtype = "float32",
+    bool sparse_grad = false) {
+  Operator op_("Embedding");
+  if (input_dim != "__default__") {
+    op_.SetParam("input_dim", input_dim);
+  }
+  if (output_dim != "__default__") {
+    op_.SetParam("output_dim", output_dim);
+  }
+  op_.SetParam("dtype", dtype);
+  op_.SetParam("sparse_grad", sparse_grad);
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Flatten(const NDArray& x) {
+  Operator op_("Flatten");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> FullyConnected(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& bias,
+    const std::string& num_hidden = "__default__",
+    bool no_bias = false,
+    bool flatten = true) {
+  Operator op_("FullyConnected");
+  if (num_hidden != "__default__") {
+    op_.SetParam("num_hidden", num_hidden);
+  }
+  op_.SetParam("no_bias", no_bias);
+  op_.SetParam("flatten", flatten);
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> GridGenerator(const NDArray& data,
+    const std::string& transform_type = "affine",
+    const std::string& target_shape = "(0, 0)") {
+  Operator op_("GridGenerator");
+  op_.SetParam("transform_type", transform_type);
+  op_.SetParam("target_shape", target_shape);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> GroupNorm(const NDArray& data,
+    const NDArray& gamma,
+    const NDArray& beta,
+    int num_groups = 1,
+    double eps = 1e-05,
+    bool output_mean_var = false) {
+  Operator op_("GroupNorm");
+  op_.SetParam("num_groups", num_groups);
+  op_.SetParam("eps", eps);
+  op_.SetParam("output_mean_var", output_mean_var);
+  op_.PushInput(data);
+  op_.PushInput(gamma);
+  op_.PushInput(beta);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> IdentityAttachKLSparseReg(const NDArray& data,
+    const NDArray& moving_avg,
+    double sparseness_target = 0.1,
+    double penalty = 0.001,
+    double momentum = 0.9) {
+  Operator op_("IdentityAttachKLSparseReg");
+  op_.SetParam("sparseness_target", sparseness_target);
+  op_.SetParam("penalty", penalty);
+  op_.SetParam("momentum", momentum);
+  op_.PushInput(data);
+  op_.PushInput(moving_avg);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> InstanceNorm(const NDArray& data,
+    const NDArray& gamma,
+    const NDArray& beta,
+    double eps = 0.001) {
+  Operator op_("InstanceNorm");
+  op_.SetParam("eps", eps);
+  op_.PushInput(data);
+  op_.PushInput(gamma);
+  op_.PushInput(beta);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> L2Normalization(const NDArray& data,
+    double eps = 1e-10,
+    const std::string& mode = "instance") {
+  Operator op_("L2Normalization");
+  op_.SetParam("eps", eps);
+  op_.SetParam("mode", mode);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> LRN(const NDArray& data,
+    double alpha = 0.0001,
+    double beta = 0.75,
+    double knorm = 2.0,
+    int nsize = 5) {
+  Operator op_("LRN");
+  op_.SetParam("alpha", alpha);
+  op_.SetParam("beta", beta);
+  op_.SetParam("knorm", knorm);
+  op_.SetParam("nsize", nsize);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> LayerNorm(const NDArray& data,
+    const NDArray& gamma,
+    const NDArray& beta,
+    int axis = -1,
+    double eps = 1e-05,
+    bool output_mean_var = false) {
+  Operator op_("LayerNorm");
+  op_.SetParam("axis", axis);
+  op_.SetParam("eps", eps);
+  op_.SetParam("output_mean_var", output_mean_var);
+  op_.PushInput(data);
+  op_.PushInput(gamma);
+  op_.PushInput(beta);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> LeakyReLU(const NDArray& data,
+    const NDArray& gamma,
+    const std::string& act_type = "leaky",
+    double slope = 0.25,
+    double lower_bound = 0.125,
+    double upper_bound = 0.334) {
+  Operator op_("LeakyReLU");
+  op_.SetParam("act_type", act_type);
+  op_.SetParam("slope", slope);
+  op_.SetParam("lower_bound", lower_bound);
+  op_.SetParam("upper_bound", upper_bound);
+  op_.PushInput(data);
+  op_.PushInput(gamma);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> LinearRegressionOutput(const NDArray& data,
+    const NDArray& label,
+    double grad_scale = 1.0) {
+  Operator op_("LinearRegressionOutput");
+  op_.SetParam("grad_scale", grad_scale);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> LogisticRegressionOutput(const NDArray& data,
+    const NDArray& label,
+    double grad_scale = 1.0) {
+  Operator op_("LogisticRegressionOutput");
+  op_.SetParam("grad_scale", grad_scale);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> MAERegressionOutput(const NDArray& data,
+    const NDArray& label,
+    double grad_scale = 1.0) {
+  Operator op_("MAERegressionOutput");
+  op_.SetParam("grad_scale", grad_scale);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> MakeLoss(const NDArray& data,
+    double grad_scale = 1.0,
+    double valid_thresh = 0.0,
+    const std::string& normalization = "null") {
+  Operator op_("MakeLoss");
+  op_.SetParam("grad_scale", grad_scale);
+  op_.SetParam("valid_thresh", valid_thresh);
+  op_.SetParam("normalization", normalization);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> Pooling(const NDArray& data,
+    const std::string& kernel = "()",
+    const std::string& pool_type = "max",
+    bool global_pool = false,
+    const std::string& stride = "()",
+    const std::string& pad = "()",
+    const std::string& pooling_convention = "valid",
+    bool count_include_pad = true,
+    bool cudnn_off = false,
+    int p_value = 2,
+    const std::string& layout = "__default__") {
+  Operator op_("Pooling");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("pool_type", pool_type);
+  op_.SetParam("global_pool", global_pool);
+  op_.SetParam("stride", stride);
+  op_.SetParam("pad", pad);
+  op_.SetParam("pooling_convention", pooling_convention);
+  op_.SetParam("count_include_pad", count_include_pad);
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.SetParam("p_value", p_value);
+  if (layout != "__default__") {
+    op_.SetParam("layout", layout);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> RNN(const NDArray& data,
+    const NDArray& parameters,
+    const NDArray& state,
+    const NDArray& state_cell,
+    const std::string& mode = "lstm",
+    int state_size = 0,
+    int num_layers = 1,
+    bool bidirectional = false,
+    double p = 0.0,
+    bool state_outputs = true,
+    const std::string& lstm_state_clip_min = "__default__",
+    const std::string& lstm_state_clip_max = "__default__",
+    bool lstm_state_clip_nan = false,
+    const std::string& projection_size = "__default__",
+    bool use_sequence_length = false) {
+  Operator op_("RNN");
+  op_.SetParam("mode", mode);
+  op_.SetParam("state_size", state_size);
+  op_.SetParam("num_layers", num_layers);
+  op_.SetParam("bidirectional", bidirectional);
+  op_.SetParam("p", p);
+  op_.SetParam("state_outputs", state_outputs);
+  if (lstm_state_clip_min != "__default__") {
+    op_.SetParam("lstm_state_clip_min", lstm_state_clip_min);
+  }
+  if (lstm_state_clip_max != "__default__") {
+    op_.SetParam("lstm_state_clip_max", lstm_state_clip_max);
+  }
+  op_.SetParam("lstm_state_clip_nan", lstm_state_clip_nan);
+  if (projection_size != "__default__") {
+    op_.SetParam("projection_size", projection_size);
+  }
+  op_.SetParam("use_sequence_length", use_sequence_length);
+  op_.PushInput(data);
+  op_.PushInput(parameters);
+  op_.PushInput(state);
+  op_.PushInput(state_cell);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ROIPooling(const NDArray& data,
+    const NDArray& rois,
+    const std::string& pooled_size = "(7, 7)",
+    double spatial_scale = 1.0) {
+  Operator op_("ROIPooling");
+  op_.SetParam("pooled_size", pooled_size);
+  op_.SetParam("spatial_scale", spatial_scale);
+  op_.PushInput(data);
+  op_.PushInput(rois);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SVMOutput(const NDArray& data,
+    const NDArray& label,
+    double margin = 1.0,
+    double regularization_coefficient = 1.0,
+    bool use_linear = false) {
+  Operator op_("SVMOutput");
+  op_.SetParam("margin", margin);
+  op_.SetParam("regularization_coefficient", regularization_coefficient);
+  op_.SetParam("use_linear", use_linear);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SequenceLast(const NDArray& data,
+    const NDArray& sequence_length,
+    bool use_sequence_length = false,
+    int axis = 0) {
+  Operator op_("SequenceLast");
+  op_.SetParam("use_sequence_length", use_sequence_length);
+  op_.SetParam("axis", axis);
+  op_.PushInput(data);
+  op_.PushInput(sequence_length);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SequenceMask(const NDArray& data,
+    const NDArray& sequence_length,
+    bool use_sequence_length = false,
+    double value = 0.0,
+    int axis = 0) {
+  Operator op_("SequenceMask");
+  op_.SetParam("use_sequence_length", use_sequence_length);
+  op_.SetParam("value", value);
+  op_.SetParam("axis", axis);
+  op_.PushInput(data);
+  op_.PushInput(sequence_length);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SequenceReverse(const NDArray& data,
+    const NDArray& sequence_length,
+    bool use_sequence_length = false,
+    int axis = 0) {
+  Operator op_("SequenceReverse");
+  op_.SetParam("use_sequence_length", use_sequence_length);
+  op_.SetParam("axis", axis);
+  op_.PushInput(data);
+  op_.PushInput(sequence_length);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SoftmaxActivation(const NDArray& data,
+    const std::string& mode = "instance") {
+  Operator op_("SoftmaxActivation");
+  op_.SetParam("mode", mode);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SoftmaxOutput(const NDArray& data,
+    const NDArray& label,
+    double grad_scale = 1.0,
+    double ignore_label = -1.0,
+    bool multi_output = false,
+    bool use_ignore = false,
+    bool preserve_shape = false,
+    const std::string& normalization = "null",
+    bool out_grad = false,
+    double smooth_alpha = 0.0) {
+  Operator op_("SoftmaxOutput");
+  op_.SetParam("grad_scale", grad_scale);
+  op_.SetParam("ignore_label", ignore_label);
+  op_.SetParam("multi_output", multi_output);
+  op_.SetParam("use_ignore", use_ignore);
+  op_.SetParam("preserve_shape", preserve_shape);
+  op_.SetParam("normalization", normalization);
+  op_.SetParam("out_grad", out_grad);
+  op_.SetParam("smooth_alpha", smooth_alpha);
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> SpatialTransformer(const NDArray& data,
+    const NDArray& loc,
+    const std::string& target_shape = "(0, 0)",
+    const std::string& transform_type = "affine",
+    const std::string& sampler_type = "bilinear",
+    const std::string& cudnn_off = "__default__") {
+  Operator op_("SpatialTransformer");
+  op_.SetParam("target_shape", target_shape);
+  op_.SetParam("transform_type", transform_type);
+  op_.SetParam("sampler_type", sampler_type);
+  if (cudnn_off != "__default__") {
+    op_.SetParam("cudnn_off", cudnn_off);
+  }
+  op_.PushInput(data);
+  op_.PushInput(loc);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> UpSampling(const NDArray& data,
+    int scale = 2,
+    const std::string& sample_type = "nearest",
+    int num_args = 1,
+    int num_filter = 0,
+    const std::string& multi_input_mode = "concat",
+    const std::string& workspace = "__default__") {
+  Operator op_("UpSampling");
+  op_.SetParam("scale", scale);
+  op_.SetParam("sample_type", sample_type);
+  op_.SetParam("num_args", num_args);
+  op_.SetParam("num_filter", num_filter);
+  op_.SetParam("multi_input_mode", multi_input_mode);
+  if (workspace != "__default__") {
+    op_.SetParam("workspace", workspace);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _arange(double start = 0.0,
+    const std::string& stop = "__default__",
+    double step = 1.0,
+    int repeat = 1,
+    const std::string& dtype = "float32") {
+  Operator op_("_arange");
+  op_.SetParam("start", start);
+  if (stop != "__default__") {
+    op_.SetParam("stop", stop);
+  }
+  op_.SetParam("step", step);
+  op_.SetParam("repeat", repeat);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _cond(const std::vector<NDArray>& inputs,
+    const std::string& pred_graph = "",
+    const std::string& then_graph = "",
+    const std::string& else_graph = "",
+    int n_out = 0,
+    const std::string& pred_free_names = "()",
+    const std::string& then_free_names = "()",
+    const std::string& else_free_names = "()") {
+  Operator op_("_cond");
+  op_.SetParam("pred_graph", pred_graph);
+  op_.SetParam("then_graph", then_graph);
+  op_.SetParam("else_graph", else_graph);
+  op_.SetParam("n_out", n_out);
+  op_.SetParam("pred_free_names", pred_free_names);
+  op_.SetParam("then_free_names", then_free_names);
+  op_.SetParam("else_free_names", else_free_names);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_AdaptiveAvgPooling2D(const NDArray& data,
+    const std::string& output_size = "(1, 1)") {
+  Operator op_("_contrib_AdaptiveAvgPooling2D");
+  op_.SetParam("output_size", output_size);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_BilinearResize2D(const NDArray& data,
+    int height = 1,
+    int width = 1,
+    const std::string& scale_height = "__default__",
+    const std::string& scale_width = "__default__",
+    const std::string& mode = "size") {
+  Operator op_("_contrib_BilinearResize2D");
+  op_.SetParam("height", height);
+  op_.SetParam("width", width);
+  if (scale_height != "__default__") {
+    op_.SetParam("scale_height", scale_height);
+  }
+  if (scale_width != "__default__") {
+    op_.SetParam("scale_width", scale_width);
+  }
+  op_.SetParam("mode", mode);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_DeformableConvolution(const NDArray& data,
+    const NDArray& offset,
+    const NDArray& weight,
+    const NDArray& bias,
+    const std::string& kernel = "(3, 3)",
+    const std::string& stride = "(1, 1)",
+    const std::string& dilate = "(1, 1)",
+    const std::string& pad = "(0, 0)",
+    int num_filter = 1,
+    int num_group = 1,
+    int num_deformable_group = 1,
+    int workspace = 1024,
+    bool no_bias = false,
+    const std::string& layout = "NCHW") {
+  Operator op_("_contrib_DeformableConvolution");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("stride", stride);
+  op_.SetParam("dilate", dilate);
+  op_.SetParam("pad", pad);
+  op_.SetParam("num_filter", num_filter);
+  op_.SetParam("num_group", num_group);
+  op_.SetParam("num_deformable_group", num_deformable_group);
+  op_.SetParam("workspace", workspace);
+  op_.SetParam("no_bias", no_bias);
+  op_.SetParam("layout", layout);
+  op_.PushInput(data);
+  op_.PushInput(offset);
+  op_.PushInput(weight);
+  op_.PushInput(bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_DeformablePSROIPooling(const NDArray& data,
+    const NDArray& rois,
+    const NDArray& trans,
+    double spatial_scale = 1.0,
+    int output_dim = 1,
+    int group_size = 1,
+    int pooled_size = 1,
+    int part_size = 0,
+    int sample_per_part = 1,
+    double trans_std = 0.0,
+    bool no_trans = false) {
+  Operator op_("_contrib_DeformablePSROIPooling");
+  op_.SetParam("spatial_scale", spatial_scale);
+  op_.SetParam("output_dim", output_dim);
+  op_.SetParam("group_size", group_size);
+  op_.SetParam("pooled_size", pooled_size);
+  op_.SetParam("part_size", part_size);
+  op_.SetParam("sample_per_part", sample_per_part);
+  op_.SetParam("trans_std", trans_std);
+  op_.SetParam("no_trans", no_trans);
+  op_.PushInput(data);
+  op_.PushInput(rois);
+  op_.PushInput(trans);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_MultiBoxDetection(const NDArray& cls_prob,
+    const NDArray& loc_pred,
+    const NDArray& anchor,
+    bool clip = true,
+    double threshold = 0.01,
+    int background_id = 0,
+    double nms_threshold = 0.5,
+    bool force_suppress = false,
+    const std::string& variances = "(0.1, 0.1, 0.2, 0.2)",
+    int nms_topk = -1) {
+  Operator op_("_contrib_MultiBoxDetection");
+  op_.SetParam("clip", clip);
+  op_.SetParam("threshold", threshold);
+  op_.SetParam("background_id", background_id);
+  op_.SetParam("nms_threshold", nms_threshold);
+  op_.SetParam("force_suppress", force_suppress);
+  op_.SetParam("variances", variances);
+  op_.SetParam("nms_topk", nms_topk);
+  op_.PushInput(cls_prob);
+  op_.PushInput(loc_pred);
+  op_.PushInput(anchor);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_MultiBoxPrior(const NDArray& data,
+    const std::string& sizes = "(1.0,)",
+    const std::string& ratios = "(1.0,)",
+    bool clip = false,
+    const std::string& steps = "(-1.0, -1.0)",
+    const std::string& offsets = "(0.5, 0.5)") {
+  Operator op_("_contrib_MultiBoxPrior");
+  op_.SetParam("sizes", sizes);
+  op_.SetParam("ratios", ratios);
+  op_.SetParam("clip", clip);
+  op_.SetParam("steps", steps);
+  op_.SetParam("offsets", offsets);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_MultiBoxTarget(const NDArray& anchor,
+    const NDArray& label,
+    const NDArray& cls_pred,
+    double overlap_threshold = 0.5,
+    double ignore_label = -1.0,
+    double negative_mining_ratio = -1.0,
+    double negative_mining_thresh = 0.5,
+    int minimum_negative_samples = 0,
+    const std::string& variances = "(0.1, 0.1, 0.2, 0.2)") {
+  Operator op_("_contrib_MultiBoxTarget");
+  op_.SetParam("overlap_threshold", overlap_threshold);
+  op_.SetParam("ignore_label", ignore_label);
+  op_.SetParam("negative_mining_ratio", negative_mining_ratio);
+  op_.SetParam("negative_mining_thresh", negative_mining_thresh);
+  op_.SetParam("minimum_negative_samples", minimum_negative_samples);
+  op_.SetParam("variances", variances);
+  op_.PushInput(anchor);
+  op_.PushInput(label);
+  op_.PushInput(cls_pred);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_PSROIPooling(const NDArray& data,
+    const NDArray& rois,
+    double spatial_scale = 1.0,
+    int output_dim = 1,
+    int pooled_size = 7,
+    int group_size = 0) {
+  Operator op_("_contrib_PSROIPooling");
+  op_.SetParam("spatial_scale", spatial_scale);
+  op_.SetParam("output_dim", output_dim);
+  op_.SetParam("pooled_size", pooled_size);
+  op_.SetParam("group_size", group_size);
+  op_.PushInput(data);
+  op_.PushInput(rois);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_Proposal(const NDArray& cls_prob,
+    const NDArray& bbox_pred,
+    const NDArray& im_info,
+    int rpn_pre_nms_top_n = 6000,
+    int rpn_post_nms_top_n = 300,
+    double threshold = 0.7,
+    int rpn_min_size = 16,
+    const std::string& scales = "(4, 8, 16, 32)",
+    const std::string& ratios = "(0.5, 1, 2)",
+    int feature_stride = 16,
+    bool output_score = false,
+    bool iou_loss = false) {
+  Operator op_("_contrib_Proposal");
+  op_.SetParam("rpn_pre_nms_top_n", rpn_pre_nms_top_n);
+  op_.SetParam("rpn_post_nms_top_n", rpn_post_nms_top_n);
+  op_.SetParam("threshold", threshold);
+  op_.SetParam("rpn_min_size", rpn_min_size);
+  op_.SetParam("scales", scales);
+  op_.SetParam("ratios", ratios);
+  op_.SetParam("feature_stride", feature_stride);
+  op_.SetParam("output_score", output_score);
+  op_.SetParam("iou_loss", iou_loss);
+  op_.PushInput(cls_prob);
+  op_.PushInput(bbox_pred);
+  op_.PushInput(im_info);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_ROIAlign(const NDArray& data,
+    const NDArray& rois,
+    const std::string& pooled_size = "(7, 7)",
+    double spatial_scale = 1.0,
+    int sample_ratio = 2,
+    bool position_sensitive = false,
+    bool aligned = false) {
+  Operator op_("_contrib_ROIAlign");
+  op_.SetParam("pooled_size", pooled_size);
+  op_.SetParam("spatial_scale", spatial_scale);
+  op_.SetParam("sample_ratio", sample_ratio);
+  op_.SetParam("position_sensitive", position_sensitive);
+  op_.SetParam("aligned", aligned);
+  op_.PushInput(data);
+  op_.PushInput(rois);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_allclose(const NDArray& a,
+    const NDArray& b,
+    double rtol = 1e-05,
+    double atol = 1e-08,
+    bool equal_nan = true) {
+  Operator op_("_contrib_allclose");
+  op_.SetParam("rtol", rtol);
+  op_.SetParam("atol", atol);
+  op_.SetParam("equal_nan", equal_nan);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_arange_like(const NDArray& data,
+    double start = 0.0,
+    double step = 1.0,
+    int repeat = 1,
+    const std::string& axis = "__default__") {
+  Operator op_("_contrib_arange_like");
+  op_.SetParam("start", start);
+  op_.SetParam("step", step);
+  op_.SetParam("repeat", repeat);
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_bipartite_matching(const NDArray& data,
+    double threshold = 0.5,
+    bool is_ascend = false,
+    int topk = -1) {
+  Operator op_("_contrib_bipartite_matching");
+  op_.SetParam("threshold", threshold);
+  op_.SetParam("is_ascend", is_ascend);
+  op_.SetParam("topk", topk);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_boolean_mask(const NDArray& data,
+    const NDArray& index,
+    int axis = 0) {
+  Operator op_("_contrib_boolean_mask");
+  op_.SetParam("axis", axis);
+  op_.PushInput(data);
+  op_.PushInput(index);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_box_iou(const NDArray& lhs,
+    const NDArray& rhs,
+    const std::string& format = "corner") {
+  Operator op_("_contrib_box_iou");
+  op_.SetParam("format", format);
+  op_.PushInput(lhs);
+  op_.PushInput(rhs);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_box_nms(const NDArray& data,
+    double overlap_thresh = 0.5,
+    double valid_thresh = 0.0,
+    int topk = -1,
+    int coord_start = 2,
+    int score_index = 1,
+    int id_index = -1,
+    int background_id = -1,
+    bool force_suppress = false,
+    const std::string& in_format = "corner",
+    const std::string& out_format = "corner") {
+  Operator op_("_contrib_box_nms");
+  op_.SetParam("overlap_thresh", overlap_thresh);
+  op_.SetParam("valid_thresh", valid_thresh);
+  op_.SetParam("topk", topk);
+  op_.SetParam("coord_start", coord_start);
+  op_.SetParam("score_index", score_index);
+  op_.SetParam("id_index", id_index);
+  op_.SetParam("background_id", background_id);
+  op_.SetParam("force_suppress", force_suppress);
+  op_.SetParam("in_format", in_format);
+  op_.SetParam("out_format", out_format);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_count_sketch(const NDArray& data,
+    const NDArray& h,
+    const NDArray& s,
+    int out_dim = 1,
+    int processing_batch_size = 32) {
+  Operator op_("_contrib_count_sketch");
+  op_.SetParam("out_dim", out_dim);
+  op_.SetParam("processing_batch_size", processing_batch_size);
+  op_.PushInput(data);
+  op_.PushInput(h);
+  op_.PushInput(s);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_dequantize(const NDArray& data,
+    const NDArray& min_range,
+    const NDArray& max_range,
+    const std::string& out_type = "float32") {
+  Operator op_("_contrib_dequantize");
+  op_.SetParam("out_type", out_type);
+  op_.PushInput(data);
+  op_.PushInput(min_range);
+  op_.PushInput(max_range);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_div_sqrt_dim(const NDArray& x) {
+  Operator op_("_contrib_div_sqrt_dim");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_fft(const NDArray& data,
+    int compute_size = 128) {
+  Operator op_("_contrib_fft");
+  op_.SetParam("compute_size", compute_size);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_gradientmultiplier(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_contrib_gradientmultiplier");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_ifft(const NDArray& data,
+    int compute_size = 128) {
+  Operator op_("_contrib_ifft");
+  op_.SetParam("compute_size", compute_size);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_index_array(const NDArray& data,
+    const std::string& axes = "__default__") {
+  Operator op_("_contrib_index_array");
+  if (axes != "__default__") {
+    op_.SetParam("axes", axes);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_index_copy(const NDArray& old_tensor,
+    const NDArray& index_vector,
+    const NDArray& new_tensor) {
+  Operator op_("_contrib_index_copy");
+  op_.PushInput(old_tensor);
+  op_.PushInput(index_vector);
+  op_.PushInput(new_tensor);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_mp_adamw_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    const NDArray& weight32,
+    const NDArray& rescale_grad,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double eta = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("_contrib_mp_adamw_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("eta", eta);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  op_.PushInput(weight32);
+  op_.PushInput(rescale_grad);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quadratic(const NDArray& data,
+    double a = 0.0,
+    double b = 0.0,
+    double c = 0.0) {
+  Operator op_("_contrib_quadratic");
+  op_.SetParam("a", a);
+  op_.SetParam("b", b);
+  op_.SetParam("c", c);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantize_v2(const NDArray& data,
+    const std::string& min_calib_range = "__default__",
+    const std::string& max_calib_range = "__default__",
+    const std::string& out_type = "int8") {
+  Operator op_("_contrib_quantize_v2");
+  if (min_calib_range != "__default__") {
+    op_.SetParam("min_calib_range", min_calib_range);
+  }
+  if (max_calib_range != "__default__") {
+    op_.SetParam("max_calib_range", max_calib_range);
+  }
+  op_.SetParam("out_type", out_type);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_act(const NDArray& data,
+    const NDArray& min_data,
+    const NDArray& max_data,
+    const std::string& act_type = "relu") {
+  Operator op_("_contrib_quantized_act");
+  op_.SetParam("act_type", act_type);
+  op_.PushInput(data);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_concat(const std::vector<NDArray>& inputs,
+    int dim = 1,
+    const std::string& num_args = "__default__") {
+  Operator op_("_contrib_quantized_concat");
+  op_.SetParam("dim", dim);
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_conv(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& min_data,
+    const NDArray& max_data,
+    const NDArray& min_weight,
+    const NDArray& max_weight,
+    const NDArray& bias,
+    const NDArray& min_bias,
+    const NDArray& max_bias,
+    const std::string& kernel = "()",
+    const std::string& stride = "()",
+    const std::string& dilate = "()",
+    const std::string& pad = "()",
+    int num_filter = 1,
+    int num_group = 1,
+    bool no_bias = false,
+    const std::string& layout = "__default__",
+    const std::string& cudnn_tune = "__default__",
+    bool cudnn_off = false,
+    int workspace = 1024) {
+  Operator op_("_contrib_quantized_conv");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("stride", stride);
+  op_.SetParam("dilate", dilate);
+  op_.SetParam("pad", pad);
+  op_.SetParam("num_filter", num_filter);
+  op_.SetParam("num_group", num_group);
+  op_.SetParam("no_bias", no_bias);
+  if (layout != "__default__") {
+    op_.SetParam("layout", layout);
+  }
+  if (cudnn_tune != "__default__") {
+    op_.SetParam("cudnn_tune", cudnn_tune);
+  }
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.SetParam("workspace", workspace);
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  op_.PushInput(min_weight);
+  op_.PushInput(max_weight);
+  op_.PushInput(bias);
+  op_.PushInput(min_bias);
+  op_.PushInput(max_bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_elemwise_add(const NDArray& lhs,
+    const NDArray& rhs,
+    const NDArray& min_lhs,
+    const NDArray& max_lhs,
+    const NDArray& min_rhs,
+    const NDArray& max_rhs,
+    const std::string& min_calib_range = "__default__",
+    const std::string& max_calib_range = "__default__",
+    bool with_relu = false) {
+  Operator op_("_contrib_quantized_elemwise_add");
+  if (min_calib_range != "__default__") {
+    op_.SetParam("min_calib_range", min_calib_range);
+  }
+  if (max_calib_range != "__default__") {
+    op_.SetParam("max_calib_range", max_calib_range);
+  }
+  op_.SetParam("with_relu", with_relu);
+  op_.PushInput(lhs);
+  op_.PushInput(rhs);
+  op_.PushInput(min_lhs);
+  op_.PushInput(max_lhs);
+  op_.PushInput(min_rhs);
+  op_.PushInput(max_rhs);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_flatten(const NDArray& data,
+    const NDArray& min_data,
+    const NDArray& max_data) {
+  Operator op_("_contrib_quantized_flatten");
+  op_.PushInput(data);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_fully_connected(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& min_data,
+    const NDArray& max_data,
+    const NDArray& min_weight,
+    const NDArray& max_weight,
+    const NDArray& bias,
+    const NDArray& min_bias,
+    const NDArray& max_bias,
+    const std::string& num_hidden = "__default__",
+    bool no_bias = false,
+    bool flatten = true) {
+  Operator op_("_contrib_quantized_fully_connected");
+  if (num_hidden != "__default__") {
+    op_.SetParam("num_hidden", num_hidden);
+  }
+  op_.SetParam("no_bias", no_bias);
+  op_.SetParam("flatten", flatten);
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  op_.PushInput(min_weight);
+  op_.PushInput(max_weight);
+  op_.PushInput(bias);
+  op_.PushInput(min_bias);
+  op_.PushInput(max_bias);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_quantized_pooling(const NDArray& data,
+    const NDArray& min_data,
+    const NDArray& max_data,
+    const std::string& kernel = "()",
+    const std::string& pool_type = "max",
+    const std::string& stride = "()",
+    const std::string& pad = "()",
+    bool global_pool = false,
+    const std::string& pooling_convention = "valid",
+    bool count_include_pad = true,
+    bool cudnn_off = false) {
+  Operator op_("_contrib_quantized_pooling");
+  op_.SetParam("kernel", kernel);
+  op_.SetParam("pool_type", pool_type);
+  op_.SetParam("stride", stride);
+  op_.SetParam("pad", pad);
+  op_.SetParam("global_pool", global_pool);
+  op_.SetParam("pooling_convention", pooling_convention);
+  op_.SetParam("count_include_pad", count_include_pad);
+  op_.SetParam("cudnn_off", cudnn_off);
+  op_.PushInput(data);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _contrib_requantize(const NDArray& data,
+    const NDArray& min_range,
+    const NDArray& max_range,
+    const std::string& min_calib_range = "__default__",
+    const std::string& max_calib_range = "__default__",
+    const std::string& out_type = "int8") {
+  Operator op_("_contrib_requantize");
+  if (min_calib_range != "__default__") {
+    op_.SetParam("min_calib_range", min_calib_range);
+  }
+  if (max_calib_range != "__default__") {
+    op_.SetParam("max_calib_range", max_calib_range);
+  }
+  op_.SetParam("out_type", out_type);
+  op_.PushInput(data);
+  op_.PushInput(min_range);
+  op_.PushInput(max_range);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _div_scalar(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_div_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _eye(int N = 0,
+    int M = 0,
+    int k = 0,
+    const std::string& dtype = "float32") {
+  Operator op_("_eye");
+  op_.SetParam("N", N);
+  op_.SetParam("M", M);
+  op_.SetParam("k", k);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _foreach(const std::vector<NDArray>& inputs,
+    const std::string& subgraph = "",
+    int n_data = 0,
+    int n_state = 0,
+    int n_out = 0,
+    const std::string& data_names = "()",
+    const std::string& state_names = "()",
+    const std::string& free_names = "()") {
+  Operator op_("_foreach");
+  op_.SetParam("subgraph", subgraph);
+  op_.SetParam("n_data", n_data);
+  op_.SetParam("n_state", n_state);
+  op_.SetParam("n_out", n_out);
+  op_.SetParam("data_names", data_names);
+  op_.SetParam("state_names", state_names);
+  op_.SetParam("free_names", free_names);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _full(const std::string& shape = "()",
+    double value = 0.0,
+    const std::string& dtype = "float32") {
+  Operator op_("_full");
+  op_.SetParam("shape", shape);
+  op_.SetParam("value", value);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _full_like(const NDArray& x,
+    double value = 0.0) {
+  Operator op_("_full_like");
+  op_.SetParam("value", value);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _getitem(const NDArray& x,
+    const std::string& key = "__default__") {
+  Operator op_("_getitem");
+  if (key != "__default__") {
+    op_.SetParam("key", key);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _histogram(const NDArray& data,
+    const std::string& bins = "__default__",
+    const std::string& bin_cnt = "__default__",
+    const std::string& range = "__default__") {
+  Operator op_("_histogram");
+  if (bins != "__default__") {
+    op_.SetParam("bins", bins);
+  }
+  if (bin_cnt != "__default__") {
+    op_.SetParam("bin_cnt", bin_cnt);
+  }
+  if (range != "__default__") {
+    op_.SetParam("range", range);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_crop(const NDArray& data,
+    int x = 0,
+    int y = 0,
+    int width = 0,
+    int height = 0) {
+  Operator op_("_image_crop");
+  op_.SetParam("x", x);
+  op_.SetParam("y", y);
+  op_.SetParam("width", width);
+  op_.SetParam("height", height);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_flip_left_right(const NDArray& data) {
+  Operator op_("_image_flip_left_right");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_flip_top_bottom(const NDArray& data) {
+  Operator op_("_image_flip_top_bottom");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_normalize(const NDArray& data,
+    double mean = 0.0,
+    double std = 1.0) {
+  Operator op_("_image_normalize");
+  op_.SetParam("mean", mean);
+  op_.SetParam("std", std);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_brightness(const NDArray& data,
+    double min_factor = 0.0,
+    double max_factor = 1.0) {
+  Operator op_("_image_random_brightness");
+  op_.SetParam("min_factor", min_factor);
+  op_.SetParam("max_factor", max_factor);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_contrast(const NDArray& data,
+    double min_factor = 0.0,
+    double max_factor = 1.0) {
+  Operator op_("_image_random_contrast");
+  op_.SetParam("min_factor", min_factor);
+  op_.SetParam("max_factor", max_factor);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_flip_left_right(const NDArray& data) {
+  Operator op_("_image_random_flip_left_right");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_flip_top_bottom(const NDArray& data) {
+  Operator op_("_image_random_flip_top_bottom");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_lighting(const NDArray& data,
+    double alpha_std = 0.05) {
+  Operator op_("_image_random_lighting");
+  op_.SetParam("alpha_std", alpha_std);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_random_saturation(const NDArray& data,
+    double min_factor = 0.0,
+    double max_factor = 1.0) {
+  Operator op_("_image_random_saturation");
+  op_.SetParam("min_factor", min_factor);
+  op_.SetParam("max_factor", max_factor);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_resize(const NDArray& data,
+    int size = 0,
+    bool keep_ratio = false,
+    int interp = 1) {
+  Operator op_("_image_resize");
+  op_.SetParam("size", size);
+  op_.SetParam("keep_ratio", keep_ratio);
+  op_.SetParam("interp", interp);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _image_to_tensor(const NDArray& data) {
+  Operator op_("_image_to_tensor");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _minus_scalar(const NDArray& x,
+    double scalar = 0.0) {
+  Operator op_("_minus_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _mul_scalar(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_mul_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _ones(const std::string& shape = "()",
+    const std::string& dtype = "float32") {
+  Operator op_("_ones");
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _plus_scalar(const NDArray& x,
+    double scalar = 0.0) {
+  Operator op_("_plus_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _power_scalar(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_power_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_exponential(double lam = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_exponential");
+  op_.SetParam("lam", lam);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_gamma(double alpha = 1.0,
+    double beta = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_gamma");
+  op_.SetParam("alpha", alpha);
+  op_.SetParam("beta", beta);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_generalized_negative_binomial(double mu = 1.0,
+    double alpha = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_generalized_negative_binomial");
+  op_.SetParam("mu", mu);
+  op_.SetParam("alpha", alpha);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_negative_binomial(int k = 1,
+    double p = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_negative_binomial");
+  op_.SetParam("k", k);
+  op_.SetParam("p", p);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_normal(double loc = 0.0,
+    double scale = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_normal");
+  op_.SetParam("loc", loc);
+  op_.SetParam("scale", scale);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_poisson(double lam = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_poisson");
+  op_.SetParam("lam", lam);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_randint(int low = 0,
+    int high = 1,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "int32") {
+  Operator op_("_random_randint");
+  op_.SetParam("low", low);
+  op_.SetParam("high", high);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _random_uniform(double low = 0.0,
+    double high = 1.0,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("_random_uniform");
+  op_.SetParam("low", low);
+  op_.SetParam("high", high);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _rdiv_scalar(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_rdiv_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _rminus_scalar(const NDArray& x,
+    double scalar = 0.0) {
+  Operator op_("_rminus_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _rnn_param_concat(const std::vector<NDArray>& inputs,
+    int dim = 0,
+    const std::string& num_args = "__default__") {
+  Operator op_("_rnn_param_concat");
+  op_.SetParam("dim", dim);
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _rpower_scalar(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("_rpower_scalar");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_exponential(const NDArray& lam,
+    const std::string& shape = "()") {
+  Operator op_("_sample_exponential");
+  op_.SetParam("shape", shape);
+  op_.PushInput(lam);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_gamma(const NDArray& alpha,
+    const NDArray& beta,
+    const std::string& shape = "()") {
+  Operator op_("_sample_gamma");
+  op_.SetParam("shape", shape);
+  op_.PushInput(alpha);
+  op_.PushInput(beta);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_multinomial(const NDArray& data,
+    const std::string& shape = "()",
+    bool get_prob = false,
+    const std::string& dtype = "int32") {
+  Operator op_("_sample_multinomial");
+  op_.SetParam("shape", shape);
+  op_.SetParam("get_prob", get_prob);
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_normal(const NDArray& mu,
+    const NDArray& sigma,
+    const std::string& shape = "()") {
+  Operator op_("_sample_normal");
+  op_.SetParam("shape", shape);
+  op_.PushInput(mu);
+  op_.PushInput(sigma);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_poisson(const NDArray& lam,
+    const std::string& shape = "()",
+    const std::string& dtype = "float32") {
+  Operator op_("_sample_poisson");
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(lam);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sample_uniform(const NDArray& low,
+    const NDArray& high,
+    const std::string& shape = "()") {
+  Operator op_("_sample_uniform");
+  op_.SetParam("shape", shape);
+  op_.PushInput(low);
+  op_.PushInput(high);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_arctan2(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_arctan2");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_add(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_add");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_div(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_div");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_equal(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_equal");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_greater(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_greater");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_greater_equal(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_greater_equal");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_hypot(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_hypot");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_lesser(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_lesser");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_lesser_equal(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_lesser_equal");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_logical_and(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_logical_and");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_logical_or(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_logical_or");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_logical_xor(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_logical_xor");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_maximum(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_maximum");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_minimum(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_minimum");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_mod(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_mod");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_mul(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_mul");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_not_equal(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_not_equal");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_power(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_power");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _scalar_broadcast_sub(const NDArray& x,
+    double scalar = 0.0,
+    bool reverse = false) {
+  Operator op_("_scalar_broadcast_sub");
+  op_.SetParam("scalar", scalar);
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _shuffle(const NDArray& data) {
+  Operator op_("_shuffle");
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sparse_adagrad_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& indices,
+    const NDArray& history,
+    double lr = 0.01,
+    double epsilon = 1e-07,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("_sparse_adagrad_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(indices);
+  op_.PushInput(history);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sparse_adam_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& indices,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("_sparse_adam_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(indices);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sparse_sgd_mom_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& indices,
+    const NDArray& mom,
+    double lr = 0.01,
+    double momentum = 0.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("_sparse_sgd_mom_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(indices);
+  op_.PushInput(mom);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _sparse_sgd_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& indices,
+    double lr = 0.01,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("_sparse_sgd_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _split_v2(const NDArray& x,
+    const std::string& indices = "()",
+    int axis = 0,
+    bool squeeze_axis = false,
+    int sections = 0) {
+  Operator op_("_split_v2");
+  op_.SetParam("indices", indices);
+  op_.SetParam("axis", axis);
+  op_.SetParam("squeeze_axis", squeeze_axis);
+  op_.SetParam("sections", sections);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _square_sum(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("_square_sum");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _while_loop(const std::vector<NDArray>& inputs,
+    const std::string& cond_graph = "",
+    const std::string& func_graph = "",
+    int n_state = 0,
+    int n_out = 0,
+    int max_iterations = 0,
+    const std::string& state_names = "()",
+    const std::string& cond_free_names = "()",
+    const std::string& func_free_names = "()") {
+  Operator op_("_while_loop");
+  op_.SetParam("cond_graph", cond_graph);
+  op_.SetParam("func_graph", func_graph);
+  op_.SetParam("n_state", n_state);
+  op_.SetParam("n_out", n_out);
+  op_.SetParam("max_iterations", max_iterations);
+  op_.SetParam("state_names", state_names);
+  op_.SetParam("cond_free_names", cond_free_names);
+  op_.SetParam("func_free_names", func_free_names);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> _zeros(const std::string& shape = "()",
+    const std::string& dtype = "float32") {
+  Operator op_("_zeros");
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> abs(const NDArray& x) {
+  Operator op_("abs");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> adadelta_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& acc_g,
+    const NDArray& acc_d,
+    double lr = 1.0,
+    double rho = 0.9,
+    double epsilon = 1e-05,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("adadelta_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("rho", rho);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(acc_g);
+  op_.PushInput(acc_d);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> adagrad_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& history,
+    double lr = 0.01,
+    double epsilon = 1e-07,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("adagrad_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(history);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> adam_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    bool lazy_update = true) {
+  Operator op_("adam_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lazy_update", lazy_update);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> adamax_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.002,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double t = 1.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("adamax_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("t", t);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> adamw_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double eta = 1.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("adamw_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("eta", eta);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> add_n(const std::vector<NDArray>& inputs,
+    const std::string& num_args = "__default__") {
+  Operator op_("add_n");
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arccos(const NDArray& x) {
+  Operator op_("arccos");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arccosh(const NDArray& x) {
+  Operator op_("arccosh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arcsin(const NDArray& x) {
+  Operator op_("arcsin");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arcsinh(const NDArray& x) {
+  Operator op_("arcsinh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arctan(const NDArray& x) {
+  Operator op_("arctan");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arctan2(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("arctan2");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> arctanh(const NDArray& x) {
+  Operator op_("arctanh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> argmax(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false) {
+  Operator op_("argmax");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> argmax_channel(const NDArray& x) {
+  Operator op_("argmax_channel");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> argmin(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false) {
+  Operator op_("argmin");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> argsort(const NDArray& x,
+    int axis = -1,
+    bool is_ascend = true,
+    const std::string& dtype = "float32") {
+  Operator op_("argsort");
+  op_.SetParam("axis", axis);
+  op_.SetParam("is_ascend", is_ascend);
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> batch_dot(const NDArray& a,
+    const NDArray& b,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  Operator op_("batch_dot");
+  op_.SetParam("transpose_a", transpose_a);
+  op_.SetParam("transpose_b", transpose_b);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> batch_take(const NDArray& a,
+    const NDArray& indices) {
+  Operator op_("batch_take");
+  op_.PushInput(a);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> bernoulli(double prob = 0.5,
+    const std::string& shape = "(1,)",
+    const std::string& dtype = "float32") {
+  Operator op_("bernoulli");
+  op_.SetParam("prob", prob);
+  op_.SetParam("shape", shape);
+  op_.SetParam("dtype", dtype);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_add(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_add");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_axis(const NDArray& x,
+    const std::string& axis = "__default__",
+    const std::string& size = "__default__") {
+  Operator op_("broadcast_axis");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  if (size != "__default__") {
+    op_.SetParam("size", size);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_div(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_div");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_equal(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_equal");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_greater(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_greater");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_greater_equal(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_greater_equal");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_hypot(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_hypot");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_lesser(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_lesser");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_lesser_equal(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_lesser_equal");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_like(const NDArray& lhs,
+    const NDArray& rhs,
+    const std::string& lhs_axes = "__default__",
+    const std::string& rhs_axes = "__default__") {
+  Operator op_("broadcast_like");
+  if (lhs_axes != "__default__") {
+    op_.SetParam("lhs_axes", lhs_axes);
+  }
+  if (rhs_axes != "__default__") {
+    op_.SetParam("rhs_axes", rhs_axes);
+  }
+  op_.PushInput(lhs);
+  op_.PushInput(rhs);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_logical_and(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_logical_and");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_logical_or(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_logical_or");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_logical_xor(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_logical_xor");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_maximum(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_maximum");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_minimum(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_minimum");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_mod(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_mod");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_mul(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_mul");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_not_equal(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_not_equal");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_power(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_power");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_sub(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("broadcast_sub");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> broadcast_to(const NDArray& x,
+    const std::string& shape = "__default__") {
+  Operator op_("broadcast_to");
+  if (shape != "__default__") {
+    op_.SetParam("shape", shape);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> cast(const NDArray& x,
+    const std::string& dtype = "float32") {
+  Operator op_("cast");
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> cast_storage(const NDArray& x,
+    const std::string& stype = "default") {
+  Operator op_("cast_storage");
+  op_.SetParam("stype", stype);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> cbrt(const NDArray& x) {
+  Operator op_("cbrt");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ceil(const NDArray& x) {
+  Operator op_("ceil");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> clip(const NDArray& x,
+    const std::string& a_min = "__default__",
+    const std::string& a_max = "__default__") {
+  Operator op_("clip");
+  if (a_min != "__default__") {
+    op_.SetParam("a_min", a_min);
+  }
+  if (a_max != "__default__") {
+    op_.SetParam("a_max", a_max);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> copy(const NDArray& x) {
+  Operator op_("copy");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> cos(const NDArray& x) {
+  Operator op_("cos");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> cosh(const NDArray& x) {
+  Operator op_("cosh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> dcasgd_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& prev_weight,
+    double lr = 0.01,
+    double lamda = 0.04,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("dcasgd_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("lamda", lamda);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(prev_weight);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> degrees(const NDArray& x) {
+  Operator op_("degrees");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> depth_to_space(const NDArray& x,
+    int block_size = 1) {
+  Operator op_("depth_to_space");
+  op_.SetParam("block_size", block_size);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> diag(const NDArray& x,
+    int k = 0) {
+  Operator op_("diag");
+  op_.SetParam("k", k);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> digamma(const NDArray& x) {
+  Operator op_("digamma");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> dot(const NDArray& a,
+    const NDArray& b,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  Operator op_("dot");
+  op_.SetParam("transpose_a", transpose_a);
+  op_.SetParam("transpose_b", transpose_b);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> embedding_like_weight_grad(const NDArray& x) {
+  Operator op_("embedding_like_weight_grad");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> erf(const NDArray& x) {
+  Operator op_("erf");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> erfinv(const NDArray& x) {
+  Operator op_("erfinv");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> exp(const NDArray& x) {
+  Operator op_("exp");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> expand_dims(const NDArray& x,
+    int axis = 0) {
+  Operator op_("expand_dims");
+  op_.SetParam("axis", axis);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> expm1(const NDArray& x) {
+  Operator op_("expm1");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> fix(const NDArray& x) {
+  Operator op_("fix");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> flip(const NDArray& x,
+    int axis = 0) {
+  Operator op_("flip");
+  op_.SetParam("axis", axis);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> floor(const NDArray& x) {
+  Operator op_("floor");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ftml_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& d,
+    const NDArray& v,
+    const NDArray& z,
+    double lr = 0.0025,
+    double beta1 = 0.6,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double t = 1.0,
+    double rescale_grad = 1.0,
+    double clip_grad = -1.0) {
+  Operator op_("ftml_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("t", t);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_grad", clip_grad);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(d);
+  op_.PushInput(v);
+  op_.PushInput(z);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ftrl_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& z,
+    const NDArray& n,
+    double lr = 0.1,
+    double lamda1 = 0.01,
+    double beta = 1.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("ftrl_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("lamda1", lamda1);
+  op_.SetParam("beta", beta);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(z);
+  op_.PushInput(n);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> gamma(const NDArray& x) {
+  Operator op_("gamma");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> gammaln(const NDArray& x) {
+  Operator op_("gammaln");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> gather_nd(const NDArray& data,
+    const NDArray& indices) {
+  Operator op_("gather_nd");
+  op_.PushInput(data);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> group_adagrad_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& history,
+    double lr = 0.01,
+    double epsilon = 1e-05,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double wd = 0.0) {
+  Operator op_("group_adagrad_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("wd", wd);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(history);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> hard_sigmoid(const NDArray& x,
+    double alpha = 0.2,
+    double beta = 0.5) {
+  Operator op_("hard_sigmoid");
+  op_.SetParam("alpha", alpha);
+  op_.SetParam("beta", beta);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> isinf(const NDArray& x) {
+  Operator op_("isinf");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> isnan(const NDArray& x) {
+  Operator op_("isnan");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> khatri_rao(const std::vector<NDArray>& inputs,
+    const std::string& num_args = "__default__") {
+  Operator op_("khatri_rao");
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> lamb_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-06,
+    double wd = 0.0,
+    double t = 1.0,
+    bool bias_correction = true,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double lower_bound = 0.001,
+    double upper_bound = 10.0) {
+  Operator op_("lamb_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("t", t);
+  op_.SetParam("bias_correction", bias_correction);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lower_bound", lower_bound);
+  op_.SetParam("upper_bound", upper_bound);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_det(const NDArray& a) {
+  Operator op_("linalg_det");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_extractdiag(const NDArray& a,
+    int offset = 0) {
+  Operator op_("linalg_extractdiag");
+  op_.SetParam("offset", offset);
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_extracttrian(const NDArray& a,
+    int offset = 0,
+    bool lower = true) {
+  Operator op_("linalg_extracttrian");
+  op_.SetParam("offset", offset);
+  op_.SetParam("lower", lower);
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_gelqf(const NDArray& a) {
+  Operator op_("linalg_gelqf");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_gemm(const NDArray& a,
+    const NDArray& b,
+    const NDArray& c,
+    bool transpose_a = false,
+    bool transpose_b = false,
+    double alpha = 1.0,
+    double beta = 1.0,
+    int axis = -2) {
+  Operator op_("linalg_gemm");
+  op_.SetParam("transpose_a", transpose_a);
+  op_.SetParam("transpose_b", transpose_b);
+  op_.SetParam("alpha", alpha);
+  op_.SetParam("beta", beta);
+  op_.SetParam("axis", axis);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  op_.PushInput(c);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_gemm2(const NDArray& a,
+    const NDArray& b,
+    bool transpose_a = false,
+    bool transpose_b = false,
+    double alpha = 1.0) {
+  Operator op_("linalg_gemm2");
+  op_.SetParam("transpose_a", transpose_a);
+  op_.SetParam("transpose_b", transpose_b);
+  op_.SetParam("alpha", alpha);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_inverse(const NDArray& a) {
+  Operator op_("linalg_inverse");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_makediag(const NDArray& a,
+    int offset = 0) {
+  Operator op_("linalg_makediag");
+  op_.SetParam("offset", offset);
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_maketrian(const NDArray& a,
+    int offset = 0,
+    bool lower = true) {
+  Operator op_("linalg_maketrian");
+  op_.SetParam("offset", offset);
+  op_.SetParam("lower", lower);
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_potrf(const NDArray& a) {
+  Operator op_("linalg_potrf");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_potri(const NDArray& a) {
+  Operator op_("linalg_potri");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_slogdet(const NDArray& a) {
+  Operator op_("linalg_slogdet");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_sumlogdiag(const NDArray& a) {
+  Operator op_("linalg_sumlogdiag");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_syevd(const NDArray& a) {
+  Operator op_("linalg_syevd");
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_syrk(const NDArray& a,
+    bool transpose = false,
+    double alpha = 1.0) {
+  Operator op_("linalg_syrk");
+  op_.SetParam("transpose", transpose);
+  op_.SetParam("alpha", alpha);
+  op_.PushInput(a);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_trmm(const NDArray& a,
+    const NDArray& b,
+    bool transpose = false,
+    bool rightside = false,
+    bool lower = true,
+    double alpha = 1.0) {
+  Operator op_("linalg_trmm");
+  op_.SetParam("transpose", transpose);
+  op_.SetParam("rightside", rightside);
+  op_.SetParam("lower", lower);
+  op_.SetParam("alpha", alpha);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> linalg_trsm(const NDArray& a,
+    const NDArray& b,
+    bool transpose = false,
+    bool rightside = false,
+    bool lower = true,
+    double alpha = 1.0) {
+  Operator op_("linalg_trsm");
+  op_.SetParam("transpose", transpose);
+  op_.SetParam("rightside", rightside);
+  op_.SetParam("lower", lower);
+  op_.SetParam("alpha", alpha);
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> log(const NDArray& x) {
+  Operator op_("log");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> log10(const NDArray& x) {
+  Operator op_("log10");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> log1p(const NDArray& x) {
+  Operator op_("log1p");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> log2(const NDArray& x) {
+  Operator op_("log2");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> log_softmax(const NDArray& x,
+    int axis = -1,
+    const std::string& temperature = "__default__") {
+  Operator op_("log_softmax");
+  op_.SetParam("axis", axis);
+  if (temperature != "__default__") {
+    op_.SetParam("temperature", temperature);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> logical_not(const NDArray& x) {
+  Operator op_("logical_not");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> make_loss(const NDArray& x) {
+  Operator op_("make_loss");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> max(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("max");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> maximum(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("maximum");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> mean(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("mean");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> min(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("min");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> minimum(const NDArray& a,
+    const NDArray& b) {
+  Operator op_("minimum");
+  op_.PushInput(a);
+  op_.PushInput(b);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> mp_sgd_mom_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mom,
+    const NDArray& weight32,
+    double lr = 0.01,
+    double momentum = 0.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    bool lazy_update = true) {
+  Operator op_("mp_sgd_mom_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lazy_update", lazy_update);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mom);
+  op_.PushInput(weight32);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> mp_sgd_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& weight32,
+    double lr = 0.01,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    bool lazy_update = true) {
+  Operator op_("mp_sgd_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lazy_update", lazy_update);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(weight32);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> multi_mp_sgd_mom_update(const std::vector<NDArray>& inputs,
+    const std::string& lrs = "()",
+    const std::string& wds = "()",
+    double momentum = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    int num_weights = 1) {
+  Operator op_("multi_mp_sgd_mom_update");
+  op_.SetParam("lrs", lrs);
+  op_.SetParam("wds", wds);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("num_weights", num_weights);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> multi_mp_sgd_update(const std::vector<NDArray>& inputs,
+    const std::string& lrs = "()",
+    const std::string& wds = "()",
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    int num_weights = 1) {
+  Operator op_("multi_mp_sgd_update");
+  op_.SetParam("lrs", lrs);
+  op_.SetParam("wds", wds);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("num_weights", num_weights);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> multi_sgd_mom_update(const std::vector<NDArray>& inputs,
+    const std::string& lrs = "()",
+    const std::string& wds = "()",
+    double momentum = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    int num_weights = 1) {
+  Operator op_("multi_sgd_mom_update");
+  op_.SetParam("lrs", lrs);
+  op_.SetParam("wds", wds);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("num_weights", num_weights);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> multi_sgd_update(const std::vector<NDArray>& inputs,
+    const std::string& lrs = "()",
+    const std::string& wds = "()",
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    int num_weights = 1) {
+  Operator op_("multi_sgd_update");
+  op_.SetParam("lrs", lrs);
+  op_.SetParam("wds", wds);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("num_weights", num_weights);
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> nadam_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mean,
+    const NDArray& var,
+    double lr = 0.001,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double t = 1.0,
+    double m_schedule = 1.0,
+    double schedule_decay = 0.004,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("nadam_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("beta1", beta1);
+  op_.SetParam("beta2", beta2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("t", t);
+  op_.SetParam("m_schedule", m_schedule);
+  op_.SetParam("schedule_decay", schedule_decay);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mean);
+  op_.PushInput(var);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> nag_mom_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mom,
+    double lr = 0.01,
+    double momentum = 0.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("nag_mom_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mom);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> nanprod(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("nanprod");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> nansum(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("nansum");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> negative(const NDArray& x) {
+  Operator op_("negative");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> norm(const NDArray& x,
+    int ord = 2,
+    const std::string& axis = "__default__",
+    bool keepdims = false) {
+  Operator op_("norm");
+  op_.SetParam("ord", ord);
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> one_hot(const NDArray& indices,
+    int depth = 1,
+    double on_value = 1.0,
+    double off_value = 0.0,
+    const std::string& dtype = "float32") {
+  Operator op_("one_hot");
+  op_.SetParam("depth", depth);
+  op_.SetParam("on_value", on_value);
+  op_.SetParam("off_value", off_value);
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ones_like(const NDArray& x) {
+  Operator op_("ones_like");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> pad(const NDArray& x,
+    const std::string& mode = "constant",
+    const std::string& pad_width = "__default__",
+    double constant_value = 0.0) {
+  Operator op_("pad");
+  op_.SetParam("mode", mode);
+  if (pad_width != "__default__") {
+    op_.SetParam("pad_width", pad_width);
+  }
+  op_.SetParam("constant_value", constant_value);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> pick(const NDArray& data,
+    const NDArray& index,
+    int axis = -1,
+    bool keepdims = false,
+    const std::string& mode = "clip") {
+  Operator op_("pick");
+  op_.SetParam("axis", axis);
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("mode", mode);
+  op_.PushInput(data);
+  op_.PushInput(index);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> prod(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("prod");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> radians(const NDArray& x) {
+  Operator op_("radians");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> ravel_multi_index(const NDArray& data,
+    const std::string& shape = "__default__") {
+  Operator op_("ravel_multi_index");
+  if (shape != "__default__") {
+    op_.SetParam("shape", shape);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> rcbrt(const NDArray& x) {
+  Operator op_("rcbrt");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> reciprocal(const NDArray& x) {
+  Operator op_("reciprocal");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> relu(const NDArray& x) {
+  Operator op_("relu");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> repeat(const NDArray& x,
+    int repeats = 1,
+    const std::string& axis = "__default__") {
+  Operator op_("repeat");
+  op_.SetParam("repeats", repeats);
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> reshape(const NDArray& x,
+    const std::string& shape = "__default__",
+    bool reverse = false) {
+  Operator op_("reshape");
+  if (shape != "__default__") {
+    op_.SetParam("shape", shape);
+  }
+  op_.SetParam("reverse", reverse);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> reshape_like(const NDArray& lhs,
+    const NDArray& rhs,
+    const std::string& lhs_begin = "__default__",
+    const std::string& lhs_end = "__default__",
+    const std::string& rhs_begin = "__default__",
+    const std::string& rhs_end = "__default__") {
+  Operator op_("reshape_like");
+  if (lhs_begin != "__default__") {
+    op_.SetParam("lhs_begin", lhs_begin);
+  }
+  if (lhs_end != "__default__") {
+    op_.SetParam("lhs_end", lhs_end);
+  }
+  if (rhs_begin != "__default__") {
+    op_.SetParam("rhs_begin", rhs_begin);
+  }
+  if (rhs_end != "__default__") {
+    op_.SetParam("rhs_end", rhs_end);
+  }
+  op_.PushInput(lhs);
+  op_.PushInput(rhs);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> rint(const NDArray& x) {
+  Operator op_("rint");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> rmsprop_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& n,
+    double lr = 0.001,
+    double gamma1 = 0.95,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double clip_weights = -1.0) {
+  Operator op_("rmsprop_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("gamma1", gamma1);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("clip_weights", clip_weights);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(n);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> rmspropalex_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& n,
+    const NDArray& g_state,
+    const NDArray& delta,
+    double lr = 0.001,
+    double gamma1 = 0.95,
+    double gamma2 = 0.9,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double clip_weights = -1.0) {
+  Operator op_("rmspropalex_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("gamma1", gamma1);
+  op_.SetParam("gamma2", gamma2);
+  op_.SetParam("epsilon", epsilon);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("clip_weights", clip_weights);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(n);
+  op_.PushInput(g_state);
+  op_.PushInput(delta);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> round(const NDArray& x) {
+  Operator op_("round");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> rsqrt(const NDArray& x) {
+  Operator op_("rsqrt");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> scatter_nd(const NDArray& data,
+    const NDArray& indices,
+    const std::string& shape = "__default__") {
+  Operator op_("scatter_nd");
+  if (shape != "__default__") {
+    op_.SetParam("shape", shape);
+  }
+  op_.PushInput(data);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sgd_mom_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mom,
+    double lr = 0.01,
+    double momentum = 0.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    bool lazy_update = true) {
+  Operator op_("sgd_mom_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lazy_update", lazy_update);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mom);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sgd_update(const NDArray& weight,
+    const NDArray& grad,
+    double lr = 0.01,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    bool lazy_update = true) {
+  Operator op_("sgd_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("lazy_update", lazy_update);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sgld_update(const NDArray& weight,
+    const NDArray& grad,
+    double lr = 0.1,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("sgld_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> shape_array(const NDArray& x) {
+  Operator op_("shape_array");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sigmoid(const NDArray& x) {
+  Operator op_("sigmoid");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sign(const NDArray& x) {
+  Operator op_("sign");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> signsgd_update(const NDArray& weight,
+    const NDArray& grad,
+    double lr = 0.01,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  Operator op_("signsgd_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> signum_update(const NDArray& weight,
+    const NDArray& grad,
+    const NDArray& mom,
+    double lr = 0.01,
+    double momentum = 0.0,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double wd_lh = 0.0) {
+  Operator op_("signum_update");
+  op_.SetParam("lr", lr);
+  op_.SetParam("momentum", momentum);
+  op_.SetParam("wd", wd);
+  op_.SetParam("rescale_grad", rescale_grad);
+  op_.SetParam("clip_gradient", clip_gradient);
+  op_.SetParam("wd_lh", wd_lh);
+  op_.PushInput(weight);
+  op_.PushInput(grad);
+  op_.PushInput(mom);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sin(const NDArray& x) {
+  Operator op_("sin");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sinh(const NDArray& x) {
+  Operator op_("sinh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> size_array(const NDArray& x) {
+  Operator op_("size_array");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> slice(const NDArray& x,
+    const std::string& begin = "__default__",
+    const std::string& end = "__default__",
+    const std::string& step = "__default__") {
+  Operator op_("slice");
+  if (begin != "__default__") {
+    op_.SetParam("begin", begin);
+  }
+  if (end != "__default__") {
+    op_.SetParam("end", end);
+  }
+  if (step != "__default__") {
+    op_.SetParam("step", step);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> slice_axis(const NDArray& x,
+    int axis = 0,
+    int begin = 0,
+    const std::string& end = "__default__") {
+  Operator op_("slice_axis");
+  op_.SetParam("axis", axis);
+  op_.SetParam("begin", begin);
+  if (end != "__default__") {
+    op_.SetParam("end", end);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> slice_like(const NDArray& x,
+    const NDArray& like,
+    const std::string& axes = "()") {
+  Operator op_("slice_like");
+  op_.SetParam("axes", axes);
+  op_.PushInput(x);
+  op_.PushInput(like);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> smooth_l1(const NDArray& x,
+    double scalar = 1.0) {
+  Operator op_("smooth_l1");
+  op_.SetParam("scalar", scalar);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> softmax(const NDArray& x,
+    const std::string& length = "__default__",
+    int axis = -1,
+    const std::string& temperature = "__default__",
+    bool use_length = false) {
+  Operator op_("softmax");
+  if (length != "__default__") {
+    op_.SetParam("length", length);
+  }
+  op_.SetParam("axis", axis);
+  if (temperature != "__default__") {
+    op_.SetParam("temperature", temperature);
+  }
+  op_.SetParam("use_length", use_length);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> softmax_cross_entropy(const NDArray& data,
+    const NDArray& label) {
+  Operator op_("softmax_cross_entropy");
+  op_.PushInput(data);
+  op_.PushInput(label);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> softmin(const NDArray& x,
+    int axis = -1) {
+  Operator op_("softmin");
+  op_.SetParam("axis", axis);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> softsign(const NDArray& x) {
+  Operator op_("softsign");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sort(const NDArray& x,
+    int axis = -1,
+    bool is_ascend = true) {
+  Operator op_("sort");
+  op_.SetParam("axis", axis);
+  op_.SetParam("is_ascend", is_ascend);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> space_to_depth(const NDArray& x,
+    int block_size = 1) {
+  Operator op_("space_to_depth");
+  op_.SetParam("block_size", block_size);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> split(const NDArray& x,
+    int num_outputs = 1,
+    int axis = 1,
+    bool squeeze_axis = false) {
+  Operator op_("split");
+  op_.SetParam("num_outputs", num_outputs);
+  op_.SetParam("axis", axis);
+  op_.SetParam("squeeze_axis", squeeze_axis);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sqrt(const NDArray& x) {
+  Operator op_("sqrt");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> square(const NDArray& x) {
+  Operator op_("square");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> squeeze(const NDArray& x,
+    const std::string& axis = "__default__") {
+  Operator op_("squeeze");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> stack(const std::vector<NDArray>& inputs,
+    int axis = 0,
+    const std::string& num_args = "__default__") {
+  Operator op_("stack");
+  op_.SetParam("axis", axis);
+  if (num_args != "__default__") {
+    op_.SetParam("num_args", num_args);
+  }
+  for (const auto& a_ : inputs) op_.PushInput(a_);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> sum(const NDArray& x,
+    const std::string& axis = "__default__",
+    bool keepdims = false,
+    bool exclude = false) {
+  Operator op_("sum");
+  if (axis != "__default__") {
+    op_.SetParam("axis", axis);
+  }
+  op_.SetParam("keepdims", keepdims);
+  op_.SetParam("exclude", exclude);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> swapaxes(const NDArray& x,
+    int dim1 = 0,
+    int dim2 = 0) {
+  Operator op_("swapaxes");
+  op_.SetParam("dim1", dim1);
+  op_.SetParam("dim2", dim2);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> take(const NDArray& a,
+    const NDArray& indices,
+    int axis = 0,
+    const std::string& mode = "clip") {
+  Operator op_("take");
+  op_.SetParam("axis", axis);
+  op_.SetParam("mode", mode);
+  op_.PushInput(a);
+  op_.PushInput(indices);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> tan(const NDArray& x) {
+  Operator op_("tan");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> tanh(const NDArray& x) {
+  Operator op_("tanh");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> tile(const NDArray& x,
+    const std::string& reps = "()") {
+  Operator op_("tile");
+  op_.SetParam("reps", reps);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> topk(const NDArray& x,
+    int axis = -1,
+    int k = 1,
+    const std::string& ret_typ = "indices",
+    bool is_ascend = false,
+    const std::string& dtype = "float32") {
+  Operator op_("topk");
+  op_.SetParam("axis", axis);
+  op_.SetParam("k", k);
+  op_.SetParam("ret_typ", ret_typ);
+  op_.SetParam("is_ascend", is_ascend);
+  op_.SetParam("dtype", dtype);
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> transpose(const NDArray& x,
+    const std::string& axes = "__default__") {
+  Operator op_("transpose");
+  if (axes != "__default__") {
+    op_.SetParam("axes", axes);
+  }
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> trunc(const NDArray& x) {
+  Operator op_("trunc");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> unravel_index(const NDArray& data,
+    const std::string& shape = "__default__") {
+  Operator op_("unravel_index");
+  if (shape != "__default__") {
+    op_.SetParam("shape", shape);
+  }
+  op_.PushInput(data);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> where(const NDArray& cond,
+    const NDArray& x,
+    const NDArray& y) {
+  Operator op_("where");
+  op_.PushInput(cond);
+  op_.PushInput(x);
+  op_.PushInput(y);
+  return op_.Invoke();
+}
+
+inline std::vector<NDArray> zeros_like(const NDArray& x) {
+  Operator op_("zeros_like");
+  op_.PushInput(x);
+  return op_.Invoke();
+}
+}  // namespace op
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_OP_H_

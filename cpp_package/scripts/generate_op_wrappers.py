@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Generate typed C++ wrappers for every registered op.
+
+Reference parity: ``cpp-package/scripts/OpWrapperGenerator.py``, which
+emits ``op.h`` from the C registry so C++ callers get one typed function
+per operator instead of the stringly ``Operator("name")`` builder.  Here
+the registry is the TPU op table: each wrapper introspects the OpDef's
+python signature (tensor inputs = parameters without defaults or the
+declared ``input_names``; hyper-parameters = keyword parameters with
+defaults) and lowers onto the same ``MXImperativeInvoke`` ABI the fluent
+builder uses — proving the FRONTENDS.md "bindings are mechanical" ruling
+by construction.
+
+Usage: python cpp_package/scripts/generate_op_wrappers.py \
+           [-o cpp_package/include/mxnet-cpp/op.h]
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import keyword
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+HEADER = '''\
+/* GENERATED FILE — do not edit.
+ * Produced by cpp_package/scripts/generate_op_wrappers.py from the live
+ * op registry (mxnet_tpu/ops/registry.py), the TPU analogue of the
+ * reference's OpWrapperGenerator.py output.  One typed inline function
+ * per operator, lowering onto Operator(...)/MXImperativeInvoke.
+ */
+#ifndef MXNET_CPP_OP_H_
+#define MXNET_CPP_OP_H_
+
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/operator.h"
+
+namespace mxnet {
+namespace cpp {
+namespace op {
+
+'''
+
+FOOTER = '''\
+}  // namespace op
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_OP_H_
+'''
+
+# sentinel meaning "parameter not supplied: let the backend default apply"
+SKIP_SENTINEL = '"__default__"'
+
+CPP_KEYWORDS = {
+    "and", "or", "not", "xor", "new", "delete", "default", "register",
+    "template", "typename", "union", "enum", "export", "auto", "switch",
+    "case", "do", "for", "while", "if", "else", "int", "float", "double",
+    "bool", "char", "short", "long", "signed", "unsigned", "void",
+    "const", "static", "struct", "class", "public", "private", "return",
+}
+
+
+def cpp_ident(name):
+    if not name or "." in name or "__" in name:
+        return None
+    if name[0].isdigit():
+        return None
+    ident = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    if ident in CPP_KEYWORDS or keyword.iskeyword(ident):
+        ident += "_"
+    return ident
+
+
+def cpp_literal(value):
+    """(cpp_type, cpp_default, needs_skip_check) for a python default."""
+    if value is None:
+        return "const std::string&", SKIP_SENTINEL, True
+    if isinstance(value, bool):
+        return "bool", "true" if value else "false", False
+    if isinstance(value, int):
+        return "int", str(value), False
+    if isinstance(value, float):
+        v = repr(float(value))
+        return "double", v, False
+    if isinstance(value, str):
+        return "const std::string&", '"%s"' % value, False
+    if isinstance(value, (tuple, list)):
+        return "const std::string&", '"%s"' % (tuple(value),), False
+    return None, None, False
+
+
+def op_signature(opdef):
+    """(tensor_inputs, variadic, attrs) from the OpDef's function.
+
+    attrs: list of (name, cpp_type, cpp_default, skip_check).
+    Returns None when the op can't be wrapped (exotic signature).
+    """
+    try:
+        sig = inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        return None
+    skip = {"rng", "_train"}
+    inputs, attrs, variadic = [], [], False
+    for p in sig.parameters.values():
+        if p.name in skip:
+            continue
+        if p.kind == p.VAR_POSITIONAL:
+            variadic = True
+            continue
+        if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY):
+            return None
+        if p.default is p.empty:
+            inputs.append(p.name)
+        elif p.name in opdef.input_names:
+            inputs.append(p.name)      # optional tensor slot (e.g. bias)
+        else:
+            typ, dflt, chk = cpp_literal(p.default)
+            if typ is None:
+                # closure plumbing (e.g. ``lambda x, _f=fn: _f(x)`` in
+                # the generated unary/binary families) — not an op
+                # attribute, just omit it from the wrapper
+                if callable(p.default) or p.name.startswith("_"):
+                    continue
+                return None
+            attrs.append((p.name, typ, dflt, chk))
+    return inputs, variadic, attrs
+
+
+def emit_wrapper(name, opdef):
+    ident = cpp_ident(name)
+    if ident is None:
+        return None
+    sig = op_signature(opdef)
+    if sig is None:
+        return None
+    inputs, variadic, attrs = sig
+
+    params = []
+    if variadic:
+        params.append("const std::vector<NDArray>& inputs")
+    params += ["const NDArray& %s" % cpp_ident(i) for i in inputs]
+    params += ["%s %s = %s" % (typ, cpp_ident(n), dflt)
+               for n, typ, dflt, _ in attrs]
+
+    body = ['  Operator op_("%s");' % name]
+    for n, typ, dflt, chk in attrs:
+        set_line = '  op_.SetParam("%s", %s);' % (n, cpp_ident(n))
+        if chk:
+            body.append('  if (%s != %s) {' % (cpp_ident(n),
+                                               SKIP_SENTINEL))
+            body.append("  " + set_line)
+            body.append("  }")
+        else:
+            body.append(set_line)
+    if variadic:
+        body.append("  for (const auto& a_ : inputs) op_.PushInput(a_);")
+    for i in inputs:
+        body.append("  op_.PushInput(%s);" % cpp_ident(i))
+    body.append("  return op_.Invoke();")
+
+    return ("inline std::vector<NDArray> %s(%s) {\n%s\n}\n"
+            % (ident, ",\n    ".join(params) if params else "",
+               "\n".join(body)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output",
+                    default=os.path.join(os.path.dirname(__file__), "..",
+                                         "include", "mxnet-cpp", "op.h"))
+    args = ap.parse_args()
+
+    from mxnet_tpu.ops import registry
+
+    chunks, emitted, skipped = [], [], []
+    seen = set()
+    for name in sorted(registry.list_ops(builtin_only=True)):
+        opdef = registry.get_op(name)
+        ident = cpp_ident(name)
+        if ident in seen:
+            continue
+        w = emit_wrapper(name, opdef)
+        if w is None:
+            skipped.append(name)
+            continue
+        seen.add(ident)
+        chunks.append(w)
+        emitted.append(name)
+
+    with open(args.output, "w") as f:
+        f.write(HEADER)
+        f.write("\n".join(chunks))
+        f.write(FOOTER)
+    print("emitted %d wrappers to %s (skipped %d: %s)"
+          % (len(emitted), args.output, len(skipped),
+             ", ".join(skipped[:10]) + ("..." if len(skipped) > 10
+                                        else "")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""The generated C++ op-wrapper header must stay in sync with the
+registry (reference: cpp-package's OpWrapperGenerator.py output is
+CI-regenerated).  cpp_train compiling against op.h is the build gate in
+ci/runtime_functions.sh; this checks freshness + coverage."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+GEN = os.path.join(ROOT, "cpp_package", "scripts",
+                   "generate_op_wrappers.py")
+HEADER = os.path.join(ROOT, "cpp_package", "include", "mxnet-cpp",
+                      "op.h")
+
+
+def test_generated_header_in_sync(tmp_path):
+    out = str(tmp_path / "op.h")
+    r = subprocess.run([sys.executable, GEN, "-o", out],
+                       capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(out) as f:
+        fresh = f.read()
+    with open(HEADER) as f:
+        committed = f.read()
+    assert fresh == committed, (
+        "cpp_package/include/mxnet-cpp/op.h is stale — rerun "
+        "python cpp_package/scripts/generate_op_wrappers.py")
+
+
+def test_wrapper_coverage():
+    from mxnet_tpu.ops import registry
+
+    with open(HEADER) as f:
+        text = f.read()
+    distinct = registry.list_ops(builtin_only=True)
+    wrapped = text.count("inline std::vector<NDArray>")
+    # everything except the user-defined-op bridge (Custom) wraps
+    assert wrapped >= len(distinct) - 1, (
+        "only %d of %d registry ops wrapped" % (wrapped, len(distinct)))
+    for name in ("FullyConnected", "Convolution", "sgd_update",
+                 "adam_update", "BatchNorm", "_split_v2"):
+        assert 'Operator op_("%s")' % name in text, name
