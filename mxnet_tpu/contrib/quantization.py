@@ -567,15 +567,25 @@ _QPOOL_ATTRS = ("kernel", "pool_type", "stride", "pad", "global_pool",
                 "pooling_convention", "count_include_pad", "cudnn_off")
 
 
+_QADD_OPS = ("broadcast_add", "elemwise_add", "_plus")
+
+_CALIB_ATTRS = ("min_calib_range", "max_calib_range")
+
+
 def fuse_int8_chains(qsym):
     """Peephole over a quantized graph: re-express
-    ``quantize_v2( chain( dequantize(x_q) ) )`` — where ``chain`` is a
-    (possibly empty) sequence of relu / max-pool / flatten — entirely in
-    the quantized domain:
-    ``chain_q( requantize(x_q) )`` using ``_contrib_quantized_act`` /
-    ``quantized_pooling`` / ``quantized_flatten``.  Calibrated ranges on
-    the quantize node ride on the requantize.  Kills the fp32 round
-    trip between adjacent quantized layers (docs/PERF_INT8.md).
+    ``quantize_v2( chain( seam ) )`` — where ``chain`` is a (possibly
+    empty) sequence of relu / pooling / flatten and ``seam`` is either a
+    ``dequantize`` or a residual ``broadcast_add`` of two int8-available
+    tensors — entirely in the quantized domain, via
+    ``_contrib_quantized_act / quantized_pooling / quantized_flatten /
+    quantized_elemwise_add``.  Calibrated ranges on the quantize node
+    ride on the requantize / quantized add.
+
+    Every rewritten fp32 node records its int8 twin, so an identity
+    shortcut that reads a previous block's fp32 relu finds that relu's
+    quantized form and the residual add runs int8-in/int8-out — the
+    remaining fp32 seams round 4 measured (docs/PERF_INT8.md) are gone.
     """
     from ..ops.registry import get_op
 
@@ -583,50 +593,87 @@ def fuse_int8_chains(qsym):
         if node.op.name == "Activation":
             return str(node.attrs.get("act_type", "relu")) == "relu"
         if node.op.name == "Pooling":
+            # max pooling only: symmetric clipping to the requantize
+            # target range commutes with max, NOT with avg — an avg pool
+            # inside the chain would average post-clip values against a
+            # post-pool calib range and corrupt outputs (the final
+            # GAP->FC seam stays fp32; its tensors are tiny)
             return str(node.attrs.get("pool_type", "max")) == "max" \
-                and not _attr_truthy(node.attrs.get("global_pool")) \
                 and all(k in _QPOOL_ATTRS for k in node.attrs)
         return node.op.name in ("Flatten", "flatten")
 
-    def _attr_truthy(v):
-        return str(v).lower() in ("true", "1")
-
     topo = qsym._topo()
     mapped = {}
+    int8_twin = {}   # id(original fp32 node) -> [(qnode, oi) x3]
     n_fused = 0
+    n_add_miss = 0   # residual adds left fp32 (no int8 form available)
 
     def map_entry(e):
         return (mapped[id(e[0])], e[1])
+
+    def q_triple_of(e):
+        """int8 (q, min, max) entries for an fp32 input of a residual
+        add, or None when it has no quantized form."""
+        src, _ = e
+        if not src.is_var and src.op.name == "_contrib_dequantize":
+            return [map_entry(x) for x in src.inputs]
+        return int8_twin.get(id(src))
+
+    def wrap_chain(chain, triple):
+        """Re-emit the fp32 relu/pool/flatten links as quantized ops on
+        top of ``triple``, recording each link's int8 twin."""
+        for link in reversed(chain):
+            qop, attrs = {
+                "Activation": ("_contrib_quantized_act",
+                               {"act_type": "relu"}),
+                "Pooling": ("_contrib_quantized_pooling",
+                            dict(link.attrs)),
+                "Flatten": ("_contrib_quantized_flatten", {}),
+                "flatten": ("_contrib_quantized_flatten", {}),
+            }[link.op.name]
+            qn = _Node(get_op(qop), link.name + "_q", triple, attrs)
+            triple = [(qn, 0), (qn, 1), (qn, 2)]
+            int8_twin[id(link)] = triple
+        return triple
 
     for node in topo:
         if node.is_var:
             mapped[id(node)] = node
             continue
         if node.op.name == "_contrib_quantize_v2":
-            # walk down through the fp32 chain to a dequantize
+            # walk down through the fp32 chain to a seam
             chain = []
             cur, oi = node.inputs[0]
             while not cur.is_var and _chain_ok(cur):
                 chain.append(cur)
                 cur, oi = cur.inputs[0]
+            triple = None
             if not cur.is_var and cur.op.name == "_contrib_dequantize":
                 src = [map_entry(e) for e in cur.inputs]  # (q, mn, mx)
                 rq = _Node(get_op("_contrib_requantize"),
                            node.name + "_requant", src,
                            dict(node.attrs))  # calib ranges if any
                 triple = [(rq, 0), (rq, 1), (rq, 2)]
-                for link in reversed(chain):
-                    qop, attrs = {
-                        "Activation": ("_contrib_quantized_act",
-                                       {"act_type": "relu"}),
-                        "Pooling": ("_contrib_quantized_pooling",
-                                    dict(link.attrs)),
-                        "Flatten": ("_contrib_quantized_flatten", {}),
-                        "flatten": ("_contrib_quantized_flatten", {}),
-                    }[link.op.name]
-                    qn = _Node(get_op(qop), link.name + "_q", triple,
-                               attrs)
-                    triple = [(qn, 0), (qn, 1), (qn, 2)]
+            elif not cur.is_var and cur.op.name in _QADD_OPS:
+                a = q_triple_of(cur.inputs[0])
+                b = q_triple_of(cur.inputs[1])
+                if a is None or b is None:
+                    # int8 twins are recorded in topo order; an
+                    # architecture whose shortcut consumer precedes the
+                    # main branch's quantize keeps its fp32 seam — make
+                    # that visible instead of silent
+                    n_add_miss += 1
+                if a is not None and b is not None:
+                    attrs = {k: node.attrs[k] for k in _CALIB_ATTRS
+                             if k in node.attrs}
+                    qadd = _Node(
+                        get_op("_contrib_quantized_elemwise_add"),
+                        cur.name + "_q",
+                        [a[0], b[0], a[1], a[2], b[1], b[2]], attrs)
+                    triple = [(qadd, 0), (qadd, 1), (qadd, 2)]
+                    int8_twin[id(cur)] = triple
+            if triple is not None:
+                triple = wrap_chain(chain, triple)
                 # map the quantize node to the chain tail: consumers
                 # read outputs 0..2, which every quantized op exposes
                 mapped[id(node)] = triple[0][0]
@@ -638,6 +685,12 @@ def fuse_int8_chains(qsym):
                                  user_attrs=dict(node.user_attrs)
                                  if node.user_attrs else None)
 
-    logging.getLogger(__name__).info("fused %d int8 chains", n_fused)
+    log = logging.getLogger(__name__)
+    log.info("fused %d int8 chains", n_fused)
+    if n_add_miss:
+        log.warning(
+            "%d residual add(s) kept an fp32 seam (no int8 twin for an "
+            "input at rewrite time — expected for adds behind "
+            "non-fusable chains, e.g. global avg pool)", n_add_miss)
     return Symbol([(mapped[id(n)], oi) for n, oi in qsym._outputs]), \
         n_fused

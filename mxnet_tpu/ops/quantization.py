@@ -191,6 +191,44 @@ def _quantized_flatten(data, min_data, max_data):
     return data.reshape(data.shape[0], -1), min_data, max_data
 
 
+@register("_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), no_grad=True,
+          num_outputs=3,
+          input_names=("lhs", "rhs", "min_lhs", "max_lhs", "min_rhs",
+                       "max_rhs"))
+def _quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs,
+                            min_calib_range=None, max_calib_range=None,
+                            with_relu=False):
+    """int8 + int8 -> int8 under per-input scales (reference:
+    quantization/quantized_elemwise_add.cc) — the residual-add rescale
+    kernel that keeps resnet skip connections in the quantized domain.
+    One fused elementwise kernel: reads two int8 tensors, writes one
+    int8 tensor — a quarter of the fp32 seam's HBM traffic, which is
+    the entire game on a bandwidth-bound graph (docs/PERF_INT8.md)."""
+    # inputs may be int8 tensors OR raw int32 conv/fc accumulators
+    # (whose min/max describe the INT32_MAX-scale range, like
+    # dequantize) — scale each by its own dtype's quantized max
+    qa = INT8_MAX if lhs.dtype == jnp.int8 else INT32_MAX
+    qb = INT8_MAX if rhs.dtype == jnp.int8 else INT32_MAX
+    sa = _scale(min_lhs, max_lhs, qa)
+    sb = _scale(min_rhs, max_rhs, qb)
+    if min_calib_range is not None and max_calib_range is not None:
+        mag = jnp.maximum(jnp.abs(jnp.asarray(min_calib_range,
+                                              jnp.float32)),
+                          jnp.abs(jnp.asarray(max_calib_range,
+                                              jnp.float32)))
+    else:
+        # exact bound: |a*sa + b*sb| <= qa*sa + qb*sb
+        mag = qa * sa + qb * sb
+    so = jnp.maximum(mag, 1e-10) / INT8_MAX
+    acc = (lhs.astype(jnp.float32) * (sa / so)
+           + rhs.astype(jnp.float32) * (sb / so))
+    if with_relu:
+        acc = jnp.maximum(acc, 0.0)
+    q = jnp.clip(jnp.round(acc), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, -mag, mag
+
+
 @register("_contrib_quantized_concat", aliases=("quantized_concat",),
           no_grad=True, num_outputs=3)
 def _quantized_concat(*args, dim=1, num_args=None):
