@@ -113,6 +113,16 @@ def main():
         "loss_final": float(np.asarray(
             loss.asnumpy(), dtype=np.float32).mean()),
     }
+    # batch-1 serving latency, 100 chained steps/dispatch so the tunnel
+    # RTT amortizes away (docs/PERF_LATENCY.md — 30 steps is enough at
+    # b128 but dominates at b1)
+    try:
+        r1 = compiled_throughput(net, x[0:1], steps=100, draws=3)
+        b1key = "latency_b1_%s" % model_name
+        extra[b1key + "_img_per_sec"] = round(r1["median"], 1)
+        extra[b1key + "_ms"] = round(1000.0 / r1["median"], 3)
+    except Exception as e:
+        extra["latency_b1_error"] = "%s: %s" % (type(e).__name__, e)
     if os.environ.get("BENCH_INT8", "1") != "0":
         try:
             extra.update(int8_bench(batch=batch, steps=steps,
@@ -147,15 +157,8 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
     (``ops/quantization.py``, preferred_element_type) — measured with
     the same compiled-loop discipline as the bf16 number."""
     import os as _os
-    import tempfile
 
-    import numpy as np
-
-    import mxnet_tpu as mx
     from mxnet_tpu.benchmark import compiled_throughput
-    from mxnet_tpu.contrib.quantization import quantize_model
-    from mxnet_tpu.gluon import SymbolBlock
-    from mxnet_tpu.gluon.model_zoo import vision
 
     model_name = _os.environ.get("BENCH_INT8_MODEL", "resnet50_v1")
     size = int(_os.environ.get("BENCH_INT8_SIZE", "224"))
@@ -165,27 +168,8 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
     # BENCH_INT8_FUSE=0 measures the reference-shaped per-layer graph
     fuse = _os.environ.get("BENCH_INT8_FUSE", "1") != "0"
 
-    rng = np.random.RandomState(0)
-    net = getattr(vision, model_name)(classes=1000)
-    net.initialize(mx.init.Xavier())
-    net.hybridize()
-    x32 = mx.nd.array(rng.rand(batch, 3, size, size).astype(np.float32))
-    with mx.autograd.pause():
-        net(x32[0:1])  # deferred init only; skip the full-batch compile
-    with tempfile.TemporaryDirectory() as d:
-        prefix = _os.path.join(d, "m")
-        net.export(prefix, 0)
-        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
-        calib = mx.io.NDArrayIter(
-            rng.rand(n_calib, 3, size, size).astype(np.float32),
-            np.zeros((n_calib,)), max(1, n_calib // 2))
-        qsym, qargs, qauxs = quantize_model(
-            sym, args, auxs, calib_mode="naive", calib_data=calib,
-            num_calib_examples=n_calib, fold_bn=fuse, fuse_int8=fuse)
-        qprefix = _os.path.join(d, "q")
-        mx.model.save_checkpoint(qprefix, 0, qsym, qargs, qauxs)
-        qnet = SymbolBlock.imports(qprefix + "-symbol.json", ["data"],
-                                   qprefix + "-0000.params")
+    qnet, x32 = _build_int8_net(model_name, batch=batch, size=size,
+                                n_calib=n_calib, fuse=fuse)
     r = compiled_throughput(qnet, x32, steps=steps, draws=5)
     out = {
         "int8_img_per_sec": round(r["median"], 2),
@@ -194,15 +178,99 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
     }
     if bf16_img_s:
         out["int8_vs_bf16"] = round(r["median"] / bf16_img_s, 4)
+    # VGG16: the weight-streaming-bound model where int8's halved bytes
+    # pay off hardest (docs/PERF_INT8.md r5) — interleaved bf16/int8
+    # draws in THIS process so the ratio is immune to session drift
+    if _os.environ.get("BENCH_INT8_VGG", "1") != "0":
+        try:
+            out.update(_int8_vs_bf16_pair("vgg16", batch=batch,
+                                          steps=20, reps=3))
+        except Exception as e:
+            out["int8_vgg16_error"] = "%s: %s" % (type(e).__name__, e)
     return out
 
 
+def _build_int8_net(model_name, batch=128, size=224, n_calib=16,
+                    fuse=True):
+    """fp32 zoo model -> calibrated int8 SymbolBlock (+ its input).
+    Shared by the int8 leg and the interleaved A/B pair."""
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.gluon import SymbolBlock
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(0)
+    net = getattr(vision, model_name)(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x32 = mx.nd.array(rng.rand(batch, 3, size, size).astype(np.float32))
+    with mx.autograd.pause():
+        net(x32[0:1])  # deferred init only; skip the full-batch compile
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "m")
+        net.export(prefix, 0)
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+        calib = mx.io.NDArrayIter(
+            rng.rand(n_calib, 3, size, size).astype(np.float32),
+            np.zeros((n_calib,)), max(1, n_calib // 2))
+        qsym, qargs, qauxs = quantize_model(
+            sym, args, auxs, calib_mode="naive", calib_data=calib,
+            num_calib_examples=n_calib, fold_bn=fuse, fuse_int8=fuse)
+        mx.model.save_checkpoint(os.path.join(d, "q"), 0, qsym, qargs,
+                                 qauxs)
+        qnet = SymbolBlock.imports(os.path.join(d, "q-symbol.json"),
+                                   ["data"],
+                                   os.path.join(d, "q-0000.params"))
+    return qnet, x32
+
+
+def _int8_vs_bf16_pair(model_name, batch=128, size=224, steps=20,
+                       reps=3, n_calib=16):
+    """Interleaved same-process bf16 vs int8 measurement of one model:
+    each loop compiles ONCE, timed draws alternate (drift-immune)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.benchmark import interleaved_throughput
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(0)
+    net16 = getattr(vision, model_name)(classes=1000)
+    net16.initialize(mx.init.Xavier())
+    net16.cast("bfloat16")
+    net16.hybridize()
+    x16 = mx.nd.array(rng.rand(batch, 3, size, size)
+                      .astype(np.float32)).astype("bfloat16")
+    with mx.autograd.pause():
+        net16(x16[0:1])
+    qnet, x32 = _build_int8_net(model_name, batch=batch, size=size,
+                                n_calib=n_calib)
+    mb, mi = interleaved_throughput([(net16, x16), (qnet, x32)],
+                                    steps=steps, reps=reps)
+    key = "int8_%s" % model_name
+    return {key + "_img_per_sec": round(mi, 2),
+            key + "_bf16_img_per_sec": round(mb, 2),
+            key + "_vs_bf16": round(mi / mb, 4)}
+
+
 def long_context_bench(seq=8192, steps=5):
-    """Long-context metric: full training step at an 8k sequence on one
-    chip (flash attention keeps memory O(seq); the reference's
+    """Long-context metric: full training steps at 8k/16k/32k sequences
+    on one chip (flash attention keeps memory O(seq); the reference's
     long-sequence story tops out at BucketingModule — this is net-new
     capability, SURVEY §5).  Multi-chip sequence scaling (ring
     attention over an "sp" mesh axis) is exercised by dryrun_multichip.
+
+    MFU accounting (VERDICT r4 #7, same discipline as the transformer
+    number): model FLOPs per token = 6*N (matmuls, fwd+bwd) plus the
+    attention score/value FLOPs 6*L*T*d (12*L*T*d for full attention,
+    halved because the kernel is causal), over the v5e bf16 197-TFLOPs
+    peak.  Remat recompute is NOT credited — MFU counts the math the
+    model requires, so the remat config pays its recompute as lost
+    utilization, which is the honest reading.
     """
     import time as _time
 
@@ -213,26 +281,45 @@ def long_context_bench(seq=8192, steps=5):
     from mxnet_tpu.models import TransformerLM, TransformerConfig
     from mxnet_tpu.models.transformer import make_train_step
 
-    cfg = TransformerConfig(vocab_size=32000, d_model=1024, n_heads=16,
-                            n_layers=4, d_ff=4096, max_len=seq,
-                            dtype="bfloat16", remat=True)
-    model = TransformerLM(cfg)
-    params = model.init(jax.random.PRNGKey(0))
-    velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
-    step = jax.jit(make_train_step(model))
-    toks = jax.random.randint(jax.random.PRNGKey(1), (1, seq + 1), 0,
-                              cfg.vocab_size)
-    x, y = toks[:, :-1], toks[:, 1:]
-    params, velocity, loss = step(params, velocity, x, y)
-    float(loss)
-    best = 0.0
-    for _ in range(2):
-        t0 = _time.perf_counter()
-        for _ in range(steps):
-            params, velocity, loss = step(params, velocity, x, y)
-        float(np.asarray(loss))  # host fetch: real execution barrier
-        best = max(best, seq * steps / (_time.perf_counter() - t0))
-    return {"longctx_seq%d_tokens_per_sec" % seq: round(best, 1)}
+    seqs = [int(s) for s in os.environ.get(
+        "BENCH_LONGCTX_SEQS", "8192,16384,32768").split(",")]
+    out = {}
+    scaling = {}
+    for T in seqs:
+        cfg = TransformerConfig(vocab_size=32000, d_model=1024,
+                                n_heads=16, n_layers=4, d_ff=4096,
+                                max_len=T, dtype="bfloat16", remat=True)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
+        step = jax.jit(make_train_step(model))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, T + 1), 0,
+                                  cfg.vocab_size)
+        x, y = toks[:, :-1], toks[:, 1:]
+        params, velocity, loss = step(params, velocity, x, y)
+        float(loss)
+        n_steps = steps if T <= seq else max(2, steps // 2)
+        best = 0.0
+        for _ in range(2):
+            t0 = _time.perf_counter()
+            for _ in range(n_steps):
+                params, velocity, loss = step(params, velocity, x, y)
+            float(np.asarray(loss))  # host fetch: real barrier
+            best = max(best, T * n_steps / (_time.perf_counter() - t0))
+        n_params = sum(int(np.prod(v.shape))
+                       for v in jax.tree_util.tree_leaves(params))
+        flops_per_tok = 6 * n_params + 6 * cfg.n_layers * T * cfg.d_model
+        mfu = best * flops_per_tok / 197e12
+        scaling[str(T)] = {"tokens_per_sec": round(best, 1),
+                           "mfu": round(mfu, 4)}
+        # headline keys track the canonical seq, or the first measured
+        # one if the env override dropped it (never silently absent)
+        if T == seq or (seq not in seqs and T == seqs[0]):
+            out["longctx_seq%d_tokens_per_sec" % T] = round(best, 1)
+            out["longctx_mfu"] = round(mfu, 4)
+        del params, velocity, step, model
+    out["longctx_scaling"] = scaling
+    return out
 
 
 def transformer_bench(batch=8, seq=1024, steps=10):

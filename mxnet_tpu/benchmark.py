@@ -54,13 +54,9 @@ def _summarize(draws, items_per_draw):
     }
 
 
-def compiled_throughput(net, x, steps=30, draws=5):
-    """items/sec of ``net`` forward on batch ``x``, K steps per compiled
-    dispatch; returns {median,min,max,draws} over ``draws`` repetitions.
-
-    ``net`` must be callable on an NDArray inside a trace (hybridized
-    Gluon blocks are); runs in inference mode (``autograd.pause``).
-    """
+def _compiled_draw(net, x, steps):
+    """Compile the K-step chained loop ONCE; return a zero-arg callable
+    that runs one timed draw and returns items/sec."""
     from .gluon.block import params_as_trace_inputs
 
     batch = x.shape[0]
@@ -86,13 +82,40 @@ def compiled_throughput(net, x, steps=30, draws=5):
         for _ in range(2):  # compile, then one warm draw off the clock
             r = jloop(x.data, zero, pdatas)
             np.asarray(jax.device_get(r.ravel()[0]))
-        times = []
-        for _ in range(draws):
+
+    def draw():
+        with autograd.pause(train_mode=False):
             t0 = time.perf_counter()
             r = jloop(x.data, zero, pdatas)
             np.asarray(jax.device_get(r.ravel()[0]))
-            times.append(time.perf_counter() - t0)
+            return batch * steps / (time.perf_counter() - t0)
+    return draw
+
+
+def compiled_throughput(net, x, steps=30, draws=5):
+    """items/sec of ``net`` forward on batch ``x``, K steps per compiled
+    dispatch; returns {median,min,max,draws} over ``draws`` repetitions.
+
+    ``net`` must be callable on an NDArray inside a trace (hybridized
+    Gluon blocks are); runs in inference mode (``autograd.pause``).
+    """
+    batch = x.shape[0]
+    one_draw = _compiled_draw(net, x, steps)
+    times = [batch * steps / one_draw() for _ in range(draws)]
     return _summarize(times, batch * steps)
+
+
+def interleaved_throughput(pairs, steps=20, reps=3):
+    """A/B measurement immune to chip/session drift: compile each
+    (net, x) loop ONCE, then alternate timed draws A,B,A,B,...
+    Returns a list of per-pair median items/sec."""
+    draws = [_compiled_draw(net, x, steps) for net, x in pairs]
+    results = [[] for _ in pairs]
+    for _ in range(reps):
+        for i, d in enumerate(draws):
+            results[i].append(d())
+    import numpy as _np
+    return [float(_np.median(r)) for r in results]
 
 
 def percall_throughput(net, x, steps=30, draws=5):
