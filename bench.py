@@ -184,7 +184,7 @@ def int8_bench(batch=128, steps=30, bf16_img_s=None):
     if _os.environ.get("BENCH_INT8_VGG", "1") != "0":
         try:
             out.update(_int8_vs_bf16_pair("vgg16", batch=batch,
-                                          steps=20, reps=3))
+                                          steps=20, reps=3, fuse=fuse))
         except Exception as e:
             out["int8_vgg16_error"] = "%s: %s" % (type(e).__name__, e)
     return out
@@ -229,7 +229,7 @@ def _build_int8_net(model_name, batch=128, size=224, n_calib=16,
 
 
 def _int8_vs_bf16_pair(model_name, batch=128, size=224, steps=20,
-                       reps=3, n_calib=16):
+                       reps=3, n_calib=16, fuse=True):
     """Interleaved same-process bf16 vs int8 measurement of one model:
     each loop compiles ONCE, timed draws alternate (drift-immune)."""
     import numpy as np
@@ -248,7 +248,7 @@ def _int8_vs_bf16_pair(model_name, batch=128, size=224, steps=20,
     with mx.autograd.pause():
         net16(x16[0:1])
     qnet, x32 = _build_int8_net(model_name, batch=batch, size=size,
-                                n_calib=n_calib)
+                                n_calib=n_calib, fuse=fuse)
     mb, mi = interleaved_throughput([(net16, x16), (qnet, x32)],
                                     steps=steps, reps=reps)
     key = "int8_%s" % model_name

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Named-entity recognition with a BiLSTM tagger (reference:
+``example/named_entity_recognition`` — BiLSTM sequence labeling over
+word embeddings, scaled to a zero-egress task).
+
+Per-token BIO tagging: embedding → bidirectional LSTM → per-token dense
+softmax, trained with token-level cross-entropy (padding masked).  The
+synthetic language marks entity spans with a trigger token followed by
+2-3 tokens from an entity vocabulary; the tagger must emit B/I on the
+span (context-dependent: the SAME entity tokens without a trigger are
+O), which requires the recurrent state — a bag-of-tokens model cannot
+solve it.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+VOCAB = 120
+TRIGGER = 110          # "Mr." / "in" style trigger token
+ENT_LO, ENT_HI = 80, 110   # entity-capable tokens
+SEQ = 24
+TAGS = 3               # O=0, B=1, I=2
+
+
+def make_data(rng, n):
+    X = rng.randint(0, 80, (n, SEQ))
+    Y = np.zeros((n, SEQ), np.int64)
+    for i in range(n):
+        # plant 1-2 triggered entity spans at DISJOINT positions (an
+        # overlap would overwrite tokens while the first plant's labels
+        # persist, contradicting the generative rule)
+        used = np.zeros(SEQ, bool)
+        for _ in range(rng.randint(1, 3)):
+            ln = rng.randint(2, 4)
+            for _try in range(10):
+                p = rng.randint(0, SEQ - ln - 1)
+                if not used[p:p + ln + 1].any():
+                    break
+            else:
+                continue
+            used[p:p + ln + 1] = True
+            X[i, p] = TRIGGER
+            X[i, p + 1:p + 1 + ln] = rng.randint(ENT_LO, ENT_HI, ln)
+            Y[i, p + 1] = 1                      # B
+            Y[i, p + 2:p + 1 + ln] = 2           # I
+        # distractor: entity-range tokens WITHOUT a trigger stay O
+        p = rng.randint(0, SEQ - 2)
+        if X[i, p] != TRIGGER and (p == 0 or X[i, p - 1] != TRIGGER):
+            X[i, p] = rng.randint(ENT_LO, ENT_HI)
+    return X.astype(np.float32), Y
+
+
+class BiLSTMTagger(gluon.nn.Block):
+    def __init__(self, embed=32, hidden=48, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(VOCAB, embed)
+            self.lstm = gluon.rnn.LSTM(hidden, num_layers=1,
+                                       bidirectional=True, layout="NTC")
+            self.out = gluon.nn.Dense(TAGS, flatten=False)
+
+    def forward(self, tokens):
+        return self.out(self.lstm(self.embed(tokens)))  # [B, T, TAGS]
+
+
+def f1_entities(pred, gold):
+    """Span-level F1: a predicted B..I span counts iff it exactly
+    matches a gold span."""
+    def spans(tags):
+        out, i = set(), 0
+        while i < len(tags):
+            if tags[i] == 1:
+                j = i + 1
+                while j < len(tags) and tags[j] == 2:
+                    j += 1
+                out.add((i, j))
+                i = j
+            else:
+                i += 1
+        return out
+
+    tp = fp = fn = 0
+    for p, g in zip(pred, gold):
+        ps, gs = spans(p), spans(g)
+        tp += len(ps & gs)
+        fp += len(ps - gs)
+        fn += len(gs - ps)
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    return 2 * prec * rec / max(prec + rec, 1e-9)
+
+
+def train(epochs=6, batch=32, lr=0.003, seed=0, verbose=True):
+    rng = np.random.RandomState(seed)
+    mx.random.seed(seed)
+    Xtr, Ytr = make_data(rng, 512)
+    Xte, Yte = make_data(rng, 256)
+    net = BiLSTMTagger()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        for i in range(0, len(Xtr), batch):
+            idx = perm[i:i + batch]
+            xb = mx.nd.array(Xtr[idx])
+            yb = mx.nd.array(Ytr[idx].astype(np.float32))
+            with autograd.record():
+                lp = mx.nd.log_softmax(net(xb), axis=-1)
+                loss = -mx.nd.pick(lp, yb, axis=2).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        if verbose:
+            with autograd.pause():
+                pred = net(mx.nd.array(Xte)).asnumpy().argmax(-1)
+            print("epoch %d loss %.3f span-F1 %.3f"
+                  % (ep, tot / max(1, len(Xtr) // batch),
+                     f1_entities(pred, Yte)))
+    with autograd.pause():
+        pred = net(mx.nd.array(Xte)).asnumpy().argmax(-1)
+    return net, f1_entities(pred, Yte)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    net, f1 = train(epochs=args.epochs, verbose=not args.smoke)
+    print("entity span F1: %.3f" % f1)
+    if args.smoke:
+        assert f1 > 0.8, f1
+        print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,11 +84,12 @@ def _compiled_draw(net, x, steps):
             np.asarray(jax.device_get(r.ravel()[0]))
 
     def draw():
+        """One timed draw; returns elapsed seconds."""
         with autograd.pause(train_mode=False):
             t0 = time.perf_counter()
             r = jloop(x.data, zero, pdatas)
             np.asarray(jax.device_get(r.ravel()[0]))
-            return batch * steps / (time.perf_counter() - t0)
+            return time.perf_counter() - t0
     return draw
 
 
@@ -101,7 +102,7 @@ def compiled_throughput(net, x, steps=30, draws=5):
     """
     batch = x.shape[0]
     one_draw = _compiled_draw(net, x, steps)
-    times = [batch * steps / one_draw() for _ in range(draws)]
+    times = [one_draw() for _ in range(draws)]
     return _summarize(times, batch * steps)
 
 
@@ -113,9 +114,8 @@ def interleaved_throughput(pairs, steps=20, reps=3):
     results = [[] for _ in pairs]
     for _ in range(reps):
         for i, d in enumerate(draws):
-            results[i].append(d())
-    import numpy as _np
-    return [float(_np.median(r)) for r in results]
+            results[i].append(pairs[i][1].shape[0] * steps / d())
+    return [float(np.median(r)) for r in results]
 
 
 def percall_throughput(net, x, steps=30, draws=5):

@@ -161,6 +161,39 @@ def test_faster_rcnn():
     assert "OK" in out, out
 
 
+def test_fcn_segmentation():
+    """FCN semantic segmentation trains through Deconvolution upsampling
+    with skip fusion (reference example/fcn-xs)."""
+    out = _run([os.path.join(EX, "fcn-xs", "train_fcn.py"), "--smoke"],
+               timeout=900)
+    assert "OK" in out, out
+
+
+def test_cnn_text_classification():
+    """Kim-CNN (parallel filter widths + max-over-time) learns planted
+    signature trigrams (reference example/cnn_text_classification)."""
+    out = _run([os.path.join(EX, "cnn_text_classification",
+                             "train_cnn_text.py"), "--smoke"],
+               timeout=540)
+    assert "OK" in out, out
+
+
+def test_named_entity_recognition():
+    """BiLSTM BIO tagger reaches span-F1 > 0.8 on a context-dependent
+    synthetic language (reference example/named_entity_recognition)."""
+    out = _run([os.path.join(EX, "named_entity_recognition",
+                             "train_ner.py"), "--smoke"], timeout=540)
+    assert "OK" in out, out
+
+
+def test_recommender_neumf():
+    """NeuMF-style recommender: GMF + MLP branches, implicit feedback,
+    hit@5 ranking (reference example/recommenders)."""
+    out = _run([os.path.join(EX, "recommenders", "train_deep_mf.py"),
+                "--smoke"], timeout=540)
+    assert "OK" in out, out
+
+
 def test_large_vocab_embedding():
     """Host-resident 16GB-logical embedding trains with O(touched rows)
     device traffic (VERDICT r2 missing #5 / next #8)."""
