@@ -56,7 +56,7 @@ def _summarize(draws, items_per_draw):
 
 def _compiled_draw(net, x, steps):
     """Compile the K-step chained loop ONCE; return a zero-arg callable
-    that runs one timed draw and returns items/sec."""
+    that runs one timed draw and returns ELAPSED SECONDS."""
     from .gluon.block import params_as_trace_inputs
 
     batch = x.shape[0]

@@ -607,6 +607,7 @@ def fuse_int8_chains(qsym):
     int8_twin = {}   # id(original fp32 node) -> [(qnode, oi) x3]
     n_fused = 0
     n_add_miss = 0   # residual adds left fp32 (no int8 form available)
+    n_concat_miss = [0]  # concats left fp32 (a branch didn't resolve)
 
     def map_entry(e):
         return (mapped[id(e[0])], e[1])
@@ -618,6 +619,59 @@ def fuse_int8_chains(qsym):
         if not src.is_var and src.op.name == "_contrib_dequantize":
             return [map_entry(x) for x in src.inputs]
         return int8_twin.get(id(src))
+
+    def q_triple_deep(e):
+        """Like q_triple_of, but a branch that is itself a
+        relu/pool/flatten chain over a dequantize (an inception branch
+        tail feeding only the concat, so it never grew a twin) gets its
+        chain re-emitted quantized on top of a runtime-range requantize
+        (data-dependent min/max — tight, and commutes with the chain)."""
+        t = q_triple_of(e)
+        if t is not None:
+            return t
+        chain = []
+        cur = e[0]
+        while not cur.is_var and _chain_ok(cur):
+            chain.append(cur)
+            cur = cur.inputs[0][0]
+        if cur.is_var:
+            return None
+        if cur.op.name == "_contrib_dequantize" and chain:
+            rq = _Node(get_op("_contrib_requantize"),
+                       chain[0].name + "_requant",
+                       [map_entry(x) for x in cur.inputs], {})
+            return wrap_chain(chain, [(rq, 0), (rq, 1), (rq, 2)])
+        # chain over an already-quantized node (e.g. a reduction block's
+        # pool branch riding the PREVIOUS quantized concat)
+        base = int8_twin.get(id(cur))
+        if base is None and cur.op.name in ("Concat", "concat"):
+            # inner concat feeding an outer one (inception towers):
+            # recurse — its own branches resolve the same way
+            base = q_concat_of(cur)
+        if base is not None:
+            return wrap_chain(chain, base)
+        return None
+
+    def q_concat_of(cat, extra_attrs=None):
+        """Quantized form of a Concat node: every branch resolved via
+        q_triple_deep, interleaved min/max layout, twin registered.
+        Without ``extra_attrs`` the branch ranges set the common scale;
+        the main loop passes the quantize node's calib attrs instead."""
+        triples = [q_triple_deep(e) for e in cat.inputs]
+        if any(t is None for t in triples):
+            n_concat_miss[0] += 1
+            return None
+        attrs = dict(extra_attrs or {})
+        attrs["dim"] = cat.attrs.get("dim", 1)
+        attrs["num_args"] = len(triples)
+        ins = [t[0] for t in triples]
+        for t in triples:
+            ins += [t[1], t[2]]
+        qc = _Node(get_op("_contrib_quantized_concat"),
+                   cat.name + "_q", ins, attrs)
+        triple = [(qc, 0), (qc, 1), (qc, 2)]
+        int8_twin[id(cat)] = triple
+        return triple
 
     def wrap_chain(chain, triple):
         """Re-emit the fp32 relu/pool/flatten links as quantized ops on
@@ -654,6 +708,12 @@ def fuse_int8_chains(qsym):
                            node.name + "_requant", src,
                            dict(node.attrs))  # calib ranges if any
                 triple = [(rq, 0), (rq, 1), (rq, 2)]
+            elif not cur.is_var and cur.op.name in ("Concat", "concat"):
+                # inception-style branch merge: re-bin every branch onto
+                # a common int8 scale instead of an fp32 round trip
+                triple = q_concat_of(
+                    cur, {k: node.attrs[k] for k in _CALIB_ATTRS
+                          if k in node.attrs})
             elif not cur.is_var and cur.op.name in _QADD_OPS:
                 a = q_triple_of(cur.inputs[0])
                 b = q_triple_of(cur.inputs[1])
@@ -692,5 +752,10 @@ def fuse_int8_chains(qsym):
             "%d residual add(s) kept an fp32 seam (no int8 twin for an "
             "input at rewrite time — expected for adds behind "
             "non-fusable chains, e.g. global avg pool)", n_add_miss)
+    if n_concat_miss[0]:
+        log.warning(
+            "%d concat(s) kept an fp32 seam (a branch did not resolve "
+            "to int8 — expected for avg-pool towers, whose chains are "
+            "excluded by the calib-commute rule)", n_concat_miss[0])
     return Symbol([(mapped[id(n)], oi) for n, oi in qsym._outputs]), \
         n_fused

@@ -231,7 +231,8 @@ def _quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs,
 
 @register("_contrib_quantized_concat", aliases=("quantized_concat",),
           no_grad=True, num_outputs=3)
-def _quantized_concat(*args, dim=1, num_args=None):
+def _quantized_concat(*args, dim=1, num_args=None, min_calib_range=None,
+                      max_calib_range=None):
     """Concat int8 tensors that may carry DIFFERENT scales (reference:
     quantization/quantized_concat.cc — the op inception-style branches
     need so the merge stays int8).  Input layout follows the reference:
@@ -244,16 +245,27 @@ def _quantized_concat(*args, dim=1, num_args=None):
     data = args[:n]
     mins = args[n::2]
     maxs = args[n + 1::2]
-    # widest represented magnitude across branches -> common scale
-    mags = [jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-            for mn, mx in zip(mins, maxs)]
-    common = mags[0]
-    for m in mags[1:]:
-        common = jnp.maximum(common, m)
+    # calibrated output range when available (essential when a branch is
+    # a raw int32 accumulator, whose REPRESENTABLE range is astronomically
+    # loose); else the widest represented magnitude across branches
+    if min_calib_range is not None and max_calib_range is not None:
+        common = jnp.maximum(jnp.abs(jnp.asarray(min_calib_range,
+                                                 jnp.float32)),
+                             jnp.abs(jnp.asarray(max_calib_range,
+                                                 jnp.float32)))
+    else:
+        mags = [jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+                for mn, mx in zip(mins, maxs)]
+        common = mags[0]
+        for m in mags[1:]:
+            common = jnp.maximum(common, m)
     out_scale = jnp.maximum(common, 1e-10) / INT8_MAX
     rebinned = []
     for d, mn, mx in zip(data, mins, maxs):
-        s = _scale(mn, mx)
+        # branches may be int8 OR raw int32 accumulators (scale by the
+        # dtype's quantized max, like dequantize/quantized_elemwise_add)
+        s = _scale(mn, mx,
+                   INT8_MAX if d.dtype == jnp.int8 else INT32_MAX)
         q = jnp.round(d.astype(jnp.float32) * (s / out_scale))
         rebinned.append(
             jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8))

@@ -320,3 +320,56 @@ def test_fuse_int8_residual_adds_end_to_end():
                        + 1e-9))
         assert cos > 0.99, cos
         assert (got.argmax(1) == want.argmax(1)).all()
+
+
+def test_fuse_int8_concat_branches():
+    """Inception-style branch merge: quantize(concat(dequant, dequant))
+    becomes quantized_concat — branches hand each other int8
+    (VERDICT r4 #1's quantized_concat, wired into the pipeline)."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    data = mx.sym.Variable("data")
+    stem = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), pad=(1, 1), num_filter=8, name="stem"),
+        act_type="relu")
+    b1 = mx.sym.Convolution(stem, kernel=(1, 1), num_filter=8,
+                            name="branch1")
+    b3 = mx.sym.Convolution(stem, kernel=(3, 3), pad=(1, 1),
+                            num_filter=8, name="branch3")
+    merged = mx.sym.Activation(mx.sym.concat(b1, b3, dim=1),
+                               act_type="relu")
+    head = mx.sym.Convolution(merged, kernel=(1, 1), num_filter=4,
+                              name="head")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(head), num_hidden=10,
+                                name="out")
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(2, 3, 16, 16).astype(np.float32))
+    ex0 = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    args = {n: mx.nd.array(rng.randn(*a.shape).astype(np.float32) * 0.2)
+            for n, a in ex0.arg_dict.items() if n != "data"}
+    auxs = {}
+    sym = net
+    calib = mx.io.NDArrayIter(
+        rng.rand(8, 3, 16, 16).astype(np.float32),
+        np.zeros((8,)), 4)
+    qsym, qargs, qauxs = quantize_model(
+        sym, args, auxs, calib_mode="naive", calib_data=calib,
+        num_calib_examples=8, fold_bn=True, fuse_int8=True)
+    ops = {}
+    for n in qsym._topo():
+        if not n.is_var:
+            ops[n.op.name] = ops.get(n.op.name, 0) + 1
+    assert ops.get("_contrib_quantized_concat", 0) == 1, ops
+    assert ops.get("Concat", 0) == 0 and ops.get("concat", 0) == 0, ops
+
+    def run(s, a, aux):
+        ex = s.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+        ex.copy_params_from(a, aux, allow_extra_params=True)
+        return ex.forward(is_train=False, data=x.asnumpy())[0].asnumpy()
+
+    want = run(sym, args, auxs)
+    got = run(qsym, qargs, qauxs)
+    cos = float((got * want).sum()
+                / (np.linalg.norm(got) * np.linalg.norm(want) + 1e-9))
+    assert cos > 0.99, cos
