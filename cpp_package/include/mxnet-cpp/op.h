@@ -1111,11 +1111,19 @@ inline std::vector<NDArray> _contrib_quantized_act(const NDArray& data,
 
 inline std::vector<NDArray> _contrib_quantized_concat(const std::vector<NDArray>& inputs,
     int dim = 1,
-    const std::string& num_args = "__default__") {
+    const std::string& num_args = "__default__",
+    const std::string& min_calib_range = "__default__",
+    const std::string& max_calib_range = "__default__") {
   Operator op_("_contrib_quantized_concat");
   op_.SetParam("dim", dim);
   if (num_args != "__default__") {
     op_.SetParam("num_args", num_args);
+  }
+  if (min_calib_range != "__default__") {
+    op_.SetParam("min_calib_range", min_calib_range);
+  }
+  if (max_calib_range != "__default__") {
+    op_.SetParam("max_calib_range", max_calib_range);
   }
   for (const auto& a_ : inputs) op_.PushInput(a_);
   return op_.Invoke();
