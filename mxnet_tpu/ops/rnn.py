@@ -116,8 +116,15 @@ def _layer_scan(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
 
 
 def _rnn_visible(attrs):
-    """Symbol-visible outputs: (out[, hy[, cy]]) when state_outputs."""
-    so = str(attrs.get("state_outputs", "True")).lower() in ("true", "1")
+    """Symbol-visible outputs: (out[, hy[, cy]]) when state_outputs is
+    EXPLICITLY requested.  This matches the reference's graph-level
+    default state_outputs=false (rnn-inl.h): an unannotated RNN composes
+    as a single-output symbol.  NOTE the deliberate repo divergence on
+    the IMPERATIVE path: ``nd.RNN``'s kernel default is
+    ``state_outputs=True`` (returns [out, hy(, cy)]), a convenience this
+    repo's tests and gluon layer encode — reference-ported imperative
+    code that wants one output should pass ``state_outputs=False``."""
+    so = str(attrs.get("state_outputs", "False")).lower() in ("true", "1")
     if not so:
         return [0]
     return [0, 1, 2] if str(attrs.get("mode", "lstm")) == "lstm" \
