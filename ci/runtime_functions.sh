@@ -44,8 +44,14 @@ unittest_frontend() {
 }
 
 unittest_parallel() {
+    # test_dispatch.py rides with the fused-step tests: donation,
+    # persistent compile cache, shape bucketing, and the no-tree-flatten
+    # hot-path regression guard.  Every pytest run prints the jit
+    # cache-hit/recompile counters via the conftest terminal-summary
+    # hook — watch "recompile" for dispatch regressions.
     python -m pytest tests/test_parallel.py tests/test_dist.py \
-        tests/test_fused_step.py tests/test_elastic.py \
+        tests/test_fused_step.py tests/test_dispatch.py \
+        tests/test_elastic.py \
         tests/test_data_parallel.py tests/test_gradient_compression.py -q
 }
 

@@ -11,6 +11,13 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# arm the persistent XLA compilation cache (MXNET_COMPILE_CACHE) before
+# anything can trigger a compile — jax reads the cache dir at compile time,
+# so this must precede the first jitted call anywhere in the process
+from .runtime import init_compile_cache as _init_compile_cache
+
+_init_compile_cache()
+
 from ._dist import init_from_env as _dist_init_from_env
 
 _dist_init_from_env()  # multi-worker bootstrap (mxnet_tpu.tools.launch)
@@ -48,6 +55,7 @@ from . import callback  # noqa: F401
 from . import predict  # noqa: F401
 from . import image  # noqa: F401
 from . import profiler  # noqa: F401
+from . import dispatch  # noqa: F401
 from . import contrib  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401

@@ -56,14 +56,23 @@ def init_from_env():
         # cross-process CPU collectives ride gloo TCP
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         local = int(os.environ.get("MXNET_TPU_LOCAL_DEVICES", "1"))
-        jax.config.update("jax_num_cpu_devices", local)
-        # jax_num_cpu_devices conflicts with an inherited
-        # --xla_force_host_platform_device_count (e.g. from test envs)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" in flags:
-            os.environ["XLA_FLAGS"] = " ".join(
-                f for f in flags.split()
-                if "host_platform_device_count" not in f)
+        try:
+            jax.config.update("jax_num_cpu_devices", local)
+            # jax_num_cpu_devices conflicts with an inherited
+            # --xla_force_host_platform_device_count (e.g. from test envs)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "host_platform_device_count" in flags:
+                os.environ["XLA_FLAGS"] = " ".join(
+                    f for f in flags.split()
+                    if "host_platform_device_count" not in f)
+        except AttributeError:
+            # older jax has no jax_num_cpu_devices config; the XLA flag
+            # (read at backend init, which hasn't happened yet) is the
+            # only way to get >1 host device there
+            flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count=%d" % local)
+            os.environ["XLA_FLAGS"] = " ".join(flags)
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=nproc,

@@ -65,6 +65,28 @@ class _Config:
         Knob("MXNET_SUBGRAPH_BACKEND", str, "",
              "Reference subgraph-fusion backend selector. Inert: XLA "
              "fusion replaces subgraph properties.", inert=True),
+        Knob("MXNET_DONATE_BUFFERS", bool, True,
+             "Donate mutated inputs (params, optimizer state, BN "
+             "running stats) to XLA so compiled steps update them "
+             "in-place in HBM instead of allocating fresh outputs — the "
+             "TPU analogue of the reference CachedOp's static_alloc "
+             "in-place memory planning. Donated pre-step buffers are "
+             "invalidated; reading one afterwards raises. Set 0 to "
+             "fall back to copy-on-step."),
+        Knob("MXNET_COMPILE_CACHE", str, "",
+             "Persistent XLA compilation-cache directory so jitted "
+             "modules survive process restarts (maps onto JAX's "
+             "jax_compilation_cache_dir). '' disables; '1'/'auto' uses "
+             "~/.cache/mxnet_tpu/xla-cache; any other value is the "
+             "directory. Must be set before the first compilation "
+             "(mxnet_tpu arms it at import)."),
+        Knob("MXNET_SHAPE_BUCKETS", str, "",
+             "Leading-batch-dim bucketing for the io/DataLoader "
+             "boundary and FusedTrainStep: pad ragged batches up to the "
+             "next bucket so jit caches key on the bucket, not the raw "
+             "shape (reference bucketing module / BucketingModule "
+             "analogue). '' disables; 'pow2' rounds up to powers of "
+             "two; else a comma list like '8,16,32,64'."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

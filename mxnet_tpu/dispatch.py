@@ -1,0 +1,195 @@
+"""Donation-aware dispatch layer.
+
+Reference parity target: ``src/imperative/cached_op.cc`` — the CachedOp's
+``static_alloc``/``static_shape`` flags pre-plan in-place memory so a step
+writes parameters and optimizer state where they already live instead of
+allocating fresh outputs, and its shape-keyed executable cache avoids
+re-planning.  On TPU the analogous machinery is XLA input/output aliasing
+(``jax.jit(..., donate_argnums=...)``), a persistent compilation cache, and
+shape bucketing so ragged batches hit an existing executable.
+
+This module centralises the three policies so the executor, ``_CachedOp``,
+the fused train step, and the optimizer update path all make the same
+decision:
+
+* :func:`donation_active` / :func:`donation_scope` — whether mutated input
+  buffers may be donated right now (config knob + thread-local override;
+  callers additionally skip donation under autograd recording or when the
+  inputs are tracers).
+* :func:`bucket_size` / :func:`pad_batch` — leading-dim shape bucketing.
+* :class:`TrackedJit` — ``jax.jit`` plus the profiler's dispatch counters
+  (cache hits/misses, recompiles, donated bytes).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["donation_active", "donation_scope", "no_donation",
+           "bucket_size", "bucket_spec", "pad_batch", "TrackedJit"]
+
+_tls = threading.local()
+
+
+def donation_active():
+    """True when compiled calls may donate mutated input buffers: the
+    MXNET_DONATE_BUFFERS knob, unless a :func:`donation_scope` override is
+    live on this thread, and never under the naive (eager) engine."""
+    override = getattr(_tls, "donate", None)
+    if override is not None:
+        return override
+    from .config import config
+
+    return bool(config.donate_buffers) and not config.naive_engine
+
+
+class donation_scope:
+    """Thread-local donation override.  ``donation_scope(None)`` is a
+    no-op passthrough so call sites can wrap unconditionally."""
+
+    def __init__(self, enable):
+        self._enable = enable
+        self._prev = ()
+
+    def __enter__(self):
+        if self._enable is not None:
+            self._prev = (getattr(_tls, "donate", None),)
+            _tls.donate = bool(self._enable)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev:
+            _tls.donate = self._prev[0]
+            self._prev = ()
+        return False
+
+
+def no_donation():
+    """Scope under which donation is off (e.g. when a caller must keep
+    reading pre-step buffers)."""
+    return donation_scope(False)
+
+
+# -- shape bucketing --------------------------------------------------------
+_POW2 = "pow2"
+_spec_cache = {}
+
+
+def bucket_spec():
+    """The parsed MXNET_SHAPE_BUCKETS spec: None (off), 'pow2', or a
+    sorted tuple of bucket sizes."""
+    from .config import config
+
+    raw = (config.shape_buckets or "").strip().lower()
+    return _parse_spec(raw)
+
+
+def _parse_spec(raw):
+    if not raw:
+        return None
+    got = _spec_cache.get(raw)
+    if got is None:
+        if raw == _POW2:
+            got = _POW2
+        else:
+            got = tuple(sorted({int(t) for t in raw.split(",") if t.strip()}))
+            if not got:
+                got = None
+        _spec_cache[raw] = got
+    return got
+
+
+def bucket_size(n, spec=None):
+    """Padded leading-dim size for a batch of ``n`` rows under ``spec``
+    (default: the MXNET_SHAPE_BUCKETS knob).  Returns ``n`` unchanged when
+    bucketing is off or ``n`` exceeds the largest bucket (those shapes
+    compile on their own, like the reference BucketingModule's default
+    bucket)."""
+    if spec is None:
+        spec = bucket_spec()
+    elif isinstance(spec, str):
+        spec = _parse_spec(spec.strip().lower())
+    if spec is None or n <= 0:
+        return n
+    if spec == _POW2:
+        return 1 << (int(n) - 1).bit_length()
+    for b in spec:
+        if b >= n:
+            return b
+    return n
+
+
+def pad_batch(data, target):
+    """Pad ``data`` (a jax array) along axis 0 up to ``target`` rows by
+    wrapping around existing rows — the reference ``NDArrayIter``
+    'pad' last-batch semantics, which keeps padded rows statistically
+    plausible (vs. zeros skewing e.g. BN batch stats)."""
+    n = data.shape[0]
+    if target == n:
+        return data
+    import jax.numpy as jnp
+
+    idx = np.arange(target) % n
+    return jnp.take(data, jnp.asarray(idx), axis=0)
+
+
+# -- counted jit ------------------------------------------------------------
+def _donated_nbytes(args, positions):
+    total = 0
+    for i in positions:
+        a = args[i]
+        if isinstance(a, (tuple, list)):
+            for x in a:
+                total += getattr(x, "nbytes", 0)
+        else:
+            total += getattr(a, "nbytes", 0)
+    return total
+
+
+class TrackedJit:
+    """``jax.jit`` wrapper that reports into the profiler's dispatch
+    counters: every trace bumps ``recompile``, every call bumps
+    ``jit_cache_hit`` or ``jit_cache_miss`` (a call that traced is a miss),
+    and donated argument bytes accumulate into ``donated_bytes``."""
+
+    __slots__ = ("_jitted", "_donate")
+
+    def __init__(self, fn, donate_argnums=(), static_argnums=(), label=None):
+        from . import profiler as _prof
+
+        donate = tuple(donate_argnums)
+        self._donate = donate
+
+        def traced(*a, **k):
+            _prof.dispatch_count("recompile")
+            return fn(*a, **k)
+
+        traced.__name__ = label or getattr(fn, "__name__", "tracked_fn")
+        import jax
+
+        kw = {}
+        if donate:
+            kw["donate_argnums"] = donate
+        if static_argnums:
+            kw["static_argnums"] = tuple(static_argnums)
+        self._jitted = jax.jit(traced, **kw)
+
+    def __call__(self, *args):
+        from . import profiler as _prof
+
+        counters = _prof._dispatch
+        before = counters.get("recompile", 0)
+        if self._donate:
+            nbytes = _donated_nbytes(args, self._donate)
+            out = self._jitted(*args)
+            _prof.dispatch_count("donated_bytes", nbytes)
+        else:
+            out = self._jitted(*args)
+        _prof.dispatch_count(
+            "jit_cache_miss" if counters.get("recompile", 0) != before
+            else "jit_cache_hit")
+        return out
+
+    def lower(self, *args, **kw):
+        return self._jitted.lower(*args, **kw)

@@ -203,7 +203,19 @@ class Executor:
         return fn
 
     def _compiled(self, kind, train):
-        key = (kind, train,
+        from . import dispatch as _dispatch
+
+        # donate the aux-state inputs (BN running stats) on the fused
+        # fwd+bwd kinds: their pre-step value is dead after the call (the
+        # returned new_aux is written back), so XLA may update them
+        # in-place in device memory.  Forward never donates — its aux
+        # snapshot (_aux_in) must survive for the paired backward.  dp
+        # resharding device_puts fresh arrays anyway, so skip there.
+        donate_aux = (kind in ("backward", "backward_ones")
+                      and bool(self.aux_names)
+                      and not self._dp_devs
+                      and _dispatch.donation_active())
+        key = (kind, train, donate_aux,
                tuple(a.shape + (str(a.dtype),) for a in self.arg_arrays))
         f = self._fn_cache.get(key)
         if f is not None:
@@ -212,11 +224,12 @@ class Executor:
         n_out = self._n_out
         grad_pos = [i for i, n in enumerate(self.arg_names)
                     if self._grad_req.get(n, "null") != "null"]
+        donate = (2,) if donate_aux else ()
 
         if kind == "forward":
             def run(rng, args, auxs):
                 return graph_fn(rng, args, auxs)
-            f = jax.jit(run)
+            f = _dispatch.TrackedJit(run, label="Executor.forward")
         elif kind in ("backward", "backward_ones"):
             # fused fwd+bwd: one XLA module for the whole training step's
             # compute (reference: full fwd+bwd graph in GraphExecutor::Init).
@@ -237,10 +250,12 @@ class Executor:
                                 tuple(jnp.zeros_like(a) for a in new_aux)))
                 return outs, new_aux, grads
             if kind == "backward":
-                f = jax.jit(run)
+                f = _dispatch.TrackedJit(run, donate_argnums=donate,
+                                         label="Executor.backward")
             else:
-                f = jax.jit(lambda rng, args, auxs: run(rng, args, auxs,
-                                                        None))
+                f = _dispatch.TrackedJit(
+                    lambda rng, args, auxs: run(rng, args, auxs, None),
+                    donate_argnums=donate, label="Executor.backward_ones")
         else:
             raise ValueError(kind)
         inner = f

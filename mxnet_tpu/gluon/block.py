@@ -380,6 +380,7 @@ class _CachedOp:
         self._aux_list = None     # Parameters with grad_req null (mutable state)
         self._out_fmt = None
         self._n_out = None
+        self._out_plan = None     # fast regroup plan, derived from _out_fmt
 
     def _build(self, flat_fmt, n_inputs):
         block = self._block
@@ -436,26 +437,43 @@ class _CachedOp:
         ads = [a.data() for a in auxs]
         inputs = list(flat) + pds + ads
         if self._n_out is None:
-            # first call: trace eagerly once to learn output structure
+            # first call: abstract trace (jax.eval_shape — no execution,
+            # no compile) to learn the output structure; the pure_fn's
+            # side effects on _n_out/_out_fmt happen during tracing.  The
+            # one real compile below then already carries the mutate map —
+            # and, with it, buffer donation — so no executable is built
+            # twice and no donated (deleted) buffer gets re-fed.
+            import functools as _functools
+
+            import jax as _jax
+
             from .. import random as _random
-            from ..ops.registry import split_params
 
             datas = [x.data for x in inputs]
+            # consume one key exactly like the old eager probe did, so
+            # seeded rng streams through hybridized nets stay identical
             rng = _random.next_key()
             train = autograd.is_training()
-            res = self._opdef.call(datas, {}, rng=rng, train=train)
-            if not isinstance(res, (tuple, list)):
-                res = (res,)
-            # now _n_out/_out_fmt are set; fall through to set mutate and
-            # record properly by re-invoking (cheap: jit cache hit)
+            _jax.eval_shape(
+                _functools.partial(self._opdef.fn, _train=train),
+                rng, *datas)
             n_out = self._n_out
             for j in range(len(auxs)):
                 self._opdef.mutate[n_out + j] = len(flat) + len(params) + j
         outputs = invoke(self._opdef, inputs, {})
         if not isinstance(outputs, (list, tuple)):
             outputs = [outputs]
-        real = outputs[:self._n_out]
-        out, rest = _regroup_arrays(list(real), self._out_fmt)
+        if self._out_plan is None:
+            fmt = self._out_fmt
+            self._out_plan = ("single" if fmt == 0 else
+                              "flat" if isinstance(fmt, list)
+                              and all(f == 0 for f in fmt) else "nested")
+        # steady state regroups via the cached plan — no per-call tree walk
+        if self._out_plan == "single":
+            return outputs[0]
+        if self._out_plan == "flat":
+            return list(outputs[:self._n_out])
+        out, _ = _regroup_arrays(list(outputs[:self._n_out]), self._out_fmt)
         return out
 
 
@@ -638,7 +656,14 @@ class HybridBlock(Block):
             if self._cached_op is None:
                 self._ensure_init(ctx, x, *args)
                 self._cached_op = _CachedOp(self)
-            flat, fmt = _flatten_arrays([x, *args] if args else x)
+            # plain-NDArray inputs (the steady-state case) have a trivial
+            # flatten plan — skip the recursive tree walk per call
+            if not args and isinstance(x, NDArray):
+                return self._cached_op([x], 0)
+            inputs = (x,) + args
+            if args and all(isinstance(a, NDArray) for a in inputs):
+                return self._cached_op(list(inputs), [0] * len(inputs))
+            flat, fmt = _flatten_arrays(list(inputs) if args else x)
             return self._cached_op(flat, fmt)
         try:
             params = {k: v.data(ctx) for k, v in self._reg_params.items()}

@@ -107,7 +107,21 @@ class FusedTrainStep:
     replicated across steps (no per-step broadcast); call :meth:`sync`
     before single-device eager evaluation."""
 
-    def __init__(self, net, loss_fn, trainer, devices=None):
+    def __init__(self, net, loss_fn, trainer, devices=None, donate=None,
+                 bucket=None):
+        """``donate``: None → MXNET_DONATE_BUFFERS knob; True/False forces
+        buffer donation for the step on/off.  ``bucket``: None → the
+        MXNET_SHAPE_BUCKETS knob; False forces bucketing off; else a spec
+        ('pow2', '8,16,32', or a sequence of sizes) — ragged batches are
+        padded up to the bucket (wrap-around rows) with the loss and
+        gradients masked to the real rows, so the step compiles once per
+        bucket instead of once per ragged size.  (BatchNorm batch
+        statistics do see the padded rows — the same trade the reference
+        NDArrayIter 'pad' last-batch mode makes.)
+
+        Optimizer-state handles are captured at first call; if
+        ``trainer.load_states`` later replaces them, call
+        :meth:`refresh_state_handles`."""
         for p in trainer._params:
             if p._replicas is not None and len(p.list_data()) > 1:
                 raise ValueError("FusedTrainStep supports single-context "
@@ -142,6 +156,18 @@ class FusedTrainStep:
         self._jitted = None
         self._n_states = None
         self._state_fmt = None
+        self._state_nds = None    # flat state handles, cached at build
+        self._donate_opt = donate
+        self._donate = False      # resolved at build
+        if isinstance(bucket, (list, tuple)):
+            bucket = tuple(sorted(int(b) for b in bucket))
+        self._bucket = bucket
+
+    def refresh_state_handles(self):
+        """Re-capture the updater's state NDArrays (needed only after
+        ``trainer.load_states`` swapped them)."""
+        if self._jitted is not None:
+            self._state_nds, self._state_fmt = self._flat_states()
 
     # -- state flattening -------------------------------------------------
     def _ensure_states(self):
@@ -182,10 +208,13 @@ class FusedTrainStep:
 
     # -- the traced step --------------------------------------------------
     def _build(self, x_nd, y_nd):
+        from ... import dispatch as _dispatch
+
         self._ensure_states()
         state_nds, state_fmt = self._flat_states()
         self._state_fmt = state_fmt
         self._n_states = len(state_nds)
+        self._state_nds = state_nds
         net, loss_fn = self._net, self._loss_fn
         params, auxs = self._params, self._auxs
         optimizer, updater = self._optimizer, self._updater
@@ -193,6 +222,15 @@ class FusedTrainStep:
         step_self = self
 
         def traced(rng, scalars, x, y, pdatas, adatas, sdatas):
+            # scalars[0] is the real row count of the (possibly padded)
+            # batch; masking the loss to the real rows makes the gradients
+            # of a bucketed ragged batch match the unpadded computation
+            # (pad rows contribute nothing), so one executable per bucket
+            # serves every ragged size.  The slot exists whether or not
+            # bucketing is on — the signature never changes.
+            n_valid = scalars[0]
+            opt_scalars = scalars[1:]
+
             def fwd(pdatas_in, adatas_in):
                 p_nds = [NDArray(a) for a in pdatas_in]
                 a_nds = [NDArray(a) for a in adatas_in]
@@ -205,8 +243,14 @@ class FusedTrainStep:
                         loss = loss_fn(out, NDArray(y))
                 finally:
                     _trace_state.active -= 1
-                lsum = jnp.sum(loss.data)
-                return lsum, (loss.data, tuple(a.data for a in a_nds))
+                ld = loss.data
+                if ld.ndim:
+                    mask = (jnp.arange(ld.shape[0]) < n_valid).astype(
+                        ld.dtype)
+                    ld = ld * mask.reshape((ld.shape[0],)
+                                           + (1,) * (ld.ndim - 1))
+                lsum = jnp.sum(ld)
+                return lsum, (ld, tuple(a.data for a in a_nds))
 
             (lsum, (lossvec, new_aux)), grads = jax.value_and_grad(
                 fwd, has_aux=True)(tuple(pdatas), tuple(adatas))
@@ -217,7 +261,7 @@ class FusedTrainStep:
             w_nds = [NDArray(a) for a in pdatas]
             g_nds = [NDArray(g) for g in grads]
             s_nds = [NDArray(a) for a in sdatas]
-            feed = _ScalarFeed(vector=scalars)
+            feed = _ScalarFeed(vector=opt_scalars)
             # tracing runs the host-side optimizer code once; the per-step
             # counter bumps belong to _host_scalars, so undo them here
             saved_counts = (dict(optimizer._index_update_count),
@@ -235,7 +279,13 @@ class FusedTrainStep:
                     tuple(s.data for s in s_nds))
 
         # donate params/aux/state buffers: updated in place on device
-        self._jitted = jax.jit(traced, donate_argnums=(4, 5, 6))
+        # (the reference CachedOp static_alloc analogue); resolved once so
+        # the whole run uses one executable per shape signature
+        self._donate = (self._donate_opt if self._donate_opt is not None
+                        else _dispatch.donation_active())
+        self._jitted = _dispatch.TrackedJit(
+            traced, donate_argnums=(4, 5, 6) if self._donate else (),
+            label="FusedTrainStep")
 
     def _host_scalars(self):
         """Per-step host pass: bump update counters and capture the float
@@ -255,19 +305,26 @@ class FusedTrainStep:
 
     def __call__(self, x, y):
         """Run one training step; returns the per-sample loss NDArray."""
+        from ... import dispatch as _dispatch
+        from ... import profiler as _prof
+
         x = x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
         y = y if isinstance(y, NDArray) else _wrap(jnp.asarray(y))
         batch = x.shape[0]
+        target = (batch if self._bucket is False
+                  else _dispatch.bucket_size(batch, self._bucket))
         if self._dp is not None:
             # reject ragged batches BEFORE any side effect (rescale_grad,
             # jit build, optimizer update-counter bumps)
             n_dev = len(self._dp[0].mesh.devices.ravel())
-            if batch % n_dev:
+            if target % n_dev:
                 raise ValueError(
                     "data-parallel FusedTrainStep: batch size %d is not "
                     "divisible by %d devices (pad or drop the ragged "
-                    "final batch)" % (batch, n_dev))
-        # Trainer.step parity: normalize grads by batch size
+                    "final batch, or use bucket sizes that divide the "
+                    "device count)" % (target, n_dev))
+        # Trainer.step parity: normalize grads by the REAL batch size
+        # (pad rows are masked out of the loss, so 1/batch is exact)
         self._optimizer.rescale_grad = 1.0 / batch
         if self._jitted is None:
             # finish any deferred parameter initialization with one eager
@@ -275,12 +332,17 @@ class FusedTrainStep:
             with autograd.pause(train_mode=False):
                 self._net(x)
             self._build(x, y)
-        scalars = self._host_scalars()
+        scalars = np.concatenate([
+            np.asarray([batch], dtype=np.float32), self._host_scalars()])
         pdatas = tuple(p.list_data()[0].data for p in self._params)
         adatas = tuple(a.list_data()[0].data for a in self._auxs)
-        state_nds, _ = self._flat_states()
+        state_nds = self._state_nds
         sdatas = tuple(s.data for s in state_nds)
         xd, yd = x.data, y.data
+        if target != batch:
+            xd = _dispatch.pad_batch(xd, target)
+            yd = _dispatch.pad_batch(yd, target)
+            _prof.dispatch_count("bucket_padded_batches")
         if self._dp is not None:
             shard, repl = self._dp
             xd = jax.device_put(xd, shard)
@@ -298,7 +360,32 @@ class FusedTrainStep:
             a.list_data()[0]._set_data(d)
         for s, d in zip(state_nds, new_s):
             s._set_data(d)
+        if self._donate and self._dp is None:
+            self._invalidate_donated(pdatas + adatas + sdatas,
+                                     new_p + new_a + new_s + (lossvec,))
+        if target != batch and lossvec.ndim:
+            lossvec = lossvec[:batch]
         return _wrap(lossvec)
+
+    @staticmethod
+    def _invalidate_donated(ins, outs):
+        """XLA normally consumes every donated buffer (the caller's
+        pre-step handles are marked deleted, so stale reads raise a clear
+        error).  If a donation was declined (layout/dtype mismatch), the
+        pre-step buffer would instead survive with a silently stale value
+        — delete it explicitly so reuse fails loudly either way."""
+        live = None
+        for buf in ins:
+            if buf.is_deleted():
+                continue
+            try:
+                if live is None:
+                    live = {o.unsafe_buffer_pointer() for o in outs}
+                if buf.unsafe_buffer_pointer() in live:
+                    continue  # aliased into an output: still in use
+                buf.delete()
+            except Exception:
+                return  # backend without buffer introspection: leave as is
 
     def sync(self):
         """Devolve replicated parameters/aux/optimizer state to the

@@ -38,9 +38,17 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False):
+                 thread_pool=False, bucket=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        # bucket: pad the ragged final batch's leading dim up to a shape
+        # bucket so jitted consumers compile once per bucket (None → the
+        # MXNET_SHAPE_BUCKETS knob; False disables; else a spec like
+        # 'pow2' / '8,16,32' / a sequence).  Pad rows wrap around real
+        # rows, matching the reference NDArrayIter 'pad' semantics.
+        if isinstance(bucket, (list, tuple)):
+            bucket = tuple(sorted(int(b) for b in bucket))
+        self._bucket = bucket
 
         if batch_sampler is None:
             if batch_size is None:
@@ -69,16 +77,44 @@ class DataLoader:
             batchify_fn = default_batchify_fn
         self._batchify_fn = batchify_fn
 
+    def _maybe_pad(self, batch):
+        if self._bucket is False:
+            return batch
+        from ... import dispatch as _dispatch
+
+        first = batch[0] if isinstance(batch, (list, tuple)) else batch
+        if not isinstance(first, nd.NDArray) or not first.shape:
+            return batch
+        n = first.shape[0]
+        target = _dispatch.bucket_size(n, self._bucket)
+        if target == n:
+            return batch
+        from ... import profiler as _prof
+
+        _prof.dispatch_count("bucket_padded_batches")
+
+        def pad(a):
+            if isinstance(a, nd.NDArray) and a.shape:
+                return nd.NDArray(_dispatch.pad_batch(a.data, target),
+                                  ctx=a.context)
+            return a
+
+        if isinstance(batch, (list, tuple)):
+            return [pad(a) for a in batch]
+        return pad(batch)
+
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[i] for i in batch])
+                yield self._maybe_pad(
+                    self._batchify_fn([self._dataset[i] for i in batch]))
             return
 
         # thread-pool pipeline with bounded prefetch
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             def fetch(batch):
-                return self._batchify_fn([self._dataset[i] for i in batch])
+                return self._maybe_pad(
+                    self._batchify_fn([self._dataset[i] for i in batch]))
 
             batches = iter(self._batch_sampler)
             pending = []

@@ -21,7 +21,7 @@ class Trainer:
     (reference: gluon/trainer.py:27)."""
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None, donate=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -48,6 +48,9 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._contains_sparse = False
+        # donation policy for the update kernels: None defers to the
+        # MXNET_DONATE_BUFFERS knob at each step; True/False pins it
+        self._donate = donate
 
     @property
     def _optimizer(self):
@@ -159,10 +162,17 @@ class Trainer:
             for dev, (arr, grad) in enumerate(
                     zip(param.list_data(), param.list_grad())):
                 batched.setdefault(dev, []).append((i, grad, arr))
-        for dev in sorted(batched):
-            upd = self._updaters[dev % len(self._updaters)]
-            idxs, grads, arrs = (list(t) for t in zip(*batched[dev]))
-            upd(idxs, grads, arrs)
+        from .. import dispatch as _dispatch
+
+        # the update kernels mutate weight + state in place; under the
+        # donation scope their pre-update buffers are donated to XLA so
+        # the step writes where the data already lives (no per-step
+        # param-sized allocations)
+        with _dispatch.donation_scope(self._donate):
+            for dev in sorted(batched):
+                upd = self._updaters[dev % len(self._updaters)]
+                idxs, grads, arrs = (list(t) for t in zip(*batched[dev]))
+                upd(idxs, grads, arrs)
 
     def save_states(self, fname):
         """Save optimizer (updater) states (reference: trainer.save_states)."""

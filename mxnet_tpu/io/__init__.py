@@ -8,7 +8,8 @@ prefetch onto device (the reference's `iter_prefetcher.h`), sharded by
 on-disk format so existing `.rec` datasets and `im2rec` tooling carry over.
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter,  # noqa: F401
-                 ResizeIter, PrefetchingIter, CSVIter, LibSVMIter, MNISTIter)
+                 ResizeIter, BucketPadIter, PrefetchingIter, CSVIter,
+                 LibSVMIter, MNISTIter)
 from . import io  # noqa: F401
 from .image_iter import ImageRecordIter, ImageRecordIter_v1  # noqa: F401
 from ..recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader,  # noqa: F401

@@ -23,7 +23,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter"]
+           "BucketPadIter", "PrefetchingIter", "CSVIter", "MNISTIter"]
 
 
 class DataDesc:
@@ -265,6 +265,76 @@ class ResizeIter(DataIter):
         if self.iter_next():
             return self.current_batch
         raise StopIteration
+
+
+class BucketPadIter(DataIter):
+    """Pad ragged batches from ``data_iter`` up to a shape bucket so every
+    batch a jitted consumer sees has a bucketed leading dim (one compiled
+    executable per bucket instead of one per ragged size).
+
+    Pad rows wrap around the batch's real rows — the reference
+    ``NDArrayIter`` 'pad' last-batch semantics — and the pad count is
+    reported via ``DataBatch.pad`` (added to any padding the inner
+    iterator already did) so consumers can mask or slice.
+
+    ``buckets``: None → the MXNET_SHAPE_BUCKETS knob; else a spec
+    ('pow2', '8,16,32', or a sequence of sizes)."""
+
+    def __init__(self, data_iter, buckets=None):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        if isinstance(buckets, (list, tuple)):
+            buckets = tuple(sorted(int(b) for b in buckets))
+        self._buckets = buckets
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.data_iter.reset()
+
+    @staticmethod
+    def _pad_list(arrays, target):
+        from .. import dispatch as _dispatch
+
+        out = []
+        for a in arrays:
+            if a is None or not getattr(a, "shape", None):
+                out.append(a)
+            elif isinstance(a, NDArray):
+                out.append(NDArray(_dispatch.pad_batch(a.data, target),
+                                   ctx=a.context))
+            else:
+                idx = np.arange(target) % a.shape[0]
+                out.append(np.take(a, idx, axis=0))
+        return out
+
+    def next(self):
+        from .. import dispatch as _dispatch
+        from .. import profiler as _prof
+
+        batch = self.data_iter.next()
+        data = batch.data if isinstance(batch.data, (list, tuple)) \
+            else [batch.data]
+        n = data[0].shape[0]
+        target = _dispatch.bucket_size(n, self._buckets)
+        if target == n:
+            return batch
+        _prof.dispatch_count("bucket_padded_batches")
+        label = (batch.label if isinstance(batch.label, (list, tuple))
+                 else ([batch.label] if batch.label is not None else None))
+        return DataBatch(
+            data=self._pad_list(data, target),
+            label=self._pad_list(label, target) if label else batch.label,
+            pad=(batch.pad or 0) + (target - n),
+            index=batch.index, bucket_key=target,
+            provide_data=batch.provide_data,
+            provide_label=batch.provide_label)
 
 
 class PrefetchingIter(DataIter):

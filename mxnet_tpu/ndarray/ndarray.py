@@ -98,7 +98,18 @@ class NDArray:
         return self
 
     def asnumpy(self):
-        return np.asarray(self._data)
+        try:
+            return np.asarray(self._data)
+        except RuntimeError as e:
+            if "deleted" in str(e).lower():
+                raise RuntimeError(
+                    "this NDArray's buffer was donated to a compiled step "
+                    "(MXNET_DONATE_BUFFERS): the pre-step value no longer "
+                    "exists on device. Read the post-step handle instead, "
+                    "or .copy() before the step, or disable donation "
+                    "(MXNET_DONATE_BUFFERS=0 / dispatch.no_donation()). "
+                    "Original error: %s" % e) from e
+            raise
 
     def asscalar(self):
         if self.size != 1:

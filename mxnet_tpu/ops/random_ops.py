@@ -76,7 +76,8 @@ def _randint(rng, low=0, high=1, shape=(1,), dtype="int32"):
           aliases=("sample_uniform",), input_names=("low", "high"))
 def _sample_uniform(rng, low, high, shape=()):
     s = tuple(shape) if shape else ()
-    return low[..., *([None] * len(s))] + (high - low)[..., *([None] * len(s))] \
+    exp = (Ellipsis,) + (None,) * len(s)
+    return low[exp] + (high - low)[exp] \
         * jax.random.uniform(rng, low.shape + s, low.dtype)
 
 
@@ -84,8 +85,9 @@ def _sample_uniform(rng, low, high, shape=()):
           aliases=("sample_normal",), input_names=("mu", "sigma"))
 def _sample_normal(rng, mu, sigma, shape=()):
     s = tuple(shape) if shape else ()
+    exp = (Ellipsis,) + (None,) * len(s)
     eps = jax.random.normal(rng, mu.shape + s, mu.dtype)
-    return mu[..., *([None] * len(s))] + sigma[..., *([None] * len(s))] * eps
+    return mu[exp] + sigma[exp] * eps
 
 
 @register("_sample_gamma", needs_rng=True, no_grad=True,

@@ -161,12 +161,43 @@ class OpDef:
             if self.needs_rng:
                 return f(rng, *arrays, **kw)
             return f(*arrays, **kw)
+        donate = self._donate_positions(arrays, params)
         f = _jitted(self, active_impl(self), _freeze(static),
-                    tuple(k for k, _ in arrs), train)
+                    tuple(k for k, _ in arrs), train, donate)
         args = list(arrays) + [v for _, v in arrs]
+        if donate:
+            from .. import profiler as _prof
+
+            _prof.dispatch_count(
+                "donated_bytes",
+                sum(getattr(arrays[j], "nbytes", 0) for j in donate))
         if self.needs_rng:
             return f(rng, *args)
         return f(*args)
+
+    def _donate_positions(self, arrays, params):
+        """Input positions donated to XLA for this call: the mutated
+        inputs (optimizer state, BN running stats) — their post-call
+        value is written back via the mutate map, so the pre-call buffer
+        is dead and XLA may update it in place (reference CachedOp
+        static_alloc in-place planning).  Empty when donation is off,
+        while an autograd tape would keep the input buffers for replay,
+        or under an enclosing trace (mutation can't escape it anyway)."""
+        if not (self.mutate or self.mutate_fn):
+            return ()
+        from ..dispatch import donation_active
+
+        if not donation_active():
+            return ()
+        from .. import autograd as _ag
+
+        if not self.no_grad and _ag.is_recording():
+            return ()
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return ()
+        mut = (self.mutate_fn(params, len(arrays)) if self.mutate_fn
+               else self.mutate)
+        return tuple(sorted({j for j in mut.values() if j < len(arrays)}))
 
 
 def split_params(opdef, params):
@@ -310,8 +341,8 @@ def _bound_fn(opdef, impl, static_items, train):
     return call
 
 
-def _jitted(opdef, impl, static_items, array_param_names, train):
-    key = (opdef, impl, static_items, array_param_names, train)
+def _jitted(opdef, impl, static_items, array_param_names, train, donate=()):
+    key = (opdef, impl, static_items, array_param_names, train, donate)
     cached = _JIT_CACHE.get(key)
     if cached is not None:
         return cached
@@ -322,6 +353,9 @@ def _jitted(opdef, impl, static_items, array_param_names, train):
     n_ap = len(array_param_names)
 
     def call(*args):
+        from .. import profiler as _prof
+
+        _prof.dispatch_count("op_recompile")
         if n_ap:
             data, ap = args[:-n_ap], args[-n_ap:]
             pkw = dict(kw)
@@ -330,7 +364,14 @@ def _jitted(opdef, impl, static_items, array_param_names, train):
         return fn(*args, **kw)
 
     call.__name__ = opdef.name
-    jitted = jax.jit(call)
+    if donate:
+        # donate positions index the data arrays; the jitted signature
+        # prepends rng for needs_rng ops
+        shift = 1 if opdef.needs_rng else 0
+        jitted = jax.jit(call,
+                         donate_argnums=tuple(j + shift for j in donate))
+    else:
+        jitted = jax.jit(call)
     _JIT_CACHE[key] = jitted
     return jitted
 

@@ -718,6 +718,12 @@ class Updater:
         self.states = {}
         self.states_synced = {}
         self.aggregate_updates = optimizer.aggregate_num > 0
+        # chunk plan cache: Trainer._update calls with the same param
+        # list every step, so the dense/dtype grouping walk is identical
+        # — precompute it once per (indices, dtypes, stypes) key and
+        # replay slices on later steps (per-cache-key dispatch planning)
+        self._chunk_key = None
+        self._chunk_plan = None
 
     def _state_of(self, index, weight):
         if index not in self.states:
@@ -752,14 +758,32 @@ class Updater:
         if run:
             yield tuple(zip(*run))
 
+    def _chunk_slices(self, indices, grads, weights):
+        """Positions of each aggregate chunk, cached per call signature."""
+        key = (tuple(indices),
+               tuple(str(w.dtype) for w in weights),
+               tuple(getattr(w, "stype", "default") for w in weights),
+               tuple(getattr(g, "stype", "default") for g in grads),
+               int(self.optimizer.aggregate_num))
+        if key != self._chunk_key:
+            pos = {id(w): p for p, w in enumerate(weights)}
+            plan = []
+            for _, _, ws in self._aggregate_chunks(indices, grads, weights):
+                plan.append([pos[id(w)] for w in ws])
+            self._chunk_key = key
+            self._chunk_plan = plan
+        return self._chunk_plan
+
     def __call__(self, index, grad, weight):
         if not isinstance(index, (list, tuple)):
             index, grad, weight = [index], [grad], [weight]
         if self.aggregate_updates and len(index) > 1:
-            for idxs, gs, ws in self._aggregate_chunks(index, grad, weight):
+            for ps in self._chunk_slices(index, grad, weight):
+                idxs = [index[p] for p in ps]
+                ws = [weight[p] for p in ps]
+                gs = [grad[p] for p in ps]
                 states = [self._state_of(i, w) for i, w in zip(idxs, ws)]
-                self.optimizer.update_multi_precision(
-                    list(idxs), list(ws), list(gs), states)
+                self.optimizer.update_multi_precision(idxs, ws, gs, states)
             return
         for i, g, w in zip(index, grad, weight):
             self.optimizer.update_multi_precision(

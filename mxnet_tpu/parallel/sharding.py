@@ -103,8 +103,12 @@ def constraint(x, *spec_entries, mesh=None):
     # stage under shard_map
     try:
         manual = set(jax.sharding.get_abstract_mesh().manual_axes)
-    except AttributeError:  # pragma: no cover - older jax
-        manual = set()
+    except AttributeError:  # older jax: shard_map binds its axes in the
+        try:                # tracer axis env instead
+            from jax._src import core as _core
+            manual = set(_core.get_axis_env().axis_names())
+        except Exception:  # pragma: no cover
+            manual = set()
     if manual:
         def strip(e):
             if isinstance(e, (tuple, list)):

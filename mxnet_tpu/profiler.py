@@ -30,7 +30,7 @@ import time
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "state", "ProfileDomain", "Task", "Event",
-           "Counter", "Frame", "Marker"]
+           "Counter", "Frame", "Marker", "dispatch_count", "dispatch_stats"]
 
 _lock = threading.Lock()
 _config = {
@@ -130,6 +130,42 @@ class _Null:
 
 
 _NULL = _Null()
+
+
+# -- dispatch counters (always on, unlike spans) ----------------------------
+# The donation-aware dispatch path (executor / _CachedOp / FusedTrainStep /
+# ops.registry) reports cache behaviour here: "jit_cache_hit" /
+# "jit_cache_miss" count calls that reused vs. (re)built a compiled
+# executable at the step/graph level, "recompile" counts step-level traces,
+# "op_recompile" counts per-op jit traces, "donated_bytes" accumulates the
+# bytes of device buffers handed to XLA for in-place reuse, and
+# "bucket_padded_batches" counts ragged batches padded up to a shape bucket.
+# These are plain ints (no profiler session required) so CI can print them
+# after every tier-1 run; when a profiler session IS running each update
+# also lands as a chrome-trace counter event.
+_DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
+                  "op_recompile", "donated_bytes", "bucket_padded_batches")
+_dispatch = {k: 0 for k in _DISPATCH_KEYS}
+
+
+def dispatch_count(name, delta=1):
+    """Bump a dispatch counter (internal hook API; unknown names are
+    created on the fly so experiments don't need a registry edit)."""
+    _dispatch[name] = _dispatch.get(name, 0) + delta
+    if _state == "run" and not _paused:
+        _events.append({"name": "dispatch::%s" % name, "cat": "counter",
+                        "ph": "C", "ts": _now_us(), "pid": os.getpid(),
+                        "args": {"value": _dispatch[name]}})
+
+
+def dispatch_stats(reset=False):
+    """Snapshot of the dispatch counters as a plain dict."""
+    with _lock:
+        out = dict(_dispatch)
+        if reset:
+            for k in list(_dispatch):
+                _dispatch[k] = 0
+    return out
 
 
 # -- public API (reference profiler.py surface) -----------------------------

@@ -7,7 +7,54 @@ IO deps), served through the same ``Features``/``feature_list`` API.
 """
 from __future__ import annotations
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list", "init_compile_cache",
+           "compile_cache_dir"]
+
+_compile_cache_dir = None
+
+
+def init_compile_cache(path=None):
+    """Arm JAX's persistent compilation cache so jitted modules survive
+    process restarts (the reference keeps compiled CachedOp plans only
+    in-process; XLA lets us do better).
+
+    ``path`` defaults to the MXNET_COMPILE_CACHE knob ('' → disabled,
+    '1'/'auto'/'true' → ``~/.cache/mxnet_tpu/xla-cache``, else a directory).
+    JAX consults ``jax_compilation_cache_dir`` at compile time, so this must
+    run before the first compilation — ``import mxnet_tpu`` calls it, and
+    callers may also invoke it explicitly with a path early in a process.
+    Returns the resolved directory, or None when disabled."""
+    global _compile_cache_dir
+    import os
+
+    from .config import config
+
+    raw = path if path is not None else config.compile_cache
+    raw = (raw or "").strip()
+    if not raw or raw == "0":
+        return _compile_cache_dir
+    if raw.lower() in ("1", "true", "auto"):
+        raw = os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                           "xla-cache")
+    os.makedirs(raw, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", raw)
+    # default thresholds skip small/fast programs; persist everything —
+    # tier-1-sized graphs are exactly what restarts keep recompiling
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass  # older jax: thresholds stay at their defaults
+    _compile_cache_dir = raw
+    return _compile_cache_dir
+
+
+def compile_cache_dir():
+    """The armed persistent-cache directory, or None."""
+    return _compile_cache_dir
 
 
 class Feature:
@@ -39,6 +86,7 @@ def _probe():
     feats["INT8"] = True  # int8 dot/conv with int32 accumulation
     feats["F16C"] = False
     feats["INT64_TENSOR_SIZE"] = bool(jax.config.jax_enable_x64)
+    feats["COMPILE_CACHE"] = bool(_compile_cache_dir)
     feats["DIST_KVSTORE"] = True  # jax.distributed + gloo/ICI collectives
     feats["PROFILER"] = True
     try:

@@ -63,6 +63,22 @@ def subprocess_env(**extra):
     return env
 
 
+def pytest_terminal_summary(terminalreporter):
+    """Print the dispatch counters (jit cache hits/misses, recompiles,
+    donated bytes) after every run — the tier-1 gate reads these to spot
+    recompile regressions (ci/runtime_functions.sh)."""
+    try:
+        from mxnet_tpu import profiler
+
+        stats = profiler.dispatch_stats()
+        terminalreporter.write_sep(
+            "-", "dispatch counters (mxnet_tpu.profiler.dispatch_stats)")
+        terminalreporter.write_line(
+            "  ".join("%s=%d" % (k, v) for k, v in sorted(stats.items())))
+    except Exception:
+        pass  # never let diagnostics fail the suite
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Reference parity: @with_seed decorator — reproducible randomized

@@ -37,3 +37,47 @@ def test_percall_throughput_runs():
         net(x)
     r = percall_throughput(net, x, steps=2, draws=2)
     assert 0 < r["min"] <= r["median"] <= r["max"]
+
+
+def test_donated_fused_step_steady_state_memory_and_compiles():
+    """Acceptance micro-benchmark (donation-aware fused dispatch): the
+    donated fused step leaves no second param-sized buffer behind per
+    step (every pre-step param buffer is consumed in place), and with
+    shape bucketing the recompile count stays at the initial 1 across
+    >=3 ragged final-batch sizes."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(8, 12).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, (8,)))
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    step = FusedTrainStep(net, loss_fn=gluon.loss.SoftmaxCrossEntropyLoss(),
+                          trainer=tr, donate=True, bucket="8")
+    params = list(net.collect_params().values())
+
+    step(x, y)  # the ONE compile for the bucket-8 signature
+    base = profiler.dispatch_stats()
+
+    ptr_pool = set()
+    for n in (8, 7, 5, 3, 8):  # three ragged sizes in the mix
+        pre = [p.list_data()[0].data for p in params]
+        step(x[:n], y[:n])
+        # donation consumed every pre-step param buffer in place: the
+        # step allocated no surviving second copy of the parameters
+        assert all(b.is_deleted() for b in pre)
+        ptr_pool |= {p.list_data()[0].data.unsafe_buffer_pointer()
+                     for p in params}
+
+    after = profiler.dispatch_stats()
+    assert after["recompile"] - base["recompile"] == 0
+    assert after["jit_cache_hit"] - base["jit_cache_hit"] >= 5
+    assert after["donated_bytes"] > base["donated_bytes"]
+    # steady state cycles a bounded buffer pool (in-place reuse /
+    # allocator ping-pong), it does not grow a fresh set per step
+    assert len(ptr_pool) <= 2 * len(params), len(ptr_pool)
