@@ -51,8 +51,41 @@ unittest_parallel() {
     # hook — watch "recompile" for dispatch regressions.
     python -m pytest tests/test_parallel.py tests/test_dist.py \
         tests/test_fused_step.py tests/test_dispatch.py \
-        tests/test_elastic.py \
+        tests/test_elastic.py tests/test_async_kv.py \
         tests/test_data_parallel.py tests/test_gradient_compression.py -q
+}
+
+fault_injection_smoke() {
+    # Preemption-safety smoke (docs/FAULT_TOLERANCE.md): one supervised
+    # run per fault mode — mid-epoch crash, SIGTERM drain, torn save —
+    # each must resume to a final bit-identical to the clean oracle.
+    # Budget: 60s wall (the e2e suite proper lives in test_elastic.py).
+    timeout 60 env JAX_PLATFORMS=cpu MXTPU_RESTART_BACKOFF=0.05 \
+        python - <<'PY'
+import json, os, sys, tempfile
+sys.path.insert(0, "tests")
+from conftest import subprocess_env
+from mxnet_tpu.elastic import supervise
+
+env = subprocess_env(MXTPU_RESTART_BACKOFF="0.05")
+d = tempfile.mkdtemp()
+worker = os.path.join("tests", "elastic_worker.py")
+
+def run(name, fault):
+    p = os.path.join(d, name)
+    supervise([sys.executable, worker, p, "10"], max_restarts=2,
+              env={**env, **fault})
+    return json.load(open(p + ".final.json"))
+
+clean = run("clean", {})
+for name, fault in (("crash", {"MXTPU_FI_AT_STEP": "7"}),
+                    ("sigterm", {"MXTPU_FI_SIGTERM_AT_STEP": "4"}),
+                    ("torn", {"MXTPU_FI_CRASH_AFTER_PARAMS": "5"})):
+    got = run(name, fault)
+    assert got["w"] == clean["w"] and got["b"] == clean["b"], name
+    print("fault mode %-8s -> bit-identical resume" % name)
+print("fault_injection_smoke OK")
+PY
 }
 
 unittest_serving() {

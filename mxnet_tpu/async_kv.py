@@ -32,18 +32,42 @@ gathers the requested rows only.  The host server IS the TPU-native
 placement for this: host-row tables are host-resident by design, so
 cross-worker consistency comes from one authoritative host copy, not
 from device collectives.
+
+Self-healing transport (reference parity: ps-lite ``resender.h`` ack +
+retransmit over its heartbeat layer): every request carries
+``(client_id, seq)``; the client retries a failed call on a FRESH
+connection with bounded exponential backoff + jitter, and the server
+keeps a per-client ``(last_seq, last_reply)`` record so a retried
+mutating op (a ``push`` whose reply was lost in a connection reset) is
+applied exactly once — the cached reply is returned instead of
+re-applying.  The client holds one outstanding request at a time (the
+``_call`` lock), so one cached reply per client is sufficient.  The
+server also reaps stale connections: a handler that sees no request for
+``MXTPU_KV_REAP_S`` closes its socket, so dead workers cannot pin
+threads forever.  See docs/FAULT_TOLERANCE.md.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import random as _pyrandom
 import socket
 import socketserver
 import struct
 import threading
+import time
+import uuid
 
 import numpy as np
 
 _KV_KEY = "mxtpu/async_server_addr"
+
+# transport knobs (documented in docs/FAULT_TOLERANCE.md / ENV_VARS.md)
+_DEF_TIMEOUT = float(os.environ.get("MXTPU_KV_TIMEOUT", "60"))
+_DEF_RETRIES = int(os.environ.get("MXTPU_KV_RETRIES", "5"))
+_DEF_BACKOFF = float(os.environ.get("MXTPU_KV_BACKOFF", "0.05"))
+_DEF_BACKOFF_CAP = float(os.environ.get("MXTPU_KV_BACKOFF_CAP", "2.0"))
+_DEF_REAP_S = float(os.environ.get("MXTPU_KV_REAP_S", "600"))
 
 
 def _send_msg(sock, obj):
@@ -72,13 +96,28 @@ class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr):
+    def __init__(self, addr, reap_s=None):
         super().__init__(addr, _Handler)
         self.store: dict = {}
         self.row_tables: dict = {}
         self.updater = None
         self.lock = threading.Lock()
         self._str_idx: dict = {}
+        # per-client retransmit dedup: client_id -> [last_seq, last_reply,
+        # last_seen].  One entry per client suffices (clients hold one
+        # outstanding request), so memory is O(workers).
+        self.sessions: dict = {}
+        self.reap_s = _DEF_REAP_S if reap_s is None else float(reap_s)
+
+    def _prune_sessions(self):
+        """Drop dedup records for clients idle past the reap window
+        (called under ``lock``; bounds the table if workers churn)."""
+        if len(self.sessions) <= 1024:
+            return
+        now = time.monotonic()
+        for cid in [c for c, s in self.sessions.items()
+                    if now - s[2] > max(self.reap_s, 60.0)]:
+            del self.sessions[cid]
 
     def key_index(self, key):
         """Same int-index convention the worker-side store uses for
@@ -106,135 +145,220 @@ def _row_of(tbl, i):
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         srv: _Server = self.server  # type: ignore[assignment]
+        # stale-connection reaper: a worker that died without closing its
+        # socket must not pin this handler thread forever — recv blocks at
+        # most reap_s, then the connection is closed (a live client that
+        # was merely idle transparently reconnects on its next call)
+        if srv.reap_s > 0:
+            self.request.settimeout(srv.reap_s)
         try:
             while True:
-                op, key, payload = _recv_msg(self.request)
+                msg = _recv_msg(self.request)
+                if len(msg) == 5:          # (client_id, seq, op, key, payload)
+                    cid, seq, op, key, payload = msg
+                else:                      # legacy stateless (op, key, payload)
+                    cid, seq = None, None
+                    op, key, payload = msg
                 with srv.lock:
-                    if op == "init":
-                        if key not in srv.store:
-                            srv.store[key] = np.array(payload)
-                        reply = None
-                    elif op == "push":
-                        grad = np.asarray(payload)
-                        cur = srv.store.get(key)
-                        if cur is None:
-                            reply = KeyError(key)
-                        elif srv.updater is not None:
-                            # per-push apply — THE async semantics: no
-                            # waiting for other workers' contributions
-                            srv.updater(key, grad, cur)
-                            reply = None
-                        else:
-                            # without a server-side optimizer there is no
-                            # meaningful async aggregation (the reference
-                            # requires update_on_kvstore in async mode)
-                            reply = RuntimeError(
-                                "dist_async push before set_optimizer: "
-                                "async mode requires the optimizer to run "
-                                "on the kvstore (update_on_kvstore=True)")
-                    elif op == "pull":
-                        cur = srv.store.get(key)
-                        reply = KeyError(key) if cur is None \
-                            else cur.copy()
-                    elif op == "init_rows":
-                        if key not in srv.row_tables:
-                            shape, dtype, init_blob = payload
-                            srv.row_tables[key] = {
-                                "shape": tuple(shape),
-                                "dtype": np.dtype(dtype),
-                                "init": (pickle.loads(init_blob)
-                                         if init_blob is not None
-                                         else None),
-                                "rows": {},
-                            }
-                        reply = None
-                    elif op == "push_rows":
-                        tbl = srv.row_tables.get(key)
-                        if tbl is None:
-                            reply = KeyError(key)
-                        elif srv.updater is None:
-                            # assigning per-worker grads would resolve
-                            # overlapping ids last-writer-wins — the
-                            # silent divergence this server exists to
-                            # prevent; same contract as dense push
-                            reply = RuntimeError(
-                                "dist host-row push before "
-                                "set_optimizer: the server-side sparse "
-                                "reduce needs the optimizer on the "
-                                "kvstore (update_on_kvstore=True)")
-                        else:
-                            ids, grads = payload
-                            grads = np.asarray(grads)
-                            for j, i in enumerate(np.asarray(ids)):
-                                i = int(i)
-                                # per-row updater index: per-row state
-                                # AND update counts
-                                srv.updater("hostrow:%s:%d" % (key, i),
-                                            grads[j], _row_of(tbl, i))
-                            reply = None
-                    elif op == "pull_rows":
-                        tbl = srv.row_tables.get(key)
-                        if tbl is None:
-                            reply = KeyError(key)
-                        else:
-                            ids = np.asarray(payload)
-                            reply = np.stack(
-                                [_row_of(tbl, int(i)).copy()
-                                 for i in ids]) if len(ids) else \
-                                np.zeros((0,) + tbl["shape"][1:],
-                                         tbl["dtype"])
-                    elif op == "set_optimizer":
-                        from . import optimizer as opt
-
-                        optimizer = pickle.loads(payload)
-                        updater = opt.get_updater(optimizer)
-
-                        def np_updater(k, g, stored, _u=updater,
-                                       _srv=srv):
-                            from .ndarray import array
-
-                            w = array(stored)
-                            _u(_srv.key_index(k), array(g), w)
-                            stored[...] = w.asnumpy()
-
-                        srv.updater = np_updater
-                        reply = None
-                    else:
-                        reply = ValueError("unknown op %r" % (op,))
-                _send_msg(self.request, reply)
-        except (ConnectionError, EOFError):
+                    if cid is not None:
+                        sess = srv.sessions.get(cid)
+                        if sess is not None and seq <= sess[0]:
+                            # retransmit of an op whose reply was lost:
+                            # answer from the cache, do NOT re-apply
+                            _send_msg(self.request, (seq, sess[1]))
+                            continue
+                    reply = self._apply(srv, op, key, payload)
+                    if cid is not None:
+                        srv.sessions[cid] = [seq, reply, time.monotonic()]
+                        srv._prune_sessions()
+                _send_msg(self.request, (seq, reply))
+        except (ConnectionError, EOFError, socket.timeout, OSError):
             pass
+
+    @staticmethod
+    def _apply(srv, op, key, payload):
+        """Execute one op against the store (caller holds ``srv.lock``);
+        returns the reply value (an Exception instance for error replies)."""
+        if op == "init":
+            if key not in srv.store:
+                srv.store[key] = np.array(payload)
+            return None
+        if op == "push":
+            grad = np.asarray(payload)
+            cur = srv.store.get(key)
+            if cur is None:
+                return KeyError(key)
+            if srv.updater is not None:
+                # per-push apply — THE async semantics: no waiting for
+                # other workers' contributions
+                srv.updater(key, grad, cur)
+                return None
+            # without a server-side optimizer there is no meaningful
+            # async aggregation (the reference requires
+            # update_on_kvstore in async mode)
+            return RuntimeError(
+                "dist_async push before set_optimizer: "
+                "async mode requires the optimizer to run "
+                "on the kvstore (update_on_kvstore=True)")
+        if op == "pull":
+            cur = srv.store.get(key)
+            return KeyError(key) if cur is None else cur.copy()
+        if op == "init_rows":
+            if key not in srv.row_tables:
+                shape, dtype, init_blob = payload
+                srv.row_tables[key] = {
+                    "shape": tuple(shape),
+                    "dtype": np.dtype(dtype),
+                    "init": (pickle.loads(init_blob)
+                             if init_blob is not None else None),
+                    "rows": {},
+                }
+            return None
+        if op == "push_rows":
+            tbl = srv.row_tables.get(key)
+            if tbl is None:
+                return KeyError(key)
+            if srv.updater is None:
+                # assigning per-worker grads would resolve overlapping
+                # ids last-writer-wins — the silent divergence this
+                # server exists to prevent; same contract as dense push
+                return RuntimeError(
+                    "dist host-row push before "
+                    "set_optimizer: the server-side sparse "
+                    "reduce needs the optimizer on the "
+                    "kvstore (update_on_kvstore=True)")
+            ids, grads = payload
+            grads = np.asarray(grads)
+            for j, i in enumerate(np.asarray(ids)):
+                i = int(i)
+                # per-row updater index: per-row state AND update counts
+                srv.updater("hostrow:%s:%d" % (key, i),
+                            grads[j], _row_of(tbl, i))
+            return None
+        if op == "pull_rows":
+            tbl = srv.row_tables.get(key)
+            if tbl is None:
+                return KeyError(key)
+            ids = np.asarray(payload)
+            return np.stack(
+                [_row_of(tbl, int(i)).copy()
+                 for i in ids]) if len(ids) else \
+                np.zeros((0,) + tbl["shape"][1:], tbl["dtype"])
+        if op == "set_optimizer":
+            from . import optimizer as opt
+
+            optimizer = pickle.loads(payload)
+            updater = opt.get_updater(optimizer)
+
+            def np_updater(k, g, stored, _u=updater, _srv=srv):
+                from .ndarray import array
+
+                w = array(stored)
+                _u(_srv.key_index(k), array(g), w)
+                stored[...] = w.asnumpy()
+
+            srv.updater = np_updater
+            return None
+        return ValueError("unknown op %r" % (op,))
 
 
 class AsyncKVClient:
-    """Worker-side handle; worker 0 also hosts the server thread."""
+    """Worker-side handle; worker 0 also hosts the server thread.
 
-    def __init__(self):
-        import jax
-        from jax._src import distributed
+    ``addr='host:port'`` connects straight to a running server (tests,
+    out-of-band deployments); without it the jax.distributed
+    coordination KV supplies the address and worker 0 hosts the server.
 
-        client = distributed.global_state.client
-        assert client is not None, \
-            "dist_async needs jax.distributed (use tools/launch.py)"
+    The transport self-heals: a timed-out or reset call closes the
+    socket, backs off (exponential + jitter, capped), reconnects, and
+    retransmits the SAME sequence number — the server deduplicates, so
+    a push whose reply was lost is applied exactly once."""
+
+    def __init__(self, addr=None, timeout=None, max_retries=None,
+                 backoff=None, backoff_cap=None):
         self._server = None
-        if jax.process_index() == 0:
-            self._server = _Server(("0.0.0.0", 0))
-            port = self._server.server_address[1]
-            threading.Thread(target=self._server.serve_forever,
-                             daemon=True).start()
-            host = distributed.global_state.coordinator_address.split(":")[0]
-            client.key_value_set(_KV_KEY, "%s:%d" % (host, port))
-            addr = "%s:%d" % (host, port)
-        else:
-            addr = client.blocking_key_value_get(_KV_KEY, 60_000)
+        if addr is None:
+            import jax
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            assert client is not None, \
+                "dist_async needs jax.distributed (use tools/launch.py)"
+            if jax.process_index() == 0:
+                self._server = _Server(("0.0.0.0", 0))
+                port = self._server.server_address[1]
+                threading.Thread(target=self._server.serve_forever,
+                                 daemon=True).start()
+                host = distributed.global_state.coordinator_address \
+                    .split(":")[0]
+                client.key_value_set(_KV_KEY, "%s:%d" % (host, port))
+                addr = "%s:%d" % (host, port)
+            else:
+                addr = client.blocking_key_value_get(_KV_KEY, 60_000)
         h, p = addr.rsplit(":", 1)
-        self._sock = socket.create_connection((h, int(p)), timeout=60)
+        self._addr = (h, int(p))
+        self._timeout = _DEF_TIMEOUT if timeout is None else float(timeout)
+        self._retries = _DEF_RETRIES if max_retries is None \
+            else int(max_retries)
+        self._backoff = _DEF_BACKOFF if backoff is None else float(backoff)
+        self._backoff_cap = _DEF_BACKOFF_CAP if backoff_cap is None \
+            else float(backoff_cap)
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
+        self._sock = None
         self._lock = threading.Lock()
+        # test hook: seq numbers whose send succeeds but whose reply is
+        # "lost" (socket closed before recv) — exercises the retransmit+
+        # dedup path deterministically
+        self._fi_drop_after_send = set()
+        self._connect()
+
+    def _connect(self):
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._sock.settimeout(self._timeout)
+
+    def _close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(self, op, key, payload=None):
         with self._lock:
-            _send_msg(self._sock, (op, key, payload))
-            reply = _recv_msg(self._sock)
+            self._seq += 1
+            seq = self._seq
+            last_err = None
+            for attempt in range(self._retries + 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    _send_msg(self._sock,
+                              (self._client_id, seq, op, key, payload))
+                    if seq in self._fi_drop_after_send:
+                        self._fi_drop_after_send.discard(seq)
+                        self._close()
+                        raise ConnectionError(
+                            "injected reply loss (seq %d)" % seq)
+                    rseq, reply = _recv_msg(self._sock)
+                    if rseq != seq:  # torn stream: resync on a fresh conn
+                        raise ConnectionError(
+                            "reply seq %s != request seq %d" % (rseq, seq))
+                    break
+                except (ConnectionError, EOFError, socket.timeout,
+                        OSError) as e:
+                    last_err = e
+                    self._close()
+                    if attempt >= self._retries:
+                        raise ConnectionError(
+                            "async-KV call %r failed after %d retries: %s"
+                            % (op, self._retries, last_err)) from last_err
+                    delay = min(self._backoff_cap,
+                                self._backoff * (2.0 ** attempt)) \
+                        * (0.5 + 0.5 * _pyrandom.random())
+                    time.sleep(delay)
         if isinstance(reply, Exception):
             raise reply
         return reply

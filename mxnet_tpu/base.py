@@ -8,7 +8,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "DTYPE_MAP", "np_dtype", "string_types"]
+__all__ = ["MXNetError", "DTYPE_MAP", "np_dtype", "string_types",
+           "encode_rng_state", "decode_rng_state"]
 
 string_types = (str,)
 
@@ -74,3 +75,19 @@ def in_user_trace():
     """True when user-level jax is tracing (jit/scan/grad over framework
     calls).  Imperative caching/mutation must not capture tracers then."""
     return not _trace_state_clean()
+
+
+def encode_rng_state(rng):
+    """JSON-able snapshot of a ``np.random.RandomState`` (checkpointed by
+    the data-iterator ``state_dict`` protocol so shuffle order of FUTURE
+    epochs survives a mid-epoch resume)."""
+    kind, keys, pos, has_gauss, cached = rng.get_state()
+    return [kind, [int(k) for k in keys], int(pos), int(has_gauss),
+            float(cached)]
+
+
+def decode_rng_state(state):
+    """Inverse of :func:`encode_rng_state` (a set_state-compatible tuple)."""
+    kind, keys, pos, has_gauss, cached = state
+    return (kind, np.asarray(keys, dtype=np.uint32), int(pos),
+            int(has_gauss), float(cached))

@@ -108,7 +108,7 @@ class FusedTrainStep:
     before single-device eager evaluation."""
 
     def __init__(self, net, loss_fn, trainer, devices=None, donate=None,
-                 bucket=None):
+                 bucket=None, watchdog=None, preemption=None):
         """``donate``: None → MXNET_DONATE_BUFFERS knob; True/False forces
         buffer donation for the step on/off.  ``bucket``: None → the
         MXNET_SHAPE_BUCKETS knob; False forces bucketing off; else a spec
@@ -121,7 +121,15 @@ class FusedTrainStep:
 
         Optimizer-state handles are captured at first call; if
         ``trainer.load_states`` later replaces them, call
-        :meth:`refresh_state_handles`."""
+        :meth:`refresh_state_handles`.
+
+        Resilience wiring (mxnet_tpu.elastic): every ``__call__`` kicks
+        ``watchdog`` (default: the process's active elastic.Watchdog, so
+        a wedged collective inside the compiled step converts into a
+        restartable exit), and checks ``preemption`` (an
+        elastic.PreemptionHandler) BEFORE any side effect — a pending
+        SIGTERM drain raises PreemptionRequested at the step boundary,
+        where params/optimizer state are consistent to checkpoint."""
         for p in trainer._params:
             if p._replicas is not None and len(p.list_data()) > 1:
                 raise ValueError("FusedTrainStep supports single-context "
@@ -162,6 +170,8 @@ class FusedTrainStep:
         if isinstance(bucket, (list, tuple)):
             bucket = tuple(sorted(int(b) for b in bucket))
         self._bucket = bucket
+        self._watchdog = watchdog
+        self._preemption = preemption
 
     def refresh_state_handles(self):
         """Re-capture the updater's state NDArrays (needed only after
@@ -306,8 +316,16 @@ class FusedTrainStep:
     def __call__(self, x, y):
         """Run one training step; returns the per-sample loss NDArray."""
         from ... import dispatch as _dispatch
+        from ... import elastic as _elastic
         from ... import profiler as _prof
 
+        # liveness + drain checks at the step boundary, before any side
+        # effect (rescale_grad, jit build, optimizer counter bumps)
+        wd = self._watchdog or _elastic.active_watchdog()
+        if wd is not None:
+            wd.kick()
+        if self._preemption is not None:
+            self._preemption.check()
         x = x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
         y = y if isinstance(y, NDArray) else _wrap(jnp.asarray(y))
         batch = x.shape[0]

@@ -38,7 +38,7 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, bucket=None):
+                 thread_pool=False, bucket=None, seed=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         # bucket: pad the ragged final batch's leading dim up to a shape
@@ -56,7 +56,10 @@ class DataLoader:
                                  "batch_sampler is specified")
             if sampler is None:
                 if shuffle:
-                    sampler = RandomSampler(len(dataset))
+                    # seed= makes the shuffle order checkpointable: with
+                    # it, state_dict()/load_state_dict() give exact
+                    # mid-epoch resume after preemption
+                    sampler = RandomSampler(len(dataset), seed=seed)
                 else:
                     sampler = SequentialSampler(len(dataset))
             elif shuffle:
@@ -69,7 +72,15 @@ class DataLoader:
             raise ValueError("batch_size, shuffle, sampler and last_batch "
                              "must not be specified if batch_sampler is "
                              "specified.")
+        else:
+            sampler = None  # caller-owned batch_sampler: position unknown
+        self._sampler = sampler
         self._batch_sampler = batch_sampler
+        self._epoch = 0          # completed epochs
+        self._served = 0         # batches yielded in the current epoch
+        self._in_epoch = False
+        self._epoch_sampler_state = None  # sampler rng AT epoch start
+        self._resume = None
         self._num_workers = num_workers if num_workers >= 0 else 0
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * self._num_workers)
@@ -103,11 +114,77 @@ class DataLoader:
             return [pad(a) for a in batch]
         return pad(batch)
 
+    # -- mid-epoch resume -------------------------------------------------
+    def _sampler_snapshot(self):
+        s = self._sampler
+        if s is None:
+            raise ValueError(
+                "DataLoader.state_dict: a caller-supplied batch_sampler "
+                "has no recoverable position — construct the loader from "
+                "batch_size/shuffle/sampler for preemption-safe resume")
+        if isinstance(s, RandomSampler):
+            snap = s.state_dict()
+            if snap["rng"] is None:
+                raise ValueError(
+                    "DataLoader.state_dict: shuffle order is drawn from "
+                    "the global np.random and cannot be replayed — pass "
+                    "seed= to DataLoader (or a seeded RandomSampler) for "
+                    "exact resume")
+            return snap
+        return None  # deterministic sampler (sequential)
+
+    def state_dict(self):
+        """JSON-able position snapshot: completed epochs, batches already
+        served this epoch, and the sampler RNG as of the epoch START (so
+        the resumed loader re-draws the same order and skips the served
+        batches).  Checkpoint alongside params; restore with
+        :meth:`load_state_dict` before iterating."""
+        return {"epoch": int(self._epoch), "served": int(self._served),
+                "sampler": (self._epoch_sampler_state if self._in_epoch
+                            else self._sampler_snapshot())}
+
+    def load_state_dict(self, state):
+        self._resume = dict(state)
+
+    def _index_batches(self):
+        """Batch index stream with resume bookkeeping (shared by the
+        inline and thread-pool paths)."""
+        resume, self._resume = self._resume, None
+        skip = 0
+        if resume is not None:
+            self._epoch = int(resume["epoch"])
+            skip = int(resume["served"])
+            if resume.get("sampler") is not None and self._sampler is not None:
+                self._sampler.load_state_dict(resume["sampler"])
+        # snapshot BEFORE the batch sampler draws this epoch's order
+        self._epoch_sampler_state = None
+        if self._sampler is not None \
+                and hasattr(self._sampler, "state_dict"):
+            self._epoch_sampler_state = self._sampler.state_dict()
+        self._in_epoch = True
+        self._served = skip
+        it = iter(self._batch_sampler)
+        for _ in range(skip):  # replay position: already-trained batches
+            next(it)
+        return it
+
+    def _epoch_done(self):
+        self._epoch += 1
+        self._served = 0
+        self._in_epoch = False
+        self._epoch_sampler_state = None
+
     def __iter__(self):
         if self._num_workers == 0:
-            for batch in self._batch_sampler:
-                yield self._maybe_pad(
+            for batch in self._index_batches():
+                out = self._maybe_pad(
                     self._batchify_fn([self._dataset[i] for i in batch]))
+                # count BEFORE yielding: the generator suspends at yield,
+                # so a post-yield increment would lag one batch behind
+                # what the consumer has already trained on
+                self._served += 1
+                yield out
+            self._epoch_done()
             return
 
         # thread-pool pipeline with bounded prefetch
@@ -116,7 +193,7 @@ class DataLoader:
                 return self._maybe_pad(
                     self._batchify_fn([self._dataset[i] for i in batch]))
 
-            batches = iter(self._batch_sampler)
+            batches = self._index_batches()
             pending = []
             try:
                 for _ in range(self._prefetch or 1):
@@ -129,7 +206,9 @@ class DataLoader:
                     pending.append(pool.submit(fetch, next(batches)))
                 except StopIteration:
                     pass
+                self._served += 1
                 yield out
+            self._epoch_done()
 
     def __len__(self):
         return len(self._batch_sampler)

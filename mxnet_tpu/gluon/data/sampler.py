@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...base import decode_rng_state, encode_rng_state
+
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
 
@@ -29,16 +31,35 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Shuffled indices; with ``seed=`` the order comes from an own
+    RandomState whose state is checkpointable (``state_dict``), so a
+    preempted DataLoader can re-draw the SAME epoch order on resume and
+    later epochs shuffle exactly as an uninterrupted run would."""
+
+    def __init__(self, length, seed=None):
         self._length = length
+        self._rng = np.random.RandomState(seed) if seed is not None else None
 
     def __iter__(self):
         indices = np.arange(self._length)
-        np.random.shuffle(indices)
+        (self._rng if self._rng is not None else np.random).shuffle(indices)
         return iter(indices)
 
     def __len__(self):
         return self._length
+
+    def state_dict(self):
+        """RNG snapshot; None without ``seed=`` (global np.random order
+        cannot be replayed — DataLoader.state_dict rejects that)."""
+        return {"rng": (encode_rng_state(self._rng)
+                        if self._rng is not None else None)}
+
+    def load_state_dict(self, state):
+        if state.get("rng") is None:
+            return
+        if self._rng is None:
+            self._rng = np.random.RandomState()
+        self._rng.set_state(decode_rng_state(state["rng"]))
 
 
 class BatchSampler(Sampler):

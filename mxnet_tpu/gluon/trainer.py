@@ -51,6 +51,7 @@ class Trainer:
         # donation policy for the update kernels: None defers to the
         # MXNET_DONATE_BUFFERS knob at each step; True/False pins it
         self._donate = donate
+        self._preemption = None
 
     @property
     def _optimizer(self):
@@ -118,9 +119,20 @@ class Trainer:
                               " rate is mutated.")
         self._optimizer.set_learning_rate(lr)
 
+    def attach_preemption_handler(self, handler):
+        """Attach an :class:`mxnet_tpu.elastic.PreemptionHandler`: every
+        :meth:`step` then raises ``PreemptionRequested`` at the step
+        boundary (before the update mutates params/optimizer state) once
+        a drain signal has arrived, so the caller can checkpoint a
+        consistent state and exit.  Pass None to detach."""
+        self._preemption = handler
+        return self
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update: rescale by 1/batch_size, reduce grads
         across devices, apply updates (reference: trainer.py:302)."""
+        if self._preemption is not None:
+            self._preemption.check()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

@@ -19,6 +19,7 @@ import threading
 
 import numpy as np
 
+from .. import base as _base
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
@@ -113,6 +114,20 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- mid-epoch resume (elastic.CheckpointManager rides these) ---------
+    def state_dict(self):
+        """JSON-able snapshot of the iteration position (epoch, cursor,
+        shuffle order/rng) for exact mid-epoch resume after preemption.
+        Checkpoint it in ``CheckpointManager`` ``extra`` and restore with
+        :meth:`load_state_dict`; the resumed iterator replays exactly the
+        REMAINING batches, so crash-resume stays bit-identical."""
+        raise NotImplementedError(
+            "%s does not support mid-epoch resume" % type(self).__name__)
+
+    def load_state_dict(self, state):
+        raise NotImplementedError(
+            "%s does not support mid-epoch resume" % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize input data to a list of (name, numpy array) (io.py:434)."""
@@ -150,7 +165,7 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
@@ -162,6 +177,14 @@ class NDArrayIter(DataIter):
                                  "of samples")
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        # seed: own RandomState so the shuffle order is (a) independent of
+        # other np.random consumers and (b) checkpointable — state_dict
+        # snapshots it so epochs after a mid-epoch resume shuffle exactly
+        # as the uninterrupted run would have.  None keeps the legacy
+        # global-np.random behavior (resume then replays the current epoch
+        # exactly, but later epochs depend on the ambient global RNG).
+        self._seed = seed
+        self._rng = np.random.RandomState(seed) if seed is not None else None
         self.idx = np.arange(self.num_data)
         self._leftover = np.array([], dtype=np.int64)  # roll_over carry
         self.cursor = -batch_size
@@ -179,7 +202,8 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            (self._rng if self._rng is not None else np.random) \
+                .shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and len(self._leftover):
             # the unserved tail of the previous epoch leads this one
             self._order = np.concatenate([self._leftover, self.idx])
@@ -187,6 +211,35 @@ class NDArrayIter(DataIter):
             self._order = self.idx
         self._epoch_size = len(self._order)
         self.cursor = -self.batch_size
+
+    def state_dict(self):
+        """Exact-resume snapshot: current epoch order + cursor + roll_over
+        carry + (with ``seed=``) the shuffle RNG state."""
+        return {
+            "cursor": int(self.cursor),
+            "order": [int(i) for i in self._order],
+            "idx": [int(i) for i in self.idx],
+            "leftover": [int(i) for i in self._leftover],
+            "batch_size": int(self.batch_size),
+            "rng": (_base.encode_rng_state(self._rng)
+                    if self._rng is not None else None),
+        }
+
+    def load_state_dict(self, state):
+        if int(state["batch_size"]) != self.batch_size:
+            raise ValueError(
+                "iterator resume: batch_size changed (%d -> %d); the "
+                "replayed batch boundaries would differ"
+                % (state["batch_size"], self.batch_size))
+        self.idx = np.asarray(state["idx"], dtype=np.int64)
+        self._order = np.asarray(state["order"], dtype=np.int64)
+        self._leftover = np.asarray(state["leftover"], dtype=np.int64)
+        self._epoch_size = len(self._order)
+        self.cursor = int(state["cursor"])
+        if state.get("rng") is not None:
+            if self._rng is None:
+                self._rng = np.random.RandomState()
+            self._rng.set_state(_base.decode_rng_state(state["rng"]))
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -250,6 +303,14 @@ class ResizeIter(DataIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def state_dict(self):
+        return {"cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def load_state_dict(self, state):
+        self.cur = int(state["cur"])
+        self.data_iter.load_state_dict(state["inner"])
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -297,6 +358,14 @@ class BucketPadIter(DataIter):
 
     def reset(self):
         self.data_iter.reset()
+
+    def state_dict(self):
+        # padding is a pure per-batch transform: position state lives
+        # entirely in the wrapped iterator
+        return self.data_iter.state_dict()
+
+    def load_state_dict(self, state):
+        self.data_iter.load_state_dict(state)
 
     @staticmethod
     def _pad_list(arrays, target):

@@ -49,18 +49,25 @@ def save(fname, data, format="npz"):
     if isinstance(data, NDArray):
         data = [data]
     arrays = {}
+    # host numpy values are accepted alongside NDArray so checkpoint
+    # writers (elastic.CheckpointManager.save_async) can serialize a
+    # device→host snapshot from a background thread without touching jax
     if isinstance(data, dict):
         for key, val in data.items():
-            if not isinstance(key, str) or not isinstance(val, NDArray):
+            if not isinstance(key, str) or not isinstance(val,
+                                                          (NDArray,
+                                                           np.ndarray)):
                 raise ValueError("save only accepts dict str->NDArray or "
                                  "list of NDArray")
-            arrays["name:" + key] = val.asnumpy()
+            arrays["name:" + key] = (val.asnumpy()
+                                     if isinstance(val, NDArray) else val)
     elif isinstance(data, (list, tuple)):
         for i, val in enumerate(data):
-            if not isinstance(val, NDArray):
+            if not isinstance(val, (NDArray, np.ndarray)):
                 raise ValueError("save only accepts dict str->NDArray or "
                                  "list of NDArray")
-            arrays["idx:%09d" % i] = val.asnumpy()
+            arrays["idx:%09d" % i] = (val.asnumpy()
+                                      if isinstance(val, NDArray) else val)
     else:
         raise ValueError("data needs to either be a NDArray, dict of str to "
                          "NDArray or a list of NDArray")

@@ -89,6 +89,14 @@ def _probe():
     feats["COMPILE_CACHE"] = bool(_compile_cache_dir)
     feats["DIST_KVSTORE"] = True  # jax.distributed + gloo/ICI collectives
     feats["PROFILER"] = True
+    # resilience layer (mxnet_tpu.elastic): background checksummed
+    # checkpoint writes, and SIGTERM→checkpoint-at-step-boundary drain
+    feats["ASYNC_CHECKPOINT"] = True
+    try:
+        import signal
+        feats["PREEMPTION_DRAIN"] = hasattr(signal, "SIGTERM")
+    except Exception:
+        feats["PREEMPTION_DRAIN"] = False
     try:
         import cv2  # noqa: F401
         feats["OPENCV"] = True

@@ -63,6 +63,14 @@ def subprocess_env(**extra):
     return env
 
 
+def pytest_configure(config):
+    # the tier-1 gate runs with -m 'not slow'; register the marker so
+    # the deselect is intentional, not a typo pytest warns about
+    config.addinivalue_line(
+        "markers", "slow: long-running variant excluded from the tier-1 "
+        "gate (run explicitly with -m slow)")
+
+
 def pytest_terminal_summary(terminalreporter):
     """Print the dispatch counters (jit cache hits/misses, recompiles,
     donated bytes) after every run — the tier-1 gate reads these to spot

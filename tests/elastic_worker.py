@@ -1,13 +1,25 @@
 """Deterministic training worker for the elastic-recovery tests.
 
-Trains a small dense regression for N steps, checkpointing every step;
-resumes from the newest checkpoint on restart.  With MXTPU_FI_AT_STEP
-set it crashes there on the first incarnation only — the supervised
-rerun must finish and (the test asserts) produce final params
-bit-identical to an uninterrupted run.
+Trains a small dense regression for N steps over a shuffled NDArrayIter
+(48 samples, batch 16 -> 3 batches/epoch, seed=11), checkpointing every
+step with ``save_async`` and riding the iterator's ``state_dict`` in the
+checkpoint extra; resumes (params, optimizer state, AND mid-epoch
+iterator position) from the newest verified checkpoint on restart.
+
+Fault hooks (all incarnation-0 only, driven by env):
+  MXTPU_FI_AT_STEP            crash (InjectedFault) at that step
+  MXTPU_FI_SIGTERM_AT_STEP    self-deliver SIGTERM at that step; the
+                              PreemptionHandler drains at the next step
+                              boundary and exits PREEMPTED_EXIT_CODE
+  MXTPU_FI_CRASH_AFTER_PARAMS os._exit(23) inside the checkpoint writer
+                              between the params and meta renames
+
+In every case the supervised rerun must finish and (the tests assert)
+produce final params bit-identical to an uninterrupted run.
 """
 import json
 import os
+import signal
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -17,18 +29,27 @@ import numpy as np  # noqa: E402
 
 def main():
     import mxnet_tpu as mx
-    from mxnet_tpu.elastic import CheckpointManager, FaultInjector
+    from mxnet_tpu.elastic import (CheckpointManager, FaultInjector,
+                                   PreemptionHandler, PreemptionRequested)
+    from mxnet_tpu.io import NDArrayIter
 
     prefix = sys.argv[1]
     total_steps = int(sys.argv[2])
+    incarnation = int(os.environ.get("MXTPU_RESTART_COUNT", "0"))
+    sigterm_at = int(os.environ.get("MXTPU_FI_SIGTERM_AT_STEP", "-1"))
 
     rng = np.random.RandomState(7)
-    Xh = rng.randn(64, 10).astype(np.float32)
-    X = mx.nd.array(Xh)
-    Y = mx.nd.array((Xh @ rng.randn(10, 1)).astype(np.float32))
+    Xh = rng.randn(48, 10).astype(np.float32)
+    Yh = (Xh @ rng.randn(10, 1)).astype(np.float32)
+
+    # one batch per training step; epochs wrap every 3 steps, so any
+    # crash step that is not a multiple of 3 exercises MID-epoch resume
+    it = NDArrayIter(Xh, Yh, batch_size=16, shuffle=True,
+                     last_batch_handle="discard", seed=11)
 
     ckpt = CheckpointManager(prefix, keep_n=2)
     fi = FaultInjector()
+    ph = PreemptionHandler().install()
 
     resumed = ckpt.latest()
     if resumed is None:
@@ -37,34 +58,60 @@ def main():
         b = mx.nd.zeros((1,))
         mom_w = mx.nd.zeros((1, 10))
         mom_b = mx.nd.zeros((1,))
+        last_loss = None
     else:
-        step0, params, extra = resumed
-        start = step0
+        start, params, extra = resumed
         w, b = params["w"], params["b"]
         mom_w, mom_b = params["mom_w"], params["mom_b"]
-        print("resumed at step %d (incarnation %s)"
-              % (start, os.environ.get("MXTPU_RESTART_COUNT")))
+        if "iter" in extra:
+            it.load_state_dict(extra["iter"])
+        last_loss = extra.get("loss")
+        print("resumed at step %d (incarnation %s)" % (start, incarnation))
 
     w.attach_grad()
     b.attach_grad()
-    # resume landing exactly at total_steps (killed after the last save
-    # but before final.json): nothing to train, report the saved loss
-    last_loss = resumed[2].get("loss") if resumed else None
-    for step in range(start, total_steps):
-        fi.maybe_fail(step)
-        with mx.autograd.record():
-            loss = ((mx.nd.FullyConnected(X, w, b, num_hidden=1) - Y)
-                    ** 2).mean()
-        loss.backward()
-        # explicit momentum sgd so optimizer state rides the checkpoint
-        mx.nd.sgd_mom_update(w, w.grad, mom_w, lr=0.05, momentum=0.9,
-                             out=w)
-        mx.nd.sgd_mom_update(b, b.grad, mom_b, lr=0.05, momentum=0.9,
-                             out=b)
-        last_loss = float(loss.asnumpy())
-        ckpt.save(step + 1, {"w": w, "b": b,
-                             "mom_w": mom_w, "mom_b": mom_b},
-                  extra={"loss": last_loss})
+
+    def snapshot():
+        return {"w": w, "b": b, "mom_w": mom_w, "mom_b": mom_b}
+
+    def next_batch():
+        try:
+            return it.next()
+        except StopIteration:
+            it.reset()
+            return it.next()
+
+    done = start
+    try:
+        for step in range(start, total_steps):
+            ph.check()  # drain at the step boundary, state consistent
+            fi.maybe_fail(step)
+            if step == sigterm_at and incarnation == 0:
+                os.kill(os.getpid(), signal.SIGTERM)  # preemption notice
+            batch = next_batch()
+            X, Y = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                loss = ((mx.nd.FullyConnected(X, w, b, num_hidden=1) - Y)
+                        ** 2).mean()
+            loss.backward()
+            # explicit momentum sgd so optimizer state rides the checkpoint
+            mx.nd.sgd_mom_update(w, w.grad, mom_w, lr=0.05, momentum=0.9,
+                                 out=w)
+            mx.nd.sgd_mom_update(b, b.grad, mom_b, lr=0.05, momentum=0.9,
+                                 out=b)
+            last_loss = float(loss.asnumpy())
+            done = step + 1
+            ckpt.save_async(done, snapshot(),
+                            extra={"loss": last_loss,
+                                   "iter": it.state_dict()})
+        ckpt.flush()  # the final step's write must be committed
+    except PreemptionRequested:
+        # sync drain checkpoint (save() orders after the in-flight async
+        # write), then exit with the distinctive preemption status
+        ph.drain(lambda: ckpt.save(done, snapshot(),
+                                   extra={"loss": last_loss,
+                                          "iter": it.state_dict()}))
+
     final = {"w": w.asnumpy().tolist(), "b": b.asnumpy().tolist(),
              "loss": last_loss}
     with open(prefix + ".final.json", "w") as f:

@@ -3,11 +3,13 @@ gap the TPU build must fill: checkpoint-based auto-resume + restart).
 
 The headline assertion mirrors the dist_sync kvstore standard: a run
 that crashes mid-training and auto-resumes must produce final params
-BIT-IDENTICAL to an uninterrupted run.
+BIT-IDENTICAL to an uninterrupted run — including crashes landing
+mid-epoch (the data iterator's ``state_dict`` rides the checkpoint),
+graceful SIGTERM drains, and a crash inside the checkpoint writer
+between the params and meta renames.
 """
 import json
 import os
-import subprocess
 import sys
 
 import numpy as np
@@ -15,14 +17,16 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.elastic import (CheckpointManager, FaultInjector,
-                               InjectedFault, Watchdog, supervise,
-                               WATCHDOG_EXIT_CODE)
+                               InjectedFault, PreemptionHandler, Watchdog,
+                               _backoff_delay, supervise,
+                               PREEMPTED_EXIT_CODE, WATCHDOG_EXIT_CODE)
 
 from conftest import subprocess_env
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
-ENV = subprocess_env()
+# fast restarts: the e2e tests below exercise several supervised reruns
+ENV = subprocess_env(MXTPU_RESTART_BACKOFF="0.05")
 
 
 # ---------------------------------------------------------------------------
@@ -35,7 +39,7 @@ def test_checkpoint_roundtrip_and_prune(tmp_path):
     assert cm.steps() == [3, 4]  # pruned to keep_n
     step, params, extra = cm.latest()
     assert step == 4 and extra["epoch"] == 4
-    assert float(params["w"].asnumpy()) == 4.0
+    assert params["w"].asnumpy().item() == 4.0
 
 
 def test_checkpoint_commit_point_is_meta(tmp_path):
@@ -53,6 +57,61 @@ def test_checkpoint_commit_point_is_meta(tmp_path):
 
 def test_cold_start_returns_none(tmp_path):
     assert CheckpointManager(str(tmp_path / "nope")).latest() is None
+
+
+def test_save_async_roundtrip(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ck"), keep_n=2)
+    for s in range(1, 4):
+        job = cm.save_async(s, {"w": mx.nd.array([float(s)])},
+                            extra={"s": s})
+    job.wait()
+    cm.flush()
+    assert cm.steps() == [2, 3]
+    step, params, extra = cm.latest()
+    assert step == 3 and extra["s"] == 3
+    assert params["w"].asnumpy().item() == 3.0
+
+
+def test_latest_skips_truncated_params(tmp_path):
+    """A torn/bit-rotted params file fails its CRC and ``latest()``
+    falls back to the previous verified checkpoint (no crash)."""
+    cm = CheckpointManager(str(tmp_path / "ck"), keep_n=3)
+    for s in (1, 2):
+        cm.save(s, {"w": mx.nd.array([float(s)])})
+    with open(cm._params_path(2), "r+b") as f:
+        f.truncate(os.path.getsize(cm._params_path(2)) // 2)
+    step, params, _ = cm.latest()
+    assert step == 1
+    assert params["w"].asnumpy().item() == 1.0
+
+    # bit-flip the survivor too -> nothing verifies -> cold start
+    with open(cm._params_path(1), "r+b") as f:
+        f.seek(3)
+        byte = f.read(1)
+        f.seek(3)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    assert cm.latest() is None
+
+
+def test_latest_skips_invalid_meta(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ck"), keep_n=3)
+    for s in (1, 2):
+        cm.save(s, {"w": mx.nd.array([float(s)])})
+    with open(cm._meta_path(2), "w") as f:
+        f.write("{not json")
+    step, params, _ = cm.latest()
+    assert step == 1 and params["w"].asnumpy().item() == 1.0
+
+
+def test_meta_without_checksums_still_loads(tmp_path):
+    """Pre-checksum checkpoints (no ``checksums`` key) stay loadable."""
+    cm = CheckpointManager(str(tmp_path / "ck"))
+    cm.save(1, {"w": mx.nd.array([1.0])})
+    meta = json.load(open(cm._meta_path(1)))
+    del meta["checksums"]
+    with open(cm._meta_path(1), "w") as f:
+        json.dump(meta, f)
+    assert cm.latest()[0] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -90,34 +149,148 @@ def test_watchdog_fires_on_stall_and_not_when_kicked():
     wd.stop()
 
 
+def test_watchdog_double_start_raises_and_stop_joins():
+    from mxnet_tpu.elastic import active_watchdog
+
+    wd = Watchdog(timeout=60.0, on_stall=lambda: None).start()
+    assert active_watchdog() is wd
+    with pytest.raises(RuntimeError, match="called twice"):
+        wd.start()
+    wd.stop()
+    assert not wd._thread.is_alive()  # stop() joins the watcher
+    assert active_watchdog() is None
+
+
 # ---------------------------------------------------------------------------
-# End-to-end: crash -> supervise restart -> resume -> bit-identical
+# PreemptionHandler + backoff units
 # ---------------------------------------------------------------------------
-def _run_worker(prefix, steps, extra_env=None, max_restarts=0):
+def test_preemption_handler_flag_and_check():
+    import signal as _signal
+
+    from mxnet_tpu.elastic import PreemptionRequested
+
+    ph = PreemptionHandler().install()
+    try:
+        assert not ph.requested
+        ph.check()  # no signal yet: no-op
+        os.kill(os.getpid(), _signal.SIGTERM)
+        for _ in range(100):  # delivery lands at a bytecode boundary
+            if ph.requested:
+                break
+        assert ph.requested
+        with pytest.raises(PreemptionRequested):
+            ph.check()
+    finally:
+        ph.uninstall()
+
+
+def test_backoff_delay_grows_and_caps():
+    base, cap = 2.0, 30.0
+    for failures, ideal in ((1, 2.0), (2, 4.0), (3, 8.0), (10, cap)):
+        for _ in range(8):
+            d = _backoff_delay(failures, base, cap)
+            assert min(ideal, cap) * 0.5 <= d <= min(ideal, cap)
+    assert _backoff_delay(5, 0.0) == 0.0  # disabled
+
+
+def test_supervise_nonretryable_exit_code(tmp_path):
+    script = tmp_path / "assert_fail.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    with pytest.raises(RuntimeError, match="non-retryable rc=9"):
+        supervise([sys.executable, str(script)], max_restarts=5, env=ENV,
+                  nonretryable={9})
+    # same failure without the classification burns the whole budget
+    with pytest.raises(RuntimeError, match="after 1 restarts"):
+        supervise([sys.executable, str(script)], max_restarts=1, env=ENV,
+                  backoff=0.01)
+
+
+def test_supervise_nonretryable_from_env(tmp_path):
+    script = tmp_path / "assert_fail.py"
+    script.write_text("import sys; sys.exit(11)\n")
+    with pytest.raises(RuntimeError, match="non-retryable rc=11"):
+        supervise([sys.executable, str(script)], max_restarts=5,
+                  env={**ENV, "MXTPU_NONRETRYABLE_EXIT_CODES": "9,11"})
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: fault -> supervise restart -> resume -> bit-identical
+# ---------------------------------------------------------------------------
+STEPS = 10
+
+
+def _run_worker(prefix, steps=STEPS, extra_env=None, max_restarts=0):
     argv = [sys.executable, WORKER, prefix, str(steps)]
     return supervise(argv, max_restarts=max_restarts,
                      env={**ENV, **(extra_env or {})})
 
 
-def test_crash_resume_bitwise_equal(tmp_path):
-    steps = 10
-    # uninterrupted baseline
-    clean = str(tmp_path / "clean")
-    restarts = _run_worker(clean, steps)
-    assert restarts == 0
+def _final(prefix):
+    with open(prefix + ".final.json") as f:
+        return json.load(f)
 
-    # crashing run: dies at step 6 on incarnation 0, restarts, resumes
+
+@pytest.fixture(scope="module")
+def clean_final(tmp_path_factory):
+    """One uninterrupted baseline run shared by every fault-path test
+    (the worker is deterministic, so one oracle serves them all)."""
+    prefix = str(tmp_path_factory.mktemp("elastic") / "clean")
+    assert _run_worker(prefix) == 0
+    return _final(prefix)
+
+
+def test_crash_resume_bitwise_equal(tmp_path, clean_final):
+    # dies at step 6 on incarnation 0 (mid-epoch: 6 steps = 2 epochs of
+    # 3 batches, so the NEXT crash step below covers mid-epoch too),
+    # restarts, resumes from the checkpoint + iterator state
     faulty = str(tmp_path / "faulty")
-    restarts = _run_worker(faulty, steps,
-                           extra_env={"MXTPU_FI_AT_STEP": "6"},
+    restarts = _run_worker(faulty, extra_env={"MXTPU_FI_AT_STEP": "6"},
                            max_restarts=2)
     assert restarts == 1  # exactly one restart used
 
-    a = json.load(open(clean + ".final.json"))
-    b = json.load(open(faulty + ".final.json"))
-    assert a["w"] == b["w"] and a["b"] == b["b"]  # bit-identical
+    b = _final(faulty)
+    assert clean_final["w"] == b["w"] and clean_final["b"] == b["b"]
     # initial loss is ~10 on this task; 10 steps brings it under 2
-    assert np.isfinite(a["loss"]) and a["loss"] < 2.0
+    assert np.isfinite(clean_final["loss"]) and clean_final["loss"] < 2.0
+
+
+def test_mid_epoch_crash_resume_bitwise_equal(tmp_path, clean_final):
+    """Crash at step 7 — one batch INTO the third epoch — so the resume
+    must restore the iterator's mid-epoch cursor and shuffle order, not
+    just restart the epoch."""
+    faulty = str(tmp_path / "midepoch")
+    restarts = _run_worker(faulty, extra_env={"MXTPU_FI_AT_STEP": "7"},
+                           max_restarts=2)
+    assert restarts == 1
+    b = _final(faulty)
+    assert clean_final["w"] == b["w"] and clean_final["b"] == b["b"]
+
+
+def test_sigterm_drain_resume_bitwise_equal(tmp_path, clean_final):
+    """SIGTERM mid-loop: the worker drains (checkpoint at the next step
+    boundary, exit PREEMPTED_EXIT_CODE), supervise restarts WITHOUT
+    charging the failure budget (max_restarts=0 proves it), and the
+    resumed run is bit-identical."""
+    drained = str(tmp_path / "drained")
+    restarts = _run_worker(
+        drained, extra_env={"MXTPU_FI_SIGTERM_AT_STEP": "4"},
+        max_restarts=0)
+    assert restarts == 1  # one (free) preemption restart
+    b = _final(drained)
+    assert clean_final["w"] == b["w"] and clean_final["b"] == b["b"]
+
+
+def test_mid_save_crash_falls_back_and_resumes(tmp_path, clean_final):
+    """os._exit between the params and meta renames (the torn-save
+    window): the half-written step never becomes visible, latest() is
+    the previous step, and the rerun is still bit-identical."""
+    torn = str(tmp_path / "torn")
+    restarts = _run_worker(
+        torn, extra_env={"MXTPU_FI_CRASH_AFTER_PARAMS": "5"},
+        max_restarts=2)
+    assert restarts == 1
+    b = _final(torn)
+    assert clean_final["w"] == b["w"] and clean_final["b"] == b["b"]
 
 
 def test_supervise_budget_exhausted(tmp_path):
@@ -140,3 +313,34 @@ def test_supervise_restarts_watchdog_exit(tmp_path):
     restarts = supervise([sys.executable, str(script)], max_restarts=2,
                          env=ENV)
     assert restarts == 1
+
+
+def test_supervise_preemption_budget_is_separate(tmp_path):
+    """PREEMPTED_EXIT_CODE never burns the failure budget; the separate
+    max_preemptions bound stops a preemption livelock."""
+    script = tmp_path / "preempt_twice.py"
+    script.write_text(
+        "import os, sys\n"
+        "if int(os.environ['MXTPU_RESTART_COUNT']) < 2:\n"
+        "    sys.exit(%d)\n" % PREEMPTED_EXIT_CODE)
+    assert supervise([sys.executable, str(script)], max_restarts=0,
+                     env=ENV) == 2
+    with pytest.raises(RuntimeError, match="preempted"):
+        supervise([sys.executable, str(script)], max_restarts=0, env=ENV,
+                  max_preemptions=1)
+
+
+@pytest.mark.slow
+def test_crash_step_sweep_bitwise_equal(tmp_path, clean_final):
+    """Exhaustive variant of the headline test: crash at EVERY step
+    (each epoch position, first and last step included) and require
+    bit-identical finals.  Slow: one supervised rerun per step."""
+    for at in range(1, STEPS):
+        prefix = str(tmp_path / ("sweep%d" % at))
+        restarts = _run_worker(
+            prefix, extra_env={"MXTPU_FI_AT_STEP": str(at)},
+            max_restarts=2)
+        assert restarts == 1
+        b = _final(prefix)
+        assert clean_final["w"] == b["w"] and clean_final["b"] == b["b"], \
+            "divergence after crash at step %d" % at

@@ -137,3 +137,61 @@ def test_bf16_plain_path_multi_precision():
         tr.step(x.shape[0])
         losses.append(float(l.asnumpy().astype(np.float32).mean()))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+# ---------------------------------------------------------------------------
+# Resilience wiring (mxnet_tpu.elastic)
+# ---------------------------------------------------------------------------
+def test_fused_step_kicks_active_watchdog():
+    """Every __call__ kicks the process's active watchdog, so a training
+    loop built on FusedTrainStep gets hang detection for free."""
+    import time
+
+    from mxnet_tpu import elastic
+
+    x, y = _data()
+    net = _make_net()
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          trainer)
+    wd = elastic.Watchdog(timeout=3600.0, on_stall=lambda: None).start()
+    try:
+        wd._last = time.monotonic() - 1000.0  # pretend a long stall
+        step(x, y)
+        assert time.monotonic() - wd._last < 100.0  # kicked by the step
+    finally:
+        wd.stop()
+
+
+def test_fused_step_and_trainer_observe_preemption():
+    """A pending drain signal raises PreemptionRequested at the step
+    boundary — BEFORE the step mutates params — for both the fused path
+    and the plain Trainer.step path."""
+    from mxnet_tpu import elastic
+
+    x, y = _data()
+    net = _make_net()
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ph = elastic.PreemptionHandler()
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          trainer, preemption=ph)
+    step(x, y)  # no signal: trains normally
+
+    before = {k: p.list_data()[0].asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    import signal as _signal
+
+    ph._on_signal(_signal.SIGTERM, None)  # simulate the SIGTERM arriving
+    with pytest.raises(elastic.PreemptionRequested):
+        step(x, y)
+    for k, p in net.collect_params().items():
+        np.testing.assert_array_equal(before[k],
+                                      p.list_data()[0].asnumpy())
+
+    trainer.attach_preemption_handler(ph)
+    with pytest.raises(elastic.PreemptionRequested):
+        trainer.step(x.shape[0])

@@ -161,3 +161,201 @@ def test_databatch_attributes():
                         pad=1, bucket_key=7)
     assert b.pad == 1 and b.bucket_key == 7
     assert len(b.data) == 1 and len(b.label) == 1
+
+
+# ---------------------------------------------------------------------------
+# Mid-epoch exact resume (preemption-safe iterators)
+# ---------------------------------------------------------------------------
+def _collect_n(it, n):
+    out = []
+    for _ in range(n):
+        try:
+            b = it.next()
+        except StopIteration:
+            it.reset()
+            b = it.next()
+        out.append(b.data[0].asnumpy().copy())
+    return out
+
+
+def test_ndarrayiter_mid_epoch_resume_bitwise():
+    """state_dict taken mid-epoch (after a JSON roundtrip, as it rides
+    the checkpoint meta) replays the remaining batches — including the
+    NEXT epoch's shuffle — exactly."""
+    import json
+
+    X = np.arange(48, dtype=np.float32).reshape(24, 2)
+    for cut in (2, 3, 5):  # mid-epoch, epoch boundary, into 2nd epoch
+        a = mx.io.NDArrayIter(X, batch_size=8, shuffle=True,
+                              last_batch_handle="discard", seed=11)
+        _collect_n(a, cut)
+        state = json.loads(json.dumps(a.state_dict()))
+        rest_a = _collect_n(a, 7)
+
+        b = mx.io.NDArrayIter(X, batch_size=8, shuffle=True,
+                              last_batch_handle="discard", seed=11)
+        b.load_state_dict(state)
+        rest_b = _collect_n(b, 7)
+        for da, db in zip(rest_a, rest_b):
+            np.testing.assert_array_equal(da, db)
+
+
+def test_ndarrayiter_resume_rejects_batch_size_change():
+    X = np.zeros((24, 2), np.float32)
+    a = mx.io.NDArrayIter(X, batch_size=8, seed=3)
+    state = a.state_dict()
+    b = mx.io.NDArrayIter(X, batch_size=6, seed=3)
+    with pytest.raises(ValueError, match="batch_size changed"):
+        b.load_state_dict(state)
+
+
+def test_ndarrayiter_roll_over_resume_keeps_leftover():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    a = mx.io.NDArrayIter(X, batch_size=4, shuffle=True,
+                          last_batch_handle="roll_over", seed=5)
+    ref = _collect_n(a, 6)
+
+    c = mx.io.NDArrayIter(X, batch_size=4, shuffle=True,
+                          last_batch_handle="roll_over", seed=5)
+    got = _collect_n(c, 3)
+    state = c.state_dict()
+    d = mx.io.NDArrayIter(X, batch_size=4, shuffle=True,
+                          last_batch_handle="roll_over", seed=5)
+    d.load_state_dict(state)
+    got += _collect_n(d, 3)
+    for da, db in zip(ref, got):
+        np.testing.assert_array_equal(da, db)
+
+
+def test_resizeiter_state_dict_resume():
+    X = np.arange(48, dtype=np.float32).reshape(24, 2)
+    a = mx.io.ResizeIter(
+        mx.io.NDArrayIter(X, batch_size=8, shuffle=True, seed=7), size=5)
+    _collect_n(a, 2)
+    state = a.state_dict()
+    rest_a = _collect_n(a, 3)
+
+    b = mx.io.ResizeIter(
+        mx.io.NDArrayIter(X, batch_size=8, shuffle=True, seed=7), size=5)
+    b.load_state_dict(state)
+    rest_b = _collect_n(b, 3)
+    for da, db in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(da, db)
+
+
+def test_bucketpaditer_state_dict_delegates():
+    X = np.arange(40, dtype=np.float32).reshape(20, 2)
+    a = mx.io.BucketPadIter(
+        mx.io.NDArrayIter(X, batch_size=8, shuffle=True, seed=9,
+                          last_batch_handle="discard"))
+    _collect_n(a, 1)
+    state = a.state_dict()
+    rest_a = _collect_n(a, 2)
+
+    b = mx.io.BucketPadIter(
+        mx.io.NDArrayIter(X, batch_size=8, shuffle=True, seed=9,
+                          last_batch_handle="discard"))
+    b.load_state_dict(state)
+    rest_b = _collect_n(b, 2)
+    for da, db in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(da, db)
+
+
+def test_dataiter_base_resume_unsupported():
+    class Custom(mx.io.DataIter):
+        pass
+
+    with pytest.raises(NotImplementedError, match="mid-epoch resume"):
+        Custom().state_dict()
+
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+@pytest.mark.parametrize("cut", [4, 6, 7])
+def test_dataloader_mid_epoch_resume(num_workers, cut):
+    """DataLoader.state_dict/load_state_dict: a loader rebuilt at batch
+    ``cut`` (mid-epoch or across the boundary; 5 batches/epoch) serves
+    the exact same remaining stream, for inline and thread-pool paths."""
+    import json
+
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.arange(60, dtype=np.float32).reshape(30, 2))
+    total = 12
+
+    ref_loader = DataLoader(ds, batch_size=6, shuffle=True, seed=13,
+                            num_workers=num_workers)
+
+    def take(loader, n, out):
+        while len(out) < n:
+            for batch in loader:
+                out.append(batch.asnumpy().copy())
+                if len(out) == n:
+                    return
+
+    ref = []
+    take(ref_loader, total, ref)
+
+    part_loader = DataLoader(ds, batch_size=6, shuffle=True, seed=13,
+                             num_workers=num_workers)
+    part = []
+    state = None
+
+    def take_until_cut():
+        nonlocal state
+        while True:
+            for batch in part_loader:
+                part.append(batch.asnumpy().copy())
+                if len(part) == cut:
+                    state = json.loads(json.dumps(
+                        part_loader.state_dict()))
+                    return
+
+    take_until_cut()
+
+    resumed = DataLoader(ds, batch_size=6, shuffle=True, seed=13,
+                         num_workers=num_workers)
+    resumed.load_state_dict(state)
+    rest = []
+    take(resumed, total - cut, rest)
+    for da, db in zip(ref, part + rest):
+        np.testing.assert_array_equal(da, db)
+
+
+def test_dataloader_unseeded_shuffle_refuses_state_dict():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.zeros((12, 2), np.float32))
+    loader = DataLoader(ds, batch_size=4, shuffle=True)  # no seed
+    with pytest.raises(ValueError, match="pass seed="):
+        loader.state_dict()
+
+
+def test_dataloader_caller_batch_sampler_refuses_state_dict():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon.data.sampler import (BatchSampler,
+                                              SequentialSampler)
+
+    ds = ArrayDataset(np.zeros((12, 2), np.float32))
+    bs = BatchSampler(SequentialSampler(12), 4)
+    loader = DataLoader(ds, batch_sampler=bs)
+    with pytest.raises(ValueError, match="no recoverable position"):
+        loader.state_dict()
+
+
+def test_dataloader_sequential_resume_without_seed():
+    """Deterministic (sequential) order resumes with no RNG at all."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.arange(24, dtype=np.float32).reshape(12, 2))
+    a = DataLoader(ds, batch_size=4)
+    it = iter(a)
+    first = next(it).asnumpy()
+    state = a.state_dict()
+    rest_a = [b.asnumpy() for b in it]
+
+    b = DataLoader(ds, batch_size=4)
+    b.load_state_dict(state)
+    rest_b = [x.asnumpy() for x in b]
+    assert len(rest_a) == len(rest_b) == 2
+    for da, db in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(da, db)
