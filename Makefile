@@ -7,6 +7,7 @@
 #   make cpp        -> C++ frontend example binary
 #   make test       -> full pytest suite (CPU oracle, 8-device mesh)
 #   make test-fast  -> quick shard (operators + ndarray + autograd)
+#   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
 
@@ -27,10 +28,13 @@ test-fast:
 	$(PYTHON) -m pytest tests/test_operator.py tests/test_ndarray.py \
 	    tests/test_autograd.py -q
 
+lint:
+	$(PYTHON) tools/mxlint mxnet_tpu/ example/ tools/
+
 ci:
 	bash ci/runtime_functions.sh all
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast ci clean
+.PHONY: all native cpp test test-fast lint ci clean

@@ -22,6 +22,15 @@ sanity_check() {
 from mxnet_tpu.ops import registry
 assert len(registry.OPS) > 250, len(registry.OPS)
 print('ops:', len(registry.OPS))"
+    lint_check
+}
+
+lint_check() {
+    # mxlint trace-safety & concurrency analyzer over the whole tree
+    # (docs/STATIC_ANALYSIS.md); exits nonzero on any error-severity
+    # finding that isn't explicitly suppressed in source
+    python -m mxnet_tpu.lint mxnet_tpu/ example/ tools/
+    python -m pytest tests/test_lint.py -q
 }
 
 unittest_core() {

@@ -87,6 +87,14 @@ class _Config:
              "shape (reference bucketing module / BucketingModule "
              "analogue). '' disables; 'pow2' rounds up to powers of "
              "two; else a comma list like '8,16,32,64'."),
+        Knob("MXNET_TRACE_GUARD", str, "",
+             "Runtime trace-safety guard (complements the mxlint static "
+             "analyzer): when a device->host sync (NDArray.asnumpy and "
+             "everything routed through it: .item(), float(), int()) "
+             "executes inside a traced region, 'warn' emits a "
+             "RuntimeWarning naming the offending user frame, 'raise' "
+             "turns it into dispatch.TraceGuardError. Each hit bumps the "
+             "profiler's trace_guard dispatch counter. '' disables."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

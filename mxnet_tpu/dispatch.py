@@ -22,14 +22,117 @@ decision:
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
 
 __all__ = ["donation_active", "donation_scope", "no_donation",
-           "bucket_size", "bucket_spec", "pad_batch", "TrackedJit"]
+           "bucket_size", "bucket_spec", "pad_batch", "TrackedJit",
+           "TraceGuardError", "trace_scope", "in_framework_trace",
+           "trace_guard_mode", "guard_host_sync"]
 
 _tls = threading.local()
+
+
+# -- runtime trace guard ----------------------------------------------------
+class TraceGuardError(RuntimeError):
+    """A host sync executed inside a traced region while
+    ``MXNET_TRACE_GUARD=raise`` (see docs/STATIC_ANALYSIS.md)."""
+
+
+class trace_scope:
+    """Marks this thread as inside a framework trace (``TrackedJit`` /
+    ``_CachedOp``) so :func:`guard_host_sync` can attribute violations to
+    the jitted function by name.  Re-entrant."""
+
+    __slots__ = ("_label",)
+
+    def __init__(self, label):
+        self._label = label
+
+    def __enter__(self):
+        stack = getattr(_tls, "trace_stack", None)
+        if stack is None:
+            stack = _tls.trace_stack = []
+        stack.append(self._label)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.trace_stack.pop()
+        return False
+
+
+def in_framework_trace():
+    """Label of the innermost live framework trace on this thread (a
+    ``TrackedJit``-compiled function mid-trace), or None."""
+    stack = getattr(_tls, "trace_stack", None)
+    return stack[-1] if stack else None
+
+
+def trace_guard_mode():
+    """'', 'warn', or 'raise' — the MXNET_TRACE_GUARD knob, validated."""
+    from .config import config
+
+    mode = (config.trace_guard or "").strip().lower()
+    if mode in ("", "0", "off", "false"):
+        return ""
+    if mode not in ("warn", "raise"):
+        raise ValueError(
+            "MXNET_TRACE_GUARD must be '', 'warn' or 'raise'; got %r"
+            % mode)
+    return mode
+
+
+def _offending_frame():
+    """(filename, lineno, func, line) of the nearest stack frame outside
+    the framework itself — the user code that triggered the sync."""
+    import traceback
+
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    for fr in reversed(traceback.extract_stack()):
+        fn = os.path.abspath(fr.filename)
+        if not fn.startswith(pkg_root):
+            return fr
+    return None
+
+
+def guard_host_sync(kind):
+    """Called from every device->host sync choke point (``NDArray.
+    asnumpy``).  Inside a traced region — a framework :class:`trace_scope`
+    or any live jax trace — a sync is a trace-safety violation: it runs
+    once at trace time (baking a constant / stale value into the compiled
+    program) or raises a ConcretizationError later.  Under
+    ``MXNET_TRACE_GUARD=warn`` this warns; ``raise`` makes it a
+    :class:`TraceGuardError`.  Off by default (zero overhead beyond one
+    env read)."""
+    mode = trace_guard_mode()
+    if not mode:
+        return
+    label = in_framework_trace()
+    if label is None:
+        from . import base as _base
+
+        if not _base.in_user_trace():
+            return
+        label = "<jax trace>"
+    from . import profiler as _prof
+
+    _prof.dispatch_count("trace_guard")
+    fr = _offending_frame()
+    where = ("%s:%d in %s(): %s" % (fr.filename, fr.lineno, fr.name,
+                                    (fr.line or "").strip())
+             if fr is not None else "<unknown frame>")
+    msg = ("trace guard: %s during trace of %s — a device->host sync "
+           "inside a traced region executes at trace time only (baked "
+           "constant / stale value in the compiled program). Offending "
+           "frame: %s. Move the sync outside the traced code, or "
+           "silence with MXNET_TRACE_GUARD=0." % (kind, label, where))
+    if mode == "raise":
+        raise TraceGuardError(msg)
+    import warnings
+
+    warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 def donation_active():
@@ -161,11 +264,14 @@ class TrackedJit:
         donate = tuple(donate_argnums)
         self._donate = donate
 
+        name = label or getattr(fn, "__name__", "tracked_fn")
+
         def traced(*a, **k):
             _prof.dispatch_count("recompile")
-            return fn(*a, **k)
+            with trace_scope(name):
+                return fn(*a, **k)
 
-        traced.__name__ = label or getattr(fn, "__name__", "tracked_fn")
+        traced.__name__ = name
         import jax
 
         kw = {}

@@ -401,9 +401,16 @@ class _CachedOp:
             p_nds = [NDArray(a) for a in pdatas]
             a_nds = [NDArray(a) for a in adatas]
             args, rest = _regroup_arrays(in_nds, flat_fmt)
-            assert not rest
+            # `rest` is a python list; emptiness is static at trace time
+            assert not rest  # mxlint: disable=TS004
             scope = autograd.pause(train_mode=_train)
-            _trace_state.active = getattr(_trace_state, "active", 0) + 1
+            # the _trace_state depth counter and the cached._out_fmt /
+            # _n_out captures below are *deliberately* trace-time-only:
+            # the counter tells re-entrant framework code it is running
+            # under a trace, and the output format is a static fact of
+            # the traced program that only exists while tracing
+            _trace_state.active = (  # mxlint: disable=TS002
+                getattr(_trace_state, "active", 0) + 1)
             try:
                 with scope, _random.key_source(rng):
                     with _ParamSubstitution(cached._param_list, p_nds,
@@ -411,10 +418,10 @@ class _CachedOp:
                         out = block.forward(*args) if isinstance(args, list) \
                             else block.forward(args)
             finally:
-                _trace_state.active -= 1
+                _trace_state.active -= 1  # mxlint: disable=TS002
             flat_out, out_fmt = _flatten_arrays(out)
-            cached._out_fmt = out_fmt
-            cached._n_out = len(flat_out)
+            cached._out_fmt = out_fmt  # mxlint: disable=TS002
+            cached._n_out = len(flat_out)  # mxlint: disable=TS002
             # aux state rides along as extra outputs (mutate writes it back)
             return tuple(o.data for o in flat_out) + \
                 tuple(a.data for a in a_nds)

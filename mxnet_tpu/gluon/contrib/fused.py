@@ -244,7 +244,10 @@ class FusedTrainStep:
             def fwd(pdatas_in, adatas_in):
                 p_nds = [NDArray(a) for a in pdatas_in]
                 a_nds = [NDArray(a) for a in adatas_in]
-                _trace_state.active = getattr(_trace_state, "active", 0) + 1
+                # trace-depth counter is deliberately trace-time-only:
+                # it tells re-entrant framework code a trace is active
+                _trace_state.active = (  # mxlint: disable=TS002
+                    getattr(_trace_state, "active", 0) + 1)
                 try:
                     with autograd.pause(train_mode=True), \
                             _random.key_source(rng), \
@@ -252,7 +255,7 @@ class FusedTrainStep:
                         out = net(NDArray(x))
                         loss = loss_fn(out, NDArray(y))
                 finally:
-                    _trace_state.active -= 1
+                    _trace_state.active -= 1  # mxlint: disable=TS002
                 ld = loss.data
                 if ld.ndim:
                     mask = (jnp.arange(ld.shape[0]) < n_valid).astype(
@@ -281,8 +284,11 @@ class FusedTrainStep:
                     state = step_self._regroup_state(state_fmt[j], s_nds)
                     optimizer.update_multi_precision(
                         i, w_nds[j], g_nds[j], state)
-            optimizer._index_update_count = saved_counts[0]
-            optimizer.num_update = saved_counts[1]
+            # deliberate trace-time write: this UNDOES the counter bumps
+            # the optimizer made while being traced just above (the real
+            # per-step bumps happen host-side in _host_scalars)
+            optimizer._index_update_count = saved_counts[0]  # mxlint: disable=TS002
+            optimizer.num_update = saved_counts[1]  # mxlint: disable=TS002
             return (lossvec,
                     tuple(w.data for w in w_nds),
                     tuple(a for a in new_aux),

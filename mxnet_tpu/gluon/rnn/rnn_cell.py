@@ -484,7 +484,12 @@ class ZoneoutCell(ModifierCell):
         new_states = [F.where(mask(p_states, new_s), new_s, old_s)
                       for new_s, old_s in zip(next_states, states)] \
             if p_states != 0. else next_states
-        self._prev_output = output
+        # cross-call residual state, exactly as the reference ZoneoutCell
+        # keeps it: correct in imperative mode; under a hybridized trace
+        # the write happens at trace time only, so the residual chain
+        # restarts from zeros_like per compiled call (the reference has
+        # the same caveat — ZoneoutCell is documented non-hybridizable)
+        self._prev_output = output  # mxlint: disable=TS002
         return output, new_states
 
 

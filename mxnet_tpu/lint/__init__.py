@@ -1,0 +1,68 @@
+"""mxlint — trace-safety & concurrency static analyzer.
+
+The two highest-risk bug classes in a TPU-native JAX/XLA framework are
+invisible until production: host transfers and Python side effects
+captured inside traced/jitted regions (silent recompiles, wrong numerics,
+100x slowdowns), and races in the async host-side layers.  JAX's tracing
+model makes these hazards *statically* detectable from the AST — a traced
+function runs exactly once per shape signature, so anything impure inside
+it is either baked in as a constant, silently dropped, or a
+ConcretizationError waiting for a new shape.
+
+Usage::
+
+    python -m mxnet_tpu.lint mxnet_tpu/ example/ tools/
+    python -m mxnet_tpu.lint --list-rules
+    python -m mxnet_tpu.lint path.py --format json
+
+Rules (docs/STATIC_ANALYSIS.md has the full catalog + fix patterns):
+
+=====  ========  =====================================================
+TS001  error     host sync (.asnumpy()/.item()/float()/np.asarray)
+                 inside traced code
+TS002  error     trace-time side effect (attribute mutation, print,
+                 time.time(), container append) in a traced body
+TS003  error     untracked randomness (np.random / stdlib random)
+                 inside traced code — use mxnet_tpu.random
+TS004  warning   Python control flow branching on a tracer-valued
+                 expression (recompile / ConcretizationError trap)
+TS005  error     use-after-donate: a buffer read after being passed
+                 through a donating jit call in the same scope
+CC001  error     lock held across a blocking call (recv/join/sleep/
+                 sendall/connect)
+CC002  error     non-daemon thread with no join path
+=====  ========  =====================================================
+
+Suppress a finding with a trailing (or immediately preceding standalone)
+comment ``# mxlint: disable=TS002`` (comma list, or ``disable=all``);
+``# mxlint: skip-file`` skips a whole file.  Suppressions should carry a
+rationale — they are audit points, not escape hatches.
+
+The static analyzer is complemented by a *runtime* trace guard
+(``MXNET_TRACE_GUARD=warn|raise``, see ``mxnet_tpu.dispatch``) that
+catches the host syncs static analysis cannot prove, e.g. through
+aliases, getattr indirection, or dynamically-built callables.
+
+This package is stdlib-only (ast + tokenize): linting never imports jax
+or initializes a backend.
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    RULES,
+    Finding,
+    LintError,
+    Rule,
+    Severity,
+    format_json,
+    format_text,
+    lint_file,
+    lint_paths,
+    lint_source,
+    register_rule,
+)
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+__all__ = ["RULES", "Finding", "LintError", "Rule", "Severity",
+           "format_json", "format_text", "lint_file", "lint_paths",
+           "lint_source", "register_rule"]

@@ -1,0 +1,83 @@
+"""CLI driver: ``python -m mxnet_tpu.lint [paths...]`` (alias
+``tools/mxlint``).
+
+Exit codes: 0 clean, 1 findings at failing severity (errors, plus
+warnings under ``--strict``), 2 usage / internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import (RULES, LintError, Severity, format_json, format_text,
+                   lint_paths)
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="mxlint",
+        description="mxnet_tpu trace-safety & concurrency static "
+                    "analyzer (stdlib-only; never imports jax).")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (default: text)")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma list of rule ids to run (default: all)")
+    p.add_argument("--disable", default=None, metavar="RULES",
+                   help="comma list of rule ids to skip")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the run (exit 1)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _split_rules(spec):
+    if not spec:
+        return None
+    return {r.strip().upper() for r in spec.split(",") if r.strip()}
+
+
+def _list_rules(out):
+    width = max(len(r.summary) for r in RULES.values())
+    for rule_id in sorted(RULES):
+        r = RULES[rule_id]
+        out.write("%s  %-7s  %-*s\n"
+                  % (r.id, r.severity, width, r.summary))
+
+
+def main(argv=None):
+    parser = _build_parser()
+    ns = parser.parse_args(argv)
+    if ns.list_rules:
+        _list_rules(sys.stdout)
+        return 0
+    if not ns.paths:
+        parser.error("no paths given (or use --list-rules)")
+    select = _split_rules(ns.select)
+    disable = _split_rules(ns.disable)
+    for spec in (select or ()), (disable or ()):
+        unknown = set(spec) - set(RULES)
+        if unknown:
+            sys.stderr.write("mxlint: unknown rule id(s): %s\n"
+                             % ", ".join(sorted(unknown)))
+            return 2
+    try:
+        findings, n_files = lint_paths(ns.paths, select=select,
+                                       disable=disable)
+    except LintError as e:
+        sys.stderr.write("mxlint: %s\n" % e)
+        return 2
+    if ns.format == "json":
+        sys.stdout.write(format_json(findings, n_files) + "\n")
+    else:
+        sys.stdout.write(format_text(findings, n_files) + "\n")
+    failing = {Severity.ERROR}
+    if ns.strict:
+        failing.add(Severity.WARNING)
+    return 1 if any(f.severity in failing for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""mxlint core: rule registry, findings, suppressions, reporters.
+
+Deliberately stdlib-only.  The analyzer parses files with ``ast`` and
+routes the tree through every registered rule; rules are small functions
+``check(ctx) -> iterable[Finding]`` registered via :func:`register_rule`
+so projects (and tests) can extend the rule set without touching the
+driver.  Suppression directives are read from the raw source lines
+(``# mxlint: disable=RULE``), pylint-style: a trailing comment silences
+its own line, a standalone directive line silences the next line.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+__all__ = ["Severity", "Finding", "Rule", "RULES", "LintError",
+           "register_rule", "lint_source", "lint_file", "lint_paths",
+           "format_text", "format_json"]
+
+
+class Severity:
+    """Finding severities.  ``error`` fails the run (exit 1); ``warning``
+    is reported but only fails under ``--strict``."""
+
+    ERROR = "error"
+    WARNING = "warning"
+
+    ORDER = (ERROR, WARNING)
+
+
+class LintError(RuntimeError):
+    """Internal analyzer failure (not a finding)."""
+
+
+class Finding:
+    """One diagnostic: (rule, severity, path, line, col, message)."""
+
+    __slots__ = ("rule", "severity", "path", "line", "col", "message")
+
+    def __init__(self, rule, severity, path, line, col, message):
+        self.rule = rule
+        self.severity = severity
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+
+    def as_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message}
+
+    def format(self):
+        return "%s:%d:%d: %s [%s] %s" % (self.path, self.line, self.col,
+                                         self.rule, self.severity,
+                                         self.message)
+
+    def __repr__(self):
+        return "Finding(%s)" % self.format()
+
+
+class Rule:
+    """A registered rule: id, default severity, one-line summary, and the
+    checker ``fn(ModuleContext) -> iterable[(node_or_line, col, msg)]``
+    (checkers yield positions; the driver stamps rule/severity/path)."""
+
+    __slots__ = ("id", "severity", "summary", "doc", "checker")
+
+    def __init__(self, id, severity, summary, checker, doc=None):
+        self.id = id
+        self.severity = severity
+        self.summary = summary
+        self.checker = checker
+        self.doc = doc or (checker.__doc__ or "").strip()
+
+
+#: rule id -> Rule.  Populated by :func:`register_rule` (rules.py imports
+#: at package import register the builtin set).
+RULES: dict = {}
+
+
+def register_rule(rule_id, severity, summary):
+    """Decorator: register ``fn(ctx)`` as rule ``rule_id``.
+
+    The checker receives a :class:`mxnet_tpu.lint.rules.ModuleContext`
+    and yields ``(lineno, col, message)`` triples (or ast nodes in place
+    of ``lineno``, from which position is taken)."""
+    assert re.fullmatch(r"[A-Z]{2}\d{3}", rule_id), rule_id
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise LintError("duplicate rule id %s" % rule_id)
+        RULES[rule_id] = Rule(rule_id, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+# -- suppressions -----------------------------------------------------------
+_DIRECTIVE = re.compile(
+    r"#\s*mxlint:\s*(?P<verb>disable|skip-file)\s*"
+    r"(?:=\s*(?P<rules>[A-Za-z0-9_,\s]+?))?\s*(?:—|--|\.|$)")
+
+
+def _parse_suppressions(lines):
+    """(skip_file, {lineno: set(rule_ids) | {'all'}}) from raw source
+    lines.  A directive with code before the ``#`` applies to its own
+    line; a standalone comment line applies to the following line too."""
+    skip_file = False
+    per_line = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _DIRECTIVE.search(raw)
+        if not m:
+            continue
+        if m.group("verb") == "skip-file":
+            skip_file = True
+            continue
+        rules = {r.strip().upper() for r in
+                 (m.group("rules") or "all").split(",") if r.strip()}
+        if not rules:
+            rules = {"ALL"}
+        rules = {"all" if r == "ALL" else r for r in rules}
+        targets = [i]
+        if not raw.split("#", 1)[0].strip():
+            targets.append(i + 1)  # standalone directive: next line too
+        for t in targets:
+            per_line.setdefault(t, set()).update(rules)
+    return skip_file, per_line
+
+
+def _suppressed(finding, per_line):
+    got = per_line.get(finding.line)
+    return bool(got) and ("all" in got or finding.rule in got)
+
+
+# -- driver -----------------------------------------------------------------
+def lint_source(source, path="<string>", select=None, disable=None):
+    """Lint one source string; returns a list of :class:`Finding`.
+
+    ``select``/``disable``: iterables of rule ids restricting which rules
+    run.  Suppression comments are honored.  A syntax error yields a
+    single synthetic ``PARSE``-rule error finding rather than raising, so
+    one broken file cannot take down a whole-tree run."""
+    from .rules import ModuleContext
+
+    lines = source.splitlines()
+    skip_file, per_line = _parse_suppressions(lines)
+    if skip_file:
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("PARSE", Severity.ERROR, path, e.lineno or 1,
+                        (e.offset or 1) - 1, "syntax error: %s" % e.msg)]
+    ctx = ModuleContext(tree, path, lines)
+    findings = []
+    for rule in RULES.values():
+        if select and rule.id not in select:
+            continue
+        if disable and rule.id in disable:
+            continue
+        for hit in rule.checker(ctx):
+            node_or_line, col, message = hit
+            if isinstance(node_or_line, ast.AST):
+                line = node_or_line.lineno
+                col = node_or_line.col_offset if col is None else col
+            else:
+                line = node_or_line
+            f = Finding(rule.id, rule.severity, path, line, col or 0,
+                        message)
+            if not _suppressed(f, per_line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path, select=None, disable=None):
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path=path, select=select,
+                           disable=disable)
+
+
+def iter_python_files(paths):
+    """Expand files/directories into .py files (sorted, deduped;
+    __pycache__ and hidden directories skipped)."""
+    seen = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p not in seen:
+                seen.append(p)
+            continue
+        if not os.path.isdir(p):
+            raise LintError("no such file or directory: %s" % p)
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    full = os.path.join(root, fn)
+                    if full not in seen:
+                        seen.append(full)
+    return seen
+
+
+def lint_paths(paths, select=None, disable=None):
+    """Lint files/trees; returns (findings, n_files)."""
+    findings = []
+    files = iter_python_files(paths)
+    for path in files:
+        findings.extend(lint_file(path, select=select, disable=disable))
+    return findings, len(files)
+
+
+# -- reporters --------------------------------------------------------------
+def format_text(findings, n_files=None):
+    out = [f.format() for f in findings]
+    counts = _counts(findings, n_files)
+    tail = "%d error(s), %d warning(s)" % (counts["error"],
+                                           counts["warning"])
+    if n_files is not None:
+        tail += " in %d file(s)" % n_files
+    out.append(tail)
+    return "\n".join(out)
+
+
+def _counts(findings, n_files=None):
+    counts = {"error": 0, "warning": 0}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    if n_files is not None:
+        counts["files"] = n_files
+    return counts
+
+
+def format_json(findings, n_files=None):
+    """Stable machine-readable report (schema asserted by
+    tests/test_lint.py; bump ``version`` on breaking changes)."""
+    payload = {
+        "version": 1,
+        "tool": "mxlint",
+        "counts": _counts(findings, n_files),
+        "findings": [f.as_dict() for f in findings],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,828 @@
+"""mxlint builtin rules: trace-safety (TS001–TS005) and concurrency
+(CC001–CC002).
+
+Traced-region model
+-------------------
+A function body is *traced* when JAX runs it once to build a graph and
+then replays the compiled artifact without re-running the Python:
+
+* ``hybrid_forward`` methods (captured by gluon's ``_CachedOp`` as one
+  pure jax function);
+* functions decorated with (or passed to) a tracing entry point:
+  ``jax.jit`` / ``dispatch.TrackedJit`` / ``eval_shape`` / ``grad`` /
+  ``value_and_grad`` / ``vmap`` / ``pmap`` / ``shard_map`` / ``remat`` /
+  ``lax.scan`` / ``while_loop`` / ``fori_loop`` / ``cond`` bodies;
+* functions registered as framework ops (``ops.registry.register`` /
+  ``OpDef``) — the registry jits every op impl;
+* any ``def`` nested inside a traced function.
+
+Matching is by terminal attribute name (``jax.jit`` and ``jit`` both
+match), which trades a sliver of precision for zero-import analysis.
+
+Taint model (TS001/TS004)
+-------------------------
+Inside a traced function, positional parameters without defaults (minus
+``self``/``cls``/``F``) are assumed tracer-valued; taint propagates
+through assignments.  Static accessors (``.shape``/``.ndim``/``.dtype``/
+``.size``, ``len()``, ``isinstance()``, ``is None``) *kill* taint — those
+are known at trace time and safe to branch on.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Severity, register_rule
+
+__all__ = ["ModuleContext"]
+
+# tracing entry points, matched on the terminal name of the callee
+TRACE_SINKS = frozenset({
+    "jit", "TrackedJit", "eval_shape", "grad", "value_and_grad", "vmap",
+    "pmap", "shard_map", "remat", "scan", "while_loop", "fori_loop",
+    "cond", "switch", "custom_vjp", "custom_jvp",
+})
+# op-registry sinks: the registry jits every registered impl
+REGISTRY_SINKS = frozenset({"OpDef", "register"})
+TRACED_DEF_NAMES = frozenset({"hybrid_forward"})
+
+# attribute reads that are static at trace time (kill taint)
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "stype",
+                          "context", "ctx", "aval", "weak_type"})
+# calls whose result is not a tracer even on tracer input (or that only
+# inspect static structure)
+UNTAINT_CALLS = frozenset({"len", "isinstance", "issubclass", "type",
+                           "hasattr", "getattr", "callable", "id",
+                           "repr", "str", "format"})
+
+# host-sync method names (NDArray / jax.Array surface)
+HOST_SYNC_METHODS = frozenset({"asnumpy", "asscalar", "item", "tolist",
+                               "wait_to_read", "block_until_ready"})
+# numpy entry points that force a concrete host value from their argument
+NUMPY_SYNC_FUNCS = frozenset({"asarray", "array", "copy", "save",
+                              "savez", "allclose", "array_equal"})
+
+# container mutators whose effect escapes the trace when the receiver is
+# not function-local
+MUTATOR_METHODS = frozenset({"append", "extend", "insert", "add",
+                             "update", "pop", "remove", "clear", "write",
+                             "setdefault", "discard", "popitem",
+                             "appendleft"})
+
+# blocking primitives for CC001 (terminal attribute names)
+BLOCKING_ATTRS = frozenset({"recv", "recvfrom", "recv_into", "accept",
+                            "sendall", "connect", "create_connection",
+                            "select", "poll"})
+TIME_BLOCKING = frozenset({"sleep"})
+
+
+def _terminal_name(node):
+    """Rightmost identifier of a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node):
+    """Leftmost Name of an Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted(node):
+    """Dotted path of a pure Name/Attribute chain ('np.random.rand'),
+    else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _walk_skip_nested(node):
+    """Walk a function body without descending into nested function /
+    class definitions (those get their own analysis pass)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleContext:
+    """Per-file analysis context shared by every rule: the AST, source
+    lines, import aliases, the set of traced function defs, and the
+    module-level function table used for one-level call resolution."""
+
+    def __init__(self, tree, path, lines):
+        self.tree = tree
+        self.path = path
+        self.lines = lines
+        self.numpy_aliases = set()      # names bound to the numpy module
+        self.np_random_aliases = set()  # names bound to numpy.random
+        self.random_aliases = set()     # names bound to stdlib random
+        self.time_aliases = set()       # names bound to time
+        self.threading_aliases = set()
+        self.from_random_names = set()  # from random import <name>
+        self.from_time_names = set()    # from time import sleep
+        self.thread_ctor_names = set()  # from threading import Thread
+        self._collect_imports()
+        self.functions = [n for n in ast.walk(tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+        self.func_by_name = {}
+        for fn in self.functions:
+            self.func_by_name.setdefault(fn.name, []).append(fn)
+        self._parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.traced = self._find_traced()
+        self._locals_cache = {}
+
+    # -- imports ----------------------------------------------------------
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    mod = alias.name
+                    if mod in ("numpy", "jax.numpy"):
+                        self.numpy_aliases.add(name)
+                    elif mod in ("numpy.random",):
+                        self.np_random_aliases.add(alias.asname or "numpy")
+                    elif mod == "random":
+                        self.random_aliases.add(name)
+                    elif mod == "time":
+                        self.time_aliases.add(name)
+                    elif mod == "threading":
+                        self.threading_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if mod == "numpy" and alias.name == "random":
+                        self.np_random_aliases.add(name)
+                    elif mod == "random":
+                        self.from_random_names.add(name)
+                    elif mod == "time" and alias.name in TIME_BLOCKING:
+                        self.from_time_names.add(name)
+                    elif mod == "threading" and alias.name == "Thread":
+                        self.thread_ctor_names.add(name)
+
+    # -- traced-function discovery ---------------------------------------
+    def _decorator_traced(self, fn):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _terminal_name(target)
+            if name in TRACE_SINKS or name in REGISTRY_SINKS:
+                return True
+            # functools.partial(jax.jit, ...) style decorators
+            if name == "partial" and isinstance(dec, ast.Call) and dec.args:
+                if _terminal_name(dec.args[0]) in TRACE_SINKS:
+                    return True
+        return False
+
+    def _enclosing_fn(self, node):
+        p = self._parents.get(node)
+        while p is not None and not isinstance(
+                p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            p = self._parents.get(p)
+        return p
+
+    def _is_within(self, node, ancestor):
+        p = self._parents.get(node)
+        while p is not None:
+            if p is ancestor:
+                return True
+            p = self._parents.get(p)
+        return False
+
+    def _find_traced(self):
+        traced = set()
+        for fn in self.functions:
+            if fn.name in TRACED_DEF_NAMES or self._decorator_traced(fn):
+                traced.add(fn)
+
+        def mark_name_args(call, scope):
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    cands = self.func_by_name.get(arg.id, ())
+                    # scope-aware resolution: `jit(call)` inside a
+                    # factory refers to the nested `call`, not an
+                    # unrelated same-named method elsewhere in the module
+                    if scope is not None:
+                        nested = [fd for fd in cands
+                                  if self._is_within(fd, scope)]
+                        cands = nested or cands
+                    traced.update(cands)
+                elif isinstance(arg, ast.Call):
+                    # one nesting level: jit(shard_map(step, ...))
+                    mark_name_args(arg, scope)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name in TRACE_SINKS or name in REGISTRY_SINKS:
+                    mark_name_args(node, self._enclosing_fn(node))
+        # closure: defs nested inside a traced def are traced too
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in traced:
+                    continue
+                p = self._parents.get(fn)
+                while p is not None:
+                    if isinstance(p, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and p in traced:
+                        traced.add(fn)
+                        changed = True
+                        break
+                    p = self._parents.get(p)
+        return traced
+
+    # -- per-function facts ----------------------------------------------
+    def params_of(self, fn):
+        a = fn.args
+        names = [x.arg for x in
+                 getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def assigned_in(self, fn):
+        """Names bound inside ``fn``'s own body (nested defs excluded):
+        every Name in Store context, plus nested def/class/import names."""
+        got = self._locals_cache.get(fn)
+        if got is not None:
+            return got
+        names = set()
+        for n in _walk_skip_nested(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                names.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.add(n.name)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for alias in n.names:
+                    names.add(alias.asname or alias.name.split(".")[0])
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                names.add(n.name)
+        self._locals_cache[fn] = names
+        return names
+
+    def _static_params(self, fn):
+        """Param names declared static in a tracing decorator —
+        ``static_argnums``/``nondiff_argnums``/``static_argnames`` on
+        ``@jit(...)`` / ``@partial(jax.custom_vjp, ...)`` — those stay
+        concrete Python values inside the trace."""
+        pos = getattr(fn.args, "posonlyargs", []) + fn.args.args
+        names = set()
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnums", "nondiff_argnums"):
+                    elts = kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                    for el in elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, int) and el.value < len(pos):
+                            names.add(pos[el.value].arg)
+                elif kw.arg == "static_argnames":
+                    elts = kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                    for el in elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            names.add(el.value)
+        return names
+
+    def tainted_names(self, fn):
+        """Tracer-tainted names in a traced fn: positional params without
+        defaults (minus self/cls/F and decorator-declared static params),
+        propagated through assignments in source order (one forward
+        pass)."""
+        a = fn.args
+        pos = getattr(a, "posonlyargs", []) + a.args
+        n_default = len(a.defaults)
+        no_default = pos[:len(pos) - n_default] if n_default else pos
+        tainted = {x.arg for x in no_default} - {"self", "cls", "F"}
+        tainted -= self._static_params(fn)
+        if a.vararg:
+            tainted.add(a.vararg.arg)
+        stmts = sorted(
+            (n for n in _walk_skip_nested(fn)
+             if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.For, ast.NamedExpr))),
+            key=lambda n: (n.lineno, n.col_offset))
+        for st in stmts:
+            if isinstance(st, ast.For):
+                if self.expr_tainted(st.iter, tainted):
+                    for t in ast.walk(st.target):
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+                continue
+            value = st.value
+            if value is None:
+                continue
+            is_tainted = self.expr_tainted(value, tainted)
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for tgt in targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name) and isinstance(
+                            t.ctx, ast.Store):
+                        if is_tainted:
+                            tainted.add(t.id)
+                        else:
+                            tainted.discard(t.id)
+        return tainted
+
+    def expr_tainted(self, node, tainted):
+        """Could ``node`` evaluate to a tracer, given tainted names?
+        Static accessors and shape-introspection calls kill taint."""
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value, tainted)
+        if isinstance(node, ast.Call):
+            fname = _terminal_name(node.func)
+            if fname in UNTAINT_CALLS or fname in ("int", "float", "bool"):
+                return False
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if self.expr_tainted(node.func, tainted):
+                return True
+            return any(self.expr_tainted(x, tainted) for x in args)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return any(self.expr_tainted(x, tainted)
+                       for x in [node.left] + node.comparators)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension)):
+                if isinstance(child, ast.comprehension):
+                    if self.expr_tainted(child.iter, tainted):
+                        return True
+                elif self.expr_tainted(child, tainted):
+                    return True
+        return False
+
+    def traced_defs(self):
+        return [fn for fn in self.functions if fn in self.traced]
+
+    # -- module-level blocking-call map (CC001) ---------------------------
+    def is_blocking_call(self, call):
+        """Direct blocking primitive?  (socket recv/accept/sendall/...,
+        time.sleep, Thread/Process.join — str.join is screened out by its
+        single non-numeric argument.)"""
+        name = _terminal_name(call.func)
+        if name in BLOCKING_ATTRS:
+            return True
+        if name == "join":
+            # thread.join() / thread.join(0.05) / join(timeout=...) are
+            # blocking; " ".join(parts) takes one non-numeric positional
+            if any(kw.arg == "timeout" for kw in call.keywords):
+                return True
+            if not call.args and not call.keywords:
+                return True
+            return (len(call.args) == 1
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, (int, float)))
+        if name in TIME_BLOCKING:
+            dotted = _dotted(call.func)
+            if dotted and "." in dotted:
+                return dotted.split(".")[0] in self.time_aliases
+            return name in self.from_time_names
+        if name == "wait":
+            # Event.wait()/Condition.wait(): only flag the zero-arg form
+            # explicitly given a timeout=None default — too ambiguous
+            # otherwise (Condition.wait REQUIRES the lock held)
+            return False
+        return False
+
+    def blocking_functions(self):
+        """Names of module-level (or method) defs whose bodies contain a
+        direct blocking call — one level of interprocedural resolution so
+        ``_send_msg``-style wrappers are still caught under a lock."""
+        out = set()
+        for fn in self.functions:
+            for n in _walk_skip_nested(fn):
+                if isinstance(n, ast.Call) and self.is_blocking_call(n):
+                    out.add(fn.name)
+                    break
+        return out
+
+
+# ===========================================================================
+# Trace-safety rules
+# ===========================================================================
+@register_rule("TS001", Severity.ERROR,
+               "host sync inside traced code")
+def check_host_sync(ctx):
+    """``.asnumpy()``/``.item()``/``float()``/``np.asarray`` inside a
+    traced body either raises at trace time (tracer input) or — worse —
+    silently executes at *trace* time on a closure-captured concrete
+    array, baking a stale constant into every future execution."""
+    for fn in ctx.traced_defs():
+        tainted = ctx.tainted_names(fn)
+        for node in _walk_skip_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and name in HOST_SYNC_METHODS:
+                yield (node, None,
+                       ".%s() is a device->host sync inside traced "
+                       "function %r: it runs once at trace time (baking "
+                       "a constant) or raises on a tracer. Return the "
+                       "value and sync outside the traced region."
+                       % (name, fn.name))
+            elif isinstance(node.func, ast.Name) \
+                    and name in ("float", "int", "bool") and node.args \
+                    and ctx.expr_tainted(node.args[0], tainted):
+                yield (node, None,
+                       "%s() on a traced value inside %r forces "
+                       "concretization (ConcretizationError or a baked "
+                       "constant). Keep it as an array, or branch on "
+                       "static .shape/.dtype." % (name, fn.name))
+            elif isinstance(node.func, ast.Attribute) \
+                    and name in NUMPY_SYNC_FUNCS:
+                dotted = _dotted(node.func)
+                root = dotted.split(".")[0] if dotted else None
+                if root in ctx.numpy_aliases and node.args and \
+                        ctx.expr_tainted(node.args[0], tainted) and \
+                        root not in ("jnp",):
+                    yield (node, None,
+                           "%s(<traced value>) inside %r pulls the "
+                           "array to host numpy at trace time. Use "
+                           "jax.numpy on device, or move the host "
+                           "conversion outside the traced region."
+                           % (dotted, fn.name))
+            elif isinstance(node.func, ast.Attribute) \
+                    and name == "device_get":
+                yield (node, None,
+                       "jax.device_get inside traced function %r is a "
+                       "host transfer at trace time." % fn.name)
+
+
+@register_rule("TS002", Severity.ERROR,
+               "trace-time side effect in a traced body")
+def check_side_effects(ctx):
+    """A traced body runs ONCE per shape signature; attribute mutation,
+    ``print``, clocks, and container appends to enclosing state happen at
+    trace time only — silently absent from the compiled program (and
+    re-run on every recompile)."""
+    for fn in ctx.traced_defs():
+        local = ctx.assigned_in(fn)
+        for node in _walk_skip_nested(fn):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if isinstance(node.func, ast.Name) and name == "print":
+                    yield (node, None,
+                           "print() inside traced function %r executes "
+                           "at trace time only (once per compilation, "
+                           "never per step). Use jax.debug.print, or "
+                           "log outside the traced region." % fn.name)
+                elif isinstance(node.func, ast.Attribute) and \
+                        name in ("time", "perf_counter", "monotonic",
+                                 "process_time"):
+                    dotted = _dotted(node.func)
+                    if dotted and dotted.split(".")[0] in ctx.time_aliases:
+                        yield (node, None,
+                               "%s() inside traced function %r is "
+                               "evaluated once at trace time — the "
+                               "compiled step reuses that stale "
+                               "timestamp forever. Time the call site "
+                               "outside the trace." % (dotted, fn.name))
+                elif isinstance(node.func, ast.Attribute) and \
+                        name in MUTATOR_METHODS:
+                    root = _root_name(node.func.value)
+                    if root is not None and root not in local:
+                        yield (node, None,
+                               "mutating %r (closure/global) via .%s() "
+                               "inside traced function %r is a trace-"
+                               "time side effect: it fires once per "
+                               "compilation, not once per call."
+                               % (root, name, fn.name))
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(tgt)
+                        if root is not None and root not in local:
+                            yield (tgt, None,
+                                   "writing %s on non-local %r inside "
+                                   "traced function %r is a trace-time "
+                                   "side effect (runs once per "
+                                   "compilation; invisible to the "
+                                   "compiled program). Return the value "
+                                   "instead."
+                                   % ("an attribute" if isinstance(
+                                       tgt, ast.Attribute)
+                                      else "an item", root, fn.name))
+            elif isinstance(node, ast.Global):
+                yield (node, None,
+                       "'global' inside traced function %r: rebinding "
+                       "module state at trace time is a side effect the "
+                       "compiled program never sees." % fn.name)
+
+
+@register_rule("TS003", Severity.ERROR,
+               "untracked randomness inside traced code")
+def check_randomness(ctx):
+    """``np.random``/stdlib ``random`` inside a traced body draws ONE
+    sample at trace time and bakes it in — every compiled call reuses the
+    same 'random' numbers.  ``mxnet_tpu.random`` threads a key through
+    the trace so compiled programs stay stochastic AND reproducible."""
+    for fn in ctx.traced_defs():
+        for node in _walk_skip_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            parts = dotted.split(".")
+            if len(parts) >= 2 and parts[0] in ctx.numpy_aliases \
+                    and parts[1] == "random":
+                yield (node, None,
+                       "%s inside traced function %r draws at trace "
+                       "time: the compiled program replays one frozen "
+                       "sample. Use mxnet_tpu.random (key-threaded) "
+                       "instead." % (dotted, fn.name))
+            elif parts[0] in ctx.np_random_aliases and len(parts) >= 2:
+                yield (node, None,
+                       "%s inside traced function %r draws at trace "
+                       "time (frozen sample). Use mxnet_tpu.random."
+                       % (dotted, fn.name))
+            elif parts[0] in ctx.random_aliases and len(parts) == 2:
+                yield (node, None,
+                       "stdlib %s inside traced function %r draws at "
+                       "trace time (frozen sample) and is invisible to "
+                       "mxnet_tpu.random.seed(). Use mxnet_tpu.random."
+                       % (dotted, fn.name))
+            elif len(parts) == 1 and parts[0] in ctx.from_random_names:
+                yield (node, None,
+                       "stdlib random.%s inside traced function %r "
+                       "draws at trace time (frozen sample). Use "
+                       "mxnet_tpu.random." % (parts[0], fn.name))
+
+
+@register_rule("TS004", Severity.WARNING,
+               "Python control flow on a traced value")
+def check_tracer_branch(ctx):
+    """``if``/``while`` on a tracer-valued expression raises
+    ConcretizationError under jit — or, via shape-dependent paths,
+    silently recompiles per value.  Branch on static ``.shape``/
+    ``.dtype``, or use ``F.where`` / ``lax.cond``."""
+    for fn in ctx.traced_defs():
+        tainted = ctx.tainted_names(fn)
+        if not tainted:
+            continue
+        for node in _walk_skip_nested(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            else:
+                continue
+            if ctx.expr_tainted(test, tainted):
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression",
+                        ast.Assert: "assert"}[type(node)]
+                yield (node, None,
+                       "%s on a traced value inside %r: under jit this "
+                       "raises ConcretizationError (or forces a "
+                       "recompile per concrete value). Branch on static "
+                       ".shape/.dtype, or use F.where / lax.cond."
+                       % (kind, fn.name))
+
+
+@register_rule("TS005", Severity.ERROR,
+               "use-after-donate of a buffer")
+def check_use_after_donate(ctx):
+    """An argument passed through a donating jit call (``donate_argnums``
+    / ``TrackedJit(..., donate_argnums=...)``) is consumed by XLA: the
+    pre-call buffer is deleted (in-place HBM reuse).  Reading the same
+    variable afterwards raises 'buffer was deleted' — or worse, observes
+    a stale copy if donation was declined."""
+    for scope in [ctx.tree] + ctx.functions:
+        walk = _walk_skip_nested(scope) if scope is not ctx.tree else (
+            n for n in _walk_skip_nested(scope)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        nodes = sorted(
+            (n for n in walk
+             if isinstance(n, (ast.Assign, ast.Call, ast.Name))),
+            key=lambda n: (n.lineno, n.col_offset))
+        donating = {}     # local name -> donated positions
+        donated = {}      # var name -> (line of donating call)
+        assigns = {}      # var name -> [assignment lines]
+
+        def donate_positions(call):
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    if isinstance(kw.value, (ast.Tuple, ast.List)):
+                        out = []
+                        for el in kw.value.elts:
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, int):
+                                out.append(el.value)
+                        return tuple(out)
+                    if isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, int):
+                        return (kw.value.value,)
+            return None
+
+        def is_jit_ctor(call):
+            return _terminal_name(call.func) in ("jit", "TrackedJit")
+
+        handled_calls = set()
+
+        def process_call(n):
+            positions = None
+            if isinstance(n.func, ast.Name) and n.func.id in donating:
+                positions = donating[n.func.id]
+            elif isinstance(n.func, ast.Call) and is_jit_ctor(n.func):
+                # jax.jit(f, donate_argnums=(0,))(x) inline call
+                positions = donate_positions(n.func)
+            if positions:
+                for pos in positions:
+                    if pos < len(n.args) and isinstance(
+                            n.args[pos], ast.Name):
+                        donated.setdefault(n.args[pos].id, n.lineno)
+
+        for n in nodes:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                positions = donate_positions(n.value) \
+                    if is_jit_ctor(n.value) else None
+                # evaluation order: the value call runs (donating its
+                # args) BEFORE the target is rebound, so `w = fast(w)`
+                # both donates and then refreshes `w`
+                if positions is None:
+                    process_call(n.value)
+                handled_calls.add(id(n.value))
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name):
+                        assigns.setdefault(tgt.id, []).append(n.lineno)
+                        if positions:
+                            donating[tgt.id] = positions
+                        else:
+                            donating.pop(tgt.id, None)
+                        donated.pop(tgt.id, None)
+            elif isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    for t in ast.walk(tgt):
+                        if isinstance(t, ast.Name):
+                            assigns.setdefault(t.id, []).append(n.lineno)
+                            donated.pop(t.id, None)
+            elif isinstance(n, ast.Call):
+                if id(n) not in handled_calls:
+                    process_call(n)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                at = donated.get(n.id)
+                if at is not None and n.lineno > at:
+                    yield (n, None,
+                           "%r was donated to a compiled call at line "
+                           "%d (donate_argnums): its device buffer is "
+                           "deleted after the call. Use the call's "
+                           "RETURN value, .copy() before donating, or "
+                           "dispatch.no_donation()." % (n.id, at))
+                    donated.pop(n.id, None)  # one finding per donation
+
+
+# ===========================================================================
+# Concurrency rules
+# ===========================================================================
+def _lockish(expr):
+    """Is this `with` context expression a lock?  Name/Attribute chains
+    whose terminal identifier contains 'lock' or 'mutex'."""
+    name = _terminal_name(expr)
+    if name is None:
+        return False
+    low = name.lower()
+    return "lock" in low or "mutex" in low
+
+
+@register_rule("CC001", Severity.ERROR,
+               "lock held across a blocking call")
+def check_lock_blocking(ctx):
+    """Holding a lock across a blocking call (socket recv/sendall,
+    thread join, sleep) serializes every other thread on I/O latency —
+    and deadlocks outright if the blocked peer needs the same lock.
+    Move the blocking call outside the critical section (stage the data
+    under the lock, send after release)."""
+    blocking_fns = ctx.blocking_functions()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_lockish(item.context_expr)
+                   or (isinstance(item.context_expr, ast.Call)
+                       and _lockish(item.context_expr.func))
+                   for item in node.items):
+            continue
+        lock_names = [_terminal_name(
+            it.context_expr.func if isinstance(it.context_expr, ast.Call)
+            else it.context_expr) for it in node.items]
+        lock_label = next((n for n in lock_names if n), "lock")
+        for inner in ast.walk(node):
+            if inner is node or not isinstance(inner, ast.Call):
+                continue
+            if ctx.is_blocking_call(inner):
+                yield (inner, None,
+                       "blocking call %r while holding %r: every other "
+                       "thread contending for the lock stalls on this "
+                       "I/O (deadlock if the peer needs the lock). "
+                       "Stage under the lock, block after release."
+                       % (_terminal_name(inner.func) or "call",
+                          lock_label))
+            else:
+                callee = _terminal_name(inner.func)
+                if callee in blocking_fns and callee is not None:
+                    yield (inner, None,
+                           "%r (which performs blocking I/O) called "
+                           "while holding %r: the critical section "
+                           "waits on the network. Stage the payload "
+                           "under the lock and call %r after release."
+                           % (callee, lock_label, callee))
+
+
+@register_rule("CC002", Severity.ERROR,
+               "non-daemon thread without a join path")
+def check_thread_lifecycle(ctx):
+    """A non-daemon thread with no ``join()`` keeps the process alive
+    after main exits (hung CI, zombie workers on preemption).  Either
+    mark it ``daemon=True`` (ok to die with the process) or join it on
+    every exit path."""
+    joined_roots = set()
+    daemon_assigned_roots = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                _terminal_name(node.func) == "join" and \
+                isinstance(node.func, ast.Attribute):
+            root = _terminal_name(node.func.value)
+            if root:
+                joined_roots.add(root)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "daemon":
+                    root = _terminal_name(tgt.value)
+                    if root:
+                        daemon_assigned_roots.add(root)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        dotted = _dotted(node.func)
+        is_thread = False
+        if name == "Thread":
+            if dotted and "." in dotted:
+                is_thread = dotted.split(".")[0] in ctx.threading_aliases
+            else:
+                is_thread = name in ctx.thread_ctor_names
+        if not is_thread:
+            continue
+        daemon_true = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True for kw in node.keywords)
+        if daemon_true:
+            continue
+        # find the variable (or attribute) the thread is bound to
+        parent = ctx._parents.get(node)
+        target = None
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                target = _terminal_name(tgt)
+        elif isinstance(parent, ast.Attribute):
+            # Thread(...).start() — anonymous, can never be joined
+            target = None
+        if target and (target in joined_roots
+                       or target in daemon_assigned_roots):
+            continue
+        yield (node, None,
+               "non-daemon Thread%s has no join path in this module: "
+               "the process cannot exit while it runs (hung shutdown / "
+               "zombie worker on preemption). Pass daemon=True or join "
+               "it on every exit path."
+               % (" bound to %r" % target if target else ""))

@@ -62,6 +62,32 @@ def _as_numpy(x):
     return x.asnumpy() if isinstance(x, nd.NDArray) else numpy.asarray(x)
 
 
+def _as_numpy_batch(arrays):
+    """Convert a sequence to host numpy with at most ONE device->host
+    sync: every NDArray member is fetched in a single ``jax.device_get``
+    (one transfer, one ``host_sync`` counter bump) instead of an
+    ``asnumpy()`` round-trip per array.  Host-side members pass through
+    ``numpy.asarray`` untouched."""
+    arrays = list(arrays)
+    out = [None] * len(arrays)
+    idx = [i for i, x in enumerate(arrays) if isinstance(x, nd.NDArray)]
+    if idx:
+        import jax
+
+        from . import dispatch as _dispatch
+        from . import profiler as _prof
+
+        _prof.dispatch_count("host_sync")
+        _dispatch.guard_host_sync("metric update (batched device_get)")
+        fetched = jax.device_get([arrays[i].data for i in idx])
+        for i, v in zip(idx, fetched):
+            out[i] = numpy.asarray(v)
+    for i, x in enumerate(arrays):
+        if out[i] is None:
+            out[i] = numpy.asarray(x)
+    return out
+
+
 def check_label_shapes(labels, preds, wrap=False, shape=False):
     """Reference-compatible shape guard (metric.check_label_shapes)."""
     got = (labels.shape, preds.shape) if shape else (len(labels), len(preds))
@@ -77,10 +103,14 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 
 
 def _pairs(labels, preds):
-    """Normalize to aligned (label, pred) array pairs."""
+    """Normalize to aligned (label, pred) HOST numpy pairs — all device
+    members of both lists come over in one batched transfer, so a metric
+    ``update()`` costs at most one host sync per batch."""
     labels, preds = check_label_shapes(labels, preds, wrap=True)
-    for lab, pr in zip(labels, preds):
-        yield lab, pr
+    labels, preds = list(labels), list(preds)
+    flat = _as_numpy_batch(labels + preds)
+    n = len(labels)
+    return list(zip(flat[:n], flat[n:]))
 
 
 class EvalMetric:
@@ -123,7 +153,7 @@ class EvalMetric:
 
     def update(self, labels, preds):
         for lab, pr in _pairs(labels, preds):
-            s, n = self._measure(_as_numpy(lab), _as_numpy(pr))
+            s, n = self._measure(lab, pr)
             self.sum_metric += s
             self.num_inst += n
 
@@ -505,8 +535,8 @@ class Loss(EvalMetric):
     def update(self, _, preds):
         if isinstance(preds, nd.NDArray):
             preds = [preds]
-        for pred in preds:
-            self.sum_metric += _as_numpy(pred).sum()
+        for pred in _as_numpy_batch(preds):
+            self.sum_metric += pred.sum()
             self.num_inst += pred.size
 
 
@@ -545,11 +575,14 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
-        for pred, label in zip(preds, labels):
-            got = self._feval(_as_numpy(label), _as_numpy(pred))
-            s, n = got if isinstance(got, tuple) else (got, 1)
+        labels, preds = list(labels), list(preds)
+        n = min(len(labels), len(preds))  # zip semantics of the reference
+        flat = _as_numpy_batch(labels[:n] + preds[:n])
+        for label, pred in zip(flat[:n], flat[n:]):
+            got = self._feval(label, pred)
+            s, n_inst = got if isinstance(got, tuple) else (got, 1)
             self.sum_metric += s
-            self.num_inst += n
+            self.num_inst += n_inst
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")

@@ -98,6 +98,14 @@ class NDArray:
         return self
 
     def asnumpy(self):
+        # the single device->host sync choke point (.item()/.asscalar()/
+        # float()/int()/bool() all route through here): count it, and let
+        # the runtime trace guard flag syncs inside traced regions
+        from .. import dispatch as _dispatch
+        from .. import profiler as _prof
+
+        _prof.dispatch_count("host_sync")
+        _dispatch.guard_host_sync("NDArray.asnumpy()")
         try:
             return np.asarray(self._data)
         except RuntimeError as e:

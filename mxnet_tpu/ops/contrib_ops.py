@@ -277,7 +277,10 @@ def _boolean_mask(data, index, axis=0):
     (``nd.contrib.boolean_mask``) records on the tape."""
     import numpy as np
 
-    idx = jnp.asarray(np.flatnonzero(np.asarray(index)), jnp.int32)
+    # deliberate host materialization (registered cacheable=False so this
+    # never runs under jit): see docstring — data-dependent output shape
+    idx = jnp.asarray(np.flatnonzero(np.asarray(index)),  # mxlint: disable=TS001
+                      jnp.int32)
     return jnp.take(data, idx, axis=axis)
 
 

@@ -94,12 +94,15 @@ def foreach_core(body, data_arrays, state_arrays, data_fmt, state_fmt,
         states = [NDArray(a) for a in carry[1:]]
         slices = [NDArray(a) for a in xs]
         d_arg, rest = _regroup(slices, data_fmt)
-        assert not rest
+        # `rest` is a python list; emptiness is static at trace time
+        assert not rest  # mxlint: disable=TS004
         s_arg, rest = _regroup(states, state_fmt)
-        assert not rest
+        assert not rest  # mxlint: disable=TS004
         out, new_states = _wrap_body(body, sub, train)(d_arg, s_arg)
         flat_out, ofmt = _flatten(out)
-        cell["out_fmt"] = ofmt
+        # out_fmt is a static fact of the traced program, captured at
+        # trace time by design (it only exists while tracing)
+        cell["out_fmt"] = ofmt  # mxlint: disable=TS002
         flat_ns, nsfmt = _flatten(new_states)
         if len(flat_ns) != len(carry) - 1:
             raise ValueError(
@@ -132,13 +135,15 @@ def while_core(cond, func, state_arrays, state_fmt, max_iterations,
         key, sub = jax.random.split(key)
         states = [NDArray(a) for a in carry[2:]]
         s_arg, rest = _regroup(states, state_fmt)
-        assert not rest
+        # `rest` is a python list; emptiness is static at trace time
+        assert not rest  # mxlint: disable=TS004
         s_list = _as_list(s_arg)
         runner = _wrap_body(lambda *a: (cond(*a), func(*a)), sub, train)
         c_nd, (out, new_states) = runner(*s_list)
         execute = alive & (jnp.squeeze(c_nd.data) != 0)
         flat_out, ofmt = _flatten(out)
-        cell["out_fmt"] = ofmt
+        # static trace-time capture, same as foreach_core above
+        cell["out_fmt"] = ofmt  # mxlint: disable=TS002
         flat_ns, _ = _flatten(new_states)
         if len(flat_ns) != len(carry) - 2:
             raise ValueError(

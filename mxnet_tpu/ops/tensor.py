@@ -479,10 +479,13 @@ def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
     ``lhs``'s VALUES flow through; ``rhs`` contributes shape alone, so
     its gradient is zero — which jax AD produces for free."""
     def _rng(begin, end, ndim):
-        b = 0 if begin is None else int(begin)
-        e = ndim if end is None else int(end)
-        b += ndim if b < 0 else 0
-        e += ndim if e < 0 else 0
+        # begin/end are static op kwargs (python ints or None) and ndim
+        # a python int from len(shape) — never tracers; mxlint's taint
+        # model can't see through the nested-def call sites
+        b = 0 if begin is None else int(begin)  # mxlint: disable=TS001
+        e = ndim if end is None else int(end)  # mxlint: disable=TS001
+        b += ndim if b < 0 else 0  # mxlint: disable=TS004
+        e += ndim if e < 0 else 0  # mxlint: disable=TS004
         return b, e
     lb, le = _rng(lhs_begin, lhs_end, len(lhs.shape))
     rb, re = _rng(rhs_begin, rhs_end, len(rhs.shape))

@@ -144,7 +144,8 @@ _NULL = _Null()
 # after every tier-1 run; when a profiler session IS running each update
 # also lands as a chrome-trace counter event.
 _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
-                  "op_recompile", "donated_bytes", "bucket_padded_batches")
+                  "op_recompile", "donated_bytes", "bucket_padded_batches",
+                  "host_sync", "trace_guard")
 _dispatch = {k: 0 for k in _DISPATCH_KEYS}
 
 

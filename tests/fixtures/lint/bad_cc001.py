@@ -1,0 +1,12 @@
+"""CC001 bad: lock held across blocking calls."""
+import threading
+import time
+
+lock = threading.Lock()
+
+
+def flush(sock, payload, worker):
+    with lock:
+        sock.sendall(payload)
+        time.sleep(0.1)
+        worker.join()

@@ -1,0 +1,8 @@
+"""CC002 bad: non-daemon thread with no join path."""
+import threading
+
+
+def serve(handler):
+    t = threading.Thread(target=handler)
+    t.start()
+    return t

@@ -1,0 +1,9 @@
+"""TS001 bad: host syncs inside a traced body."""
+import jax
+
+
+@jax.jit
+def step(x, scale_nd):
+    v = scale_nd.asnumpy()
+    s = float(x.sum())
+    return x * s + v[0]

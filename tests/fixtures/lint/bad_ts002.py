@@ -1,0 +1,15 @@
+"""TS002 bad: trace-time side effects in a traced body."""
+import time
+
+import jax
+
+history = []
+
+
+@jax.jit
+def step(model, x):
+    print("stepping")
+    history.append(1)
+    model.counter = model.counter + 1
+    t = time.time()
+    return x * t

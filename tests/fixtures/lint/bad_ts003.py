@@ -1,0 +1,12 @@
+"""TS003 bad: untracked randomness inside traced code."""
+import random
+
+import numpy as np
+import jax
+
+
+@jax.jit
+def noisy(x):
+    noise = np.random.normal(size=3)
+    flip = random.random()
+    return x + noise * flip

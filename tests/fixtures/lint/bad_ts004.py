@@ -1,0 +1,11 @@
+"""TS004 bad: Python control flow on tracer-valued expressions."""
+import jax
+
+
+@jax.jit
+def clamp(x, lo):
+    if x.sum() > 0:
+        x = x - lo
+    while x.mean() > 1.0:
+        x = x / 2
+    return x

@@ -1,0 +1,9 @@
+"""TS005 bad: reading a buffer after donating it."""
+import jax
+
+
+def train(step, w, g):
+    fast = jax.jit(step, donate_argnums=(0,))
+    new_w = fast(w, g)
+    stale = w + 1
+    return new_w, stale

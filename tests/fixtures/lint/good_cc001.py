@@ -1,0 +1,15 @@
+"""CC001 good: stage under the lock, block after release."""
+import threading
+import time
+
+lock = threading.Lock()
+pending = []
+
+
+def flush(sock, worker):
+    with lock:
+        payload = b"".join(pending)
+        pending.clear()
+    sock.sendall(payload)
+    time.sleep(0.1)
+    worker.join()

@@ -1,0 +1,14 @@
+"""CC002 good: daemon worker, and a joined non-daemon worker."""
+import threading
+
+
+def serve(handler):
+    t = threading.Thread(target=handler, daemon=True)
+    t.start()
+    return t
+
+
+def run_once(handler):
+    t = threading.Thread(target=handler)
+    t.start()
+    t.join()

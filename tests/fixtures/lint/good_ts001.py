@@ -1,0 +1,12 @@
+"""TS001 good: syncs happen outside the traced region."""
+import jax
+
+
+@jax.jit
+def step(x, scale):
+    return x * scale
+
+
+def evaluate(step_fn, x, scale):
+    out = step_fn(x, scale)
+    return float(out.sum())

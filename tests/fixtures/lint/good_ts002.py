@@ -1,0 +1,19 @@
+"""TS002 good: side effects confined to local state / host code."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    partials = []
+    for i in range(3):
+        partials.append(x * i)
+    return sum(partials)
+
+
+def train(x):
+    t0 = time.time()
+    out = step(x)
+    print("step took", time.time() - t0)
+    return out

@@ -1,0 +1,12 @@
+"""TS003 good: randomness threaded through the framework key."""
+import numpy as np
+import jax
+
+
+@jax.jit
+def noisy(x, key):
+    return x + jax.random.normal(key, x.shape)
+
+
+def host_init():
+    return np.random.normal(size=3)

@@ -1,0 +1,323 @@
+"""mxlint static analyzer + runtime trace guard.
+
+Covers: one failing and one passing fixture per rule (TS001–TS005,
+CC001–CC002), suppression directives, the JSON reporter schema, CLI exit
+codes, the MXNET_TRACE_GUARD runtime guard end-to-end, and the
+one-host-sync-per-batch metric contract.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from conftest import subprocess_env
+
+import mxnet_tpu as mx
+from mxnet_tpu import dispatch, profiler
+from mxnet_tpu.lint import (RULES, Severity, format_json, format_text,
+                            lint_file, lint_paths, lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+ALL_RULES = ("TS001", "TS002", "TS003", "TS004", "TS005", "CC001", "CC002")
+
+
+def _rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# -- fixture corpus ---------------------------------------------------------
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_bad_fixture_fails(rule):
+    findings = lint_file(os.path.join(FIXTURES, "bad_%s.py" % rule.lower()))
+    assert rule in _rules_hit(findings), findings
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_good_fixture_passes(rule):
+    findings = lint_file(os.path.join(FIXTURES, "good_%s.py" % rule.lower()))
+    assert not findings, findings
+
+
+def test_findings_carry_position_and_severity():
+    findings = lint_file(os.path.join(FIXTURES, "bad_ts001.py"))
+    f = findings[0]
+    assert f.line > 0 and f.col >= 0
+    assert f.severity in (Severity.ERROR, Severity.WARNING)
+    assert f.path.endswith("bad_ts001.py")
+    assert f.rule in RULES
+    # human format is path:line:col: RULE [severity] message
+    assert f.format().startswith("%s:%d:%d: %s [" % (f.path, f.line,
+                                                     f.col, f.rule))
+
+
+def test_rule_registry_complete():
+    assert set(ALL_RULES) <= set(RULES)
+    for rule in RULES.values():
+        assert rule.summary and rule.doc
+
+
+# -- suppressions -----------------------------------------------------------
+BAD_PRINT = textwrap.dedent("""\
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("traced")%s
+        return x
+""")
+
+
+def test_trailing_suppression():
+    assert lint_source(BAD_PRINT % "")
+    assert not lint_source(BAD_PRINT % "  # mxlint: disable=TS002")
+    assert not lint_source(BAD_PRINT % "  # mxlint: disable=all")
+    # suppressing a different rule does not silence the finding
+    assert lint_source(BAD_PRINT % "  # mxlint: disable=TS001")
+
+
+def test_standalone_suppression_covers_next_line():
+    src = textwrap.dedent("""\
+        import jax
+
+        @jax.jit
+        def step(x):
+            # mxlint: disable=TS002 -- deliberate trace marker
+            print("traced")
+            return x
+    """)
+    assert not lint_source(src)
+
+
+def test_skip_file_directive():
+    src = "# mxlint: skip-file\n" + BAD_PRINT % ""
+    assert not lint_source(src)
+
+
+def test_select_and_disable():
+    src = BAD_PRINT % ""
+    assert not lint_source(src, select={"TS001"})
+    assert lint_source(src, select={"TS002"})
+    assert not lint_source(src, disable={"TS002"})
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = lint_source("def broken(:\n", path="x.py")
+    assert len(findings) == 1
+    assert findings[0].rule == "PARSE"
+    assert findings[0].severity == Severity.ERROR
+
+
+# -- reporters --------------------------------------------------------------
+def test_json_reporter_schema():
+    findings, n_files = lint_paths([os.path.join(FIXTURES, "bad_ts002.py")])
+    payload = json.loads(format_json(findings, n_files))
+    assert payload["version"] == 1
+    assert payload["tool"] == "mxlint"
+    assert payload["counts"]["files"] == 1
+    assert payload["counts"]["error"] == len(
+        [f for f in findings if f.severity == "error"])
+    for item in payload["findings"]:
+        assert set(item) == {"rule", "severity", "path", "line", "col",
+                             "message"}
+        assert isinstance(item["line"], int)
+
+
+def test_text_reporter_tail():
+    findings, n_files = lint_paths([os.path.join(FIXTURES, "bad_ts004.py")])
+    text = format_text(findings, n_files)
+    assert text.splitlines()[-1].endswith("in 1 file(s)")
+    assert "warning(s)" in text
+
+
+# -- CLI --------------------------------------------------------------------
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.lint"] + list(args),
+        cwd=REPO, env=subprocess_env(), capture_output=True, text=True,
+        timeout=120)
+
+
+def test_cli_exit_codes():
+    bad = os.path.join(FIXTURES, "bad_cc001.py")
+    good = os.path.join(FIXTURES, "good_cc001.py")
+    assert _run_cli(good).returncode == 0
+    res = _run_cli(bad)
+    assert res.returncode == 1
+    assert "CC001" in res.stdout
+    # warnings alone pass unless --strict
+    warn_only = os.path.join(FIXTURES, "bad_ts004.py")
+    assert _run_cli(warn_only).returncode == 0
+    assert _run_cli("--strict", warn_only).returncode == 1
+    # usage errors exit 2
+    assert _run_cli("/no/such/path.py").returncode == 2
+    assert _run_cli("--select", "ZZ999", good).returncode == 2
+
+
+def test_cli_json_format():
+    res = _run_cli("--format", "json", os.path.join(FIXTURES,
+                                                    "bad_ts003.py"))
+    payload = json.loads(res.stdout)
+    assert payload["tool"] == "mxlint"
+    assert any(f["rule"] == "TS003" for f in payload["findings"])
+
+
+def test_mxlint_alias_runs_without_importing_jax():
+    """tools/mxlint must work standalone — the analyzer is stdlib-only,
+    so even a broken/missing jax install can still lint."""
+    env = subprocess_env()
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint"),
+         os.path.join(FIXTURES, "bad_ts001.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1
+    assert "TS001" in res.stdout
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: the analyzer runs clean over the repo."""
+    findings, n_files = lint_paths(
+        [os.path.join(REPO, d) for d in ("mxnet_tpu", "example", "tools")])
+    assert n_files > 100
+    assert not findings, format_text(findings, n_files)
+
+
+# -- runtime trace guard ----------------------------------------------------
+def _stats_delta(key, before):
+    return profiler.dispatch_stats()[key] - before[key]
+
+
+def test_trace_guard_off_by_default():
+    before = profiler.dispatch_stats()
+    a = mx.nd.array(np.ones(3))
+    a.asnumpy()
+    assert _stats_delta("host_sync", before) == 1
+    assert _stats_delta("trace_guard", before) == 0
+
+
+def test_trace_guard_raise_names_offending_frame(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE_GUARD", "raise")
+    captured = mx.nd.array(np.full((3,), 7.0))
+
+    def bad_step(x):
+        scale = captured.asnumpy()[0]  # injected in-trace host sync
+        return x * scale
+
+    import jax.numpy as jnp
+
+    tj = dispatch.TrackedJit(bad_step)
+    before = profiler.dispatch_stats()
+    with pytest.raises(dispatch.TraceGuardError) as exc:
+        tj(jnp.ones(3))
+    msg = str(exc.value)
+    assert "bad_step" in msg                      # which traced fn
+    assert "test_lint.py" in msg                  # offending user frame
+    assert "in bad_step()" in msg
+    assert _stats_delta("trace_guard", before) == 1
+
+
+def test_trace_guard_warn_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE_GUARD", "warn")
+    captured = mx.nd.array(np.ones(3))
+
+    def leaky(x):
+        return x * float(captured.asnumpy()[0])
+
+    import jax.numpy as jnp
+
+    tj = dispatch.TrackedJit(leaky)
+    with pytest.warns(RuntimeWarning, match="trace guard"):
+        out = tj(jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(out), np.ones(3))
+    # outside any trace the guard stays silent even when armed
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        captured.asnumpy()
+
+
+def test_trace_guard_invalid_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE_GUARD", "bogus")
+    with pytest.raises(ValueError, match="MXNET_TRACE_GUARD"):
+        dispatch.trace_guard_mode()
+
+
+def test_trace_guard_catches_user_jit(monkeypatch):
+    """The guard also fires under plain jax.jit (no TrackedJit): any live
+    trace counts."""
+    monkeypatch.setenv("MXNET_TRACE_GUARD", "raise")
+    captured = mx.nd.array(np.ones(3))
+
+    import jax
+
+    @jax.jit
+    def user_fn(x):
+        return x + captured.asnumpy()
+
+    with pytest.raises(dispatch.TraceGuardError, match="jax trace"):
+        user_fn(np.ones(3))
+
+
+# -- metric host-sync batching ----------------------------------------------
+def test_metric_update_single_host_sync():
+    """One update() = at most one device->host transfer, however many
+    (label, pred) pairs ride in the batch."""
+    from mxnet_tpu import metric
+
+    acc = metric.create("acc")
+    labels = [mx.nd.array(np.array([0.0, 1.0, 1.0])) for _ in range(4)]
+    preds = [mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7]]))
+             for _ in range(4)]
+    before = profiler.dispatch_stats()
+    acc.update(labels, preds)
+    assert _stats_delta("host_sync", before) == 1
+    assert acc.get()[1] == 1.0
+
+
+def test_metric_suite_values_unchanged_by_batching():
+    from mxnet_tpu import metric
+
+    label = mx.nd.array(np.array([0.0, 1.0, 1.0, 0.0]))
+    pred = mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8],
+                                 [0.3, 0.7], [0.6, 0.4]]))
+    acc = metric.Accuracy()
+    acc.update([label], [pred])
+    assert acc.get()[1] == 1.0
+
+    f1 = metric.F1()
+    f1.update([label], [pred])
+    assert f1.get()[1] == 1.0
+
+    mse = metric.MSE()
+    mse.update([mx.nd.array(np.zeros(4))], [mx.nd.array(np.ones(4))])
+    assert mse.get()[1] == 1.0
+
+    loss = metric.Loss()
+    before = profiler.dispatch_stats()
+    loss.update(None, [mx.nd.array(np.full((2,), 3.0)),
+                       mx.nd.array(np.full((2,), 1.0))])
+    assert _stats_delta("host_sync", before) == 1
+    assert loss.get()[1] == 2.0
+
+    custom = metric.CustomMetric(lambda l, p: float((l == p).mean()),
+                                 name="match")
+    before = profiler.dispatch_stats()
+    custom.update([label], [label])
+    assert _stats_delta("host_sync", before) == 1
+    assert custom.get()[1] == 1.0
+
+
+def test_metric_update_host_arrays_cost_no_sync():
+    from mxnet_tpu import metric
+
+    acc = metric.Accuracy()
+    before = profiler.dispatch_stats()
+    acc.update([np.array([1.0, 0.0])], [np.array([[0.1, 0.9],
+                                                  [0.8, 0.2]])])
+    assert _stats_delta("host_sync", before) == 0
+    assert acc.get()[1] == 1.0
