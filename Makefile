@@ -8,6 +8,7 @@
 #   make test       -> full pytest suite (CPU oracle, 8-device mesh)
 #   make test-fast  -> quick shard (operators + ndarray + autograd)
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
+#   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
 
@@ -31,10 +32,13 @@ test-fast:
 lint:
 	$(PYTHON) tools/mxlint mxnet_tpu/ example/ tools/
 
+chaos:
+	bash ci/runtime_functions.sh chaos_check
+
 ci:
 	bash ci/runtime_functions.sh all
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint ci clean
+.PHONY: all native cpp test test-fast lint chaos ci clean

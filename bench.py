@@ -172,6 +172,48 @@ def main(argv=None):
         loss.asnumpy(), dtype=np.float32).mean())
     extra["dispatch"] = profiler.dispatch_stats()
 
+    # ---- numerical-health sentinel overhead ----
+    # same net/trainer with the guard armed: the fused finiteness
+    # reduction + lax.cond containment must stay within the 3%
+    # acceptance budget (docs/NUMERICAL_HEALTH.md).  The unguarded step
+    # is RE-timed here, interleaved rep-for-rep with the guarded one —
+    # the primary train leg ran minutes earlier and machine drift
+    # between legs would otherwise swamp a single-digit overhead
+    if _leg_ok(extra, "sentinel", need=15 if quick else 45):
+        try:
+            guard_step = FusedTrainStep(net, loss_fn, trainer,
+                                        numeric_guard="skip")
+            for _ in range(2 if quick else 3):  # warmup: separate module
+                gloss = guard_step(x, y)
+            host_fetch(gloss)
+            # same total step budget as one train leg, but split into
+            # short back-to-back base/guard window PAIRS; the overhead
+            # is the MEDIAN per-pair ratio — host interference lands on
+            # one window of one pair and would be read as sentinel cost
+            # (or savings) by a mean or an extreme, while the median
+            # pair is clean on a mostly-idle machine
+            win = max(2, steps // 2)
+            guard_img_s, ratios = 0.0, []
+            for _ in range(3 * reps):
+                dts = {}
+                for tag, s in (("base", step), ("guard", guard_step)):
+                    t0 = time.perf_counter()
+                    for _ in range(win):
+                        gloss = s(x, y)
+                    host_fetch(gloss)
+                    dts[tag] = time.perf_counter() - t0
+                guard_img_s = max(guard_img_s,
+                                  batch * win / dts["guard"])
+                ratios.append(dts["guard"] / dts["base"] - 1.0)
+            ratios.sort()
+            mid = len(ratios) // 2
+            overhead = (ratios[mid] if len(ratios) % 2
+                        else (ratios[mid - 1] + ratios[mid]) / 2.0)
+            extra["sentinel_guard_img_per_sec"] = round(guard_img_s, 2)
+            extra["sentinel_overhead_pct"] = round(overhead * 100.0, 2)
+        except Exception as e:  # secondary metric must not sink the run
+            extra["sentinel_error"] = "%s: %s" % (type(e).__name__, e)
+
     # ---- inference ----
     # two disciplines (mxnet_tpu/benchmark.py): the compiled K-step loop
     # (one dispatch per draw — measures the device, stable to a few

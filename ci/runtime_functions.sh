@@ -97,6 +97,16 @@ print("fault_injection_smoke OK")
 PY
 }
 
+chaos_check() {
+    # Numerical-health sentinel + chaos fault-injection matrix
+    # (docs/NUMERICAL_HEALTH.md): every seeded fault plan in
+    # tests/test_chaos.py — NaN-gradient skip/rollback/rescale/restore
+    # escalation, KV drop/delay/dup healing, checkpoint-corruption CRC
+    # fallback, loader skip-and-count — plus the preemption smoke.
+    python -m pytest tests/ -q -m chaos
+    fault_injection_smoke
+}
+
 unittest_serving() {
     python -m pytest tests/test_predict.py tests/test_native.py \
         tests/test_quantization.py tests/test_pallas.py \
@@ -151,6 +161,7 @@ all() {
     unittest_serving
     unittest_dtype_sweep
     integration_examples
+    chaos_check
     multichip_dryrun
 }
 

@@ -67,6 +67,8 @@ from .config import config  # noqa: F401  (mx.config = the knob registry;
 from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import elastic  # noqa: F401
+from . import chaos  # noqa: F401
+from . import sentinel  # noqa: F401
 from . import benchmark  # noqa: F401
 
 # everything registered up to here is the shipped op corpus; later

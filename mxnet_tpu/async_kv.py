@@ -265,6 +265,14 @@ class _Handler(socketserver.BaseRequestHandler):
         return ValueError("unknown op %r" % (op,))
 
 
+def _chaos_note(kind, seq):
+    """Report an armed transport fault actually firing to the chaos
+    plan/counters (mxnet_tpu.chaos)."""
+    from . import chaos as _chaos
+
+    _chaos.note_kv_fault(kind, seq)
+
+
 class AsyncKVClient:
     """Worker-side handle; worker 0 also hosts the server thread.
 
@@ -310,10 +318,15 @@ class AsyncKVClient:
         self._seq = 0
         self._sock = None
         self._lock = threading.Lock()
-        # test hook: seq numbers whose send succeeds but whose reply is
+        # chaos hooks (armed by mxnet_tpu.chaos.arm_kv_client or directly
+        # by tests): seq numbers whose send succeeds but whose reply is
         # "lost" (socket closed before recv) — exercises the retransmit+
-        # dedup path deterministically
+        # dedup path deterministically; seq -> seconds delayed before the
+        # send (reordering window); seqs transmitted twice (the server's
+        # (client_id, seq) dedup must answer the duplicate from cache)
         self._fi_drop_after_send = set()
+        self._fi_delay_before_send = {}
+        self._fi_duplicate_send = set()
         self._connect()
 
     def _connect(self):
@@ -345,11 +358,26 @@ class AsyncKVClient:
                 try:
                     if self._sock is None:
                         self._connect()  # mxlint: disable=CC001
+                    fi_delay = self._fi_delay_before_send.pop(seq, None)
+                    if fi_delay:
+                        _chaos_note("kv_delay", seq)
+                        time.sleep(fi_delay)  # mxlint: disable=CC001
                     _send_msg(  # mxlint: disable=CC001
                         self._sock,
                         (self._client_id, seq, op, key, payload))
+                    fi_dup = seq in self._fi_duplicate_send
+                    if fi_dup:
+                        self._fi_duplicate_send.discard(seq)
+                        _chaos_note("kv_dup", seq)
+                        # retransmit the identical frame: the server must
+                        # answer both from its dedup cache; the spare
+                        # reply is drained right after the real one
+                        _send_msg(  # mxlint: disable=CC001
+                            self._sock,
+                            (self._client_id, seq, op, key, payload))
                     if seq in self._fi_drop_after_send:
                         self._fi_drop_after_send.discard(seq)
+                        _chaos_note("kv_drop", seq)
                         self._close()
                         raise ConnectionError(
                             "injected reply loss (seq %d)" % seq)
@@ -358,6 +386,16 @@ class AsyncKVClient:
                     if rseq != seq:  # torn stream: resync on a fresh conn
                         raise ConnectionError(
                             "reply seq %s != request seq %d" % (rseq, seq))
+                    if fi_dup:
+                        # drain the duplicate's reply so the stream stays
+                        # aligned; the server's dedup answered it from
+                        # the (client_id, seq) cache
+                        dseq, _dreply = _recv_msg(  # mxlint: disable=CC001
+                            self._sock)
+                        if dseq != seq:
+                            raise ConnectionError(
+                                "dup reply seq %s != request seq %d"
+                                % (dseq, seq))
                     break
                 except (ConnectionError, EOFError, socket.timeout,
                         OSError) as e:

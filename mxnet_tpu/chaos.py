@@ -1,0 +1,307 @@
+"""Deterministic seeded fault injection (the chaos harness).
+
+PR 2's elastic layer proved the framework survives *external* faults
+(crash, SIGTERM, torn checkpoint) via one-off ``MXTPU_FI_*`` hooks; this
+module generalizes them into one declarative, seeded plan so the chaos
+suite (``tests/test_chaos.py``, ``ci/runtime_functions.sh chaos_check``)
+can exercise the numerical-health sentinel AND the resilience paths
+end-to-end — and so a failure reproduces from nothing but the spec
+string.
+
+A plan is a comma list of ``fault@step`` items plus an optional seed::
+
+    MXNET_CHAOS="seed=7,nan_grad@3,kv_drop@5"        # env-driven
+    with chaos.inject("nan_grad@3", seed=7): ...      # scoped
+
+Faults (each firing bumps the ``faults_injected`` dispatch counter):
+
+==================  ========================================================
+``nan_grad@N``      poison step N's loss scale with NaN so every gradient
+                    goes non-finite through the *genuine* backward path
+                    (``FusedTrainStep`` hook; no recompile — the scale is
+                    a traced scalar input)
+``bitflip_param@N`` flip one seeded bit of one parameter element at the
+                    step-N boundary (host-side SDC model, Dixit et al.)
+``kv_drop@N``       the async-KV client's Nth call loses its reply after
+                    send (exercises retransmit + server dedup)
+``kv_delay@N``      delay the Nth call before send (reordering window)
+``kv_dup@N``        transmit the Nth call twice (server must dedup)
+``ckpt_truncate@N`` truncate checkpoint step N's params file mid-write
+                    (via :func:`corrupt_checkpoint`)
+``ckpt_bitflip@N``  flip one seeded bit in checkpoint step N's params file
+``loader_raise@N``  ``ChaosDataset`` raises on its Nth record fetch
+==================  ========================================================
+
+Every fault fires at most once per process (deterministic, idempotent
+under retry loops); ``step`` counts are 0-based and fault-local (the Nth
+opportunity of that kind).  The plan is inert — ``maybe(...)`` costs one
+attribute load — unless ``MXNET_CHAOS`` is set or ``inject()`` is active.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
+           "corrupt_loss_scale", "poison_grad", "flip_param_bit",
+           "arm_kv_client", "corrupt_checkpoint", "FAULT_KINDS"]
+
+FAULT_KINDS = frozenset({
+    "nan_grad", "bitflip_param", "kv_drop", "kv_delay", "kv_dup",
+    "ckpt_truncate", "ckpt_bitflip", "loader_raise",
+})
+
+
+def _count_fault():
+    from . import profiler as _prof
+
+    _prof.dispatch_count("faults_injected")
+
+
+class ChaosPlan:
+    """Parsed, seeded fault plan.  ``fire(kind, step)`` is True exactly
+    once for each ``kind@step`` item in the spec (and then consumed), so
+    injected faults stay deterministic under restarts and retries."""
+
+    def __init__(self, spec, seed=0):
+        self.spec = spec
+        self.seed = int(seed)
+        self._faults = {}      # (kind, step) -> not-yet-fired
+        self._lock = threading.Lock()
+        for item in str(spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if item.startswith("seed="):
+                self.seed = int(item[len("seed="):])
+                continue
+            if "@" not in item:
+                raise ValueError("MXNET_CHAOS item %r: expected "
+                                 "'fault@step' or 'seed=N'" % item)
+            kind, step = item.split("@", 1)
+            kind = kind.strip()
+            if kind not in FAULT_KINDS:
+                raise ValueError("MXNET_CHAOS: unknown fault %r (one of "
+                                 "%s)" % (kind, sorted(FAULT_KINDS)))
+            self._faults[(kind, int(step))] = True
+        self.kinds = frozenset(k for k, _ in self._faults)
+
+    def rng(self, kind, step):
+        """Per-fault deterministic RNG: which bit/element gets hit
+        depends only on (seed, kind, step), never on call order."""
+        return np.random.RandomState(
+            (self.seed * 1000003 + hash((kind, step))) & 0x7FFFFFFF)
+
+    def fire(self, kind, step):
+        """True exactly once when the plan schedules ``kind`` at this
+        fault-local ``step``; bumps ``faults_injected``."""
+        if kind not in self.kinds:
+            return False
+        with self._lock:
+            if not self._faults.get((kind, int(step))):
+                return False
+            self._faults[(kind, int(step))] = False
+        _count_fault()
+        return True
+
+    def pending(self):
+        """Faults not yet fired (chaos tests assert this drains empty)."""
+        return sorted(k for k, live in self._faults.items() if live)
+
+
+_scoped = None
+_env_plan = None
+_env_spec_seen = None
+
+
+def active():
+    """The active :class:`ChaosPlan`, or None.  A scoped ``inject()``
+    shadows the ``MXNET_CHAOS`` env plan."""
+    global _env_plan, _env_spec_seen
+    if _scoped is not None:
+        return _scoped
+    spec = os.environ.get("MXNET_CHAOS", "")
+    if not spec:
+        return None
+    if spec != _env_spec_seen:  # re-parse only when the env changes
+        _env_plan = ChaosPlan(spec)
+        _env_spec_seen = spec
+    return _env_plan
+
+
+class inject:
+    """Context manager arming a scoped chaos plan::
+
+        with chaos.inject("nan_grad@2", seed=7) as plan:
+            train()
+        assert plan.pending() == []
+    """
+
+    def __init__(self, spec, seed=0):
+        self.plan = ChaosPlan(spec, seed=seed)
+
+    def __enter__(self):
+        global _scoped
+        if _scoped is not None:
+            raise RuntimeError("chaos.inject() does not nest")
+        _scoped = self.plan
+        return self.plan
+
+    def __exit__(self, *exc):
+        global _scoped
+        _scoped = None
+        return False
+
+
+# ---------------------------------------------------------------------------
+# hot-path hooks (each is a cheap no-op without an active plan)
+# ---------------------------------------------------------------------------
+def corrupt_loss_scale(step, scale):
+    """``nan_grad``: return NaN in place of the step's loss scale.  The
+    scale multiplies the loss inside the compiled step, so every gradient
+    goes non-finite through the real backward computation — the exact
+    signature a hardware SDC or fp16 overflow produces."""
+    plan = active()
+    if plan is not None and plan.fire("nan_grad", step):
+        return float("nan")
+    return scale
+
+
+def poison_grad(step, params):
+    """``nan_grad`` on the eager Trainer path (which has no loss-scale
+    slot): write NaN into one seeded element of one parameter's gradient
+    before the update.  Returns the poisoned parameter's name or None."""
+    plan = active()
+    if plan is None or not params or "nan_grad" not in plan.kinds:
+        return None
+    if not plan.fire("nan_grad", step):
+        return None
+    rng = plan.rng("nan_grad", step)
+    p = params[rng.randint(len(params))]
+    for g in p.list_grad():
+        host = np.array(g.asnumpy())   # asnumpy views are read-only
+        flat = host.reshape(-1)
+        if flat.size == 0 or flat.dtype.kind != "f":
+            return None
+        flat[rng.randint(flat.size)] = np.nan
+        import jax.numpy as jnp
+
+        g._set_data(jnp.asarray(host, dtype=g.data.dtype))
+    return getattr(p, "name", None)
+
+
+def flip_param_bit(step, params):
+    """``bitflip_param``: flip one seeded bit of one element of one
+    parameter (host-side write-back).  Returns the poisoned parameter's
+    name, or None when nothing fired."""
+    plan = active()
+    if plan is None or not params or "bitflip_param" not in plan.kinds:
+        return None
+    if not plan.fire("bitflip_param", step):
+        return None
+    rng = plan.rng("bitflip_param", step)
+    p = params[rng.randint(len(params))]
+    arr = p.list_data()[0] if hasattr(p, "list_data") else p
+    host = np.array(arr.asnumpy())     # asnumpy views are read-only
+    flat = host.reshape(-1)
+    if flat.size == 0 or flat.dtype.kind not in "fiu":
+        return None
+    idx = rng.randint(flat.size)
+    bits = flat[idx:idx + 1].view("u%d" % flat.dtype.itemsize)
+    bits ^= np.asarray(1, bits.dtype) << rng.randint(8 * flat.dtype.itemsize)
+    import jax.numpy as jnp
+
+    arr._set_data(jnp.asarray(host, dtype=arr.data.dtype))
+    return getattr(p, "name", None)
+
+
+def arm_kv_client(client):
+    """Arm the async-KV transport faults (``kv_drop``/``kv_delay``/
+    ``kv_dup``) on an :class:`~mxnet_tpu.async_kv.AsyncKVClient`.  The
+    step number in the spec is the 1-based sequence number of the call to
+    hit (the client numbers requests from 1)."""
+    plan = active()
+    if plan is None:
+        return client
+    for (kind, seq), live in list(plan._faults.items()):
+        if not live:
+            continue
+        if kind == "kv_drop":
+            client._fi_drop_after_send.add(seq)
+        elif kind == "kv_delay":
+            client._fi_delay_before_send[seq] = 0.05
+        elif kind == "kv_dup":
+            client._fi_duplicate_send.add(seq)
+    return client
+
+
+def note_kv_fault(kind, seq):
+    """Called by the async_kv client when an armed transport fault
+    actually fires — routes the event through the plan so counters and
+    ``pending()`` stay truthful."""
+    plan = active()
+    if plan is not None:
+        plan.fire(kind, seq)
+    else:
+        _count_fault()  # hand-armed via the test hooks
+
+
+def corrupt_checkpoint(manager, step=None, mode="truncate"):
+    """``ckpt_truncate`` / ``ckpt_bitflip``: damage a *committed*
+    checkpoint's params file in place (newest by default) — the torn
+    write / bit-rot the CRC meta exists to catch.  Returns the damaged
+    step.  Usable directly from tests (no active plan required)."""
+    steps = manager.steps()
+    if not steps:
+        raise ValueError("no committed checkpoint to corrupt")
+    step = steps[-1] if step is None else step
+    path = manager._params_path(step)
+    plan = active()
+    rng = plan.rng("ckpt_" + mode, step) if plan is not None \
+        else np.random.RandomState(step)
+    if plan is not None:
+        plan.fire("ckpt_truncate" if mode == "truncate" else "ckpt_bitflip",
+                  step)
+    else:
+        _count_fault()
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size // 2))
+    elif mode == "bitflip":
+        off = int(rng.randint(max(1, size)))
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([(b[0] if b else 0) ^ (1 << int(rng.randint(8)))]))
+    else:
+        raise ValueError("mode must be 'truncate' or 'bitflip'")
+    return step
+
+
+class ChaosDataset:
+    """Wrap a Dataset so fetch N raises (``loader_raise@N`` — the
+    mid-batch reader failure the DataLoader's skip-and-count path must
+    absorb).  Without an active plan it is transparent."""
+
+    def __init__(self, dataset, error=IOError("chaos: injected record "
+                                              "read failure")):
+        self._dataset = dataset
+        self._error = error
+        self._fetches = 0
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        plan = active()
+        with self._lock:
+            n = self._fetches
+            self._fetches += 1
+        if plan is not None and plan.fire("loader_raise", n):
+            raise type(self._error)(*self._error.args)
+        return self._dataset[idx]

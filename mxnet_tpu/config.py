@@ -95,6 +95,30 @@ class _Config:
              "RuntimeWarning naming the offending user frame, 'raise' "
              "turns it into dispatch.TraceGuardError. Each hit bumps the "
              "profiler's trace_guard dispatch counter. '' disables."),
+        Knob("MXNET_NUMERIC_GUARD", str, "",
+             "Numerical-health sentinel over the training hot path "
+             "(docs/NUMERICAL_HEALTH.md): a fused on-device finiteness "
+             "reduction over loss+gradients rides FusedTrainStep / "
+             "Trainer.step. 'warn' counts+warns but still applies the "
+             "update; 'skip' keeps params/optimizer state bitwise "
+             "unchanged across a non-finite step (selected on device, no "
+             "host round-trip); 'escalate' runs the full ladder "
+             "skip -> rescale -> rollback-k -> restore-checkpoint -> "
+             "exit(77, retryable). '' disables (zero overhead)."),
+        Knob("MXNET_ROLLBACK_STEPS", int, 0,
+             "Depth k of the bad-step rollback ring (host-RAM snapshots "
+             "of params + optimizer state kept by the sentinel; restore "
+             "is shape/dtype-preserving so it never recompiles). 0 "
+             "disables snapshotting; the escalation ladder then skips "
+             "the rollback rung. See docs/NUMERICAL_HEALTH.md."),
+        Knob("MXNET_CHAOS", str, "",
+             "Deterministic seeded fault-injection plan for the chaos "
+             "harness (mxnet_tpu.chaos), e.g. "
+             "'seed=7,nan_grad@3,kv_drop@5'. Faults: nan_grad, "
+             "bitflip_param, kv_drop, kv_delay, kv_dup, ckpt_truncate, "
+             "ckpt_bitflip, loader_raise. Each firing bumps the "
+             "faults_injected dispatch counter. '' disables. Testing "
+             "only — never set in production."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

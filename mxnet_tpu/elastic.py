@@ -61,10 +61,15 @@ from .ndarray import utils as _nd_utils
 __all__ = ["CheckpointManager", "FaultInjector", "InjectedFault",
            "PreemptionHandler", "PreemptionRequested", "Watchdog",
            "supervise", "active_watchdog",
-           "WATCHDOG_EXIT_CODE", "PREEMPTED_EXIT_CODE"]
+           "WATCHDOG_EXIT_CODE", "PREEMPTED_EXIT_CODE",
+           "NUMERIC_EXIT_CODE"]
 
 WATCHDOG_EXIT_CODE = 75   # distinctive "stalled, please restart" status
 PREEMPTED_EXIT_CODE = 76  # graceful drain: checkpointed, restart for free
+NUMERIC_EXIT_CODE = 77    # sentinel escalation exhausted: params poisoned
+#                           beyond local repair — restart from the newest
+#                           verified checkpoint (retryable: supervise
+#                           charges the normal failure budget)
 
 
 def _log(msg):

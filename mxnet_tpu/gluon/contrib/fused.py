@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ... import autograd
+from ... import chaos as _chaos
 from ... import random as _random
 from ...ndarray.ndarray import NDArray, _wrap
 from ...ops import registry as _registry
@@ -108,7 +109,8 @@ class FusedTrainStep:
     before single-device eager evaluation."""
 
     def __init__(self, net, loss_fn, trainer, devices=None, donate=None,
-                 bucket=None, watchdog=None, preemption=None):
+                 bucket=None, watchdog=None, preemption=None,
+                 numeric_guard=None, sentinel=None):
         """``donate``: None → MXNET_DONATE_BUFFERS knob; True/False forces
         buffer donation for the step on/off.  ``bucket``: None → the
         MXNET_SHAPE_BUCKETS knob; False forces bucketing off; else a spec
@@ -129,7 +131,24 @@ class FusedTrainStep:
         restartable exit), and checks ``preemption`` (an
         elastic.PreemptionHandler) BEFORE any side effect — a pending
         SIGTERM drain raises PreemptionRequested at the step boundary,
-        where params/optimizer state are consistent to checkpoint."""
+        where params/optimizer state are consistent to checkpoint.
+
+        Numerical health (mxnet_tpu.sentinel): ``numeric_guard`` is the
+        guard mode (None → the MXNET_NUMERIC_GUARD knob; False forces
+        off).  When active, the compiled step also emits an int32 health
+        vector ``[loss_nonfinite, per-param grad nonfinite flags]`` —
+        the reductions fuse into the backward pass — and in skip /
+        escalate modes runs the whole optimizer update inside the true
+        branch of a ``lax.cond(ok, ...)`` ON DEVICE, so a NaN/Inf step
+        leaves training state bitwise unchanged without a recompile and
+        a finite step pays no extra pass over it.  The verdict readout
+        is deferred one step (see :meth:`check_health`).  The loss
+        is multiplied by the sentinel's dynamic loss scale inside the
+        trace (a per-step scalar slot — rescaling never recompiles) and
+        the reciprocal is folded into ``rescale_grad`` on the host.
+        Pass ``sentinel=`` to share a configured
+        :class:`~mxnet_tpu.sentinel.HealthSentinel` (scaler, rollback
+        ring, checkpoint manager, divergence detector)."""
         for p in trainer._params:
             if p._replicas is not None and len(p.list_data()) > 1:
                 raise ValueError("FusedTrainStep supports single-context "
@@ -172,6 +191,19 @@ class FusedTrainStep:
         self._bucket = bucket
         self._watchdog = watchdog
         self._preemption = preemption
+        from ... import sentinel as _sentinel_mod
+
+        if sentinel is not None:
+            self._sentinel = sentinel
+            self._guard_mode = (sentinel.mode if numeric_guard is None
+                                else _sentinel_mod.guard_mode(numeric_guard))
+        else:
+            mode = _sentinel_mod.guard_mode(numeric_guard)
+            self._guard_mode = mode
+            self._sentinel = (_sentinel_mod.HealthSentinel(
+                trainer=trainer, mode=mode) if mode else None)
+        self._step_idx = 0
+        self._pending_health = None
 
     def refresh_state_handles(self):
         """Re-capture the updater's state NDArrays (needed only after
@@ -230,16 +262,20 @@ class FusedTrainStep:
         optimizer, updater = self._optimizer, self._updater
         n_p, n_a, n_s = len(params), len(auxs), len(state_nds)
         step_self = self
+        guard = self._guard_mode
 
         def traced(rng, scalars, x, y, pdatas, adatas, sdatas):
             # scalars[0] is the real row count of the (possibly padded)
             # batch; masking the loss to the real rows makes the gradients
             # of a bucketed ragged batch match the unpadded computation
             # (pad rows contribute nothing), so one executable per bucket
-            # serves every ragged size.  The slot exists whether or not
-            # bucketing is on — the signature never changes.
+            # serves every ragged size.  scalars[1] is the sentinel's
+            # loss scale (1.0 with the guard off).  Both slots exist
+            # whether or not the features are on — the signature never
+            # changes, so toggling bucketing/scale never recompiles.
             n_valid = scalars[0]
-            opt_scalars = scalars[1:]
+            loss_scale = scalars[1]
+            opt_scalars = scalars[2:]
 
             def fwd(pdatas_in, adatas_in):
                 p_nds = [NDArray(a) for a in pdatas_in]
@@ -263,36 +299,85 @@ class FusedTrainStep:
                     ld = ld * mask.reshape((ld.shape[0],)
                                            + (1,) * (ld.ndim - 1))
                 lsum = jnp.sum(ld)
+                if guard:
+                    # scale the DIFFERENTIATED loss only (lossvec stays
+                    # user-scale); the host folds 1/scale into
+                    # rescale_grad, so the applied update is unchanged
+                    lsum = lsum * loss_scale
                 return lsum, (ld, tuple(a.data for a in a_nds))
 
             (lsum, (lossvec, new_aux)), grads = jax.value_and_grad(
                 fwd, has_aux=True)(tuple(pdatas), tuple(adatas))
 
-            # optimizer update: run the genuine Optimizer code on NDArray-
-            # wrapped tracers; the registry's mutate hooks write results
-            # back into the wrappers
-            w_nds = [NDArray(a) for a in pdatas]
-            g_nds = [NDArray(g) for g in grads]
-            s_nds = [NDArray(a) for a in sdatas]
-            feed = _ScalarFeed(vector=opt_scalars)
-            # tracing runs the host-side optimizer code once; the per-step
-            # counter bumps belong to _host_scalars, so undo them here
-            saved_counts = (dict(optimizer._index_update_count),
-                            optimizer.num_update)
-            with _OptimTap(feed, execute=True):
-                for j, i in enumerate(step_self._pidx):
-                    state = step_self._regroup_state(state_fmt[j], s_nds)
-                    optimizer.update_multi_precision(
-                        i, w_nds[j], g_nds[j], state)
-            # deliberate trace-time write: this UNDOES the counter bumps
-            # the optimizer made while being traced just above (the real
-            # per-step bumps happen host-side in _host_scalars)
-            optimizer._index_update_count = saved_counts[0]  # mxlint: disable=TS002
-            optimizer.num_update = saved_counts[1]  # mxlint: disable=TS002
-            return (lossvec,
-                    tuple(w.data for w in w_nds),
-                    tuple(a for a in new_aux),
-                    tuple(s.data for s in s_nds))
+            # numerical-health vector: [loss nonfinite?, per-param grad
+            # nonfinite flags] — cheap reductions that fuse into the
+            # backward pass.  Off mode returns a constant (XLA folds it)
+            # so the output arity never changes.
+            if guard:
+                # |g|.sum() is non-finite iff g has any non-finite
+                # element (f32 accumulation: no false overflow), so one
+                # abs-sum per gradient + ONE isfinite over the stacked
+                # scalars replaces per-element isfinite passes — cheaper
+                # for XLA to fuse into the backward
+                probes = jnp.stack(
+                    [lsum.astype(jnp.float32)]
+                    + [jnp.sum(jnp.abs(g.astype(jnp.float32)))
+                       for g in grads])
+                health = (~jnp.isfinite(probes)).astype(jnp.int32)
+                ok = jnp.sum(health) == 0
+            else:
+                health = jnp.zeros((1 + n_p,), dtype=jnp.int32)
+                ok = None
+
+            def _apply_update():
+                # optimizer update: run the genuine Optimizer code on
+                # NDArray-wrapped tracers; the registry's mutate hooks
+                # write results back into the wrappers
+                w_nds = [NDArray(a) for a in pdatas]
+                g_nds = [NDArray(g) for g in grads]
+                s_nds = [NDArray(a) for a in sdatas]
+                feed = _ScalarFeed(vector=opt_scalars)
+                # tracing runs the host-side optimizer code once; the
+                # per-step counter bumps belong to _host_scalars, so
+                # undo them here
+                saved_counts = (dict(optimizer._index_update_count),
+                                optimizer.num_update)
+                with _OptimTap(feed, execute=True):
+                    for j, i in enumerate(step_self._pidx):
+                        state = step_self._regroup_state(state_fmt[j],
+                                                         s_nds)
+                        optimizer.update_multi_precision(
+                            i, w_nds[j], g_nds[j], state)
+                # deliberate trace-time write: this UNDOES the counter
+                # bumps the optimizer made while being traced just above
+                # (the real per-step bumps happen host-side in
+                # _host_scalars)
+                optimizer._index_update_count = saved_counts[0]  # mxlint: disable=TS002
+                optimizer.num_update = saved_counts[1]  # mxlint: disable=TS002
+                return (tuple(w.data for w in w_nds), tuple(new_aux),
+                        tuple(s.data for s in s_nds))
+
+            if guard in ("skip", "escalate"):
+                # on-device bad-step containment: a non-finite loss or
+                # gradient leaves EVERY buffer (params, BN aux, optimizer
+                # state) bitwise unchanged — the step is atomic, no host
+                # round-trip, no recompile.  The WHOLE update runs inside
+                # the lax.cond true branch: the predicate only needs the
+                # gradients, so XLA decides before any training-state
+                # buffer is written and both branches alias their
+                # operands in place — no conditional operand/result
+                # copies and no extra read+write pass over params + aux
+                # + optimizer state (per-buffer where() selects, or a
+                # cond over precomputed updates, would pay one — the old
+                # state must outlive the update to serve as fallback)
+                new_w, new_a, new_s = jax.lax.cond(
+                    ok,
+                    _apply_update,
+                    lambda: (tuple(pdatas), tuple(adatas),
+                             tuple(sdatas)))
+            else:
+                new_w, new_a, new_s = _apply_update()
+            return lossvec, new_w, new_a, new_s, health
 
         # donate params/aux/state buffers: updated in place on device
         # (the reference CachedOp static_alloc analogue); resolved once so
@@ -330,6 +415,13 @@ class FusedTrainStep:
         wd = self._watchdog or _elastic.active_watchdog()
         if wd is not None:
             wd.kick()
+        # drain the PREVIOUS step's health verdict before anything of
+        # this step starts (chaos hooks, loss-scale read, rescale_grad,
+        # input capture): sentinel actions — rescale, rollback, restore
+        # — land at exactly the same step boundary as a synchronous
+        # check would, and a preemption drain below checkpoints
+        # post-recovery state
+        self.check_health()
         if self._preemption is not None:
             self._preemption.check()
         x = x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
@@ -347,9 +439,21 @@ class FusedTrainStep:
                     "divisible by %d devices (pad or drop the ragged "
                     "final batch, or use bucket sizes that divide the "
                     "device count)" % (target, n_dev))
+        step_idx = self._step_idx
+        # chaos hooks (inert without an active plan): SDC model — flip a
+        # seeded parameter bit at the step boundary, and/or poison the
+        # loss scale so every gradient goes non-finite through the real
+        # backward path (both reach the device via existing per-step
+        # inputs, so injection never recompiles)
+        _chaos.flip_param_bit(step_idx, self._trainer._params)
+        scale = (self._sentinel.loss_scale
+                 if self._sentinel is not None else 1.0)
+        scale = _chaos.corrupt_loss_scale(step_idx, scale)
         # Trainer.step parity: normalize grads by the REAL batch size
-        # (pad rows are masked out of the loss, so 1/batch is exact)
-        self._optimizer.rescale_grad = 1.0 / batch
+        # (pad rows are masked out of the loss, so 1/batch is exact);
+        # the loss-scale reciprocal folds in here so the applied update
+        # is mathematically unscaled
+        self._optimizer.rescale_grad = 1.0 / (batch * scale)
         if self._jitted is None:
             # finish any deferred parameter initialization with one eager
             # forward before tracing
@@ -357,7 +461,8 @@ class FusedTrainStep:
                 self._net(x)
             self._build(x, y)
         scalars = np.concatenate([
-            np.asarray([batch], dtype=np.float32), self._host_scalars()])
+            np.asarray([batch, scale], dtype=np.float32),
+            self._host_scalars()])
         pdatas = tuple(p.list_data()[0].data for p in self._params)
         adatas = tuple(a.list_data()[0].data for a in self._auxs)
         state_nds = self._state_nds
@@ -376,7 +481,7 @@ class FusedTrainStep:
             adatas = tuple(jax.device_put(a, repl) for a in adatas)
             sdatas = tuple(jax.device_put(s, repl) for s in sdatas)
         rng = _random.next_key()
-        lossvec, new_p, new_a, new_s = self._jitted(
+        lossvec, new_p, new_a, new_s, health = self._jitted(
             rng, jnp.asarray(scalars), xd, yd, pdatas, adatas, sdatas)
         for p, d in zip(self._params, new_p):
             p.list_data()[0]._set_data(d)
@@ -385,11 +490,42 @@ class FusedTrainStep:
         for s, d in zip(state_nds, new_s):
             s._set_data(d)
         if self._donate and self._dp is None:
-            self._invalidate_donated(pdatas + adatas + sdatas,
-                                     new_p + new_a + new_s + (lossvec,))
+            self._invalidate_donated(
+                pdatas + adatas + sdatas,
+                new_p + new_a + new_s + (lossvec, health))
+        if self._sentinel is not None and self._guard_mode:
+            # deferred one step: np.asarray(health) is a device sync,
+            # and fetching THIS step's vector here would serialize every
+            # dispatch behind the step it just issued.  The verdict is
+            # read at the top of the NEXT call instead — before that
+            # step's inputs are captured — so the device pipeline stays
+            # full and sentinel actions still land at the same step
+            # boundary a synchronous check would hit.  Containment does
+            # not wait for the host: a bad step was already left bitwise
+            # unchanged by the in-trace lax.cond.  check_health() drains
+            # the tail after the last step of a loop.
+            self._pending_health = (step_idx, health)
+        self._step_idx = step_idx + 1
         if target != batch and lossvec.ndim:
             lossvec = lossvec[:batch]
         return _wrap(lossvec)
+
+    def check_health(self):
+        """Observe the most recent step's health vector now.
+
+        The per-step check is deferred by one step so the host never
+        blocks on the device mid-loop; call this after the final step
+        (or before reading params for a checkpoint) to flush the tail.
+        No-op when nothing is pending.  May trigger the full escalation
+        ladder, including ``sys.exit(NUMERIC_EXIT_CODE)``.
+        """
+        if self._pending_health is None:
+            return
+        step_i, health = self._pending_health
+        self._pending_health = None
+        h = np.asarray(health)
+        self._sentinel.observe(step_i, int(h[0]), h[1:],
+                               [p.name for p in self._params])
 
     @staticmethod
     def _invalidate_donated(ins, outs):

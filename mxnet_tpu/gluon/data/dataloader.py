@@ -38,9 +38,16 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, bucket=None, seed=None):
+                 thread_pool=False, bucket=None, seed=None,
+                 skip_corrupt=False):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        # skip_corrupt: a sample whose fetch raises IOError (e.g. a
+        # recordio CorruptRecordError) is dropped from the batch with a
+        # warning + `corrupt_records` dispatch counter bump instead of
+        # aborting the epoch; a batch where EVERY sample fails still
+        # raises (the data source is gone, not merely pitted)
+        self._skip_corrupt = bool(skip_corrupt)
         # bucket: pad the ragged final batch's leading dim up to a shape
         # bucket so jitted consumers compile once per bucket (None → the
         # MXNET_SHAPE_BUCKETS knob; False disables; else a spec like
@@ -174,11 +181,34 @@ class DataLoader:
         self._in_epoch = False
         self._epoch_sampler_state = None
 
+    def _fetch_samples(self, batch):
+        """Fetch one batch of samples; with ``skip_corrupt`` a failing
+        sample is skipped-and-counted rather than killing the epoch."""
+        if not self._skip_corrupt:
+            return [self._dataset[i] for i in batch]
+        import logging
+
+        from ... import profiler as _prof
+
+        samples, failed = [], 0
+        for i in batch:
+            try:
+                samples.append(self._dataset[i])
+            except IOError as e:
+                failed += 1
+                _prof.dispatch_count("corrupt_records")
+                logging.getLogger(__name__).warning(
+                    "skipping corrupt/unreadable record %s: %s", i, e)
+        if not samples:
+            raise IOError("DataLoader: all %d records of a batch failed "
+                          "to read — data source unavailable" % failed)
+        return samples
+
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._index_batches():
                 out = self._maybe_pad(
-                    self._batchify_fn([self._dataset[i] for i in batch]))
+                    self._batchify_fn(self._fetch_samples(batch)))
                 # count BEFORE yielding: the generator suspends at yield,
                 # so a post-yield increment would lag one batch behind
                 # what the consumer has already trained on
@@ -191,7 +221,7 @@ class DataLoader:
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             def fetch(batch):
                 return self._maybe_pad(
-                    self._batchify_fn([self._dataset[i] for i in batch]))
+                    self._batchify_fn(self._fetch_samples(batch)))
 
             batches = self._index_batches()
             pending = []

@@ -21,7 +21,8 @@ class Trainer:
     (reference: gluon/trainer.py:27)."""
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None, donate=None):
+                 compression_params=None, update_on_kvstore=None, donate=None,
+                 numeric_guard=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -52,6 +53,12 @@ class Trainer:
         # MXNET_DONATE_BUFFERS knob at each step; True/False pins it
         self._donate = donate
         self._preemption = None
+        # numerical-health guard for the eager step path (None defers to
+        # the MXNET_NUMERIC_GUARD knob, resolved lazily at first step)
+        self._numeric_guard = numeric_guard
+        self._sentinel = None
+        self._sentinel_ready = False
+        self._step_count = 0
 
     @property
     def _optimizer(self):
@@ -128,16 +135,79 @@ class Trainer:
         self._preemption = handler
         return self
 
+    def attach_sentinel(self, sentinel):
+        """Attach a configured :class:`mxnet_tpu.sentinel.HealthSentinel`
+        (scaler, rollback ring, divergence detector, escalation policy);
+        every :meth:`step` then checks gradient finiteness BEFORE the
+        update and skips/escalates per the sentinel's mode.  Pass None to
+        detach (and fall back to the MXNET_NUMERIC_GUARD knob)."""
+        self._sentinel = sentinel
+        self._sentinel_ready = sentinel is not None
+        return self
+
+    def _sentinel_for_step(self):
+        if not self._sentinel_ready:
+            self._sentinel_ready = True
+            from .. import sentinel as _sentinel_mod
+
+            mode = _sentinel_mod.guard_mode(self._numeric_guard)
+            if mode:
+                self._sentinel = _sentinel_mod.HealthSentinel(
+                    trainer=self, mode=mode)
+        # a kvstore-resident optimizer applies updates server-side during
+        # push, before the host could veto them — the guard cannot make
+        # the step atomic there, so it stands down
+        return None if self._update_on_kvstore else self._sentinel
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update: rescale by 1/batch_size, reduce grads
-        across devices, apply updates (reference: trainer.py:302)."""
+        across devices, apply updates (reference: trainer.py:302).
+
+        With a numerical-health guard active (``numeric_guard=`` /
+        MXNET_NUMERIC_GUARD / :meth:`attach_sentinel`), one fused
+        finiteness reduction runs over every gradient after the
+        all-reduce; a non-finite step skips the update (params bitwise
+        unchanged) and feeds the sentinel's escalation ladder."""
         if self._preemption is not None:
             self._preemption.check()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        sentinel = self._sentinel_for_step()
+        if sentinel is None:
+            self._update(ignore_stale_grad)
+            return
+        from .. import chaos as _chaos
+        from .. import sentinel as _sentinel_mod
+        import numpy as _np
+
+        step_idx = self._step_count
+        self._step_count = step_idx + 1
+        gparams = [p for p in self._params if p.grad_req != "null"]
+        if _chaos.active() is not None:
+            _chaos.flip_param_bit(step_idx, self._params)
+            _chaos.poison_grad(step_idx, gparams)
+        grads = [g for p in gparams for g in p.list_grad()]
+        counts = _sentinel_mod.nonfinite_counts(grads) if grads \
+            else _np.zeros(0, _np.int32)
+        # replicas of one param each contributed a slot: fold them back
+        # to per-param attribution
+        per_param, k = [], 0
+        for p in gparams:
+            n = len(p.list_grad())
+            per_param.append(int(counts[k:k + n].sum()))
+            k += n
+        names = [p.name for p in gparams]
+        if any(per_param):
+            action = sentinel.observe(step_idx, 0, per_param, names)
+            if action == "warn":
+                self._update(ignore_stale_grad)
+            return  # any other action: update skipped, params unchanged
         self._update(ignore_stale_grad)
+        # good-step bookkeeping AFTER the update so ring snapshots
+        # capture post-step state (matching the fused path)
+        sentinel.observe(step_idx, 0, per_param, names)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -1,4 +1,4 @@
-"""mxlint builtin rules: trace-safety (TS001–TS005) and concurrency
+"""mxlint builtin rules: trace-safety (TS001–TS006) and concurrency
 (CC001–CC002).
 
 Traced-region model
@@ -708,6 +708,92 @@ def check_use_after_donate(ctx):
                            "RETURN value, .copy() before donating, or "
                            "dispatch.no_donation()." % (n.id, at))
                     donated.pop(n.id, None)  # one finding per donation
+
+
+# reductions whose result can be exactly 0 (empty/masked/underflowed
+# input) — dividing by one, or taking log/sqrt of one, is the classic
+# silent-NaN factory inside compiled op code
+REDUCTION_NAMES = frozenset({"sum", "mean", "prod", "norm", "var", "std",
+                             "min", "max", "amin", "amax",
+                             "count_nonzero"})
+# math entry points that are non-finite at 0 (or negative) input
+UNSAFE_AT_ZERO = frozenset({"log", "log2", "log10", "sqrt", "rsqrt",
+                            "reciprocal"})
+
+
+@register_rule("TS006", Severity.WARNING,
+               "unguarded division/log on a traced reduction")
+def check_unguarded_math(ctx):
+    """Dividing by — or taking ``log``/``sqrt`` of — the raw result of a
+    reduction (``sum``/``mean``/``norm``/``max``/…) over traced data is
+    how NaNs are born inside compiled ops: an all-masked batch, an
+    underflowed bf16 accumulation, or an empty slice makes the reduction
+    exactly 0, the division mints inf/NaN, and XLA happily propagates it
+    into the parameters (no exception is ever raised under jit).  Guard
+    the denominator/argument: ``maximum(d, eps)``, ``clip``, ``d + eps``,
+    ``where(d != 0, d, 1)``, or ``nan_to_num`` — any wrapping guard
+    silences this rule."""
+    for fn in ctx.traced_defs():
+        tainted = ctx.tainted_names(fn)
+        if not tainted:
+            continue
+
+        def reduction_call(node):
+            if not isinstance(node, ast.Call):
+                return False
+            if _terminal_name(node.func) not in REDUCTION_NAMES:
+                return False
+            if isinstance(node.func, ast.Attribute) and \
+                    ctx.expr_tainted(node.func.value, tainted):
+                return True  # x.sum() method form
+            return any(ctx.expr_tainted(a, tainted) for a in node.args)
+
+        # one ordered pass: track names currently bound to a BARE
+        # reduction result (rebinding to anything else — including a
+        # guarded expression — clears the name)
+        red_names = set()
+
+        def risky(node):
+            return reduction_call(node) or (
+                isinstance(node, ast.Name) and node.id in red_names)
+
+        for node in sorted(
+                _walk_skip_nested(fn),
+                key=lambda n: (getattr(n, "lineno", 0),
+                               getattr(n, "col_offset", 0))):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div) \
+                    and risky(node.right):
+                yield (node, None,
+                       "dividing by a raw reduction result inside traced "
+                       "function %r: a fully-masked/empty/underflowed "
+                       "input makes it exactly 0 and the compiled step "
+                       "mints inf/NaN silently. Guard the denominator "
+                       "(maximum(d, eps), d + eps, where(d != 0, d, 1))."
+                       % fn.name)
+            elif isinstance(node, ast.Call) and \
+                    _terminal_name(node.func) in UNSAFE_AT_ZERO and \
+                    node.args and risky(node.args[0]):
+                yield (node, None,
+                       "%s() of a raw reduction result inside traced "
+                       "function %r is non-finite at 0: an empty or "
+                       "fully-masked input NaNs the compiled step "
+                       "silently. Clamp first (maximum(x, eps) / clip)."
+                       % (_terminal_name(node.func), fn.name))
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)) and node.value is not None:
+                is_red = reduction_call(node.value) or (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in red_names)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    for t in ast.walk(tgt):
+                        if isinstance(t, ast.Name) and isinstance(
+                                t.ctx, ast.Store):
+                            if is_red:
+                                red_names.add(t.id)
+                            else:
+                                red_names.discard(t.id)
 
 
 # ===========================================================================

@@ -14,7 +14,24 @@ import re
 
 import numpy as np
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "notify_nonfinite"]
+
+# monitors that asked to receive sentinel events (Monitor.install adds)
+_installed = []
+
+
+def notify_nonfinite(step, names, monitor=None):
+    """Sentinel → monitor bridge: report ONE deduplicated event per bad
+    step (not one per array — the sentinel already aggregated the
+    per-parameter non-finite counts) carrying the step index and the
+    offending parameter names.  Delivered to ``monitor`` if given, else
+    to every installed :class:`Monitor`; always logged."""
+    targets = [monitor] if monitor is not None else list(_installed)
+    for m in targets:
+        m.notify_nonfinite(step, names)
+    if not targets:
+        logging.getLogger(__name__).warning(
+            "non-finite step %d (%s)", step, ", ".join(names) or "?")
 
 
 class Monitor:
@@ -37,6 +54,10 @@ class Monitor:
         self.step = 0
         self.activated = False
         self._exes = []
+        self.nonfinite_events = []   # [(step, names)] — deduped, bounded
+        self._nonfinite_steps_seen = set()
+        if self not in _installed:
+            _installed.append(self)
 
     # -- executor hookup -------------------------------------------------
     def install(self, exe):
@@ -50,8 +71,31 @@ class Monitor:
         for i, o in enumerate(outputs):
             full = name if len(outputs) == 1 else "%s_output%d" % (name, i)
             if self.re_pattern.match(full):
-                self.queue.append((self.step, full,
-                                   self.stat_func(np.asarray(o))))
+                host = np.asarray(o)
+                self.queue.append((self.step, full, self.stat_func(host)))
+                # nonfinite taps are deduped against the sentinel: the
+                # sentinel reports the whole step once via
+                # notify_nonfinite, so _tap never re-reports arrays from
+                # a step that already has an event
+                if (self.step not in self._nonfinite_steps_seen
+                        and host.dtype.kind == "f"
+                        and not np.isfinite(host).all()):
+                    self.notify_nonfinite(self.step, [full])
+
+    # -- sentinel events --------------------------------------------------
+    def notify_nonfinite(self, step, names):
+        """One event per bad step, whoever reports first (sentinel wins
+        on the fused path — it runs before any eager tap); duplicates
+        for an already-seen step are dropped."""
+        step = int(step)
+        if step in self._nonfinite_steps_seen:
+            return
+        self._nonfinite_steps_seen.add(step)
+        self.nonfinite_events.append((step, tuple(names)))
+        del self.nonfinite_events[:-256]
+        logging.getLogger(__name__).warning(
+            "Batch: %7d non-finite values in: %s",
+            step, ", ".join(names) or "?")
 
     # -- batch lifecycle (reference tic/toc/toc_print) -------------------
     def tic(self):

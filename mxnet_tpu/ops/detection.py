@@ -204,8 +204,10 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
             # background prob (the reference sorts by -p(background))
             logits = cls_p.T                              # [N, C]
             m = logits.max(axis=1, keepdims=True)
-            prob_bg = jnp.exp(logits[:, 0] - m[:, 0]) / \
-                jnp.exp(logits - m).sum(axis=1)
+            # the shifted-softmax denominator is >= exp(0) = 1 by
+            # construction (m is the row max), so it can never be 0
+            prob_bg = (jnp.exp(logits[:, 0] - m[:, 0])  # mxlint: disable=TS006
+                       / jnp.exp(logits - m).sum(axis=1))
             cand = (~positive) & (match_iou < negative_mining_thresh)
             score = jnp.where(cand, -prob_bg, -jnp.inf)
             order = jnp.argsort(-score)                   # hardest first

@@ -316,8 +316,11 @@ def _lamb_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     update = mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight
     wnorm = jnp.linalg.norm(weight)
     unorm = jnp.linalg.norm(update)
+    # maximum() keeps the untaken where-branch finite: with unorm == 0 a
+    # bare division mints inf that where must mask (and that TS006 flags)
     trust = jnp.where(jnp.logical_and(wnorm > 0, unorm > 0),
-                      jnp.clip(wnorm, lower_bound, upper_bound) / unorm, 1.0)
+                      jnp.clip(wnorm, lower_bound, upper_bound)
+                      / jnp.maximum(unorm, 1e-12), 1.0)
     return weight - lr * trust * update, m, v
 
 

@@ -241,7 +241,11 @@ def _norm(x, ord=2, axis=None, keepdims=False):
     ax = _axis(axis)
     if ord == 1:
         return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
-    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+    # sqrt of a sum of squares is finite everywhere (the sum is >= 0 and
+    # sqrt(0) = 0); the inf GRADIENT of norm at exactly 0 is reference
+    # parity, so the value stays unclamped deliberately
+    return jnp.sqrt(  # mxlint: disable=TS006
+        jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
 
 
 def _square_sum_core(x, axis=None, keepdims=False):

@@ -3,6 +3,7 @@ from .optimizer import (Optimizer, Updater, get_updater, create, register,  # no
                         SGD, Signum, SignSGD, FTML, DCASGD, NAG, SGLD, Adam,
                         AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam,
                         AdamW, LBSGD, LAMB, Test)
+from .loss_scaler import DynamicLossScaler  # noqa: F401
 from . import contrib  # noqa: F401
 
 opt_registry = Optimizer.opt_registry

@@ -145,7 +145,11 @@ _NULL = _Null()
 # also lands as a chrome-trace counter event.
 _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "op_recompile", "donated_bytes", "bucket_padded_batches",
-                  "host_sync", "trace_guard")
+                  "host_sync", "trace_guard",
+                  # numerical-health sentinel + chaos harness
+                  # (docs/NUMERICAL_HEALTH.md)
+                  "nonfinite_steps", "rollbacks", "divergence_checks",
+                  "faults_injected", "corrupt_records", "io_retries")
 _dispatch = {k: 0 for k in _DISPATCH_KEYS}
 
 

@@ -13,13 +13,23 @@ from __future__ import annotations
 
 import collections
 import io as _pyio
+import logging
 import os
 import struct
+import time
 
 import numpy as np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "CorruptRecordError"]
+
+
+class CorruptRecordError(IOError):
+    """The record at the current offset violates the framing protocol
+    (bad magic, torn multi-part sequence, truncation) — the DATA is bad,
+    so retrying the read cannot help.  Subclasses IOError for backwards
+    compatibility; the read-retry path re-raises it immediately, and the
+    DataLoader's ``skip_corrupt`` mode skips-and-counts it."""
 
 _MAGIC = 0xCED7230A
 _LEN_MASK = (1 << 29) - 1
@@ -165,7 +175,45 @@ class MXRecordIO:
             self._write_chunk(3, parts[-1])
 
     def read(self):
+        """Read the next record, retrying TRANSIENT failures.
+
+        A plain OSError (flaky network filesystem, preempted mount) is
+        retried up to ``MXTPU_IO_RETRIES`` times (default 3) with capped
+        exponential backoff starting at ``MXTPU_IO_BACKOFF`` seconds —
+        the file is reopened and re-seeked to the pre-read offset, and
+        each retry bumps the ``io_retries`` dispatch counter.
+        :class:`CorruptRecordError` (the data itself is bad) is never
+        retried — callers skip-and-count or abort."""
         assert not self.writable
+        from . import profiler as _prof
+
+        retries = int(os.environ.get("MXTPU_IO_RETRIES", "3"))
+        backoff = float(os.environ.get("MXTPU_IO_BACKOFF", "0.05"))
+        pos = self.tell()
+        attempt = 0
+        while True:
+            try:
+                if self._h is None and self.fp is None:
+                    self.open()
+                    self._seek(pos)
+                return self._read_once()
+            except CorruptRecordError:
+                raise
+            except OSError as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                _prof.dispatch_count("io_retries")
+                logging.getLogger(__name__).warning(
+                    "transient read failure on %s at offset %d (%s) — "
+                    "retry %d/%d", self.uri, pos, e, attempt, retries)
+                time.sleep(min(1.0, backoff * (2 ** (attempt - 1))))
+                try:
+                    self.close()  # next loop iteration reopens + seeks
+                except OSError:
+                    pass
+
+    def _read_once(self):
         if self._h is not None:
             import ctypes
 
@@ -176,7 +224,8 @@ class MXRecordIO:
             if rc == 1:
                 return None
             if rc != 0:
-                raise IOError(_NATIVE.rio_last_error().decode())
+                raise CorruptRecordError(
+                    _NATIVE.rio_last_error().decode())
             try:
                 return ctypes.string_at(buf, blen.value)
             finally:
@@ -187,12 +236,13 @@ class MXRecordIO:
             hdr = self.fp.read(8)
             if len(hdr) < 8:
                 if out is not None:
-                    raise IOError("truncated multi-part record at EOF")
+                    raise CorruptRecordError(
+                        "truncated multi-part record at EOF")
                 return None
             magic, lrec = struct.unpack("<II", hdr)
             if magic != _MAGIC:
-                raise IOError("invalid RecordIO magic at offset %d"
-                              % (self.fp.tell() - 8))
+                raise CorruptRecordError("invalid RecordIO magic at offset "
+                                         "%d" % (self.fp.tell() - 8))
             cflag = lrec >> 29
             n = lrec & _LEN_MASK
             buf = self.fp.read(n)
@@ -201,16 +251,17 @@ class MXRecordIO:
                 self.fp.read(pad)
             if cflag == 0:
                 if out is not None:
-                    raise IOError("unexpected whole record inside "
-                                  "multi-part record")
+                    raise CorruptRecordError("unexpected whole record inside "
+                                             "multi-part record")
                 return buf
             if cflag == 1:
                 if out is not None:
-                    raise IOError("begin part inside multi-part record "
-                                  "(lost end part?)")
+                    raise CorruptRecordError("begin part inside multi-part "
+                                             "record (lost end part?)")
                 out = bytearray(buf)
             elif out is None:
-                raise IOError("continuation part without a begin part")
+                raise CorruptRecordError(
+                    "continuation part without a begin part")
             else:
                 out += magic_bytes
                 out += buf

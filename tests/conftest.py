@@ -69,6 +69,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running variant excluded from the tier-1 "
         "gate (run explicitly with -m slow)")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection scenario (the chaos "
+        "harness; run the full matrix with `make chaos` / "
+        "ci/runtime_functions.sh chaos_check)")
 
 
 def pytest_terminal_summary(terminalreporter):

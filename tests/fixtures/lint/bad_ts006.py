@@ -1,0 +1,12 @@
+"""TS006 bad: unguarded division/log/sqrt on raw reduction results."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def normalize(x, mask):
+    denom = mask.sum()
+    x = x / denom                      # denom can be exactly 0
+    probs = x / x.sum()                # direct reduction denominator
+    ent = -(probs * jnp.log(probs.max())).sum()
+    return ent, jnp.sqrt(x.var())

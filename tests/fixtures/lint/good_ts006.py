@@ -1,0 +1,15 @@
+"""TS006 good: every reduction feeding a division/log/sqrt is guarded."""
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-8
+
+
+@jax.jit
+def normalize(x, mask):
+    denom = jnp.maximum(mask.sum(), 1.0)      # clamp kills the hazard
+    x = x / denom
+    probs = x / (x.sum() + EPS)               # + eps guard
+    safe = jnp.where(probs.max() > 0, probs.max(), 1.0)
+    ent = -(probs * jnp.log(safe)).sum()
+    return ent, jnp.sqrt(jnp.clip(x.var(), EPS, None))

@@ -202,6 +202,7 @@ def test_large_vocab_embedding():
     assert "OK" in out, out
 
 
+@pytest.mark.slow  # ~2 min on the CPU oracle; integration_examples runs it
 def test_large_vocab_embedding_dist():
     """The same flagship large-embedding flow across 2 workers via the
     server-side sparse reduce (VERDICT r3 missing #5): both ranks
@@ -222,6 +223,7 @@ def test_large_vocab_embedding_dist():
     assert "OK rank=0" in r.stdout and "OK rank=1" in r.stdout, r.stdout
 
 
+@pytest.mark.slow  # ~2 min on the CPU oracle; integration_examples runs it
 def test_train_imagenet(tmp_path):
     """ImageNet-shaped driver (VERDICT r2 missing #4): full-aug record
     pipeline + stepped-lr fit + checkpoint/resume on synthetic JPEGs."""

@@ -23,7 +23,8 @@ from mxnet_tpu.lint import (RULES, Severity, format_json, format_text,
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
-ALL_RULES = ("TS001", "TS002", "TS003", "TS004", "TS005", "CC001", "CC002")
+ALL_RULES = ("TS001", "TS002", "TS003", "TS004", "TS005", "TS006",
+             "CC001", "CC002")
 
 
 def _rules_hit(findings):
