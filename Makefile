@@ -9,6 +9,7 @@
 #   make test-fast  -> quick shard (operators + ndarray + autograd)
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
+#   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
 
@@ -35,10 +36,13 @@ lint:
 chaos:
 	bash ci/runtime_functions.sh chaos_check
 
+serve-smoke:
+	bash ci/runtime_functions.sh serving_check
+
 ci:
 	bash ci/runtime_functions.sh all
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke ci clean

@@ -238,6 +238,17 @@ def main(argv=None):
                 infer_img_s / INFER_BASELINE_IMG_S, 4),
         })
 
+    # ---- serving front (overload-safe layer, docs/SERVING.md) ----
+    # p50/p99 request latency + shed rate through ModelServer, and the
+    # steady-state p99 overhead of the serving front (admission queue +
+    # batcher + breaker bookkeeping) over a bare Predictor.forward loop
+    if os.environ.get("BENCH_SERVING", "1") != "0" and \
+            _leg_ok(extra, "serving", need=20 if quick else 45):
+        try:
+            extra.update(serving_bench(quick=quick))
+        except Exception as e:  # secondary metric must not sink the run
+            extra["serving_error"] = "%s: %s" % (type(e).__name__, e)
+
     # secondary legs: skipped wholesale in quick mode, and individually
     # when the remaining budget can't plausibly cover them
     if not quick:
@@ -273,6 +284,106 @@ def main(argv=None):
 
     extra["dispatch"] = profiler.dispatch_stats()
     extra["elapsed_s"] = round(time.monotonic() - _T0, 1)
+
+
+def serving_bench(quick=False):
+    """Serving-front leg (docs/SERVING.md): batch-1 request latency
+    p50/p99 through :class:`mxnet_tpu.serving.ModelServer` vs the bare
+    ``Predictor.forward`` loop on the SAME model in the SAME process
+    (drift-immune overhead reading), plus the shed rate under a
+    synthetic burst at 4x the admission cap."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.predict import Predictor
+
+    n_req = 100 if quick else 400
+    max_queue = 32
+    rng = np.random.RandomState(0)
+
+    # small MLP: the front's overhead is model-independent bookkeeping,
+    # so a short forward makes the p99 delta legible instead of noise
+    d_in, d_h = 64, 256
+    data = mx.sym.var("data")
+    w1, b1 = mx.sym.var("fc1_weight"), mx.sym.var("fc1_bias")
+    w2, b2 = mx.sym.var("fc2_weight"), mx.sym.var("fc2_bias")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, w1, b1, num_hidden=d_h, name="fc1"),
+        act_type="relu")
+    sym = mx.sym.FullyConnected(h, w2, b2, num_hidden=8, name="fc2")
+    params = {
+        "arg:fc1_weight": mx.nd.array(
+            (rng.rand(d_h, d_in) * 0.1).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.zeros((d_h,)),
+        "arg:fc2_weight": mx.nd.array(
+            (rng.rand(8, d_h) * 0.1).astype(np.float32)),
+        "arg:fc2_bias": mx.nd.zeros((8,)),
+    }
+    xs = [rng.rand(1, d_in).astype(np.float32) for _ in range(16)]
+
+    def pctl(lat_s, q):
+        return round(float(np.percentile(np.asarray(lat_s), q)) * 1e3, 3)
+
+    # -- bare Predictor loop (the overhead baseline) --
+    bare = Predictor(sym, dict(params), input_shapes={"data": (1, d_in)})
+    for x in xs:
+        bare.forward(data=mx.nd.array(x))[0].asnumpy()  # warm
+    bare_lat = []
+    for i in range(n_req):
+        t0 = time.perf_counter()
+        bare.forward(data=mx.nd.array(xs[i % len(xs)]))[0].asnumpy()
+        bare_lat.append(time.perf_counter() - t0)
+
+    out = {"serving_bare_p50_ms": pctl(bare_lat, 50),
+           "serving_bare_p99_ms": pctl(bare_lat, 99)}
+
+    # -- steady state through the serving front (no faults) --
+    # max_wait 0: a closed-loop sequential client would otherwise spend
+    # every request waiting out the batching timer, which would read as
+    # front overhead when it is really idle batching slack
+    srv = serving.ModelServer(sym, dict(params),
+                              input_shapes={"data": (1, d_in)},
+                              max_queue=max_queue, max_batch=8,
+                              max_wait_ms=0, deadline_ms=30_000)
+    try:
+        for x in xs:
+            srv.submit({"data": x})  # settle the EWMA + caches
+        lat = []
+        for i in range(n_req):
+            t0 = time.perf_counter()
+            srv.submit({"data": xs[i % len(xs)]})
+            lat.append(time.perf_counter() - t0)
+        out["serving_p50_ms"] = pctl(lat, 50)
+        out["serving_p99_ms"] = pctl(lat, 99)
+        out["serving_overhead_p99_pct"] = round(
+            (out["serving_p99_ms"] / max(out["serving_bare_p99_ms"], 1e-9)
+             - 1.0) * 100.0, 1)
+
+        # -- burst at 4x the admission cap: shedding, not collapse --
+        futs, shed = [], 0
+        offered = 4 * max_queue
+        for i in range(offered):
+            try:
+                futs.append(srv.submit_async(
+                    {"data": xs[i % len(xs)]}, deadline_ms=30_000))
+            except serving.Overloaded:
+                shed += 1
+        burst_lat = []
+        for f in futs:
+            f.result(timeout=60)
+            burst_lat.append(f.latency_s())
+        out["serving_burst_offered"] = offered
+        out["serving_shed_rate"] = round(shed / offered, 4)
+        out["serving_burst_p99_ms"] = pctl(burst_lat, 99)
+        snap = srv.snapshot()
+        out["serving_queue_depth_peak"] = snap["queue_depth_peak"]
+        out["serving_batches"] = {
+            k: snap[k] for k in ("batches_full", "batches_timer",
+                                 "batches_deadline")}
+    finally:
+        srv.drain(timeout=30)
+    return out
 
 
 def int8_bench(batch=128, steps=30, bf16_img_s=None):

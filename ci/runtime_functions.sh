@@ -115,6 +115,22 @@ unittest_serving() {
         tests/test_onnx.py -q
 }
 
+serving_check() {
+    # Overload-safe serving front (docs/SERVING.md): admission/shedding,
+    # deadline batching, hedging, circuit breaker, SIGTERM drain (rc 76),
+    # hot-swap reload, and the chaos acceptance scenario (replica_crash +
+    # request_burst: every admitted request gets exactly one typed
+    # terminal outcome, queue depth bounded, breaker recovers).
+    python -m pytest tests/test_serving.py -q
+    # the serving module must lint clean — NO suppressions: the batcher
+    # holds a lock, so a single CC001 slip is a latency cliff
+    python -m mxnet_tpu.lint mxnet_tpu/serving.py
+    if grep -n "mxlint: disable" mxnet_tpu/serving.py; then
+        echo "serving.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 integration_examples() {
     python -m pytest tests/test_examples.py tests/test_tools.py -q
 }
@@ -159,6 +175,7 @@ all() {
     unittest_frontend
     unittest_parallel
     unittest_serving
+    serving_check
     unittest_dtype_sweep
     integration_examples
     chaos_check

@@ -70,6 +70,18 @@ _DEF_BACKOFF_CAP = float(os.environ.get("MXTPU_KV_BACKOFF_CAP", "2.0"))
 _DEF_REAP_S = float(os.environ.get("MXTPU_KV_REAP_S", "600"))
 
 
+def backoff_delay(attempt, base, cap, jitter=True):
+    """Bounded exponential backoff for retry ``attempt`` (0-based):
+    ``min(cap, base * 2**attempt)``, scaled by uniform [0.5, 1.0) jitter
+    to decorrelate a gang of clients retrying off the same fault.  The
+    shared retry policy of this transport and the serving circuit
+    breaker (:class:`mxnet_tpu.serving.CircuitBreaker`)."""
+    d = min(float(cap), float(base) * (2.0 ** attempt))
+    if jitter:
+        d *= 0.5 + 0.5 * _pyrandom.random()
+    return d
+
+
 def _send_msg(sock, obj):
     blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(struct.pack("<Q", len(blob)) + blob)
@@ -405,9 +417,8 @@ class AsyncKVClient:
                         raise ConnectionError(
                             "async-KV call %r failed after %d retries: %s"
                             % (op, self._retries, last_err)) from last_err
-                    delay = min(self._backoff_cap,
-                                self._backoff * (2.0 ** attempt)) \
-                        * (0.5 + 0.5 * _pyrandom.random())
+                    delay = backoff_delay(attempt, self._backoff,
+                                          self._backoff_cap)
                     time.sleep(delay)  # mxlint: disable=CC001 -- see above
         if isinstance(reply, Exception):
             raise reply

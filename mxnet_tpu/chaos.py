@@ -30,6 +30,13 @@ Faults (each firing bumps the ``faults_injected`` dispatch counter):
                     (via :func:`corrupt_checkpoint`)
 ``ckpt_bitflip@N``  flip one seeded bit in checkpoint step N's params file
 ``loader_raise@N``  ``ChaosDataset`` raises on its Nth record fetch
+``slow_replica@N``  serving: the Nth model execution stalls ~250ms before
+                    running (straggler — exercises hedging/deadlines)
+``replica_crash@N`` serving: the Nth model execution raises
+                    :class:`InjectedReplicaCrash` (exercises failover +
+                    circuit breaker)
+``request_burst@N`` serving: the Nth load-generator wave is multiplied
+                    8x (overload — exercises shedding/bounded queue)
 ==================  ========================================================
 
 Every fault fires at most once per process (deterministic, idempotent
@@ -46,12 +53,21 @@ import numpy as np
 
 __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "corrupt_loss_scale", "poison_grad", "flip_param_bit",
-           "arm_kv_client", "corrupt_checkpoint", "FAULT_KINDS"]
+           "arm_kv_client", "corrupt_checkpoint", "FAULT_KINDS",
+           "slow_replica", "replica_crash", "request_burst",
+           "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
     "nan_grad", "bitflip_param", "kv_drop", "kv_delay", "kv_dup",
     "ckpt_truncate", "ckpt_bitflip", "loader_raise",
+    "slow_replica", "replica_crash", "request_burst",
 })
+
+
+class InjectedReplicaCrash(RuntimeError):
+    """The failure :func:`replica_crash` raises inside a serving replica
+    execution — caught by the serving layer's failover path like any
+    real replica fault."""
 
 
 def _count_fault():
@@ -280,6 +296,39 @@ def corrupt_checkpoint(manager, step=None, mode="truncate"):
     else:
         raise ValueError("mode must be 'truncate' or 'bitflip'")
     return step
+
+
+# ---------------------------------------------------------------------------
+# serving fault hooks (mxnet_tpu.serving worker loop / load generators)
+# ---------------------------------------------------------------------------
+def slow_replica(n, delay=0.25):
+    """``slow_replica@N``: seconds the Nth model execution should stall
+    before running (0.0 otherwise).  The serving worker sleeps OUTSIDE
+    every lock, then executes normally — a straggler, not a failure."""
+    plan = active()
+    if plan is not None and plan.fire("slow_replica", n):
+        return float(delay)
+    return 0.0
+
+
+def replica_crash(n):
+    """``replica_crash@N``: raise :class:`InjectedReplicaCrash` in place
+    of the Nth model execution — feeds the serving failover + circuit
+    breaker exactly like a real replica fault."""
+    plan = active()
+    if plan is not None and plan.fire("replica_crash", n):
+        raise InjectedReplicaCrash("chaos: injected crash on serving "
+                                   "execution %d" % n)
+
+
+def request_burst(n, factor=8):
+    """``request_burst@N``: multiplier for the Nth load-generator wave
+    (1 otherwise) — the traffic spike the bounded admission queue must
+    shed, not absorb."""
+    plan = active()
+    if plan is not None and plan.fire("request_burst", n):
+        return int(factor)
+    return 1
 
 
 class ChaosDataset:

@@ -116,9 +116,10 @@ class _Config:
              "harness (mxnet_tpu.chaos), e.g. "
              "'seed=7,nan_grad@3,kv_drop@5'. Faults: nan_grad, "
              "bitflip_param, kv_drop, kv_delay, kv_dup, ckpt_truncate, "
-             "ckpt_bitflip, loader_raise. Each firing bumps the "
-             "faults_injected dispatch counter. '' disables. Testing "
-             "only — never set in production."),
+             "ckpt_bitflip, loader_raise, slow_replica, replica_crash, "
+             "request_burst (serving — docs/SERVING.md). Each firing "
+             "bumps the faults_injected dispatch counter. '' disables. "
+             "Testing only — never set in production."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

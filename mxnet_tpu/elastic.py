@@ -359,6 +359,7 @@ class PreemptionHandler:
         self._signal_count = 0
         self._prev = {}
         self._installed = False
+        self._callbacks = []
 
     def install(self):
         """Register the signal handlers (main thread only — CPython
@@ -374,11 +375,25 @@ class PreemptionHandler:
         self._prev.clear()
         self._installed = False
 
+    def add_callback(self, fn):
+        """Register ``fn`` to run from the signal handler on the FIRST
+        drain signal (e.g. ``ModelServer._drain_flag.set`` so admission
+        closes immediately, before the step boundary).  ``fn`` runs in
+        signal-handler context: it must be async-signal safe — an atomic
+        flag/Event set, never lock acquisition or I/O."""
+        self._callbacks.append(fn)
+        return self
+
     def _on_signal(self, signum, frame):
         self._signal_count += 1
         if self._signal_count > 1:
             os._exit(self.exit_code)  # impatient second signal
         self._requested.set()
+        for fn in self._callbacks:
+            try:
+                fn()
+            except Exception:
+                pass  # a broken callback must not mask the drain
         _log("received signal %d: draining at the next step boundary"
              % signum)
 

@@ -33,12 +33,11 @@ def _load_params(source):
     returns (arg_params, aux_params) with prefixes stripped."""
     if isinstance(source, dict):
         loaded = source
-    elif isinstance(source, bytes):
-        import tempfile
-        with tempfile.NamedTemporaryFile(suffix=".params") as f:
-            f.write(source)
-            f.flush()
-            loaded = nd.load(f.name)
+    elif isinstance(source, (bytes, bytearray, memoryview)):
+        # straight from the in-memory buffer — the old NamedTemporaryFile
+        # round-trip re-opened the file while the writing handle was still
+        # open, which fails on platforms without shared-open semantics
+        loaded = nd.load_frombuffer(bytes(source))
     else:
         loaded = nd.load(source)
     arg_params, aux_params = {}, {}
@@ -157,15 +156,63 @@ class Predictor:
     def reshape(self, input_shapes):
         """New predictor bound to different input shapes (reference
         MXPredReshape); weights are shared, the graph recompiles."""
+        return Predictor(self._symbol, self._shared_params(), ctx=self._ctx,
+                         input_shapes=input_shapes,
+                         input_dtypes=self._input_dtypes)
+
+    # -- serving hooks (mxnet_tpu.serving) ------------------------------
+    def _shared_params(self):
+        """Bound weights/aux as a prefixed dict, sharing the underlying
+        NDArrays (no copy) — the currency of reshape()/clone()."""
         params = {}
         for name, arr in self._executor.arg_dict.items():
             if name not in self._input_names:
                 params["arg:" + name] = arr
         for name, arr in self._executor.aux_dict.items():
             params["aux:" + name] = arr
-        return Predictor(self._symbol, params, ctx=self._ctx,
-                         input_shapes=input_shapes,
+        return params
+
+    def clone(self, ctx=None):
+        """A new replica over the SAME weights (shared NDArrays, no HBM
+        copy on the same device): its executor stages inputs
+        independently, so two clones can serve concurrently."""
+        shapes = {n: tuple(self._executor.arg_dict[n].shape)
+                  for n in self._input_names}
+        return Predictor(self._symbol, self._shared_params(),
+                         ctx=ctx or self._ctx, input_shapes=shapes,
                          input_dtypes=self._input_dtypes)
+
+    def warm(self, batch_sizes):
+        """Pre-compile one executable per leading-dim bucket by running a
+        zeros forward at each size (the executor's compile cache is keyed
+        by input shape) so no request triggers an XLA compile at serving
+        time.  Returns the batch sizes warmed."""
+        base = {n: tuple(self._executor.arg_dict[n].shape)
+                for n in self._input_names}
+        dtypes = {n: self._executor.arg_dict[n].dtype
+                  for n in self._input_names}
+        warmed = []
+        for b in sorted(set(int(b) for b in batch_sizes)):
+            feed = {n: nd.zeros((b,) + base[n][1:], dtype=dtypes[n],
+                                ctx=self._ctx)
+                    for n in base}
+            self.forward(**feed)
+            warmed.append(b)
+        return warmed
+
+    def health_check(self):
+        """Liveness/sanity probe: one forward on zeros at the bound
+        shapes; healthy iff it completes and every output is finite.
+        Used by the serving layer before (re)admitting a replica."""
+        try:
+            feed = {n: nd.zeros(tuple(self._executor.arg_dict[n].shape),
+                                dtype=self._executor.arg_dict[n].dtype,
+                                ctx=self._ctx)
+                    for n in self._input_names}
+            outs = self.forward(**feed)
+            return all(bool(np.isfinite(o.asnumpy()).all()) for o in outs)
+        except Exception:
+            return False
 
 
 # ---------------------------------------------------------------------------

@@ -149,7 +149,10 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   # numerical-health sentinel + chaos harness
                   # (docs/NUMERICAL_HEALTH.md)
                   "nonfinite_steps", "rollbacks", "divergence_checks",
-                  "faults_injected", "corrupt_records", "io_retries")
+                  "faults_injected", "corrupt_records", "io_retries",
+                  # overload-safe serving layer (docs/SERVING.md)
+                  "requests_admitted", "requests_shed", "hedges_fired",
+                  "breaker_trips", "batches_closed_by_deadline")
 _dispatch = {k: 0 for k in _DISPATCH_KEYS}
 
 

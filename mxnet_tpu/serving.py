@@ -1,0 +1,899 @@
+"""Overload-safe serving layer over :class:`~mxnet_tpu.predict.Predictor`.
+
+The bare ``Predictor`` is the parity port of the reference's
+``c_predict_api.cc`` — one synchronous request at a time, no queueing, no
+timeouts, no failure handling.  This module is the robustness front a
+production model server puts between the network and the compiled model,
+following the overload/deadline discipline of Clipper (Crankshaw et al.,
+NSDI'17) and TensorFlow-Serving (Olston et al., 2017):
+
+* **Bounded admission + load shedding** — requests past the queue cap are
+  rejected *immediately* with a typed :class:`Overloaded` instead of
+  growing an unbounded backlog (queue depth stays at the configured cap
+  no matter the offered load; the client retries against another task).
+* **Deadline-aware dynamic batching** — admitted requests carry an
+  absolute deadline; the batcher closes a batch when it is full, when the
+  oldest request's remaining slack is about to be eaten by the expected
+  model latency (EWMA-estimated), or when a max-wait timer expires.
+  Batches are padded up to the configured shape buckets
+  (``MXNET_SHAPE_BUCKETS`` / ``buckets=``, reference BucketingModule
+  semantics via :func:`mxnet_tpu.dispatch.bucket_size`), so a warmed
+  server never triggers a new XLA compile under load.
+* **Replica hedging** — a request batch still in flight after
+  ``hedge_ms`` is re-dispatched to a *second* replica; the first result
+  wins and the loser is discarded with explicit cancellation bookkeeping
+  (``hedges_fired`` / wasted-execution stats).  Tail latency from one
+  slow replica stops being the service's tail latency.
+* **Per-replica circuit breaker** — ``threshold`` consecutive failures
+  trip the breaker OPEN; after a bounded exponential backoff (shared
+  :func:`mxnet_tpu.async_kv.backoff_delay` helper) it goes HALF_OPEN and
+  admits exactly one probe; a probe success closes it, a failure re-trips
+  with escalated backoff.  A tripped replica stops eating requests while
+  the healthy ones carry the traffic (state: ``DEGRADED``).
+* **Lifecycle + graceful drain** — STARTING → SERVING → DEGRADED →
+  DRAINING → STOPPED.  SIGTERM (via the existing
+  :class:`~mxnet_tpu.elastic.PreemptionHandler`) flips the server to
+  DRAINING *from the signal handler* (a lone ``Event.set``, async-signal
+  safe): new requests get a typed :class:`Draining`, every already
+  admitted request still completes, and the process exits with
+  ``PREEMPTED_EXIT_CODE`` (76) so :func:`~mxnet_tpu.elastic.supervise`
+  restarts it for free.
+* **Atomic hot-swap reload** — :meth:`ModelServer.reload` compiles and
+  warms the new replicas *before* the pointer flip; in-flight batches
+  finish on the old replicas, which are retired once their in-flight
+  count drains to zero.
+
+Outcome contract (the chaos suite's acceptance invariant): every admitted
+request reaches **exactly one** typed terminal outcome — a result,
+:class:`DeadlineExceeded`, :class:`Overloaded` (at admission),
+:class:`Draining` (at admission while draining), or :class:`Unavailable`
+(every replica tried and failed) — none hang and none are dropped.
+
+Threading model: one scheduler thread owns ALL timing decisions (batch
+close, hedge firing, deadline expiry, breaker reopen) under the server
+condition variable; a small executor pool runs the blocking model
+forwards *outside* the lock (no lock is ever held across compute or
+sleep — the CC001 discipline mxlint enforces).  See docs/SERVING.md.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+from . import chaos as _chaos
+from .async_kv import backoff_delay as _backoff_delay
+
+__all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
+           "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
+           "Unavailable",
+           "STARTING", "SERVING", "DEGRADED", "DRAINING", "STOPPED"]
+
+# -- lifecycle states -------------------------------------------------------
+STARTING = "STARTING"
+SERVING = "SERVING"
+DEGRADED = "DEGRADED"   # at least one breaker open, traffic still flowing
+DRAINING = "DRAINING"   # admission closed, in-flight completing
+STOPPED = "STOPPED"
+
+# env-tunable defaults (docs/SERVING.md / docs/ENV_VARS.md)
+_DEF_MAX_QUEUE = int(os.environ.get("MXTPU_SERVE_MAX_QUEUE", "64"))
+_DEF_MAX_BATCH = int(os.environ.get("MXTPU_SERVE_MAX_BATCH", "8"))
+_DEF_MAX_WAIT_MS = float(os.environ.get("MXTPU_SERVE_MAX_WAIT_MS", "5"))
+_DEF_DEADLINE_MS = float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "1000"))
+_DEF_HEDGE_MS = float(os.environ.get("MXTPU_SERVE_HEDGE_MS", "0"))
+_DEF_BREAKER_THRESHOLD = int(os.environ.get(
+    "MXTPU_SERVE_BREAKER_THRESHOLD", "3"))
+_DEF_BREAKER_BACKOFF = float(os.environ.get(
+    "MXTPU_SERVE_BREAKER_BACKOFF", "0.2"))
+_DEF_BREAKER_BACKOFF_CAP = float(os.environ.get(
+    "MXTPU_SERVE_BREAKER_BACKOFF_CAP", "30"))
+
+# close a batch this many seconds before the oldest deadline would be
+# missed, on top of the EWMA latency estimate (slack safety margin)
+_CLOSE_MARGIN_S = 0.02
+_EWMA_ALPHA = 0.3
+# scheduler idle poll: bounds how late a signal-set drain flag is noticed
+_IDLE_POLL_S = 0.1
+
+
+def _log(msg):
+    print("[serving] %s" % msg, file=sys.stderr, flush=True)
+
+
+def _count(name, delta=1):
+    from . import profiler as _prof
+
+    _prof.dispatch_count(name, delta)
+
+
+# ---------------------------------------------------------------------------
+# typed outcomes
+# ---------------------------------------------------------------------------
+class ServingError(RuntimeError):
+    """Base of every typed serving rejection/failure."""
+
+
+class Overloaded(ServingError):
+    """Admission queue at capacity — request shed, retry elsewhere/later."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired before a result was produced."""
+
+
+class Draining(ServingError):
+    """The server is draining (or stopped) and admits no new requests."""
+
+
+class Unavailable(ServingError):
+    """Every replica was tried for this request and failed."""
+
+
+# ---------------------------------------------------------------------------
+# request / future
+# ---------------------------------------------------------------------------
+class ServingFuture:
+    """One admitted request.  Resolved exactly once (first writer wins —
+    the hedging/deadline/failover races all funnel through
+    :meth:`_resolve` / :meth:`_reject` under the server lock)."""
+
+    __slots__ = ("inputs", "rows", "deadline", "t_admit", "job",
+                 "_outputs", "_error", "_event", "t_done")
+
+    def __init__(self, inputs, rows, deadline, t_admit):
+        self.inputs = inputs          # {name: np.ndarray}, leading dim=rows
+        self.rows = rows
+        self.deadline = deadline      # absolute time.monotonic()
+        self.t_admit = t_admit
+        self.job = None               # set when batched
+        self._outputs = None
+        self._error = None
+        self._event = threading.Event()
+        self.t_done = None
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    def _settle(self):
+        """Mark terminal (caller holds the server lock)."""
+        self.t_done = time.monotonic()
+        if self.job is not None:
+            self.job.unresolved -= 1
+        self._event.set()
+
+    def _resolve(self, outputs):
+        if self._event.is_set():
+            return False
+        self._outputs = outputs
+        self._settle()
+        return True
+
+    def _reject(self, error):
+        if self._event.is_set():
+            return False
+        self._error = error
+        self._settle()
+        return True
+
+    def result(self, timeout=None):
+        """Block for the terminal outcome: the output list, or the typed
+        :class:`ServingError` raised."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request not terminal after %ss"
+                               % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+    def latency_s(self):
+        return None if self.t_done is None else self.t_done - self.t_admit
+
+
+class _BatchJob:
+    """One closed batch: the padded feed plus per-request row offsets."""
+
+    __slots__ = ("requests", "offsets", "feed", "rows", "padded_rows",
+                 "close_reason", "tried", "inflight_execs", "hedged",
+                 "hedge_at", "failures", "unresolved", "dispatched")
+
+    def __init__(self, requests, offsets, feed, rows, padded_rows, reason):
+        self.requests = requests
+        self.offsets = offsets
+        self.feed = feed
+        self.rows = rows
+        self.padded_rows = padded_rows
+        self.close_reason = reason
+        self.tried = set()            # replica ids this job ran (or runs) on
+        self.inflight_execs = 0
+        self.hedged = False
+        self.hedge_at = None
+        self.failures = 0
+        self.unresolved = len(requests)
+        self.dispatched = False
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probing.
+
+    CLOSED --(threshold consecutive failures)--> OPEN
+    OPEN   --(backoff elapsed)--> HALF_OPEN (admits ONE probe)
+    HALF_OPEN --probe ok--> CLOSED;  --probe fails--> OPEN (backoff doubles)
+
+    The reopen backoff is the shared bounded-exponential-with-jitter
+    helper the async-KV transport retries use
+    (:func:`mxnet_tpu.async_kv.backoff_delay`).  All methods are called
+    under the owning server's lock.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "CLOSED", "OPEN", "HALF_OPEN"
+
+    def __init__(self, threshold=_DEF_BREAKER_THRESHOLD,
+                 backoff=_DEF_BREAKER_BACKOFF,
+                 backoff_cap=_DEF_BREAKER_BACKOFF_CAP):
+        self.threshold = max(1, int(threshold))
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.state = self.CLOSED
+        self.failures = 0         # consecutive
+        self.trips = 0
+        self.reopen_at = None
+        self.probe_inflight = False
+
+    def would_allow(self, now):
+        """Non-mutating availability check (scheduler peek)."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            return now >= self.reopen_at
+        return not self.probe_inflight
+
+    def allow(self, now):
+        """Mutating admission check: an OPEN breaker whose backoff has
+        elapsed transitions to HALF_OPEN and reserves the probe slot."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if now < self.reopen_at:
+                return False
+            self.state = self.HALF_OPEN
+            self.probe_inflight = True
+            return True
+        if self.probe_inflight:
+            return False
+        self.probe_inflight = True
+        return True
+
+    def record_success(self):
+        if self.state != self.CLOSED:
+            _log("breaker: probe succeeded, closing (after %d trip%s)"
+                 % (self.trips, "" if self.trips == 1 else "s"))
+        self.state = self.CLOSED
+        self.failures = 0
+        self.trips = 0
+        self.reopen_at = None
+        self.probe_inflight = False
+
+    def record_failure(self, now):
+        """Returns True when this failure tripped (or re-tripped) the
+        breaker."""
+        self.probe_inflight = False
+        self.failures += 1
+        if self.state == self.HALF_OPEN:
+            return self._trip(now)        # failed probe: straight back OPEN
+        if self.state == self.CLOSED and self.failures >= self.threshold:
+            return self._trip(now)
+        return False
+
+    def _trip(self, now):
+        self.trips += 1
+        self.state = self.OPEN
+        delay = _backoff_delay(self.trips - 1, self.backoff,
+                               self.backoff_cap)
+        self.reopen_at = now + delay
+        _count("breaker_trips")
+        _log("breaker tripped (trip %d, %d consecutive failures): "
+             "half-open probe in %.3fs" % (self.trips, self.failures, delay))
+        return True
+
+
+# ---------------------------------------------------------------------------
+# replica
+# ---------------------------------------------------------------------------
+class Replica:
+    """One Predictor behind its own serialization lock and breaker.
+    ``Predictor``'s executor stages inputs statefully, so executions on
+    one replica serialize; concurrency comes from multiple replicas."""
+
+    def __init__(self, rid, predictor,
+                 breaker_threshold=_DEF_BREAKER_THRESHOLD,
+                 breaker_backoff=_DEF_BREAKER_BACKOFF,
+                 breaker_backoff_cap=_DEF_BREAKER_BACKOFF_CAP):
+        self.id = rid
+        self.predictor = predictor
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_backoff,
+                                      breaker_backoff_cap)
+        self.inflight = 0             # guarded by the server lock
+        self.retired = False
+        self._lock = threading.Lock()
+
+    def execute(self, feed):
+        """Run one padded batch; numpy in, list of numpy outputs out."""
+        from . import ndarray as nd
+
+        with self._lock:
+            outs = self.predictor.forward(
+                **{k: nd.array(v) for k, v in feed.items()})
+            return [np.asarray(o.asnumpy()) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+class ModelServer:
+    """Robustness front over one or more ``Predictor`` replicas.
+
+    Construct from an exported model (``symbol`` + ``params`` +
+    ``input_shapes``, replicated ``num_replicas`` times via
+    ``Predictor.clone()``) or hand over prebuilt ``predictors=[...]``.
+
+    ``submit()`` / ``submit_async()`` take ``{input_name: np.ndarray}``
+    with a leading batch dim (usually 1 row) and return the model's
+    output list (sliced back to the request's rows) or raise a typed
+    :class:`ServingError`.  See the module docstring for the semantics.
+    """
+
+    def __init__(self, symbol=None, params=None, input_shapes=None,
+                 ctx=None, predictors=None, num_replicas=1,
+                 max_queue=None, max_batch=None, max_wait_ms=None,
+                 deadline_ms=None, hedge_ms=None, buckets=None,
+                 breaker_threshold=None, breaker_backoff=None,
+                 breaker_backoff_cap=None, warm=True):
+        self.max_queue = _DEF_MAX_QUEUE if max_queue is None \
+            else int(max_queue)
+        self.max_batch = _DEF_MAX_BATCH if max_batch is None \
+            else int(max_batch)
+        self.max_wait = (_DEF_MAX_WAIT_MS if max_wait_ms is None
+                         else float(max_wait_ms)) / 1e3
+        self.default_deadline = (_DEF_DEADLINE_MS if deadline_ms is None
+                                 else float(deadline_ms)) / 1e3
+        self.hedge_ms = _DEF_HEDGE_MS if hedge_ms is None \
+            else float(hedge_ms)
+        self._breaker_cfg = (
+            _DEF_BREAKER_THRESHOLD if breaker_threshold is None
+            else int(breaker_threshold),
+            _DEF_BREAKER_BACKOFF if breaker_backoff is None
+            else float(breaker_backoff),
+            _DEF_BREAKER_BACKOFF_CAP if breaker_backoff_cap is None
+            else float(breaker_backoff_cap))
+        self._buckets = self._resolve_buckets(buckets)
+
+        self._state = STARTING
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending = collections.deque()   # admitted, not yet batched
+        self._jobs = []                       # closed batches, not finished
+        self._dispatch_q = queue.Queue()      # (job, replica, exec_idx)
+        self._drain_flag = threading.Event()
+        self._stop = False
+        self._exec_seq = 0
+        self._rr = 0
+        self._retired = []
+        self._replica_seq = 0
+        self._ewma_latency = 0.01
+        self._preemption = None
+        self.stats = {
+            "queue_depth_peak": 0, "admitted": 0, "shed": 0,
+            "rejected_draining": 0, "ok": 0, "deadline_exceeded": 0,
+            "unavailable": 0, "batches_full": 0, "batches_timer": 0,
+            "batches_deadline": 0, "hedges_fired": 0, "hedge_wins": 0,
+            "wasted_executions": 0, "failovers": 0, "reloads": 0,
+        }
+
+        # -- build + warm replicas (still STARTING: nothing admitted) ----
+        self._model_spec = (symbol, params, dict(input_shapes or {}), ctx)
+        self._replicas = self._build_replicas(predictors, symbol, params,
+                                              input_shapes, ctx,
+                                              num_replicas, warm)
+        if not self._replicas:
+            raise ValueError("ModelServer needs at least one replica")
+        self._input_names = list(
+            self._replicas[0].predictor._input_names)
+
+        n_workers = max(2, 2 * len(self._replicas))
+        self._threads = [threading.Thread(target=self._scheduler_loop,
+                                          name="serve-sched", daemon=True)]
+        self._threads += [
+            threading.Thread(target=self._worker_loop,
+                             name="serve-exec-%d" % i, daemon=True)
+            for i in range(n_workers)]
+        for t in self._threads:
+            t.start()
+        self._state = SERVING
+        _log("serving: %d replica(s), max_queue=%d max_batch=%d "
+             "buckets=%s hedge_ms=%g"
+             % (len(self._replicas), self.max_queue, self.max_batch,
+                list(self._buckets), self.hedge_ms))
+
+    # -- construction helpers ----------------------------------------------
+    def _resolve_buckets(self, buckets):
+        from . import dispatch as _dispatch
+
+        if buckets is None:
+            spec = _dispatch.bucket_spec()
+            if isinstance(spec, tuple):
+                buckets = [b for b in spec if b <= self.max_batch]
+            else:                      # None or 'pow2': pow2 chain
+                buckets, b = [], 1
+                while b < self.max_batch:
+                    buckets.append(b)
+                    b <<= 1
+        buckets = sorted(set(int(b) for b in buckets) | {self.max_batch})
+        return tuple(b for b in buckets if b <= self.max_batch)
+
+    def _build_replicas(self, predictors, symbol, params, input_shapes,
+                        ctx, num_replicas, warm):
+        from .predict import Predictor
+
+        preds = list(predictors or [])
+        if not preds:
+            if symbol is None or params is None:
+                raise ValueError("pass symbol+params (+input_shapes) or "
+                                 "predictors=[...]")
+            first = Predictor(symbol, params, ctx=ctx,
+                              input_shapes=input_shapes)
+            preds = [first] + [first.clone()
+                               for _ in range(int(num_replicas) - 1)]
+        out = []
+        for p in preds:
+            if warm:
+                p.warm(self._buckets)     # pre-compile every bucket shape
+            rid = self._replica_seq
+            self._replica_seq += 1
+            out.append(Replica(rid, p, *self._breaker_cfg))
+        return out
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def state(self):
+        return self._state
+
+    def queue_depth(self):
+        with self._cv:
+            return self._queue_depth_locked()
+
+    def submit_async(self, inputs, deadline_ms=None):
+        """Admit one request; returns a :class:`ServingFuture`.  Raises
+        :class:`Overloaded` / :class:`Draining` at admission time."""
+        feed = {}
+        rows = None
+        for name, arr in dict(inputs).items():
+            a = np.asarray(arr)
+            if a.ndim == 0:
+                raise ValueError("input %r must have a leading batch dim"
+                                 % name)
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ValueError(
+                    "ragged request: input %r has %d rows, expected %d"
+                    % (name, a.shape[0], rows))
+            feed[name] = a
+        if not feed:
+            raise ValueError("empty request")
+        unknown = set(feed) - set(self._input_names)
+        if unknown:
+            raise ValueError("unknown input(s) %s (model inputs: %s)"
+                             % (sorted(unknown), self._input_names))
+        missing = set(self._input_names) - set(feed)
+        if missing:
+            raise ValueError("missing input(s) %s" % sorted(missing))
+        if rows > self.max_batch:
+            raise ValueError("request rows %d > max_batch %d"
+                             % (rows, self.max_batch))
+
+        now = time.monotonic()
+        deadline = now + (self.default_deadline if deadline_ms is None
+                          else float(deadline_ms) / 1e3)
+        with self._cv:
+            if self._drain_flag.is_set() or self._state in (DRAINING,
+                                                            STOPPED):
+                self.stats["rejected_draining"] += 1
+                raise Draining("server is %s: not admitting requests"
+                               % (DRAINING if self._state != STOPPED
+                                  else STOPPED))
+            depth = self._queue_depth_locked()
+            if depth >= self.max_queue:
+                self.stats["shed"] += 1
+                _count("requests_shed")
+                raise Overloaded(
+                    "admission queue at capacity (%d/%d): request shed"
+                    % (depth, self.max_queue))
+            req = ServingFuture(feed, rows, deadline, now)
+            self._pending.append(req)
+            self.stats["admitted"] += 1
+            _count("requests_admitted")
+            self.stats["queue_depth_peak"] = max(
+                self.stats["queue_depth_peak"],
+                self._queue_depth_locked())
+            self._cv.notify_all()
+        return req
+
+    def submit(self, inputs, deadline_ms=None, timeout=None):
+        """Synchronous :meth:`submit_async`: the output list, or the
+        typed :class:`ServingError` raised."""
+        fut = self.submit_async(inputs, deadline_ms=deadline_ms)
+        if timeout is None:
+            timeout = (fut.deadline - time.monotonic()) + 30.0
+        return fut.result(timeout=timeout)
+
+    def install_preemption_drain(self, handler=None):
+        """Wire graceful drain into SIGTERM/SIGINT via
+        :class:`~mxnet_tpu.elastic.PreemptionHandler`: the first signal
+        stops admission immediately (the handler callback only sets an
+        Event — async-signal safe); the main loop then observes
+        ``handler.requested`` / ``check()`` and calls
+        ``handler.drain(server.drain)`` to finish in-flight work and
+        exit with rc 76.  Returns the handler."""
+        if handler is None:
+            from .elastic import PreemptionHandler
+
+            handler = PreemptionHandler().install()
+        handler.add_callback(self._drain_flag.set)
+        self._preemption = handler
+        return handler
+
+    def drain(self, timeout=None):
+        """Graceful drain: stop admitting (typed :class:`Draining`
+        rejections), let every admitted request reach its terminal
+        outcome, then stop the worker threads.  Returns True when
+        everything in flight completed (False on timeout)."""
+        self._drain_flag.set()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            if self._state == STOPPED:
+                return True
+            if self._state != DRAINING:
+                self._state = DRAINING
+                _log("state -> DRAINING (%d queued, %d batches in flight)"
+                     % (len(self._pending), len(self._jobs)))
+            self._cv.notify_all()
+            while self._pending or self._jobs:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                self._cv.wait(0.05)
+            drained = not self._pending and not self._jobs
+            self._stop = True
+            self._cv.notify_all()
+        for _ in self._threads:
+            self._dispatch_q.put(None)     # one sentinel per worker
+        for t in self._threads:
+            t.join(timeout=5.0)
+        with self._cv:
+            self._state = STOPPED
+        _log("state -> STOPPED (drained=%s)" % drained)
+        return drained
+
+    close = drain
+
+    def reload(self, symbol=None, params=None, predictors=None,
+               num_replicas=None, warm=True):
+        """Atomic hot-swap model reload: build + compile + warm the new
+        replicas FIRST, then flip the replica pointer under the lock.
+        In-flight batches finish on the old replicas, which are retired
+        once their in-flight count drains to zero.  Admission never
+        pauses."""
+        old_symbol, old_params, shapes, ctx = self._model_spec
+        symbol = old_symbol if symbol is None else symbol
+        if params is None and predictors is None:
+            raise ValueError("reload needs params or predictors")
+        n = num_replicas if num_replicas is not None \
+            else len(self._replicas)
+        # expensive part outside the lock: nothing admitted stalls
+        new = self._build_replicas(predictors, symbol, params, shapes,
+                                   ctx, n, warm)
+        with self._cv:
+            old = self._replicas
+            for r in old:
+                r.retired = True
+            self._replicas = new
+            self._retired.extend(old)
+            self._model_spec = (symbol,
+                                params if params is not None
+                                else old_params, shapes, ctx)
+            self.stats["reloads"] += 1
+            self._prune_retired_locked()
+            self._cv.notify_all()
+        _log("reload: swapped in %d replica(s); %d old retiring"
+             % (len(new), len(old)))
+
+    def snapshot(self):
+        """Point-in-time stats + lifecycle view (for tests/metrics)."""
+        with self._cv:
+            return {
+                "state": self._state,
+                "queue_depth": self._queue_depth_locked(),
+                "replicas": [
+                    {"id": r.id, "breaker": r.breaker.state,
+                     "inflight": r.inflight, "trips": r.breaker.trips}
+                    for r in self._replicas],
+                "retired_pending": len(self._retired),
+                "ewma_latency_s": self._ewma_latency,
+                **dict(self.stats),
+            }
+
+    # -- internals (all *_locked helpers run under self._cv) ---------------
+    def _queue_depth_locked(self):
+        depth = len(self._pending)
+        for j in self._jobs:
+            if not j.dispatched:
+                depth += len(j.requests)
+        return depth
+
+    def _est_latency(self):
+        return self._ewma_latency
+
+    def _bucket_for(self, rows):
+        for b in self._buckets:
+            if b >= rows:
+                return b
+        return rows
+
+    def _active_replicas(self):
+        return [r for r in self._replicas if not r.retired]
+
+    def _pick_locked(self, tried, now, peek=False):
+        """Least-loaded active replica that the breaker admits, has a
+        free execution slot, and is not in ``tried``; None if nothing is
+        available right now."""
+        best = None
+        cands = self._active_replicas()
+        n = len(cands)
+        for i in range(n):
+            r = cands[(self._rr + i) % n]
+            if r.id in tried or r.inflight >= 1:
+                continue
+            if not r.breaker.would_allow(now):
+                continue
+            if best is None or r.inflight < best.inflight:
+                best = r
+        if best is not None and not peek:
+            if not best.breaker.allow(now):     # reserves half-open probe
+                return None
+            self._rr += 1
+        return best
+
+    def _expire_locked(self, now):
+        """Every admitted request past its deadline gets its typed
+        terminal outcome HERE — queued, batched, or in flight — so no
+        request can hang on a wedged replica."""
+        for req in [r for r in self._pending if r.deadline <= now]:
+            self._pending.remove(req)
+            self._reject_locked(req, DeadlineExceeded(
+                "deadline expired after %.0fms in queue"
+                % ((now - req.t_admit) * 1e3)))
+        for job in self._jobs:
+            for req in job.requests:
+                if not req.done and req.deadline <= now:
+                    self._reject_locked(req, DeadlineExceeded(
+                        "deadline expired after %.0fms (batch %s)"
+                        % ((now - req.t_admit) * 1e3,
+                           "in flight" if job.inflight_execs else "queued")))
+
+    def _reject_locked(self, req, err):
+        if req._reject(err):
+            key = ("deadline_exceeded" if isinstance(err, DeadlineExceeded)
+                   else "unavailable" if isinstance(err, Unavailable)
+                   else "rejected_other")
+            self.stats[key] = self.stats.get(key, 0) + 1
+            if isinstance(err, DeadlineExceeded):
+                _count("requests_deadline_exceeded")
+
+    def _form_batches_locked(self, now):
+        while self._pending:
+            if self._pick_locked(frozenset(), now, peek=True) is None:
+                return            # nobody can run it: leave queued (bounded)
+            oldest = self._pending[0]
+            rows_avail = sum(r.rows for r in self._pending)
+            full = rows_avail >= self.max_batch
+            timer = (now - oldest.t_admit) >= self.max_wait
+            dl = (oldest.deadline - now) <= (self._est_latency()
+                                             + _CLOSE_MARGIN_S)
+            if not (full or timer or dl):
+                return
+            reason = "full" if full else ("deadline" if dl else "timer")
+            take, offsets, rows = [], [], 0
+            while self._pending and \
+                    rows + self._pending[0].rows <= self.max_batch:
+                r = self._pending.popleft()
+                take.append(r)
+                offsets.append(rows)
+                rows += r.rows
+            padded = self._bucket_for(rows)
+            feed = {}
+            for name in self._input_names:
+                cat = np.concatenate([r.inputs[name] for r in take], axis=0)
+                if padded != rows:
+                    # wrap-around padding (NDArrayIter 'pad' semantics):
+                    # padded rows stay statistically plausible
+                    cat = cat[np.arange(padded) % rows]
+                feed[name] = cat
+            job = _BatchJob(take, offsets, feed, rows, padded, reason)
+            for r in take:
+                r.job = job
+            self._jobs.append(job)
+            self.stats["batches_%s" % reason] += 1
+            if reason == "deadline":
+                _count("batches_closed_by_deadline")
+            if padded != rows:
+                _count("bucket_padded_batches")
+
+    def _dispatch_locked(self, job, repl, now, hedge=False):
+        repl.inflight += 1
+        job.inflight_execs += 1
+        job.tried.add(repl.id)
+        job.dispatched = True
+        if not hedge and self.hedge_ms > 0:
+            job.hedge_at = now + self.hedge_ms / 1e3
+        idx = self._exec_seq
+        self._exec_seq += 1
+        self._dispatch_q.put((job, repl, idx))
+
+    def _assign_locked(self, now):
+        for job in self._jobs:
+            if job.unresolved == 0 or job.inflight_execs > 0:
+                continue
+            active_ids = {r.id for r in self._active_replicas()}
+            if job.failures > 0 and active_ids and \
+                    active_ids <= job.tried:
+                for req in job.requests:
+                    self._reject_locked(req, Unavailable(
+                        "all %d replica(s) failed this batch"
+                        % len(job.tried)))
+                continue
+            repl = self._pick_locked(job.tried, now)
+            if repl is None:
+                continue              # parked until a breaker reopens
+            if job.failures > 0:
+                self.stats["failovers"] += 1
+            self._dispatch_locked(job, repl, now)
+
+    def _hedge_locked(self, now):
+        if self.hedge_ms <= 0:
+            return
+        for job in self._jobs:
+            if (job.unresolved and job.inflight_execs >= 1
+                    and not job.hedged and job.hedge_at is not None
+                    and now >= job.hedge_at):
+                repl = self._pick_locked(job.tried, now)
+                if repl is None:
+                    continue
+                job.hedged = True
+                self.stats["hedges_fired"] += 1
+                _count("hedges_fired")
+                self._dispatch_locked(job, repl, now, hedge=True)
+
+    def _prune_jobs_locked(self):
+        self._jobs = [j for j in self._jobs
+                      if j.unresolved > 0 or j.inflight_execs > 0]
+
+    def _prune_retired_locked(self):
+        self._retired = [r for r in self._retired if r.inflight > 0]
+
+    def _recompute_state_locked(self):
+        if self._state not in (SERVING, DEGRADED):
+            return
+        degraded = any(r.breaker.state != CircuitBreaker.CLOSED
+                       for r in self._active_replicas())
+        want = DEGRADED if degraded else SERVING
+        if want != self._state:
+            _log("state %s -> %s" % (self._state, want))
+            self._state = want
+
+    def _next_wake_locked(self, now):
+        cand = [now + _IDLE_POLL_S]
+        if self._pending:
+            oldest = self._pending[0]
+            cand.append(oldest.t_admit + self.max_wait)
+            cand.append(oldest.deadline - self._est_latency()
+                        - _CLOSE_MARGIN_S)
+            cand.append(min(r.deadline for r in self._pending))
+        for job in self._jobs:
+            if job.unresolved:
+                cand.append(min(r.deadline for r in job.requests
+                                if not r.done))
+                if (job.hedge_at is not None and not job.hedged
+                        and job.inflight_execs >= 1):
+                    cand.append(job.hedge_at)
+        if self._pending or any(j.unresolved and j.inflight_execs == 0
+                                for j in self._jobs):
+            for r in self._active_replicas():
+                if r.breaker.state == CircuitBreaker.OPEN:
+                    cand.append(r.breaker.reopen_at)
+        return max(5e-4, min(cand) - now)
+
+    # -- threads -----------------------------------------------------------
+    def _scheduler_loop(self):
+        with self._cv:
+            while not self._stop:
+                now = time.monotonic()
+                if self._drain_flag.is_set() and \
+                        self._state in (SERVING, DEGRADED):
+                    self._state = DRAINING
+                    _log("state -> DRAINING (signal)")
+                self._expire_locked(now)
+                self._prune_jobs_locked()
+                self._form_batches_locked(now)
+                self._assign_locked(now)
+                self._hedge_locked(now)
+                self._prune_retired_locked()
+                self._recompute_state_locked()
+                self._cv.wait(self._next_wake_locked(now))
+
+    def _worker_loop(self):
+        while True:
+            item = self._dispatch_q.get()
+            if item is None:
+                return
+            job, repl, idx = item
+            with self._cv:
+                if job.unresolved == 0:
+                    # first-wins cancellation: the batch settled (hedge
+                    # winner or deadline) before this execution started
+                    repl.inflight -= 1
+                    job.inflight_execs -= 1
+                    self.stats["wasted_executions"] += 1
+                    self._cv.notify_all()
+                    continue
+            # chaos + compute happen OUTSIDE every lock (CC001)
+            delay = _chaos.slow_replica(idx)
+            if delay:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            outs, err = None, None
+            try:
+                _chaos.replica_crash(idx)
+                outs = repl.execute(job.feed)
+            except Exception as e:   # noqa: BLE001 — typed outcome below
+                err = e
+            dt = time.perf_counter() - t0
+            with self._cv:
+                repl.inflight -= 1
+                job.inflight_execs -= 1
+                now = time.monotonic()
+                if err is None:
+                    repl.breaker.record_success()
+                    self._ewma_latency = (
+                        (1 - _EWMA_ALPHA) * self._ewma_latency
+                        + _EWMA_ALPHA * dt)
+                    self._settle_job_locked(job, outs)
+                else:
+                    job.failures += 1
+                    repl.breaker.record_failure(now)
+                    _log("replica %d failed batch (%s: %s)"
+                         % (repl.id, type(err).__name__, err))
+                self._recompute_state_locked()
+                self._cv.notify_all()
+
+    def _settle_job_locked(self, job, outs):
+        resolved = 0
+        for req, off in zip(job.requests, job.offsets):
+            if req.done:
+                continue
+            if req._resolve([o[off:off + req.rows] for o in outs]):
+                resolved += 1
+        if resolved:
+            self.stats["ok"] += resolved
+            if job.hedged:
+                self.stats["hedge_wins"] += 1
+        else:
+            self.stats["wasted_executions"] += 1

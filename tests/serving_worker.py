@@ -1,0 +1,88 @@
+"""Serving process for the SIGTERM graceful-drain test.
+
+Starts a ModelServer over a tiny FC model, admits a burst of requests,
+self-delivers SIGTERM mid-burst (incarnation 0 only, like
+elastic_worker.py), then verifies PR 2's drain contract at serving
+granularity:
+
+* admission closes IMMEDIATELY (the PreemptionHandler callback sets the
+  drain flag from the signal handler) — a post-signal submit gets a
+  typed ``Draining`` rejection;
+* every request admitted BEFORE the signal still reaches a successful
+  result (none dropped, none hung);
+* the process exits with ``PREEMPTED_EXIT_CODE`` (76) via
+  ``PreemptionHandler.drain`` so ``supervise`` restarts it for free.
+
+Writes a JSON report (argv[1]) BEFORE the drain exit so the test can
+assert on what happened inside.
+"""
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.elastic import PreemptionRequested
+
+    report_path = sys.argv[1]
+
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=5, name="fc")
+    rng = np.random.RandomState(3)
+    params = {"arg:fc_weight": mx.nd.array(rng.rand(5, 4)
+                                           .astype(np.float32)),
+              "arg:fc_bias": mx.nd.zeros((5,))}
+
+    srv = serving.ModelServer(out, params, input_shapes={"data": (1, 4)},
+                              max_queue=64, max_batch=4, max_wait_ms=50,
+                              deadline_ms=30_000)
+    ph = srv.install_preemption_drain()
+
+    # admit a burst, then preempt ourselves mid-burst: the batcher still
+    # has most of these queued when the signal lands
+    futs = [srv.submit_async({"data": rng.rand(1, 4).astype(np.float32)})
+            for _ in range(12)]
+    os.kill(os.getpid(), signal.SIGTERM)
+
+    # admission must be closed from the signal handler onward
+    draining_typed = False
+    try:
+        srv.submit_async({"data": rng.rand(1, 4).astype(np.float32)})
+    except serving.Draining:
+        draining_typed = True
+
+    # every admitted request still completes during the drain
+    outcomes = []
+    for f in futs:
+        try:
+            f.result(timeout=60)
+            outcomes.append("ok")
+        except serving.ServingError as e:
+            outcomes.append(type(e).__name__)
+        except TimeoutError:
+            outcomes.append("HUNG")
+
+    with open(report_path, "w") as f:
+        json.dump({"admitted": len(futs), "outcomes": outcomes,
+                   "draining_typed": draining_typed,
+                   "state": srv.state,
+                   "requested": ph.requested}, f)
+
+    try:
+        ph.check()
+    except PreemptionRequested:
+        ph.drain(lambda: srv.drain(timeout=60))  # exits rc 76
+    raise SystemExit("drain did not exit")  # pragma: no cover
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,500 @@
+"""Overload-safe serving layer tests (mxnet_tpu/serving.py).
+
+The acceptance invariant (ISSUE 5): under injected ``replica_crash`` +
+``request_burst`` chaos, every admitted request gets EXACTLY ONE typed
+terminal outcome — a result, ``DeadlineExceeded``, or ``Overloaded`` —
+none hang or disappear; the circuit breaker recovers via its half-open
+probe; and queue depth stays bounded at the configured cap throughout.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, profiler, serving
+from mxnet_tpu.predict import Predictor, _load_params
+from mxnet_tpu.serving import (CircuitBreaker, DeadlineExceeded, Draining,
+                               ModelServer, Overloaded, ServingError,
+                               Unavailable)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conftest import subprocess_env  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# tiny model: 4 -> 5 FC (compiles in milliseconds, exact numpy oracle)
+# ---------------------------------------------------------------------------
+def _fc_model(seed=3):
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=5, name="fc")
+    rng = np.random.RandomState(seed)
+    wn = rng.rand(5, 4).astype(np.float32)
+    params = {"arg:fc_weight": mx.nd.array(wn),
+              "arg:fc_bias": mx.nd.zeros((5,))}
+    return out, params, wn
+
+
+def _server(n_replicas=1, **kw):
+    sym, params, wn = _fc_model()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 2)
+    kw.setdefault("deadline_ms", 20_000)
+    srv = ModelServer(sym, params, input_shapes={"data": (1, 4)},
+                      num_replicas=n_replicas, **kw)
+    return srv, wn
+
+
+def _req(rng, rows=1):
+    return {"data": rng.rand(rows, 4).astype(np.float32)}
+
+
+def _drain_all(futs, timeout=60):
+    """Collect every future's terminal outcome; 'HUNG' is the invariant
+    violation the whole layer exists to prevent."""
+    outcomes = []
+    for f in futs:
+        try:
+            f.result(timeout=timeout)
+            outcomes.append("ok")
+        except ServingError as e:
+            outcomes.append(type(e).__name__)
+        except TimeoutError:
+            outcomes.append("HUNG")
+    return outcomes
+
+
+# ---------------------------------------------------------------------------
+# correctness + batching
+# ---------------------------------------------------------------------------
+def test_serving_matches_bare_predictor():
+    srv, wn = _server()
+    try:
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 4).astype(np.float32)
+        got = srv.submit({"data": x})
+        np.testing.assert_allclose(got[0], x @ wn.T, rtol=1e-5, atol=1e-6)
+        assert srv.state == serving.SERVING
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_batching_slices_rows_back():
+    """Concurrent requests ride one padded batch; each gets exactly its
+    own rows back."""
+    srv, wn = _server(max_wait_ms=20, max_batch=8)
+    try:
+        rng = np.random.RandomState(1)
+        xs = [rng.rand(r, 4).astype(np.float32) for r in (1, 2, 3)]
+        futs = [srv.submit_async({"data": x}) for x in xs]
+        for x, f in zip(xs, futs):
+            out = f.result(timeout=30)
+            assert out[0].shape == (x.shape[0], 5)
+            np.testing.assert_allclose(out[0], x @ wn.T, rtol=1e-5,
+                                       atol=1e-6)
+        snap = srv.snapshot()
+        assert snap["ok"] == 3
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_bucket_padding_no_recompile_after_warm():
+    """Warmed buckets absorb every batch shape: the padded 3-row batch
+    bumps bucket_padded_batches but triggers ZERO new step compiles."""
+    srv, _ = _server(max_wait_ms=30, max_batch=8)
+    try:
+        rng = np.random.RandomState(2)
+        before_rc = profiler.dispatch_stats()["recompile"]
+        before_pad = profiler.dispatch_stats()["bucket_padded_batches"]
+        futs = [srv.submit_async(_req(rng)) for _ in range(3)]
+        assert _drain_all(futs) == ["ok"] * 3
+        assert profiler.dispatch_stats()["recompile"] == before_rc
+        assert profiler.dispatch_stats()["bucket_padded_batches"] \
+            > before_pad
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_request_validation():
+    srv, _ = _server()
+    try:
+        rng = np.random.RandomState(3)
+        with pytest.raises(ValueError):
+            srv.submit_async({})
+        with pytest.raises(ValueError):
+            srv.submit_async({"bogus": rng.rand(1, 4)})
+        with pytest.raises(ValueError):          # rows > max_batch
+            srv.submit_async(_req(rng, rows=64))
+    finally:
+        srv.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# overload / deadlines
+# ---------------------------------------------------------------------------
+def test_overload_sheds_typed_and_queue_stays_bounded():
+    """Flood a stalled single replica: admissions past the cap get a
+    typed Overloaded IMMEDIATELY, and the internal queue never grows
+    past max_queue (bounded memory is the whole point)."""
+    srv, _ = _server(max_queue=8, max_wait_ms=1)
+    try:
+        rng = np.random.RandomState(4)
+        with chaos.inject("slow_replica@0,slow_replica@1"):
+            futs, shed = [], 0
+            for _ in range(40):
+                try:
+                    futs.append(srv.submit_async(_req(rng)))
+                except Overloaded:
+                    shed += 1
+            assert shed > 0
+            outcomes = _drain_all(futs)
+        assert "HUNG" not in outcomes
+        assert all(o == "ok" for o in outcomes)
+        snap = srv.snapshot()
+        assert snap["queue_depth_peak"] <= 8
+        assert snap["shed"] == shed
+        assert profiler.dispatch_stats()["requests_shed"] >= shed
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_deadline_exceeded_is_typed():
+    """Requests whose deadline expires while the replica is stalled get
+    DeadlineExceeded — not a hang, not a silent drop."""
+    srv, _ = _server(max_queue=32, max_wait_ms=1, deadline_ms=20_000)
+    try:
+        rng = np.random.RandomState(5)
+        with chaos.inject("slow_replica@0,slow_replica@1"):
+            # soak up the replica, then admit requests that cannot
+            # possibly be served inside their 40ms budget
+            soak = srv.submit_async(_req(rng))
+            doomed = [srv.submit_async(_req(rng), deadline_ms=40)
+                      for _ in range(4)]
+            for f in doomed:
+                with pytest.raises(DeadlineExceeded):
+                    f.result(timeout=30)
+            assert soak.result(timeout=30)
+        assert srv.snapshot()["deadline_exceeded"] >= 4
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_batch_closes_early_for_tight_deadline():
+    """A lone request with little slack must NOT wait out the max-wait
+    timer: the batcher closes by deadline slack (batches_deadline) and
+    the request still succeeds."""
+    srv, _ = _server(max_wait_ms=5_000, max_batch=8)
+    try:
+        rng = np.random.RandomState(6)
+        before = profiler.dispatch_stats()["batches_closed_by_deadline"]
+        t0 = time.monotonic()
+        out = srv.submit(_req(rng), deadline_ms=200, timeout=30)
+        dt = time.monotonic() - t0
+        assert out is not None
+        assert dt < 2.0, "request waited out a 5s timer despite a " \
+                         "200ms deadline (%.3fs)" % dt
+        assert srv.snapshot()["batches_deadline"] >= 1
+        assert profiler.dispatch_stats()["batches_closed_by_deadline"] \
+            > before
+    finally:
+        srv.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# hedging / failover / circuit breaker
+# ---------------------------------------------------------------------------
+def test_hedge_beats_straggler():
+    """First execution stalls 1s; with hedge_ms=60 the second replica
+    answers long before the straggler would have."""
+    srv, wn = _server(n_replicas=2, hedge_ms=60, max_wait_ms=1)
+    try:
+        rng = np.random.RandomState(7)
+        with chaos.inject("slow_replica@0"):
+            x = rng.rand(1, 4).astype(np.float32)
+            t0 = time.monotonic()
+            out = srv.submit({"data": x}, timeout=30)
+            dt = time.monotonic() - t0
+        np.testing.assert_allclose(out[0], x @ wn.T, rtol=1e-5, atol=1e-6)
+        assert dt < 0.9, "hedge did not beat the 1s straggler (%.3fs)" % dt
+        snap = srv.snapshot()
+        assert snap["hedges_fired"] >= 1
+        assert profiler.dispatch_stats()["hedges_fired"] >= 1
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_failover_to_second_replica():
+    """A crashed execution fails over to an untried replica — the client
+    still sees a result, plus a failover in the stats."""
+    srv, wn = _server(n_replicas=2, breaker_threshold=3)
+    try:
+        rng = np.random.RandomState(8)
+        with chaos.inject("replica_crash@0"):
+            x = rng.rand(1, 4).astype(np.float32)
+            out = srv.submit({"data": x}, timeout=30)
+        np.testing.assert_allclose(out[0], x @ wn.T, rtol=1e-5, atol=1e-6)
+        assert srv.snapshot()["failovers"] >= 1
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_single_replica_total_failure_is_unavailable():
+    srv, _ = _server(n_replicas=1, breaker_threshold=5)
+    try:
+        rng = np.random.RandomState(9)
+        with chaos.inject("replica_crash@0"):
+            with pytest.raises(Unavailable):
+                srv.submit(_req(rng), timeout=30)
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_breaker_trips_and_recovers_half_open():
+    """threshold consecutive failures trip the breaker (DEGRADED); after
+    the backoff a half-open probe succeeds and the breaker closes —
+    service recovers with no restart."""
+    srv, _ = _server(n_replicas=1, breaker_threshold=2,
+                     breaker_backoff=0.05, breaker_backoff_cap=0.1)
+    try:
+        rng = np.random.RandomState(10)
+        before = profiler.dispatch_stats()["breaker_trips"]
+        with chaos.inject("replica_crash@0,replica_crash@1") as plan:
+            for _ in range(2):
+                with pytest.raises(Unavailable):
+                    srv.submit(_req(rng), timeout=30)
+            assert plan.pending() == []
+            snap = srv.snapshot()
+            assert snap["replicas"][0]["trips"] >= 1
+            assert profiler.dispatch_stats()["breaker_trips"] > before
+            # the tripped breaker parks new work until its half-open
+            # probe; the probe (this request) succeeds and closes it
+            out = srv.submit(_req(rng), timeout=30)
+            assert out is not None
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            snap = srv.snapshot()
+            if snap["replicas"][0]["breaker"] == CircuitBreaker.CLOSED \
+                    and snap["state"] == serving.SERVING:
+                break
+            time.sleep(0.02)
+        assert snap["replicas"][0]["breaker"] == CircuitBreaker.CLOSED
+        assert snap["state"] == serving.SERVING
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_circuit_breaker_unit():
+    """State machine in isolation with synthetic clocks."""
+    br = CircuitBreaker(threshold=2, backoff=10.0, backoff_cap=100.0)
+    now = 1000.0
+    assert br.allow(now) and br.state == br.CLOSED
+    assert not br.record_failure(now)        # 1 of 2
+    assert br.record_failure(now)            # trips
+    assert br.state == br.OPEN and br.trips == 1
+    assert br.reopen_at > now
+    assert not br.allow(now)                 # still open
+    later = br.reopen_at + 0.001
+    assert br.would_allow(later)
+    assert br.allow(later)                   # half-open, probe reserved
+    assert br.state == br.HALF_OPEN
+    assert not br.allow(later)               # only ONE probe
+    assert br.record_failure(later)          # failed probe re-trips
+    assert br.state == br.OPEN and br.trips == 2
+    again = br.reopen_at + 0.001
+    assert br.allow(again)
+    br.record_success()                      # probe ok: fully closed
+    assert br.state == br.CLOSED and br.failures == 0 and br.trips == 0
+    assert br.allow(again)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: chaos burst + crash
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_chaos_burst_and_crash_every_request_typed():
+    """ISSUE 5 acceptance: under replica_crash + request_burst chaos,
+    every admitted request gets exactly one typed terminal outcome (ok /
+    DeadlineExceeded / Overloaded at admission) — none hang or
+    disappear; queue depth stays bounded at its cap; the breaker
+    recovers via half-open probe."""
+    srv, wn = _server(n_replicas=2, max_queue=8, max_wait_ms=1,
+                      deadline_ms=5_000, breaker_threshold=2,
+                      breaker_backoff=0.05, breaker_backoff_cap=0.1)
+    try:
+        rng = np.random.RandomState(11)
+        futs, shed = [], 0
+        spec = ("replica_crash@1,replica_crash@2,replica_crash@3,"
+                "request_burst@1,slow_replica@5")
+        with chaos.inject(spec, seed=11) as plan:
+            for wave in range(6):
+                n = 2 * chaos.request_burst(wave)    # wave 1 bursts 8x
+                for _ in range(n):
+                    try:
+                        futs.append(srv.submit_async(_req(rng)))
+                    except Overloaded:
+                        shed += 1
+                time.sleep(0.01)
+            outcomes = _drain_all(futs, timeout=60)
+
+        # exactly-one-typed-outcome invariant: all futures terminal
+        assert len(outcomes) == len(futs)
+        assert "HUNG" not in outcomes, outcomes
+        assert set(outcomes) <= {"ok", "DeadlineExceeded", "Unavailable"}, \
+            outcomes
+        assert outcomes.count("ok") >= 1
+        snap = srv.snapshot()
+        # conservation: every admitted request accounted for exactly once
+        assert snap["admitted"] == len(futs)
+        assert snap["ok"] + snap["deadline_exceeded"] \
+            + snap["unavailable"] == len(futs)
+        assert snap["shed"] == shed
+        # bounded queue throughout the burst
+        assert snap["queue_depth_peak"] <= 8
+        # all scheduled faults actually fired
+        assert plan.pending() == [], plan.pending()
+
+        # breaker recovery: service returns to SERVING with closed
+        # breakers and answers correctly
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = srv.snapshot()
+            if all(r["breaker"] == CircuitBreaker.CLOSED
+                   for r in snap["replicas"]):
+                break
+            x = rng.rand(1, 4).astype(np.float32)
+            try:
+                srv.submit({"data": x}, timeout=10)
+            except ServingError:
+                pass
+            time.sleep(0.05)
+        assert all(r["breaker"] == CircuitBreaker.CLOSED
+                   for r in snap["replicas"]), snap
+        x = rng.rand(1, 4).astype(np.float32)
+        np.testing.assert_allclose(srv.submit({"data": x}, timeout=30)[0],
+                                   x @ wn.T, rtol=1e-5, atol=1e-6)
+        assert srv.snapshot()["state"] == serving.SERVING
+    finally:
+        srv.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: drain + reload
+# ---------------------------------------------------------------------------
+def test_drain_in_process_completes_admitted_rejects_new():
+    srv, _ = _server(max_queue=64, max_wait_ms=20)
+    try:
+        rng = np.random.RandomState(12)
+        futs = [srv.submit_async(_req(rng)) for _ in range(10)]
+        assert srv.drain(timeout=60) is True
+        assert _drain_all(futs, timeout=5) == ["ok"] * 10
+        with pytest.raises(Draining):
+            srv.submit_async(_req(rng))
+        assert srv.state == serving.STOPPED
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_sigterm_graceful_drain_exits_76(tmp_path):
+    """PR 2's supervise contract at serving granularity: SIGTERM
+    mid-burst -> every admitted request completes, new ones get a typed
+    Draining, process exits rc 76 (free restart under supervise)."""
+    from mxnet_tpu.elastic import PREEMPTED_EXIT_CODE
+
+    report = str(tmp_path / "report.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "serving_worker.py"), report],
+        capture_output=True, text=True, env=subprocess_env(),
+        cwd="/root/repo", timeout=300)
+    assert r.returncode == PREEMPTED_EXIT_CODE, \
+        "rc=%d\n%s\n%s" % (r.returncode, r.stdout, r.stderr)
+    import json
+    rep = json.load(open(report))
+    assert rep["outcomes"] == ["ok"] * rep["admitted"], rep
+    assert rep["draining_typed"] is True
+    assert rep["requested"] is True
+
+
+def test_hot_swap_reload_atomic():
+    """reload() swaps weights with zero downtime: requests before see
+    W1, after see W2, and nothing is rejected during the swap."""
+    sym, params1, w1 = _fc_model(seed=3)
+    _, params2, w2 = _fc_model(seed=4)
+    srv = ModelServer(sym, params1, input_shapes={"data": (1, 4)},
+                      max_batch=4, max_wait_ms=2, deadline_ms=20_000)
+    try:
+        rng = np.random.RandomState(13)
+        x = rng.rand(1, 4).astype(np.float32)
+        np.testing.assert_allclose(srv.submit({"data": x})[0], x @ w1.T,
+                                   rtol=1e-5, atol=1e-6)
+        srv.reload(params=params2)
+        np.testing.assert_allclose(srv.submit({"data": x})[0], x @ w2.T,
+                                   rtol=1e-5, atol=1e-6)
+        snap = srv.snapshot()
+        assert snap["reloads"] == 1
+        assert snap["state"] == serving.SERVING
+        assert snap["retired_pending"] == 0      # old replicas pruned
+    finally:
+        srv.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# satellites: predict.py hooks + bytes regression
+# ---------------------------------------------------------------------------
+def test_load_params_from_bytes_regression(tmp_path):
+    """_load_params(bytes) must not round-trip through a still-open
+    NamedTemporaryFile (broke on platforms without shared-open
+    semantics): it now loads straight from the in-memory buffer."""
+    sym, params, wn = _fc_model()
+    path = str(tmp_path / "m.params")
+    mx.nd.save(path, params)
+    blob = open(path, "rb").read()
+    arg, aux = _load_params(blob)
+    np.testing.assert_array_equal(arg["fc_weight"].asnumpy(), wn)
+    assert aux == {}
+    # bytearray/memoryview take the same path
+    arg2, _ = _load_params(bytearray(blob))
+    np.testing.assert_array_equal(arg2["fc_weight"].asnumpy(), wn)
+    # end to end: a Predictor built from raw bytes serves correctly
+    p = Predictor(sym, blob, input_shapes={"data": (2, 4)})
+    x = np.random.RandomState(14).rand(2, 4).astype(np.float32)
+    got = p.forward(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(got, x @ wn.T, rtol=1e-5)
+
+
+def test_predictor_warm_health_clone():
+    sym, params, wn = _fc_model()
+    p = Predictor(sym, params, input_shapes={"data": (1, 4)})
+    assert p.warm([1, 2, 4]) == [1, 2, 4]
+    before = profiler.dispatch_stats()["recompile"]
+    x = np.random.RandomState(15).rand(2, 4).astype(np.float32)
+    out = p.forward(data=mx.nd.array(x))[0].asnumpy()  # warmed shape
+    np.testing.assert_allclose(out, x @ wn.T, rtol=1e-5)
+    assert profiler.dispatch_stats()["recompile"] == before
+    assert p.health_check() is True
+    c = p.clone()
+    out2 = c.forward(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out2, out, rtol=0, atol=0)
+
+
+def test_chaos_serving_fault_kinds_registered():
+    for kind in ("slow_replica", "replica_crash", "request_burst"):
+        assert kind in chaos.FAULT_KINDS
+    # hooks are inert without an active plan
+    assert chaos.slow_replica(0) == 0.0
+    chaos.replica_crash(0)                      # must not raise
+    assert chaos.request_burst(0) == 1
+    with chaos.inject("slow_replica@1,request_burst@0") as plan:
+        assert chaos.slow_replica(0) == 0.0     # fault-local step 1, not 0
+        assert chaos.slow_replica(1) == 0.25
+        assert chaos.slow_replica(1) == 0.0     # fires exactly once
+        assert chaos.request_burst(0, factor=5) == 5
+        assert plan.pending() == []
+    with chaos.inject("replica_crash@0"):
+        with pytest.raises(chaos.InjectedReplicaCrash):
+            chaos.replica_crash(0)
