@@ -203,7 +203,9 @@ class Predictor:
     def health_check(self):
         """Liveness/sanity probe: one forward on zeros at the bound
         shapes; healthy iff it completes and every output is finite.
-        Used by the serving layer before (re)admitting a replica."""
+        The serving layer's circuit breaker runs this as its half-open
+        probe (``Replica.probe``) before readmitting a replica to live
+        traffic."""
         try:
             feed = {n: nd.zeros(tuple(self._executor.arg_dict[n].shape),
                                 dtype=self._executor.arg_dict[n].dtype,

@@ -27,8 +27,10 @@ NSDI'17) and TensorFlow-Serving (Olston et al., 2017):
 * **Per-replica circuit breaker** — ``threshold`` consecutive failures
   trip the breaker OPEN; after a bounded exponential backoff (shared
   :func:`mxnet_tpu.async_kv.backoff_delay` helper) it goes HALF_OPEN and
-  admits exactly one probe; a probe success closes it, a failure re-trips
-  with escalated backoff.  A tripped replica stops eating requests while
+  admits exactly one probe execution, which first proves the replica on
+  a zeros health check (``Predictor.health_check``) before it touches
+  live traffic; a healthy probe closes the breaker, an unhealthy one
+  re-trips with escalated backoff.  A tripped replica stops eating requests while
   the healthy ones carry the traffic (state: ``DEGRADED``).
 * **Lifecycle + graceful drain** — STARTING → SERVING → DEGRADED →
   DRAINING → STOPPED.  SIGTERM (via the existing
@@ -229,6 +231,12 @@ class CircuitBreaker:
     OPEN   --(backoff elapsed)--> HALF_OPEN (admits ONE probe)
     HALF_OPEN --probe ok--> CLOSED;  --probe fails--> OPEN (backoff doubles)
 
+    The server runs the probe as a zeros health check
+    (``Replica.probe`` -> ``Predictor.health_check``) before the
+    replica sees live traffic again.  A probe dispatch that gets
+    cancelled before running must call :meth:`release_probe` — that is
+    the only way the reserved slot frees without an outcome.
+
     The reopen backoff is the shared bounded-exponential-with-jitter
     helper the async-KV transport retries use
     (:func:`mxnet_tpu.async_kv.backoff_delay`).  All methods are called
@@ -283,6 +291,14 @@ class CircuitBreaker:
         self.reopen_at = None
         self.probe_inflight = False
 
+    def release_probe(self):
+        """Release a reserved half-open probe slot WITHOUT recording an
+        outcome — the probe execution was cancelled before it ran (e.g.
+        its batch settled first).  Without this the breaker would stay
+        HALF_OPEN with the slot taken forever and the replica would
+        never rejoin rotation."""
+        self.probe_inflight = False
+
     def record_failure(self, now):
         """Returns True when this failure tripped (or re-tripped) the
         breaker."""
@@ -334,6 +350,13 @@ class Replica:
             outs = self.predictor.forward(
                 **{k: nd.array(v) for k, v in feed.items()})
             return [np.asarray(o.asnumpy()) for o in outs]
+
+    def probe(self):
+        """Half-open health probe: ``Predictor.health_check()`` (one
+        zeros forward, finite outputs) under the same serialization lock
+        as live executions.  True iff the replica looks healthy."""
+        with self._lock:
+            return self.predictor.health_check()
 
 
 # ---------------------------------------------------------------------------
@@ -572,6 +595,29 @@ class ModelServer:
                     break
                 self._cv.wait(0.05)
             drained = not self._pending and not self._jobs
+            if not drained:
+                # drain timed out with work still unresolved.  The
+                # outcome contract (every admitted request gets exactly
+                # one typed terminal outcome) must survive the timeout:
+                # once the scheduler stops, deadline expiry never fires
+                # and an unresolved future would hang its caller forever.
+                aborted = 0
+                while self._pending:
+                    req = self._pending.popleft()
+                    self._reject_locked(req, Draining(
+                        "drain timed out after %.1fs with the request "
+                        "still queued" % timeout))
+                    aborted += 1
+                for job in self._jobs:
+                    for req in job.requests:
+                        if not req.done:
+                            self._reject_locked(req, Draining(
+                                "drain timed out after %.1fs with the "
+                                "request still in flight" % timeout))
+                            aborted += 1
+                self._prune_jobs_locked()
+                _log("drain timeout: aborted %d unresolved request(s) "
+                     "with typed Draining" % aborted)
             self._stop = True
             self._cv.notify_all()
         for _ in self._threads:
@@ -607,6 +653,9 @@ class ModelServer:
                 r.retired = True
             self._replicas = new
             self._retired.extend(old)
+            # admission validates against the NEW model's input names
+            # from this point on (they may differ from the old model's)
+            self._input_names = list(new[0].predictor._input_names)
             self._model_spec = (symbol,
                                 params if params is not None
                                 else old_params, shapes, ctx)
@@ -738,6 +787,10 @@ class ModelServer:
                 _count("bucket_padded_batches")
 
     def _dispatch_locked(self, job, repl, now, hedge=False):
+        # probe_inflight is True here iff THIS dispatch's allow() just
+        # reserved the half-open slot (one execution per replica at a
+        # time, and every earlier probe was settled or released)
+        probe = repl.breaker.probe_inflight
         repl.inflight += 1
         job.inflight_execs += 1
         job.tried.add(repl.id)
@@ -746,7 +799,7 @@ class ModelServer:
             job.hedge_at = now + self.hedge_ms / 1e3
         idx = self._exec_seq
         self._exec_seq += 1
-        self._dispatch_q.put((job, repl, idx))
+        self._dispatch_q.put((job, repl, idx, hedge, probe))
 
     def _assign_locked(self, now):
         for job in self._jobs:
@@ -844,15 +897,41 @@ class ModelServer:
             item = self._dispatch_q.get()
             if item is None:
                 return
-            job, repl, idx = item
+            job, repl, idx, is_hedge, is_probe = item
             with self._cv:
                 if job.unresolved == 0:
                     # first-wins cancellation: the batch settled (hedge
                     # winner or deadline) before this execution started
                     repl.inflight -= 1
                     job.inflight_execs -= 1
+                    if is_probe:
+                        # the half-open slot this dispatch reserved must
+                        # be released, or the breaker wedges HALF_OPEN
+                        # and the replica never rejoins rotation
+                        repl.breaker.release_probe()
                     self.stats["wasted_executions"] += 1
                     self._cv.notify_all()
+                    continue
+            if is_probe:
+                # half-open readmission: the replica proves itself on a
+                # zeros health check (Predictor.health_check) BEFORE it
+                # touches live traffic; the check runs outside the lock
+                healthy = repl.probe()
+                with self._cv:
+                    if healthy:
+                        repl.breaker.record_success()
+                    else:
+                        repl.inflight -= 1
+                        job.inflight_execs -= 1
+                        repl.breaker.record_failure(time.monotonic())
+                        # the batch never actually ran here: let it
+                        # retry this replica after the next backoff
+                        job.tried.discard(repl.id)
+                        _log("replica %d failed half-open health probe"
+                             % repl.id)
+                        self._recompute_state_locked()
+                        self._cv.notify_all()
+                if not healthy:
                     continue
             # chaos + compute happen OUTSIDE every lock (CC001)
             delay = _chaos.slow_replica(idx)
@@ -875,7 +954,7 @@ class ModelServer:
                     self._ewma_latency = (
                         (1 - _EWMA_ALPHA) * self._ewma_latency
                         + _EWMA_ALPHA * dt)
-                    self._settle_job_locked(job, outs)
+                    self._settle_job_locked(job, outs, is_hedge)
                 else:
                     job.failures += 1
                     repl.breaker.record_failure(now)
@@ -884,7 +963,7 @@ class ModelServer:
                 self._recompute_state_locked()
                 self._cv.notify_all()
 
-    def _settle_job_locked(self, job, outs):
+    def _settle_job_locked(self, job, outs, from_hedge=False):
         resolved = 0
         for req, off in zip(job.requests, job.offsets):
             if req.done:
@@ -893,7 +972,9 @@ class ModelServer:
                 resolved += 1
         if resolved:
             self.stats["ok"] += resolved
-            if job.hedged:
+            # a hedge "win" is only when the HEDGE execution settled the
+            # job — a primary win on a hedged job is not hedging benefit
+            if from_hedge:
                 self.stats["hedge_wins"] += 1
         else:
             self.stats["wasted_executions"] += 1

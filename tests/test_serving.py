@@ -312,6 +312,79 @@ def test_circuit_breaker_unit():
     assert br.allow(again)
 
 
+def test_circuit_breaker_release_probe_unwedges_slot():
+    """A probe dispatch cancelled before running (its batch settled
+    first) must release the reserved half-open slot — otherwise the
+    breaker stays HALF_OPEN with probe_inflight forever and the replica
+    never rejoins rotation (REVIEW: probe-slot leak)."""
+    br = CircuitBreaker(threshold=1, backoff=10.0, backoff_cap=10.0)
+    now = 1000.0
+    assert br.record_failure(now)            # trips immediately
+    later = br.reopen_at + 0.001
+    assert br.allow(later)                   # half-open, slot reserved
+    assert br.probe_inflight and not br.would_allow(later)
+    br.release_probe()                       # cancelled before running
+    assert br.state == br.HALF_OPEN
+    assert br.would_allow(later)             # replica back in rotation
+    assert br.allow(later)                   # next probe reserves again
+    br.record_success()
+    assert br.state == br.CLOSED
+
+
+def test_half_open_probe_is_health_check():
+    """Half-open readmission probes with Predictor.health_check (zeros
+    forward) BEFORE live traffic: an unhealthy replica never closes its
+    breaker, a healthy one recovers."""
+    srv, wn = _server(n_replicas=1, breaker_threshold=1,
+                      breaker_backoff=0.05, breaker_backoff_cap=0.1)
+    try:
+        rng = np.random.RandomState(21)
+        with chaos.inject("replica_crash@0"):
+            with pytest.raises(Unavailable):
+                srv.submit(_req(rng), timeout=30)
+        assert srv.snapshot()["replicas"][0]["breaker"] != \
+            CircuitBreaker.CLOSED
+        repl = srv._replicas[0]
+        orig, calls = repl.predictor.health_check, []
+        repl.predictor.health_check = \
+            lambda: (calls.append(1), False)[1]
+        try:
+            # every probe fails the zeros check: the breaker never
+            # closes and the request times out typed, not hung
+            with pytest.raises(DeadlineExceeded):
+                srv.submit(_req(rng), deadline_ms=600, timeout=30)
+            assert len(calls) >= 1
+            assert srv.snapshot()["replicas"][0]["breaker"] != \
+                CircuitBreaker.CLOSED
+        finally:
+            repl.predictor.health_check = orig
+        # healthy probe readmits: request served, breaker closes
+        x = rng.rand(1, 4).astype(np.float32)
+        np.testing.assert_allclose(srv.submit({"data": x}, timeout=30)[0],
+                                   x @ wn.T, rtol=1e-5, atol=1e-6)
+        assert srv.snapshot()["replicas"][0]["breaker"] == \
+            CircuitBreaker.CLOSED
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_hedge_wins_only_counts_hedge_settling():
+    """A primary win on a hedged job is NOT a hedge win: both replicas
+    stall, the hedge fires, the primary still finishes first —
+    hedges_fired bumps but hedge_wins stays 0."""
+    srv, _ = _server(n_replicas=2, hedge_ms=60, max_wait_ms=1)
+    try:
+        rng = np.random.RandomState(22)
+        with chaos.inject("slow_replica@0,slow_replica@1"):
+            out = srv.submit(_req(rng), timeout=30)
+        assert out is not None
+        snap = srv.snapshot()
+        assert snap["hedges_fired"] >= 1
+        assert snap["hedge_wins"] == 0
+    finally:
+        srv.drain(timeout=30)
+
+
 # ---------------------------------------------------------------------------
 # THE acceptance scenario: chaos burst + crash
 # ---------------------------------------------------------------------------
@@ -397,6 +470,50 @@ def test_drain_in_process_completes_admitted_rejects_new():
         assert srv.state == serving.STOPPED
     finally:
         srv.drain(timeout=10)
+
+
+def test_drain_timeout_rejects_unresolved_typed():
+    """drain(timeout) that expires with work still in flight must NOT
+    leave futures unresolved (a caller in result() would hang forever
+    once the scheduler stops): survivors get a typed Draining."""
+    srv, _ = _server(max_wait_ms=1)
+    rng = np.random.RandomState(23)
+    with chaos.inject("slow_replica@0"):
+        fut = srv.submit_async(_req(rng))
+        time.sleep(0.05)                 # dispatched and stalled ~250ms
+        assert srv.drain(timeout=0.1) is False
+        with pytest.raises(Draining):
+            fut.result(timeout=5)
+    assert srv.state == serving.STOPPED
+
+
+def test_reload_refreshes_input_names():
+    """reload() with a model whose input names differ must validate
+    admissions against the NEW names (stale names rejected well-formed
+    requests for the new model)."""
+    data = mx.sym.var("tokens")
+    w = mx.sym.var("fc2_weight")
+    b = mx.sym.var("fc2_bias")
+    sym2 = mx.sym.FullyConnected(data, w, b, num_hidden=5, name="fc2")
+    rng = np.random.RandomState(24)
+    w2 = rng.rand(5, 4).astype(np.float32)
+    params2 = {"arg:fc2_weight": mx.nd.array(w2),
+               "arg:fc2_bias": mx.nd.zeros((5,))}
+
+    srv, _ = _server()
+    try:
+        from mxnet_tpu.predict import Predictor as _P
+
+        x = rng.rand(1, 4).astype(np.float32)
+        assert srv.submit({"data": x}) is not None
+        p2 = _P(sym2, params2, input_shapes={"tokens": (1, 4)})
+        srv.reload(symbol=sym2, predictors=[p2])
+        np.testing.assert_allclose(srv.submit({"tokens": x})[0],
+                                   x @ w2.T, rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError):      # old name now unknown
+            srv.submit_async({"data": x})
+    finally:
+        srv.drain(timeout=30)
 
 
 def test_sigterm_graceful_drain_exits_76(tmp_path):
