@@ -10,6 +10,7 @@
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
+#   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
 
@@ -39,10 +40,13 @@ chaos:
 serve-smoke:
 	bash ci/runtime_functions.sh serving_check
 
+obs-smoke:
+	bash ci/runtime_functions.sh obs_check
+
 ci:
 	bash ci/runtime_functions.sh all
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke obs-smoke ci clean

@@ -10,19 +10,36 @@ weights via the optimizer's multi-precision states), SoftmaxCrossEntropyLoss,
 sgd+momentum — with the whole train step compiled to ONE XLA module
 (`gluon.contrib.FusedTrainStep`).
 
+Blackout-proof harness (docs/OBSERVABILITY.md): the round is a sequence of
+independently budgeted LEGS.  Each leg runs under its own SIGALRM budget
+(BENCH_LEG_BUDGET_<NAME> overrides the default), so a leg that blows its
+budget times out ALONE — every other leg still runs and the round still
+emits its records (round 5 of this repo produced rc 124 / zero data when
+one global watchdog fired; never again).  Each leg's record is flushed
+incrementally to BENCH_PARTIAL_PATH (default bench_partial.jsonl, one
+JSON line per leg) the moment the leg ends, and the final single-line
+JSON still always prints.  All legs share one process, so the persistent
+XLA compile cache (MXNET_COMPILE_CACHE, armed before import) and every
+in-process jit cache carry across legs.
+
 Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (default 30),
 BENCH_MODEL (default resnet50_v1), BENCH_DTYPE (default bfloat16),
-BENCH_BUDGET_S (wall-clock budget, default 480 — a SIGALRM watchdog
-flushes whatever was measured so far and exits 0), BENCH_QUICK / --quick
-(small model, few steps, primary leg only; auto-enabled on the CPU
-backend where the full resnet50 sweep cannot finish inside the budget),
+BENCH_BUDGET_S (global wall-clock ceiling, default 480), BENCH_QUICK /
+--quick (small model, few steps; auto-enabled on the CPU backend),
+BENCH_LEGS (comma list: run only these legs), BENCH_FORCE_TIMEOUT_LEG
+(burn the named leg's budget so its watchdog fires — the harness's own
+regression test), BENCH_PARTIAL_PATH, BENCH_BASELINE /
+BENCH_REGRESSION_STRICT (regression tripwire vs the last recorded
+round: >10% drop on a leg metric is flagged; strict mode exits 3),
 BENCH_COMPILE_CACHE (persistent XLA compile cache, on by default; 0
-disables).  Always prints ONE parseable JSON line and exits 0 — partial
-results carry "skipped (budget)" markers instead of dying at rc 124.
+disables).  Always prints ONE parseable JSON line and exits 0 (3 only
+in strict regression mode) — partial results carry per-leg status
+markers instead of dying at rc 124.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -55,27 +72,214 @@ def _remaining():
     return _budget_s() - (time.monotonic() - _T0)
 
 
-def _leg_ok(extra, name, need):
-    """True when ~`need` seconds of budget remain for leg `name`;
-    otherwise record the skip so the report says why the key is absent."""
-    if _remaining() < need:
+def _alarm_handler(signum, frame):
+    raise BudgetExceeded("bench watchdog fired")
+
+
+def _arm(seconds):
+    """(Re)arm the SIGALRM watchdog for ``seconds`` (0 cancels).  Safe
+    no-op off the main thread / on platforms without SIGALRM."""
+    try:
+        import signal
+
+        if seconds:
+            signal.signal(signal.SIGALRM, _alarm_handler)
+            signal.alarm(max(1, int(math.ceil(seconds))))
+        else:
+            signal.alarm(0)
+    except (ValueError, OSError, AttributeError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# leg harness
+# ---------------------------------------------------------------------------
+def _partial_path():
+    return os.environ.get("BENCH_PARTIAL_PATH", "bench_partial.jsonl")
+
+
+def _reset_partial():
+    try:
+        with open(_partial_path(), "w"):
+            pass
+    except OSError:
+        pass
+
+
+def _flush_leg(name, status, record, elapsed):
+    """Append this leg's record to the incremental JSONL file NOW — if a
+    later leg (or the whole process) dies, everything measured so far is
+    already on disk."""
+    line = {"leg": name, "status": status,
+            "elapsed_s": round(elapsed, 1), "record": record}
+    try:
+        with open(_partial_path(), "a") as f:
+            f.write(json.dumps(line) + "\n")
+            f.flush()
+    except (OSError, TypeError, ValueError):
+        pass
+
+
+def _selected_legs():
+    sel = os.environ.get("BENCH_LEGS", "").strip()
+    if not sel:
+        return None
+    return {s.strip() for s in sel.split(",") if s.strip()}
+
+
+def _leg_budget(name, default_need):
+    try:
+        return float(os.environ.get(
+            "BENCH_LEG_BUDGET_" + name.upper(), default_need))
+    except ValueError:
+        return default_need
+
+
+def _run_leg(extra, name, fn, need):
+    """Run one leg under its own SIGALRM budget.  A timeout or error
+    kills THIS leg only; its status lands in ``extra`` and the record
+    (or lack of one) is flushed incrementally.  Returns the record dict
+    on success, else None."""
+    selected = _selected_legs()
+    if selected is not None and name not in selected:
+        extra[name + "_status"] = "skipped (BENCH_LEGS)"
+        return None
+    need = _leg_budget(name, need)
+    remaining = _remaining()
+    if remaining < min(need, 10.0):
         extra[name + "_status"] = "skipped (budget)"
-        return False
-    return True
+        _flush_leg(name, "skipped (budget)", {}, 0.0)
+        return None
+    budget = min(need, remaining)
+    forced = os.environ.get("BENCH_FORCE_TIMEOUT_LEG", "") == name
+    if forced:
+        budget = min(budget, 1.5)
+    t0 = time.monotonic()
+    record, status = {}, "ok"
+    _arm(budget)
+    try:
+        if forced:
+            # burn this leg's budget so its watchdog fires: proves a
+            # timed-out leg cannot take the round down with it
+            while True:
+                time.sleep(0.05)
+        record = fn() or {}
+    except BudgetExceeded:
+        status = "timeout (leg budget %.0fs)" % budget
+    except Exception as e:  # one leg must never sink the round
+        status = "error: %s: %s" % (type(e).__name__, e)
+    finally:
+        # hand the watchdog back to the global ceiling between legs
+        rem = _remaining()
+        _arm(rem if rem > 0 else 1)
+    elapsed = time.monotonic() - t0
+    if status == "ok":
+        extra.update(record)
+    extra[name + "_status"] = status
+    _flush_leg(name, status, record, elapsed)
+    return record if status == "ok" else None
 
 
+# ---------------------------------------------------------------------------
+# regression tripwire
+# ---------------------------------------------------------------------------
+_HIGHER_BETTER = ("_img_per_sec", "_per_sec", "_tokens_per_sec", "mfu",
+                  "_vs_bf16", "_vs_baseline", "_vs_v100_fp16", "value")
+_LOWER_BETTER = ("_ms",)
+
+
+def _flat_metrics(result):
+    out = {}
+    v = result.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+        out["value"] = float(v)
+    for k, val in (result.get("extra") or {}).items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[k] = float(val)
+    return out
+
+
+def _direction(key):
+    for s in _HIGHER_BETTER:
+        if key.endswith(s):
+            return 1
+    for s in _LOWER_BETTER:
+        if key.endswith(s):
+            return -1
+    return 0
+
+
+def check_regressions(result, baseline_path=None, threshold=0.10):
+    """Compare this round's leg metrics against the last recorded round
+    (BENCH_BASELINE, or the newest parseable BENCH_r*.json next to this
+    script with a matching platform) and flag any metric that moved
+    >``threshold`` in the bad direction — throughput/MFU drops, latency
+    increases.  Returns {status, baseline, flagged:[...]}; never
+    raises."""
+    try:
+        path = baseline_path or os.environ.get("BENCH_BASELINE", "")
+        base = None
+        if path:
+            with open(path) as f:
+                base = json.load(f)
+        else:
+            import glob
+
+            here = os.path.dirname(os.path.abspath(__file__))
+            for cand in sorted(glob.glob(os.path.join(here,
+                                                      "BENCH_r*.json")),
+                               reverse=True):
+                try:
+                    with open(cand) as f:
+                        loaded = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if isinstance(loaded, dict) and loaded.get("value"):
+                    base, path = loaded, cand
+                    break
+        if not isinstance(base, dict):
+            return {"status": "skipped (no baseline)"}
+        bplat = (base.get("extra") or {}).get("platform")
+        nplat = (result.get("extra") or {}).get("platform")
+        if bplat != nplat:
+            return {"status": "skipped (platform mismatch: baseline %s, "
+                              "current %s)" % (bplat, nplat),
+                    "baseline": os.path.basename(path)}
+        old_m, new_m = _flat_metrics(base), _flat_metrics(result)
+        flagged = []
+        for key, old in sorted(old_m.items()):
+            new = new_m.get(key)
+            direction = _direction(key)
+            if new is None or old <= 0 or direction == 0:
+                continue
+            drop = ((old - new) / old) * direction
+            if drop > threshold:
+                flagged.append({"metric": key,
+                                "baseline": round(old, 4),
+                                "current": round(new, 4),
+                                "drop_pct": round(drop * 100.0, 1)})
+        return {"status": "checked",
+                "baseline": os.path.basename(path),
+                "flagged": flagged}
+    except Exception as e:
+        return {"status": "error: %s: %s" % (type(e).__name__, e)}
+
+
+# ---------------------------------------------------------------------------
+# the round
+# ---------------------------------------------------------------------------
 def main(argv=None):
     import argparse
 
     ap = argparse.ArgumentParser(
         description="mxnet_tpu training/inference benchmark")
     ap.add_argument("--quick", action="store_true",
-                    help="small model, few steps, primary leg only")
+                    help="small model, few steps, primary legs only")
     cli, _ = ap.parse_known_args(argv)
 
     # Persistent XLA compile cache: armed BEFORE mxnet_tpu imports (the
-    # cache only takes effect if configured before the first compile).
-    # Repeat runs then skip every recompilation.
+    # cache only takes effect if configured before the first compile),
+    # then shared by every leg in this round AND by the next round.
     if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
         os.environ.setdefault("MXNET_COMPILE_CACHE", "auto")
 
@@ -83,7 +287,7 @@ def main(argv=None):
     import jax
 
     import mxnet_tpu as mx
-    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu import gluon, profiler, telemetry
     from mxnet_tpu.gluon.contrib import FusedTrainStep
     from mxnet_tpu.gluon.model_zoo import vision
 
@@ -112,177 +316,198 @@ def main(argv=None):
     extra["compile_cache_dir"] = mx.runtime.compile_cache_dir()
     RESULT["metric"] = "%s_train_img_per_sec_b%d_%s_%s" % (
         model_name.split("_")[0], batch, dtype, platform)
+    _reset_partial()
 
-    net = getattr(vision, model_name)(classes=1000)
-    net.initialize(mx.init.Xavier(), ctx=ctx)
-    net.hybridize(static_alloc=True, static_shape=True)
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # shared training context, built lazily INSIDE the first leg that
+    # needs it (so BENCH_LEGS=serving,transformer never compiles resnet,
+    # and the build time is charged to a leg budget, not the round)
+    tctx = {}
 
-    rng = np.random.RandomState(0)
-    x32 = mx.nd.array(rng.rand(batch, 3, size, size).astype(np.float32),
-                      ctx=ctx)
-    y = mx.nd.array(rng.randint(0, 1000, (batch,)), ctx=ctx)
-
-    # finish deferred init in fp32, then cast the net to the compute dtype
-    # (BatchNorm keeps its statistics in fp32; the optimizer holds fp32
-    # master weights — the reference's mp_sgd flow)
-    with mx.autograd.pause():
-        net(x32)
-    multi_precision = dtype != "float32"
-    if multi_precision:
-        net.cast(dtype)
-    x = x32.astype(dtype) if multi_precision else x32
-
-    trainer = gluon.Trainer(
-        net.collect_params(), "sgd",
-        {"learning_rate": 0.05, "momentum": 0.9,
-         "multi_precision": multi_precision})
-    step = FusedTrainStep(net, loss_fn, trainer)
-
-    # ---- training ----
-    for _ in range(2 if quick else 3):  # warmup: compile fwd+bwd+update
-        loss = step(x, y)
-    loss.wait_to_read()
-
-    # best-of-N repetitions (remote-tunnel jitter); every timed region
-    # ends with a HOST VALUE FETCH, not just a ready-barrier — the
-    # remote runtime can acknowledge un-materialized buffers, which
-    # makes barrier-only timings read impossibly fast.  The train loop
-    # is naturally serialized through the donated parameter chain.
     def host_fetch(arr):
-        arr.asnumpy()  # materialize on host: the real execution barrier
+        # materialize on host: the real execution barrier — the remote
+        # runtime can acknowledge un-materialized buffers, which makes
+        # barrier-only timings read impossibly fast
+        arr.asnumpy()
 
-    train_img_s = 0.0
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(steps):
+    def ensure_train_ctx():
+        if tctx:
+            return tctx
+        net = getattr(vision, model_name)(classes=1000)
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        net.hybridize(static_alloc=True, static_shape=True)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        x32 = mx.nd.array(rng.rand(batch, 3, size, size).astype(np.float32),
+                          ctx=ctx)
+        y = mx.nd.array(rng.randint(0, 1000, (batch,)), ctx=ctx)
+        # finish deferred init in fp32, then cast the net to the compute
+        # dtype (BatchNorm keeps its statistics in fp32; the optimizer
+        # holds fp32 master weights — the reference's mp_sgd flow)
+        with mx.autograd.pause():
+            net(x32)
+        multi_precision = dtype != "float32"
+        if multi_precision:
+            net.cast(dtype)
+        x = x32.astype(dtype) if multi_precision else x32
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": multi_precision})
+        step = FusedTrainStep(net, loss_fn, trainer)
+        for _ in range(2 if quick else 3):  # warmup: compile fwd+bwd+update
             loss = step(x, y)
         host_fetch(loss)
-        dt = time.perf_counter() - t0
-        train_img_s = max(train_img_s, batch * steps / dt)
-        # publish after every rep so the watchdog flush has the best so far
-        RESULT["value"] = round(train_img_s, 2)
-        RESULT["vs_baseline"] = round(
-            train_img_s / TRAIN_BASELINE_IMG_S, 4)
-        extra["train_steps_per_sec"] = round(train_img_s / batch, 2)
-        if _remaining() < 0:
-            raise BudgetExceeded("train loop consumed the budget")
+        tctx.update(net=net, loss_fn=loss_fn, trainer=trainer, step=step,
+                    x=x, y=y)
+        return tctx
 
-    extra["loss_final"] = float(np.asarray(
-        loss.asnumpy(), dtype=np.float32).mean())
-    extra["dispatch"] = profiler.dispatch_stats()
+    # ---- legs -----------------------------------------------------------
+    def train_leg():
+        c = ensure_train_ctx()
+        step, x, y = c["step"], c["x"], c["y"]
+        # best-of-N repetitions (remote-tunnel jitter); every timed
+        # region ends with a HOST VALUE FETCH, not just a ready-barrier.
+        # The train loop is naturally serialized through the donated
+        # parameter chain.
+        train_img_s = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(x, y)
+            host_fetch(loss)
+            dt = time.perf_counter() - t0
+            train_img_s = max(train_img_s, batch * steps / dt)
+            # publish after every rep so a watchdog flush has the best
+            # so far
+            RESULT["value"] = round(train_img_s, 2)
+            RESULT["vs_baseline"] = round(
+                train_img_s / TRAIN_BASELINE_IMG_S, 4)
+            extra["train_steps_per_sec"] = round(train_img_s / batch, 2)
+        out = {"loss_final": float(np.asarray(
+            loss.asnumpy(), dtype=np.float32).mean())}
+        # live cost-analysis gauges the step accountant published during
+        # the loop (docs/OBSERVABILITY.md): MFU + HBM utilization with
+        # zero device syncs
+        gauges = telemetry.registry().snapshot()["gauges"]
+        for src, dst in (("train.fused.mfu", "train_mfu"),
+                         ("train.fused.hbm_util", "train_hbm_util"),
+                         ("train.fused.items_per_sec",
+                          "train_live_img_per_sec")):
+            if src in gauges:
+                out[dst] = round(gauges[src], 4)
+        return out
 
-    # ---- numerical-health sentinel overhead ----
-    # same net/trainer with the guard armed: the fused finiteness
-    # reduction + lax.cond containment must stay within the 3%
-    # acceptance budget (docs/NUMERICAL_HEALTH.md).  The unguarded step
-    # is RE-timed here, interleaved rep-for-rep with the guarded one —
-    # the primary train leg ran minutes earlier and machine drift
-    # between legs would otherwise swamp a single-digit overhead
-    if _leg_ok(extra, "sentinel", need=15 if quick else 45):
-        try:
-            guard_step = FusedTrainStep(net, loss_fn, trainer,
-                                        numeric_guard="skip")
-            for _ in range(2 if quick else 3):  # warmup: separate module
-                gloss = guard_step(x, y)
-            host_fetch(gloss)
-            # same total step budget as one train leg, but split into
-            # short back-to-back base/guard window PAIRS; the overhead
-            # is the MEDIAN per-pair ratio — host interference lands on
-            # one window of one pair and would be read as sentinel cost
-            # (or savings) by a mean or an extreme, while the median
-            # pair is clean on a mostly-idle machine
-            win = max(2, steps // 2)
-            guard_img_s, ratios = 0.0, []
-            for _ in range(3 * reps):
-                dts = {}
-                for tag, s in (("base", step), ("guard", guard_step)):
-                    t0 = time.perf_counter()
-                    for _ in range(win):
-                        gloss = s(x, y)
-                    host_fetch(gloss)
-                    dts[tag] = time.perf_counter() - t0
-                guard_img_s = max(guard_img_s,
-                                  batch * win / dts["guard"])
-                ratios.append(dts["guard"] / dts["base"] - 1.0)
-            ratios.sort()
-            mid = len(ratios) // 2
-            overhead = (ratios[mid] if len(ratios) % 2
-                        else (ratios[mid - 1] + ratios[mid]) / 2.0)
-            extra["sentinel_guard_img_per_sec"] = round(guard_img_s, 2)
-            extra["sentinel_overhead_pct"] = round(overhead * 100.0, 2)
-        except Exception as e:  # secondary metric must not sink the run
-            extra["sentinel_error"] = "%s: %s" % (type(e).__name__, e)
+    def sentinel_leg():
+        # same net/trainer with the guard armed: the fused finiteness
+        # reduction + lax.cond containment must stay within the 3%
+        # acceptance budget (docs/NUMERICAL_HEALTH.md).  Interleaved
+        # base/guard window pairs; the overhead is the MEDIAN per-pair
+        # ratio — host interference lands on one window of one pair and
+        # would be read as sentinel cost (or savings) by a mean or an
+        # extreme, while the median pair is clean on a mostly-idle
+        # machine.
+        c = ensure_train_ctx()
+        step, x, y = c["step"], c["x"], c["y"]
+        guard_step = FusedTrainStep(c["net"], c["loss_fn"], c["trainer"],
+                                    numeric_guard="skip")
+        for _ in range(2 if quick else 3):  # warmup: separate module
+            gloss = guard_step(x, y)
+        host_fetch(gloss)
+        win = max(2, steps // 2)
+        guard_img_s, ratios = 0.0, []
+        for _ in range(3 if quick else 3 * reps):
+            dts = {}
+            for tag, s in (("base", step), ("guard", guard_step)):
+                t0 = time.perf_counter()
+                for _ in range(win):
+                    gloss = s(x, y)
+                host_fetch(gloss)
+                dts[tag] = time.perf_counter() - t0
+            guard_img_s = max(guard_img_s, batch * win / dts["guard"])
+            ratios.append(dts["guard"] / dts["base"] - 1.0)
+        ratios.sort()
+        mid = len(ratios) // 2
+        overhead = (ratios[mid] if len(ratios) % 2
+                    else (ratios[mid - 1] + ratios[mid]) / 2.0)
+        return {"sentinel_guard_img_per_sec": round(guard_img_s, 2),
+                "sentinel_overhead_pct": round(overhead * 100.0, 2)}
 
-    # ---- inference ----
-    # two disciplines (mxnet_tpu/benchmark.py): the compiled K-step loop
-    # (one dispatch per draw — measures the device, stable to a few
-    # percent, the gate metric) and the per-dispatch user path (tunnel-
-    # sensitive, published with its spread).
-    from mxnet_tpu.benchmark import compiled_throughput, percall_throughput
+    def inference_leg():
+        # two disciplines (mxnet_tpu/benchmark.py): the compiled K-step
+        # loop (one dispatch per draw — measures the device, the gate
+        # metric) and the per-dispatch user path (tunnel-sensitive,
+        # published with its spread).
+        from mxnet_tpu.benchmark import (compiled_throughput,
+                                         percall_throughput)
 
-    infer_img_s = None
-    if _leg_ok(extra, "inference", need=20 if quick else 60):
+        c = ensure_train_ctx()
+        net, x = c["net"], c["x"]
         draws = 2 if quick else 5
         dev = compiled_throughput(net, x, steps=steps, draws=draws)
         percall = percall_throughput(net, x, steps=steps, draws=draws)
-        infer_img_s = dev["median"]
-        extra.update({
-            "inference_img_per_sec": round(infer_img_s, 2),
+        tctx["infer_img_s"] = dev["median"]
+        return {
+            "inference_img_per_sec": round(dev["median"], 2),
             "inference_img_per_sec_spread": [round(dev["min"], 2),
                                              round(dev["max"], 2)],
             "inference_percall_img_per_sec": round(percall["median"], 2),
             "inference_percall_spread": [round(percall["min"], 2),
                                          round(percall["max"], 2)],
             "inference_vs_v100_fp16": round(
-                infer_img_s / INFER_BASELINE_IMG_S, 4),
-        })
+                dev["median"] / INFER_BASELINE_IMG_S, 4),
+        }
 
-    # ---- serving front (overload-safe layer, docs/SERVING.md) ----
-    # p50/p99 request latency + shed rate through ModelServer, and the
-    # steady-state p99 overhead of the serving front (admission queue +
-    # batcher + breaker bookkeeping) over a bare Predictor.forward loop
-    if os.environ.get("BENCH_SERVING", "1") != "0" and \
-            _leg_ok(extra, "serving", need=20 if quick else 45):
-        try:
-            extra.update(serving_bench(quick=quick))
-        except Exception as e:  # secondary metric must not sink the run
-            extra["serving_error"] = "%s: %s" % (type(e).__name__, e)
+    def serving_leg():
+        return serving_bench(quick=quick)
 
-    # secondary legs: skipped wholesale in quick mode, and individually
-    # when the remaining budget can't plausibly cover them
-    if not quick:
+    def latency_b1_leg():
         # batch-1 serving latency, 100 chained steps/dispatch so the
         # tunnel RTT amortizes away (docs/PERF_LATENCY.md)
-        if _leg_ok(extra, "latency_b1", need=40):
-            try:
-                r1 = compiled_throughput(net, x[0:1], steps=100, draws=3)
-                b1key = "latency_b1_%s" % model_name
-                extra[b1key + "_img_per_sec"] = round(r1["median"], 1)
-                extra[b1key + "_ms"] = round(1000.0 / r1["median"], 3)
-            except Exception as e:
-                extra["latency_b1_error"] = "%s: %s" % (type(e).__name__, e)
-        if os.environ.get("BENCH_INT8", "1") != "0" and \
-                _leg_ok(extra, "int8", need=90):
-            try:
-                extra.update(int8_bench(batch=batch, steps=steps,
-                                        bf16_img_s=infer_img_s))
-            except Exception as e:  # secondary metric must not sink the run
-                extra["int8_error"] = "%s: %s" % (type(e).__name__, e)
-        if os.environ.get("BENCH_TRANSFORMER", "1") != "0" and \
-                _leg_ok(extra, "transformer", need=90):
-            try:
-                extra.update(transformer_bench())
-            except Exception as e:  # secondary metric must not sink the run
-                extra["transformer_error"] = "%s: %s" % (type(e).__name__, e)
-        if os.environ.get("BENCH_LONGCTX", "1") != "0" and \
-                _leg_ok(extra, "longctx", need=120):
-            try:
-                extra.update(long_context_bench())
-            except Exception as e:
-                extra["longctx_error"] = "%s: %s" % (type(e).__name__, e)
+        from mxnet_tpu.benchmark import compiled_throughput
+
+        c = ensure_train_ctx()
+        r1 = compiled_throughput(c["net"], c["x"][0:1], steps=100, draws=3)
+        b1key = "latency_b1_%s" % model_name
+        return {b1key + "_img_per_sec": round(r1["median"], 1),
+                b1key + "_ms": round(1000.0 / r1["median"], 3)}
+
+    def int8_leg():
+        return int8_bench(batch=batch, steps=steps,
+                          bf16_img_s=tctx.get("infer_img_s"))
+
+    def transformer_leg():
+        return transformer_bench(quick=quick)
+
+    def longctx_leg():
+        return long_context_bench()
+
+    # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
+    # builds a second XLA module — so some exceed their full-mode numbers
+    legs = [
+        ("train", train_leg, 150 if quick else 240),
+        ("sentinel", sentinel_leg, 60 if quick else 45),
+        ("inference", inference_leg, 45 if quick else 60),
+        ("serving", serving_leg, 25 if quick else 45),
+    ]
+    if not quick:
+        legs.append(("latency_b1", latency_b1_leg, 40))
+        if os.environ.get("BENCH_INT8", "1") != "0":
+            legs.append(("int8", int8_leg, 120))
+    # the transformer leg runs in quick mode too: its record carries the
+    # cost-analysis-derived "mfu", the number the observability layer is
+    # accepted on
+    if os.environ.get("BENCH_TRANSFORMER", "1") != "0":
+        legs.append(("transformer", transformer_leg, 90 if quick else 120))
+    if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
+        legs.append(("longctx", longctx_leg, 150))
+    if os.environ.get("BENCH_SERVING", "1") == "0":
+        legs = [leg for leg in legs if leg[0] != "serving"]
+
+    for name, fn, need in legs:
+        _run_leg(extra, name, fn, need)
 
     extra["dispatch"] = profiler.dispatch_stats()
+    extra["regression_check"] = check_regressions(RESULT)
     extra["elapsed_s"] = round(time.monotonic() - _T0, 1)
 
 
@@ -291,11 +516,13 @@ def serving_bench(quick=False):
     p50/p99 through :class:`mxnet_tpu.serving.ModelServer` vs the bare
     ``Predictor.forward`` loop on the SAME model in the SAME process
     (drift-immune overhead reading), plus the shed rate under a
-    synthetic burst at 4x the admission cap."""
+    synthetic burst at 4x the admission cap.  The served p50/p99 are
+    read from the telemetry layer's ``serving.latency_ms`` histogram —
+    the same numbers a production scrape of the registry reports."""
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import serving
+    from mxnet_tpu import serving, telemetry
     from mxnet_tpu.predict import Predictor
 
     n_req = 100 if quick else 400
@@ -342,6 +569,7 @@ def serving_bench(quick=False):
     # max_wait 0: a closed-loop sequential client would otherwise spend
     # every request waiting out the batching timer, which would read as
     # front overhead when it is really idle batching slack
+    hist = telemetry.registry().histogram("serving.latency_ms")
     srv = serving.ModelServer(sym, dict(params),
                               input_shapes={"data": (1, d_in)},
                               max_queue=max_queue, max_batch=8,
@@ -349,18 +577,19 @@ def serving_bench(quick=False):
     try:
         for x in xs:
             srv.submit({"data": x})  # settle the EWMA + caches
-        lat = []
+        hist.reset()                 # measurement window starts here
         for i in range(n_req):
-            t0 = time.perf_counter()
             srv.submit({"data": xs[i % len(xs)]})
-            lat.append(time.perf_counter() - t0)
-        out["serving_p50_ms"] = pctl(lat, 50)
-        out["serving_p99_ms"] = pctl(lat, 99)
+        hs = hist.snapshot()
+        out["serving_p50_ms"] = round(hs["p50"], 3)
+        out["serving_p99_ms"] = round(hs["p99"], 3)
+        out["serving_latency_count"] = hs["count"]
         out["serving_overhead_p99_pct"] = round(
             (out["serving_p99_ms"] / max(out["serving_bare_p99_ms"], 1e-9)
              - 1.0) * 100.0, 1)
 
         # -- burst at 4x the admission cap: shedding, not collapse --
+        hist.reset()
         futs, shed = [], 0
         offered = 4 * max_queue
         for i in range(offered):
@@ -369,13 +598,12 @@ def serving_bench(quick=False):
                     {"data": xs[i % len(xs)]}, deadline_ms=30_000))
             except serving.Overloaded:
                 shed += 1
-        burst_lat = []
         for f in futs:
             f.result(timeout=60)
-            burst_lat.append(f.latency_s())
         out["serving_burst_offered"] = offered
         out["serving_shed_rate"] = round(shed / offered, 4)
-        out["serving_burst_p99_ms"] = pctl(burst_lat, 99)
+        out["serving_burst_p99_ms"] = round(
+            hist.snapshot()["p99"] or 0.0, 3)
         snap = srv.snapshot()
         out["serving_queue_depth_peak"] = snap["queue_depth_peak"]
         out["serving_batches"] = {
@@ -558,13 +786,18 @@ def long_context_bench(seq=8192, steps=5):
     return out
 
 
-def transformer_bench(batch=8, seq=1024, steps=10):
+def transformer_bench(batch=8, seq=1024, steps=10, quick=False):
     """Secondary metric: flagship TransformerLM training throughput.
 
     The matmul-dominated flagship shows the MXU utilization the
     framework reaches when the workload maps cleanly onto the systolic
     array (GPT-style LM, bf16, single chip); reported as tokens/sec +
-    model-FLOPs-utilization estimate (6*N*tokens rule).
+    two MFU readings: ``mfu`` from XLA's own cost analysis of the
+    compiled step (``lower().cost_analysis()`` — counts the FLOPs the
+    executable actually schedules) and the analytic 6*N*tokens estimate
+    (``transformer_mfu_vs_v5e_peak``, kept for trajectory continuity
+    with earlier rounds).  ``quick`` shrinks the model/seq so the leg
+    fits a CPU-oracle budget while still exercising the cost path.
     """
     import time as _time
 
@@ -572,15 +805,22 @@ def transformer_bench(batch=8, seq=1024, steps=10):
     import jax.numpy as jnp
     import numpy as np
 
+    from mxnet_tpu.config import config
     from mxnet_tpu.models import TransformerLM, TransformerConfig
     from mxnet_tpu.models.transformer import make_train_step
 
-    # wide-and-shallow at batch 8 keeps all activations resident (no
-    # remat recompute) and the d=2048 matmuls fill the MXU: measured
-    # ~47% single-chip MFU vs ~19% for the d=1024/8-layer remat config
-    cfg = TransformerConfig(vocab_size=32000, d_model=2048, n_heads=16,
-                            n_layers=4, d_ff=8192, max_len=seq,
-                            dtype="bfloat16", remat=False)
+    if quick:
+        batch, seq, steps = 2, min(seq, 128), 3
+        cfg = TransformerConfig(vocab_size=2048, d_model=256, n_heads=4,
+                                n_layers=2, d_ff=1024, max_len=seq,
+                                dtype="float32", remat=False)
+    else:
+        # wide-and-shallow at batch 8 keeps all activations resident (no
+        # remat recompute) and the d=2048 matmuls fill the MXU: measured
+        # ~47% single-chip MFU vs ~19% for the d=1024/8-layer remat config
+        cfg = TransformerConfig(vocab_size=32000, d_model=2048, n_heads=16,
+                                n_layers=4, d_ff=8192, max_len=seq,
+                                dtype="bfloat16", remat=False)
     model = TransformerLM(cfg)
     params = model.init(jax.random.PRNGKey(0))
     velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -590,10 +830,24 @@ def transformer_bench(batch=8, seq=1024, steps=10):
     tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size)
     x, y = tokens[:, :-1], tokens[:, 1:]
 
+    # cost analysis BEFORE the first call: the lowering it produces is
+    # exactly the trace the compile below reuses, so the probe is ~free
+    flops_per_step = None
+    try:
+        ca = step.lower(params, velocity, x, y).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            f = float(ca.get("flops", 0.0) or 0.0)
+            if f > 0:
+                flops_per_step = f
+    except Exception:
+        pass
+
     params, velocity, loss = step(params, velocity, x, y)  # compile
     float(loss)  # real sync
     best = 0.0
-    for _ in range(3):
+    for _ in range(2 if quick else 3):
         t0 = _time.perf_counter()
         for _ in range(steps):
             params, velocity, loss = step(params, velocity, x, y)
@@ -603,19 +857,28 @@ def transformer_bench(batch=8, seq=1024, steps=10):
 
     n_params = sum(int(np.prod(v.shape))
                    for v in jax.tree_util.tree_leaves(params))
-    flops_per_tok = 6 * n_params
-    mfu = best * flops_per_tok / 197e12  # v5e bf16 peak
+    peak = float(config.telemetry_peak_flops)
+    analytic_mfu = best * 6 * n_params / peak
+    steps_per_sec = best / (batch * seq)
     out = {
         "transformer_train_tokens_per_sec": round(best, 1),
         "transformer_params_m": round(n_params / 1e6, 1),
-        "transformer_mfu_vs_v5e_peak": round(mfu, 4),
+        "transformer_mfu_vs_v5e_peak": round(analytic_mfu, 4),
         "transformer_loss": float(np.asarray(loss, np.float32)),
     }
-    try:
-        out["transformer_kernel_breakdown_ms"] = _kernel_breakdown(
-            step, (params, velocity), (x, y), steps=3)
-    except Exception as e:  # diagnostics must not sink the bench
-        out["transformer_kernel_breakdown_error"] = str(e)
+    if flops_per_step is not None:
+        out["mfu"] = round(steps_per_sec * flops_per_step / peak, 4)
+        out["mfu_source"] = "xla_cost_analysis"
+        out["transformer_flops_per_step"] = flops_per_step
+    else:
+        out["mfu"] = round(analytic_mfu, 4)
+        out["mfu_source"] = "analytic_6n"
+    if not quick:
+        try:
+            out["transformer_kernel_breakdown_ms"] = _kernel_breakdown(
+                step, (params, velocity), (x, y), steps=3)
+        except Exception as e:  # diagnostics must not sink the bench
+            out["transformer_kernel_breakdown_error"] = str(e)
     return out
 
 
@@ -649,17 +912,10 @@ def _kernel_breakdown(step, state, data, steps=3):
 
 
 if __name__ == "__main__":
-    import signal
-
-    def _alarm(signum, frame):
-        raise BudgetExceeded("BENCH_BUDGET_S=%g watchdog fired"
-                             % _budget_s())
-
-    try:  # watchdog: flush partial results instead of dying at rc 124
-        signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(max(1, int(_budget_s())))
-    except (ValueError, OSError, AttributeError):
-        pass  # no SIGALRM here (non-main thread / platform)
+    # global ceiling until the first leg arms its own budget; legs re-arm
+    # the remaining global budget on exit, so imports and between-leg
+    # glue stay covered too
+    _arm(_budget_s())
     try:
         main()
     except BudgetExceeded as e:
@@ -667,9 +923,9 @@ if __name__ == "__main__":
     except Exception as e:  # the driver needs a JSON line no matter what
         RESULT["error"] = "%s: %s" % (type(e).__name__, e)
     finally:
-        try:
-            signal.alarm(0)
-        except (ValueError, OSError, AttributeError):
-            pass
+        _arm(0)
         print(json.dumps(RESULT))
-        sys.exit(0)
+        check = (RESULT["extra"].get("regression_check") or {})
+        strict = os.environ.get("BENCH_REGRESSION_STRICT", "") not in (
+            "", "0")
+        sys.exit(3 if strict and check.get("flagged") else 0)

@@ -131,6 +131,41 @@ serving_check() {
     fi
 }
 
+obs_check() {
+    # Always-on telemetry plane (docs/OBSERVABILITY.md): metrics
+    # registry, histogram quantiles, exporters, profiler ring buffer +
+    # dispatch bridge, cost-analysis step accounting, trace IDs, and
+    # the blackout-proof bench harness (forced leg timeout).
+    python -m pytest tests/test_telemetry.py tests/test_profiler.py -q
+    # registry smoke: counters/histograms round-trip through the
+    # Prometheus dump in a fresh process
+    env JAX_PLATFORMS=cpu python - <<'EOF'
+from mxnet_tpu import telemetry
+reg = telemetry.MetricsRegistry()
+reg.counter("smoke.hits").inc(3)
+h = reg.histogram("smoke.lat_ms")
+for v in (1.0, 2.0, 8.0):
+    h.observe(v)
+text = reg.dump_prometheus()
+assert "smoke_hits 3" in text, text
+assert "smoke_lat_ms_count 3" in text, text
+for line in text.strip().split("\n"):
+    if not line.startswith("#"):
+        float(line.rsplit(" ", 1)[1])
+snap = reg.snapshot()
+p50 = snap["histograms"]["smoke.lat_ms"]["p50"]
+assert abs(p50 - 2.0) / 2.0 <= 0.25, snap   # growth-1 relative bound
+print("obs registry smoke OK")
+EOF
+    # the telemetry module must lint clean — NO suppressions: every
+    # layer reports through it, so a CC001 slip is a global stall
+    python -m mxnet_tpu.lint mxnet_tpu/telemetry.py
+    if grep -n "mxlint: disable" mxnet_tpu/telemetry.py; then
+        echo "telemetry.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 integration_examples() {
     python -m pytest tests/test_examples.py tests/test_tools.py -q
 }
@@ -176,6 +211,7 @@ all() {
     unittest_parallel
     unittest_serving
     serving_check
+    obs_check
     unittest_dtype_sweep
     integration_examples
     chaos_check

@@ -97,6 +97,10 @@ def label_error_rate(decoded, labels, label_lens):
 def train(n_train=256, batch=32, epochs=30, lr=5e-3, seed=0,
           verbose=True):
     X, labels, label_lens = synthetic_utterances(n_train, seed)
+    # seed the framework RNG too: parameter init draws from the global
+    # stream, and an unlucky draw can leave CTC stuck near LER 1.0 for
+    # several epochs — the smoke threshold needs a deterministic start
+    mx.random.seed(seed)
     net = AcousticModel()
     net.initialize(mx.init.Xavier())
     trainer = gluon.Trainer(net.collect_params(), "adam",

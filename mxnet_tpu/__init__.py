@@ -55,6 +55,7 @@ from . import callback  # noqa: F401
 from . import predict  # noqa: F401
 from . import image  # noqa: F401
 from . import profiler  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import dispatch  # noqa: F401
 from . import contrib  # noqa: F401
 from . import monitor  # noqa: F401
@@ -78,3 +79,7 @@ ops.registry.freeze_builtins()
 
 if config.profiler_autostart:
     profiler.start()
+
+# JSONL exporter / localhost metrics endpoint, when the MXNET_TELEMETRY_*
+# knobs ask for them (both default off — docs/OBSERVABILITY.md)
+telemetry.init_from_env()

@@ -120,6 +120,38 @@ class _Config:
              "request_burst (serving — docs/SERVING.md). Each firing "
              "bumps the faults_injected dispatch counter. '' disables. "
              "Testing only — never set in production."),
+        Knob("MXNET_PROFILER_MAX_EVENTS", int, 1000000,
+             "Cap on the profiler's in-RAM chrome-trace event ring "
+             "(docs/OBSERVABILITY.md). Beyond it the oldest events are "
+             "dropped (counted in the profiler.events_dropped telemetry "
+             "counter) so week-long serving runs with the profiler on "
+             "cannot grow host memory without bound. Read at import; "
+             "profiler.set_max_events() resizes at runtime."),
+        Knob("MXNET_TELEMETRY_EXPORT", str, "",
+             "Path for the telemetry registry's periodic JSONL export "
+             "(one snapshot per line: counters, gauges, histogram "
+             "quantiles — docs/OBSERVABILITY.md). '' disables the "
+             "exporter thread."),
+        Knob("MXNET_TELEMETRY_INTERVAL_S", float, 10.0,
+             "Seconds between JSONL telemetry snapshots when "
+             "MXNET_TELEMETRY_EXPORT is set."),
+        Knob("MXNET_TELEMETRY_HTTP_PORT", int, 0,
+             "Serve the telemetry registry on 127.0.0.1:<port> "
+             "(/metrics Prometheus text, /metrics.json snapshot). "
+             "0 disables. Localhost-only by design."),
+        Knob("MXNET_TELEMETRY_COST", bool, True,
+             "Capture XLA cost analysis (FLOPs/bytes) for compiled "
+             "train steps at first dispatch so live MFU / HBM-"
+             "bandwidth-utilization gauges are published with zero "
+             "device syncs. Costs one extra (non-compiling) trace per "
+             "TrackedJit; set 0 to skip."),
+        Knob("MXNET_TELEMETRY_PEAK_FLOPS", float, 197e12,
+             "Accelerator peak FLOP/s the MFU gauges divide by. Default "
+             "is TPU v5e bf16 peak (197 TFLOP/s); set to your part's "
+             "number when running elsewhere."),
+        Knob("MXNET_TELEMETRY_PEAK_HBM_GBS", float, 819.0,
+             "Accelerator peak HBM bandwidth (GB/s) the hbm_util gauge "
+             "divides by. Default is TPU v5e (819 GB/s)."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

@@ -254,20 +254,26 @@ class TrackedJit:
     """``jax.jit`` wrapper that reports into the profiler's dispatch
     counters: every trace bumps ``recompile``, every call bumps
     ``jit_cache_hit`` or ``jit_cache_miss`` (a call that traced is a miss),
-    and donated argument bytes accumulate into ``donated_bytes``."""
+    and donated argument bytes accumulate into ``donated_bytes``.  It is
+    also where cost-analysis step accounting hooks in:
+    :meth:`cost_analysis` captures XLA's FLOPs/bytes estimate for the
+    compiled step so telemetry.StepAccountant can publish live MFU and
+    HBM-bandwidth gauges with zero device syncs."""
 
-    __slots__ = ("_jitted", "_donate")
+    __slots__ = ("_jitted", "_donate", "_cost")
 
     def __init__(self, fn, donate_argnums=(), static_argnums=(), label=None):
         from . import profiler as _prof
 
         donate = tuple(donate_argnums)
         self._donate = donate
+        self._cost = None
 
         name = label or getattr(fn, "__name__", "tracked_fn")
 
         def traced(*a, **k):
-            _prof.dispatch_count("recompile")
+            if not getattr(_tls, "cost_probe", False):
+                _prof.dispatch_count("recompile")
             with trace_scope(name):
                 return fn(*a, **k)
 
@@ -284,8 +290,7 @@ class TrackedJit:
     def __call__(self, *args):
         from . import profiler as _prof
 
-        counters = _prof._dispatch
-        before = counters.get("recompile", 0)
+        before = _prof.dispatch_value("recompile")
         if self._donate:
             nbytes = _donated_nbytes(args, self._donate)
             out = self._jitted(*args)
@@ -293,9 +298,54 @@ class TrackedJit:
         else:
             out = self._jitted(*args)
         _prof.dispatch_count(
-            "jit_cache_miss" if counters.get("recompile", 0) != before
+            "jit_cache_miss" if _prof.dispatch_value("recompile") != before
             else "jit_cache_hit")
         return out
 
     def lower(self, *args, **kw):
         return self._jitted.lower(*args, **kw)
+
+    def cost_analysis(self, *args, **kw):
+        """XLA's per-execution cost estimate for this function at the
+        given concrete args: ``{"flops": float, "bytes_accessed": float}``
+        (0.0 where the backend doesn't report), or None when
+        unavailable.  Cached after the first successful capture, so call
+        it with the first step's args and reuse freely.
+
+        Prefers ``lower().cost_analysis()`` (HLO-level, no XLA
+        compilation) and falls back to ``lower().compile()
+        .cost_analysis()``.  Lowering re-traces the wrapped function;
+        the ``cost_probe`` flag keeps that probe trace out of the
+        ``recompile`` counter so cache-hit/miss accounting stays exact.
+        """
+        if self._cost is not None:
+            return self._cost
+        from . import profiler as _prof
+
+        _tls.cost_probe = True
+        try:
+            lowered = self._jitted.lower(*args, **kw)
+        except Exception:
+            return None
+        finally:
+            _tls.cost_probe = False
+        ca = None
+        try:
+            ca = lowered.cost_analysis()
+        except Exception:
+            ca = None
+        if not ca:
+            try:
+                ca = lowered.compile().cost_analysis()
+            except Exception:
+                return None
+        if isinstance(ca, (list, tuple)):      # some backends: one per device
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return None
+        self._cost = {
+            "flops": float(ca.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+        }
+        _prof.dispatch_count("cost_analyses")
+        return self._cost

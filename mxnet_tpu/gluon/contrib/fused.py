@@ -204,6 +204,7 @@ class FusedTrainStep:
                 trainer=trainer, mode=mode) if mode else None)
         self._step_idx = 0
         self._pending_health = None
+        self._accountant = None   # telemetry.StepAccountant, armed at build
 
     def refresh_state_handles(self):
         """Re-capture the updater's state NDArrays (needed only after
@@ -481,6 +482,9 @@ class FusedTrainStep:
             adatas = tuple(jax.device_put(a, repl) for a in adatas)
             sdatas = tuple(jax.device_put(s, repl) for s in sdatas)
         rng = _random.next_key()
+        if self._accountant is None:
+            self._arm_accountant(rng, jnp.asarray(scalars), xd, yd,
+                                 pdatas, adatas, sdatas)
         lossvec, new_p, new_a, new_s, health = self._jitted(
             rng, jnp.asarray(scalars), xd, yd, pdatas, adatas, sdatas)
         for p, d in zip(self._params, new_p):
@@ -506,9 +510,27 @@ class FusedTrainStep:
             # the tail after the last step of a loop.
             self._pending_health = (step_idx, health)
         self._step_idx = step_idx + 1
+        self._accountant.on_step(batch)
         if target != batch and lossvec.ndim:
             lossvec = lossvec[:batch]
         return _wrap(lossvec)
+
+    def _arm_accountant(self, *concrete_args):
+        """Cost-analysis step accounting (docs/OBSERVABILITY.md): capture
+        XLA's FLOPs/bytes for the compiled step once at first dispatch
+        (lower() only traces, so donated buffers are untouched) and feed
+        a StepAccountant publishing live train.fused.* gauges — MFU,
+        HBM GB/s, items/sec — from host wall-clock alone (zero syncs)."""
+        from ... import telemetry as _telemetry
+        from ...config import config as _config
+
+        self._accountant = _telemetry.StepAccountant("train.fused")
+        if _config.telemetry_cost:
+            try:
+                self._accountant.set_cost(
+                    self._jitted.cost_analysis(*concrete_args))
+            except Exception:
+                pass          # accounting must never break the step
 
     def check_health(self):
         """Observe the most recent step's health vector now.

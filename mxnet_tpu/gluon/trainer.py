@@ -59,6 +59,7 @@ class Trainer:
         self._sentinel = None
         self._sentinel_ready = False
         self._step_count = 0
+        self._accountant = None   # telemetry.StepAccountant, lazy
 
     @property
     def _optimizer(self):
@@ -172,6 +173,13 @@ class Trainer:
             self._preemption.check()
         if not self._kv_initialized:
             self._init_kvstore()
+        # live examples/sec + steps/sec gauges (train.eager.*) from the
+        # wall-clock between successive step() entries — no device syncs
+        if self._accountant is None:
+            from .. import telemetry as _telemetry
+
+            self._accountant = _telemetry.StepAccountant("train.eager")
+        self._accountant.on_step(batch_size)
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         sentinel = self._sentinel_for_step()

@@ -23,6 +23,7 @@ device scheduling.  Two layers instead:
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -30,7 +31,9 @@ import time
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "state", "ProfileDomain", "Task", "Event",
-           "Counter", "Frame", "Marker", "dispatch_count", "dispatch_stats"]
+           "Counter", "Frame", "Marker", "dispatch_count", "dispatch_stats",
+           "dispatch_value", "record_span", "record_event", "now_us",
+           "set_max_events"]
 
 _lock = threading.Lock()
 _config = {
@@ -46,13 +49,60 @@ _config = {
 }
 _state = "stop"
 _paused = False
-_events = []       # chrome trace events
+# chrome trace events — bounded ring (oldest dropped) so a week-long
+# serving run with the profiler on cannot grow host memory without bound;
+# all mutation goes through _append/_drain under _lock
+_events = collections.deque()
+_max_events = int(os.environ.get("MXNET_PROFILER_MAX_EVENTS", "1000000"))
 _agg = {}          # name -> [count, total_us, min_us, max_us]
 _t0 = time.perf_counter()
 
 
 def _now_us():
     return (time.perf_counter() - _t0) * 1e6
+
+
+def now_us():
+    """Microseconds on the profiler's span timebase (for callers that
+    time work themselves and report it via :func:`record_span`)."""
+    return _now_us()
+
+
+def set_max_events(n):
+    """Resize the event ring (``MXNET_PROFILER_MAX_EVENTS`` sets the
+    import-time default).  Shrinking drops the oldest events."""
+    global _max_events
+    n = int(n)
+    if n < 1:
+        raise ValueError("max events must be >= 1, got %d" % n)
+    dropped = 0
+    with _lock:
+        _max_events = n
+        while len(_events) > _max_events:
+            _events.popleft()
+            dropped += 1
+    if dropped:
+        _count_dropped(dropped)
+
+
+def _count_dropped(n):
+    from . import telemetry
+
+    telemetry.registry().counter("profiler.events_dropped").inc(n)
+
+
+def _append(evt):
+    """Sole writer into the event ring: append under ``_lock`` (a
+    concurrent :func:`dump` snapshot-and-clear can never lose or
+    double-write events), evicting the oldest beyond the cap."""
+    dropped = 0
+    with _lock:
+        while len(_events) >= _max_events:
+            _events.popleft()
+            dropped += 1
+        _events.append(evt)
+    if dropped:
+        _count_dropped(dropped)
 
 
 def _active(category="imperative"):
@@ -62,16 +112,19 @@ def _active(category="imperative"):
                 or _config.get("profile_" + category, True))
 
 
-def record_span(name, cat, begin_us, dur_us, tid=None):
+def record_span(name, cat, begin_us, dur_us, tid=None, args=None):
     """Append one complete ('X') chrome-trace span (internal hook API).
     No-op unless the profiler is running (so instrumented library code is
     free to leave Task/Frame objects in place)."""
     if _state != "run" or _paused:
         return
-    _events.append({"name": name, "cat": cat, "ph": "X",
-                    "ts": begin_us, "dur": dur_us, "pid": os.getpid(),
-                    "tid": tid if tid is not None
-                    else threading.get_ident() % 10000})
+    evt = {"name": name, "cat": cat, "ph": "X",
+           "ts": begin_us, "dur": dur_us, "pid": os.getpid(),
+           "tid": tid if tid is not None
+           else threading.get_ident() % 10000}
+    if args:
+        evt["args"] = args
+    _append(evt)
     if _config.get("aggregate_stats"):
         with _lock:
             a = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
@@ -79,6 +132,21 @@ def record_span(name, cat, begin_us, dur_us, tid=None):
             a[1] += dur_us
             a[2] = min(a[2], dur_us)
             a[3] = max(a[3], dur_us)
+
+
+def record_event(evt):
+    """Append one raw chrome-trace event (async 'b'/'e' flow events,
+    instants, counters — whatever ``ph`` says).  Fills in ``ts``/``pid``/
+    ``tid`` when absent; gated on the profiler running like every other
+    recorder.  This is the channel mxnet_tpu.telemetry's request-trace
+    helpers emit through."""
+    if _state != "run" or _paused:
+        return
+    e = dict(evt)
+    e.setdefault("ts", _now_us())
+    e.setdefault("pid", os.getpid())
+    e.setdefault("tid", threading.get_ident() % 10000)
+    _append(e)
 
 
 class _Span:
@@ -140,9 +208,10 @@ _NULL = _Null()
 # "op_recompile" counts per-op jit traces, "donated_bytes" accumulates the
 # bytes of device buffers handed to XLA for in-place reuse, and
 # "bucket_padded_batches" counts ragged batches padded up to a shape bucket.
-# These are plain ints (no profiler session required) so CI can print them
-# after every tier-1 run; when a profiler session IS running each update
-# also lands as a chrome-trace counter event.
+# These live in the mxnet_tpu.telemetry registry as Counters under the
+# "dispatch." prefix (no profiler session required) so CI can print them
+# after every tier-1 run and any exporter can scrape them; when a profiler
+# session IS running each update also lands as a chrome-trace counter event.
 _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "op_recompile", "donated_bytes", "bucket_padded_batches",
                   "host_sync", "trace_guard",
@@ -153,26 +222,42 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   # overload-safe serving layer (docs/SERVING.md)
                   "requests_admitted", "requests_shed", "hedges_fired",
                   "breaker_trips", "batches_closed_by_deadline")
-_dispatch = {k: 0 for k in _DISPATCH_KEYS}
+_DISPATCH_PREFIX = "dispatch."
+
+
+def _registry():
+    from . import telemetry          # lazy: telemetry imports us back
+
+    return telemetry.registry()
 
 
 def dispatch_count(name, delta=1):
     """Bump a dispatch counter (internal hook API; unknown names are
     created on the fly so experiments don't need a registry edit)."""
-    _dispatch[name] = _dispatch.get(name, 0) + delta
+    value = _registry().counter(_DISPATCH_PREFIX + name).inc(delta)
     if _state == "run" and not _paused:
-        _events.append({"name": "dispatch::%s" % name, "cat": "counter",
-                        "ph": "C", "ts": _now_us(), "pid": os.getpid(),
-                        "args": {"value": _dispatch[name]}})
+        _append({"name": "dispatch::%s" % name, "cat": "counter",
+                 "ph": "C", "ts": _now_us(), "pid": os.getpid(),
+                 "args": {"value": value}})
+
+
+def dispatch_value(name):
+    """Current value of one dispatch counter (cheaper than a full
+    dispatch_stats snapshot on the hot path)."""
+    return _registry().counter(_DISPATCH_PREFIX + name).value
 
 
 def dispatch_stats(reset=False):
-    """Snapshot of the dispatch counters as a plain dict."""
-    with _lock:
-        out = dict(_dispatch)
-        if reset:
-            for k in list(_dispatch):
-                _dispatch[k] = 0
+    """Snapshot of the dispatch counters as a plain dict (all the
+    well-known keys, zero-filled, plus any ad-hoc ones)."""
+    from . import telemetry
+
+    out = {k: 0 for k in _DISPATCH_KEYS}
+    for full, metric in _registry().find(_DISPATCH_PREFIX):
+        if not isinstance(metric, telemetry.Counter):
+            continue
+        key = full[len(_DISPATCH_PREFIX):]
+        out[key] = metric.reset() if reset else metric.value
     return out
 
 
@@ -343,10 +428,10 @@ class Counter:
         self._value = value
         if _state != "run" or _paused:
             return
-        _events.append({"name": "%s::%s" % (self.domain.name, self.name),
-                        "cat": "counter", "ph": "C", "ts": _now_us(),
-                        "pid": os.getpid(),
-                        "args": {"value": value}})
+        _append({"name": "%s::%s" % (self.domain.name, self.name),
+                 "cat": "counter", "ph": "C", "ts": _now_us(),
+                 "pid": os.getpid(),
+                 "args": {"value": value}})
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -373,9 +458,9 @@ class Marker:
     def mark(self, scope="process"):
         if _state != "run" or _paused:
             return
-        _events.append({"name": "%s::%s" % (self.domain.name, self.name),
-                        "cat": "marker", "ph": "i", "ts": _now_us(),
-                        "pid": os.getpid(), "s": scope[0]})
+        _append({"name": "%s::%s" % (self.domain.name, self.name),
+                 "cat": "marker", "ph": "i", "ts": _now_us(),
+                 "pid": os.getpid(), "s": scope[0]})
 
 
 # ---------------------------------------------------------------------------

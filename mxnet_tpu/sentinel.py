@@ -360,11 +360,25 @@ class HealthSentinel:
         bad = bool(loss_nonfinite) or bool(np.any(np.asarray(grad_counts)))
         if not bad:
             self._good_step(step)
+            self._publish_gauges()
             return "ok"
         names = [n for n, c in zip(param_names, grad_counts) if c]
         if loss_nonfinite:
             names = ["<loss>"] + names
-        return self._bad_step(step, names)
+        action = self._bad_step(step, names)
+        self._publish_gauges()
+        return action
+
+    def _publish_gauges(self):
+        """Live sentinel state as telemetry gauges (the counters —
+        nonfinite_steps, rollbacks — already flow through the dispatch.*
+        bridge): current loss scale and bad-step streak, so a scrape
+        shows numerical health without a profiler session."""
+        from . import telemetry as _telemetry
+
+        g = _telemetry.registry().gauge
+        g("sentinel.loss_scale").set(self.loss_scale)
+        g("sentinel.bad_streak").set(self.bad_streak)
 
     def _good_step(self, step):
         self.bad_streak = 0

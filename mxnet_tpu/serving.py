@@ -69,6 +69,7 @@ import time
 import numpy as np
 
 from . import chaos as _chaos
+from . import telemetry as _telemetry
 from .async_kv import backoff_delay as _backoff_delay
 
 __all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
@@ -146,7 +147,7 @@ class ServingFuture:
     :meth:`_resolve` / :meth:`_reject` under the server lock)."""
 
     __slots__ = ("inputs", "rows", "deadline", "t_admit", "job",
-                 "_outputs", "_error", "_event", "t_done")
+                 "_outputs", "_error", "_event", "t_done", "trace_id")
 
     def __init__(self, inputs, rows, deadline, t_admit):
         self.inputs = inputs          # {name: np.ndarray}, leading dim=rows
@@ -158,6 +159,10 @@ class ServingFuture:
         self._error = None
         self._event = threading.Event()
         self.t_done = None
+        # end-to-end request trace (docs/OBSERVABILITY.md): one async
+        # chrome-trace span per admitted request, keyed by this id across
+        # admission -> batch close -> dispatch -> hedge -> outcome
+        self.trace_id = _telemetry.new_trace_id()
 
     @property
     def done(self):
@@ -175,6 +180,11 @@ class ServingFuture:
             return False
         self._outputs = outputs
         self._settle()
+        lat_ms = (self.t_done - self.t_admit) * 1e3
+        _telemetry.registry().histogram("serving.latency_ms").observe(lat_ms)
+        _telemetry.trace_end("request", self.trace_id,
+                             args={"outcome": "ok",
+                                   "latency_ms": round(lat_ms, 3)})
         return True
 
     def _reject(self, error):
@@ -182,6 +192,12 @@ class ServingFuture:
             return False
         self._error = error
         self._settle()
+        lat_ms = (self.t_done - self.t_admit) * 1e3
+        _telemetry.registry().histogram(
+            "serving.rejected_latency_ms").observe(lat_ms)
+        _telemetry.trace_end("request", self.trace_id,
+                             args={"outcome": type(error).__name__,
+                                   "latency_ms": round(lat_ms, 3)})
         return True
 
     def result(self, timeout=None):
@@ -545,6 +561,10 @@ class ModelServer:
             self._pending.append(req)
             self.stats["admitted"] += 1
             _count("requests_admitted")
+            _telemetry.trace_begin("request", req.trace_id,
+                                   args={"rows": rows,
+                                         "deadline_ms": round(
+                                             (deadline - now) * 1e3, 1)})
             self.stats["queue_depth_peak"] = max(
                 self.stats["queue_depth_peak"],
                 self._queue_depth_locked())
@@ -785,6 +805,10 @@ class ModelServer:
                 _count("batches_closed_by_deadline")
             if padded != rows:
                 _count("bucket_padded_batches")
+            _telemetry.trace_instant(
+                "batch_close",
+                args={"reason": reason, "rows": rows, "padded": padded,
+                      "trace_ids": [r.trace_id for r in take]})
 
     def _dispatch_locked(self, job, repl, now, hedge=False):
         # probe_inflight is True here iff THIS dispatch's allow() just
@@ -799,6 +823,10 @@ class ModelServer:
             job.hedge_at = now + self.hedge_ms / 1e3
         idx = self._exec_seq
         self._exec_seq += 1
+        _telemetry.trace_instant(
+            "hedge_dispatch" if hedge else "dispatch",
+            args={"replica": repl.id, "exec": idx, "probe": probe,
+                  "trace_ids": [r.trace_id for r in job.requests]})
         self._dispatch_q.put((job, repl, idx, hedge, probe))
 
     def _assign_locked(self, now):
@@ -945,6 +973,16 @@ class ModelServer:
             except Exception as e:   # noqa: BLE001 — typed outcome below
                 err = e
             dt = time.perf_counter() - t0
+            from . import profiler as _prof
+
+            _prof.record_span(
+                "serving::execute", "serving",
+                _prof.now_us() - dt * 1e6, dt * 1e6,
+                args={"replica": repl.id, "hedge": is_hedge,
+                      "error": type(err).__name__ if err else None,
+                      "trace_ids": [r.trace_id for r in job.requests]})
+            _telemetry.registry().histogram(
+                "serving.execute_ms").observe(dt * 1e3)
             with self._cv:
                 repl.inflight -= 1
                 job.inflight_execs -= 1

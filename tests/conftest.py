@@ -89,6 +89,30 @@ def pytest_terminal_summary(terminalreporter):
             "  ".join("%s=%d" % (k, v) for k, v in sorted(stats.items())))
     except Exception:
         pass  # never let diagnostics fail the suite
+    # on failure, dump the full telemetry registry: the counters/gauges/
+    # histograms the run accumulated are exactly the state a triager
+    # would ask for first (docs/OBSERVABILITY.md)
+    if not (terminalreporter.stats.get("failed")
+            or terminalreporter.stats.get("error")):
+        return
+    try:
+        from mxnet_tpu import telemetry
+
+        snap = telemetry.registry().snapshot()
+        terminalreporter.write_sep(
+            "-", "telemetry registry snapshot (failures present)")
+        for kind in ("counters", "gauges"):
+            live = {k: v for k, v in sorted(snap[kind].items()) if v}
+            if live:
+                terminalreporter.write_line("%s: %s" % (
+                    kind, "  ".join("%s=%g" % kv for kv in live.items())))
+        for name, h in sorted(snap["histograms"].items()):
+            if h["count"]:
+                terminalreporter.write_line(
+                    "hist %s: count=%d p50=%.3g p99=%.3g max=%.3g"
+                    % (name, h["count"], h["p50"], h["p99"], h["max"]))
+    except Exception:
+        pass  # never let diagnostics fail the suite
 
 
 @pytest.fixture(autouse=True)

@@ -615,3 +615,43 @@ def test_chaos_serving_fault_kinds_registered():
     with chaos.inject("replica_crash@0"):
         with pytest.raises(chaos.InjectedReplicaCrash):
             chaos.replica_crash(0)
+
+
+def test_every_request_carries_a_trace_id(tmp_path):
+    """Observability acceptance: every admitted request is minted a
+    process-unique trace ID at admission, and that ID is visible in the
+    chrome-trace dump as a begin/end async pair plus batch-close /
+    dispatch instants and the execute span (docs/OBSERVABILITY.md)."""
+    import json
+
+    fname = str(tmp_path / "serve_trace.json")
+    profiler.set_config(filename=fname, profile_all=True)
+    profiler.start()
+    srv, _ = _server(max_wait_ms=10, max_batch=4)
+    try:
+        rng = np.random.RandomState(21)
+        futs = [srv.submit_async(_req(rng)) for _ in range(8)]
+        assert _drain_all(futs) == ["ok"] * 8
+    finally:
+        srv.drain(timeout=30)
+        profiler.stop()
+        profiler.dump()
+    ids = [f.trace_id for f in futs]
+    assert all(ids) and len(set(ids)) == 8      # minted, unique
+    evts = json.load(open(fname))["traceEvents"]
+    by_id = {}
+    for e in evts:
+        if e.get("cat") == "serving" and e.get("ph") in ("b", "e"):
+            by_id.setdefault(e["id"], []).append(e["ph"])
+    for tid in ids:                              # full begin/end pair each
+        assert sorted(by_id.get(tid, [])) == ["b", "e"], tid
+    # the end event carries the typed outcome
+    outcomes = [e["args"]["outcome"] for e in evts
+                if e.get("ph") == "e" and e.get("id") in ids]
+    assert outcomes == ["ok"] * 8
+    # batch-close instants + execute spans cover every request
+    covered = set()
+    for e in evts:
+        if e.get("name") in ("batch_close", "serving::execute"):
+            covered.update(e.get("args", {}).get("trace_ids", []))
+    assert set(ids) <= covered
