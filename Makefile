@@ -10,6 +10,7 @@
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
+#   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
@@ -40,6 +41,9 @@ chaos:
 serve-smoke:
 	bash ci/runtime_functions.sh serving_check
 
+gen-smoke:
+	bash ci/runtime_functions.sh gen_check
+
 obs-smoke:
 	bash ci/runtime_functions.sh obs_check
 
@@ -49,4 +53,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke obs-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke obs-smoke ci clean

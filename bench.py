@@ -478,6 +478,9 @@ def main(argv=None):
     def transformer_leg():
         return transformer_bench(quick=quick)
 
+    def decode_leg():
+        return decode_bench(quick=quick)
+
     def longctx_leg():
         return long_context_bench()
 
@@ -498,6 +501,10 @@ def main(argv=None):
     # accepted on
     if os.environ.get("BENCH_TRANSFORMER", "1") != "0":
         legs.append(("transformer", transformer_leg, 90 if quick else 120))
+    # the decode leg runs in quick mode too: continuous-batching
+    # generative inference is accepted on decode_tokens_per_sec / ttft_ms
+    if os.environ.get("BENCH_DECODE", "1") != "0":
+        legs.append(("decode", decode_leg, 60 if quick else 90))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
@@ -609,6 +616,73 @@ def serving_bench(quick=False):
         out["serving_batches"] = {
             k: snap[k] for k in ("batches_full", "batches_timer",
                                  "batches_deadline")}
+    finally:
+        srv.drain(timeout=30)
+    return out
+
+
+def decode_bench(quick=False):
+    """Generative-decode leg (docs/GENERATIVE.md): continuous-batching
+    token generation through :class:`mxnet_tpu.generation.GenerationServer`
+    — paged KV cache, prefill/decode split, iteration-level scheduler.
+    Reports steady-state ``decode_tokens_per_sec`` (median of the
+    per-iteration histogram over the measurement window),
+    ``ttft_ms`` (submit -> first streamed token, prefill-dominated), and
+    ``kv_page_util`` (allocator peak over the run).  The server warms
+    every (prefill, slot) bucket before the window, so the window itself
+    must be compile-free — the recompile counter delta is reported so the
+    tripwire catches a bucketing regression as well as a throughput one."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import profiler, telemetry
+    from mxnet_tpu.generation import GenerationConfig, GenerationServer
+    from mxnet_tpu.models import TransformerConfig, TransformerLM
+
+    vocab = 1024
+    cfg = TransformerConfig(vocab_size=vocab, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_len=128,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    max_new = 16 if quick else 32
+    gcfg = GenerationConfig(page_size=16, max_pages=128,
+                            max_slots=4 if quick else 8,
+                            max_new_tokens=max_new)
+    n_req = 8 if quick else 32
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, vocab, size=4 + (i * 5) % 21).astype(np.int32)
+               for i in range(n_req + 2)]
+
+    reg = telemetry.registry()
+    ttft = reg.histogram("gen.ttft_ms")
+    tps = reg.histogram("gen.decode_tokens_per_sec")
+    srv = GenerationServer(model, params, gcfg)
+    out = {}
+    try:
+        for p in prompts[:2]:
+            srv.submit(p, max_new_tokens=4)      # settle the host paths
+        base_recompiles = profiler.dispatch_value("recompile")
+        base_tokens = profiler.dispatch_value("gen_tokens")
+        ttft.reset()
+        tps.reset()                              # window starts here
+        t0 = time.perf_counter()
+        futs = [srv.submit_async(p, max_new_tokens=max_new)
+                for p in prompts[2:]]
+        for f in futs:
+            f.result(timeout=300)
+        wall = time.perf_counter() - t0
+        toks = profiler.dispatch_value("gen_tokens") - base_tokens
+        hs_tps, hs_ttft = tps.snapshot(), ttft.snapshot()
+        out["decode_tokens_per_sec"] = round(hs_tps["p50"] or 0.0, 1)
+        out["decode_wall_tokens_per_sec"] = round(toks / wall, 1)
+        out["decode_tokens_total"] = int(toks)
+        out["ttft_ms"] = round(hs_ttft["p50"] or 0.0, 3)
+        out["ttft_p99_ms"] = round(hs_ttft["p99"] or 0.0, 3)
+        out["kv_page_util"] = round(srv.engine.allocator.peak_util, 4)
+        out["decode_recompiles_in_window"] = int(
+            profiler.dispatch_value("recompile") - base_recompiles)
     finally:
         srv.drain(timeout=30)
     return out

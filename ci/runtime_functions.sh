@@ -131,6 +131,23 @@ serving_check() {
     fi
 }
 
+gen_check() {
+    # Continuous-batching generative inference (docs/GENERATIVE.md):
+    # paged-KV decode parity vs the full-forward oracle, zero recompiles
+    # across join/leave churn on a warmed server, bitwise solo-vs-batched
+    # token streams, typed Overloaded on page exhaustion, and the
+    # exactly-one-typed-outcome contract under drain.
+    python -m pytest tests/test_generation.py -q
+    # the generation module must lint clean — NO suppressions: the
+    # scheduler holds a lock between device iterations, so a single
+    # CC001 slip stalls every active stream at once
+    python -m mxnet_tpu.lint mxnet_tpu/generation.py
+    if grep -n "mxlint: disable" mxnet_tpu/generation.py; then
+        echo "generation.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 obs_check() {
     # Always-on telemetry plane (docs/OBSERVABILITY.md): metrics
     # registry, histogram quantiles, exporters, profiler ring buffer +
@@ -211,6 +228,7 @@ all() {
     unittest_parallel
     unittest_serving
     serving_check
+    gen_check
     obs_check
     unittest_dtype_sweep
     integration_examples

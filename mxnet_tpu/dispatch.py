@@ -28,7 +28,8 @@ import threading
 import numpy as np
 
 __all__ = ["donation_active", "donation_scope", "no_donation",
-           "bucket_size", "bucket_spec", "pad_batch", "TrackedJit",
+           "bucket_size", "bucket_spec", "pow2_chain", "pad_batch",
+           "TrackedJit",
            "TraceGuardError", "trace_scope", "in_framework_trace",
            "trace_guard_mode", "guard_host_sync"]
 
@@ -221,6 +222,25 @@ def bucket_size(n, spec=None):
         if b >= n:
             return b
     return n
+
+
+def pow2_chain(cap):
+    """Full power-of-two bucket chain up to ``cap``: (1, 2, 4, ..., cap),
+    with ``cap`` itself always included even when it is not a power of two.
+    The warmup-enumeration companion to ``bucket_size(spec='pow2')``: an
+    open-ended pow2 spec cannot be pre-compiled, but a capped chain can —
+    consumers (serving batch buckets, generation decode-slot buckets)
+    compile every member up front so steady state never retraces."""
+    cap = int(cap)
+    if cap <= 0:
+        return ()
+    out = []
+    b = 1
+    while b < cap:
+        out.append(b)
+        b <<= 1
+    out.append(cap)
+    return tuple(out)
 
 
 def pad_batch(data, target):

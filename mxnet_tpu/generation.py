@@ -1,0 +1,615 @@
+"""Continuous-batching generative inference: paged KV cache + token scheduler.
+
+The reference framework had no autoregressive serving story at all; this
+module is the TPU-native one (docs/GENERATIVE.md).  Two ideas carry all the
+throughput, borrowed from Orca (iteration-level scheduling, OSDI'22) and
+vLLM/PagedAttention (block-allocated KV memory, SOSP'23):
+
+* **Paged KV cache** — K/V live in fixed-size pages ``[L, P, page_size, H,
+  D]`` handed out by a host-side free-list allocator
+  (:class:`PageAllocator`).  HBM scales with tokens actually generated, not
+  ``max_len x max_batch``.  Page 0 is the reserved garbage page: writes from
+  prompt padding and inactive decode slots land there unconditionally, so
+  the device code never branches on validity.  Occupancy is published on the
+  ``gen.kv_page_util`` gauge and exhaustion sheds with a typed
+  :class:`~mxnet_tpu.serving.Overloaded` — never an OOM.
+
+* **Token-level continuous batching** — :class:`GenerationServer` runs one
+  scheduler thread whose unit of work is a single decode iteration.
+  Sequences join (via prefill) and leave (EOS / length / deadline) the
+  running batch at iteration boundaries.  Decode shapes are quantized to a
+  fixed slot-count bucket chain (the ``MXNET_SHAPE_BUCKETS`` discipline,
+  `dispatch.pow2_chain`) with active-slot masks, and
+  :meth:`GenerationEngine.warm` compiles every bucket up front — so
+  join/leave churn causes **zero recompiles** after warmup (asserted by the
+  tests via the ``recompile`` dispatch counter).
+
+The request handle is :class:`~mxnet_tpu.serving.StreamingFuture`: tokens
+stream out per iteration, and the serving layer's outcome contract is
+preserved verbatim — every admitted request gets exactly one typed terminal
+outcome (`Overloaded` / `DeadlineExceeded` / `Draining` / success),
+including under drain and SIGTERM preemption.
+
+Model-side compute lives in ``models/transformer.py`` (``prefill`` /
+``decode_step``); everything here is host-side orchestration.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import dispatch as _dispatch
+from . import profiler as _profiler
+from . import telemetry as _telemetry
+from .serving import (DRAINING, SERVING, STARTING, STOPPED, DeadlineExceeded,
+                      Draining, Overloaded, StreamingFuture)
+
+__all__ = ["GenerationConfig", "PageAllocator", "GenerationEngine",
+           "GenerationServer"]
+
+_DEF_PAGE_SIZE = int(os.environ.get("MXTPU_GEN_PAGE_SIZE", "16"))
+_DEF_MAX_PAGES = int(os.environ.get("MXTPU_GEN_MAX_PAGES", "256"))
+_DEF_MAX_SLOTS = int(os.environ.get("MXTPU_GEN_MAX_SLOTS", "8"))
+_DEF_MAX_NEW = int(os.environ.get("MXTPU_GEN_MAX_NEW", "128"))
+_DEF_MAX_QUEUE = int(os.environ.get("MXTPU_GEN_MAX_QUEUE", "64"))
+_DEF_DEADLINE_MS = float(os.environ.get("MXTPU_GEN_DEADLINE_MS", "60000"))
+_DEF_SLOT_BUCKETS = os.environ.get("MXTPU_GEN_SLOT_BUCKETS", "")
+_DEF_PREFILL_BUCKETS = os.environ.get("MXTPU_GEN_PREFILL_BUCKETS", "")
+
+
+def _log(msg):
+    print("[mxnet_tpu.generation] %s" % msg, flush=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Knobs for the generative stack (env defaults: ``MXTPU_GEN_*``,
+    docs/ENV_VARS.md)."""
+
+    page_size: int = _DEF_PAGE_SIZE     # tokens per KV page
+    max_pages: int = _DEF_MAX_PAGES     # total pages incl. the garbage page
+    max_slots: int = _DEF_MAX_SLOTS     # concurrent decode sequences
+    max_new_tokens: int = _DEF_MAX_NEW  # per-request generation cap
+    max_seq_len: int = 0                # 0 -> model config max_len
+    # bucket chains ('' -> pow2 chain capped at max_slots / max_seq_len)
+    slot_buckets: str = _DEF_SLOT_BUCKETS
+    prefill_buckets: str = _DEF_PREFILL_BUCKETS
+    eos_id: int = -1                    # -1 -> no EOS stopping
+
+
+def _resolve_chain(spec, cap):
+    """Concrete ascending bucket chain from a comma spec, capped (and
+    capped-member-included) so warmup can enumerate every compile."""
+    cap = int(cap)
+    if spec:
+        vals = {int(t) for t in str(spec).split(",") if str(t).strip()}
+        vals = {v for v in vals if 0 < v <= cap}
+        vals.add(cap)
+        return tuple(sorted(vals))
+    return _dispatch.pow2_chain(cap)
+
+
+def _pick_bucket(chain, n):
+    for b in chain:
+        if b >= n:
+            return b
+    return chain[-1]
+
+
+# ---------------------------------------------------------------------------
+# page allocator
+# ---------------------------------------------------------------------------
+class PageAllocator:
+    """Host-side free-list allocator over the KV page pool.
+
+    Page 0 is reserved as the garbage page (see module docstring) and is
+    never handed out; capacity is therefore ``num_pages - 1``.  Occupancy
+    is published on the ``gen.kv_page_util`` gauge after every alloc/free,
+    and the high-water mark is kept for the bench leg.
+    """
+
+    def __init__(self, num_pages):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the garbage page)")
+        self.num_pages = int(num_pages)
+        self._capacity = self.num_pages - 1
+        # pop() from the tail -> lowest page ids are handed out first
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._lock = threading.Lock()
+        self.peak_util = 0.0
+
+    @property
+    def capacity(self):
+        return self._capacity
+
+    @property
+    def used(self):
+        with self._lock:
+            return self._capacity - len(self._free)
+
+    def alloc(self, n):
+        """Allocate ``n`` pages; returns their ids, or None when the pool
+        cannot satisfy the request (all-or-nothing — no partial grants)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            got = [self._free.pop() for _ in range(int(n))]
+        self._publish()
+        return got
+
+    def free(self, pages):
+        with self._lock:
+            self._free.extend(int(p) for p in pages)
+        self._publish()
+
+    def _publish(self):
+        util = self.used / self._capacity
+        if util > self.peak_util:
+            self.peak_util = util
+        _telemetry.registry().gauge("gen.kv_page_util").set(util)
+
+
+# ---------------------------------------------------------------------------
+# engine: jitted prefill/decode over bucketed shapes
+# ---------------------------------------------------------------------------
+class _Seq:
+    """One sequence resident in the decode batch (host-side bookkeeping)."""
+
+    __slots__ = ("fut", "table", "n_pages", "length", "last_token",
+                 "n_new", "max_new", "prompt_len")
+
+    def __init__(self, fut, table, n_pages, length, last_token, max_new,
+                 prompt_len):
+        self.fut = fut
+        self.table = table            # np [M] int32, padded with 0
+        self.n_pages = n_pages        # leading valid entries of table
+        self.length = length          # tokens with K/V in the cache
+        self.last_token = last_token  # next token to feed decode_step
+        self.n_new = 1                # generated so far (prefill emits #1)
+        self.max_new = max_new
+        self.prompt_len = prompt_len
+
+
+class GenerationEngine:
+    """Owns the paged KV arrays plus the jitted prefill/decode callables.
+
+    Shapes are quantized to fixed bucket chains (prompt length for prefill,
+    slot count for decode) and :meth:`warm` compiles every member, so the
+    steady state never retraces.  Both callables go through
+    `dispatch.TrackedJit` — the same ``recompile`` / ``jit_cache_*``
+    counters the rest of the runtime uses — and donate the page arrays on
+    TPU so XLA updates the cache in place in HBM.
+    """
+
+    def __init__(self, model, params, config=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.model = model
+        self.params = params
+        self.cfg = config or GenerationConfig()
+        if model.cfg.use_moe:
+            raise NotImplementedError("paged decode does not support MoE yet")
+        self.page_size = int(self.cfg.page_size)
+        self.max_seq = int(self.cfg.max_seq_len or model.cfg.max_len)
+        self.pages_per_seq = -(-self.max_seq // self.page_size)
+        self.allocator = PageAllocator(self.cfg.max_pages)
+        self.k_pages, self.v_pages = model.init_kv_pages(
+            self.cfg.max_pages, self.page_size)
+        self.slot_chain = _resolve_chain(self.cfg.slot_buckets,
+                                         self.cfg.max_slots)
+        self.prefill_chain = _resolve_chain(self.cfg.prefill_buckets,
+                                            self.max_seq)
+        # donation makes the HBM page update in-place; on CPU it only
+        # produces copy warnings, so gate it on the backend
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        self._prefill_jit = _dispatch.TrackedJit(
+            self._prefill_fn, donate_argnums=donate, label="gen_prefill")
+        self._decode_jit = _dispatch.TrackedJit(
+            self._decode_fn, donate_argnums=donate, label="gen_decode")
+
+    def _prefill_fn(self, params, k_pages, v_pages, tokens, length, table):
+        return self.model.prefill(params, k_pages, v_pages, tokens, length,
+                                  table)
+
+    def _decode_fn(self, params, k_pages, v_pages, tokens, tables, lens,
+                   active):
+        return self.model.decode_step(params, k_pages, v_pages, tokens,
+                                      tables, lens, active)
+
+    def prefill(self, prompt, table):
+        """Run one prompt (1-D int array) against pages ``table`` (np [M]);
+        returns the next-token logits as np [V]."""
+        jnp = self._jnp
+        T = int(prompt.shape[0])
+        tpad = _pick_bucket(self.prefill_chain, T)
+        toks = np.zeros((1, tpad), np.int32)
+        toks[0, :T] = prompt
+        self.k_pages, self.v_pages, logits = self._prefill_jit(
+            self.params, self.k_pages, self.v_pages, jnp.asarray(toks),
+            jnp.int32(T), jnp.asarray(table))
+        _profiler.dispatch_count("gen_prefills")
+        return np.asarray(logits)
+
+    def decode(self, seqs):
+        """One decode iteration over ``seqs`` (list of :class:`_Seq`),
+        padded up to the enclosing slot bucket; returns np logits
+        [len(seqs), V].  Does NOT advance host bookkeeping — the caller
+        owns lengths/tokens so it can settle outcomes under its lock."""
+        jnp = self._jnp
+        n = len(seqs)
+        bucket = _pick_bucket(self.slot_chain, n)
+        m = self.pages_per_seq
+        toks = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, m), np.int32)
+        lens = np.zeros(bucket, np.int32)
+        active = np.zeros(bucket, bool)
+        for i, s in enumerate(seqs):
+            toks[i] = s.last_token
+            tables[i] = s.table
+            lens[i] = s.length
+            active[i] = True
+        self.k_pages, self.v_pages, logits = self._decode_jit(
+            self.params, self.k_pages, self.v_pages, jnp.asarray(toks),
+            jnp.asarray(tables), jnp.asarray(lens), jnp.asarray(active))
+        _profiler.dispatch_count("gen_decode_iters")
+        _profiler.dispatch_count("gen_tokens", n)
+        return np.asarray(logits[:n])
+
+    def warm(self):
+        """Compile every prefill and decode bucket up front.  All warmup
+        writes are routed to the garbage page (zero page tables, inactive
+        slots), so no allocation happens and no cache state is disturbed."""
+        jnp = self._jnp
+        m = self.pages_per_seq
+        zt = jnp.zeros(m, jnp.int32)
+        for tpad in self.prefill_chain:
+            self.k_pages, self.v_pages, _ = self._prefill_jit(
+                self.params, self.k_pages, self.v_pages,
+                jnp.zeros((1, tpad), jnp.int32), jnp.int32(1), zt)
+        for s in self.slot_chain:
+            self.k_pages, self.v_pages, _ = self._decode_jit(
+                self.params, self.k_pages, self.v_pages,
+                jnp.zeros(s, jnp.int32), jnp.zeros((s, m), jnp.int32),
+                jnp.zeros(s, jnp.int32), jnp.zeros(s, bool))
+        _log("warm: %d prefill bucket(s) %s, %d decode bucket(s) %s"
+             % (len(self.prefill_chain), list(self.prefill_chain),
+                len(self.slot_chain), list(self.slot_chain)))
+
+
+# ---------------------------------------------------------------------------
+# token-level scheduler
+# ---------------------------------------------------------------------------
+class GenerationServer:
+    """Continuous-batching front end over one :class:`GenerationEngine`.
+
+    A single scheduler thread owns the device: each loop turn it either
+    prefills ONE waiting request into a free slot or runs ONE decode
+    iteration over the active batch — that alternation IS iteration-level
+    scheduling (Orca): joins and leaves only ever happen between decode
+    steps.  All outcome settlement (resolve/reject) happens under the
+    server lock, exactly like :class:`~mxnet_tpu.serving.ModelServer`, so
+    deadline expiry, page shedding, and drain races keep the exactly-once
+    typed-outcome contract.  Device compute always runs OUTSIDE the lock.
+    """
+
+    def __init__(self, model, params, config=None, *, max_queue=None,
+                 deadline_ms=None, warm=True):
+        self.engine = GenerationEngine(model, params, config)
+        self.cfg = self.engine.cfg
+        self.max_queue = _DEF_MAX_QUEUE if max_queue is None \
+            else int(max_queue)
+        self.default_deadline = (_DEF_DEADLINE_MS if deadline_ms is None
+                                 else float(deadline_ms)) / 1e3
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending = collections.deque()   # (fut, prompt, max_new)
+        self._active = []                     # [_Seq]
+        self._inflight = None                 # fut mid-prefill (not yet in
+        #                                       _active; drain must see it)
+        self._drain_flag = threading.Event()
+        self._stop = False
+        self._preemption = None
+        self._state = STARTING
+        self.stats = {
+            "admitted": 0, "shed_queue": 0, "shed_pages": 0, "ok": 0,
+            "deadline_exceeded": 0, "rejected_draining": 0,
+        }
+        if warm:
+            self.engine.warm()
+        self._state = SERVING
+        self._thread = threading.Thread(target=self._loop,
+                                        name="gen-scheduler", daemon=True)
+        self._thread.start()
+
+    @property
+    def state(self):
+        return self._state
+
+    # -- admission -----------------------------------------------------
+    def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
+                     on_token=None):
+        """Admit one generation request; returns a
+        :class:`~mxnet_tpu.serving.StreamingFuture` or raises the typed
+        admission error (:class:`Overloaded` / :class:`Draining`)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size >= self.engine.max_seq:
+            raise ValueError("prompt length %d >= max_seq_len %d"
+                             % (prompt.size, self.engine.max_seq))
+        max_new = int(max_new_tokens or self.cfg.max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        now = time.monotonic()
+        deadline = now + (self.default_deadline if deadline_ms is None
+                          else float(deadline_ms) / 1e3)
+        with self._cv:
+            if (self._drain_flag.is_set()
+                    or self._state in (DRAINING, STOPPED)):
+                self.stats["rejected_draining"] += 1
+                raise Draining("generation server is draining")
+            if len(self._pending) >= self.max_queue:
+                self.stats["shed_queue"] += 1
+                _profiler.dispatch_count("requests_shed")
+                raise Overloaded("generation queue full (%d pending)"
+                                 % len(self._pending))
+            fut = StreamingFuture({"tokens": prompt}, rows=1,
+                                  deadline=deadline, t_admit=now,
+                                  on_token=on_token)
+            self.stats["admitted"] += 1
+            _profiler.dispatch_count("requests_admitted")
+            _telemetry.trace_begin("request", fut.trace_id, cat="gen",
+                                   args={"prompt_len": int(prompt.size),
+                                         "max_new": max_new})
+            self._pending.append((fut, prompt, max_new))
+            self._cv.notify_all()
+        return fut
+
+    def submit(self, prompt, timeout=None, **kw):
+        """Blocking convenience: the generated token-id list."""
+        return self.submit_async(prompt, **kw).result(timeout=timeout)
+
+    # -- scheduler loop ------------------------------------------------
+    def _loop(self):
+        while True:
+            work = None
+            with self._cv:
+                if self._stop:
+                    break
+                if self._drain_flag.is_set() and self._state == SERVING:
+                    self._state = DRAINING
+                self._expire_locked(time.monotonic())
+                if (self._pending
+                        and len(self._active) < self.cfg.max_slots):
+                    work = self._pending.popleft()
+                    self._inflight = work[0]
+                elif not self._active:
+                    self._cv.wait(0.02)
+                    continue
+            if work is not None:
+                self._do_prefill(*work)
+            else:
+                self._decode_iteration()
+
+    def _expire_locked(self, now):
+        for i in range(len(self._pending) - 1, -1, -1):
+            fut, _, _ = self._pending[i]
+            if now >= fut.deadline:
+                del self._pending[i]
+                self._reject_locked(fut, DeadlineExceeded(
+                    "deadline passed while queued"))
+        for s in list(self._active):
+            if now >= s.fut.deadline:
+                self._retire_locked(s, DeadlineExceeded(
+                    "deadline passed after %d token(s)" % s.n_new))
+
+    def _reject_locked(self, fut, err):
+        if fut._reject(err):
+            key = ("deadline_exceeded"
+                   if isinstance(err, DeadlineExceeded) else
+                   "shed_pages" if isinstance(err, Overloaded) else
+                   "rejected_draining")
+            self.stats[key] += 1
+        self._cv.notify_all()
+
+    def _retire_locked(self, seq, err=None):
+        """Remove ``seq`` from the active batch, free its pages, settle.
+        Idempotent: a sequence already retired (deadline expiry or drain
+        sweep racing the decode loop) is left alone — pages free once."""
+        if seq not in self._active:
+            return
+        self._active.remove(seq)
+        pages = [int(p) for p in seq.table[:seq.n_pages]]
+        if err is None:
+            if seq.fut._resolve(list(seq.fut.stream_tokens)):
+                self.stats["ok"] += 1
+        else:
+            self._reject_locked(seq.fut, err)
+        if pages:
+            self.engine.allocator.free(pages)
+        self._cv.notify_all()
+
+    def _do_prefill(self, fut, prompt, max_new):
+        eng = self.engine
+        need = -(-int(prompt.size) // eng.page_size)
+        pages = eng.allocator.alloc(need)
+        if pages is None:
+            _profiler.dispatch_count("gen_pages_shed")
+            with self._cv:
+                self._inflight = None
+                self._reject_locked(fut, Overloaded(
+                    "KV pages exhausted: prompt needs %d page(s), "
+                    "%d free of %d" % (need, eng.allocator.capacity
+                                       - eng.allocator.used,
+                                       eng.allocator.capacity)))
+            return
+        table = np.zeros(eng.pages_per_seq, np.int32)
+        table[:need] = pages
+        logits = eng.prefill(prompt, table)        # device work, no lock
+        tok = int(np.argmax(logits))
+        seq = _Seq(fut, table, need, int(prompt.size), tok, max_new,
+                   int(prompt.size))
+        is_eos = self.cfg.eos_id >= 0 and tok == self.cfg.eos_id
+        emitted = False if is_eos else fut._emit(tok)  # EOS never streams
+        if emitted and fut.t_first_token is not None:
+            _telemetry.registry().histogram("gen.ttft_ms").observe(
+                (fut.t_first_token - fut.t_admit) * 1e3)
+        with self._cv:
+            self._inflight = None
+            if fut.done:                           # drain/deadline raced
+                eng.allocator.free(pages)
+            elif time.monotonic() >= fut.deadline:
+                self._reject_locked(fut, DeadlineExceeded(
+                    "deadline passed during prefill"))
+                eng.allocator.free(pages)
+            elif is_eos or max_new <= 1:
+                self._active.append(seq)
+                self._retire_locked(seq)
+            else:
+                self._active.append(seq)
+                self._cv.notify_all()
+
+    def _decode_iteration(self):
+        eng = self.engine
+        with self._cv:
+            seqs = list(self._active)
+        if not seqs:
+            return
+        # grow page tables for sequences crossing a page boundary; a pool
+        # miss sheds THAT sequence with a typed Overloaded (its streamed
+        # tokens stand; the outcome names the truncation)
+        survivors = []
+        for s in seqs:
+            needed = s.length // eng.page_size + 1
+            if needed > s.n_pages:
+                got = eng.allocator.alloc(1)
+                if got is None:
+                    _profiler.dispatch_count("gen_pages_shed")
+                    with self._cv:
+                        self._retire_locked(s, Overloaded(
+                            "KV pages exhausted mid-decode after %d "
+                            "token(s)" % s.n_new))
+                    continue
+                s.table[s.n_pages] = got[0]
+                s.n_pages += 1
+            survivors.append(s)
+        if not survivors:
+            return
+        t0 = time.perf_counter()
+        logits = eng.decode(survivors)             # device work, no lock
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            _telemetry.registry().histogram(
+                "gen.decode_tokens_per_sec").observe(len(survivors) / dt)
+        _telemetry.trace_instant(
+            "gen.decode_iter", cat="gen",
+            args={"active": len(survivors),
+                  "bucket": _pick_bucket(eng.slot_chain, len(survivors)),
+                  "ms": round(dt * 1e3, 3)})
+        next_toks = np.argmax(logits, axis=-1)
+        # advance + emit with no lock held (token callbacks are user code);
+        # settlement then happens under the lock, and _retire_locked is
+        # idempotent against deadline/drain sweeps that raced the step
+        finished = []
+        for i, s in enumerate(survivors):
+            if s.fut.done:                         # settled while decoding
+                finished.append(s)
+                continue
+            s.length += 1
+            tok = int(next_toks[i])
+            if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
+                finished.append(s)
+                continue
+            s.last_token = tok
+            s.n_new += 1
+            if not s.fut._emit(tok):
+                finished.append(s)
+                continue
+            if s.n_new >= s.max_new or s.length >= eng.max_seq:
+                finished.append(s)
+        if finished:
+            with self._cv:
+                for s in finished:
+                    self._retire_locked(s)
+
+    # -- lifecycle -----------------------------------------------------
+    def install_preemption_drain(self, handler=None):
+        """Wire graceful drain into SIGTERM/SIGINT exactly like
+        ``ModelServer.install_preemption_drain`` (rc-76 contract,
+        docs/FAULT_TOLERANCE.md)."""
+        if handler is None:
+            from .elastic import PreemptionHandler
+
+            handler = PreemptionHandler().install()
+        handler.add_callback(self._drain_flag.set)
+        self._preemption = handler
+        return handler
+
+    def drain(self, timeout=None):
+        """Stop admission (typed :class:`Draining` rejections), let every
+        admitted request reach its terminal outcome, then stop the
+        scheduler.  On timeout, unresolved requests are swept with typed
+        ``Draining`` so nothing ever hangs.  Returns True when everything
+        in flight completed."""
+        self._drain_flag.set()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            if self._state == STOPPED:
+                return True
+            if self._state != DRAINING:
+                self._state = DRAINING
+                _log("state -> DRAINING (%d queued, %d active)"
+                     % (len(self._pending), len(self._active)))
+            self._cv.notify_all()
+            while self._pending or self._active or self._inflight is not None:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                self._cv.wait(0.05)
+            drained = not (self._pending or self._active
+                           or self._inflight is not None)
+            if not drained:
+                aborted = 0
+                while self._pending:
+                    fut, _, _ = self._pending.popleft()
+                    self._reject_locked(fut, Draining(
+                        "drain timed out with the request still queued"))
+                    aborted += 1
+                if self._inflight is not None and not self._inflight.done:
+                    self._reject_locked(self._inflight, Draining(
+                        "drain timed out during prefill"))
+                    aborted += 1
+                for s in list(self._active):
+                    if not s.fut.done:
+                        self._retire_locked(s, Draining(
+                            "drain timed out after %d token(s)" % s.n_new))
+                        aborted += 1
+                _log("drain timeout: aborted %d unresolved request(s) "
+                     "with typed Draining" % aborted)
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+        self._state = STOPPED
+        return drained
+
+    def close(self, timeout=5.0):
+        return self.drain(timeout=timeout)
+
+    def snapshot(self):
+        with self._lock:
+            alloc = self.engine.allocator
+            return {
+                "state": self._state,
+                "pending": len(self._pending),
+                "active": len(self._active),
+                "pages_used": alloc.used,
+                "pages_capacity": alloc.capacity,
+                "kv_page_util_peak": round(alloc.peak_util, 4),
+                "stats": dict(self.stats),
+            }

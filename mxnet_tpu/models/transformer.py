@@ -154,7 +154,7 @@ class TransformerLM:
         return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
                 ).astype(x.dtype) * scale
 
-    def _block(self, bp, x, use_ring):
+    def _block(self, bp, x, use_ring, with_kv=False):
         cfg = self.cfg
         B, T, E = x.shape
         H, D = cfg.n_heads, cfg.head_dim
@@ -192,7 +192,140 @@ class TransformerLM:
             ff = jnp.einsum("btf,fe->bte", up, bp["w_down"],
                             preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + constraint(ff, "dp", "sp", None)
+        if with_kv:
+            return x, aux, k, v
         return x, aux
+
+    # -- generative decode (paged KV cache) ----------------------------
+    #
+    # Layout: k_pages / v_pages are [L, P, page_size, H, D] in the model
+    # dtype.  Page 0 is the reserved GARBAGE page: writes from prompt
+    # padding and inactive decode slots are routed there unconditionally,
+    # so neither function ever branches on validity — the attention mask
+    # (position <= length) is the only consumer-side filter, and stale
+    # garbage never leaks into logits.  Per-sequence page tables are
+    # [M] int32 (M = max pages per sequence) padded with 0; position t of
+    # a sequence lives at flat slot page_table[t // ps] * ps + t % ps.
+    # The allocator/scheduler around these functions lives in
+    # mxnet_tpu/generation.py (docs/GENERATIVE.md).
+
+    def init_kv_pages(self, num_pages, page_size):
+        """Allocate zeroed paged KV storage: ([L,P,ps,H,D], same) pair."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, num_pages, page_size, cfg.n_heads,
+                 cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def prefill(self, params, k_pages, v_pages, tokens, length, page_table):
+        """Run the prompt through the model, writing per-layer K/V into the
+        paged cache and returning next-token logits.
+
+        tokens: [1, Tpad] int32 (prompt left-aligned, padded to a shape
+        bucket); length: scalar int32, true prompt length (traced — one
+        compile per Tpad bucket, not per length); page_table: [M] int32,
+        pages backing positions 0..length-1.  Returns
+        (k_pages, v_pages, logits [V] f32) where logits are taken at
+        position length-1 (the next-token distribution — TTFT comes from
+        argmax of this, no decode step needed for the first token).
+        """
+        cfg = self.cfg
+        if cfg.use_moe:
+            raise NotImplementedError("paged decode does not support MoE yet")
+        ps = k_pages.shape[2]
+        Tpad = tokens.shape[1]
+        x = params["embed"][tokens]
+        block_names = [k for k in params if k.startswith("blocks.")]
+        stacked = {k.split(".", 1)[1]: params[k] for k in block_names}
+
+        t = jnp.arange(Tpad)
+        dest = jnp.where(t < length, page_table[t // ps] * ps + t % ps,
+                         t % ps)
+
+        def write(pages_l, kv):
+            return (pages_l.reshape(-1, *kv.shape[1:])
+                    .at[dest].set(kv).reshape(pages_l.shape))
+
+        def body(x, xs):
+            bp, kp, vp = xs
+            x, _aux, k, v = self._block(bp, x, use_ring=False, with_kv=True)
+            return x, (write(kp, k[0]), write(vp, v[0]))
+
+        x, (k_pages, v_pages) = lax.scan(body, x, (stacked, k_pages, v_pages))
+        x = self._rmsnorm(x, params["final_ln_scale"])
+        last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                        keepdims=False)
+        logits = jnp.einsum("e,ev->v", last, params["unembed"],
+                            preferred_element_type=jnp.float32)
+        return k_pages, v_pages, logits
+
+    def decode_step(self, params, k_pages, v_pages, tokens, page_tables,
+                    lens, active):
+        """One autoregressive step for a batch of decode slots.
+
+        tokens: [S] int32, the token each slot is appending; page_tables:
+        [S, M] int32; lens: [S] int32, sequence length BEFORE this token
+        (the token is written at position ``lens`` and attends positions
+        0..lens); active: [S] bool, writes from inactive slots go to the
+        garbage page.  Returns (k_pages, v_pages, logits [S, V] f32) —
+        logits for the NEXT token of each slot.  All shapes are static per
+        slot-count bucket, so join/leave churn never recompiles.
+        """
+        cfg = self.cfg
+        if cfg.use_moe:
+            raise NotImplementedError("paged decode does not support MoE yet")
+        H, D = cfg.n_heads, cfg.head_dim
+        S = tokens.shape[0]
+        ps = k_pages.shape[2]
+        x = params["embed"][tokens][:, None, :]            # [S, 1, E]
+        block_names = [k for k in params if k.startswith("blocks.")]
+        stacked = {k.split(".", 1)[1]: params[k] for k in block_names}
+
+        cur_page = jnp.take_along_axis(page_tables, (lens // ps)[:, None],
+                                       axis=1)[:, 0]
+        dest = jnp.where(active, cur_page, 0) * ps + lens % ps  # [S]
+        span = page_tables.shape[1] * ps
+        attn_mask = jnp.arange(span)[None, :] <= lens[:, None]  # [S, span]
+
+        def body(x, xs):
+            bp, kp, vp = xs
+            h = self._rmsnorm(x, bp["ln1_scale"])
+            qkv = jnp.einsum("ste,ef->stf", h, bp["wqkv"],
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(S, H, D)
+            kp = kp.reshape(-1, H, D).at[dest].set(
+                k.reshape(S, H, D)).reshape(kp.shape)
+            vp = vp.reshape(-1, H, D).at[dest].set(
+                v.reshape(S, H, D)).reshape(vp.shape)
+            kg = kp[page_tables].reshape(S, span, H, D)
+            vg = vp[page_tables].reshape(S, span, H, D)
+            s = jnp.einsum("shd,skhd->shk", q, kg,
+                           preferred_element_type=jnp.float32) / math.sqrt(D)
+            s = jnp.where(attn_mask[:, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("shk,skhd->shd", p, vg,
+                              preferred_element_type=jnp.float32
+                              ).astype(x.dtype)
+            o = jnp.einsum("stf,fe->ste", attn.reshape(S, 1, H * D),
+                           bp["wo"], preferred_element_type=jnp.float32
+                           ).astype(x.dtype)
+            x = x + o
+            h = self._rmsnorm(x, bp["ln2_scale"])
+            up = jnp.einsum("ste,ef->stf", h, bp["w_up"],
+                            preferred_element_type=jnp.float32)
+            ff = jnp.einsum("stf,fe->ste", jax.nn.gelu(up).astype(x.dtype),
+                            bp["w_down"], preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
+            x = x + ff
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = lax.scan(body, x, (stacked, k_pages, v_pages))
+        x = self._rmsnorm(x, params["final_ln_scale"])
+        logits = jnp.einsum("se,ev->sv", x[:, 0], params["unembed"],
+                            preferred_element_type=jnp.float32)
+        return k_pages, v_pages, logits
 
     def apply(self, params, tokens):
         """tokens [B, T] int32 -> logits [B, T, V] (f32)."""

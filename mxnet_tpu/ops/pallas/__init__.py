@@ -10,8 +10,9 @@ all (the reference predates flash attention entirely; SURVEY.md §5
 Kernels fall back to pure-lax implementations off-TPU (CPU oracle testing —
 SURVEY.md §4 test strategy).
 """
-from .flash_attention import flash_attention, flash_self_attention  # noqa: F401
+from .flash_attention import (flash_attention, flash_attention_lse,  # noqa: F401
+                              flash_self_attention)
 from .layers import fused_rmsnorm, fused_softmax_xent  # noqa: F401
 
-__all__ = ["flash_attention", "flash_self_attention", "fused_rmsnorm",
-           "fused_softmax_xent"]
+__all__ = ["flash_attention", "flash_attention_lse", "flash_self_attention",
+           "fused_rmsnorm", "fused_softmax_xent"]

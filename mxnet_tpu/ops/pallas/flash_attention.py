@@ -338,6 +338,48 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
     return o.transpose(0, 2, 1, 3)
 
 
+def flash_attention_lse(q, k, v, causal=True, scale=None, block_q=None,
+                        block_k=None, interpret=None):
+    """Forward-only flash attention returning ``(o, lse)``.
+
+    Same [B, T, H, D] API as :func:`flash_attention`, plus the per-row
+    logsumexp [B, H, T] of the scaled masked scores (fully-masked rows get
+    the ``-1e30`` sentinel).  This is the block kernel for flash-decoding
+    style merges of normalized partials over disjoint key sets —
+    `parallel.ring_attention(use_pallas=True)` combines one such call per
+    ring step.  No custom VJP: inference/forward path only.  Off-TPU falls
+    back to the lax blockwise kernel unless ``interpret=True``.
+    """
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = False
+        if jax.default_backend() != "tpu":
+            from ...parallel.ring_attention import blockwise_attention
+            return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                       return_lse=True)
+
+    block_q = block_q or min(128, _round_up(T, 8))
+    block_k = block_k or min(128, _round_up(Tk, 8))
+    qt = q.transpose(0, 2, 1, 3)                       # [B, H, T, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    pq = _round_up(T, block_q) - T
+    pk = _round_up(Tk, block_k) - Tk
+    if pq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    o, lse = _fwd(qt, kt, vt, causal, scale, block_q, block_k, Tk, interpret)
+    if pq:
+        o = o[:, :, :T]
+        lse = lse[:, :, :T]
+    return o.transpose(0, 2, 1, 3), lse[..., 0]
+
+
 def flash_self_attention(q, k, v, causal=True, batch_axis="dp",
                          head_axis="tp"):
     """Mesh-aware flash attention: q/k/v [B, T, H, D] with batch possibly

@@ -221,7 +221,11 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "faults_injected", "corrupt_records", "io_retries",
                   # overload-safe serving layer (docs/SERVING.md)
                   "requests_admitted", "requests_shed", "hedges_fired",
-                  "breaker_trips", "batches_closed_by_deadline")
+                  "breaker_trips", "batches_closed_by_deadline",
+                  # continuous-batching generative inference
+                  # (docs/GENERATIVE.md)
+                  "gen_prefills", "gen_decode_iters", "gen_tokens",
+                  "gen_pages_shed")
 _DISPATCH_PREFIX = "dispatch."
 
 

@@ -73,6 +73,7 @@ from . import telemetry as _telemetry
 from .async_kv import backoff_delay as _backoff_delay
 
 __all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
+           "StreamingFuture",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
            "Unavailable",
            "STARTING", "SERVING", "DEGRADED", "DRAINING", "STOPPED"]
@@ -212,6 +213,84 @@ class ServingFuture:
 
     def latency_s(self):
         return None if self.t_done is None else self.t_done - self.t_admit
+
+
+class StreamingFuture(ServingFuture):
+    """A :class:`ServingFuture` whose result accretes incrementally — the
+    generative-serving request handle (``mxnet_tpu.generation``,
+    docs/GENERATIVE.md).
+
+    The terminal contract is unchanged: exactly one typed terminal outcome
+    per admitted request (``result()`` returns the full token list or
+    raises the typed :class:`ServingError`).  On top of that the producer
+    streams tokens as they are generated; consumers pick one of
+    - ``on_token(token_id)`` callback, invoked from the scheduler thread
+      with no locks held — keep it fast, it gates decode iterations;
+    - the :meth:`tokens` iterator, yielding each token as it lands and
+      finishing (or raising the terminal error) at settlement;
+    - plain ``result()``, ignoring the stream entirely.
+    A token emitted concurrently with a terminal race (deadline, drain)
+    is dropped rather than delivered after the outcome — the stream is
+    always a prefix of the settled result.
+    """
+
+    __slots__ = ("_stream", "_stream_cv", "_on_token", "t_first_token")
+
+    def __init__(self, inputs, rows, deadline, t_admit, on_token=None):
+        super().__init__(inputs, rows, deadline, t_admit)
+        self._stream = []
+        self._stream_cv = threading.Condition()
+        self._on_token = on_token
+        self.t_first_token = None
+
+    def _emit(self, token):
+        """Producer side: append one token (no server lock held).  Returns
+        False (and drops the token) when the future is already terminal."""
+        with self._stream_cv:
+            if self._event.is_set():
+                return False
+            if self.t_first_token is None:
+                self.t_first_token = time.monotonic()
+            self._stream.append(token)
+            self._stream_cv.notify_all()
+        if self._on_token is not None:
+            self._on_token(token)
+        return True
+
+    def _settle(self):
+        # take the stream lock across the terminal flip so an _emit racing
+        # with settlement either lands fully before it or is dropped —
+        # never delivered after the typed outcome
+        with self._stream_cv:
+            super()._settle()
+            self._stream_cv.notify_all()
+
+    @property
+    def stream_tokens(self):
+        """Snapshot of the tokens streamed so far."""
+        with self._stream_cv:
+            return list(self._stream)
+
+    def tokens(self, timeout=None):
+        """Iterate over generated tokens as they arrive.
+
+        Ends at a successful terminal outcome; raises the typed
+        :class:`ServingError` if the request settled with one.  ``timeout``
+        bounds the wait for EACH next token, not the whole stream."""
+        i = 0
+        while True:
+            with self._stream_cv:
+                while i >= len(self._stream) and not self._event.is_set():
+                    if not self._stream_cv.wait(timeout):
+                        raise TimeoutError(
+                            "no token after %ss" % timeout)
+                if i >= len(self._stream):
+                    break
+                tok = self._stream[i]
+                i += 1
+            yield tok
+        if self._error is not None:
+            raise self._error
 
 
 class _BatchJob:
@@ -472,10 +551,7 @@ class ModelServer:
             if isinstance(spec, tuple):
                 buckets = [b for b in spec if b <= self.max_batch]
             else:                      # None or 'pow2': pow2 chain
-                buckets, b = [], 1
-                while b < self.max_batch:
-                    buckets.append(b)
-                    b <<= 1
+                buckets = list(_dispatch.pow2_chain(self.max_batch))
         buckets = sorted(set(int(b) for b in buckets) | {self.max_batch})
         return tuple(b for b in buckets if b <= self.max_batch)
 

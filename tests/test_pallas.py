@@ -9,8 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mxnet_tpu.ops.pallas import flash_attention, fused_rmsnorm, \
-    fused_softmax_xent
+from mxnet_tpu.ops.pallas import flash_attention, flash_attention_lse, \
+    fused_rmsnorm, fused_softmax_xent
 from mxnet_tpu.ops.pallas.flash_attention import _flash  # noqa: F401
 from mxnet_tpu.ops.pallas.layers import _rmsnorm_lax, _xent_lax
 from mxnet_tpu.parallel.ring_attention import blockwise_attention
@@ -43,6 +43,24 @@ class TestFlashAttention:
             out = flash_attention(q, k, v, causal=causal, interpret=True)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_lse_parity(self, causal):
+        # flash_attention_lse (the ring-attention block kernel) must agree
+        # with the lax blockwise oracle on BOTH the normalized output and
+        # the logsumexp, or merged partials drift
+        shape = (2, 100, 2, 32)          # unaligned T exercises padding
+        q = _rand(0, shape)
+        k = _rand(1, shape)
+        v = _rand(2, shape)
+        ref_o, ref_lse = blockwise_attention(q, k, v, causal=causal,
+                                             return_lse=True)
+        out_o, out_lse = flash_attention_lse(q, k, v, causal=causal,
+                                             interpret=True)
+        np.testing.assert_allclose(np.asarray(out_o), np.asarray(ref_o),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out_lse), np.asarray(ref_lse),
+                                   rtol=2e-5, atol=2e-5)
 
     def test_grad_parity(self):
         shape = (1, 128, 2, 32)
