@@ -11,6 +11,7 @@
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
+#   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
@@ -44,6 +45,9 @@ serve-smoke:
 gen-smoke:
 	bash ci/runtime_functions.sh gen_check
 
+fleet-smoke:
+	bash ci/runtime_functions.sh fleet_check
+
 obs-smoke:
 	bash ci/runtime_functions.sh obs_check
 
@@ -53,4 +57,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke obs-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke fleet-smoke obs-smoke ci clean

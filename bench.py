@@ -484,6 +484,9 @@ def main(argv=None):
     def longctx_leg():
         return long_context_bench()
 
+    def fleet_leg():
+        return fleet_bench(quick=quick)
+
     # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
     # builds a second XLA module — so some exceed their full-mode numbers
     legs = [
@@ -505,6 +508,11 @@ def main(argv=None):
     # generative inference is accepted on decode_tokens_per_sec / ttft_ms
     if os.environ.get("BENCH_DECODE", "1") != "0":
         legs.append(("decode", decode_leg, 60 if quick else 90))
+    # the fleet leg runs in quick mode too: the sharded-serving +
+    # autoscaling layer is accepted on fleet_scaleup_ms (lower-better
+    # under the >10% regression tripwire) and the 2x-capacity shed rate
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        legs.append(("fleet", fleet_leg, 60 if quick else 120))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
@@ -684,6 +692,99 @@ def decode_bench(quick=False):
         out["decode_recompiles_in_window"] = int(
             profiler.dispatch_value("recompile") - base_recompiles)
     finally:
+        srv.drain(timeout=30)
+    return out
+
+
+def fleet_bench(quick=False):
+    """Fleet-layer leg (docs/SHARDED_SERVING.md): a pjit-sharded
+    ModelServer (tp=2 mesh slices) under a :class:`FleetSupervisor`.
+    Reports ``fleet_scaleup_ms`` — wall time from burst onset to the
+    autoscaled second replica entering rotation (the elasticity number
+    the fleet layer is accepted on) — and the steady-state shed rate at
+    2x admission capacity AFTER the scale-up, which the extra replica
+    should hold well below the single-replica burst's."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.fleet import FleetSupervisor
+
+    rng = np.random.RandomState(0)
+    d_in = 64
+    data = mx.sym.var("data")
+    w1, b1 = mx.sym.var("fc1_weight"), mx.sym.var("fc1_bias")
+    sym = mx.sym.FullyConnected(data, w1, b1, num_hidden=8, name="fc1")
+    params = {
+        "arg:fc1_weight": mx.nd.array(
+            (rng.rand(8, d_in) * 0.1).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.zeros((8,)),
+    }
+    rules = [("fc1_weight", ("tp", None))]
+    max_queue = 16
+    xs = [rng.rand(1, d_in).astype(np.float32) for _ in range(16)]
+
+    out = {}
+    srv = serving.ModelServer(sym, dict(params),
+                              input_shapes={"data": (1, d_in)},
+                              mesh_axes={"tp": 2}, rules=rules,
+                              max_queue=max_queue, max_batch=8,
+                              max_wait_ms=0, deadline_ms=30_000)
+    sup = FleetSupervisor(srv, service="bench", heartbeat_s=0.05,
+                          interval_s=0.05, min_replicas=1,
+                          max_replicas=2, shed_up=0.02,
+                          idle_down_s=60, cooldown_s=0.2,
+                          breach_ticks=2)
+    try:
+        for x in xs:
+            srv.submit({"data": x})              # settle caches
+        out["fleet_replica_devices"] = \
+            srv.snapshot()["replicas"][0]["devices"]
+
+        # -- burst -> scale-up latency --
+        futs = []
+        t0 = time.perf_counter()
+        deadline = t0 + (60 if quick else 120)
+        while time.perf_counter() < deadline and \
+                srv.num_active_replicas() < 2:
+            for i in range(2 * max_queue):
+                try:
+                    futs.append(srv.submit_async(
+                        {"data": xs[i % len(xs)]}, deadline_ms=30_000))
+                except serving.Overloaded:
+                    pass
+        out["fleet_scaleup_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except serving.ServingError:
+                pass
+
+        # -- steady-state shed rate at 2x capacity, scaled fleet --
+        n_waves = 10 if quick else 40
+        offered = shed = 0
+        futs = []
+        for _ in range(n_waves):
+            for i in range(2 * max_queue):
+                offered += 1
+                try:
+                    futs.append(srv.submit_async(
+                        {"data": xs[i % len(xs)]}, deadline_ms=30_000))
+                except serving.Overloaded:
+                    shed += 1
+            time.sleep(0.01)
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except serving.ServingError:
+                pass
+        out["fleet_shed_rate_2x"] = round(shed / max(offered, 1), 4)
+        out["fleet_replicas_final"] = srv.num_active_replicas()
+        out["fleet_scale_ups"] = sup.scale_ups
+    finally:
+        sup.stop()
+        sup.registry.close()
         srv.drain(timeout=30)
     return out
 

@@ -148,6 +148,24 @@ gen_check() {
     fi
 }
 
+fleet_check() {
+    # Fleet layer (docs/SHARDED_SERVING.md): pjit-sharded replicas over
+    # mesh slices (single-device output parity, zero under-load
+    # recompiles, param-ownership regression), KV-backed registry
+    # TTL/reap semantics, and the shed-rate autoscaler acceptance —
+    # scale-up on burst, drain on idle, chaos registry_stale +
+    # replica_slow_start convergence with every request typed.
+    python -m pytest tests/test_fleet.py -q
+    # the fleet module must lint clean — NO suppressions: both
+    # supervisor loops run lock-free by design, so a single CC001 slip
+    # means someone added a lock across a blocking registry RPC
+    python -m mxnet_tpu.lint mxnet_tpu/fleet.py
+    if grep -n "mxlint: disable" mxnet_tpu/fleet.py; then
+        echo "fleet.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 obs_check() {
     # Always-on telemetry plane (docs/OBSERVABILITY.md): metrics
     # registry, histogram quantiles, exporters, profiler ring buffer +
@@ -229,6 +247,7 @@ all() {
     unittest_serving
     serving_check
     gen_check
+    fleet_check
     obs_check
     unittest_dtype_sweep
     integration_examples

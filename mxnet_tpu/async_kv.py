@@ -112,6 +112,9 @@ class _Server(socketserver.ThreadingTCPServer):
         super().__init__(addr, _Handler)
         self.store: dict = {}
         self.row_tables: dict = {}
+        # service registry: key -> (value, expires_at) under TTL
+        # (the fleet layer's heartbeat store, docs/SHARDED_SERVING.md)
+        self.registry: dict = {}
         self.updater = None
         self.lock = threading.Lock()
         self._str_idx: dict = {}
@@ -259,6 +262,36 @@ class _Handler(socketserver.BaseRequestHandler):
                 [_row_of(tbl, int(i)).copy()
                  for i in ids]) if len(ids) else \
                 np.zeros((0,) + tbl["shape"][1:], tbl["dtype"])
+        # -- service registry (TTL'd keys; mxnet_tpu.fleet) -------------
+        if op == "rset":
+            value, ttl_s = payload
+            srv.registry[key] = (value, time.monotonic() + float(ttl_s))
+            return None
+        if op == "rget":
+            ent = srv.registry.get(key)
+            if ent is None:
+                return KeyError(key)
+            value, expires = ent
+            if time.monotonic() >= expires:
+                del srv.registry[key]       # lazily reap on read
+                return KeyError(key)
+            return value
+        if op == "rdel":
+            srv.registry.pop(key, None)
+            return None
+        if op == "rlist":
+            now = time.monotonic()
+            prefix = key or ""
+            return {k: (v, e - now) for k, (v, e) in srv.registry.items()
+                    if k.startswith(prefix) and e > now}
+        if op == "rreap":
+            now = time.monotonic()
+            prefix = key or ""
+            dead = [k for k, (_, e) in srv.registry.items()
+                    if k.startswith(prefix) and e <= now]
+            for k in dead:
+                del srv.registry[k]
+            return dead
         if op == "set_optimizer":
             from . import optimizer as opt
 
@@ -436,6 +469,30 @@ class AsyncKVClient:
     def set_optimizer(self, pickled_optimizer):
         self._call("set_optimizer", key=None, payload=pickled_optimizer)
 
+    # -- service registry (TTL'd keys; the fleet layer's heartbeat
+    #    store — mxnet_tpu.fleet / docs/SHARDED_SERVING.md) -------------
+    def registry_set(self, key, value, ttl_s):
+        """Publish ``key`` with a TTL: a heartbeat that is not refreshed
+        within ``ttl_s`` seconds expires and the reaper purges it."""
+        self._call("rset", key, (value, float(ttl_s)))
+
+    def registry_get(self, key):
+        """Current live value (KeyError once the TTL lapsed)."""
+        return self._call("rget", key)
+
+    def registry_delete(self, key):
+        """Withdraw a registry entry (clean deregistration on drain)."""
+        self._call("rdel", key)
+
+    def registry_list(self, prefix=""):
+        """Live entries under ``prefix``: {key: (value, ttl_remaining)}."""
+        return self._call("rlist", prefix)
+
+    def registry_reap(self, prefix=""):
+        """Purge expired entries under ``prefix``; returns the reaped
+        keys (the supervisor counts them as ``fleet.reaped``)."""
+        return self._call("rreap", prefix)
+
     # -- row tables (server-side sparse reduce) -------------------------
     def init_rows(self, key, shape, dtype, pickled_initializer):
         self._call("init_rows", key,
@@ -446,3 +503,15 @@ class AsyncKVClient:
 
     def pull_rows(self, key, ids_np):
         return self._call("pull_rows", key, ids_np)
+
+
+def start_local_server(host="127.0.0.1", port=0, reap_s=None):
+    """Start an in-process KV server on a daemon thread (tests, and the
+    single-host fleet registry's default backing store); returns
+    ``(server, "host:port")`` — pass the address to
+    :class:`AsyncKVClient` / :class:`mxnet_tpu.fleet.ServiceRegistry`,
+    call ``server.shutdown()`` when done."""
+    server = _Server((host, int(port)), reap_s=reap_s)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, "%s:%d" % (server.server_address[0],
+                              server.server_address[1])

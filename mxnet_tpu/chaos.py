@@ -37,6 +37,13 @@ Faults (each firing bumps the ``faults_injected`` dispatch counter):
                     circuit breaker)
 ``request_burst@N`` serving: the Nth load-generator wave is multiplied
                     8x (overload — exercises shedding/bounded queue)
+``registry_stale@N``  fleet: the Nth heartbeat publish is dropped so the
+                    registry entry's TTL lapses and the reaper fires
+                    (docs/SHARDED_SERVING.md)
+``replica_slow_start@N``  fleet: the Nth ``ModelServer.add_replica`` call
+                    stalls ~300ms before building (a cold replica whose
+                    compile/weight load drags — the autoscaler must
+                    absorb it, not wedge)
 ==================  ========================================================
 
 Every fault fires at most once per process (deterministic, idempotent
@@ -55,12 +62,14 @@ __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "corrupt_loss_scale", "poison_grad", "flip_param_bit",
            "arm_kv_client", "corrupt_checkpoint", "FAULT_KINDS",
            "slow_replica", "replica_crash", "request_burst",
+           "registry_stale", "replica_slow_start",
            "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
     "nan_grad", "bitflip_param", "kv_drop", "kv_delay", "kv_dup",
     "ckpt_truncate", "ckpt_bitflip", "loader_raise",
     "slow_replica", "replica_crash", "request_burst",
+    "registry_stale", "replica_slow_start",
 })
 
 
@@ -329,6 +338,26 @@ def request_burst(n, factor=8):
     if plan is not None and plan.fire("request_burst", n):
         return int(factor)
     return 1
+
+
+def registry_stale(n):
+    """``registry_stale@N``: True when the Nth fleet heartbeat publish
+    should be dropped — the replica's TTL'd registry entry lapses and
+    the reaper must purge it (then the next beat re-registers; the
+    fleet view self-heals)."""
+    plan = active()
+    return plan is not None and plan.fire("registry_stale", n)
+
+
+def replica_slow_start(n, delay=0.3):
+    """``replica_slow_start@N``: seconds the Nth ``add_replica`` build
+    should stall before starting (0.0 otherwise).  The serving layer
+    sleeps OUTSIDE every lock, then builds normally — a slow cold
+    start, not a failure; scale-up latency absorbs it."""
+    plan = active()
+    if plan is not None and plan.fire("replica_slow_start", n):
+        return float(delay)
+    return 0.0
 
 
 class ChaosDataset:

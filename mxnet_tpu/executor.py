@@ -279,6 +279,30 @@ class Executor:
                           else None for h in r)
                     if isinstance(r, (tuple, list)) else r for r in rest)
                 return _inner(rng, args, auxs, *rest)
+        elif self._sharded_mesh() is not None:
+            # pjit-sharded params (serving mesh-slice replicas,
+            # docs/SHARDED_SERVING.md): the bound weights are committed
+            # multi-device arrays, so the module already runs across the
+            # slice.  Every single-device operand — the rng key, staged
+            # request inputs, unsharded params — must be replicated onto
+            # the slice's mesh or jit rejects the mixed committed device
+            # sets; always-replicated inputs also keep the compile cache
+            # keys constant, so a warmed server never recompiles.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._sharded_mesh(), PartitionSpec())
+
+            def place(x, _repl=repl):
+                try:
+                    if len(x.sharding.device_set) > 1:
+                        return x
+                except (AttributeError, TypeError):
+                    pass
+                return jax.device_put(x, _repl)
+
+            def f(rng, args, auxs, *rest, _inner=inner, _place=place):
+                return _inner(_place(rng), [_place(a) for a in args],
+                              [_place(a) for a in auxs], *rest)
         else:
             # pin execution to the bound context's device: without this a
             # cpu()-bound executor on a TPU host runs under the default
@@ -294,6 +318,19 @@ class Executor:
         return f
 
     # ------------------------------------------------------------------
+    def _sharded_mesh(self):
+        """The mesh of any multi-device bound array (pjit-sharded mode),
+        else None.  Evaluated at compile-wrapper build time — sharding is
+        applied right after bind, before the first forward."""
+        for a in list(self.arg_arrays) + list(self.aux_arrays):
+            try:
+                sh = a.data.sharding
+                if len(sh.device_set) > 1:
+                    return sh.mesh
+            except (AttributeError, TypeError):
+                continue
+        return None
+
     def _devolve(self, vals):
         """Under dp: move mesh-replicated results to the primary device."""
         if not self._dp_devs:

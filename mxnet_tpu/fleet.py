@@ -1,0 +1,452 @@
+"""Fleet layer: service registry + shed-rate-driven replica autoscaling.
+
+The reference framework's elasticity story is ps-lite heartbeats plus an
+external job manager restarting dead workers (SURVEY §5).  This module is
+the TPU-native closing of that loop on top of the overload-safe serving
+stack: the same signals the serving layer already exports (shed rate,
+queue depth, the ``serving.latency_ms`` p99) drive a supervisor that adds
+and drains **logical replicas** — pjit-sharded mesh slices
+(``ModelServer(mesh_axes=...)``, docs/SHARDED_SERVING.md) or
+shared-weight clones — against explicit bounds, hysteresis, and
+cooldowns.
+
+Three cooperating parts:
+
+* :class:`ServiceRegistry` — TTL'd heartbeat/load-report store over the
+  ``async_kv`` transport (one ``rset`` per replica per beat under
+  ``fleet/<service>/<rid>``).  An entry whose TTL lapses is *stale*; the
+  reaper purges it and the next beat re-registers — the fleet view
+  self-heals through heartbeat loss (chaos kind ``registry_stale``).
+  Without an address it starts an in-process server, so a single-host
+  fleet needs zero configuration.
+* :class:`FleetView` — one point-in-time snapshot of the registry:
+  live replicas, their load reports, what the reaper just purged.
+* :class:`FleetSupervisor` — the control loop.  Scale **up** when the
+  windowed shed rate or the latency p99 breaches its threshold for
+  ``breach_ticks`` consecutive ticks (hysteresis) and the cooldown has
+  elapsed; scale **down** after ``idle_down_s`` of continuous idle.
+  Scale-down rides the rc-76 retirement contract the serving layer
+  already has (``ModelServer.remove_replica`` — in-flight work finishes,
+  then the mesh slice returns to the pool), so it is free.
+
+Every decision is observable: ``fleet.replicas`` / ``fleet.shed_rate`` /
+``fleet.p99_ms`` / ``fleet.free_slices`` gauges, the
+``fleet.scaleup_ms`` histogram (burst -> first new-replica admission),
+and the ``fleet_*`` dispatch counters.
+
+Threading model: two daemon threads (heartbeat publisher, control loop),
+both lock-free — supervisor state is plain attribute writes, the server
+is only touched through its own locked public surface, and every
+blocking call (registry RPC, replica build/warm) runs with no lock held
+(the CC001 discipline mxlint enforces).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from . import chaos as _chaos
+from . import telemetry as _telemetry
+from .async_kv import AsyncKVClient, start_local_server
+
+__all__ = ["ServiceRegistry", "FleetView", "FleetSupervisor"]
+
+# env-tunable defaults (docs/SHARDED_SERVING.md / docs/ENV_VARS.md)
+_DEF_HEARTBEAT_S = float(os.environ.get("MXTPU_FLEET_HEARTBEAT_S", "0.25"))
+_DEF_TTL_S = os.environ.get("MXTPU_FLEET_TTL_S", "")
+_DEF_INTERVAL_S = float(os.environ.get("MXTPU_FLEET_INTERVAL_S", "0.25"))
+_DEF_MIN_REPLICAS = int(os.environ.get("MXTPU_FLEET_MIN_REPLICAS", "1"))
+_DEF_MAX_REPLICAS = int(os.environ.get("MXTPU_FLEET_MAX_REPLICAS", "4"))
+_DEF_SHED_UP = float(os.environ.get("MXTPU_FLEET_SHED_UP", "0.05"))
+_DEF_P99_UP_MS = float(os.environ.get("MXTPU_FLEET_P99_UP_MS", "0"))
+_DEF_IDLE_DOWN_S = float(os.environ.get("MXTPU_FLEET_IDLE_DOWN_S", "2.0"))
+_DEF_COOLDOWN_S = float(os.environ.get("MXTPU_FLEET_COOLDOWN_S", "1.0"))
+_DEF_BREACH_TICKS = int(os.environ.get("MXTPU_FLEET_BREACH_TICKS", "2"))
+
+
+def _log(msg):
+    print("[fleet] %s" % msg, file=sys.stderr, flush=True)
+
+
+def _count(name, delta=1):
+    from . import profiler as _prof
+
+    _prof.dispatch_count(name, delta)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+class ServiceRegistry:
+    """TTL'd service registry over the async-KV transport.
+
+    ``addr='host:port'`` joins an existing KV server (the multi-host
+    deployment: every host's supervisor publishes into one store);
+    without it an in-process server is started and owned.  Keys live
+    under ``fleet/<service>/<replica_id>``; a value is whatever picklable
+    load report the publisher sends.  TTL semantics are server-side
+    monotonic time, so publishers never need synchronized clocks.
+    """
+
+    def __init__(self, addr=None, client=None, service="default",
+                 ttl_s=None):
+        self.service = str(service)
+        if ttl_s is None:
+            ttl_s = float(_DEF_TTL_S) if _DEF_TTL_S \
+                else 3.0 * _DEF_HEARTBEAT_S
+        self.ttl_s = float(ttl_s)
+        self._server = None
+        if client is None:
+            if addr is None:
+                self._server, addr = start_local_server()
+            client = AsyncKVClient(addr=addr)
+        self._client = client
+        self.addr = addr
+
+    @property
+    def prefix(self):
+        return "fleet/%s/" % self.service
+
+    def _key(self, rid):
+        return self.prefix + str(rid)
+
+    def publish(self, rid, report, ttl_s=None):
+        """One heartbeat: (re)register ``rid`` with its load report for
+        one TTL window."""
+        self._client.registry_set(self._key(rid), dict(report),
+                                  self.ttl_s if ttl_s is None else ttl_s)
+
+    def withdraw(self, rid):
+        """Clean deregistration (drain/scale-down) — no TTL wait."""
+        self._client.registry_delete(self._key(rid))
+
+    def reap(self):
+        """Purge expired entries for this service; returns reaped ids."""
+        pfx = self.prefix
+        return [k[len(pfx):] for k in self._client.registry_reap(pfx)]
+
+    def view(self, reap=True):
+        """Point-in-time :class:`FleetView` (reaping stale entries first
+        unless ``reap=False``)."""
+        reaped = self.reap() if reap else []
+        pfx = self.prefix
+        entries = {}
+        for key, (value, ttl_left) in self._client.registry_list(pfx) \
+                .items():
+            entries[key[len(pfx):]] = (value, ttl_left)
+        return FleetView(self.service, entries, reaped)
+
+    def close(self):
+        """Shut down the owned in-process server (no-op when joined)."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class FleetView:
+    """One registry snapshot: live replicas + load reports + reap log."""
+
+    def __init__(self, service, entries, reaped=()):
+        self.service = service
+        self.replicas = {rid: report for rid, (report, _) in
+                         entries.items()}
+        self.ttl_remaining = {rid: ttl for rid, (_, ttl) in
+                              entries.items()}
+        self.reaped = list(reaped)
+
+    @property
+    def alive(self):
+        return sorted(self.replicas)
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def total(self, field, default=0):
+        return sum(r.get(field, default) for r in self.replicas.values())
+
+    def max(self, field, default=0):
+        vals = [r.get(field, default) for r in self.replicas.values()]
+        return max(vals) if vals else default
+
+    def as_dict(self):
+        return {"service": self.service, "alive": self.alive,
+                "reaped": self.reaped, "replicas": dict(self.replicas)}
+
+    def __repr__(self):
+        return "FleetView(%s: %d alive%s)" % (
+            self.service, len(self),
+            ", %d reaped" % len(self.reaped) if self.reaped else "")
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+class FleetSupervisor:
+    """Autoscaling control loop over a :class:`ModelServer`.
+
+    The heartbeat thread publishes one TTL'd load report per active
+    replica per beat; the control thread reaps stale entries, recomputes
+    the windowed shed rate and latency p99, and moves the replica count:
+
+    * **up** — when ``shed_rate >= shed_up`` (or ``p99 >= p99_up_ms``
+      when enabled) for ``breach_ticks`` consecutive ticks, replicas are
+      below ``max_replicas``, and the cooldown has elapsed.  The whole
+      build (+warm) is timed into the ``fleet.scaleup_ms`` histogram.
+    * **down** — after ``idle_down_s`` of continuous idle (no offered
+      traffic, empty queue, nothing in flight) above ``min_replicas``,
+      again behind the cooldown.  Retirement is the serving layer's
+      existing drain contract, so no request is lost.
+
+    ``stop()`` joins both threads and withdraws the replicas' registry
+    entries.  The supervisor never holds a lock across anything blocking.
+    """
+
+    def __init__(self, server, registry=None, service="default",
+                 heartbeat_s=None, interval_s=None,
+                 min_replicas=None, max_replicas=None,
+                 shed_up=None, p99_up_ms=None, idle_down_s=None,
+                 cooldown_s=None, breach_ticks=None, start=True):
+        self.server = server
+        self.registry = registry if registry is not None \
+            else ServiceRegistry(service=service)
+        self.heartbeat_s = _DEF_HEARTBEAT_S if heartbeat_s is None \
+            else float(heartbeat_s)
+        self.interval_s = _DEF_INTERVAL_S if interval_s is None \
+            else float(interval_s)
+        self.min_replicas = _DEF_MIN_REPLICAS if min_replicas is None \
+            else int(min_replicas)
+        self.max_replicas = _DEF_MAX_REPLICAS if max_replicas is None \
+            else int(max_replicas)
+        self.shed_up = _DEF_SHED_UP if shed_up is None else float(shed_up)
+        self.p99_up_ms = _DEF_P99_UP_MS if p99_up_ms is None \
+            else float(p99_up_ms)
+        self.idle_down_s = _DEF_IDLE_DOWN_S if idle_down_s is None \
+            else float(idle_down_s)
+        self.cooldown_s = _DEF_COOLDOWN_S if cooldown_s is None \
+            else float(cooldown_s)
+        self.breach_ticks = max(1, _DEF_BREACH_TICKS if breach_ticks
+                                is None else int(breach_ticks))
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas "
+                             "(got %d..%d)" % (self.min_replicas,
+                                               self.max_replicas))
+
+        # control state (written by the loops, read by snapshot():
+        # plain attributes, no lock — nothing blocks on them)
+        self.shed_rate = 0.0
+        self.p99_ms = 0.0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.reaped_total = 0
+        self.heartbeats = 0
+        self.heartbeats_dropped = 0
+        self._last_shed = None
+        self._last_admitted = None
+        self._last_hist_count = None
+        self._breach_streak = 0
+        self._idle_since = None
+        self._cooldown_until = 0.0
+        self._beat_seq = 0
+        self._published = set()
+
+        self._stop_evt = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._heartbeat_loop,
+                             name="fleet-heartbeat", daemon=True),
+            threading.Thread(target=self._control_loop,
+                             name="fleet-control", daemon=True),
+        ]
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for t in self._threads:
+            if not t.is_alive():
+                t.start()
+        return self
+
+    def stop(self, withdraw=True):
+        """Stop both loops; withdraw this fleet's registry entries so
+        peers see a clean deregistration instead of a TTL lapse."""
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if withdraw:
+            for rid in sorted(self._published):
+                try:
+                    self.registry.withdraw(rid)
+                except Exception:
+                    pass      # registry may already be gone at teardown
+        _log("supervisor stopped (%d up / %d down, %d beats)"
+             % (self.scale_ups, self.scale_downs, self.heartbeats))
+
+    def snapshot(self):
+        """Point-in-time control-loop view (tests/metrics)."""
+        return {
+            "replicas": self.server.num_active_replicas(),
+            "shed_rate": self.shed_rate,
+            "p99_ms": self.p99_ms,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "reaped_total": self.reaped_total,
+            "heartbeats": self.heartbeats,
+            "heartbeats_dropped": self.heartbeats_dropped,
+            "breach_streak": self._breach_streak,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+        }
+
+    # -- heartbeat thread --------------------------------------------------
+    def _heartbeat_loop(self):
+        reg = _telemetry.registry()
+        while not self._stop_evt.is_set():
+            beat = self._beat_seq
+            self._beat_seq += 1
+            if _chaos.registry_stale(beat):
+                # injected heartbeat loss: the TTL lapses and the reaper
+                # fires; the NEXT beat re-registers (self-healing)
+                self.heartbeats_dropped += 1
+                _count("fleet_heartbeats_dropped")
+            else:
+                try:
+                    snap = self.server.snapshot()
+                    for r in snap["replicas"]:
+                        self.registry.publish(r["id"], {
+                            "state": snap["state"],
+                            "breaker": r["breaker"],
+                            "inflight": r["inflight"],
+                            "devices": r.get("devices", 1),
+                            "queue_depth": snap["queue_depth"],
+                            "shed_rate": self.shed_rate,
+                            "p99_ms": self.p99_ms,
+                            "beat": beat,
+                        })
+                        self._published.add(r["id"])
+                        self.heartbeats += 1
+                        _count("fleet_heartbeats")
+                except Exception as e:
+                    # a dead registry must not kill the publisher: report
+                    # and retry next beat (the transport already retries)
+                    _log("heartbeat failed: %s: %s"
+                         % (type(e).__name__, e))
+            reg.gauge("fleet.replicas").set(
+                self.server.num_active_replicas())
+            self._stop_evt.wait(self.heartbeat_s)
+
+    # -- control thread ----------------------------------------------------
+    def _signals(self):
+        """Windowed load signals from the server stats + latency
+        histogram: (shed_rate, p99_ms, offered, queue_depth, inflight)."""
+        snap = self.server.snapshot()
+        shed, admitted = snap["shed"], snap["admitted"]
+        d_shed = shed - (self._last_shed if self._last_shed is not None
+                         else shed)
+        d_adm = admitted - (self._last_admitted if self._last_admitted
+                            is not None else admitted)
+        self._last_shed, self._last_admitted = shed, admitted
+        offered = d_shed + d_adm
+        shed_rate = d_shed / offered if offered else 0.0
+
+        hist = _telemetry.registry().histogram("serving.latency_ms")
+        hist_count = hist.count
+        if self._last_hist_count is not None and \
+                hist_count > self._last_hist_count:
+            p99 = hist.percentile(99) or 0.0
+        else:
+            p99 = 0.0     # no completions this window: p99 carries no news
+        self._last_hist_count = hist_count
+
+        inflight = sum(r["inflight"] for r in snap["replicas"])
+        return shed_rate, p99, offered, snap["queue_depth"], inflight, snap
+
+    def _tick(self, now):
+        reaped = self.registry.reap()
+        if reaped:
+            self.reaped_total += len(reaped)
+            _count("fleet_reaped", len(reaped))
+            _log("reaped %d stale registry entr%s: %s"
+                 % (len(reaped), "y" if len(reaped) == 1 else "ies",
+                    reaped))
+
+        shed_rate, p99, offered, depth, inflight, snap = self._signals()
+        self.shed_rate, self.p99_ms = shed_rate, p99
+        reg = _telemetry.registry()
+        reg.gauge("fleet.shed_rate").set(shed_rate)
+        reg.gauge("fleet.p99_ms").set(p99)
+        reg.gauge("fleet.free_slices").set(snap.get("free_slices", 0))
+
+        n = self.server.num_active_replicas()
+        breach = shed_rate >= self.shed_up or \
+            (self.p99_up_ms > 0 and p99 >= self.p99_up_ms)
+        idle = offered == 0 and depth == 0 and inflight == 0
+
+        if breach:
+            self._breach_streak += 1
+            self._idle_since = None
+        else:
+            self._breach_streak = 0
+            if idle:
+                if self._idle_since is None:
+                    self._idle_since = now
+            else:
+                self._idle_since = None
+
+        if breach and self._breach_streak >= self.breach_ticks \
+                and n < self.max_replicas and now >= self._cooldown_until:
+            self._scale_up(n)
+        elif (not breach) and self._idle_since is not None \
+                and now - self._idle_since >= self.idle_down_s \
+                and n > self.min_replicas and now >= self._cooldown_until:
+            self._scale_down(n)
+
+    def _scale_up(self, n):
+        t0 = time.monotonic()
+        try:
+            rid = self.server.add_replica()
+        except Exception as e:
+            # pool exhausted / drain race: back off a full cooldown
+            _log("scale-up blocked: %s: %s" % (type(e).__name__, e))
+            self._cooldown_until = time.monotonic() + self.cooldown_s
+            return
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self.scale_ups += 1
+        self._breach_streak = 0
+        self._cooldown_until = time.monotonic() + self.cooldown_s
+        _count("fleet_scale_ups")
+        _telemetry.registry().histogram("fleet.scaleup_ms").observe(dt_ms)
+        _log("scale UP %d -> %d (replica %d, %.0fms; shed_rate=%.3f "
+             "p99=%.1fms)" % (n, n + 1, rid, dt_ms, self.shed_rate,
+                              self.p99_ms))
+
+    def _scale_down(self, n):
+        try:
+            rid = self.server.remove_replica()
+        except (ValueError, KeyError) as e:
+            _log("scale-down blocked: %s" % e)
+            self._cooldown_until = time.monotonic() + self.cooldown_s
+            return
+        self.scale_downs += 1
+        self._idle_since = time.monotonic()  # re-arm: one window per step
+        self._cooldown_until = time.monotonic() + self.cooldown_s
+        _count("fleet_scale_downs")
+        try:
+            self.registry.withdraw(rid)      # clean deregistration
+        except Exception:
+            pass
+        self._published.discard(rid)
+        _log("scale DOWN %d -> %d (retired replica %d after %.1fs idle)"
+             % (n, n - 1, rid, self.idle_down_s))
+
+    def _control_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self._tick(time.monotonic())
+            except Exception as e:
+                # one bad tick (registry blip, server drain race) must
+                # not end autoscaling for the process's lifetime
+                _log("control tick failed: %s: %s"
+                     % (type(e).__name__, e))
+            self._stop_evt.wait(self.interval_s)

@@ -15,7 +15,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "get_mesh", "local_mesh"]
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "get_mesh",
+           "local_mesh", "mesh_slices"]
 
 _state = threading.local()
 
@@ -97,6 +98,31 @@ def make_mesh(devices=None, **axis_sizes) -> DeviceMesh:
 def local_mesh(**axis_sizes) -> DeviceMesh:
     """Mesh over this process's addressable devices only."""
     return make_mesh(devices=jax.local_devices(), **axis_sizes)
+
+
+def mesh_slices(devices=None, **axis_sizes) -> "list[DeviceMesh]":
+    """Partition the device pool into disjoint meshes of identical shape:
+    ``mesh_slices(tp=2)`` on 8 devices yields four independent tp=2
+    meshes.  Each slice is one *logical serving replica* for
+    :class:`~mxnet_tpu.serving.ModelServer` (docs/SHARDED_SERVING.md):
+    a model too big for one chip lives on one slice, and the slices give
+    the fleet autoscaler its unit of scale-up/scale-down.
+
+    Consecutive device groups keep each slice on adjacent ICI links.
+    Unlike :func:`make_mesh`, leftover devices are NOT absorbed into
+    ``dp`` — the slice shape is exactly the given axis sizes; devices
+    past the last full slice are left unused.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    sizes = {k: int(v) for k, v in axis_sizes.items() if v is not None}
+    per = math.prod(sizes.values()) if sizes else 1
+    if per < 1:
+        raise ValueError("axis sizes %r give an empty slice" % (sizes,))
+    if per > len(devices):
+        raise ValueError("slice needs %d device(s), only %d available"
+                         % (per, len(devices)))
+    return [make_mesh(devices=devices[i:i + per], **sizes)
+            for i in range(0, len(devices) - per + 1, per)]
 
 
 def current_mesh() -> "DeviceMesh | None":

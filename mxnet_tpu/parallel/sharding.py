@@ -24,7 +24,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .mesh import get_mesh
 
 __all__ = ["ShardingRules", "param_sharding", "shard_array", "auto_shard",
-           "constraint", "PartitionSpec"]
+           "constraint", "PartitionSpec", "match_partition_rules",
+           "make_shard_and_gather_fns"]
 
 P = PartitionSpec
 
@@ -85,6 +86,53 @@ def auto_shard(named_arrays, rules: ShardingRules, mesh=None):
     mesh = mesh or get_mesh()
     return {k: shard_array(v, rules.spec_for(k), mesh)
             for k, v in named_arrays.items()}
+
+
+def match_partition_rules(rules, named_arrays):
+    """Resolve a PartitionSpec per named array (fmengine-style regex
+    matching): ``rules`` is a :class:`ShardingRules` or a plain list of
+    ``(regex, PartitionSpec)`` pairs; scalars and size-1 arrays always
+    replicate (a spec axis on a 0-d/1-element array is meaningless), and
+    names no rule matches replicate too (the same default
+    :meth:`ShardingRules.spec_for` uses).  Returns ``{name: spec}``."""
+    if not isinstance(rules, ShardingRules):
+        rules = ShardingRules(list(rules or []))
+    specs = {}
+    for name, arr in named_arrays.items():
+        shape = tuple(getattr(arr, "shape", ()))
+        if len(shape) == 0 or all(d <= 1 for d in shape):
+            specs[name] = PartitionSpec()
+        else:
+            specs[name] = rules.spec_for(name)
+    return specs
+
+
+def make_shard_and_gather_fns(partition_specs, mesh=None):
+    """Per-name shard/gather callables over a spec dict (the
+    ``make_shard_and_gather_fns`` pattern of SNIPPETS.md [2], adapted to
+    the dict-of-arrays currency this framework uses).
+
+    ``shard_fns[name](x)`` places a host/committed array onto the mesh
+    with the spec's NamedSharding (axes the mesh lacks or that do not
+    divide the dim are dropped by :func:`param_sharding` — replicate,
+    never fail).  ``gather_fns[name](x)`` fetches the fully-assembled
+    host copy back (checkpointing / parity checks).  Returns
+    ``(shard_fns, gather_fns)``."""
+    import numpy as np
+
+    mesh = mesh or get_mesh()
+    shard_fns, gather_fns = {}, {}
+    for name, spec in partition_specs.items():
+        def _shard(x, _spec=spec):
+            return jax.device_put(
+                x, param_sharding(_spec, mesh, shape=tuple(np.shape(x))))
+
+        def _gather(x):
+            return np.asarray(jax.device_get(x))
+
+        shard_fns[name] = _shard
+        gather_fns[name] = _gather
+    return shard_fns, gather_fns
 
 
 def constraint(x, *spec_entries, mesh=None):

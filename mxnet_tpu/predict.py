@@ -54,10 +54,23 @@ def _load_params(source):
 
 class Predictor:
     """Inference-only executor over an exported symbol+params pair
-    (reference ``MXPredCreate`` -> ``PredictorObj``)."""
+    (reference ``MXPredCreate`` -> ``PredictorObj``).
+
+    **pjit-sharded mode** (docs/SHARDED_SERVING.md): pass ``mesh=`` (a
+    :class:`~mxnet_tpu.parallel.mesh.DeviceMesh`, e.g. one slice from
+    :func:`~mxnet_tpu.parallel.mesh.mesh_slices`) plus ``rules=`` (a
+    :class:`~mxnet_tpu.parallel.sharding.ShardingRules` or a list of
+    ``(regex, PartitionSpec)`` pairs) and the bound weights are placed
+    across the mesh's devices with NamedShardings; XLA/GSPMD propagates
+    the activation shardings and inserts every collective.  Inputs stay
+    host-staged (uncommitted — jit replicates them), so the compile
+    cache keys are identical to the single-device path: a warmed
+    sharded predictor never recompiles under load.  ``warm()`` /
+    ``health_check()`` / ``clone()`` / ``reshape()`` work unchanged."""
 
     def __init__(self, symbol, params, ctx=None, input_shapes=None,
-                 input_dtypes=None, output_names=None, aot=True):
+                 input_dtypes=None, output_names=None, aot=True,
+                 mesh=None, rules=None):
         from .symbol import Symbol, load as sym_load
         if isinstance(symbol, Symbol):
             sym = symbol
@@ -102,10 +115,28 @@ class Predictor:
                 raise ValueError("input_shapes must cover the data "
                                  "inputs; missing %s" % still)
 
+        # sharded mode rebinds weights in place (_apply_sharding), so it
+        # must own them: as_in_context returns the SAME NDArray when the
+        # ctx already matches, and re-sharding a param shared with a
+        # sibling replica would silently move that replica's weights
+        # onto this replica's mesh slice
+        def _own(arr):
+            arr = arr.as_in_context(self._ctx)
+            if mesh is None:
+                return arr
+            try:
+                devs = arr.data.sharding.device_set
+                if len(devs) > 1 and \
+                        devs == set(mesh.mesh.devices.flat):
+                    return arr      # already on this slice (clone path)
+            except (AttributeError, TypeError):
+                pass
+            return arr.copy()
+
         args = {}
         for name in sym.list_arguments():
             if name in arg_params:
-                args[name] = arg_params[name].as_in_context(self._ctx)
+                args[name] = _own(arg_params[name])
             else:
                 dt = (input_dtypes or {}).get(name, np.float32)
                 args[name] = nd.zeros(input_shapes[name], dtype=dt,
@@ -115,17 +146,61 @@ class Predictor:
             if name not in aux_params:
                 raise ValueError("missing auxiliary state %r in params"
                                  % name)
-            auxs[name] = aux_params[name].as_in_context(self._ctx)
+            auxs[name] = _own(aux_params[name])
 
         self._input_dtypes = dict(input_dtypes or {})
         self._executor = sym.bind(ctx=self._ctx, args=args, grad_req="null",
                                   aux_states=auxs)
+        self._mesh = mesh
+        self._rules = rules
+        if mesh is not None:
+            self._apply_sharding()
         self.outputs = None
         if aot:
             # AOT: trace + XLA-compile the module now by running one forward
             # on the zero-initialized inputs (jit caches by shape, so real
             # requests hit the compiled executable); outputs are discarded
             self._executor.forward(is_train=False)
+
+    def _apply_sharding(self):
+        """Place every bound weight/aux across ``self._mesh`` per the
+        partition rules (regex -> PartitionSpec,
+        :func:`~mxnet_tpu.parallel.sharding.match_partition_rules`).
+        Inputs are deliberately left host-staged: jit replicates
+        uncommitted operands, so request arrays never perturb the
+        compile cache keys."""
+        from .parallel.sharding import (ShardingRules,
+                                        make_shard_and_gather_fns,
+                                        match_partition_rules)
+
+        rules = self._rules
+        if not isinstance(rules, ShardingRules):
+            rules = ShardingRules(list(rules or []))
+        self._rules = rules
+        named = {n: a for n, a in self._executor.arg_dict.items()
+                 if n not in self._input_names}
+        for n, a in self._executor.aux_dict.items():
+            named.setdefault(n, a)
+        specs = match_partition_rules(
+            rules, {n: a.data for n, a in named.items()})
+        shard_fns, self._gather_fns = make_shard_and_gather_fns(
+            specs, self._mesh)
+        for n, a in named.items():
+            a._set_data(shard_fns[n](a.data))
+
+    def gather_params(self):
+        """Fully-assembled host copies of the bound weights/aux (prefixed
+        like :meth:`_shared_params`): the gather half of the shard/gather
+        pair — checkpointing and parity checks against an unsharded
+        oracle.  On an unsharded predictor this is a plain host fetch."""
+        gather = getattr(self, "_gather_fns", None)
+        out = {}
+        for key, arr in self._shared_params().items():
+            name = key.partition(":")[2]
+            fn = gather.get(name) if gather else None
+            out[key] = fn(arr.data) if fn is not None \
+                else np.asarray(arr.asnumpy())
+        return out
 
     # -- c_predict_api surface ------------------------------------------
     def set_input(self, key, data):
@@ -158,7 +233,8 @@ class Predictor:
         MXPredReshape); weights are shared, the graph recompiles."""
         return Predictor(self._symbol, self._shared_params(), ctx=self._ctx,
                          input_shapes=input_shapes,
-                         input_dtypes=self._input_dtypes)
+                         input_dtypes=self._input_dtypes,
+                         mesh=self._mesh, rules=self._rules)
 
     # -- serving hooks (mxnet_tpu.serving) ------------------------------
     def _shared_params(self):
@@ -180,7 +256,8 @@ class Predictor:
                   for n in self._input_names}
         return Predictor(self._symbol, self._shared_params(),
                          ctx=ctx or self._ctx, input_shapes=shapes,
-                         input_dtypes=self._input_dtypes)
+                         input_dtypes=self._input_dtypes,
+                         mesh=self._mesh, rules=self._rules)
 
     def warm(self, batch_sizes):
         """Pre-compile one executable per leading-dim bucket by running a

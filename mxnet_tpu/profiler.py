@@ -225,7 +225,13 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   # continuous-batching generative inference
                   # (docs/GENERATIVE.md)
                   "gen_prefills", "gen_decode_iters", "gen_tokens",
-                  "gen_pages_shed")
+                  "gen_pages_shed",
+                  # fleet layer: sharded replicas + autoscaling
+                  # (docs/SHARDED_SERVING.md)
+                  "fleet_replicas_added", "fleet_replicas_removed",
+                  "fleet_scale_ups", "fleet_scale_downs",
+                  "fleet_heartbeats", "fleet_heartbeats_dropped",
+                  "fleet_reaped")
 _DISPATCH_PREFIX = "dispatch."
 
 

@@ -435,6 +435,7 @@ class Replica:
                                       breaker_backoff_cap)
         self.inflight = 0             # guarded by the server lock
         self.retired = False
+        self.mesh = None              # owning mesh slice (sharded mode)
         self._lock = threading.Lock()
 
     def execute(self, feed):
@@ -464,6 +465,16 @@ class ModelServer:
     ``input_shapes``, replicated ``num_replicas`` times via
     ``Predictor.clone()``) or hand over prebuilt ``predictors=[...]``.
 
+    **Sharded logical replicas** (docs/SHARDED_SERVING.md): pass
+    ``mesh_axes={"tp": 2}`` (+ optional ``rules=`` partition rules and
+    ``devices=``) and the device pool is cut into disjoint mesh slices
+    (:func:`~mxnet_tpu.parallel.mesh.mesh_slices`); each replica is a
+    pjit-sharded ``Predictor`` over one slice — a model too big for one
+    chip serves as ONE logical replica.  :meth:`add_replica` /
+    :meth:`remove_replica` move replicas against the free-slice pool,
+    which is what the fleet autoscaler
+    (:class:`mxnet_tpu.fleet.FleetSupervisor`) drives.
+
     ``submit()`` / ``submit_async()`` take ``{input_name: np.ndarray}``
     with a leading batch dim (usually 1 row) and return the model's
     output list (sliced back to the request's rows) or raise a typed
@@ -475,7 +486,8 @@ class ModelServer:
                  max_queue=None, max_batch=None, max_wait_ms=None,
                  deadline_ms=None, hedge_ms=None, buckets=None,
                  breaker_threshold=None, breaker_backoff=None,
-                 breaker_backoff_cap=None, warm=True):
+                 breaker_backoff_cap=None, warm=True,
+                 mesh_axes=None, rules=None, devices=None):
         self.max_queue = _DEF_MAX_QUEUE if max_queue is None \
             else int(max_queue)
         self.max_batch = _DEF_MAX_BATCH if max_batch is None \
@@ -507,6 +519,7 @@ class ModelServer:
         self._rr = 0
         self._retired = []
         self._replica_seq = 0
+        self._scaleup_seq = 0
         self._ewma_latency = 0.01
         self._preemption = None
         self.stats = {
@@ -515,7 +528,24 @@ class ModelServer:
             "unavailable": 0, "batches_full": 0, "batches_timer": 0,
             "batches_deadline": 0, "hedges_fired": 0, "hedge_wins": 0,
             "wasted_executions": 0, "failovers": 0, "reloads": 0,
+            "replicas_added": 0, "replicas_removed": 0,
         }
+
+        # -- mesh-slice pool (sharded logical replicas) ------------------
+        # one slice = one logical replica: the model lives across the
+        # slice's devices (docs/SHARDED_SERVING.md); the free pool is
+        # the autoscaler's headroom
+        self._rules = rules
+        self._mesh_slices = []
+        self._free_slices = collections.deque()
+        if mesh_axes:
+            if predictors:
+                raise ValueError("mesh_axes builds replicas from "
+                                 "symbol+params; drop predictors=")
+            from .parallel.mesh import mesh_slices as _mesh_slices
+
+            self._mesh_slices = _mesh_slices(devices=devices, **mesh_axes)
+            self._free_slices.extend(self._mesh_slices)
 
         # -- build + warm replicas (still STARTING: nothing admitted) ----
         self._model_spec = (symbol, params, dict(input_shapes or {}), ctx)
@@ -560,21 +590,41 @@ class ModelServer:
         from .predict import Predictor
 
         preds = list(predictors or [])
+        slices = []
         if not preds:
             if symbol is None or params is None:
                 raise ValueError("pass symbol+params (+input_shapes) or "
                                  "predictors=[...]")
-            first = Predictor(symbol, params, ctx=ctx,
-                              input_shapes=input_shapes)
-            preds = [first] + [first.clone()
-                               for _ in range(int(num_replicas) - 1)]
+            if self._mesh_slices:
+                # sharded mode: each replica is an independent Predictor
+                # over its own mesh slice (its own param copy — slices
+                # are disjoint device groups)
+                for _ in range(int(num_replicas)):
+                    if not self._free_slices:
+                        raise ValueError(
+                            "mesh pool has %d slice(s); cannot build %d "
+                            "replicas" % (len(self._mesh_slices),
+                                          int(num_replicas)))
+                    m = self._free_slices.popleft()
+                    slices.append(m)
+                    preds.append(Predictor(symbol, params, ctx=ctx,
+                                           input_shapes=input_shapes,
+                                           mesh=m, rules=self._rules))
+            else:
+                first = Predictor(symbol, params, ctx=ctx,
+                                  input_shapes=input_shapes)
+                preds = [first] + [first.clone()
+                                   for _ in range(int(num_replicas) - 1)]
         out = []
-        for p in preds:
+        for i, p in enumerate(preds):
             if warm:
                 p.warm(self._buckets)     # pre-compile every bucket shape
             rid = self._replica_seq
             self._replica_seq += 1
-            out.append(Replica(rid, p, *self._breaker_cfg))
+            r = Replica(rid, p, *self._breaker_cfg)
+            r.mesh = slices[i] if i < len(slices) \
+                else getattr(p, "_mesh", None)
+            out.append(r)
         return out
 
     # -- public surface ----------------------------------------------------
@@ -733,7 +783,12 @@ class ModelServer:
         replicas FIRST, then flip the replica pointer under the lock.
         In-flight batches finish on the old replicas, which are retired
         once their in-flight count drains to zero.  Admission never
-        pauses."""
+        pauses.
+
+        Sharded servers (``mesh_axes=``) need enough FREE slices for the
+        new replicas — the old ones only return their slices once
+        drained — so keep pool headroom (or scale down first) before a
+        sharded reload."""
         old_symbol, old_params, shapes, ctx = self._model_spec
         symbol = old_symbol if symbol is None else symbol
         if params is None and predictors is None:
@@ -761,6 +816,114 @@ class ModelServer:
         _log("reload: swapped in %d replica(s); %d old retiring"
              % (len(new), len(old)))
 
+    # -- elasticity (the fleet autoscaler's primitives,
+    #    docs/SHARDED_SERVING.md) ------------------------------------------
+    def num_active_replicas(self):
+        with self._cv:
+            return len(self._active_replicas())
+
+    def add_replica(self, predictor=None, warm=True):
+        """Scale up by one replica and admit it to rotation; returns the
+        new replica id.  Sharded servers take the next free mesh slice
+        (raises ``RuntimeError`` when the pool is exhausted); unsharded
+        servers clone the newest active replica (shared weights, no HBM
+        copy).  The build + warm run OUTSIDE the lock, so serving never
+        pauses while a replica compiles."""
+        t0 = time.monotonic()
+        from .predict import Predictor
+
+        with self._cv:
+            if self._drain_flag.is_set() or self._state in (DRAINING,
+                                                            STOPPED):
+                raise Draining("server is draining: not adding replicas")
+            seq = self._scaleup_seq
+            self._scaleup_seq += 1
+            slice_mesh = None
+            template = None
+            if predictor is None:
+                if self._mesh_slices:
+                    if not self._free_slices:
+                        raise RuntimeError(
+                            "mesh pool exhausted (%d slices, all serving "
+                            "or retiring)" % len(self._mesh_slices))
+                    slice_mesh = self._free_slices.popleft()
+                else:
+                    act = self._active_replicas()
+                    if not act:
+                        raise RuntimeError("no active replica to clone")
+                    template = act[-1].predictor
+        try:
+            # chaos replica_slow_start: a cold replica whose compile or
+            # weight load stalls — the autoscaler must absorb the delay,
+            # not wedge (sleep outside every lock)
+            delay = _chaos.replica_slow_start(seq)
+            if delay:
+                time.sleep(delay)
+            if predictor is None:
+                if slice_mesh is not None:
+                    symbol, params, shapes, ctx = self._model_spec
+                    predictor = Predictor(symbol, params, ctx=ctx,
+                                          input_shapes=shapes,
+                                          mesh=slice_mesh,
+                                          rules=self._rules)
+                else:
+                    predictor = template.clone()
+            if warm:
+                predictor.warm(self._buckets)
+        except BaseException:
+            if slice_mesh is not None:
+                with self._cv:
+                    self._free_slices.append(slice_mesh)
+            raise
+        with self._cv:
+            if self._drain_flag.is_set() or self._state in (DRAINING,
+                                                            STOPPED):
+                # raced a drain while building: never admit, return the
+                # slice so a later restart can use it
+                if slice_mesh is not None:
+                    self._free_slices.append(slice_mesh)
+                raise Draining("server drained while the replica built")
+            rid = self._replica_seq
+            self._replica_seq += 1
+            r = Replica(rid, predictor, *self._breaker_cfg)
+            r.mesh = slice_mesh if slice_mesh is not None \
+                else getattr(predictor, "_mesh", None)
+            self._replicas.append(r)
+            self.stats["replicas_added"] += 1
+            self._cv.notify_all()
+        _count("fleet_replicas_added")
+        _log("replica %d added in %.0fms%s" % (
+            rid, (time.monotonic() - t0) * 1e3,
+            " (mesh slice)" if slice_mesh is not None else ""))
+        return rid
+
+    def remove_replica(self, rid=None):
+        """Scale down: retire one replica (the newest by default, or
+        ``rid``).  It leaves rotation immediately; in-flight executions
+        finish under the same retirement machinery hot-swap reload uses
+        (the rc-76 drain discipline — scale-down is free), then its mesh
+        slice returns to the free pool.  Refuses to drop the last active
+        replica.  Returns the retired replica id."""
+        with self._cv:
+            act = self._active_replicas()
+            if len(act) <= 1:
+                raise ValueError("cannot remove the last active replica")
+            if rid is None:
+                r = act[-1]
+            else:
+                r = next((x for x in act if x.id == rid), None)
+                if r is None:
+                    raise KeyError("no active replica %r" % (rid,))
+            r.retired = True
+            self._replicas.remove(r)
+            self._retired.append(r)
+            self.stats["replicas_removed"] += 1
+            self._prune_retired_locked()
+            self._cv.notify_all()
+        _count("fleet_replicas_removed")
+        _log("replica %d retired (scale-down)" % r.id)
+        return r.id
+
     def snapshot(self):
         """Point-in-time stats + lifecycle view (for tests/metrics)."""
         with self._cv:
@@ -769,9 +932,13 @@ class ModelServer:
                 "queue_depth": self._queue_depth_locked(),
                 "replicas": [
                     {"id": r.id, "breaker": r.breaker.state,
-                     "inflight": r.inflight, "trips": r.breaker.trips}
+                     "inflight": r.inflight, "trips": r.breaker.trips,
+                     "devices": (r.mesh.size() if r.mesh is not None
+                                 else 1)}
                     for r in self._replicas],
                 "retired_pending": len(self._retired),
+                "mesh_slices": len(self._mesh_slices),
+                "free_slices": len(self._free_slices),
                 "ewma_latency_s": self._ewma_latency,
                 **dict(self.stats),
             }
@@ -944,7 +1111,18 @@ class ModelServer:
                       if j.unresolved > 0 or j.inflight_execs > 0]
 
     def _prune_retired_locked(self):
-        self._retired = [r for r in self._retired if r.inflight > 0]
+        keep = []
+        for r in self._retired:
+            if r.inflight > 0:
+                keep.append(r)
+                continue
+            # a drained retired replica returns its mesh slice to the
+            # free pool (only slices this server owns, exactly once)
+            m = r.mesh
+            if m is not None and any(m is s for s in self._mesh_slices) \
+                    and not any(m is s for s in self._free_slices):
+                self._free_slices.append(m)
+        self._retired = keep
 
     def _recompute_state_locked(self):
         if self._state not in (SERVING, DEGRADED):

@@ -1,0 +1,398 @@
+"""Fleet layer tests (mxnet_tpu/fleet.py + sharded serving).
+
+The acceptance invariants (ISSUE 8):
+
+* a model pjit-sharded across >= 2 CPU devices serves through
+  ``ModelServer`` as ONE logical replica with output parity against the
+  single-device path, and zero under-load recompiles after warmup;
+* the autoscaler demonstrably scales up on a shed burst and drains back
+  down when idle, within its min/max bounds;
+* registry heartbeats + stale-entry reaping survive injected staleness
+  (chaos ``registry_stale``) and slow replica builds (chaos
+  ``replica_slow_start``), with every request still getting exactly one
+  typed terminal outcome.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, profiler
+from mxnet_tpu.fleet import FleetSupervisor, FleetView, ServiceRegistry
+from mxnet_tpu.parallel.mesh import mesh_slices
+from mxnet_tpu.predict import Predictor
+from mxnet_tpu.serving import ModelServer, ServingError
+
+
+# ---------------------------------------------------------------------------
+# tiny model: 4 -> 6 FC, tensor-parallel over the output dim
+# ---------------------------------------------------------------------------
+RULES = [("fc_weight", ("tp", None))]
+
+
+def _fc_model(seed=3):
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=6, name="fc")
+    rng = np.random.RandomState(seed)
+    wn = rng.rand(6, 4).astype(np.float32)
+    params = {"arg:fc_weight": mx.nd.array(wn),
+              "arg:fc_bias": mx.nd.zeros((6,))}
+    return out, params, wn
+
+
+def _sharded_server(tp=2, n_replicas=1, **kw):
+    sym, params, wn = _fc_model()
+    kw.setdefault("max_wait_ms", 2)
+    kw.setdefault("deadline_ms", 20_000)
+    kw.setdefault("buckets", (1, 2, 4, 8))
+    srv = ModelServer(sym, params, input_shapes={"data": (1, 4)},
+                      mesh_axes={"tp": tp}, rules=RULES,
+                      num_replicas=n_replicas, **kw)
+    return srv, wn
+
+
+def _supervisor(srv, **kw):
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 2)
+    kw.setdefault("shed_up", 0.02)
+    kw.setdefault("idle_down_s", 0.4)
+    kw.setdefault("cooldown_s", 0.2)
+    kw.setdefault("breach_ticks", 2)
+    return FleetSupervisor(srv, service="test", **kw)
+
+
+def _flood(srv, outcomes, n=200):
+    """Submit n single-row requests as fast as admission allows; shed
+    requests land straight in outcomes, admitted ones return futures."""
+    futs = []
+    x = {"data": np.ones((1, 4), np.float32)}
+    for _ in range(n):
+        try:
+            futs.append(srv.submit_async(x))
+        except ServingError as e:
+            outcomes.append(type(e).__name__)
+    return futs
+
+
+def _drain_all(futs, outcomes, timeout=60):
+    for f in futs:
+        try:
+            f.result(timeout=timeout)
+            outcomes.append("ok")
+        except ServingError as e:
+            outcomes.append(type(e).__name__)
+        except TimeoutError:
+            outcomes.append("HUNG")
+
+
+# ---------------------------------------------------------------------------
+# sharded replica: parity + zero recompiles
+# ---------------------------------------------------------------------------
+def test_sharded_server_parity_vs_single_device():
+    """A tp=2 mesh slice serves as one logical replica whose outputs
+    match the plain single-device predictor bit-for-bit shapes and to
+    float tolerance."""
+    srv, wn = _sharded_server(tp=2)
+    try:
+        snap = srv.snapshot()
+        assert snap["replicas"][0]["devices"] == 2
+        rng = np.random.RandomState(0)
+        for rows in (1, 3, 8):
+            x = rng.rand(rows, 4).astype(np.float32)
+            got = srv.submit({"data": x})
+            np.testing.assert_allclose(np.asarray(got[0]), x @ wn.T,
+                                       rtol=1e-5, atol=1e-5)
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_sharded_weights_actually_span_two_devices():
+    sym, params, _ = _fc_model()
+    m = mesh_slices(tp=2)[0]
+    p = Predictor(sym, params, input_shapes={"data": (1, 4)},
+                  mesh=m, rules=RULES)
+    w = p._executor.arg_dict["fc_weight"].data
+    assert len(w.sharding.device_set) == 2
+    # the template params the server would reuse stay single-device
+    assert len(params["arg:fc_weight"].data.sharding.device_set) == 1
+
+
+def test_sharded_replicas_do_not_share_params():
+    """Regression: two sharded replicas built from one params dict must
+    own their weights — resharding replica B must not move replica A's
+    weights off its slice (the as_in_context same-ctx aliasing trap)."""
+    sym, params, wn = _fc_model()
+    s0, s1 = mesh_slices(tp=2)[:2]
+    pA = Predictor(sym, params, input_shapes={"data": (2, 4)},
+                   mesh=s0, rules=RULES)
+    pB = Predictor(sym, params, input_shapes={"data": (2, 4)},
+                   mesh=s1, rules=RULES)
+    devs = [sorted(d.id for d in p._executor.arg_dict["fc_weight"]
+                   .data.sharding.device_set) for p in (pA, pB)]
+    assert devs[0] != devs[1], devs
+    x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    for p in (pA, pB):
+        p.set_input("data", x)
+        p.forward()
+        np.testing.assert_allclose(p.get_output(0).asnumpy(), x @ wn.T,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_zero_recompiles_under_load():
+    """After warmup, varied-batch traffic through the sharded replica
+    must hit the compile cache every time (replicated-operand wrapper
+    keeps cache keys constant)."""
+    srv, _ = _sharded_server(tp=2)
+    try:
+        rng = np.random.RandomState(1)
+        before = profiler.dispatch_stats()["recompile"]
+        for rows in (1, 2, 4, 8, 3, 7, 1, 5, 2, 8):
+            srv.submit({"data": rng.rand(rows, 4).astype(np.float32)})
+        after = profiler.dispatch_stats()["recompile"]
+        assert after == before, "recompiled %d times under steady load" \
+            % (after - before)
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_add_remove_replica_reclaims_slice():
+    srv, wn = _sharded_server(tp=2)
+    try:
+        free0 = srv.snapshot()["free_slices"]
+        rid = srv.add_replica()
+        assert srv.num_active_replicas() == 2
+        assert srv.snapshot()["free_slices"] == free0 - 1
+        srv.remove_replica(rid)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                srv.snapshot()["free_slices"] != free0:
+            time.sleep(0.02)
+        assert srv.snapshot()["free_slices"] == free0
+        with pytest.raises(ValueError):
+            srv.remove_replica()          # refuses the last replica
+        # still serving correctly after the add/remove churn
+        x = np.ones((2, 4), np.float32)
+        got = srv.submit({"data": x})
+        np.testing.assert_allclose(np.asarray(got[0]), x @ wn.T,
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        srv.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_registry_publish_ttl_reap():
+    reg = ServiceRegistry(service="t1", ttl_s=0.25)
+    try:
+        reg.publish(0, {"inflight": 1})
+        reg.publish(1, {"inflight": 2})
+        v = reg.view()
+        assert v.alive == ["0", "1"]
+        assert v.total("inflight") == 3
+        assert v.max("inflight") == 2
+        time.sleep(0.35)
+        reg.publish(1, {"inflight": 5})   # 1 beats on, 0 lapses
+        v = reg.view()
+        assert v.alive == ["1"]
+        assert v.reaped == ["0"]
+        assert "1 alive" in repr(v)
+        reg.withdraw(1)
+        assert len(reg.view()) == 0
+    finally:
+        reg.close()
+
+
+def test_registry_view_without_reap_keeps_stale():
+    reg = ServiceRegistry(service="t2", ttl_s=0.2)
+    try:
+        reg.publish(7, {"x": 1})
+        time.sleep(0.3)
+        # stale entries are invisible (TTL) but unreaped
+        assert len(reg.view(reap=False)) == 0
+        assert reg.reap() == ["7"]
+        assert reg.reap() == []
+    finally:
+        reg.close()
+
+
+def test_fleet_view_helpers():
+    v = FleetView("svc", {"a": ({"q": 2}, 0.5), "b": ({"q": 3}, 0.4)},
+                  reaped=["c"])
+    assert len(v) == 2 and v.alive == ["a", "b"]
+    assert v.total("q") == 5 and v.max("q") == 3
+    d = v.as_dict()
+    assert d["reaped"] == ["c"] and d["replicas"]["a"] == {"q": 2}
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+def test_supervisor_bounds_validation():
+    srv, _ = _sharded_server(tp=2)
+    try:
+        with pytest.raises(ValueError):
+            FleetSupervisor(srv, min_replicas=3, max_replicas=2,
+                            start=False)
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_supervisor_heartbeats_reach_registry():
+    srv, _ = _sharded_server(tp=2)
+    sup = _supervisor(srv)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and sup.heartbeats == 0:
+            time.sleep(0.02)
+        v = sup.registry.view(reap=False)
+        assert len(v) == 1, v.as_dict()
+        report = list(v.replicas.values())[0]
+        assert report["devices"] == 2
+        assert report["state"] == "SERVING"
+    finally:
+        sup.stop()
+        sup.registry.close()
+        srv.drain(timeout=30)
+
+
+def test_autoscaler_scales_up_on_burst_then_drains_down():
+    """THE control-loop acceptance: overload -> shed-rate breach ->
+    scale-up; sustained idle -> drain back to min_replicas."""
+    srv, _ = _sharded_server(tp=2, max_queue=16)
+    sup = _supervisor(srv)
+    outcomes = []
+    try:
+        futs = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and sup.scale_ups == 0:
+            futs += _flood(srv, outcomes)
+        assert sup.scale_ups >= 1, sup.snapshot()
+        assert srv.num_active_replicas() == 2
+        _drain_all(futs, outcomes)
+        assert "HUNG" not in outcomes
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                srv.num_active_replicas() > 1:
+            time.sleep(0.05)
+        assert srv.num_active_replicas() == 1
+        assert sup.scale_downs >= 1
+        snap = sup.snapshot()
+        assert snap["heartbeats"] > 0
+        assert snap["replicas"] == 1
+    finally:
+        sup.stop()
+        sup.registry.close()
+        srv.drain(timeout=30)
+
+
+def test_autoscaler_respects_max_replicas():
+    srv, _ = _sharded_server(tp=2, max_queue=8)
+    # pool has 4 slices but max_replicas pins the fleet at 2
+    sup = _supervisor(srv, max_replicas=2, idle_down_s=60)
+    outcomes = []
+    try:
+        futs = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and sup.scale_ups == 0:
+            futs += _flood(srv, outcomes)
+        for _ in range(3):                # keep breaching after the cap
+            futs += _flood(srv, outcomes)
+            time.sleep(0.1)
+        assert srv.num_active_replicas() <= 2
+        _drain_all(futs, outcomes)
+        assert "HUNG" not in outcomes
+    finally:
+        sup.stop()
+        sup.registry.close()
+        srv.drain(timeout=30)
+
+
+def test_fleet_dispatch_counters_registered():
+    for key in ("fleet_replicas_added", "fleet_replicas_removed",
+                "fleet_scale_ups", "fleet_scale_downs",
+                "fleet_heartbeats", "fleet_heartbeats_dropped",
+                "fleet_reaped"):
+        assert key in profiler.dispatch_stats()
+    for kind in ("registry_stale", "replica_slow_start"):
+        assert kind in chaos.FAULT_KINDS
+    # hooks are inert without an active plan
+    assert chaos.registry_stale(0) is False
+    assert chaos.replica_slow_start(0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance scenario: staleness + slow starts
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_chaos_staleness_and_slow_start_fleet_converges():
+    """ISSUE 8 acceptance: with ``registry_stale`` dropping heartbeats
+    (TTL lapse -> reap -> re-register) and ``replica_slow_start``
+    stalling the first scale-up build, the fleet still converges to the
+    target replica count under burst, drains back down when idle, and
+    every request gets exactly one typed outcome."""
+    # the silence window must outlast the slow-started replica build:
+    # _scale_up runs add_replica inline in the control tick, so the
+    # reaper pauses ~0.6s while the chaos-delayed replica compiles
+    spec = ",".join(["registry_stale@%d" % b for b in range(2, 30)]
+                    + ["replica_slow_start@0"])
+    srv, wn = _sharded_server(tp=2, max_queue=16)
+    outcomes = []
+    with chaos.inject(spec, seed=11):
+        # TTL shorter than the 6-beat injected silence: the entry MUST
+        # lapse and be reaped, then re-register on the next live beat
+        reg = ServiceRegistry(service="chaos", ttl_s=0.12)
+        sup = _supervisor(srv, registry=reg, max_replicas=2)
+        try:
+            # phase 1: burst until the autoscaler reacts (slow-started)
+            futs = []
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and sup.scale_ups == 0:
+                futs += _flood(srv, outcomes)
+            assert srv.num_active_replicas() == 2, sup.snapshot()
+            _drain_all(futs, outcomes)
+
+            # phase 2: idle -> drain back to min
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    srv.num_active_replicas() > 1:
+                time.sleep(0.05)
+            assert srv.num_active_replicas() == 1
+
+            # the dropped beats really lapsed + were reaped, and the
+            # fleet re-registered afterwards
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    len(sup.registry.view(reap=False)) == 0:
+                time.sleep(0.02)
+            snap = sup.snapshot()
+            assert snap["heartbeats_dropped"] >= 1, snap
+            assert snap["reaped_total"] >= 1, snap
+            assert len(sup.registry.view(reap=False)) >= 1
+        finally:
+            sup.stop()
+            sup.registry.close()
+            srv.drain(timeout=30)
+
+    # every request got exactly one typed terminal outcome
+    assert outcomes, "burst produced no outcomes"
+    assert "HUNG" not in outcomes
+    bad = set(outcomes) - {"ok", "Overloaded", "DeadlineExceeded",
+                           "Unavailable", "Draining"}
+    assert not bad, bad
+    # and the surviving replica still answers correctly
+    # (server is drained; rebuild a bare predictor for the oracle check)
+    sym, params, wn = _fc_model()
+    p = Predictor(sym, params, input_shapes={"data": (1, 4)},
+                  mesh=mesh_slices(tp=2)[0], rules=RULES)
+    x = np.ones((1, 4), np.float32)
+    p.set_input("data", x)
+    p.forward()
+    np.testing.assert_allclose(p.get_output(0).asnumpy(), x @ wn.T,
+                               rtol=1e-5, atol=1e-5)

@@ -483,3 +483,101 @@ class Test1F1B:
             assert 0 < bubble_fraction(P, M, "gpipe") < 1
         with pytest.raises(ValueError):
             bubble_fraction(2, 2, "zigzag")
+
+
+# ---------------------------------------------------------------------------
+# sharding rules: _filter_spec edge cases + shard/gather round-trip
+# (the helpers the fleet layer's pjit-sharded replicas are built on)
+# ---------------------------------------------------------------------------
+class TestShardingRuleEdgeCases:
+    def test_uneven_dim_falls_back_to_replication(self):
+        # a vocab of 97 with tp=2: 97 % 2 != 0 -> that axis must drop
+        # out (replicate) instead of raising inside pjit
+        from mxnet_tpu.parallel.sharding import _filter_spec
+
+        mesh = make_mesh(tp=2)
+        spec = _filter_spec(P("tp", None), mesh, shape=(97, 64))
+        assert spec == P(None, None)
+        # and an even vocab keeps the annotation
+        spec = _filter_spec(P("tp", None), mesh, shape=(96, 64))
+        assert spec == P("tp", None)
+
+    def test_absent_mesh_axes_are_dropped(self):
+        # one rule set serves many meshes: axes the mesh does not name
+        # silently vanish from the spec
+        from mxnet_tpu.parallel.sharding import _filter_spec
+
+        mesh = make_mesh(tp=2)                 # axes: dp (absorbed) + tp
+        assert _filter_spec(P("pp", "tp"), mesh, shape=(8, 8)) \
+            == P(None, "tp")
+        assert _filter_spec(P("pp", "ep"), mesh, shape=(8, 8)) \
+            == P(None, None)
+
+    def test_compound_axis_partial_keep(self):
+        # ("dp","tp") on one dim: the absent axis drops, the present one
+        # stays; the cumulative factor guards divisibility of what's kept
+        from mxnet_tpu.parallel.sharding import _filter_spec
+
+        mesh = make_mesh(tp=2)
+        assert _filter_spec(P(("dp", "tp"), None), mesh, shape=(6, 4)) \
+            == P("tp", None)
+        # 7 % 2 != 0: even the surviving axis must replicate
+        assert _filter_spec(P(("dp", "tp"), None), mesh, shape=(7, 4)) \
+            == P(None, None)
+
+    def test_match_partition_rules_scalars_replicate(self):
+        from mxnet_tpu.parallel.sharding import match_partition_rules
+
+        specs = match_partition_rules(
+            [("w", P("tp", None))],
+            {"w": np.zeros((4, 4)), "scale": np.float32(2.0),
+             "one": np.zeros((1,)), "unmatched": np.zeros((2, 2))})
+        assert specs["w"] == P("tp", None)
+        assert specs["scale"] == P()          # 0-d: spec is meaningless
+        assert specs["one"] == P()            # size-1: same
+        assert specs["unmatched"] == P()      # no rule: replicate
+
+    def test_shard_and_gather_round_trip(self):
+        from mxnet_tpu.parallel.sharding import (make_shard_and_gather_fns,
+                                                 match_partition_rules)
+
+        from mxnet_tpu.parallel.mesh import mesh_slices
+
+        mesh = mesh_slices(tp=2)[0]          # exactly 2 devices
+        rng = np.random.RandomState(0)
+        arrays = {"w": rng.rand(6, 4).astype(np.float32),
+                  "b": rng.rand(5).astype(np.float32)}  # 5 % 2: replicates
+        specs = match_partition_rules(
+            [("w", P("tp", None)), ("b", P("tp"))], arrays)
+        shard, gather = make_shard_and_gather_fns(specs, mesh)
+        sharded = {k: shard[k](v) for k, v in arrays.items()}
+        assert len(sharded["w"].sharding.device_set) == 2
+        assert not sharded["w"].sharding.is_fully_replicated
+        assert sharded["b"].sharding.is_fully_replicated
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(gather[k](sharded[k]), v)
+
+
+class TestMeshSlices:
+    def test_disjoint_consecutive_slices(self):
+        from mxnet_tpu.parallel.mesh import mesh_slices
+
+        slices = mesh_slices(tp=2)
+        assert len(slices) == 4              # 8 devices / 2 per slice
+        seen = []
+        for s in slices:
+            devs = sorted(d.id for d in s.mesh.devices.flat)
+            assert len(devs) == 2
+            seen += devs
+        assert seen == sorted(seen) and len(set(seen)) == 8
+
+    def test_leftover_devices_unused(self):
+        from mxnet_tpu.parallel.mesh import mesh_slices
+
+        assert len(mesh_slices(tp=3)) == 2   # 8 // 3, 2 devices idle
+
+    def test_oversized_slice_rejected(self):
+        from mxnet_tpu.parallel.mesh import mesh_slices
+
+        with pytest.raises(ValueError):
+            mesh_slices(tp=16)
