@@ -13,6 +13,7 @@
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
+#   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
 #   make ci         -> everything ci/runtime_functions.sh runs
 #   make clean
 
@@ -51,10 +52,13 @@ fleet-smoke:
 obs-smoke:
 	bash ci/runtime_functions.sh obs_check
 
+debug-smoke:
+	bash ci/runtime_functions.sh debug_check
+
 ci:
 	bash ci/runtime_functions.sh all
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke fleet-smoke obs-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke fleet-smoke obs-smoke debug-smoke ci clean

@@ -523,6 +523,13 @@ def main(argv=None):
 
     extra["dispatch"] = profiler.dispatch_stats()
     extra["regression_check"] = check_regressions(RESULT)
+    if extra["regression_check"].get("flagged"):
+        # tripwire fired: capture a postmortem bundle so the regression
+        # arrives with dispatch stats + recompile explanations attached
+        from mxnet_tpu import debug as _debug
+
+        _debug.write_bundle("bench_regression",
+                            extra=extra["regression_check"])
     extra["elapsed_s"] = round(time.monotonic() - _T0, 1)
 
 
@@ -1016,8 +1023,11 @@ def transformer_bench(batch=8, seq=1024, steps=10, quick=False):
             f = float(ca.get("flops", 0.0) or 0.0)
             if f > 0:
                 flops_per_step = f
-    except Exception:
-        pass
+    except Exception as e:
+        from mxnet_tpu import dispatch as _dispatch
+
+        _dispatch.note_cost_failure("bench.transformer_step",
+                                    "lower.cost_analysis", e)
 
     params, velocity, loss = step(params, velocity, x, y)  # compile
     float(loss)  # real sync
@@ -1048,6 +1058,14 @@ def transformer_bench(batch=8, seq=1024, steps=10, quick=False):
     else:
         out["mfu"] = round(analytic_mfu, 4)
         out["mfu_source"] = "analytic_6n"
+        # why the xla_cost_analysis source fell back (first recorded
+        # cost-capture failure in this process, if any)
+        from mxnet_tpu import dispatch as _dispatch
+
+        fail = _dispatch.first_cost_failure()
+        if fail:
+            out["mfu_fallback_reason"] = "%s (%s)" % (fail["error"],
+                                                      fail["stage"])
     if not quick:
         try:
             out["transformer_kernel_breakdown_ms"] = _kernel_breakdown(

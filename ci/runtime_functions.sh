@@ -201,6 +201,43 @@ EOF
     fi
 }
 
+debug_check() {
+    # Diagnosis plane (docs/OBSERVABILITY.md "Diagnosis plane"):
+    # recompile flight recorder, tagged device-memory accounting,
+    # postmortem debug bundles + the stdlib-only bundle inspector.
+    python -m pytest tests/test_debug.py -q
+    # end-to-end smoke in a fresh process: force a retrace, capture a
+    # bundle, and round-trip it through tools/inspect_bundle.py
+    smoke_dir=$(mktemp -d)
+    env JAX_PLATFORMS=cpu MXTPU_DEBUG_BUNDLE_DIR="$smoke_dir" \
+        python - <<'EOF'
+import jax.numpy as jnp
+from mxnet_tpu import debug, dispatch
+
+tj = dispatch.TrackedJit(lambda x: x + 1, label="ci_smoke")
+tj(jnp.zeros((2, 2)))
+tj(jnp.zeros((4, 2)))
+text = dispatch.explain_recompiles()
+assert "(2, 2) -> (4, 2)" in text, text
+path = debug.write_bundle("ci_smoke", force=True)
+assert path, "bundle not written"
+print("debug bundle smoke OK:", path)
+EOF
+    env JAX_PLATFORMS=cpu python tools/inspect_bundle.py "$smoke_dir" \
+        | grep -q INSPECT_OK
+    rm -rf "$smoke_dir"
+    # the diagnosis plane runs on the runtime's worst day — it must
+    # lint clean with NO suppressions, same bar as telemetry
+    python -m mxnet_tpu.lint mxnet_tpu/debug.py mxnet_tpu/memory.py \
+        mxnet_tpu/dispatch.py
+    if grep -n "mxlint: disable" mxnet_tpu/debug.py \
+            mxnet_tpu/memory.py mxnet_tpu/dispatch.py; then
+        echo "diagnosis-plane modules must not carry mxlint" \
+             "suppressions" >&2
+        return 1
+    fi
+}
+
 integration_examples() {
     python -m pytest tests/test_examples.py tests/test_tools.py -q
 }
@@ -249,6 +286,7 @@ all() {
     gen_check
     fleet_check
     obs_check
+    debug_check
     unittest_dtype_sweep
     integration_examples
     chaos_check

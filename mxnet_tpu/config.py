@@ -152,6 +152,48 @@ class _Config:
         Knob("MXNET_TELEMETRY_PEAK_HBM_GBS", float, 819.0,
              "Accelerator peak HBM bandwidth (GB/s) the hbm_util gauge "
              "divides by. Default is TPU v5e (819 GB/s)."),
+        Knob("MXTPU_EXPLAIN_RECOMPILES", str, "record",
+             "Recompile flight recorder (docs/OBSERVABILITY.md diagnosis "
+             "plane): on every TrackedJit retrace, diff the call "
+             "signature (arg shapes/dtypes/shardings, static args, "
+             "donation flags) against the last trace and keep a "
+             "human-readable explanation in a capped ring. 'off' "
+             "disables capture (counter still ticks); 'record' (default) "
+             "captures silently; 'warn' additionally warns on every "
+             "retrace after the first trace; 'raise' turns a retrace "
+             "into dispatch.RecompileError — the enforcement mode for "
+             "zero-recompile contracts."),
+        Knob("MXTPU_RECOMPILE_RING", int, 256,
+             "Capacity of the recompile flight recorder's explanation "
+             "ring (oldest entries dropped). Read when the first entry "
+             "is recorded."),
+        Knob("MXTPU_RECOMPILE_STORM", int, 16,
+             "Retraces within a 60s window that count as a recompile "
+             "storm and trigger a postmortem debug bundle (0 disables "
+             "the storm trigger)."),
+        Knob("MXTPU_DEBUG_BUNDLE_DIR", str, "",
+             "Directory for postmortem debug bundles "
+             "(docs/OBSERVABILITY.md): on rc-77, sentinel "
+             "restore-checkpoint, breaker-trip storms, the bench "
+             "regression tripwire, or a recompile storm, one JSON file "
+             "capturing the registry snapshot, recent profiler events, "
+             "recompile explanations, dispatch stats, memory/fleet "
+             "views and the active chaos plan is written here "
+             "(inspect with tools/inspect_bundle.py). '' disables."),
+        Knob("MXTPU_DEBUG_BUNDLE_KEEP", int, 20,
+             "Newest-N bundles kept in MXTPU_DEBUG_BUNDLE_DIR; older "
+             "ones are pruned after each write."),
+        Knob("MXTPU_DEBUG_BUNDLE_EVENTS", int, 500,
+             "How many of the newest profiler ring events each debug "
+             "bundle embeds."),
+        Knob("MXTPU_MEM_ACCOUNTING", bool, True,
+             "Tagged device-memory accounting (mxnet_tpu.memory): "
+             "per-device live/peak gauges from device.memory_stats() "
+             "where the backend reports it (TPU/GPU), falling back to "
+             "summing live jax buffers by device on CPU, plus "
+             "per-subsystem tag providers (params, optimizer_state, "
+             "kv_pages, replica slices) published as mem.* gauges on "
+             "every memory.update(). Set 0 to make update() a no-op."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "
@@ -165,9 +207,12 @@ class _Config:
         self._by_name = {k.name: k for k in self._KNOBS}
 
     def __getattr__(self, item):
-        key = "MXNET_" + item.upper()
-        if key in self._by_name:
-            return self._by_name[key].value
+        # two env prefixes share the attr namespace: MXNET_* (reference
+        # parity knobs) and MXTPU_* (this framework's own runtime knobs)
+        for prefix in ("MXNET_", "MXTPU_"):
+            key = prefix + item.upper()
+            if key in self._by_name:
+                return self._by_name[key].value
         raise AttributeError(item)
 
     def knob(self, name):

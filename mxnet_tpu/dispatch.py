@@ -22,8 +22,10 @@ decision:
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -31,7 +33,10 @@ __all__ = ["donation_active", "donation_scope", "no_donation",
            "bucket_size", "bucket_spec", "pow2_chain", "pad_batch",
            "TrackedJit",
            "TraceGuardError", "trace_scope", "in_framework_trace",
-           "trace_guard_mode", "guard_host_sync"]
+           "trace_guard_mode", "guard_host_sync",
+           "RecompileError", "explain_recompiles_mode", "recompile_ring",
+           "clear_recompile_ring", "explain_recompiles",
+           "first_cost_failure", "note_cost_failure"]
 
 _tls = threading.local()
 
@@ -257,6 +262,239 @@ def pad_batch(data, target):
     return jnp.take(data, jnp.asarray(idx), axis=0)
 
 
+# -- recompile flight recorder ----------------------------------------------
+# Every TrackedJit retrace captures the call signature (arg shapes /
+# dtypes / shardings, static args, donation flags) and diffs it against
+# the previous trace of the same function, producing a human-readable
+# explanation ("arg 1 `batch` shape (32, 128) -> (48, 128)") kept in a
+# capped ring.  The ring is what /debug/recompiles serves, what debug
+# bundles embed, and what the zero-recompile test contracts print on
+# failure.  Signature work happens ONLY on a retrace, so steady-state
+# cache hits pay nothing.
+class RecompileError(RuntimeError):
+    """A TrackedJit retraced while ``MXTPU_EXPLAIN_RECOMPILES=raise``
+    — the enforcement mode for zero-recompile contracts."""
+
+
+_ring_lock = threading.Lock()
+_ring = None                      # deque, sized lazily from the config knob
+_retrace_times = collections.deque(maxlen=256)   # monotonic, storm window
+_STORM_WINDOW_S = 60.0
+_first_cost_failure = None
+
+_MAX_LEAVES = 16                  # leaf descriptors kept per pytree arg
+_MAX_REPR = 80
+
+
+def explain_recompiles_mode():
+    """'off', 'record', 'warn', or 'raise' — the MXTPU_EXPLAIN_RECOMPILES
+    knob, validated."""
+    from .config import config
+
+    mode = (config.explain_recompiles or "").strip().lower()
+    if mode in ("", "0", "false", "no", "off"):
+        return "off"
+    if mode in ("1", "true", "yes", "on"):
+        return "record"
+    if mode not in ("record", "warn", "raise"):
+        raise ValueError(
+            "MXTPU_EXPLAIN_RECOMPILES must be off|record|warn|raise; "
+            "got %r" % mode)
+    return mode
+
+
+def _short_repr(x):
+    r = repr(x)
+    return r if len(r) <= _MAX_REPR else r[:_MAX_REPR - 3] + "..."
+
+
+def _describe_sharding(x):
+    try:
+        sh = getattr(x, "sharding", None)
+        if sh is None:
+            return None
+        spec = getattr(sh, "spec", None)
+        return str(spec) if spec is not None else type(sh).__name__
+    except Exception:
+        return None
+
+
+def _leaf_descriptor(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return {"shape": [int(d) for d in x.shape],
+                "dtype": str(x.dtype),
+                "sharding": _describe_sharding(x)}
+    return {"static": _short_repr(x)}
+
+
+def _arg_descriptor(x):
+    """JSON-ready descriptor of one positional argument: a leaf dict for
+    plain arrays/scalars, or a pytree summary (structure string + capped
+    leaf list) for containers."""
+    if hasattr(x, "shape") and hasattr(x, "dtype") \
+            or not isinstance(x, (tuple, list, dict)):
+        return _leaf_descriptor(x)
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    return {"tree": _short_repr(treedef),
+            "n_leaves": len(leaves),
+            "leaves": [_leaf_descriptor(v) for v in leaves[:_MAX_LEAVES]]}
+
+
+def _fmt_shape(shape):
+    return "(" + ", ".join(str(d) for d in shape) + ")"
+
+
+def _diff_leaf(old, new, label=""):
+    """Human-readable field-level differences between two leaf
+    descriptors."""
+    out = []
+    if "static" in old or "static" in new:
+        if old != new:
+            out.append("%svalue %s -> %s"
+                       % (label, old.get("static", _short_repr(old)),
+                          new.get("static", _short_repr(new))))
+        return out
+    if old.get("shape") != new.get("shape"):
+        out.append("%sshape %s -> %s" % (label, _fmt_shape(old["shape"]),
+                                         _fmt_shape(new["shape"])))
+    if old.get("dtype") != new.get("dtype"):
+        out.append("%sdtype %s -> %s" % (label, old["dtype"], new["dtype"]))
+    if old.get("sharding") != new.get("sharding"):
+        out.append("%ssharding %s -> %s"
+                   % (label, old.get("sharding"), new.get("sharding")))
+    return out
+
+
+def _diff_arg(old, new):
+    if "leaves" in old or "leaves" in new:
+        if "leaves" not in old or "leaves" not in new:
+            return ["kind changed: %s -> %s"
+                    % ("pytree" if "leaves" in old else "leaf",
+                       "pytree" if "leaves" in new else "leaf")]
+        out = []
+        if old["n_leaves"] != new["n_leaves"]:
+            out.append("pytree leaf count %d -> %d"
+                       % (old["n_leaves"], new["n_leaves"]))
+        for i, (lo, ln) in enumerate(zip(old["leaves"], new["leaves"])):
+            out.extend(_diff_leaf(lo, ln, "leaf %d " % i))
+        if not out and old["tree"] != new["tree"]:
+            out.append("pytree structure changed: %s -> %s"
+                       % (old["tree"], new["tree"]))
+        return out
+    return _diff_leaf(old, new)
+
+
+def _diff_signature(old, new, argnames):
+    """Per-argument differences between two call signatures, each line
+    naming the argument position and (when known) its name."""
+    changes = []
+    if len(old) != len(new):
+        changes.append("arity %d -> %d positional args"
+                       % (len(old), len(new)))
+    for i in range(min(len(old), len(new))):
+        if old[i] == new[i]:
+            continue
+        name = argnames[i] if i < len(argnames) else "arg%d" % i
+        for c in _diff_arg(old[i], new[i]):
+            changes.append("arg %d `%s` %s" % (i, name, c))
+    return changes
+
+
+def _ring_deque():
+    global _ring
+    if _ring is None:
+        from .config import config
+
+        cap = max(1, int(config.recompile_ring))
+        _ring = collections.deque(maxlen=cap)
+    return _ring
+
+
+def _record_entry(entry):
+    with _ring_lock:
+        _ring_deque().append(entry)
+
+
+def recompile_ring():
+    """The recorded recompile explanations, oldest first (each a
+    JSON-ready dict: ts_unix, fn, trace, call, kind, why, changes,
+    args, donate_argnums, static_argnums)."""
+    with _ring_lock:
+        return list(_ring) if _ring is not None else []
+
+
+def clear_recompile_ring():
+    """Drop all recorded explanations (tests / measurement windows)."""
+    global _ring
+    with _ring_lock:
+        _ring = None
+    _retrace_times.clear()
+
+
+def explain_recompiles(last=None, kinds=("retrace",)):
+    """Human-readable report of the recorded recompile explanations
+    (newest ``last``, default all), filtered to ``kinds`` ('retrace'
+    and/or 'initial').  The string the zero-recompile assertions print
+    on failure."""
+    entries = [e for e in recompile_ring() if e["kind"] in kinds]
+    if last is not None:
+        entries = entries[-int(last):]
+    if not entries:
+        return ("no recompile explanations recorded "
+                "(MXTPU_EXPLAIN_RECOMPILES=%s)" % explain_recompiles_mode())
+    lines = ["%d recompile explanation(s), oldest first:" % len(entries)]
+    for e in entries:
+        lines.append("  %s trace #%d (call %d): %s"
+                     % (e["fn"], e["trace"], e["call"], e["why"]))
+    return "\n".join(lines)
+
+
+def _note_retrace_storm():
+    """Feed the storm detector; on threshold, ask the debug plane for a
+    bundle (never raises — diagnosis must not take down the job)."""
+    from .config import config
+
+    threshold = int(config.recompile_storm)
+    if threshold <= 0:
+        return
+    now = time.monotonic()
+    _retrace_times.append(now)
+    recent = sum(1 for t in _retrace_times if now - t <= _STORM_WINDOW_S)
+    if recent < threshold:
+        return
+    try:
+        from . import debug as _debug
+
+        _debug.write_bundle("recompile_storm",
+                            extra={"retraces_in_window": recent,
+                                   "window_s": _STORM_WINDOW_S})
+    except Exception:
+        pass
+
+
+def note_cost_failure(label, stage, exc):
+    """Record a cost-analysis failure: bumps the
+    ``cost_analysis_failures`` dispatch counter and keeps the FIRST
+    failure's reason so the bench's ``mfu_source`` fallback is
+    diagnosable (see :func:`first_cost_failure`)."""
+    global _first_cost_failure
+    from . import profiler as _prof
+
+    _prof.dispatch_count("cost_analysis_failures")
+    if _first_cost_failure is None:
+        _first_cost_failure = {
+            "fn": label, "stage": stage,
+            "error": "%s: %s" % (type(exc).__name__, exc)}
+
+
+def first_cost_failure():
+    """{fn, stage, error} for the first cost-analysis failure in this
+    process, or None when every capture succeeded."""
+    return dict(_first_cost_failure) if _first_cost_failure else None
+
+
 # -- counted jit ------------------------------------------------------------
 def _donated_nbytes(args, positions):
     total = 0
@@ -280,16 +518,31 @@ class TrackedJit:
     compiled step so telemetry.StepAccountant can publish live MFU and
     HBM-bandwidth gauges with zero device syncs."""
 
-    __slots__ = ("_jitted", "_donate", "_cost")
+    __slots__ = ("_jitted", "_donate", "_static", "_cost", "_label",
+                 "_argnames", "_last_sig", "_traces", "_calls")
 
     def __init__(self, fn, donate_argnums=(), static_argnums=(), label=None):
         from . import profiler as _prof
 
         donate = tuple(donate_argnums)
         self._donate = donate
+        self._static = tuple(static_argnums)
         self._cost = None
+        self._last_sig = None
+        self._traces = 0
+        self._calls = 0
 
         name = label or getattr(fn, "__name__", "tracked_fn")
+        self._label = name
+        try:
+            import inspect
+
+            self._argnames = tuple(
+                p.name for p in
+                inspect.signature(fn).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+        except (TypeError, ValueError):
+            self._argnames = ()
 
         def traced(*a, **k):
             if not getattr(_tls, "cost_probe", False):
@@ -310,6 +563,7 @@ class TrackedJit:
     def __call__(self, *args):
         from . import profiler as _prof
 
+        self._calls += 1
         before = _prof.dispatch_value("recompile")
         if self._donate:
             nbytes = _donated_nbytes(args, self._donate)
@@ -317,10 +571,59 @@ class TrackedJit:
             _prof.dispatch_count("donated_bytes", nbytes)
         else:
             out = self._jitted(*args)
-        _prof.dispatch_count(
-            "jit_cache_miss" if _prof.dispatch_value("recompile") != before
-            else "jit_cache_hit")
+        retraced = _prof.dispatch_value("recompile") != before
+        _prof.dispatch_count("jit_cache_miss" if retraced
+                             else "jit_cache_hit")
+        if retraced:
+            self._note_trace(args)
         return out
+
+    def _note_trace(self, args):
+        """Flight-recorder hook, called only when this call (re)traced:
+        capture the signature, diff it against the previous trace, and
+        record/warn/raise per the MXTPU_EXPLAIN_RECOMPILES mode.  The
+        capture reads only metadata (shape/dtype/sharding avals survive
+        donation), never buffer contents."""
+        mode = explain_recompiles_mode()
+        if mode == "off":
+            return
+        try:
+            sig = [{"static": _short_repr(args[i])} if i in self._static
+                   else _arg_descriptor(args[i]) for i in range(len(args))]
+        except Exception:
+            return
+        prev, self._last_sig = self._last_sig, sig
+        self._traces += 1
+        if prev is None:
+            kind, why, changes = "initial", "initial trace", []
+        else:
+            kind = "retrace"
+            changes = _diff_signature(prev, sig, self._argnames)
+            why = "; ".join(changes) if changes else (
+                "no signature difference detected (jit cache eviction, "
+                "or a donation/global-context change)")
+        entry = {"ts_unix": round(time.time(), 3), "fn": self._label,
+                 "trace": self._traces, "call": self._calls, "kind": kind,
+                 "why": why, "changes": changes, "args": sig,
+                 "donate_argnums": list(self._donate),
+                 "static_argnums": list(self._static)}
+        _record_entry(entry)
+        from . import telemetry as _telemetry
+
+        _telemetry.trace_instant("recompile::" + self._label,
+                                 cat="dispatch",
+                                 args={"kind": kind, "why": why})
+        if kind != "retrace":
+            return
+        _note_retrace_storm()
+        msg = ("recompile: %s trace #%d (call %d): %s"
+               % (self._label, self._traces, self._calls, why))
+        if mode == "warn":
+            import warnings
+
+            warnings.warn(msg, RuntimeWarning, stacklevel=4)
+        elif mode == "raise":
+            raise RecompileError(msg)
 
     def lower(self, *args, **kw):
         return self._jitted.lower(*args, **kw)
@@ -345,7 +648,8 @@ class TrackedJit:
         _tls.cost_probe = True
         try:
             lowered = self._jitted.lower(*args, **kw)
-        except Exception:
+        except Exception as e:
+            note_cost_failure(self._label, "lower", e)
             return None
         finally:
             _tls.cost_probe = False
@@ -353,15 +657,19 @@ class TrackedJit:
         try:
             ca = lowered.cost_analysis()
         except Exception:
-            ca = None
-        if not ca:
+            ca = None             # HLO-level miss: the compile fallback
+        if not ca:                # below is the one that counts
             try:
                 ca = lowered.compile().cost_analysis()
-            except Exception:
+            except Exception as e:
+                note_cost_failure(self._label, "compile.cost_analysis", e)
                 return None
         if isinstance(ca, (list, tuple)):      # some backends: one per device
             ca = ca[0] if ca else {}
         if not isinstance(ca, dict):
+            note_cost_failure(self._label, "result",
+                              TypeError("cost analysis returned %s"
+                                        % type(ca).__name__))
             return None
         self._cost = {
             "flops": float(ca.get("flops", 0.0) or 0.0),

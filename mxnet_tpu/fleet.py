@@ -251,6 +251,12 @@ class FleetSupervisor:
         self._beat_seq = 0
         self._published = set()
 
+        # postmortem bundles embed the live fleet view (weakly held:
+        # a collected supervisor drops out of future bundles)
+        from . import debug as _debug
+
+        _debug.add_section("fleet", self.snapshot)
+
         self._stop_evt = threading.Event()
         self._threads = [
             threading.Thread(target=self._heartbeat_loop,
@@ -410,6 +416,13 @@ class FleetSupervisor:
             # pool exhausted / drain race: back off a full cooldown
             _log("scale-up blocked: %s: %s" % (type(e).__name__, e))
             self._cooldown_until = time.monotonic() + self.cooldown_s
+            from . import debug as _debug
+
+            _debug.write_bundle(
+                "fleet_scale_up_blocked",
+                extra={"replicas": n, "shed_rate": self.shed_rate,
+                       "p99_ms": self.p99_ms,
+                       "error": "%s: %s" % (type(e).__name__, e)})
             return
         dt_ms = (time.monotonic() - t0) * 1e3
         self.scale_ups += 1

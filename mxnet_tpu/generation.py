@@ -213,6 +213,26 @@ class GenerationEngine:
             self._prefill_fn, donate_argnums=donate, label="gen_prefill")
         self._decode_jit = _dispatch.TrackedJit(
             self._decode_fn, donate_argnums=donate, label="gen_decode")
+        # tagged memory accounting (docs/OBSERVABILITY.md): the engine
+        # owns the model params and the KV page pool, the two dominant
+        # HBM residents of a decode server (weakly held — a collected
+        # engine drops out of the mem.* view)
+        from . import memory as _memory
+
+        self._mem_handles = (_memory.register("params",
+                                              self._mem_params_bytes),
+                             _memory.register("kv_pages",
+                                              self._mem_kv_bytes))
+
+    def _mem_params_bytes(self):
+        import jax
+
+        return sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree_util.tree_leaves(self.params))
+
+    def _mem_kv_bytes(self):
+        return (getattr(self.k_pages, "nbytes", 0)
+                + getattr(self.v_pages, "nbytes", 0))
 
     def _prefill_fn(self, params, k_pages, v_pages, tokens, length, table):
         return self.model.prefill(params, k_pages, v_pages, tokens, length,
@@ -324,6 +344,10 @@ class GenerationServer:
         if warm:
             self.engine.warm()
         self._state = SERVING
+        # postmortem bundles embed the scheduler view (weakly held)
+        from . import debug as _debug
+
+        _debug.add_section("generation", self.snapshot)
         self._thread = threading.Thread(target=self._loop,
                                         name="gen-scheduler", daemon=True)
         self._thread.start()

@@ -60,6 +60,34 @@ class Trainer:
         self._sentinel_ready = False
         self._step_count = 0
         self._accountant = None   # telemetry.StepAccountant, lazy
+        # tagged memory accounting (docs/OBSERVABILITY.md): the trainer
+        # owns the params and the optimizer state (weakly held — a
+        # collected trainer drops out of the mem.* view)
+        from .. import memory as _memory
+
+        self._mem_handles = (
+            _memory.register("params", self._mem_params_bytes),
+            _memory.register("optimizer_state", self._mem_opt_bytes))
+
+    def _mem_params_bytes(self):
+        total = 0
+        for p in self._params:
+            try:
+                for arr in p.list_data():
+                    total += getattr(arr, "nbytes", 0)
+            except Exception:
+                continue
+        return total
+
+    def _mem_opt_bytes(self):
+        import jax
+
+        total = 0
+        for u in self._updaters:
+            for state in getattr(u, "states", {}).values():
+                for leaf in jax.tree_util.tree_leaves(state):
+                    total += getattr(leaf, "nbytes", 0)
+        return total
 
     @property
     def _optimizer(self):

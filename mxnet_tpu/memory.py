@@ -1,0 +1,217 @@
+"""Tagged device-memory accounting (docs/OBSERVABILITY.md, diagnosis
+plane pillar 2).
+
+The reference exposed per-device storage pools through its profiler
+(``profile_memory``); XLA owns the HBM arena here, so attribution needs
+two layers instead:
+
+* **Per-device live/peak gauges** — ``device.memory_stats()`` where the
+  backend reports it (TPU/GPU runtimes publish ``bytes_in_use`` /
+  ``peak_bytes_in_use``), with a fallback that sums every live jax
+  buffer by the device it lives on (the CPU backend reports no stats;
+  NDArrays are jax-buffer-backed, so this is the NDArray
+  nbytes-by-context accounting, covering raw jax arrays too).  Peaks on
+  the fallback path are a running max maintained across
+  :func:`update` calls.
+* **Per-subsystem tags** — any owner of device memory registers a
+  zero-arg byte-count provider under a tag ("params",
+  "optimizer_state", "kv_pages", "replica_slices", ...).  Bound-method
+  providers are held through ``weakref.WeakMethod`` so a collected
+  owner silently drops out — registration never extends a lifetime.
+
+:func:`update` computes one JSON-ready snapshot, publishes it as
+``mem.*`` gauges in the telemetry registry, and emits chrome-trace
+counter events per device (the allocation timeline when a profiler
+session is running).  Related capacity gauges the other subsystems
+already publish (``gen.kv_page_util``, ``fleet.*``) are rolled into the
+snapshot so one ``/debug/memory`` fetch answers "where did the HBM go".
+Everything here is diagnosis: no call may raise into the caller.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import weakref
+
+__all__ = ["register", "unregister", "tag_bytes", "device_view",
+           "update", "reset_peaks", "accounting_enabled"]
+
+_lock = threading.Lock()
+_providers = {}            # handle id -> (tag, callable-or-WeakMethod)
+_handle_seq = itertools.count(1)
+_peak = {}                 # device str -> running-max fallback peak bytes
+
+
+def accounting_enabled():
+    """The MXTPU_MEM_ACCOUNTING knob (default on)."""
+    from .config import config
+
+    return bool(config.mem_accounting)
+
+
+class _TagHandle:
+    """Returned by :func:`register`; ``close()`` (or owner collection,
+    for bound-method providers) removes the provider."""
+
+    __slots__ = ("_id", "tag")
+
+    def __init__(self, hid, tag):
+        self._id = hid
+        self.tag = tag
+
+    def close(self):
+        unregister(self)
+
+
+def register(tag, provider):
+    """Register a zero-arg callable returning this subsystem's current
+    device-resident bytes under ``tag``.  Multiple providers may share a
+    tag (their bytes sum).  A bound method is held weakly; a plain
+    function is held strongly."""
+    try:
+        ref = weakref.WeakMethod(provider)
+    except TypeError:
+        ref = None
+    hid = next(_handle_seq)
+    with _lock:
+        _providers[hid] = (str(tag), ref if ref is not None else provider)
+    return _TagHandle(hid, str(tag))
+
+
+def unregister(handle):
+    with _lock:
+        _providers.pop(handle._id, None)
+
+
+def tag_bytes():
+    """{tag: live_bytes} across the registered providers.  Dead owners
+    are dropped; a provider that raises contributes nothing (diagnosis
+    must never take down the job)."""
+    with _lock:
+        items = list(_providers.items())
+    out = {}
+    dead = []
+    for hid, (tag, ref) in items:
+        fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+        if fn is None:
+            dead.append(hid)
+            continue
+        try:
+            n = int(fn())
+        except Exception:
+            continue
+        out[tag] = out.get(tag, 0) + n
+    if dead:
+        with _lock:
+            for hid in dead:
+                _providers.pop(hid, None)
+    return out
+
+
+def _fallback_live_bytes():
+    """{device str: bytes} summed over every live jax buffer — the
+    NDArray nbytes-by-context path for backends (CPU) that report no
+    allocator stats."""
+    import jax
+
+    out = {}
+    for arr in jax.live_arrays():
+        try:
+            if arr.is_deleted():
+                continue
+            nbytes = int(arr.nbytes)
+            devs = list(arr.devices())
+        except Exception:
+            continue
+        if not devs:
+            continue
+        share = nbytes // len(devs)
+        for d in devs:
+            out[str(d)] = out.get(str(d), 0) + share
+    return out
+
+
+def device_view():
+    """{device: {live_bytes, peak_bytes, source}} for every addressable
+    device.  ``source`` is 'backend' when ``device.memory_stats()``
+    reported, else 'fallback' (live-buffer sum + host-side running
+    peak)."""
+    import jax
+
+    fallback = None
+    out = {}
+    for d in jax.local_devices():
+        key = str(d)
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            live = int(stats["bytes_in_use"])
+            peak = int(stats.get("peak_bytes_in_use", live))
+            out[key] = {"live_bytes": live, "peak_bytes": peak,
+                        "source": "backend"}
+            continue
+        if fallback is None:
+            fallback = _fallback_live_bytes()
+        live = fallback.get(key, 0)
+        with _lock:
+            peak = max(_peak.get(key, 0), live)
+            _peak[key] = peak
+        out[key] = {"live_bytes": live, "peak_bytes": peak,
+                    "source": "fallback"}
+    return out
+
+
+def reset_peaks():
+    """Forget the fallback-path running peaks (tests / measurement
+    windows); backend-reported peaks are the runtime's own."""
+    with _lock:
+        _peak.clear()
+
+
+def _rollup(reg):
+    """Related capacity gauges from the other subsystems, so one memory
+    view answers page-pool and slice-placement questions too."""
+    from . import telemetry
+
+    out = {}
+    for prefix in ("gen.kv_page_util", "gen.active_slots", "fleet."):
+        for name, m in reg.find(prefix):
+            if isinstance(m, telemetry.Gauge):
+                out[name] = m.value
+    return out
+
+
+def update(publish=True, reg=None):
+    """Compute the memory snapshot ``{devices, tags, rollup,
+    accounting}`` and (by default) publish it: per-device
+    ``mem.<device>.live_bytes`` / ``.peak_bytes`` gauges, per-tag
+    ``mem.tag.<tag>.bytes`` gauges, and one chrome-trace counter event
+    per device for the allocation timeline.  With
+    ``MXTPU_MEM_ACCOUNTING=0`` returns a stub without touching the
+    runtime."""
+    if not accounting_enabled():
+        return {"accounting": "off", "devices": {}, "tags": {},
+                "rollup": {}}
+    from . import telemetry
+
+    the_reg = reg or telemetry.registry()
+    devices = device_view()
+    tags = tag_bytes()
+    snap = {"accounting": "on", "devices": devices, "tags": tags,
+            "rollup": _rollup(the_reg)}
+    if not publish:
+        return snap
+    from . import profiler
+
+    for dev, s in devices.items():
+        the_reg.gauge("mem.%s.live_bytes" % dev).set(s["live_bytes"])
+        the_reg.gauge("mem.%s.peak_bytes" % dev).set(s["peak_bytes"])
+        profiler.record_event(
+            {"name": "mem::%s" % dev, "cat": "counter", "ph": "C",
+             "args": {"live_bytes": s["live_bytes"]}})
+    for tag, n in tags.items():
+        the_reg.gauge("mem.tag.%s.bytes" % tag).set(n)
+    return snap

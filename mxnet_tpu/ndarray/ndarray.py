@@ -73,6 +73,14 @@ class NDArray:
         return int(np.prod(self.shape)) if self.shape else 1
 
     @property
+    def nbytes(self):
+        """Bytes of the backing device buffer (metadata only, no sync)
+        — what the tagged memory accounting (mxnet_tpu.memory) sums
+        per context."""
+        n = getattr(self._data, "nbytes", None)
+        return int(n) if n is not None else self.size * self.dtype.itemsize
+
+    @property
     def ndim(self):
         return len(self.shape)
 

@@ -33,7 +33,7 @@ __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "state", "ProfileDomain", "Task", "Event",
            "Counter", "Frame", "Marker", "dispatch_count", "dispatch_stats",
            "dispatch_value", "record_span", "record_event", "now_us",
-           "set_max_events"]
+           "set_max_events", "recent_events"]
 
 _lock = threading.Lock()
 _config = {
@@ -103,6 +103,17 @@ def _append(evt):
         _events.append(evt)
     if dropped:
         _count_dropped(dropped)
+
+
+def recent_events(n=500):
+    """Snapshot of the newest ``n`` chrome-trace events in the ring
+    (postmortem debug bundles embed this; the ring itself is left
+    untouched)."""
+    n = max(0, int(n))
+    with _lock:
+        if n >= len(_events):
+            return list(_events)
+        return list(_events)[-n:]
 
 
 def _active(category="imperative"):
@@ -231,7 +242,11 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "fleet_replicas_added", "fleet_replicas_removed",
                   "fleet_scale_ups", "fleet_scale_downs",
                   "fleet_heartbeats", "fleet_heartbeats_dropped",
-                  "fleet_reaped")
+                  "fleet_reaped",
+                  # diagnosis plane (docs/OBSERVABILITY.md): cost-capture
+                  # failures behind mfu_source fallbacks, and postmortem
+                  # bundles written by the debug plane
+                  "cost_analysis_failures", "debug_bundles")
 _DISPATCH_PREFIX = "dispatch."
 
 

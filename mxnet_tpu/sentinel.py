@@ -454,13 +454,26 @@ class HealthSentinel:
                        what, restored)
         elif action == "restore":
             self._restored_checkpoint = True
+            self._debug_bundle("sentinel_restore_checkpoint", what, step)
             self._restore_from_checkpoint(what)
         else:
             _log.critical("%s — escalation exhausted; exiting rc=%d "
                           "(retryable: supervise restarts from the "
                           "newest verified checkpoint)",
                           what, NUMERIC_EXIT_CODE)
+            self._debug_bundle("sentinel_rc77", what, step)
             sys.exit(NUMERIC_EXIT_CODE)
+
+    def _debug_bundle(self, reason, what, step):
+        """Postmortem capture before the ladder's terminal rungs (the
+        docs/OBSERVABILITY.md diagnosis plane); must never block the
+        exit path on its own failure."""
+        from . import debug
+
+        debug.write_bundle(reason, extra={
+            "what": what, "step": step, "bad_streak": self.bad_streak,
+            "rescales": self._rescales, "rollbacks": self._rollbacks,
+            "events": list(self.events)})
 
     def _restore_from_checkpoint(self, what):
         from . import profiler as _prof
@@ -470,6 +483,7 @@ class HealthSentinel:
         if got is None:
             _log.critical("%s — no verified checkpoint to restore; "
                           "exiting rc=%d", what, NUMERIC_EXIT_CODE)
+            self._debug_bundle("sentinel_rc77", what, -1)
             sys.exit(NUMERIC_EXIT_CODE)
         step, arrays, _extra = got
         by_name = dict(arrays)

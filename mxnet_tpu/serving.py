@@ -417,6 +417,28 @@ class CircuitBreaker:
         return True
 
 
+_BREAKER_STORM = None
+
+
+def _note_breaker_trip(replica_id):
+    """Breaker-trip storm detector feeding the postmortem debug plane
+    (docs/OBSERVABILITY.md).  MUST be called after the server lock is
+    released — trigger sites capture the trip flag inside the critical
+    section and report here outside it (CC001: bundle writing is file
+    I/O)."""
+    global _BREAKER_STORM
+    from . import debug as _debug
+
+    if _BREAKER_STORM is None:
+        _BREAKER_STORM = _debug.StormDetector(3, window_s=30.0)
+    if _BREAKER_STORM.hit():
+        _debug.write_bundle(
+            "breaker_trip_storm",
+            extra={"replica": replica_id,
+                   "trips_threshold": _BREAKER_STORM.threshold,
+                   "window_s": _BREAKER_STORM.window_s})
+
+
 # ---------------------------------------------------------------------------
 # replica
 # ---------------------------------------------------------------------------
@@ -567,10 +589,28 @@ class ModelServer:
         for t in self._threads:
             t.start()
         self._state = SERVING
+        # tagged memory accounting: every replica's bound weights/aux
+        # (per-slice copies in sharded mode) under one tag (weakly held)
+        from . import memory as _memory
+
+        self._mem_handle = _memory.register("replica_slices",
+                                            self._mem_replica_bytes)
         _log("serving: %d replica(s), max_queue=%d max_batch=%d "
              "buckets=%s hedge_ms=%g"
              % (len(self._replicas), self.max_queue, self.max_batch,
                 list(self._buckets), self.hedge_ms))
+
+    def _mem_replica_bytes(self):
+        total = 0
+        for repl in tuple(self._replicas):
+            try:
+                ex = repl.predictor._executor
+                for d in (ex.arg_dict, ex.aux_dict):
+                    for arr in d.values():
+                        total += getattr(arr, "nbytes", 0)
+            except Exception:
+                continue
+        return total
 
     # -- construction helpers ----------------------------------------------
     def _resolve_buckets(self, buckets):
@@ -1199,13 +1239,15 @@ class ModelServer:
                 # zeros health check (Predictor.health_check) BEFORE it
                 # touches live traffic; the check runs outside the lock
                 healthy = repl.probe()
+                tripped = False
                 with self._cv:
                     if healthy:
                         repl.breaker.record_success()
                     else:
                         repl.inflight -= 1
                         job.inflight_execs -= 1
-                        repl.breaker.record_failure(time.monotonic())
+                        tripped = repl.breaker.record_failure(
+                            time.monotonic())
                         # the batch never actually ran here: let it
                         # retry this replica after the next backoff
                         job.tried.discard(repl.id)
@@ -1214,6 +1256,8 @@ class ModelServer:
                         self._recompute_state_locked()
                         self._cv.notify_all()
                 if not healthy:
+                    if tripped:
+                        _note_breaker_trip(repl.id)
                     continue
             # chaos + compute happen OUTSIDE every lock (CC001)
             delay = _chaos.slow_replica(idx)
@@ -1237,6 +1281,7 @@ class ModelServer:
                       "trace_ids": [r.trace_id for r in job.requests]})
             _telemetry.registry().histogram(
                 "serving.execute_ms").observe(dt * 1e3)
+            tripped = False
             with self._cv:
                 repl.inflight -= 1
                 job.inflight_execs -= 1
@@ -1249,11 +1294,13 @@ class ModelServer:
                     self._settle_job_locked(job, outs, is_hedge)
                 else:
                     job.failures += 1
-                    repl.breaker.record_failure(now)
+                    tripped = repl.breaker.record_failure(now)
                     _log("replica %d failed batch (%s: %s)"
                          % (repl.id, type(err).__name__, err))
                 self._recompute_state_locked()
                 self._cv.notify_all()
+            if tripped:
+                _note_breaker_trip(repl.id)
 
     def _settle_job_locked(self, job, outs, from_hedge=False):
         resolved = 0

@@ -423,6 +423,21 @@ def serve_http(port=0, reg=None):
             elif self.path.startswith("/metrics") or self.path == "/":
                 body = the_reg.dump_prometheus().encode("utf-8")
                 ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith("/debug/recompiles"):
+                from . import dispatch
+
+                body = json.dumps(
+                    {"mode": dispatch.explain_recompiles_mode(),
+                     "entries": dispatch.recompile_ring(),
+                     "text": dispatch.explain_recompiles()},
+                    default=str).encode("utf-8")
+                ctype = "application/json"
+            elif self.path.startswith("/debug/memory"):
+                from . import memory
+
+                body = json.dumps(memory.update(reg=the_reg),
+                                  default=str).encode("utf-8")
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return

@@ -18,7 +18,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import chaos, profiler
+from mxnet_tpu import chaos, dispatch, profiler
 from mxnet_tpu.fleet import FleetSupervisor, FleetView, ServiceRegistry
 from mxnet_tpu.parallel.mesh import mesh_slices
 from mxnet_tpu.predict import Predictor
@@ -154,8 +154,9 @@ def test_sharded_zero_recompiles_under_load():
         for rows in (1, 2, 4, 8, 3, 7, 1, 5, 2, 8):
             srv.submit({"data": rng.rand(rows, 4).astype(np.float32)})
         after = profiler.dispatch_stats()["recompile"]
-        assert after == before, "recompiled %d times under steady load" \
-            % (after - before)
+        assert after == before, \
+            "recompiled %d times under steady load\n%s" \
+            % (after - before, dispatch.explain_recompiles())
     finally:
         srv.drain(timeout=30)
 

@@ -14,7 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from mxnet_tpu import profiler
+from mxnet_tpu import dispatch, profiler
 from mxnet_tpu.generation import (GenerationConfig, GenerationEngine,
                                   GenerationServer, PageAllocator)
 from mxnet_tpu.models import TransformerLM, TransformerConfig
@@ -178,7 +178,10 @@ class TestContinuousBatching:
                 for i, p in enumerate(prompts)]
         for f in futs:
             f.result(timeout=60)
-        assert profiler.dispatch_value("recompile") == base
+        after = profiler.dispatch_value("recompile")
+        assert after == base, \
+            "recompiled %d times after warmup\n%s" \
+            % (after - base, dispatch.explain_recompiles())
 
     def test_streaming_iterator_and_callback(self, served):
         seen = []

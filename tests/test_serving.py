@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import chaos, profiler, serving
+from mxnet_tpu import chaos, dispatch, profiler, serving
 from mxnet_tpu.predict import Predictor, _load_params
 from mxnet_tpu.serving import (CircuitBreaker, DeadlineExceeded, Draining,
                                ModelServer, Overloaded, ServingError,
@@ -113,7 +113,10 @@ def test_bucket_padding_no_recompile_after_warm():
         before_pad = profiler.dispatch_stats()["bucket_padded_batches"]
         futs = [srv.submit_async(_req(rng)) for _ in range(3)]
         assert _drain_all(futs) == ["ok"] * 3
-        assert profiler.dispatch_stats()["recompile"] == before_rc
+        after_rc = profiler.dispatch_stats()["recompile"]
+        assert after_rc == before_rc, \
+            "recompiled %d times after warm\n%s" \
+            % (after_rc - before_rc, dispatch.explain_recompiles())
         assert profiler.dispatch_stats()["bucket_padded_batches"] \
             > before_pad
     finally:

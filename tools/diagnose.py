@@ -53,9 +53,29 @@ def main():
     section("Devices")
     import jax
 
+    print("jax       :", jax.__version__)
+    try:
+        import jaxlib
+
+        print("jaxlib    :", jaxlib.__version__)
+    except (ImportError, AttributeError):
+        print("jaxlib    : n/a")
     print("backend   :", jax.default_backend())
     for d in jax.devices():
         print("device    :", d)
+
+    section("Config knobs (effective values)")
+    from mxnet_tpu.config import _Config
+
+    for k in _Config._KNOBS:
+        try:
+            val = k.value
+        except (TypeError, ValueError) as e:
+            val = "<invalid: %s>" % e
+        src = "env" if k.name in os.environ else "default"
+        print("%-34s %-10s = %-16r (%s%s)"
+              % (k.name, k.typ.__name__, val, src,
+                 ", inert" if k.inert else ""))
 
     section("Compute probe")
     import numpy as np
@@ -75,6 +95,21 @@ def main():
         warm = (time.time() - t0) / 10
         print("%s: dot(256x256) cold %.3fs warm %.4fs"
               % (ctx, cold, warm))
+
+    section("Telemetry registry")
+    from mxnet_tpu import memory, profiler, telemetry
+
+    memory.update()            # populate the mem.* view for the snapshot
+    snap = telemetry.registry().snapshot()
+    for name, v in sorted(snap["counters"].items()):
+        if v:
+            print("counter %-34s %s" % (name, v))
+    for name, v in sorted(snap["gauges"].items()):
+        if v:
+            print("gauge   %-34s %s" % (name, v))
+    disp = profiler.dispatch_stats()
+    print("dispatch  : " + ", ".join(
+        "%s=%d" % (k, v) for k, v in sorted(disp.items()) if v))
     print("DIAGNOSE_OK", flush=True)
 
 

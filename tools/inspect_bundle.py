@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Pretty-print a postmortem debug bundle (docs/OBSERVABILITY.md).
+
+Bundles are the JSON files mxnet_tpu.debug.write_bundle drops into
+``MXTPU_DEBUG_BUNDLE_DIR`` when the runtime hits rc 77, a sentinel
+checkpoint restore, a breaker-trip storm, the bench tripwire, or a
+recompile storm.  Stdlib only — it must run on a bare interpreter on
+whatever machine the bundle was scp'd to.
+
+    python tools/inspect_bundle.py <bundle.json | bundle-dir>
+    python tools/inspect_bundle.py <path> --json [section]
+"""
+import json
+import os
+import sys
+import time
+
+
+def newest_bundle(directory):
+    names = [n for n in os.listdir(directory)
+             if n.startswith("bundle-") and n.endswith(".json")]
+    if not names:
+        raise FileNotFoundError("no bundle-*.json under %s" % directory)
+    full = [os.path.join(directory, n) for n in names]
+    return max(full, key=os.path.getmtime)
+
+
+def load(path):
+    if os.path.isdir(path):
+        path = newest_bundle(path)
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "reason" not in data:
+        raise ValueError("%s is not a debug bundle" % path)
+    return path, data
+
+
+def _hdr(title):
+    print("-" * 16 + " %s " % title + "-" * 16)
+
+
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+    return "%.1f TiB" % n
+
+
+def print_bundle(path, data):
+    _hdr("Bundle")
+    print("file      :", path)
+    print("reason    :", data.get("reason"))
+    ts = data.get("ts_unix")
+    if ts:
+        print("captured  : %s (unix %s)"
+              % (time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                               time.gmtime(ts)), ts))
+    print("pid       :", data.get("pid"))
+    print("schema    :", data.get("schema"))
+    extra = data.get("extra") or {}
+    for k in sorted(extra):
+        print("extra.%-12s: %s" % (k, extra[k]))
+
+    _hdr("Dispatch counters")
+    disp = data.get("dispatch") or {}
+    for k in sorted(disp):
+        if disp[k]:
+            print("%-28s %d" % (k, disp[k]))
+    fail = data.get("cost_analysis_failure")
+    if fail:
+        print("first cost-analysis failure: %s at stage %s (%s)"
+              % (fail.get("fn"), fail.get("stage"), fail.get("error")))
+
+    _hdr("Recompile explanations")
+    recs = data.get("recompiles") or []
+    if not recs:
+        print("(none recorded)")
+    for e in recs:
+        print("%s trace #%s (call %s, %s): %s"
+              % (e.get("fn"), e.get("trace"), e.get("call"),
+                 e.get("kind"), e.get("why")))
+
+    _hdr("Memory")
+    mem = data.get("memory") or {}
+    for dev, s in sorted((mem.get("devices") or {}).items()):
+        print("%-20s live %-12s peak %-12s (%s)"
+              % (dev, _fmt_bytes(s.get("live_bytes", 0)),
+                 _fmt_bytes(s.get("peak_bytes", 0)), s.get("source")))
+    for tag, n in sorted((mem.get("tags") or {}).items()):
+        print("tag %-16s %s" % (tag, _fmt_bytes(n)))
+    for name, v in sorted((mem.get("rollup") or {}).items()):
+        print("rollup %-20s %s" % (name, v))
+
+    chaos = data.get("chaos")
+    if chaos:
+        _hdr("Active chaos plan")
+        print("spec      :", chaos.get("spec"))
+        print("seed      :", chaos.get("seed"))
+        print("pending   :", chaos.get("pending"))
+
+    sections = data.get("sections") or {}
+    for name in sorted(sections):
+        _hdr("Section: %s" % name)
+        print(json.dumps(sections[name], indent=1, sort_keys=True,
+                         default=str))
+
+    _hdr("Registry")
+    reg = data.get("registry") or {}
+    counters = reg.get("counters") or {}
+    gauges = reg.get("gauges") or {}
+    hists = reg.get("histograms") or {}
+    for k in sorted(counters):
+        if counters[k]:
+            print("counter %-32s %s" % (k, counters[k]))
+    for k in sorted(gauges):
+        if gauges[k]:
+            print("gauge   %-32s %s" % (k, gauges[k]))
+    for k in sorted(hists):
+        h = hists[k]
+        if h.get("count"):
+            print("hist    %-32s n=%d p50=%s p99=%s"
+                  % (k, h["count"], h.get("p50"), h.get("p99")))
+
+    events = data.get("events") or []
+    print()
+    print("%d profiler event(s) embedded (rerun with --json events "
+          "for the raw chrome-trace list)" % len(events))
+    print("INSPECT_OK")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    if as_json:
+        argv.remove("--json")
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    path = argv.pop(0)
+    section = argv.pop(0) if argv else None
+    try:
+        path, data = load(path)
+    except (OSError, ValueError) as e:
+        print("inspect_bundle: %s" % e, file=sys.stderr)
+        return 1
+    if as_json:
+        payload = data if section is None else data.get(section)
+        print(json.dumps(payload, indent=1, sort_keys=True, default=str))
+        return 0
+    print_bundle(path, data)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
