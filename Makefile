@@ -11,6 +11,7 @@
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
+#   make kernel-smoke-> Pallas kernel parity + interpret lane (docs/KERNELS.md)
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
@@ -46,6 +47,9 @@ serve-smoke:
 gen-smoke:
 	bash ci/runtime_functions.sh gen_check
 
+kernel-smoke:
+	bash ci/runtime_functions.sh kernel_check
+
 fleet-smoke:
 	bash ci/runtime_functions.sh fleet_check
 
@@ -61,4 +65,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke fleet-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke obs-smoke debug-smoke ci clean

@@ -24,8 +24,12 @@ in-process jit cache carry across legs.
 
 Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (default 30),
 BENCH_MODEL (default resnet50_v1), BENCH_DTYPE (default bfloat16),
-BENCH_BUDGET_S (global wall-clock ceiling, default 480), BENCH_QUICK /
---quick (small model, few steps; auto-enabled on the CPU backend),
+BENCH_BUDGET_S (global wall-clock ceiling, default 480; quick mode
+defaults to 390 so the whole round clears an external kill timer),
+BENCH_QUICK / --quick (small model, few steps; auto-enabled on ANY
+non-TPU backend — r05's blackout was full mode running on an
+experimental platform string), BENCH_KERNELS (Pallas kernel-program
+leg, docs/KERNELS.md; on by default),
 BENCH_LEGS (comma list: run only these legs), BENCH_FORCE_TIMEOUT_LEG
 (burn the named leg's budget so its watchdog fires — the harness's own
 regression test), BENCH_PARTIAL_PATH, BENCH_BASELINE /
@@ -62,6 +66,18 @@ _T0 = time.monotonic()
 
 class BudgetExceeded(Exception):
     """Raised by the SIGALRM watchdog and by in-loop budget checks."""
+
+
+# SIGTERM (the driver's `timeout` sends it before SIGKILL) must shortcut
+# straight to the summary line: r05 died at rc 124 with zero output
+# because full-mode legs were still running when the term arrived.
+_TERMINATED = False
+
+
+def _term_handler(signum, frame):
+    global _TERMINATED
+    _TERMINATED = True
+    raise BudgetExceeded("SIGTERM from driver")
 
 
 def _budget_s():
@@ -166,7 +182,19 @@ def _run_leg(extra, name, fn, need):
         record = fn() or {}
     except BudgetExceeded:
         status = "timeout (leg budget %.0fs)" % budget
+        if _TERMINATED:
+            # the driver is tearing us down: flush this leg, then let the
+            # exception reach __main__ so the summary prints within the
+            # kill grace instead of starting another leg
+            _flush_leg(name, "terminated", record,
+                       time.monotonic() - t0)
+            raise
     except Exception as e:  # one leg must never sink the round
+        if _TERMINATED:
+            # the handler's raise surfaced wrapped in another exception
+            # (it can land inside arbitrary library code): still tear down
+            _flush_leg(name, "terminated", record, time.monotonic() - t0)
+            raise BudgetExceeded("SIGTERM from driver")
         status = "error: %s: %s" % (type(e).__name__, e)
     finally:
         # hand the watchdog back to the global ceiling between legs
@@ -180,11 +208,52 @@ def _run_leg(extra, name, fn, need):
     return record if status == "ok" else None
 
 
+_EMITTED = False
+
+
+def _emit_summary():
+    """Print the single summary JSON line, exactly once, merging in any
+    legs that only made it to the partial JSONL (a leg mid-flight when
+    SIGTERM/SIGALRM hit has its record on disk but not in RESULT).
+    Registered via atexit AND called from the __main__ finally, so every
+    exit path short of SIGKILL produces a parseable line."""
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    extra = RESULT.setdefault("extra", {})
+    if _TERMINATED:
+        extra.setdefault("budget_exceeded", "SIGTERM from driver")
+    try:
+        with open(_partial_path()) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                name = rec.get("leg")
+                if not name or (name + "_status") in extra:
+                    continue
+                extra[name + "_status"] = "%s (from partial)" % \
+                    rec.get("status", "?")
+                if rec.get("status") == "ok":
+                    for k, v in (rec.get("record") or {}).items():
+                        extra.setdefault(k, v)
+    except OSError:
+        pass
+    print(json.dumps(RESULT))
+    sys.stdout.flush()
+
+
 # ---------------------------------------------------------------------------
 # regression tripwire
 # ---------------------------------------------------------------------------
 _HIGHER_BETTER = ("_img_per_sec", "_per_sec", "_tokens_per_sec", "mfu",
-                  "_vs_bf16", "_vs_baseline", "_vs_v100_fp16", "value")
+                  "_vs_bf16", "_vs_naive", "_vs_baseline",
+                  "_vs_v100_fp16", "value")
 _LOWER_BETTER = ("_ms",)
 
 
@@ -292,11 +361,17 @@ def main(argv=None):
     from mxnet_tpu.gluon.model_zoo import vision
 
     platform = jax.default_backend()
-    # quick: explicit flag/env wins; unset env auto-enables on CPU (the
-    # full resnet50 sweep times out there); BENCH_QUICK=0 forces full.
+    # quick: explicit flag/env wins; unset env auto-enables on ANY
+    # non-TPU backend (the full sweep times out there — r05 ran full
+    # mode because an experimental platform string wasn't "cpu" and
+    # blacked out at rc 124); BENCH_QUICK=0 forces full.
     env_quick = os.environ.get("BENCH_QUICK", "")
     quick = (cli.quick or env_quick not in ("", "0")
-             or (platform == "cpu" and env_quick != "0"))
+             or (platform != "tpu" and env_quick != "0"))
+    if quick and "BENCH_BUDGET_S" not in os.environ:
+        # keep the whole quick round comfortably under the driver's
+        # external kill timer; the per-leg watchdogs re-read this
+        os.environ["BENCH_BUDGET_S"] = "390"
 
     batch = int(os.environ.get("BENCH_BATCH", "8" if quick else "128"))
     steps = int(os.environ.get("BENCH_STEPS", "5" if quick else "30"))
@@ -481,6 +556,9 @@ def main(argv=None):
     def decode_leg():
         return decode_bench(quick=quick)
 
+    def kernels_leg():
+        return kernels_bench(quick=quick)
+
     def longctx_leg():
         return long_context_bench()
 
@@ -513,12 +591,36 @@ def main(argv=None):
     # under the >10% regression tripwire) and the 2x-capacity shed rate
     if os.environ.get("BENCH_FLEET", "1") != "0":
         legs.append(("fleet", fleet_leg, 60 if quick else 120))
+    # the kernels leg runs in quick mode too: the Pallas kernel program
+    # (flash fwd+bwd through the registry, int8 fused dequant) is
+    # accepted on kernels_flash_vs_naive / kernels_int8_matmul_vs_bf16
+    if os.environ.get("BENCH_KERNELS", "1") != "0":
+        legs.append(("kernels", kernels_leg, 45 if quick else 90))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
         legs = [leg for leg in legs if leg[0] != "serving"]
 
+    if quick:
+        # quick leg budgets must collectively fit the global ceiling, so
+        # a worst-case round (every leg eats its allowance) still ends
+        # with legs marked, summary printed, rc 0 — not an external kill.
+        # Floor at min(need, 45s): the compile-dominated CPU legs
+        # (sentinel ~37s, inference ~34s measured) must not be scaled
+        # below what a healthy run actually takes.
+        total_need = sum(need for _, _, need in legs)
+        cap = 0.8 * _budget_s()
+        if total_need > cap:
+            scale = cap / total_need
+            legs = [(n, f, max(min(need, 45.0), need * scale))
+                    for n, f, need in legs]
+            extra["quick_budget_scale"] = round(scale, 3)
+
     for name, fn, need in legs:
+        # the handler's raise can be swallowed by a broad except deep in a
+        # leg (e.g. the cost-analysis probe) — the flag is authoritative
+        if _TERMINATED:
+            raise BudgetExceeded("SIGTERM from driver")
         _run_leg(extra, name, fn, need)
 
     extra["dispatch"] = profiler.dispatch_stats()
@@ -700,6 +802,117 @@ def decode_bench(quick=False):
             profiler.dispatch_value("recompile") - base_recompiles)
     finally:
         srv.drain(timeout=30)
+    return out
+
+
+def kernels_bench(quick=False):
+    """Pallas kernel-program leg (docs/KERNELS.md): measures the two
+    tentpole kernels through the SAME ``select_impl`` registry the model
+    paths use, so the number tracks whatever implementation the backend
+    actually gets (Pallas on TPU, lax fallbacks elsewhere — the quick/CPU
+    reading gates plumbing regressions, the TPU reading gates the
+    kernels).  Both are wrapped as ``kernel_unit`` TrackedJits, so the
+    flight recorder and MFU attribution see them as ``kernel.*`` units.
+
+    * flash attention forward+backward (``jax.value_and_grad`` through
+      the custom VJP) in tokens/sec, against a naive materialized-scores
+      attention with the same loss — ``kernels_flash_vs_naive``;
+    * int8 matmul with fused per-channel dequant vs a bf16 ``jnp.dot``
+      of the same shape, interleaved draws — ``kernels_int8_matmul_vs_bf16``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.ops.pallas import kernel_unit, select_impl
+
+    on_tpu = jax.default_backend() == "tpu"
+    B, H, D = 1, 4, 64
+    T = 512 if quick else 2048
+    steps = 3 if quick else 10
+    reps = 2 if quick else 3
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, T, H, D), dt)
+    k = jax.random.normal(kk, (B, T, H, D), dt)
+    v = jax.random.normal(kv, (B, T, H, D), dt)
+
+    attn_fn, attn_impl = select_impl("flash_attention")
+
+    def flash_loss(q, k, v):
+        o = attn_fn(q, k, v, causal=True)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    def naive_loss(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * (D ** -0.5)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return (o ** 2).sum()
+
+    flash_step = kernel_unit("bench_flash_fwd_bwd",
+                             jax.value_and_grad(flash_loss, (0, 1, 2)))
+    naive_step = jax.jit(jax.value_and_grad(naive_loss, (0, 1, 2)))
+
+    def tput(fn):
+        jax.block_until_ready(fn(q, k, v))      # compile outside timing
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            best = max(best, B * T * steps / (time.perf_counter() - t0))
+        return best
+
+    flash_tps, naive_tps = tput(flash_step), tput(naive_step)
+    out = {
+        "kernels_flash_impl": attn_impl,
+        "kernels_flash_fwd_bwd_tokens_per_sec": round(flash_tps, 1),
+        "kernels_naive_fwd_bwd_tokens_per_sec": round(naive_tps, 1),
+        "kernels_flash_vs_naive": round(flash_tps / naive_tps, 4),
+    }
+
+    # -- int8 fused dequant vs bf16 dot, interleaved (drift-immune) --
+    M = N = K = 512 if quick else 2048
+    rng = np.random.RandomState(0)
+    a8 = jnp.asarray(rng.randint(-127, 128, (M, K)), jnp.int8)
+    w8 = jnp.asarray(rng.randint(-127, 128, (N, K)), jnp.int8)
+    sa = jnp.float32(0.05)
+    sw = jnp.asarray(rng.rand(N).astype(np.float32) * 0.1 + 0.01)
+    int8_fn, int8_impl = select_impl("int8_matmul")
+    int8_step = kernel_unit(
+        "bench_int8_matmul",
+        lambda a, b, s_a, s_b: int8_fn(a, b, s_a, s_b))
+    bdt = jnp.bfloat16 if on_tpu else jnp.float32
+    a16 = (a8.astype(jnp.float32) * sa).astype(bdt)
+    w16 = (w8.astype(jnp.float32) * sw[:, None]).astype(bdt)
+    bf16_step = jax.jit(lambda a, b: jnp.dot(
+        a, b.T, preferred_element_type=jnp.float32))
+
+    jax.block_until_ready(int8_step(a8, w8, sa, sw))
+    jax.block_until_ready(bf16_step(a16, w16))
+    best_i = best_b = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            o = int8_step(a8, w8, sa, sw)
+        jax.block_until_ready(o)
+        best_i = max(best_i, steps / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            o = bf16_step(a16, w16)
+        jax.block_until_ready(o)
+        best_b = max(best_b, steps / (time.perf_counter() - t0))
+    gflop = 2.0 * M * N * K / 1e9
+    out.update({
+        "kernels_int8_impl": int8_impl,
+        "kernels_int8_matmul_gflops_per_sec": round(best_i * gflop, 1),
+        "kernels_bf16_matmul_gflops_per_sec": round(best_b * gflop, 1),
+        "kernels_int8_matmul_vs_bf16": round(best_i / best_b, 4),
+    })
     return out
 
 
@@ -1105,6 +1318,14 @@ def _kernel_breakdown(step, state, data, steps=3):
 
 
 if __name__ == "__main__":
+    import atexit
+    import signal as _signal
+
+    try:
+        _signal.signal(_signal.SIGTERM, _term_handler)
+    except (ValueError, OSError, AttributeError):
+        pass
+    atexit.register(_emit_summary)
     # global ceiling until the first leg arms its own budget; legs re-arm
     # the remaining global budget on exit, so imports and between-leg
     # glue stay covered too
@@ -1117,7 +1338,7 @@ if __name__ == "__main__":
         RESULT["error"] = "%s: %s" % (type(e).__name__, e)
     finally:
         _arm(0)
-        print(json.dumps(RESULT))
+        _emit_summary()
         check = (RESULT["extra"].get("regression_check") or {})
         strict = os.environ.get("BENCH_REGRESSION_STRICT", "") not in (
             "", "0")

@@ -148,6 +148,25 @@ gen_check() {
     fi
 }
 
+kernel_check() {
+    # Pallas kernel program (docs/KERNELS.md): select_impl registry mode
+    # semantics, flash-attention fwd+bwd parity (incl. the lse-cotangent
+    # custom VJP), int8 matmul int32 exactness + fused per-channel
+    # dequant oracle, and the quantized_dense wiring.  The second run
+    # routes every registry call site through the Pallas interpreter —
+    # the CPU stand-in for the real kernels.
+    python -m pytest tests/test_pallas.py tests/test_quantization.py -q
+    MXTPU_PALLAS=interpret python -m pytest tests/test_pallas.py -q
+    # the kernel layer must lint clean — NO suppressions: these are the
+    # hand-written hot paths everything else trusts blindly
+    python -m mxnet_tpu.lint mxnet_tpu/ops/pallas/ mxnet_tpu/ops/quantization.py
+    if grep -rn "mxlint: disable" mxnet_tpu/ops/pallas/ \
+            mxnet_tpu/ops/quantization.py; then
+        echo "kernel-layer modules must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 fleet_check() {
     # Fleet layer (docs/SHARDED_SERVING.md): pjit-sharded replicas over
     # mesh slices (single-device output parity, zero under-load
@@ -284,6 +303,7 @@ all() {
     unittest_serving
     serving_check
     gen_check
+    kernel_check
     fleet_check
     obs_check
     debug_check

@@ -1177,6 +1177,32 @@ inline std::vector<NDArray> _contrib_quantized_conv(const NDArray& data,
   return op_.Invoke();
 }
 
+inline std::vector<NDArray> _contrib_quantized_dense(const NDArray& data,
+    const NDArray& weight,
+    const NDArray& min_data,
+    const NDArray& max_data,
+    const NDArray& min_weight,
+    const NDArray& max_weight,
+    const NDArray& bias,
+    const std::string& num_hidden = "__default__",
+    bool no_bias = false,
+    bool flatten = true) {
+  Operator op_("_contrib_quantized_dense");
+  if (num_hidden != "__default__") {
+    op_.SetParam("num_hidden", num_hidden);
+  }
+  op_.SetParam("no_bias", no_bias);
+  op_.SetParam("flatten", flatten);
+  op_.PushInput(data);
+  op_.PushInput(weight);
+  op_.PushInput(min_data);
+  op_.PushInput(max_data);
+  op_.PushInput(min_weight);
+  op_.PushInput(max_weight);
+  op_.PushInput(bias);
+  return op_.Invoke();
+}
+
 inline std::vector<NDArray> _contrib_quantized_elemwise_add(const NDArray& lhs,
     const NDArray& rhs,
     const NDArray& min_lhs,

@@ -194,6 +194,17 @@ class _Config:
              "per-subsystem tag providers (params, optimizer_state, "
              "kv_pages, replica slices) published as mem.* gauges on "
              "every memory.update(). Set 0 to make update() a no-op."),
+        Knob("MXTPU_PALLAS", str, "auto",
+             "Kernel-selection mode for the Pallas kernel library "
+             "(docs/KERNELS.md; ops.pallas.common.select_impl): 'auto' "
+             "runs the hand-tiled kernels (flash attention fwd+bwd, int8 "
+             "matmul with fused dequant, fused rmsnorm/xent) on "
+             "single-device TPU and the identical-math lax fallbacks "
+             "elsewhere; 'off' forces the fallbacks everywhere; "
+             "'interpret' runs the real kernels through the Pallas "
+             "interpreter on any backend — the CPU parity-testing mode. "
+             "Each resolution bumps a pallas.select.<kernel>.<impl> "
+             "telemetry counter."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

@@ -33,7 +33,7 @@ __all__ = ["donation_active", "donation_scope", "no_donation",
            "bucket_size", "bucket_spec", "pow2_chain", "pad_batch",
            "TrackedJit",
            "TraceGuardError", "trace_scope", "in_framework_trace",
-           "trace_guard_mode", "guard_host_sync",
+           "trace_guard_mode", "guard_host_sync", "pallas_mode",
            "RecompileError", "explain_recompiles_mode", "recompile_ring",
            "clear_recompile_ring", "explain_recompiles",
            "first_cost_failure", "note_cost_failure"]
@@ -86,6 +86,28 @@ def trace_guard_mode():
     if mode not in ("warn", "raise"):
         raise ValueError(
             "MXNET_TRACE_GUARD must be '', 'warn' or 'raise'; got %r"
+            % mode)
+    return mode
+
+
+def pallas_mode():
+    """'auto', 'off', or 'interpret' — the MXTPU_PALLAS knob, validated.
+
+    Consumed by ``ops.pallas.common.select_impl`` (docs/KERNELS.md): 'auto'
+    picks the Pallas kernel on single-device TPU and the lax fallback
+    elsewhere; 'off' forces the fallback everywhere; 'interpret' runs the
+    real kernels through the Pallas interpreter on any backend (the CPU
+    parity-testing mode)."""
+    from .config import config
+
+    mode = (config.pallas or "").strip().lower()
+    if mode in ("", "1", "on", "true"):
+        return "auto"
+    if mode in ("0", "false", "no"):
+        return "off"
+    if mode not in ("auto", "off", "interpret"):
+        raise ValueError(
+            "MXTPU_PALLAS must be 'auto', 'off' or 'interpret'; got %r"
             % mode)
     return mode
 

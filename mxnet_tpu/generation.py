@@ -60,6 +60,9 @@ _DEF_MAX_QUEUE = int(os.environ.get("MXTPU_GEN_MAX_QUEUE", "64"))
 _DEF_DEADLINE_MS = float(os.environ.get("MXTPU_GEN_DEADLINE_MS", "60000"))
 _DEF_SLOT_BUCKETS = os.environ.get("MXTPU_GEN_SLOT_BUCKETS", "")
 _DEF_PREFILL_BUCKETS = os.environ.get("MXTPU_GEN_PREFILL_BUCKETS", "")
+_DEF_TEMPERATURE = float(os.environ.get("MXTPU_GEN_TEMPERATURE", "0"))
+_DEF_TOP_K = int(os.environ.get("MXTPU_GEN_TOP_K", "0"))
+_DEF_SEED = int(os.environ.get("MXTPU_GEN_SEED", "0"))
 
 
 def _log(msg):
@@ -80,6 +83,9 @@ class GenerationConfig:
     slot_buckets: str = _DEF_SLOT_BUCKETS
     prefill_buckets: str = _DEF_PREFILL_BUCKETS
     eos_id: int = -1                    # -1 -> no EOS stopping
+    temperature: float = _DEF_TEMPERATURE  # <= 0 -> greedy argmax
+    top_k: int = _DEF_TOP_K             # 0 -> full vocabulary
+    seed: int = _DEF_SEED               # base seed for per-request rngs
 
 
 def _resolve_chain(spec, cap):
@@ -99,6 +105,27 @@ def _pick_bucket(chain, n):
         if b >= n:
             return b
     return chain[-1]
+
+
+def _sample_token(logits, temperature, top_k, rng):
+    """Pick the next token id from one logits row (np [V], host-side).
+
+    ``temperature <= 0`` is greedy argmax — the default, bit-identical to
+    the pre-sampling decode path.  Otherwise softmax(logits / temperature)
+    in f64, optionally restricted to the ``top_k`` highest logits, sampled
+    with the request's own ``np.random.Generator`` so a fixed seed gives a
+    deterministic token stream regardless of batch composition.
+    """
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    z = np.asarray(logits, np.float64) / float(temperature)
+    if top_k and top_k < z.shape[-1]:
+        kth = np.partition(z, -top_k)[-top_k]
+        z = np.where(z >= kth, z, -np.inf)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(z.shape[-1], p=p))
 
 
 # ---------------------------------------------------------------------------
@@ -161,10 +188,10 @@ class _Seq:
     """One sequence resident in the decode batch (host-side bookkeeping)."""
 
     __slots__ = ("fut", "table", "n_pages", "length", "last_token",
-                 "n_new", "max_new", "prompt_len")
+                 "n_new", "max_new", "prompt_len", "sampling")
 
     def __init__(self, fut, table, n_pages, length, last_token, max_new,
-                 prompt_len):
+                 prompt_len, sampling):
         self.fut = fut
         self.table = table            # np [M] int32, padded with 0
         self.n_pages = n_pages        # leading valid entries of table
@@ -173,6 +200,7 @@ class _Seq:
         self.n_new = 1                # generated so far (prefill emits #1)
         self.max_new = max_new
         self.prompt_len = prompt_len
+        self.sampling = sampling      # (temperature, top_k, rng)
 
 
 class GenerationEngine:
@@ -329,7 +357,8 @@ class GenerationServer:
                                  else float(deadline_ms)) / 1e3
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending = collections.deque()   # (fut, prompt, max_new)
+        self._pending = collections.deque()   # (fut, prompt, max_new,
+        #                                       (temperature, top_k, rng))
         self._active = []                     # [_Seq]
         self._inflight = None                 # fut mid-prefill (not yet in
         #                                       _active; drain must see it)
@@ -358,10 +387,18 @@ class GenerationServer:
 
     # -- admission -----------------------------------------------------
     def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
-                     on_token=None):
+                     on_token=None, temperature=None, top_k=None, seed=None):
         """Admit one generation request; returns a
         :class:`~mxnet_tpu.serving.StreamingFuture` or raises the typed
-        admission error (:class:`Overloaded` / :class:`Draining`)."""
+        admission error (:class:`Overloaded` / :class:`Draining`).
+
+        ``temperature`` / ``top_k`` / ``seed`` override the config-level
+        sampling knobs per request (``temperature <= 0`` = greedy argmax,
+        ``top_k == 0`` = full vocabulary).  Sampling state is per-request
+        and host-side, so batch composition never perturbs a stream: an
+        explicit ``seed`` replays the exact token stream; by default each
+        request derives an independent rng from ``(cfg.seed, admission
+        index)``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -371,6 +408,11 @@ class GenerationServer:
         max_new = int(max_new_tokens or self.cfg.max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        temperature = (self.cfg.temperature if temperature is None
+                       else float(temperature))
+        top_k = self.cfg.top_k if top_k is None else int(top_k)
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0")
         now = time.monotonic()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
@@ -392,7 +434,11 @@ class GenerationServer:
             _telemetry.trace_begin("request", fut.trace_id, cat="gen",
                                    args={"prompt_len": int(prompt.size),
                                          "max_new": max_new})
-            self._pending.append((fut, prompt, max_new))
+            rng = np.random.default_rng(
+                int(seed) if seed is not None
+                else (self.cfg.seed, self.stats["admitted"]))
+            self._pending.append((fut, prompt, max_new,
+                                  (temperature, top_k, rng)))
             self._cv.notify_all()
         return fut
 
@@ -424,7 +470,7 @@ class GenerationServer:
 
     def _expire_locked(self, now):
         for i in range(len(self._pending) - 1, -1, -1):
-            fut, _, _ = self._pending[i]
+            fut = self._pending[i][0]
             if now >= fut.deadline:
                 del self._pending[i]
                 self._reject_locked(fut, DeadlineExceeded(
@@ -460,7 +506,7 @@ class GenerationServer:
             self.engine.allocator.free(pages)
         self._cv.notify_all()
 
-    def _do_prefill(self, fut, prompt, max_new):
+    def _do_prefill(self, fut, prompt, max_new, sampling):
         eng = self.engine
         need = -(-int(prompt.size) // eng.page_size)
         pages = eng.allocator.alloc(need)
@@ -477,9 +523,9 @@ class GenerationServer:
         table = np.zeros(eng.pages_per_seq, np.int32)
         table[:need] = pages
         logits = eng.prefill(prompt, table)        # device work, no lock
-        tok = int(np.argmax(logits))
+        tok = _sample_token(logits, *sampling)
         seq = _Seq(fut, table, need, int(prompt.size), tok, max_new,
-                   int(prompt.size))
+                   int(prompt.size), sampling)
         is_eos = self.cfg.eos_id >= 0 and tok == self.cfg.eos_id
         emitted = False if is_eos else fut._emit(tok)  # EOS never streams
         if emitted and fut.t_first_token is not None:
@@ -537,7 +583,6 @@ class GenerationServer:
             args={"active": len(survivors),
                   "bucket": _pick_bucket(eng.slot_chain, len(survivors)),
                   "ms": round(dt * 1e3, 3)})
-        next_toks = np.argmax(logits, axis=-1)
         # advance + emit with no lock held (token callbacks are user code);
         # settlement then happens under the lock, and _retire_locked is
         # idempotent against deadline/drain sweeps that raced the step
@@ -547,7 +592,7 @@ class GenerationServer:
                 finished.append(s)
                 continue
             s.length += 1
-            tok = int(next_toks[i])
+            tok = _sample_token(logits[i], *s.sampling)
             if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
                 finished.append(s)
                 continue
@@ -601,7 +646,7 @@ class GenerationServer:
             if not drained:
                 aborted = 0
                 while self._pending:
-                    fut, _, _ = self._pending.popleft()
+                    fut = self._pending.popleft()[0]
                     self._reject_locked(fut, Draining(
                         "drain timed out with the request still queued"))
                     aborted += 1

@@ -143,13 +143,14 @@ class TransformerLM:
 
     # -- forward -------------------------------------------------------
     def _rmsnorm(self, x, scale):
-        from ..parallel.mesh import current_mesh
-        if jax.default_backend() == "tpu" and current_mesh() is None:
-            # single-chip hot path: fused Pallas kernel (one VMEM pass);
-            # under a mesh GSPMD can't partition the custom call, and the
-            # lax form below fuses fine anyway
-            from ..ops.pallas import fused_rmsnorm
-            return fused_rmsnorm(x, scale.astype(x.dtype))
+        # kernel registry (docs/KERNELS.md): fused Pallas kernel (one VMEM
+        # pass) on single-chip TPU or under MXTPU_PALLAS=interpret; under a
+        # mesh GSPMD can't partition the custom call, and the lax form
+        # below fuses fine anyway
+        from ..ops.pallas.common import select_impl
+        fn, impl = select_impl("fused_rmsnorm")
+        if impl in ("pallas", "interpret"):
+            return fn(x, scale.astype(x.dtype))
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
                 ).astype(x.dtype) * scale
@@ -169,13 +170,22 @@ class TransformerLM:
         score_mb = B * H * T * T * 4 / 1e6
         if use_ring:
             attn = ring_self_attention(q, k, v, causal=True)
-        elif score_mb <= cfg.dense_attn_max_score_mb:
-            attn = _dense_self_attention(q, k, v, causal=True)
-        elif jax.default_backend() == "tpu":
-            from ..ops.pallas import flash_self_attention
-            attn = flash_self_attention(q, k, v, causal=True)
         else:
-            attn = blockwise_attention(q, k, v, causal=True)
+            # kernel registry (docs/KERNELS.md): 'pallas'/'sharded' is the
+            # flash kernel (dense-gated below — small problems run the
+            # materialized form at full MXU rate), 'interpret' forces the
+            # real kernels through the interpreter regardless of size (the
+            # parity-testing mode), 'fallback' is the lax blockwise path.
+            from ..ops.pallas.common import select_impl
+            attn_fn, attn_impl = select_impl("flash_attention")
+            if attn_impl == "interpret":
+                attn = attn_fn(q, k, v, causal=True)
+            elif score_mb <= cfg.dense_attn_max_score_mb:
+                attn = _dense_self_attention(q, k, v, causal=True)
+            elif attn_impl in ("pallas", "sharded"):
+                attn = attn_fn(q, k, v, causal=True)
+            else:
+                attn = blockwise_attention(q, k, v, causal=True)
         attn = attn.reshape(B, T, H * D)
         o = jnp.einsum("btf,fe->bte", attn, bp["wo"],
                        preferred_element_type=jnp.float32).astype(x.dtype)
@@ -356,11 +366,11 @@ class TransformerLM:
 
     def loss(self, params, tokens, targets):
         """Causal LM loss: mean token cross-entropy (+ MoE aux loss)."""
-        from ..parallel.mesh import current_mesh
         logits, aux = self.apply(params, tokens)
-        if jax.default_backend() == "tpu" and current_mesh() is None:
-            from ..ops.pallas import fused_softmax_xent
-            nll = fused_softmax_xent(logits, targets).mean()
+        from ..ops.pallas.common import select_impl
+        xent_fn, xent_impl = select_impl("fused_softmax_xent")
+        if xent_impl in ("pallas", "interpret"):
+            nll = xent_fn(logits, targets).mean()
         else:
             logz = jax.nn.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(logits, targets[..., None],

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .common import _NEG, _round_up
+from .common import _NEG, _round_up, register_impl
 
 __all__ = ["flash_attention", "flash_self_attention"]
 
@@ -223,13 +223,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, kv_len,
-         interpret):
+         interpret, dlse=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     nq, nk = Tq // block_q, Tk // block_k
     # delta_i = rowsum(do_i * o_i) — cheap elementwise, XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
+    if dlse is not None:
+        # lse is also an output: d lse_i / d s_ij = p_ij, so the lse
+        # cotangent enters as ds_ij += p_ij * dlse_i — algebraically
+        # identical to subtracting dlse from delta in ds = p*(dp - delta),
+        # which reuses both kernels unchanged.
+        delta = delta - dlse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
     kspec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0))
@@ -299,6 +305,28 @@ def _flash_bwd(causal, scale, block_q, block_k, kv_len, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, kv_len, interpret):
+    return _fwd(q, k, v, causal, scale, block_q, block_k, kv_len, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, kv_len,
+                   interpret):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, kv_len, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, kv_len, interpret, res,
+                   ct):
+    q, k, v, o, lse = res
+    do, dlse = ct
+    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, kv_len,
+                interpret, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
                     block_k=None, interpret=None):
     """Flash attention over [B, T, H, D] tensors.
@@ -340,15 +368,18 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
 
 def flash_attention_lse(q, k, v, causal=True, scale=None, block_q=None,
                         block_k=None, interpret=None):
-    """Forward-only flash attention returning ``(o, lse)``.
+    """Flash attention returning ``(o, lse)``.
 
     Same [B, T, H, D] API as :func:`flash_attention`, plus the per-row
     logsumexp [B, H, T] of the scaled masked scores (fully-masked rows get
     the ``-1e30`` sentinel).  This is the block kernel for flash-decoding
     style merges of normalized partials over disjoint key sets —
     `parallel.ring_attention(use_pallas=True)` combines one such call per
-    ring step.  No custom VJP: inference/forward path only.  Off-TPU falls
-    back to the lax blockwise kernel unless ``interpret=True``.
+    ring step.  Differentiable in both outputs via custom VJP: the ``lse``
+    cotangent folds into the ``delta`` operand of the same Pallas backward
+    kernels (``ds += p * dlse``), so the merged-partials form trains
+    end-to-end.  Off-TPU falls back to the lax blockwise kernel unless
+    ``interpret=True``.
     """
     B, T, H, D = q.shape
     Tk = k.shape[1]
@@ -373,7 +404,8 @@ def flash_attention_lse(q, k, v, causal=True, scale=None, block_q=None,
     if pk:
         kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pk), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pk), (0, 0)))
-    o, lse = _fwd(qt, kt, vt, causal, scale, block_q, block_k, Tk, interpret)
+    o, lse = _flash_lse(qt, kt, vt, causal, scale, block_q, block_k, Tk,
+                        interpret)
     if pq:
         o = o[:, :, :T]
         lse = lse[:, :, :T]
@@ -412,3 +444,12 @@ def flash_self_attention(q, k, v, causal=True, batch_axis="dp",
     fn = functools.partial(flash_attention, causal=causal)
     return shard_map(fn, mesh=mesh.mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _blockwise_fallback(q, k, v, causal=True, scale=None, interpret=None):
+    from ...parallel.ring_attention import blockwise_attention
+    return blockwise_attention(q, k, v, causal=causal, scale=scale)
+
+
+register_impl("flash_attention", pallas=flash_attention,
+              fallback=_blockwise_fallback, sharded=flash_self_attention)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .common import _NEG, _mesh_active, _round_up
+from .common import _NEG, _mesh_active, _round_up, register_impl
 
 __all__ = ["fused_rmsnorm", "fused_softmax_xent"]
 
@@ -251,3 +251,17 @@ def fused_softmax_xent(logits, labels, interpret=None):
     if pad_r:
         loss = loss[:N]
     return loss.reshape(lead)
+
+
+def _rmsnorm_fallback(x, scale, eps=1e-6, interpret=None):
+    return _rmsnorm_lax(x, scale, eps)
+
+
+def _xent_fallback(logits, labels, interpret=None):
+    return _xent_lax(logits, labels)
+
+
+register_impl("fused_rmsnorm", pallas=fused_rmsnorm,
+              fallback=_rmsnorm_fallback)
+register_impl("fused_softmax_xent", pallas=fused_softmax_xent,
+              fallback=_xent_fallback)

@@ -30,6 +30,16 @@ def _scale(mn, mx, qmax=INT8_MAX):
                        1e-10) / qmax
 
 
+def _int8_dot(data, weight, scale_a=None, scale_b=None):
+    """int8 [M, K] x int8 [N, K] contraction via the Pallas kernel registry
+    (``select_impl('int8_matmul')``, docs/KERNELS.md).  Without scales the
+    raw int32 accumulator; with them the fused in-register dequant -> f32."""
+    from .pallas.common import select_impl
+
+    fn, _ = select_impl("int8_matmul")
+    return fn(data, weight, scale_a, scale_b)
+
+
 @register("_contrib_quantize_v2", aliases=("quantize_v2",), no_grad=True,
           num_outputs=3)
 def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
@@ -85,12 +95,14 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
 def _quantized_fc(data, weight, min_data, max_data, min_weight,
                   max_weight, bias=None, min_bias=None, max_bias=None,
                   num_hidden=None, no_bias=False, flatten=True):
-    """int8 x int8 -> int32 matmul on the MXU (quantized_fully_connected.cc)."""
+    """int8 x int8 -> int32 matmul on the MXU (quantized_fully_connected.cc).
+
+    The contraction routes through the kernel registry (docs/KERNELS.md):
+    the Pallas int8 tile kernel on single-device TPU, this file's original
+    XLA lowering elsewhere."""
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    out = lax.dot_general(data, weight,
-                          (((1,), (1,)), ((), ())),
-                          preferred_element_type=jnp.int32)
+    out = _int8_dot(data, weight)
     sd = _scale(min_data, max_data)
     sw = _scale(min_weight, max_weight)
     out_scale = sd * sw
@@ -103,6 +115,33 @@ def _quantized_fc(data, weight, min_data, max_data, min_weight,
         out = out + b32
     r = out_scale * INT32_MAX
     return out, -r, r
+
+
+@register("_contrib_quantized_dense", aliases=("quantized_dense",),
+          no_grad=True,
+          input_names=("data", "weight", "min_data", "max_data",
+                       "min_weight", "max_weight", "bias"))
+def _quantized_dense(data, weight, min_data, max_data, min_weight,
+                     max_weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True):
+    """int8 x int8 matmul with FUSED per-channel dequant -> f32.
+
+    The kernel-first dense path: where ``quantized_fully_connected`` emits
+    the raw int32 accumulator plus a range (and a separate ``dequantize``
+    pass re-reads it from HBM), this op applies the requantization scale
+    ``scale_data * scale_weight`` in-register on the output tile and writes
+    f32 once.  ``min_weight``/``max_weight`` may be per-output-channel [N]
+    vectors (per-channel weight calibration); ``bias`` is f32 and is added
+    after dequant.  Oracle: ``dequantize(quantized_fully_connected(...))``.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    sd = _scale(min_data, max_data)
+    sw = _scale(min_weight, max_weight)
+    out = _int8_dot(data, weight, sd, sw)
+    if not no_bias and bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out
 
 
 @register("_contrib_quantized_conv", aliases=("quantized_conv",),

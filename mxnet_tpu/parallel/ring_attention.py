@@ -67,9 +67,11 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
     flash-decoding logsumexp recurrence — numerically the same global
     softmax.  Off-TPU it falls back to the lax block kernel
     (``use_pallas="interpret"`` forces the real kernels through the
-    Pallas interpreter for CPU parity tests).  Forward-path optimization:
-    the merged-partials form has no custom VJP, so keep the default lax
-    path for training.
+    Pallas interpreter for CPU parity tests).  Trainable end-to-end:
+    `flash_attention_lse` carries a custom VJP over both outputs (the lse
+    cotangent folds into the backward kernels' delta operand), so JAX AD
+    through the merge + scan + ppermute gives the exact global-attention
+    gradient — see tests/test_parallel.py's train-step parity tests.
     """
     if use_pallas:
         return _ring_attention_flash(q, k, v, axis_name, causal, scale,
@@ -135,6 +137,11 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, interpret):
     and the diagonal block is handled by the kernel's own causal mask — so
     remote blocks run the cheaper non-causal kernel and future-owner blocks
     are killed via lse = _NEG before the merge.
+
+    Differentiable: the block kernel's custom VJP covers both (o, lse), and
+    the ring step is rematerialized (``jax.checkpoint``, matching the lax
+    path) so the backward re-runs each block kernel instead of storing
+    per-step residuals.
     """
     from ..ops.pallas import flash_attention_lse
     from .collectives import ppermute_shift
@@ -163,7 +170,8 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, interpret):
         o, lse = _merge_partials(o, lse, o_b, lse_b)
         return (o, lse, k_blk, v_blk), None
 
-    (o, lse, _, _), _ = lax.scan(step, (o, lse, k, v), jnp.arange(1, n))
+    (o, lse, _, _), _ = lax.scan(
+        jax.checkpoint(step), (o, lse, k, v), jnp.arange(1, n))
     return o.astype(q.dtype)
 
 

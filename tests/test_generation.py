@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from mxnet_tpu import dispatch, profiler
 from mxnet_tpu.generation import (GenerationConfig, GenerationEngine,
-                                  GenerationServer, PageAllocator)
+                                  GenerationServer, PageAllocator,
+                                  _sample_token)
 from mxnet_tpu.models import TransformerLM, TransformerConfig
 from mxnet_tpu.serving import (DeadlineExceeded, Draining, Overloaded,
                                StreamingFuture)
@@ -202,6 +203,61 @@ class TestContinuousBatching:
         assert reg.histogram("gen.decode_tokens_per_sec").count > 0
         assert profiler.dispatch_value("gen_prefills") > 0
         assert profiler.dispatch_value("gen_tokens") > 0
+
+
+# ---------------------------------------------------------------------------
+# temperature / top-k sampling
+# ---------------------------------------------------------------------------
+class TestSampling:
+    def test_temperature_zero_is_argmax(self):
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=VOCAB).astype(np.float32)
+        for _ in range(5):
+            assert _sample_token(logits, 0.0, 0, rng) \
+                == int(np.argmax(logits))
+        assert _sample_token(logits, -1.0, 5, rng) == int(np.argmax(logits))
+
+    def test_top_k_masks_tail(self):
+        """With top_k=k, only the k highest-logit tokens are ever drawn."""
+        rng = np.random.default_rng(1)
+        logits = rng.normal(size=VOCAB).astype(np.float32)
+        allowed = set(np.argsort(logits)[-3:].tolist())
+        draws = {_sample_token(logits, 5.0, 3, rng) for _ in range(200)}
+        assert draws <= allowed
+        assert len(draws) > 1            # high temperature: not degenerate
+
+    def test_rng_determinism(self):
+        logits = np.random.default_rng(2).normal(size=VOCAB)
+        a = [_sample_token(logits, 1.0, 0, np.random.default_rng(42))
+             for _ in range(1)]
+        b = [_sample_token(logits, 1.0, 0, np.random.default_rng(42))
+             for _ in range(1)]
+        assert a == b
+
+    def test_server_seeded_stream_replays(self, served):
+        """Same prompt + explicit seed -> bitwise-identical token stream,
+        regardless of what else the server has processed in between."""
+        prompt = _prompts([6], seed=23)[0]
+        kw = dict(max_new_tokens=6, temperature=1.2, top_k=8, seed=123,
+                  timeout=60)
+        first = served.submit(prompt, **kw)
+        served.submit(_prompts([4])[0], max_new_tokens=3, timeout=60)
+        second = served.submit(prompt, **kw)
+        assert first == second
+        assert all(0 <= t < VOCAB for t in first) and len(first) == 6
+
+    def test_server_default_remains_greedy(self, served):
+        """No sampling kwargs (config defaults) -> decode is argmax, i.e.
+        identical to a temperature=0 request."""
+        prompt = _prompts([7], seed=29)[0]
+        greedy = served.submit(prompt, max_new_tokens=5, timeout=60)
+        explicit = served.submit(prompt, max_new_tokens=5, temperature=0.0,
+                                 timeout=60)
+        assert greedy == explicit
+
+    def test_negative_top_k_rejected(self, served):
+        with pytest.raises(ValueError):
+            served.submit_async(_prompts([4])[0], top_k=-1)
 
 
 # ---------------------------------------------------------------------------

@@ -9,9 +9,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mxnet_tpu.ops.pallas import flash_attention, flash_attention_lse, \
-    fused_rmsnorm, fused_softmax_xent
+from mxnet_tpu.ops.pallas import (flash_attention, flash_attention_lse,
+                                  fused_rmsnorm, fused_softmax_xent,
+                                  int8_matmul, int8_matmul_lax, kernel_unit,
+                                  select_impl)
 from mxnet_tpu.ops.pallas.flash_attention import _flash  # noqa: F401
+from mxnet_tpu.ops.pallas.int8_matmul import _int8_matmul_pallas
 from mxnet_tpu.ops.pallas.layers import _rmsnorm_lax, _xent_lax
 from mxnet_tpu.parallel.ring_attention import blockwise_attention
 
@@ -101,6 +104,139 @@ class TestFlashAttention:
         ref = blockwise_attention(q, q, q, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
+
+
+class TestFlashAttentionLSEGrad:
+    """flash_attention_lse carries a custom VJP over BOTH outputs: the lse
+    cotangent folds into the backward kernels' delta operand.  The loss
+    below depends on o AND lse, so a wrong fold-in fails loudly."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("T", [128, 100])   # 100: ragged last block
+    def test_grad_parity_vs_blockwise_oracle(self, causal, T):
+        shape = (1, T, 2, 32)
+        q = _rand(0, shape)
+        k = _rand(1, shape)
+        v = _rand(2, shape)
+
+        def loss_ref(q, k, v):
+            o, lse = blockwise_attention(q, k, v, causal=causal,
+                                         return_lse=True)
+            return (o ** 2).sum() + jnp.tanh(lse).sum()
+
+        def loss_ker(q, k, v):
+            o, lse = flash_attention_lse(q, k, v, causal=causal,
+                                         interpret=True)
+            return (o ** 2).sum() + jnp.tanh(lse).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gk = jax.grad(loss_ker, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg="d%s mismatch" % name)
+
+    def test_output_only_loss_matches_flash_attention_grad(self):
+        # with no lse cotangent the VJP must reduce to the plain one
+        shape = (1, 128, 2, 32)
+        q, k, v = _rand(0, shape), _rand(1, shape), _rand(2, shape)
+        g1 = jax.grad(lambda q: (flash_attention(
+            q, k, v, causal=True, interpret=True) ** 2).sum())(q)
+        g2 = jax.grad(lambda q: (flash_attention_lse(
+            q, k, v, causal=True, interpret=True)[0] ** 2).sum())(q)
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestInt8Matmul:
+    def _data(self, M, K, N, seed=0):
+        rng = np.random.RandomState(seed)
+        a = jnp.asarray(rng.randint(-127, 128, (M, K)), jnp.int8)
+        w = jnp.asarray(rng.randint(-127, 128, (N, K)), jnp.int8)
+        return a, w
+
+    @pytest.mark.parametrize("shape", [(37, 96, 50), (128, 128, 128),
+                                       (256, 64, 200)])
+    def test_int32_exact_vs_lax(self, shape):
+        """No scales: int8 x int8 -> int32 accumulate must be bit-exact
+        (zero padding is exact in int32), aligned or ragged."""
+        M, K, N = shape
+        a, w = self._data(M, K, N)
+        out = _int8_matmul_pallas(a, w, interpret=True)
+        ref = int8_matmul_lax(a, w)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_fused_dequant_per_channel_oracle(self):
+        """scale_a scalar + per-channel scale_b [N] on ragged shapes: the
+        in-register dequant must match dequantize-then-dot."""
+        M, K, N = 37, 96, 50
+        a, w = self._data(M, K, N, seed=1)
+        rng = np.random.RandomState(2)
+        sa = jnp.float32(0.043)
+        sw = jnp.asarray(rng.rand(N).astype(np.float32) * 0.1 + 0.01)
+        out = _int8_matmul_pallas(a, w, sa, sw, interpret=True)
+        oracle = (np.asarray(a, np.float32) * 0.043) @ \
+            (np.asarray(w, np.float32) * np.asarray(sw)[:, None]).T
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), oracle,
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_public_api_interpret_override(self):
+        # interpret=True on the public entry forces the Pallas kernel
+        # even where auto mode would pick the fallback (this CPU run)
+        a, w = self._data(32, 64, 40)
+        out = int8_matmul(a, w, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(int8_matmul_lax(a, w)))
+
+
+class TestSelectImpl:
+    def test_auto_on_cpu_selects_fallback(self, monkeypatch):
+        monkeypatch.delenv("MXTPU_PALLAS", raising=False)
+        fn, impl = select_impl("int8_matmul")
+        assert impl == "fallback"
+        assert fn is int8_matmul_lax
+
+    def test_interpret_mode_runs_real_kernel(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PALLAS", "interpret")
+        fn, impl = select_impl("int8_matmul")
+        assert impl == "interpret"
+        a = jnp.asarray(np.arange(-32, 32).reshape(8, 8) % 100, jnp.int8)
+        np.testing.assert_array_equal(np.asarray(fn(a, a)),
+                                      np.asarray(int8_matmul_lax(a, a)))
+
+    def test_off_forces_fallback(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PALLAS", "off")
+        for name in ("int8_matmul", "flash_attention", "fused_rmsnorm",
+                     "fused_softmax_xent"):
+            _, impl = select_impl(name)
+            assert impl == "fallback", name
+
+    def test_invalid_mode_raises(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PALLAS", "sideways")
+        with pytest.raises(ValueError, match="MXTPU_PALLAS"):
+            select_impl("int8_matmul")
+
+    def test_unknown_kernel_raises(self):
+        with pytest.raises(KeyError):
+            select_impl("no_such_kernel")
+
+    def test_selection_counter_bumped(self, monkeypatch):
+        from mxnet_tpu import telemetry
+        monkeypatch.setenv("MXTPU_PALLAS", "off")
+        c = telemetry.registry().counter(
+            "pallas.select.flash_attention.fallback")
+        before = c.value
+        select_impl("flash_attention")
+        assert c.value == before + 1
+
+    def test_kernel_unit_memoized_and_labeled(self):
+        from mxnet_tpu.dispatch import TrackedJit
+        fn = kernel_unit("test_unit_xyz", lambda x: x + 1)
+        assert isinstance(fn, TrackedJit)
+        assert kernel_unit("test_unit_xyz") is fn
+        assert int(fn(jnp.int32(1))) == 2
 
 
 class TestFusedRMSNorm:

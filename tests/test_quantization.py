@@ -62,6 +62,58 @@ def test_quantized_fc_matches_fp32():
     np.testing.assert_allclose(deq.asnumpy(), ref, atol=0.15)
 
 
+def test_quantized_dense_matches_dequantized_fc():
+    # fused per-channel dequant op vs the dequantize(quantized_fc) oracle
+    r = np.random.RandomState(3)
+    qx = r.randint(-127, 128, (8, 16)).astype(np.int8)
+    qw = r.randint(-127, 128, (4, 16)).astype(np.int8)
+    tx, tw = 1.5, 0.8
+    mins = [nd.array(np.float32([-tx])), nd.array(np.float32([tx])),
+            nd.array(np.float32([-tw])), nd.array(np.float32([tw]))]
+    fused = nd.contrib.quantized_dense(
+        nd.array(qx), nd.array(qw), *mins, num_hidden=4, no_bias=True)
+    fc, mn, mx_ = nd.contrib.quantized_fully_connected(
+        nd.array(qx), nd.array(qw), *mins, num_hidden=4, no_bias=True)
+    deq = nd.contrib.dequantize(fc, mn, mx_)
+    assert fused.asnumpy().dtype == np.float32
+    np.testing.assert_allclose(fused.asnumpy(), deq.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_dense_per_channel_and_bias():
+    r = np.random.RandomState(4)
+    qx = r.randint(-127, 128, (5, 12)).astype(np.int8)
+    qw = r.randint(-127, 128, (3, 12)).astype(np.int8)
+    tx = 2.0
+    tw = r.rand(3).astype(np.float32) + 0.5     # per-channel thresholds
+    bias = r.randn(3).astype(np.float32)
+    out = nd.contrib.quantized_dense(
+        nd.array(qx), nd.array(qw),
+        nd.array(np.float32([-tx])), nd.array(np.float32([tx])),
+        nd.array(-tw), nd.array(tw), nd.array(bias), num_hidden=3)
+    ref = (qx.astype(np.float32) * (tx / 127.0)) @ \
+        (qw.astype(np.float32) * (tw / 127.0)[:, None]).T + bias
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-4)
+
+
+def test_quantized_dense_interpret_mode_parity(monkeypatch):
+    # MXTPU_PALLAS=interpret routes the contraction through the real
+    # Pallas int8 kernel (interpreter); must match the XLA fallback
+    r = np.random.RandomState(5)
+    qx = r.randint(-127, 128, (7, 20)).astype(np.int8)
+    qw = r.randint(-127, 128, (6, 20)).astype(np.int8)
+    tw = r.rand(6).astype(np.float32) + 0.1
+    args = (nd.array(qx), nd.array(qw),
+            nd.array(np.float32([-1.0])), nd.array(np.float32([1.0])),
+            nd.array(-tw), nd.array(tw))
+    monkeypatch.delenv("MXTPU_PALLAS", raising=False)
+    ref = nd.contrib.quantized_dense(*args, num_hidden=6, no_bias=True)
+    monkeypatch.setenv("MXTPU_PALLAS", "interpret")
+    out = nd.contrib.quantized_dense(*args, num_hidden=6, no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_optimal_threshold_sane():
     r = np.random.RandomState(2)
     arr = np.concatenate([r.randn(100000), np.array([50.0])])  # outlier

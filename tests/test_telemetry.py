@@ -371,6 +371,32 @@ def test_bench_leg_timeout_isolated(tmp_path):
     assert legs["transformer"]["record"]["mfu"] > 0
 
 
+def test_bench_sigterm_still_emits_summary(tmp_path):
+    """r05 regression: the driver's kill timer SIGTERMs a mid-flight
+    round — bench must still print one parseable JSON summary line and
+    exit promptly within the kill grace, instead of dying silently (r05:
+    rc 124, zero output, `parsed: null`)."""
+    import signal
+
+    partial = str(tmp_path / "partial.jsonl")
+    env = subprocess_env(BENCH_LEGS="train", BENCH_PARTIAL_PATH=partial,
+                         BENCH_QUICK="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        time.sleep(6.0)                  # mid-import / mid-leg
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (proc.returncode, err[-2000:])
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["extra"].get("budget_exceeded") == "SIGTERM from driver"
+
+
 def test_bench_regression_tripwire(tmp_path):
     """check_regressions flags >10% drops on higher-is-better metrics
     and >10% increases on latency metrics, and nothing else."""
