@@ -13,6 +13,7 @@
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
 #   make kernel-smoke-> Pallas kernel parity + interpret lane (docs/KERNELS.md)
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
+#   make gateway-smoke-> cross-process fleet lane: gateway + worker failover
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
 #   make ci         -> everything ci/runtime_functions.sh runs
@@ -53,6 +54,9 @@ kernel-smoke:
 fleet-smoke:
 	bash ci/runtime_functions.sh fleet_check
 
+gateway-smoke:
+	bash ci/runtime_functions.sh gateway_check
+
 obs-smoke:
 	bash ci/runtime_functions.sh obs_check
 
@@ -65,4 +69,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke obs-smoke debug-smoke ci clean

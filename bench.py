@@ -565,6 +565,9 @@ def main(argv=None):
     def fleet_leg():
         return fleet_bench(quick=quick)
 
+    def gateway_leg():
+        return gateway_bench(quick=quick)
+
     # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
     # builds a second XLA module — so some exceed their full-mode numbers
     legs = [
@@ -591,6 +594,11 @@ def main(argv=None):
     # under the >10% regression tripwire) and the 2x-capacity shed rate
     if os.environ.get("BENCH_FLEET", "1") != "0":
         legs.append(("fleet", fleet_leg, 60 if quick else 120))
+    # the gateway leg runs in quick mode too: the cross-process fleet is
+    # accepted on gateway_route_p99_ms (lower-better) and the
+    # burst-with-one-worker-killed gateway_kill_goodput_vs_baseline
+    if os.environ.get("BENCH_GATEWAY", "1") != "0":
+        legs.append(("gateway", gateway_leg, 90 if quick else 150))
     # the kernels leg runs in quick mode too: the Pallas kernel program
     # (flash fwd+bwd through the registry, int8 fused dequant) is
     # accepted on kernels_flash_vs_naive / kernels_int8_matmul_vs_bf16
@@ -1006,6 +1014,140 @@ def fleet_bench(quick=False):
         sup.stop()
         sup.registry.close()
         srv.drain(timeout=30)
+    return out
+
+
+def gateway_bench(quick=False):
+    """Cross-process fleet leg (docs/SHARDED_SERVING.md "Deployment"):
+    2 spawned fleet workers behind the HTTP gateway.  Reports the
+    routing overhead ``gateway_route_p99_ms`` — the ``gateway.route_ms``
+    histogram p99 (admission -> request handed to a worker: pick +
+    idempotency stamp + connect; lower-better under the tripwire), with
+    the end-to-end ``gateway_p99_ms`` vs ``gateway_direct_p99_ms``
+    (direct ``ModelServer.submit``) pair alongside — and
+    ``gateway_kill_goodput_vs_baseline``: ok-fraction of a concurrent
+    burst with one worker SIGKILLed mid-burst over the ok-fraction of
+    the same burst undisturbed (the mid-stream failover number)."""
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.fleet import ServiceRegistry, WorkerSupervisor
+    from mxnet_tpu.fleet_worker import demo_model
+    from mxnet_tpu.gateway import Gateway
+
+    def pctl(lat_s, q):
+        return round(float(np.percentile(np.asarray(lat_s), q)) * 1e3, 3)
+
+    def post(addr, obj, timeout=60):
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/v1/predict",
+                         body=json.dumps(obj).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        finally:
+            conn.close()
+
+    n_req = 50 if quick else 200
+    burst = 24 if quick else 64
+    x = {"inputs": {"data": [[1.0, 2.0, 3.0, 4.0]]}}
+    out = {}
+
+    # -- direct ModelServer baseline (same model the workers build) --
+    direct = demo_model()
+    try:
+        arr = np.asarray(x["inputs"]["data"], np.float32)
+        for _ in range(8):
+            direct.submit({"data": arr})             # warm
+        lat = []
+        for _ in range(n_req):
+            t0 = time.perf_counter()
+            direct.submit({"data": arr})
+            lat.append(time.perf_counter() - t0)
+        direct_p99 = pctl(lat, 99)
+    finally:
+        direct.drain(timeout=30)
+
+    # -- 2 spawned workers behind the gateway --
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ,
+           "PYTHONPATH": here + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    reg = ServiceRegistry(service="bench-gw", ttl_s=1.0)
+    sup = WorkerSupervisor(
+        {rid: [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+               "--registry", reg.addr, "--service", "bench-gw",
+               "--rid", rid, "--heartbeat-s", "0.1"]
+         for rid in ("w0", "w1")},
+        registry=reg, max_restarts=3, backoff=0.05, poll_s=0.05,
+        env=env)
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    try:
+        sup.wait_registered(2, timeout=180)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                gw._view is None or len(gw._view.replicas) < 2):
+            time.sleep(0.05)
+        for _ in range(8):
+            post(gw.addr, x)                         # warm both paths
+        from mxnet_tpu import telemetry
+
+        route_ms = telemetry.registry().histogram("gateway.route_ms")
+        base_count = route_ms.snapshot()["count"]
+        lat = []
+        for _ in range(n_req):
+            t0 = time.perf_counter()
+            post(gw.addr, x)
+            lat.append(time.perf_counter() - t0)
+        out["gateway_p99_ms"] = pctl(lat, 99)
+        out["gateway_direct_p99_ms"] = direct_p99
+        hs = route_ms.snapshot()
+        # both end-to-end p99s are dominated by the worker's batching
+        # timer; the route histogram isolates the gateway's own overhead
+        if hs["count"] > base_count and hs["p99"] is not None:
+            out["gateway_route_p99_ms"] = round(hs["p99"], 3)
+
+        def run_burst(kill_at=None):
+            ok = [0]
+            lock = threading.Lock()
+
+            def one():
+                try:
+                    if post(gw.addr, x, timeout=90) == 200:
+                        with lock:
+                            ok[0] += 1
+                except OSError:
+                    pass
+                except Exception:
+                    pass
+            ts = [threading.Thread(target=one) for _ in range(burst)]
+            for i, t in enumerate(ts):
+                t.start()
+                if kill_at is not None and i == kill_at:
+                    sup.kill_worker()
+            for t in ts:
+                t.join(timeout=120)
+            return ok[0]
+
+        ok_base = run_burst()
+        ok_kill = run_burst(kill_at=burst // 4)
+        out["gateway_burst_ok_baseline"] = ok_base
+        out["gateway_burst_ok_killed"] = ok_kill
+        out["gateway_kill_goodput_vs_baseline"] = round(
+            ok_kill / max(ok_base, 1), 4)
+        out["gateway_retries"] = gw.retried
+        out["gateway_worker_restarts"] = sup.restarts
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()
     return out
 
 

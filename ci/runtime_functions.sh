@@ -185,6 +185,26 @@ fleet_check() {
     fi
 }
 
+gateway_check() {
+    # Cross-process fleet (docs/SHARDED_SERVING.md "Deployment"):
+    # gateway routing/affinity units, worker idempotent replay,
+    # partition staleness + heal, supervisor restart semantics, the
+    # mid-stream ReplicaLost contract, and the spawned 2-process
+    # acceptance scenario (worker_kill + gateway_partition mid-burst,
+    # every request typed, killed worker back in rotation, survivor
+    # zero-recompile across the process boundary).
+    python -m pytest tests/test_gateway.py -q -m "not slow"
+    # both new modules must lint clean — NO suppressions: the gateway
+    # handler threads and the worker heartbeat do blocking socket I/O,
+    # so a single CC001 slip serializes the whole front door
+    python -m mxnet_tpu.lint mxnet_tpu/gateway.py mxnet_tpu/fleet_worker.py
+    if grep -n "mxlint: disable" mxnet_tpu/gateway.py \
+            mxnet_tpu/fleet_worker.py; then
+        echo "gateway.py/fleet_worker.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 obs_check() {
     # Always-on telemetry plane (docs/OBSERVABILITY.md): metrics
     # registry, histogram quantiles, exporters, profiler ring buffer +
@@ -305,6 +325,7 @@ all() {
     gen_check
     kernel_check
     fleet_check
+    gateway_check
     obs_check
     debug_check
     unittest_dtype_sweep

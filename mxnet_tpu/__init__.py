@@ -73,6 +73,7 @@ from . import sentinel  # noqa: F401
 from . import serving  # noqa: F401
 from . import generation  # noqa: F401
 from . import fleet  # noqa: F401
+from . import gateway  # noqa: F401
 from . import benchmark  # noqa: F401
 
 # everything registered up to here is the shipped op corpus; later

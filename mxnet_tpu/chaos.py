@@ -44,6 +44,15 @@ Faults (each firing bumps the ``faults_injected`` dispatch counter):
                     stalls ~300ms before building (a cold replica whose
                     compile/weight load drags — the autoscaler must
                     absorb it, not wedge)
+``gateway_partition@N``  gateway: the Nth registry refresh fails as if the
+                    KV registry were unreachable — the gateway must keep
+                    routing from its last-known-good ``FleetView`` with
+                    staleness marking and re-sync on heal
+                    (docs/SHARDED_SERVING.md "Deployment")
+``worker_kill@N``   fleet: the Nth worker-kill opportunity SIGKILLs a
+                    live worker process mid-stream — the supervisor must
+                    restart it and the gateway must give every admitted
+                    request exactly one typed terminal outcome
 ==================  ========================================================
 
 Every fault fires at most once per process (deterministic, idempotent
@@ -63,6 +72,7 @@ __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "arm_kv_client", "corrupt_checkpoint", "FAULT_KINDS",
            "slow_replica", "replica_crash", "request_burst",
            "registry_stale", "replica_slow_start",
+           "gateway_partition", "worker_kill",
            "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
@@ -70,6 +80,7 @@ FAULT_KINDS = frozenset({
     "ckpt_truncate", "ckpt_bitflip", "loader_raise",
     "slow_replica", "replica_crash", "request_burst",
     "registry_stale", "replica_slow_start",
+    "gateway_partition", "worker_kill",
 })
 
 
@@ -358,6 +369,27 @@ def replica_slow_start(n, delay=0.3):
     if plan is not None and plan.fire("replica_slow_start", n):
         return float(delay)
     return 0.0
+
+
+def gateway_partition(n):
+    """``gateway_partition@N``: True when the gateway's Nth registry
+    refresh should fail as if the KV registry were unreachable.  The
+    gateway keeps serving from its last-known-good :class:`FleetView`
+    (marked stale) and re-syncs on the next successful refresh — the
+    same self-healing contract :func:`registry_stale` proves for the
+    worker side."""
+    plan = active()
+    return plan is not None and plan.fire("gateway_partition", n)
+
+
+def worker_kill(n):
+    """``worker_kill@N``: True when the Nth worker-kill opportunity
+    should SIGKILL a live worker process (a hard crash, no drain).  The
+    WorkerSupervisor must restart it within the backoff budget and the
+    gateway must fail over — retrying idempotent work, resolving
+    non-resumable streams with typed ``ReplicaLost``."""
+    plan = active()
+    return plan is not None and plan.fire("worker_kill", n)
 
 
 class ChaosDataset:

@@ -60,7 +60,7 @@ from .ndarray import utils as _nd_utils
 
 __all__ = ["CheckpointManager", "FaultInjector", "InjectedFault",
            "PreemptionHandler", "PreemptionRequested", "Watchdog",
-           "supervise", "active_watchdog",
+           "supervise", "active_watchdog", "install_preemption_drain",
            "WATCHDOG_EXIT_CODE", "PREEMPTED_EXIT_CODE",
            "NUMERIC_EXIT_CODE"]
 
@@ -415,6 +415,27 @@ class PreemptionHandler:
             checkpoint_fn()
         _log("drain checkpoint written; exiting rc=%d" % self.exit_code)
         sys.exit(self.exit_code)
+
+
+def install_preemption_drain(drain_flag_set, handler=None):
+    """Wire a server's drain flag into SIGTERM/SIGINT (the rc-76
+    graceful-drain contract, docs/FAULT_TOLERANCE.md).
+
+    The one shared implementation behind
+    ``ModelServer.install_preemption_drain`` /
+    ``GenerationServer.install_preemption_drain`` and the fleet worker
+    entrypoint: installs a fresh :class:`PreemptionHandler` when none is
+    given (main thread only — CPython signal restriction) and registers
+    ``drain_flag_set`` to run on the FIRST drain signal so admission
+    closes immediately, before the step boundary.  ``drain_flag_set``
+    runs in signal-handler context: it must be async-signal safe (an
+    Event/flag set, never lock acquisition or I/O).  Returns the
+    handler.
+    """
+    if handler is None:
+        handler = PreemptionHandler().install()
+    handler.add_callback(drain_flag_set)
+    return handler
 
 
 _active_watchdog = None

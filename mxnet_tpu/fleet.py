@@ -10,7 +10,7 @@ and drains **logical replicas** — pjit-sharded mesh slices
 shared-weight clones — against explicit bounds, hysteresis, and
 cooldowns.
 
-Three cooperating parts:
+Four cooperating parts:
 
 * :class:`ServiceRegistry` — TTL'd heartbeat/load-report store over the
   ``async_kv`` transport (one ``rset`` per replica per beat under
@@ -28,6 +28,14 @@ Three cooperating parts:
   Scale-down rides the rc-76 retirement contract the serving layer
   already has (``ModelServer.remove_replica`` — in-flight work finishes,
   then the mesh slice returns to the pool), so it is free.
+* :class:`WorkerSupervisor` — the cross-process lifecycle manager for
+  ``mxnet_tpu.fleet_worker`` processes behind the gateway
+  (docs/SHARDED_SERVING.md "Deployment"): spawns each worker with its
+  argv, restarts crashes with exponential backoff + jitter on a bounded
+  failure budget (rc-76 graceful drains restart free — the
+  :func:`~mxnet_tpu.elastic.supervise` semantics, in-process), times
+  death -> replacement into the ``fleet.failover_ms`` histogram, and
+  writes a postmortem debug bundle when crashes storm.
 
 Every decision is observable: ``fleet.replicas`` / ``fleet.shed_rate`` /
 ``fleet.p99_ms`` / ``fleet.free_slices`` gauges, the
@@ -43,6 +51,8 @@ blocking call (registry RPC, replica build/warm) runs with no lock held
 from __future__ import annotations
 
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -50,8 +60,10 @@ import time
 from . import chaos as _chaos
 from . import telemetry as _telemetry
 from .async_kv import AsyncKVClient, start_local_server
+from .elastic import PREEMPTED_EXIT_CODE, _backoff_delay
 
-__all__ = ["ServiceRegistry", "FleetView", "FleetSupervisor"]
+__all__ = ["ServiceRegistry", "FleetView", "FleetSupervisor",
+           "WorkerSupervisor"]
 
 # env-tunable defaults (docs/SHARDED_SERVING.md / docs/ENV_VARS.md)
 _DEF_HEARTBEAT_S = float(os.environ.get("MXTPU_FLEET_HEARTBEAT_S", "0.25"))
@@ -463,3 +475,267 @@ class FleetSupervisor:
                 _log("control tick failed: %s: %s"
                      % (type(e).__name__, e))
             self._stop_evt.wait(self.interval_s)
+
+
+# ---------------------------------------------------------------------------
+# cross-process worker supervision
+# ---------------------------------------------------------------------------
+class WorkerSupervisor:
+    """Spawn, monitor, and restart ``fleet_worker`` processes.
+
+    ``specs`` maps each worker id to the argv that (re)starts it, e.g.
+    ``{"w0": [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+    "--registry", addr, "--rid", "w0"]}``.  The monitor thread polls the
+    children and applies the :func:`~mxnet_tpu.elastic.supervise`
+    restart semantics in-process:
+
+    * **crash** (any rc except 0 / rc-76) — charged against the
+      per-worker ``max_restarts`` budget and respawned after
+      exponential backoff with jitter; a worker over budget (or exiting
+      a ``nonretryable`` code) is given up on and withdrawn.
+    * **rc-76 graceful drain** — respawned immediately, budget
+      untouched (a preempted worker did nothing wrong).
+    * **clean exit (rc 0)** — left down (it chose to stop).
+
+    Each respawn observes death -> replacement into the
+    ``fleet.failover_ms`` histogram and bumps ``fleet_worker_restarts``;
+    crashes that storm (3 within 30s across the fleet) write one
+    ``fleet_worker_crash_storm`` debug bundle.  The chaos kind
+    ``worker_kill@N`` SIGKILLs a live worker on the Nth monitor tick;
+    tests can also call :meth:`kill_worker` directly.
+
+    Lock-free like :class:`FleetSupervisor`: the monitor thread owns the
+    lifecycle state, public methods read plain attributes, and nothing
+    blocking ever runs under a lock.
+    """
+
+    def __init__(self, specs, registry=None, service="default",
+                 max_restarts=3, backoff=0.05, backoff_cap=8.0,
+                 poll_s=0.05, env=None, nonretryable=None, start=True):
+        if not isinstance(specs, dict):
+            specs = {"w%d" % i: argv for i, argv in enumerate(specs)}
+        self.specs = {str(rid): list(argv) for rid, argv in specs.items()}
+        self.registry = registry
+        self.service = service
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.poll_s = float(poll_s)
+        self._env = dict(env if env is not None else os.environ)
+        if nonretryable is None:
+            raw = self._env.get("MXTPU_NONRETRYABLE_EXIT_CODES", "")
+            nonretryable = {int(x) for x in raw.split(",") if x.strip()}
+        self.nonretryable = frozenset(nonretryable)
+
+        # monitor-thread state (plain attributes; snapshot() only reads)
+        self._procs = {}           # rid -> live Popen
+        self._incarnation = {rid: 0 for rid in self.specs}
+        self._failures = {rid: 0 for rid in self.specs}
+        self._died_at = {}         # rid -> monotonic death time
+        self._restart_at = {}      # rid -> earliest respawn time
+        self._given_up = set()
+        self._done = set()         # clean rc-0 exits
+        self._kill_seq = 0
+        self.restarts = 0
+        self.preemption_restarts = 0
+        self.kills = 0
+
+        from . import debug as _debug
+
+        self._storm = _debug.StormDetector(3, window_s=30.0)
+        _debug.add_section("worker_supervisor", self.snapshot)
+
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._monitor_loop,
+                                        name="fleet-worker-supervisor",
+                                        daemon=True)
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for rid in self.specs:
+            if rid not in self._procs:
+                self._spawn(rid)
+        if not self._thread.is_alive():
+            self._thread.start()
+        return self
+
+    def stop(self, timeout=15.0):
+        """Graceful shutdown: stop monitoring (no more restarts), then
+        SIGTERM every live worker (the rc-76 drain path) and SIGKILL
+        whatever outlives ``timeout``."""
+        self._stop_evt.set()
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + float(timeout)
+        for rid, proc in self._procs.items():
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                _log("worker %s ignored SIGTERM for %.1fs — SIGKILL"
+                     % (rid, timeout))
+                proc.kill()
+                proc.wait(timeout=5.0)
+        _log("worker supervisor stopped (%d restarts, %d free, "
+             "%d kills)" % (self.restarts, self.preemption_restarts,
+                            self.kills))
+
+    def snapshot(self):
+        return {
+            "workers": sorted(self.specs),
+            "alive": sorted(self.alive()),
+            "incarnation": dict(self._incarnation),
+            "failures": dict(self._failures),
+            "given_up": sorted(self._given_up),
+            "done": sorted(self._done),
+            "restarts": self.restarts,
+            "preemption_restarts": self.preemption_restarts,
+            "kills": self.kills,
+            "max_restarts": self.max_restarts,
+        }
+
+    def alive(self):
+        """Worker ids whose process is currently running."""
+        return [rid for rid, p in self._procs.items()
+                if p.poll() is None]
+
+    def pid(self, rid):
+        proc = self._procs.get(str(rid))
+        return None if proc is None else proc.pid
+
+    def kill_worker(self, rid=None, sig=signal.SIGKILL):
+        """SIGKILL a live worker (chaos ``worker_kill`` / tests).
+        Returns the killed rid, or None when nothing is running."""
+        live = sorted(self.alive())
+        if rid is None:
+            if not live:
+                return None
+            rid = live[0]
+        rid = str(rid)
+        proc = self._procs.get(rid)
+        if proc is None or proc.poll() is not None:
+            return None
+        try:
+            proc.send_signal(sig)
+        except OSError:
+            return None
+        self.kills += 1
+        _count("fleet_worker_kills")
+        _log("killed worker %s (pid %d, sig %d)"
+             % (rid, proc.pid, int(sig)))
+        return rid
+
+    def wait_registered(self, n, timeout=30.0):
+        """Block until ``n`` workers are live in the registry view (the
+        spawn -> register rendezvous).  Needs a ``registry``."""
+        if self.registry is None:
+            raise ValueError("wait_registered needs a registry")
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            try:
+                view = self.registry.view(reap=True)
+                if len(view) >= n:
+                    return view
+            except Exception:
+                pass              # registry still coming up
+            time.sleep(0.05)
+        raise TimeoutError("only %d/%d workers registered after %.1fs"
+                           % (len(self.registry.view(reap=False)), n,
+                              timeout))
+
+    # -- monitor -----------------------------------------------------------
+    def _spawn(self, rid):
+        inc = self._incarnation[rid]
+        env = {**self._env, "MXTPU_RESTART_COUNT": str(inc)}
+        self._procs[rid] = subprocess.Popen(self.specs[rid], env=env)
+        self._incarnation[rid] = inc + 1
+        self._restart_at.pop(rid, None)
+        died = self._died_at.pop(rid, None)
+        if died is not None:
+            dt_ms = (time.monotonic() - died) * 1e3
+            _telemetry.registry().histogram(
+                "fleet.failover_ms").observe(dt_ms)
+            self.restarts += 1
+            _count("fleet_worker_restarts")
+            _log("worker %s respawned (incarnation %d, pid %d, "
+                 "%.0fms after death)" % (rid, inc,
+                                          self._procs[rid].pid, dt_ms))
+
+    def _on_exit(self, rid, rc, now):
+        self._died_at[rid] = now
+        if rc == 0:
+            self._done.add(rid)
+            self._died_at.pop(rid, None)
+            _log("worker %s exited cleanly — not restarting" % rid)
+            return
+        if rc in self.nonretryable:
+            self._given_up.add(rid)
+            self._died_at.pop(rid, None)
+            _log("worker %s exited non-retryable rc=%d — giving up"
+                 % (rid, rc))
+            return
+        if rc == PREEMPTED_EXIT_CODE:
+            self.preemption_restarts += 1
+            self._restart_at[rid] = now     # free, immediate
+            _log("worker %s drained gracefully (rc=%d): restarting, "
+                 "budget untouched" % (rid, rc))
+            return
+        self._failures[rid] += 1
+        fails = self._failures[rid]
+        if fails > self.max_restarts:
+            self._given_up.add(rid)
+            self._died_at.pop(rid, None)
+            _log("worker %s failed %d times — budget exhausted"
+                 % (rid, fails))
+            from . import debug as _debug
+
+            _debug.write_bundle(
+                "fleet_worker_budget_exhausted",
+                extra={"rid": rid, "rc": rc, "failures": fails})
+            return
+        delay = _backoff_delay(fails, self.backoff, self.backoff_cap)
+        self._restart_at[rid] = now + delay
+        _count("fleet_worker_crashes")
+        _log("worker %s crashed rc=%d; restart %d/%d in %.2fs"
+             % (rid, rc, fails, self.max_restarts, delay))
+        if self._storm.hit():
+            from . import debug as _debug
+
+            _debug.write_bundle(
+                "fleet_worker_crash_storm",
+                extra={"rid": rid, "rc": rc,
+                       "snapshot": self.snapshot()})
+
+    def _tick(self, now):
+        if _chaos.worker_kill(self._kill_seq):
+            self.kill_worker()
+        self._kill_seq += 1
+        for rid, proc in list(self._procs.items()):
+            if rid in self._died_at or rid in self._given_up \
+                    or rid in self._done:
+                continue
+            rc = proc.poll()
+            if rc is not None:
+                self._on_exit(rid, rc, now)
+        for rid, t in list(self._restart_at.items()):
+            if now >= t and rid not in self._given_up:
+                self._spawn(rid)
+
+    def _monitor_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self._tick(time.monotonic())
+            except Exception as e:
+                # one bad tick must not end supervision
+                _log("worker-supervisor tick failed: %s: %s"
+                     % (type(e).__name__, e))
+            self._stop_evt.wait(self.poll_s)

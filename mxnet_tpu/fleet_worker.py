@@ -1,0 +1,500 @@
+"""Fleet worker process: one device-subset server behind the gateway.
+
+The cross-process half of the fleet layer (docs/SHARDED_SERVING.md
+"Deployment").  One worker process owns one device subset, builds a
+sharded :class:`~mxnet_tpu.serving.ModelServer` or
+:class:`~mxnet_tpu.generation.GenerationServer` from a ``--builder``
+factory, serves it over a slim stdlib HTTP/JSON endpoint, and publishes
+TTL'd load reports (including its serving address) into the async-KV
+service registry every heartbeat — the gateway routes on nothing else.
+
+Contracts this entrypoint honors:
+
+* **rc-76 graceful drain** — SIGTERM/SIGINT installs the shared
+  :func:`~mxnet_tpu.elastic.install_preemption_drain` flow: admission
+  closes immediately, in-flight work finishes, the registry entry is
+  withdrawn, and the process exits :data:`PREEMPTED_EXIT_CODE` so the
+  :class:`~mxnet_tpu.fleet.WorkerSupervisor` restarts it for free.
+* **rc-77 retryable** — any poisoned-state escalation (or plain crash)
+  exits nonzero and is restarted on the supervisor's charged failure
+  budget with backoff + jitter.
+* **registry partition tolerance** — a failed heartbeat publish is
+  counted and retried next beat (the transport already retries); when
+  the partition heals, the next successful beat re-registers and the
+  fleet view self-heals (TTL lapse -> reap -> re-register, the
+  ``registry_stale`` contract).
+* **idempotency** — requests carry an idempotency key; a key already
+  executing or executed on this worker replays the stored outcome
+  instead of double-executing, so a gateway retry after a lost reply is
+  safe.
+
+HTTP surface (JSON bodies; one typed terminal outcome per request):
+
+* ``POST /v1/predict``  — ``{"inputs": {name: nested-list}, ...}`` ->
+  ``{"outputs": [...]}`` or ``{"error": <ServingError name>}``.
+* ``POST /v1/generate`` — ``{"prompt": [ids], ...}`` -> a streamed
+  NDJSON body: one ``{"token": t}`` line per generated token, then a
+  terminal ``{"done": true, ...}`` or ``{"error": ...}`` line.
+* ``GET /healthz``      — worker snapshot (state, inflight, beats).
+
+Env knobs (``MXTPU_FLEET_WORKER_*``, docs/ENV_VARS.md): heartbeat
+period, idempotency-cache size, default deadline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+__all__ = ["FleetWorker", "demo_model", "demo_generation", "main"]
+
+_DEF_HEARTBEAT_S = float(os.environ.get(
+    "MXTPU_FLEET_WORKER_HEARTBEAT_S", "0.25"))
+_DEF_IDEM_CACHE = int(os.environ.get(
+    "MXTPU_FLEET_WORKER_IDEM_CACHE", "1024"))
+_DEF_DEADLINE_MS = float(os.environ.get(
+    "MXTPU_FLEET_WORKER_DEADLINE_MS", "30000"))
+
+
+def _log(msg):
+    print("[fleet-worker] %s" % msg, file=sys.stderr, flush=True)
+
+
+def _count(name, delta=1):
+    from . import profiler as _prof
+
+    _prof.dispatch_count(name, delta)
+
+
+# error type name -> HTTP status (the gateway keys retries off these)
+_ERROR_STATUS = {
+    "Overloaded": 429,
+    "DeadlineExceeded": 504,
+    "Draining": 503,
+    "Unavailable": 503,
+    "ReplicaLost": 502,
+}
+
+
+class _IdemEntry:
+    """One idempotency-key slot: pending until the owner settles it."""
+
+    __slots__ = ("event", "status", "body", "lines")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.status = None
+        self.body = None       # JSON-able dict (predict) or None
+        self.lines = None      # list of NDJSON lines (generate) or None
+
+    def settle(self, status, body=None, lines=None):
+        self.status, self.body, self.lines = status, body, lines
+        self.event.set()
+
+
+class FleetWorker:
+    """One worker process's runtime: HTTP endpoint + registry heartbeat
+    around a built ``ModelServer``/``GenerationServer``.
+
+    The server object is only touched through its own locked public
+    surface; worker state is plain attributes plus one small lock around
+    the idempotency dict (never held across anything blocking — the
+    CC001 discipline, same as the fleet supervisor)."""
+
+    def __init__(self, server, rid, registry=None, registry_addr=None,
+                 service="default", host="127.0.0.1", port=0,
+                 heartbeat_s=None, idem_cache=None):
+        from .fleet import ServiceRegistry
+
+        self.server = server
+        self.rid = str(rid)
+        self.kind = ("generate"
+                     if type(server).__name__ == "GenerationServer"
+                     else "predict")
+        self.registry = registry if registry is not None else \
+            ServiceRegistry(addr=registry_addr, service=service)
+        self.heartbeat_s = _DEF_HEARTBEAT_S if heartbeat_s is None \
+            else float(heartbeat_s)
+        self.beats = 0
+        self.beats_failed = 0
+        self.requests = 0
+        self.idem_replays = 0
+        self._beat_seq = 0
+        self._idem = OrderedDict()
+        self._idem_cap = _DEF_IDEM_CACHE if idem_cache is None \
+            else int(idem_cache)
+        self._idem_lock = threading.Lock()
+        self._drain_evt = threading.Event()
+        self._stop_evt = threading.Event()
+        self._preemption = None
+
+        self.httpd = self._make_httpd(host, port)
+        self.port = self.httpd.server_address[1]
+        self.addr = "%s:%d" % (host, self.port)
+        self._threads = [
+            threading.Thread(target=self.httpd.serve_forever,
+                             name="worker-http", daemon=True),
+            threading.Thread(target=self._heartbeat_loop,
+                             name="worker-heartbeat", daemon=True),
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for t in self._threads:
+            if not t.is_alive():
+                t.start()
+        _log("worker %s (%s) serving on %s" % (self.rid, self.kind,
+                                               self.addr))
+        return self
+
+    def install_drain(self, handler=None):
+        """Shared rc-76 wiring: the first SIGTERM/SIGINT sets the drain
+        flag (async-signal safe), the main loop finishes the job."""
+        from .elastic import install_preemption_drain
+
+        self._preemption = install_preemption_drain(self._drain_evt.set,
+                                                    handler=handler)
+        return self._preemption
+
+    def run(self):
+        """Serve until a drain signal, then withdraw + drain + exit 76."""
+        self.start()
+        while not self._drain_evt.wait(0.1):
+            pass
+        self.shutdown(drain_timeout=60)
+        if self._preemption is not None:
+            self._preemption.drain()          # exits rc 76
+
+    def shutdown(self, drain_timeout=30):
+        """Withdraw from the registry, drain the server, stop serving."""
+        self._stop_evt.set()
+        try:
+            self.registry.withdraw(self.rid)
+        except Exception:
+            pass                  # registry may be partitioned/gone
+        self.server.drain(timeout=drain_timeout)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for t in self._threads:
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=5.0)
+
+    def snapshot(self):
+        from . import profiler as _prof
+
+        snap = self.server.snapshot()
+        if self.kind == "generate":
+            inflight = snap.get("pending", 0) + snap.get("active", 0)
+        else:
+            inflight = sum(r["inflight"] for r in snap["replicas"]) \
+                + snap.get("queue_depth", 0)
+        return {"rid": self.rid, "kind": self.kind, "addr": self.addr,
+                "pid": os.getpid(), "state": snap["state"],
+                "inflight": inflight, "beats": self.beats,
+                "beats_failed": self.beats_failed,
+                "requests": self.requests,
+                "idem_replays": self.idem_replays,
+                # the zero-recompile assertion reaches across the
+                # process boundary through /healthz
+                "recompiles": _prof.dispatch_value("recompile")}
+
+    # -- heartbeat ---------------------------------------------------------
+    def _heartbeat_loop(self):
+        while not self._stop_evt.is_set():
+            beat = self._beat_seq
+            self._beat_seq += 1
+            try:
+                snap = self.snapshot()
+                snap["beat"] = beat
+                self.registry.publish(self.rid, snap)
+                self.beats += 1
+                _count("fleet_worker_beats")
+            except Exception as e:
+                # a partitioned registry must not kill the worker: keep
+                # serving, re-register on the next successful beat
+                self.beats_failed += 1
+                _count("fleet_worker_beats_failed")
+                _log("heartbeat %d failed (%s: %s) — will re-register "
+                     "on heal" % (beat, type(e).__name__, e))
+            self._stop_evt.wait(self.heartbeat_s)
+
+    # -- idempotency -------------------------------------------------------
+    def _idem_claim(self, key):
+        """(entry, owner): owner=True means this thread must execute and
+        settle the entry; False means replay/wait on it."""
+        with self._idem_lock:
+            ent = self._idem.get(key)
+            if ent is not None:
+                return ent, False
+            ent = _IdemEntry()
+            self._idem[key] = ent
+            while len(self._idem) > self._idem_cap:
+                self._idem.popitem(last=False)
+            return ent, True
+
+    def _idem_forget(self, key):
+        """Drop a pre-admission rejection so a later retry can succeed."""
+        with self._idem_lock:
+            self._idem.pop(key, None)
+
+    # -- request handling --------------------------------------------------
+    def _handle_predict(self, body):
+        from . import serving
+
+        key = body.get("idempotency_key")
+        ent = owner = None
+        if key:
+            ent, owner = self._idem_claim(key)
+            if not owner:
+                ent.event.wait(timeout=_DEF_DEADLINE_MS / 1e3)
+                self.idem_replays += 1
+                _count("fleet_worker_idem_replays")
+                return ent.status or 500, dict(ent.body or
+                                               {"error": "Unavailable"})
+        try:
+            inputs = {name: np.asarray(v, np.float32)
+                      for name, v in dict(body["inputs"]).items()}
+            out = self.server.submit(
+                inputs, deadline_ms=body.get("deadline_ms"))
+            resp = {"outputs": [np.asarray(o).tolist() for o in out],
+                    "rid": self.rid}
+            status = 200
+            if ent is not None:
+                ent.settle(status, body=resp)
+        except serving.ServingError as e:
+            resp = {"error": type(e).__name__, "message": str(e),
+                    "rid": self.rid}
+            status = _ERROR_STATUS.get(type(e).__name__, 500)
+            if ent is not None:
+                if isinstance(e, (serving.Overloaded, serving.Draining)):
+                    # pre-admission rejection: nothing executed, a retry
+                    # elsewhere/later must not replay the rejection
+                    ent.settle(status, body=resp)
+                    self._idem_forget(key)
+                else:
+                    ent.settle(status, body=resp)
+        except Exception as e:
+            resp = {"error": "Internal", "message": "%s: %s"
+                    % (type(e).__name__, e), "rid": self.rid}
+            status = 500
+            if ent is not None:
+                ent.settle(status, body=resp)
+                self._idem_forget(key)
+        return status, resp
+
+    def _handle_generate(self, body, write_line):
+        """Run one generation request, streaming one NDJSON line per
+        token through ``write_line``.  Returns the list of lines (for
+        idempotent replay) — the last line is the typed terminal."""
+        from . import serving
+
+        key = body.get("idempotency_key")
+        ent = owner = None
+        if key:
+            ent, owner = self._idem_claim(key)
+            if not owner:
+                ent.event.wait(timeout=_DEF_DEADLINE_MS / 1e3)
+                self.idem_replays += 1
+                _count("fleet_worker_idem_replays")
+                for line in (ent.lines or
+                             [{"error": "Unavailable", "rid": self.rid}]):
+                    write_line(line)
+                return
+        lines = []
+
+        def emit(line):
+            lines.append(line)
+            write_line(line)
+
+        try:
+            fut = self.server.submit_async(
+                np.asarray(body["prompt"], np.int32),
+                max_new_tokens=body.get("max_new_tokens"),
+                deadline_ms=body.get("deadline_ms"),
+                temperature=body.get("temperature"),
+                top_k=body.get("top_k"),
+                seed=body.get("seed"))
+        except serving.ServingError as e:
+            emit({"error": type(e).__name__, "message": str(e),
+                  "rid": self.rid})
+            if ent is not None:
+                ent.settle(_ERROR_STATUS.get(type(e).__name__, 500),
+                           lines=lines)
+                self._idem_forget(key)     # pre-admission: retryable
+            return
+        try:
+            n = 0
+            for tok in fut.tokens(timeout=_DEF_DEADLINE_MS / 1e3):
+                n += 1
+                emit({"token": int(tok)})
+            emit({"done": True, "tokens": n, "rid": self.rid})
+            if ent is not None:
+                ent.settle(200, lines=lines)
+        except serving.ServingError as e:
+            emit({"error": type(e).__name__, "message": str(e),
+                  "rid": self.rid})
+            if ent is not None:
+                ent.settle(_ERROR_STATUS.get(type(e).__name__, 500),
+                           lines=lines)
+        except Exception as e:
+            emit({"error": "Internal", "message": "%s: %s"
+                  % (type(e).__name__, e), "rid": self.rid})
+            if ent is not None:
+                ent.settle(500, lines=lines)
+                self._idem_forget(key)
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _make_httpd(self, host, port):
+        worker = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _json(self, status, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, worker.snapshot())
+                else:
+                    self._json(404, {"error": "NotFound"})
+
+            def do_POST(self):
+                worker.requests += 1
+                _count("fleet_worker_requests")
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError) as e:
+                    self._json(400, {"error": "BadRequest",
+                                     "message": str(e)})
+                    return
+                if self.path == "/v1/predict" \
+                        and worker.kind == "predict":
+                    status, resp = worker._handle_predict(body)
+                    self._json(status, resp)
+                elif self.path == "/v1/generate" \
+                        and worker.kind == "generate":
+                    # streamed NDJSON: no Content-Length, one JSON line
+                    # per token, connection close marks the end
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.end_headers()
+
+                    def write_line(obj):
+                        self.wfile.write(
+                            (json.dumps(obj) + "\n").encode())
+                        self.wfile.flush()
+
+                    try:
+                        worker._handle_generate(body, write_line)
+                    except OSError:
+                        pass      # client went away mid-stream
+                else:
+                    self._json(404, {"error": "NotFound",
+                                     "message": "no %s on a %s worker"
+                                     % (self.path, worker.kind)})
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+        class _Srv(ThreadingHTTPServer):
+            daemon_threads = True
+            # the stdlib default backlog (5) resets connections when the
+            # gateway retries a burst into one surviving worker
+            request_queue_size = 128
+
+        return _Srv((host, port), _Handler)
+
+
+# ---------------------------------------------------------------------------
+# demo builders (tiny CPU-oracle models: spawn tests, bench, smoke)
+# ---------------------------------------------------------------------------
+def demo_model():
+    """Tiny FC ModelServer (the tests/serving_worker.py model)."""
+    import mxnet_tpu as mx
+    from .serving import ModelServer
+
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=5, name="fc")
+    rng = np.random.RandomState(3)
+    params = {"arg:fc_weight": mx.nd.array(rng.rand(5, 4)
+                                           .astype(np.float32)),
+              "arg:fc_bias": mx.nd.zeros((5,))}
+    return ModelServer(out, params, input_shapes={"data": (1, 4)},
+                       max_queue=64, max_batch=4, max_wait_ms=20,
+                       deadline_ms=30_000)
+
+
+def demo_generation():
+    """Tiny transformer GenerationServer (the tests/test_generation.py
+    model) for streamed-decode spawn tests."""
+    import jax
+
+    from .generation import GenerationConfig, GenerationServer
+    from .models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_len=64,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    gcfg = GenerationConfig(page_size=8, max_pages=64, max_slots=4,
+                            max_new_tokens=16)
+    return GenerationServer(model, params, gcfg)
+
+
+def _resolve_builder(spec):
+    """``module:function`` -> the zero-arg server factory."""
+    import importlib
+
+    mod, _, fn = str(spec).partition(":")
+    return getattr(importlib.import_module(mod), fn or "build")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.fleet_worker",
+        description="fleet worker process (docs/SHARDED_SERVING.md)")
+    ap.add_argument("--registry", required=True,
+                    help="async-KV registry address host:port")
+    ap.add_argument("--service", default="default")
+    ap.add_argument("--rid", required=True,
+                    help="replica id to register under")
+    ap.add_argument("--builder",
+                    default="mxnet_tpu.fleet_worker:demo_model",
+                    help="module:function returning the server to host")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--heartbeat-s", type=float, default=None)
+    ap.add_argument("--ttl-s", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    from .fleet import ServiceRegistry
+
+    server = _resolve_builder(args.builder)()
+    registry = ServiceRegistry(addr=args.registry, service=args.service,
+                               ttl_s=args.ttl_s)
+    worker = FleetWorker(server, args.rid, registry=registry,
+                         host=args.host, port=args.port,
+                         heartbeat_s=args.heartbeat_s)
+    worker.install_drain()
+    worker.run()                    # returns only via the rc-76 exit
+    raise SystemExit("fleet worker run loop ended without drain")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,459 @@
+"""Front-door HTTP/JSON gateway for the cross-process fleet.
+
+The network half of docs/SHARDED_SERVING.md "Deployment": a slim stdlib
+``ThreadingHTTPServer`` (the ``/metrics`` endpoint pattern) that routes
+every request to the least-loaded live worker and owns the failover
+contract, so clients see exactly one typed terminal outcome per admitted
+request no matter which worker dies underneath them.
+
+Routing (``_pick``):
+
+* candidates come from the last :class:`~mxnet_tpu.fleet.FleetView`
+  refresh — workers that published an ``addr`` and report ``SERVING``;
+* **least-loaded** — reported ``inflight`` plus the gateway's own
+  in-flight count per worker (reports lag a heartbeat);
+* **breaker-aware** — a worker reporting an ``OPEN`` breaker is skipped;
+* **session affinity** — a generation request carrying ``session``
+  sticks to the worker holding its KV pages;
+* workers that just failed a connection are *suspect* for a short
+  window, so the gateway routes around a corpse the (possibly stale)
+  view still lists.
+
+Partition tolerance: the refresh loop polls the registry every
+``MXTPU_GATE_REFRESH_S``; when the registry is unreachable (or the
+``gateway_partition`` chaos kind fires) the gateway keeps serving from
+the **last-known-good view**, marks responses ``X-Fleet-Stale: 1``, and
+re-syncs on the first successful refresh — the gateway-side half of the
+``registry_stale`` self-healing contract.
+
+Failover: every request gets an idempotency key (client-supplied or
+generated), so a retry on another worker never double-executes — the
+worker replays its stored outcome for a duplicate key.  A connection
+that dies **before any token streamed** is idempotent prefill-phase
+work and is retried on another worker (``gateway_retries``); a
+generation stream that dies **mid-decode** is not resumable (the KV
+pages died with the worker) and terminates with one typed
+``ReplicaLost`` outcome (``gateway_stream_lost``).
+
+Surface: ``POST /v1/predict`` (JSON in/out, typed errors as statuses),
+``POST /v1/generate`` (NDJSON stream; the terminal line is the typed
+outcome), ``GET /v1/fleet`` (view + staleness), ``GET /healthz``.
+
+Telemetry: the ``gateway.route_ms`` histogram (admission -> request
+handed to a worker) and ``gateway_requests`` / ``gateway_retries`` /
+``gateway_stream_lost`` / ``gateway_registry_errors`` counters.
+
+Threading: refresh loop and handler threads share plain attributes;
+the only lock guards the in-flight/session dicts and is never held
+across anything blocking (the CC001 discipline).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import chaos as _chaos
+from . import telemetry as _telemetry
+
+__all__ = ["Gateway"]
+
+# env-tunable defaults (docs/ENV_VARS.md)
+_DEF_REFRESH_S = float(os.environ.get("MXTPU_GATE_REFRESH_S", "0.25"))
+_DEF_RETRIES = int(os.environ.get("MXTPU_GATE_RETRIES", "2"))
+_DEF_TIMEOUT_S = float(os.environ.get("MXTPU_GATE_TIMEOUT_S", "60"))
+_DEF_SUSPECT_S = float(os.environ.get("MXTPU_GATE_SUSPECT_S", "2.0"))
+_DEF_SESSION_CAP = int(os.environ.get("MXTPU_GATE_SESSION_CAP", "4096"))
+
+
+def _log(msg):
+    print("[gateway] %s" % msg, file=sys.stderr, flush=True)
+
+
+def _count(name, delta=1):
+    from . import profiler as _prof
+
+    _prof.dispatch_count(name, delta)
+
+
+class Gateway:
+    """Route requests across registered fleet workers (one instance =
+    one HTTP listener + one registry refresh loop)."""
+
+    def __init__(self, registry=None, registry_addr=None,
+                 service="default", host="127.0.0.1", port=0,
+                 refresh_s=None, retries=None, timeout_s=None,
+                 suspect_s=None, start=True):
+        from .fleet import ServiceRegistry
+
+        self.registry = registry if registry is not None else \
+            ServiceRegistry(addr=registry_addr, service=service)
+        self.refresh_s = _DEF_REFRESH_S if refresh_s is None \
+            else float(refresh_s)
+        self.retries = _DEF_RETRIES if retries is None else int(retries)
+        self.timeout_s = _DEF_TIMEOUT_S if timeout_s is None \
+            else float(timeout_s)
+        self.suspect_s = _DEF_SUSPECT_S if suspect_s is None \
+            else float(suspect_s)
+
+        # refresh state: plain attributes (single writer, GIL-atomic)
+        self._view = None
+        self._view_at = None
+        self._refresh_failures = 0
+        self._refresh_seq = 0
+        self.refreshes = 0
+        self.requests = 0
+        self.retried = 0
+        self.streams_lost = 0
+
+        self._lock = threading.Lock()      # sessions + local inflight
+        self._sessions = OrderedDict()     # session -> rid
+        self._inflight = {}                # rid -> gateway-local count
+        self._suspect = {}                 # rid -> monotonic expiry
+
+        self.httpd = self._make_httpd(host, port)
+        self.port = self.httpd.server_address[1]
+        self.addr = "%s:%d" % (host, self.port)
+        self._stop_evt = threading.Event()
+        self._threads = [
+            threading.Thread(target=self.httpd.serve_forever,
+                             name="gateway-http", daemon=True),
+            threading.Thread(target=self._refresh_loop,
+                             name="gateway-refresh", daemon=True),
+        ]
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for t in self._threads:
+            if not t.is_alive():
+                t.start()
+        _log("gateway for service %r on %s"
+             % (self.registry.service, self.addr))
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=5.0)
+
+    @property
+    def stale(self):
+        """True while serving from a last-known-good view (the registry
+        has been unreachable since the last successful refresh)."""
+        return self._refresh_failures > 0
+
+    def view_age_s(self):
+        return None if self._view_at is None \
+            else time.monotonic() - self._view_at
+
+    def snapshot(self):
+        view = self._view
+        return {"addr": self.addr, "stale": self.stale,
+                "view_age_s": self.view_age_s(),
+                "refreshes": self.refreshes,
+                "refresh_failures": self._refresh_failures,
+                "requests": self.requests, "retried": self.retried,
+                "streams_lost": self.streams_lost,
+                "workers": sorted(view.replicas) if view is not None
+                else [],
+                "sessions": len(self._sessions)}
+
+    # -- registry refresh --------------------------------------------------
+    def _refresh_loop(self):
+        reg = _telemetry.registry()
+        while not self._stop_evt.is_set():
+            n = self._refresh_seq
+            self._refresh_seq += 1
+            try:
+                if _chaos.gateway_partition(n):
+                    raise ConnectionError(
+                        "chaos: gateway partitioned from registry")
+                view = self.registry.view(reap=True)
+                self._view = view
+                self._view_at = time.monotonic()
+                if self._refresh_failures:
+                    _log("registry healed after %d failed refreshes "
+                         "(%d workers live)"
+                         % (self._refresh_failures, len(view)))
+                self._refresh_failures = 0
+                self.refreshes += 1
+                reg.gauge("gateway.workers").set(len(view))
+            except Exception as e:
+                # partition: keep routing from the last-known-good view
+                self._refresh_failures += 1
+                _count("gateway_registry_errors")
+                if self._refresh_failures == 1:
+                    _log("registry unreachable (%s: %s) — serving from "
+                         "last-known-good view"
+                         % (type(e).__name__, e))
+            reg.gauge("gateway.stale").set(1 if self.stale else 0)
+            self._stop_evt.wait(self.refresh_s)
+
+    # -- routing -----------------------------------------------------------
+    def _note_suspect(self, rid):
+        with self._lock:
+            self._suspect[rid] = time.monotonic() + self.suspect_s
+
+    def _track(self, rid, delta):
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + delta
+
+    def _pick(self, session=None, exclude=()):
+        """(rid, addr) of the routing choice, or None when no live
+        candidate exists."""
+        view = self._view
+        if view is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            suspect = {r for r, t in self._suspect.items() if t > now}
+            local = dict(self._inflight)
+            sticky = self._sessions.get(session) if session else None
+        cands = []
+        for rid, rep in view.replicas.items():
+            if rid in exclude or rid in suspect:
+                continue
+            addr = rep.get("addr")
+            if not addr or rep.get("breaker") == "OPEN":
+                continue
+            if rep.get("state") not in (None, "SERVING"):
+                continue
+            cands.append((rep.get("inflight", 0) + local.get(rid, 0),
+                          rid, addr))
+        if not cands:
+            return None
+        if sticky is not None:
+            for _, rid, addr in cands:
+                if rid == sticky:
+                    return rid, addr
+        cands.sort()
+        _, rid, addr = cands[0]
+        if session:
+            with self._lock:
+                self._sessions[session] = rid
+                while len(self._sessions) > _DEF_SESSION_CAP:
+                    self._sessions.popitem(last=False)
+        return rid, addr
+
+    def _connect(self, addr, path, payload, t0):
+        """Open a connection and send one POST; observing the routing
+        overhead (admission -> request handed to the worker)."""
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.timeout_s)
+        conn.request("POST", path, body=payload,
+                     headers={"Content-Type": "application/json"})
+        _telemetry.registry().histogram("gateway.route_ms").observe(
+            (time.monotonic() - t0) * 1e3)
+        return conn
+
+    # -- predict path ------------------------------------------------------
+    def _forward_predict(self, payload, t0):
+        """(status, body_bytes, rid, stale) — exactly one terminal
+        outcome; retries idempotent work across workers."""
+        excluded = []
+        attempt = 0
+        while True:
+            picked = self._pick(exclude=excluded)
+            if picked is None:
+                return 503, json.dumps(
+                    {"error": "Unavailable",
+                     "message": "no live worker (tried %s)"
+                     % (excluded or "none")}).encode(), None
+            rid, addr = picked
+            self._track(rid, 1)
+            try:
+                conn = self._connect(addr, "/v1/predict", payload, t0)
+                resp = conn.getresponse()
+                data = resp.read()
+                status = resp.status
+                conn.close()
+            except OSError as e:
+                # connection-level failure: the worker is gone; the
+                # idempotency key makes a retry elsewhere safe
+                self._note_suspect(rid)
+                excluded.append(rid)
+                attempt += 1
+                self.retried += 1
+                _count("gateway_retries")
+                _log("worker %s failed mid-predict (%s: %s) — "
+                     "retrying elsewhere" % (rid, type(e).__name__, e))
+                if attempt > self.retries:
+                    return 503, json.dumps(
+                        {"error": "Unavailable",
+                         "message": "retries exhausted after %s"
+                         % excluded}).encode(), None
+                continue
+            finally:
+                self._track(rid, -1)
+            if status in (429, 503) and attempt < self.retries \
+                    and len(self._view.replicas) > len(excluded) + 1:
+                # shed/draining on that worker: spill to a sibling
+                excluded.append(rid)
+                attempt += 1
+                self.retried += 1
+                _count("gateway_retries")
+                continue
+            return status, data, rid
+
+    # -- generate path (streamed) ------------------------------------------
+    def _forward_generate(self, body, write_line, t0):
+        """Stream one generation request; the last line written is the
+        one typed terminal outcome."""
+        session = body.get("session")
+        payload = json.dumps(body).encode()
+        excluded = []
+        attempt = 0
+        while True:
+            picked = self._pick(session=session, exclude=excluded)
+            if picked is None:
+                write_line({"error": "Unavailable",
+                            "message": "no live worker (tried %s)"
+                            % (excluded or "none")})
+                return
+            rid, addr = picked
+            self._track(rid, 1)
+            streamed = 0
+            try:
+                conn = self._connect(addr, "/v1/generate", payload, t0)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise OSError("worker %s: HTTP %d"
+                                  % (rid, resp.status))
+                first = True
+                while True:
+                    raw = resp.readline()
+                    if not raw:
+                        # a healthy stream ends with a terminal line,
+                        # never bare EOF — the worker died (SIGKILL can
+                        # look like a clean close, not a reset)
+                        raise OSError("worker %s closed the stream "
+                                      "with no terminal line" % rid)
+                    line = json.loads(raw)
+                    if first and not streamed \
+                            and line.get("error") in ("Overloaded",
+                                                      "Draining") \
+                            and attempt < self.retries:
+                        # pre-admission rejection: spill to a sibling
+                        raise OSError("worker %s shed: %s"
+                                      % (rid, line["error"]))
+                    first = False
+                    streamed += 1
+                    write_line(line)
+                    if "done" in line or "error" in line:
+                        break
+                conn.close()
+                return
+            except (OSError, ValueError) as e:
+                self._note_suspect(rid)
+                excluded.append(rid)
+                if streamed > 0:
+                    # mid-decode loss: the stream's KV pages died with
+                    # the worker — not resumable, one typed outcome
+                    self.streams_lost += 1
+                    _count("gateway_stream_lost")
+                    write_line({"error": "ReplicaLost",
+                                "message": "worker %s lost mid-stream "
+                                "after %d tokens (%s)"
+                                % (rid, streamed, e)})
+                    return
+                attempt += 1
+                self.retried += 1
+                _count("gateway_retries")
+                _log("worker %s failed pre-stream (%s: %s) — "
+                     "retrying elsewhere" % (rid, type(e).__name__, e))
+                if attempt > self.retries:
+                    write_line({"error": "Unavailable",
+                                "message": "retries exhausted after %s"
+                                % excluded})
+                    return
+            finally:
+                self._track(rid, -1)
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _make_httpd(self, host, port):
+        gw = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _json(self, status, obj):
+                data = obj if isinstance(obj, bytes) \
+                    else json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if gw.stale:
+                    self.send_header("X-Fleet-Stale", "1")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"ok": True, "stale": gw.stale})
+                elif self.path == "/v1/fleet":
+                    snap = gw.snapshot()
+                    view = gw._view
+                    snap["replicas"] = view.as_dict()["replicas"] \
+                        if view is not None else {}
+                    self._json(200, snap)
+                else:
+                    self._json(404, {"error": "NotFound"})
+
+            def do_POST(self):
+                t0 = time.monotonic()
+                gw.requests += 1
+                _count("gateway_requests")
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError) as e:
+                    self._json(400, {"error": "BadRequest",
+                                     "message": str(e)})
+                    return
+                # every request is retry-safe: give it an idempotency
+                # key unless the client brought its own
+                body.setdefault("idempotency_key",
+                                "gw-" + _telemetry.new_trace_id())
+                if self.path == "/v1/predict":
+                    status, data, rid = gw._forward_predict(
+                        json.dumps(body).encode(), t0)
+                    self._json(status, data)
+                elif self.path == "/v1/generate":
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    if gw.stale:
+                        self.send_header("X-Fleet-Stale", "1")
+                    self.end_headers()
+
+                    def write_line(obj):
+                        self.wfile.write(
+                            (json.dumps(obj) + "\n").encode())
+                        self.wfile.flush()
+
+                    try:
+                        gw._forward_generate(body, write_line, t0)
+                    except OSError:
+                        pass      # client went away mid-stream
+                else:
+                    self._json(404, {"error": "NotFound"})
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+        class _Srv(ThreadingHTTPServer):
+            daemon_threads = True
+            # the stdlib default backlog (5) resets connections under a
+            # burst of concurrent clients — the front door needs depth
+            request_queue_size = 128
+
+        return _Srv((host, port), _Handler)

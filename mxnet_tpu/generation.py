@@ -613,11 +613,10 @@ class GenerationServer:
         """Wire graceful drain into SIGTERM/SIGINT exactly like
         ``ModelServer.install_preemption_drain`` (rc-76 contract,
         docs/FAULT_TOLERANCE.md)."""
-        if handler is None:
-            from .elastic import PreemptionHandler
+        from .elastic import install_preemption_drain
 
-            handler = PreemptionHandler().install()
-        handler.add_callback(self._drain_flag.set)
+        handler = install_preemption_drain(self._drain_flag.set,
+                                           handler=handler)
         self._preemption = handler
         return handler
 

@@ -243,6 +243,14 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "fleet_scale_ups", "fleet_scale_downs",
                   "fleet_heartbeats", "fleet_heartbeats_dropped",
                   "fleet_reaped",
+                  # cross-process fleet: gateway + worker supervision
+                  # (docs/SHARDED_SERVING.md "Deployment")
+                  "fleet_worker_restarts", "fleet_worker_crashes",
+                  "fleet_worker_kills", "fleet_worker_beats",
+                  "fleet_worker_beats_failed", "fleet_worker_requests",
+                  "fleet_worker_idem_replays",
+                  "gateway_requests", "gateway_retries",
+                  "gateway_stream_lost", "gateway_registry_errors",
                   # diagnosis plane (docs/OBSERVABILITY.md): cost-capture
                   # failures behind mfu_source fallbacks, and postmortem
                   # bundles written by the debug plane

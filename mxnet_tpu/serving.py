@@ -75,7 +75,7 @@ from .async_kv import backoff_delay as _backoff_delay
 __all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
            "StreamingFuture",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
-           "Unavailable",
+           "Unavailable", "ReplicaLost",
            "STARTING", "SERVING", "DEGRADED", "DRAINING", "STOPPED"]
 
 # -- lifecycle states -------------------------------------------------------
@@ -137,6 +137,15 @@ class Draining(ServingError):
 
 class Unavailable(ServingError):
     """Every replica was tried for this request and failed."""
+
+
+class ReplicaLost(ServingError):
+    """The worker process holding this request died mid-execution and
+    the work is not safely resumable elsewhere (a generation stream past
+    its first token: the KV pages died with the worker).  Idempotent
+    prefill-phase work is retried on another worker instead — only
+    non-resumable in-flight requests surface this (gateway failover
+    contract, docs/SHARDED_SERVING.md "Deployment")."""
 
 
 # ---------------------------------------------------------------------------
@@ -753,11 +762,10 @@ class ModelServer:
         ``handler.requested`` / ``check()`` and calls
         ``handler.drain(server.drain)`` to finish in-flight work and
         exit with rc 76.  Returns the handler."""
-        if handler is None:
-            from .elastic import PreemptionHandler
+        from .elastic import install_preemption_drain
 
-            handler = PreemptionHandler().install()
-        handler.add_callback(self._drain_flag.set)
+        handler = install_preemption_drain(self._drain_flag.set,
+                                           handler=handler)
         self._preemption = handler
         return handler
 

@@ -135,6 +135,56 @@ def test_retries_exhausted_raises_connection_error(server):
         c.pull("w")
 
 
+def test_rlist_reaps_expired_rows_inline(server):
+    """``rlist`` on a prefix with dead entries must reap them inline —
+    never return an expired row, and not leave corpses for ``rreap``."""
+    c = _client(server)
+    c.registry_set("fleet/s/alive", {"x": 1}, ttl_s=30.0)
+    c.registry_set("fleet/s/dead1", {"x": 2}, ttl_s=0.05)
+    c.registry_set("fleet/s/dead2", {"x": 3}, ttl_s=0.05)
+    c.registry_set("other/keep", {"x": 4}, ttl_s=0.05)
+    time.sleep(0.1)
+    live = c.registry_list("fleet/s/")
+    assert sorted(live) == ["fleet/s/alive"]
+    # the expired matching rows were deleted server-side by the list
+    with server.lock:
+        assert sorted(server.registry) == ["fleet/s/alive", "other/keep"]
+    # nothing left under the prefix for the explicit reaper
+    assert c.registry_reap("fleet/s/") == []
+    # non-matching prefixes were untouched (reaped only on their own
+    # list/get/reap)
+    assert c.registry_reap("other/") == ["other/keep"]
+
+
+def test_partition_reconnect_exactly_once_reregister(server):
+    """Registry partition -> heal -> re-register, exactly once: a
+    publish whose reply is lost (and whose retransmit is duplicated) is
+    applied once, the view holds exactly one row per worker, and a full
+    connection loss re-registers cleanly on the next beat."""
+    c = _client(server)
+    key = "fleet/svc/w0"
+    c.registry_set(key, {"beat": 0}, ttl_s=30.0)
+
+    # lost reply + duplicated retransmit: the server's seq dedup must
+    # collapse it to one application, the client sees success
+    c._fi_drop_after_send.add(c._seq + 1)
+    c._fi_duplicate_send.add(c._seq + 1)
+    c.registry_set(key, {"beat": 1}, ttl_s=30.0)
+    view = c.registry_list("fleet/svc/")
+    assert sorted(view) == [key]
+    assert view[key][0] == {"beat": 1}
+
+    # hard partition: the live socket dies mid-session; the next beat
+    # reconnects and re-registers without error or duplication
+    c._sock.shutdown(socket.SHUT_RDWR)
+    c.registry_set(key, {"beat": 2}, ttl_s=30.0)
+    view = c.registry_list("fleet/svc/")
+    assert sorted(view) == [key]
+    assert view[key][0] == {"beat": 2}
+    with server.lock:
+        assert list(server.registry) == [key]
+
+
 def test_session_table_bounded():
     srv = _Server(("127.0.0.1", 0), reap_s=0.1)
     now = time.monotonic()

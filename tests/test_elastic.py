@@ -184,6 +184,32 @@ def test_preemption_handler_flag_and_check():
         ph.uninstall()
 
 
+def test_install_preemption_drain_shared_helper():
+    """The one shared drain-install helper (used by ModelServer,
+    GenerationServer, and FleetWorker): wires the flag into a handler,
+    reuses a caller-supplied handler instead of stacking installs, and
+    fires the callback on SIGTERM."""
+    import signal as _signal
+
+    from mxnet_tpu.elastic import install_preemption_drain
+
+    fired = []
+    ph = install_preemption_drain(lambda: fired.append("a"))
+    try:
+        # a second server sharing the same handler must NOT re-install
+        ph2 = install_preemption_drain(lambda: fired.append("b"),
+                                       handler=ph)
+        assert ph2 is ph
+        os.kill(os.getpid(), _signal.SIGTERM)
+        for _ in range(100):  # delivery lands at a bytecode boundary
+            if ph.requested:
+                break
+        assert ph.requested
+        assert sorted(fired) == ["a", "b"]
+    finally:
+        ph.uninstall()
+
+
 def test_backoff_delay_grows_and_caps():
     base, cap = 2.0, 30.0
     for failures, ideal in ((1, 2.0), (2, 4.0), (3, 8.0), (10, cap)):

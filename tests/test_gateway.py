@@ -1,0 +1,589 @@
+"""Cross-process fleet tests (mxnet_tpu/gateway.py + fleet_worker.py +
+fleet.WorkerSupervisor).
+
+The acceptance invariants (ISSUE 11):
+
+* a 2-process fleet behind the gateway survives ``worker_kill``
+  mid-stream and ``gateway_partition`` with every admitted request
+  receiving exactly one typed terminal outcome;
+* the killed worker is back in rotation within the supervisor's restart
+  budget;
+* the zero-recompile assertion still holds on the surviving worker
+  (read across the process boundary via ``/healthz``).
+
+The routing/idempotency/failover mechanics are covered in-process (fake
+views and fake NDJSON workers keep those deterministic and cheap); the
+acceptance scenario spawns real worker processes.
+"""
+import http.client
+import json
+import os
+import socketserver
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, profiler, serving, telemetry
+from mxnet_tpu.elastic import PREEMPTED_EXIT_CODE
+from mxnet_tpu.fleet import FleetView, ServiceRegistry, WorkerSupervisor
+from mxnet_tpu.gateway import Gateway
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conftest import subprocess_env  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _post(addr, path, obj, timeout=60):
+    """POST JSON to host:port, return (status, parsed-body, headers)."""
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(obj).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, json.loads(data or b"{}"), dict(resp.headers)
+    finally:
+        conn.close()
+
+
+def _get(addr, path, timeout=30):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _stream(addr, path, obj, timeout=60):
+    """POST and read the NDJSON body; returns the list of parsed lines
+    (bare EOF just ends the list — the terminal-line check is the
+    caller's assertion)."""
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    lines = []
+    try:
+        conn.request("POST", path, body=json.dumps(obj).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            lines.append(json.loads(raw))
+            if "done" in lines[-1] or "error" in lines[-1]:
+                break
+        return lines
+    finally:
+        conn.close()
+
+
+def _wait(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError("timed out waiting for %s" % msg)
+
+
+def _view(reports):
+    """FleetView from {rid: report-dict} with full TTL remaining."""
+    return FleetView("test", {rid: (rep, 1.0)
+                              for rid, rep in reports.items()})
+
+
+def _offline_gateway():
+    """Gateway with no threads running (routing unit tests drive
+    ``_pick`` directly against a hand-built view)."""
+
+    class _Reg:
+        service = "test"
+
+    gw = Gateway(registry=_Reg(), start=False,
+                 refresh_s=0.05, suspect_s=0.2)
+    return gw
+
+
+# ---------------------------------------------------------------------------
+# registration: chaos kinds, counters, typed error
+# ---------------------------------------------------------------------------
+def test_new_chaos_kinds_and_counters_registered():
+    assert "gateway_partition" in chaos.FAULT_KINDS
+    assert "worker_kill" in chaos.FAULT_KINDS
+    stats = profiler.dispatch_stats()
+    for key in ("fleet_worker_restarts", "fleet_worker_crashes",
+                "fleet_worker_kills", "fleet_worker_beats",
+                "fleet_worker_beats_failed", "fleet_worker_requests",
+                "fleet_worker_idem_replays", "gateway_requests",
+                "gateway_retries", "gateway_stream_lost",
+                "gateway_registry_errors"):
+        assert key in stats, key
+
+
+def test_replica_lost_is_typed_serving_error():
+    assert issubclass(serving.ReplicaLost, serving.ServingError)
+    assert "ReplicaLost" in serving.__all__
+    # no chaos plan active: the hooks are quiescent no-ops
+    assert not chaos.gateway_partition(0)
+    assert not chaos.worker_kill(0)
+
+
+# ---------------------------------------------------------------------------
+# routing: _pick unit tests against hand-built views
+# ---------------------------------------------------------------------------
+def test_pick_least_loaded_skips_breaker_and_non_serving():
+    gw = _offline_gateway()
+    try:
+        assert gw._pick() is None          # no view yet: nothing to route
+        gw._view = _view({
+            "w0": {"addr": "h:1", "inflight": 5},
+            "w1": {"addr": "h:2", "inflight": 1},
+            "w2": {"addr": "h:3", "inflight": 0, "breaker": "OPEN"},
+            "w3": {"addr": "h:4", "inflight": 0, "state": "DRAINING"},
+            "w4": {"inflight": 0},         # never published an addr
+        })
+        assert gw._pick() == ("w1", "h:2")
+        # gateway-local inflight counts on top of the reported load
+        gw._track("w1", 5)
+        assert gw._pick() == ("w0", "h:1")
+        # exclusion (a retry loop routing around a failure)
+        assert gw._pick(exclude=("w0", "w1")) is None
+    finally:
+        gw.httpd.server_close()
+
+
+def test_pick_session_affinity_and_suspect_window():
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"w0": {"addr": "h:1", "inflight": 9},
+                          "w1": {"addr": "h:2", "inflight": 0}})
+        # first pick binds the session to the least-loaded worker …
+        assert gw._pick(session="s1") == ("w1", "h:2")
+        # … and stays bound even when the load flips
+        gw._track("w1", 20)
+        assert gw._pick(session="s1") == ("w1", "h:2")
+        assert gw._pick() == ("w0", "h:1")
+        # a suspect worker is routed around until the window lapses
+        gw._note_suspect("w0")
+        gw._track("w1", -20)
+        assert gw._pick() == ("w1", "h:2")
+        time.sleep(gw.suspect_s + 0.05)
+        gw._track("w1", 20)
+        assert gw._pick() == ("w0", "h:1")
+    finally:
+        gw.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# worker: idempotent execute-once / replay
+# ---------------------------------------------------------------------------
+def test_worker_idempotent_replay_and_forget():
+    from mxnet_tpu.fleet_worker import FleetWorker, demo_model
+
+    reg = ServiceRegistry(service="idem")
+    server = demo_model()
+    worker = FleetWorker(server, "w0", registry=reg)   # threads not started
+    try:
+        body = {"inputs": {"data": [[1.0, 2.0, 3.0, 4.0]]},
+                "idempotency_key": "k1"}
+        st1, r1 = worker._handle_predict(dict(body))
+        assert st1 == 200 and r1["rid"] == "w0"
+        # the duplicate (a gateway retry after a lost reply) replays the
+        # stored outcome instead of executing again
+        st2, r2 = worker._handle_predict(dict(body))
+        assert (st2, r2) == (st1, r1)
+        assert worker.idem_replays == 1
+        # a failed execution is forgotten: the retry may execute anew
+        bad = {"inputs": {"data": "not-a-tensor"},
+               "idempotency_key": "k2"}
+        st3, r3 = worker._handle_predict(dict(bad))
+        assert st3 == 500 and r3["error"] == "Internal"
+        good = {"inputs": {"data": [[1.0, 1.0, 1.0, 1.0]]},
+                "idempotency_key": "k2"}
+        st4, r4 = worker._handle_predict(dict(good))
+        assert st4 == 200 and "outputs" in r4
+        assert worker.idem_replays == 1    # no replay: re-executed
+    finally:
+        worker.httpd.server_close()
+        server.drain(timeout=30)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# gateway <-> worker round trip, partition staleness (in-process)
+# ---------------------------------------------------------------------------
+def test_gateway_roundtrip_and_partition_staleness():
+    from mxnet_tpu.fleet_worker import FleetWorker, demo_model
+
+    reg = ServiceRegistry(service="rt", ttl_s=2.0)
+    server = demo_model()
+    worker = FleetWorker(server, "w0", registry=reg,
+                         heartbeat_s=0.05).start()
+    gw = Gateway(registry=reg, refresh_s=0.05, suspect_s=0.2)
+    try:
+        _wait(lambda: gw._view is not None and "w0" in gw._view.replicas,
+              msg="gateway to see w0")
+        x = np.ones((1, 4), np.float32)
+        rng = np.random.RandomState(3)          # the demo_model weights
+        wn = rng.rand(5, 4).astype(np.float32)
+        route_ms = telemetry.registry().histogram("gateway.route_ms")
+        n0 = route_ms.snapshot()["count"]
+        status, body, headers = _post(gw.addr, "/v1/predict",
+                                      {"inputs": {"data": x.tolist()}})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(body["outputs"][0]),
+                                   x @ wn.T, rtol=1e-5, atol=1e-5)
+        assert body["rid"] == "w0"
+        assert route_ms.snapshot()["count"] > n0   # overhead observed
+        assert "X-Fleet-Stale" not in headers
+
+        # partition the gateway from the registry for ~0.5s of refreshes:
+        # it must keep serving from the last-known-good view, marked stale
+        n = gw._refresh_seq + 1
+        spec = ",".join("gateway_partition@%d" % i for i in range(n, n + 10))
+        with chaos.inject(spec):
+            _wait(lambda: gw.stale, timeout=10, msg="gateway to go stale")
+            status, body, headers = _post(
+                gw.addr, "/v1/predict", {"inputs": {"data": x.tolist()}})
+            assert status == 200                # still serving
+            assert headers.get("X-Fleet-Stale") == "1"
+            _wait(lambda: not gw.stale, timeout=10,
+                  msg="gateway to heal")        # plan exhausted: re-sync
+        status, _, headers = _post(gw.addr, "/v1/predict",
+                                   {"inputs": {"data": x.tolist()}})
+        assert status == 200 and "X-Fleet-Stale" not in headers
+        assert gw.snapshot()["refresh_failures"] == 0
+    finally:
+        gw.stop()
+        worker.shutdown(drain_timeout=30)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# failover mechanics against fake NDJSON workers (deterministic)
+# ---------------------------------------------------------------------------
+class _FakeStreamWorker:
+    """Minimal NDJSON /v1/generate endpoint: streams ``tokens`` token
+    lines, then either a terminal line or a bare close (a SIGKILL'd
+    worker looks exactly like this — clean EOF, no reset)."""
+
+    def __init__(self, rid, tokens=3, die_mid_stream=False):
+        fake = self
+
+        class _H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                for t in range(fake.tokens):
+                    self.wfile.write(
+                        (json.dumps({"token": t}) + "\n").encode())
+                    self.wfile.flush()
+                if not fake.die_mid_stream:
+                    self.wfile.write((json.dumps(
+                        {"done": True, "tokens": fake.tokens,
+                         "rid": fake.rid}) + "\n").encode())
+
+            def log_message(self, *a):
+                pass
+
+        self.rid = rid
+        self.tokens = tokens
+        self.die_mid_stream = die_mid_stream
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.httpd.daemon_threads = True
+        self.addr = "127.0.0.1:%d" % self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_generate_mid_stream_death_is_one_typed_replica_lost():
+    """A stream that dies after the first token is NOT retried (the KV
+    pages died with the worker): the client sees the streamed prefix
+    plus exactly one typed ReplicaLost terminal line."""
+    dying = _FakeStreamWorker("d0", tokens=3, die_mid_stream=True)
+    healthy = _FakeStreamWorker("h0", tokens=2)
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"d0": {"addr": dying.addr, "inflight": 0},
+                          "h0": {"addr": healthy.addr, "inflight": 9}})
+        got = []
+        gw._forward_generate({"prompt": [1], "session": "s1"},
+                             got.append, time.monotonic())
+        assert [l for l in got if "token" in l] == [
+            {"token": 0}, {"token": 1}, {"token": 2}]
+        assert got[-1]["error"] == "ReplicaLost"
+        assert sum(1 for l in got if "error" in l) == 1
+        assert gw.streams_lost == 1
+        # the lost worker is suspect now; the same session re-routes to
+        # the survivor and completes normally
+        got2 = []
+        gw._forward_generate({"prompt": [1], "session": "s1"},
+                             got2.append, time.monotonic())
+        assert got2[-1] == {"done": True, "tokens": 2, "rid": "h0"}
+    finally:
+        gw.httpd.server_close()
+        dying.close()
+        healthy.close()
+
+
+def test_generate_pre_stream_failure_retries_elsewhere():
+    """A connection that dies before any token streamed is idempotent
+    prefill-phase work: retried on another worker, client sees one
+    normal stream."""
+    healthy = _FakeStreamWorker("h0", tokens=2)
+    # a dead address: connection refused before anything streams
+    sock = socketserver.TCPServer(("127.0.0.1", 0), None)
+    dead_addr = "127.0.0.1:%d" % sock.server_address[1]
+    sock.server_close()                       # port now refuses
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"dead": {"addr": dead_addr, "inflight": 0},
+                          "h0": {"addr": healthy.addr, "inflight": 9}})
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
+        assert got[-1] == {"done": True, "tokens": 2, "rid": "h0"}
+        assert gw.retried >= 1
+        assert gw.streams_lost == 0
+    finally:
+        gw.httpd.server_close()
+        healthy.close()
+
+
+# ---------------------------------------------------------------------------
+# WorkerSupervisor restart semantics (cheap non-framework children)
+# ---------------------------------------------------------------------------
+_SLEEPER = [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+def test_supervisor_crash_budget_backoff_and_clean_exit():
+    crasher = [sys.executable, "-c", "import sys; sys.exit(5)"]
+    cleaner = [sys.executable, "-c", "import sys; sys.exit(0)"]
+    sup = WorkerSupervisor({"bad": crasher, "ok": cleaner},
+                           max_restarts=2, backoff=0.01,
+                           backoff_cap=0.02, poll_s=0.01)
+    try:
+        _wait(lambda: "bad" in sup._given_up, timeout=30,
+              msg="crash budget to exhaust")
+        snap = sup.snapshot()
+        assert snap["failures"]["bad"] == 3       # budget(2) + the last
+        assert snap["restarts"] == 2              # charged respawns only
+        assert "ok" in snap["done"]               # rc 0: left down
+        assert "ok" not in snap["given_up"]
+        assert sup._incarnation["ok"] == 1        # never respawned
+    finally:
+        sup.stop(timeout=5.0)
+
+
+def test_supervisor_rc76_drain_restarts_for_free():
+    # incarnation 0 drains with rc-76 (a preemption); the respawn sleeps
+    drain_once = [sys.executable, "-c",
+                  "import os, sys, time\n"
+                  "if os.environ.get('MXTPU_RESTART_COUNT') == '0':\n"
+                  "    sys.exit(%d)\n"
+                  "time.sleep(60)\n" % PREEMPTED_EXIT_CODE]
+    sup = WorkerSupervisor({"w0": drain_once}, max_restarts=1,
+                           backoff=0.01, poll_s=0.01)
+    try:
+        _wait(lambda: sup.preemption_restarts == 1
+              and sup.alive() == ["w0"], timeout=30,
+              msg="free restart after rc-76")
+        assert sup._failures["w0"] == 0           # budget untouched
+        assert sup._incarnation["w0"] == 2
+    finally:
+        sup.stop(timeout=5.0)
+
+
+def test_supervisor_chaos_worker_kill_fires_and_respawns():
+    spec = ",".join("worker_kill@%d" % i for i in range(3))
+    with chaos.inject(spec):
+        sup = WorkerSupervisor({"w0": _SLEEPER}, max_restarts=5,
+                               backoff=0.01, backoff_cap=0.02,
+                               poll_s=0.01)
+        try:
+            _wait(lambda: sup.kills >= 1 and sup.restarts >= 1
+                  and sup.alive() == ["w0"], timeout=30,
+                  msg="chaos kill + respawn")
+            assert profiler.dispatch_stats()["fleet_worker_kills"] >= 1
+        finally:
+            sup.stop(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: spawned 2-process fleet, kill + partition
+# ---------------------------------------------------------------------------
+def _worker_argv(registry_addr, rid, builder=None):
+    argv = [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+            "--registry", registry_addr, "--service", "accept",
+            "--rid", rid, "--heartbeat-s", "0.1"]
+    if builder:
+        argv += ["--builder", builder]
+    return argv
+
+
+@pytest.mark.chaos
+def test_fleet_survives_worker_kill_and_gateway_partition():
+    """ISSUE 11 acceptance: a 2-process fleet behind the gateway
+    survives a mid-burst SIGKILL and a registry partition — every
+    admitted request gets exactly one typed terminal outcome, the killed
+    worker is back in rotation within the restart budget, and the
+    surviving worker reports zero new recompiles across the storm."""
+    reg = ServiceRegistry(service="accept", ttl_s=1.0)
+    sup = WorkerSupervisor(
+        {rid: _worker_argv(reg.addr, rid) for rid in ("w0", "w1")},
+        registry=reg, max_restarts=3, backoff=0.05, backoff_cap=0.5,
+        poll_s=0.05, env=subprocess_env())
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    outcomes = []
+    out_lock = threading.Lock()
+    try:
+        sup.wait_registered(2, timeout=180)     # cold framework import
+        _wait(lambda: gw._view is not None and len(gw._view.replicas) == 2,
+              timeout=30, msg="gateway to see both workers")
+
+        x = {"inputs": {"data": [[1.0, 2.0, 3.0, 4.0]]}}
+
+        def one_request():
+            try:
+                status, body, _ = _post(gw.addr, "/v1/predict", x,
+                                        timeout=90)
+                name = "ok" if status == 200 else body.get("error", "?")
+            except Exception as e:
+                name = "UNTYPED:%s" % type(e).__name__
+            with out_lock:
+                outcomes.append(name)
+
+        # warm both workers, then note the fleet's pids + recompile
+        # counts before the storm
+        for _ in range(6):
+            one_request()
+        assert outcomes.count("ok") >= 1
+        before = {rid: _get(rep["addr"], "/healthz")[1]
+                  for rid, rep in gw._view.replicas.items()}
+
+        # the burst, with a worker SIGKILLed and the gateway partitioned
+        # from the registry in the middle of it
+        threads = [threading.Thread(target=one_request)
+                   for _ in range(40)]
+        n = gw._refresh_seq + 1
+        spec = ",".join("gateway_partition@%d" % i
+                        for i in range(n, n + 8))
+        with chaos.inject(spec):
+            for i, t in enumerate(threads):
+                t.start()
+                if i == 10:
+                    killed = sup.kill_worker()
+                    assert killed in ("w0", "w1")
+            for t in threads:
+                t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+
+        # exactly one typed terminal outcome per admitted request
+        assert len(outcomes) == 46
+        assert not (set(outcomes) - {"ok", "Overloaded", "Draining",
+                                     "DeadlineExceeded", "Unavailable"}), \
+            outcomes
+        assert outcomes.count("ok") >= 30       # the fleet kept serving
+
+        # the killed worker is back in rotation: a NEW pid registered
+        # under the same rid within the restart budget
+        old_pid = before[killed]["pid"]
+        _wait(lambda: reg.view().replicas.get(killed, {})
+              .get("pid", old_pid) != old_pid, timeout=120,
+              msg="killed worker back in rotation")
+        assert sup.restarts >= 1
+        assert sup.snapshot()["failures"][killed] <= sup.max_restarts
+
+        # zero-recompile on the survivor, asserted across the process
+        # boundary: warm-path requests during the storm compiled nothing
+        survivor = "w1" if killed == "w0" else "w0"
+        _, after = _get(reg.view().replicas[survivor]["addr"], "/healthz")
+        assert after["recompiles"] == before[survivor]["recompiles"]
+        assert gw.retried >= 1                  # the kill forced a retry
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# generation stream failover across real processes (heavy: not tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_generation_stream_failover_across_processes():
+    """Mid-decode SIGKILL of a real generation worker: the client's
+    stream terminates with one typed ReplicaLost line and the same
+    session's next request completes on the survivor."""
+    reg = ServiceRegistry(service="accept", ttl_s=1.0)
+    builder = "mxnet_tpu.fleet_worker:demo_generation"
+    sup = WorkerSupervisor(
+        {rid: _worker_argv(reg.addr, rid, builder) for rid in
+         ("g0", "g1")},
+        registry=reg, max_restarts=3, backoff=0.05, poll_s=0.05,
+        env=subprocess_env())
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    try:
+        sup.wait_registered(2, timeout=300)
+        _wait(lambda: gw._view is not None and len(gw._view.replicas) == 2,
+              timeout=30, msg="gateway to see both workers")
+        req = {"prompt": [1, 2, 3], "max_new_tokens": 64,
+               "session": "s1"}
+        # warm the decode path end-to-end (first stream compiles)
+        lines = _stream(gw.addr, "/v1/generate",
+                        {**req, "max_new_tokens": 4}, timeout=300)
+        assert lines[-1].get("done") is True
+        first_rid = lines[-1]["rid"]
+
+        # stream again, killing the session's worker after 3 tokens
+        host, _, port = gw.addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=300)
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps(req).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        got = []
+        killed = None
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            got.append(json.loads(raw))
+            if len(got) == 3 and killed is None:
+                killed = sup.kill_worker(first_rid)
+            if "done" in got[-1] or "error" in got[-1]:
+                break
+        conn.close()
+        assert killed == first_rid
+        terminal = got[-1]
+        # either the kill landed mid-stream (ReplicaLost) or the tiny
+        # model finished the stream before the signal did (done) — both
+        # are single typed terminals; no bare EOF
+        assert ("error" in terminal and terminal["error"] == "ReplicaLost") \
+            or terminal.get("done") is True, got
+
+        # the same session re-routes and completes on a live worker
+        lines = _stream(gw.addr, "/v1/generate",
+                        {**req, "max_new_tokens": 4}, timeout=300)
+        assert lines[-1].get("done") is True
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()
