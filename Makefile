@@ -14,6 +14,7 @@
 #   make kernel-smoke-> Pallas kernel parity + interpret lane (docs/KERNELS.md)
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make gateway-smoke-> cross-process fleet lane: gateway + worker failover
+#   make sim-smoke  -> load replay + simulated fleet lane (docs/SIMULATION.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
 #   make ci         -> everything ci/runtime_functions.sh runs
@@ -57,6 +58,9 @@ fleet-smoke:
 gateway-smoke:
 	bash ci/runtime_functions.sh gateway_check
 
+sim-smoke:
+	bash ci/runtime_functions.sh sim_check
+
 obs-smoke:
 	bash ci/runtime_functions.sh obs_check
 
@@ -69,4 +73,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke sim-smoke obs-smoke debug-smoke ci clean

@@ -30,9 +30,12 @@ BENCH_QUICK / --quick (small model, few steps; auto-enabled on ANY
 non-TPU backend — r05's blackout was full mode running on an
 experimental platform string), BENCH_KERNELS (Pallas kernel-program
 leg, docs/KERNELS.md; on by default),
-BENCH_LEGS (comma list: run only these legs), BENCH_FORCE_TIMEOUT_LEG
+BENCH_LEGS (comma list: run only these legs), BENCH_LOADREPLAY
+(trace-driven overload replay leg, docs/SIMULATION.md; on by default),
+BENCH_FORCE_TIMEOUT_LEG
 (burn the named leg's budget so its watchdog fires — the harness's own
-regression test), BENCH_PARTIAL_PATH, BENCH_BASELINE /
+regression test; BENCH_FORCE_TIMEOUT_S tunes the burn window, default
+1.5s), BENCH_PARTIAL_PATH, BENCH_BASELINE /
 BENCH_REGRESSION_STRICT (regression tripwire vs the last recorded
 round: >10% drop on a leg metric is flagged; strict mode exits 3),
 BENCH_COMPILE_CACHE (persistent XLA compile cache, on by default; 0
@@ -143,6 +146,32 @@ def _selected_legs():
     return {s.strip() for s in sel.split(",") if s.strip()}
 
 
+def _quick_leg_budgets(legs, sel, budget_s):
+    """Scale quick-mode leg budgets so the legs that will actually RUN
+    collectively fit STRICTLY below 0.8x the outer budget — a
+    worst-case round (every leg eats its allowance) must still end with
+    legs marked, summary printed, rc 0, not an external kill.  Skipped
+    legs (BENCH_LEGS subsets) keep their budgets and don't count toward
+    the cap.  Floor at min(need, 45s): the compile-dominated CPU legs
+    (sentinel ~37s, inference ~34s measured) must not be scaled below
+    what a healthy run takes — but the floors may push the sum back
+    over, so a final uniform shave re-asserts the strict bound.
+    Returns (legs, scale-or-None)."""
+    active = [leg for leg in legs if sel is None or leg[0] in sel]
+    total_need = sum(need for _, _, need in active)
+    cap = 0.8 * budget_s
+    if total_need <= cap:
+        return legs, None
+    scale = cap / total_need
+    scaled = {n: max(min(need, 45.0), need * scale)
+              for n, _, need in active}
+    floored = sum(scaled.values())
+    if floored > cap:
+        shave = cap / floored * 0.999
+        scaled = {n: b * shave for n, b in scaled.items()}
+    return [(n, f, scaled.get(n, need)) for n, f, need in legs], scale
+
+
 def _leg_budget(name, default_need):
     try:
         return float(os.environ.get(
@@ -169,7 +198,11 @@ def _run_leg(extra, name, fn, need):
     budget = min(need, remaining)
     forced = os.environ.get("BENCH_FORCE_TIMEOUT_LEG", "") == name
     if forced:
-        budget = min(budget, 1.5)
+        try:
+            burn = float(os.environ.get("BENCH_FORCE_TIMEOUT_S", "1.5"))
+        except ValueError:
+            burn = 1.5
+        budget = min(budget, burn)
     t0 = time.monotonic()
     record, status = {}, "ok"
     _arm(budget)
@@ -568,6 +601,9 @@ def main(argv=None):
     def gateway_leg():
         return gateway_bench(quick=quick)
 
+    def loadreplay_leg():
+        return loadreplay_bench(quick=quick)
+
     # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
     # builds a second XLA module — so some exceed their full-mode numbers
     legs = [
@@ -604,24 +640,20 @@ def main(argv=None):
     # accepted on kernels_flash_vs_naive / kernels_int8_matmul_vs_bf16
     if os.environ.get("BENCH_KERNELS", "1") != "0":
         legs.append(("kernels", kernels_leg, 45 if quick else 90))
+    # the loadreplay leg runs in quick mode too: trace-driven overload
+    # replay (docs/SIMULATION.md) is accepted on goodput at 2x measured
+    # capacity and TTFT p99, both under the regression tripwire
+    if os.environ.get("BENCH_LOADREPLAY", "1") != "0":
+        legs.append(("loadreplay", loadreplay_leg, 45 if quick else 75))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
         legs = [leg for leg in legs if leg[0] != "serving"]
 
     if quick:
-        # quick leg budgets must collectively fit the global ceiling, so
-        # a worst-case round (every leg eats its allowance) still ends
-        # with legs marked, summary printed, rc 0 — not an external kill.
-        # Floor at min(need, 45s): the compile-dominated CPU legs
-        # (sentinel ~37s, inference ~34s measured) must not be scaled
-        # below what a healthy run actually takes.
-        total_need = sum(need for _, _, need in legs)
-        cap = 0.8 * _budget_s()
-        if total_need > cap:
-            scale = cap / total_need
-            legs = [(n, f, max(min(need, 45.0), need * scale))
-                    for n, f, need in legs]
+        legs, scale = _quick_leg_budgets(legs, _selected_legs(),
+                                         _budget_s())
+        if scale is not None:
             extra["quick_budget_scale"] = round(scale, 3)
 
     for name, fn, need in legs:
@@ -808,6 +840,74 @@ def decode_bench(quick=False):
         out["kv_page_util"] = round(srv.engine.allocator.peak_util, 4)
         out["decode_recompiles_in_window"] = int(
             profiler.dispatch_value("recompile") - base_recompiles)
+    finally:
+        srv.drain(timeout=30)
+    return out
+
+
+def loadreplay_bench(quick=False):
+    """Trace-driven load-replay leg (docs/SIMULATION.md): a seeded
+    :mod:`mxnet_tpu.loadgen` trace replayed at ~2x measured capacity
+    against a real in-process :class:`GenerationServer` — the
+    steady-overload profile the bounded admission queue must shed, not
+    absorb.  Accepted on ``loadreplay_goodput_per_sec`` (sustained
+    completions under overload, higher-better) and
+    ``loadreplay_ttft_p99_ms`` (lower-better), both under the >10%
+    regression tripwire; ``loadreplay_shed_rate`` documents how much of
+    the offered load was typed ``Overloaded`` rather than absorbed."""
+    import jax
+
+    from mxnet_tpu import loadgen
+    from mxnet_tpu.generation import GenerationConfig, GenerationServer
+    from mxnet_tpu.models import TransformerConfig, TransformerLM
+
+    vocab = 1024
+    cfg = TransformerConfig(vocab_size=vocab, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_len=128,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    max_new = 8 if quick else 16
+    gcfg = GenerationConfig(page_size=16, max_pages=128,
+                            max_slots=4 if quick else 8,
+                            max_new_tokens=max_new)
+    srv = GenerationServer(model, params, gcfg, max_queue=8)
+    out = {}
+    try:
+        # calibrate: an uncontended asap burst measures capacity (the
+        # warmup doubles as compile settling for every bucket touched)
+        cal_spec = loadgen.TraceSpec(
+            seed=11, segments=[{"duration_s": 1.0,
+                                "rate_rps": 8.0 if quick else 16.0}],
+            prompt_len_mean=6.0, prompt_len_max=24,
+            output_len_mean=float(max_new), output_len_max=max_new,
+            deadline_classes=[{"name": "cal", "deadline_ms": 60000.0,
+                               "weight": 1.0}])
+        target = loadgen.generation_target(srv, vocab=vocab)
+        cal = loadgen.replay(loadgen.generate_trace(cal_spec), target,
+                             speed=float("inf"), name="calibrate")
+        n_ok = cal.outcome_counts().get("ok", 0)
+        if not n_ok:
+            out["loadreplay_status_detail"] = "calibration produced " \
+                "no completions: %s" % cal.outcome_counts()
+            return out
+        capacity_rps = max(0.5, n_ok / max(cal.wall_s, 1e-6))
+        out["loadreplay_capacity_rps"] = round(capacity_rps, 2)
+
+        # the measured leg: 2x capacity offered for a few wall seconds
+        dur = 4.0 if quick else 8.0
+        spec = loadgen.TraceSpec(
+            seed=12,
+            segments=[{"duration_s": dur,
+                       "rate_rps": 2.0 * capacity_rps}],
+            prompt_len_mean=6.0, prompt_len_max=24,
+            output_len_mean=float(max_new), output_len_max=max_new,
+            deadline_classes=[{"name": "std", "deadline_ms": 8000.0,
+                               "weight": 1.0}])
+        report = loadgen.replay(loadgen.generate_trace(spec), target,
+                                speed=1.0, name="loadreplay")
+        out.update(report.summary())
+        out["loadreplay_knee_rps"] = loadgen.shed_knee(report.curve())
     finally:
         srv.drain(timeout=30)
     return out

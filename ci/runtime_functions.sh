@@ -205,6 +205,58 @@ gateway_check() {
     fi
 }
 
+sim_check() {
+    # Trace-driven load replay + simulated-clock fleet
+    # (docs/SIMULATION.md): trace-model determinism (Poisson/MMPP
+    # arrivals, deadline classes, sessions, shared prefixes), the
+    # replay typed-outcome contract against a real server, and the
+    # simulator acceptance — seeded runs bit-identical, the REAL
+    # FleetSupervisor + gateway routing policy at 200 replicas under a
+    # combined storm (registry partition + worker kills) in seconds.
+    python -m pytest tests/test_loadgen.py tests/test_simfleet.py \
+        -q -m "not slow"
+    # fleet-scale scenario smoke in a fresh process: 100 simulated
+    # replicas, partition + kill mid-ramp, every request exactly one
+    # typed outcome and a detectable shed knee — laptop-speed
+    env JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+
+from mxnet_tpu import loadgen
+from mxnet_tpu.simfleet import SimFleet, partition_window
+
+spec = loadgen.TraceSpec(seed=3, segments=[
+    {"duration_s": 6.0, "rate_rps": 300.0},
+    {"duration_s": 6.0, "rate_rps": 1300.0},
+], deadline_classes=[{"name": "std", "deadline_ms": 3000.0,
+                      "weight": 1.0}])
+trace = loadgen.generate_trace(spec)
+t0 = time.monotonic()
+with SimFleet(trace, initial_replicas=100, max_replicas=120,
+              slots=2, queue_cap=8, seed=5) as fl:
+    res = fl.run(chaos_spec=partition_window(6, 4) + ",worker_kill@60")
+wall = time.monotonic() - t0
+assert wall < 60.0, "storm took %.1fs" % wall
+assert sum(res["outcomes"].values()) == len(trace), res["outcomes"]
+assert set(res["outcomes"]) <= set(loadgen.TYPED_OUTCOMES)
+knee = loadgen.shed_knee(res["curve"])
+assert knee is not None, "no shed knee in the goodput curve"
+kinds = [i["kind"] for i in res["incidents"]]
+assert "worker_kill" in kinds and "registry_partition" in kinds, kinds
+print("sim storm smoke OK: %d reqs, %.1fs wall, knee %.0f rps"
+      % (len(trace), wall, knee))
+EOF
+    # the simulator must lint clean — NO suppressions: it drives the
+    # real control plane, so a CC001 slip here hides a production stall
+    python -m mxnet_tpu.lint mxnet_tpu/loadgen.py mxnet_tpu/simfleet.py \
+        mxnet_tpu/clock.py
+    if grep -n "mxlint: disable" mxnet_tpu/loadgen.py \
+            mxnet_tpu/simfleet.py mxnet_tpu/clock.py; then
+        echo "loadgen.py/simfleet.py/clock.py must not carry mxlint" \
+             "suppressions" >&2
+        return 1
+    fi
+}
+
 obs_check() {
     # Always-on telemetry plane (docs/OBSERVABILITY.md): metrics
     # registry, histogram quantiles, exporters, profiler ring buffer +
@@ -326,6 +378,7 @@ all() {
     kernel_check
     fleet_check
     gateway_check
+    sim_check
     obs_check
     debug_check
     unittest_dtype_sweep

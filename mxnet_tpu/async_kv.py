@@ -282,17 +282,12 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "rlist":
             now = time.monotonic()
             prefix = key or ""
-            live, dead = {}, []
-            for k, (v, e) in srv.registry.items():
-                if not k.startswith(prefix):
-                    continue
-                if e > now:
-                    live[k] = (v, e - now)
-                else:
-                    dead.append(k)
-            for k in dead:                # lazily reap on list, like rget
-                del srv.registry[k]
-            return live
+            # expired entries are invisible here but NOT purged: listing
+            # must never mutate the store, so reap accounting (rreap ->
+            # fleet.reaped) sees every TTL lapse exactly once
+            return {k: (v, e - now)
+                    for k, (v, e) in srv.registry.items()
+                    if k.startswith(prefix) and e > now}
         if op == "rreap":
             now = time.monotonic()
             prefix = key or ""

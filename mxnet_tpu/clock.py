@@ -1,0 +1,70 @@
+"""Injectable monotonic-clock seam (docs/SIMULATION.md).
+
+Every timer in the fleet/serving/gateway stack — registry TTLs,
+autoscaler cooldowns, suspect windows, request deadlines — reads time
+through a :class:`Clock` object instead of calling ``time.monotonic()``
+directly.  Production code never notices: the default
+:data:`MONOTONIC` singleton is a zero-state pass-through.  The
+simulator (:mod:`mxnet_tpu.simfleet`) swaps in a :class:`SimClock` and
+advances it manually, which is what lets the *real* ``FleetSupervisor``
+cooldown/hysteresis logic and the *real* gateway suspect-window math
+run a 1000-replica day of traffic in seconds of wall time.
+
+Two deliberate non-goals: ``time.perf_counter()`` duration probes
+around device compute stay real (we are simulating *control-plane*
+time, not XLA), and thread pacing (``Event.wait`` in daemon loops)
+stays on the real event so production threads still block instead of
+spinning.
+"""
+
+import time
+
+__all__ = ["Clock", "SimClock", "MONOTONIC", "resolve"]
+
+
+class Clock:
+    """The production clock: a stateless ``time.monotonic`` shim."""
+
+    def now(self):
+        """Monotonic seconds; the only timestamp source for timers."""
+        return time.monotonic()
+
+    def sleep(self, seconds):
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class SimClock(Clock):
+    """Manually advanced clock for deterministic simulation.
+
+    ``now()`` returns simulated seconds since ``start``; ``advance``
+    moves it forward (never backward — monotonic means monotonic).
+    ``sleep`` advances instead of blocking, so any polling helper
+    driven under a SimClock terminates immediately in sim time.
+    """
+
+    def __init__(self, start=0.0):
+        self._now = float(start)
+
+    def now(self):
+        return self._now
+
+    def advance(self, dt):
+        dt = float(dt)
+        if dt < 0:
+            raise ValueError("SimClock.advance(%r): time is monotonic"
+                             % (dt,))
+        self._now += dt
+        return self._now
+
+    def sleep(self, seconds):
+        if seconds > 0:
+            self.advance(seconds)
+
+
+MONOTONIC = Clock()
+
+
+def resolve(clock=None):
+    """``clock`` if given else the shared :data:`MONOTONIC` singleton."""
+    return MONOTONIC if clock is None else clock

@@ -55,15 +55,15 @@ import signal
 import subprocess
 import sys
 import threading
-import time
 
 from . import chaos as _chaos
+from . import clock as _clock
 from . import telemetry as _telemetry
 from .async_kv import AsyncKVClient, start_local_server
 from .elastic import PREEMPTED_EXIT_CODE, _backoff_delay
 
 __all__ = ["ServiceRegistry", "FleetView", "FleetSupervisor",
-           "WorkerSupervisor"]
+           "WorkerSupervisor", "cost_model"]
 
 # env-tunable defaults (docs/SHARDED_SERVING.md / docs/ENV_VARS.md)
 _DEF_HEARTBEAT_S = float(os.environ.get("MXTPU_FLEET_HEARTBEAT_S", "0.25"))
@@ -86,6 +86,43 @@ def _count(name, delta=1):
     from . import profiler as _prof
 
     _prof.dispatch_count(name, delta)
+
+
+# histograms the simulator calibrates its replica cost model from
+# (docs/SIMULATION.md "Calibration")
+_COST_MODEL_METRICS = (
+    "fleet.scaleup_ms",
+    "fleet.failover_ms",
+    "serving.latency_ms",
+    "serving.execute_ms",
+    "gen.ttft_ms",
+    "gen.decode_tokens_per_sec",
+    "gateway.route_ms",
+)
+_COST_MODEL_KEYS = ("count", "avg", "min", "max", "p50", "p95", "p99")
+
+
+def cost_model(reg=None):
+    """One-call calibration snapshot for :mod:`mxnet_tpu.simfleet`.
+
+    Returns ``{metric: {count, avg, min, max, p50, p95, p99}}`` for each
+    histogram in :data:`_COST_MODEL_METRICS`, pulled from the live
+    telemetry registry (or ``reg``).  A histogram that has never been
+    observed comes back as ``{"count": 0}`` so the simulator knows to
+    fall back to its built-in defaults.  Registered as the
+    ``cost_model`` debug-bundle section, so every postmortem carries the
+    fleet's measured cost profile.
+    """
+    reg = _telemetry.registry() if reg is None else reg
+    hists = reg.snapshot().get("histograms", {})
+    out = {}
+    for name in _COST_MODEL_METRICS:
+        h = hists.get(name)
+        if not h or not h.get("count"):
+            out[name] = {"count": 0}
+        else:
+            out[name] = {k: h.get(k) for k in _COST_MODEL_KEYS}
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -219,8 +256,10 @@ class FleetSupervisor:
                  heartbeat_s=None, interval_s=None,
                  min_replicas=None, max_replicas=None,
                  shed_up=None, p99_up_ms=None, idle_down_s=None,
-                 cooldown_s=None, breach_ticks=None, start=True):
+                 cooldown_s=None, breach_ticks=None, start=True,
+                 clock=None):
         self.server = server
+        self.clock = _clock.resolve(clock)
         self.registry = registry if registry is not None \
             else ServiceRegistry(service=service)
         self.heartbeat_s = _DEF_HEARTBEAT_S if heartbeat_s is None \
@@ -421,13 +460,13 @@ class FleetSupervisor:
             self._scale_down(n)
 
     def _scale_up(self, n):
-        t0 = time.monotonic()
+        t0 = self.clock.now()
         try:
             rid = self.server.add_replica()
         except Exception as e:
             # pool exhausted / drain race: back off a full cooldown
             _log("scale-up blocked: %s: %s" % (type(e).__name__, e))
-            self._cooldown_until = time.monotonic() + self.cooldown_s
+            self._cooldown_until = self.clock.now() + self.cooldown_s
             from . import debug as _debug
 
             _debug.write_bundle(
@@ -436,10 +475,10 @@ class FleetSupervisor:
                        "p99_ms": self.p99_ms,
                        "error": "%s: %s" % (type(e).__name__, e)})
             return
-        dt_ms = (time.monotonic() - t0) * 1e3
+        dt_ms = (self.clock.now() - t0) * 1e3
         self.scale_ups += 1
         self._breach_streak = 0
-        self._cooldown_until = time.monotonic() + self.cooldown_s
+        self._cooldown_until = self.clock.now() + self.cooldown_s
         _count("fleet_scale_ups")
         _telemetry.registry().histogram("fleet.scaleup_ms").observe(dt_ms)
         _log("scale UP %d -> %d (replica %d, %.0fms; shed_rate=%.3f "
@@ -451,11 +490,11 @@ class FleetSupervisor:
             rid = self.server.remove_replica()
         except (ValueError, KeyError) as e:
             _log("scale-down blocked: %s" % e)
-            self._cooldown_until = time.monotonic() + self.cooldown_s
+            self._cooldown_until = self.clock.now() + self.cooldown_s
             return
         self.scale_downs += 1
-        self._idle_since = time.monotonic()  # re-arm: one window per step
-        self._cooldown_until = time.monotonic() + self.cooldown_s
+        self._idle_since = self.clock.now()  # re-arm: one window per step
+        self._cooldown_until = self.clock.now() + self.cooldown_s
         _count("fleet_scale_downs")
         try:
             self.registry.withdraw(rid)      # clean deregistration
@@ -468,7 +507,7 @@ class FleetSupervisor:
     def _control_loop(self):
         while not self._stop_evt.is_set():
             try:
-                self._tick(time.monotonic())
+                self._tick(self.clock.now())
             except Exception as e:
                 # one bad tick (registry blip, server drain race) must
                 # not end autoscaling for the process's lifetime
@@ -511,9 +550,11 @@ class WorkerSupervisor:
 
     def __init__(self, specs, registry=None, service="default",
                  max_restarts=3, backoff=0.05, backoff_cap=8.0,
-                 poll_s=0.05, env=None, nonretryable=None, start=True):
+                 poll_s=0.05, env=None, nonretryable=None, start=True,
+                 clock=None):
         if not isinstance(specs, dict):
             specs = {"w%d" % i: argv for i, argv in enumerate(specs)}
+        self.clock = _clock.resolve(clock)
         self.specs = {str(rid): list(argv) for rid, argv in specs.items()}
         self.registry = registry
         self.service = service
@@ -575,9 +616,9 @@ class WorkerSupervisor:
                     proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
-        deadline = time.monotonic() + float(timeout)
+        deadline = self.clock.now() + float(timeout)
         for rid, proc in self._procs.items():
-            left = max(0.1, deadline - time.monotonic())
+            left = max(0.1, deadline - self.clock.now())
             try:
                 proc.wait(timeout=left)
             except subprocess.TimeoutExpired:
@@ -639,15 +680,15 @@ class WorkerSupervisor:
         spawn -> register rendezvous).  Needs a ``registry``."""
         if self.registry is None:
             raise ValueError("wait_registered needs a registry")
-        deadline = time.monotonic() + float(timeout)
-        while time.monotonic() < deadline:
+        deadline = self.clock.now() + float(timeout)
+        while self.clock.now() < deadline:
             try:
                 view = self.registry.view(reap=True)
                 if len(view) >= n:
                     return view
             except Exception:
                 pass              # registry still coming up
-            time.sleep(0.05)
+            self.clock.sleep(0.05)
         raise TimeoutError("only %d/%d workers registered after %.1fs"
                            % (len(self.registry.view(reap=False)), n,
                               timeout))
@@ -661,7 +702,7 @@ class WorkerSupervisor:
         self._restart_at.pop(rid, None)
         died = self._died_at.pop(rid, None)
         if died is not None:
-            dt_ms = (time.monotonic() - died) * 1e3
+            dt_ms = (self.clock.now() - died) * 1e3
             _telemetry.registry().histogram(
                 "fleet.failover_ms").observe(dt_ms)
             self.restarts += 1
@@ -733,9 +774,16 @@ class WorkerSupervisor:
     def _monitor_loop(self):
         while not self._stop_evt.is_set():
             try:
-                self._tick(time.monotonic())
+                self._tick(self.clock.now())
             except Exception as e:
                 # one bad tick must not end supervision
                 _log("worker-supervisor tick failed: %s: %s"
                      % (type(e).__name__, e))
             self._stop_evt.wait(self.poll_s)
+
+
+# every debug bundle carries the measured cost profile (module-level
+# function: add_section keeps a strong ref, which is what we want here)
+from . import debug as _debug  # noqa: E402  (needs cost_model defined)
+
+_debug.add_section("cost_model", cost_model)

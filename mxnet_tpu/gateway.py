@@ -54,11 +54,11 @@ import json
 import os
 import sys
 import threading
-import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import chaos as _chaos
+from . import clock as _clockmod
 from . import telemetry as _telemetry
 
 __all__ = ["Gateway"]
@@ -88,9 +88,10 @@ class Gateway:
     def __init__(self, registry=None, registry_addr=None,
                  service="default", host="127.0.0.1", port=0,
                  refresh_s=None, retries=None, timeout_s=None,
-                 suspect_s=None, start=True):
+                 suspect_s=None, start=True, clock=None):
         from .fleet import ServiceRegistry
 
+        self.clock = _clockmod.resolve(clock)
         self.registry = registry if registry is not None else \
             ServiceRegistry(addr=registry_addr, service=service)
         self.refresh_s = _DEF_REFRESH_S if refresh_s is None \
@@ -154,7 +155,7 @@ class Gateway:
 
     def view_age_s(self):
         return None if self._view_at is None \
-            else time.monotonic() - self._view_at
+            else self.clock.now() - self._view_at
 
     def snapshot(self):
         view = self._view
@@ -169,40 +170,47 @@ class Gateway:
                 "sessions": len(self._sessions)}
 
     # -- registry refresh --------------------------------------------------
-    def _refresh_loop(self):
+    def refresh_once(self):
+        """One registry refresh (the loop body).  The simulator drives
+        this directly under a :class:`~mxnet_tpu.clock.SimClock`, so
+        partition chaos and the last-known-good fallback run the exact
+        production code path in simulated time."""
         reg = _telemetry.registry()
+        n = self._refresh_seq
+        self._refresh_seq += 1
+        try:
+            if _chaos.gateway_partition(n):
+                raise ConnectionError(
+                    "chaos: gateway partitioned from registry")
+            view = self.registry.view(reap=True)
+            self._view = view
+            self._view_at = self.clock.now()
+            if self._refresh_failures:
+                _log("registry healed after %d failed refreshes "
+                     "(%d workers live)"
+                     % (self._refresh_failures, len(view)))
+            self._refresh_failures = 0
+            self.refreshes += 1
+            reg.gauge("gateway.workers").set(len(view))
+        except Exception as e:
+            # partition: keep routing from the last-known-good view
+            self._refresh_failures += 1
+            _count("gateway_registry_errors")
+            if self._refresh_failures == 1:
+                _log("registry unreachable (%s: %s) — serving from "
+                     "last-known-good view"
+                     % (type(e).__name__, e))
+        reg.gauge("gateway.stale").set(1 if self.stale else 0)
+
+    def _refresh_loop(self):
         while not self._stop_evt.is_set():
-            n = self._refresh_seq
-            self._refresh_seq += 1
-            try:
-                if _chaos.gateway_partition(n):
-                    raise ConnectionError(
-                        "chaos: gateway partitioned from registry")
-                view = self.registry.view(reap=True)
-                self._view = view
-                self._view_at = time.monotonic()
-                if self._refresh_failures:
-                    _log("registry healed after %d failed refreshes "
-                         "(%d workers live)"
-                         % (self._refresh_failures, len(view)))
-                self._refresh_failures = 0
-                self.refreshes += 1
-                reg.gauge("gateway.workers").set(len(view))
-            except Exception as e:
-                # partition: keep routing from the last-known-good view
-                self._refresh_failures += 1
-                _count("gateway_registry_errors")
-                if self._refresh_failures == 1:
-                    _log("registry unreachable (%s: %s) — serving from "
-                         "last-known-good view"
-                         % (type(e).__name__, e))
-            reg.gauge("gateway.stale").set(1 if self.stale else 0)
+            self.refresh_once()
             self._stop_evt.wait(self.refresh_s)
 
     # -- routing -----------------------------------------------------------
     def _note_suspect(self, rid):
         with self._lock:
-            self._suspect[rid] = time.monotonic() + self.suspect_s
+            self._suspect[rid] = self.clock.now() + self.suspect_s
 
     def _track(self, rid, delta):
         with self._lock:
@@ -214,7 +222,7 @@ class Gateway:
         view = self._view
         if view is None:
             return None
-        now = time.monotonic()
+        now = self.clock.now()
         with self._lock:
             suspect = {r for r, t in self._suspect.items() if t > now}
             local = dict(self._inflight)
@@ -254,7 +262,7 @@ class Gateway:
         conn.request("POST", path, body=payload,
                      headers={"Content-Type": "application/json"})
         _telemetry.registry().histogram("gateway.route_ms").observe(
-            (time.monotonic() - t0) * 1e3)
+            (self.clock.now() - t0) * 1e3)
         return conn
 
     # -- predict path ------------------------------------------------------
@@ -409,7 +417,7 @@ class Gateway:
                     self._json(404, {"error": "NotFound"})
 
             def do_POST(self):
-                t0 = time.monotonic()
+                t0 = gw.clock.now()
                 gw.requests += 1
                 _count("gateway_requests")
                 try:

@@ -43,6 +43,7 @@ import time
 
 import numpy as np
 
+from . import clock as _clockmod
 from . import dispatch as _dispatch
 from . import profiler as _profiler
 from . import telemetry as _telemetry
@@ -348,7 +349,8 @@ class GenerationServer:
     """
 
     def __init__(self, model, params, config=None, *, max_queue=None,
-                 deadline_ms=None, warm=True):
+                 deadline_ms=None, warm=True, clock=None):
+        self.clock = _clockmod.resolve(clock)
         self.engine = GenerationEngine(model, params, config)
         self.cfg = self.engine.cfg
         self.max_queue = _DEF_MAX_QUEUE if max_queue is None \
@@ -413,7 +415,7 @@ class GenerationServer:
         top_k = self.cfg.top_k if top_k is None else int(top_k)
         if top_k < 0:
             raise ValueError("top_k must be >= 0")
-        now = time.monotonic()
+        now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
         with self._cv:
@@ -428,7 +430,7 @@ class GenerationServer:
                                  % len(self._pending))
             fut = StreamingFuture({"tokens": prompt}, rows=1,
                                   deadline=deadline, t_admit=now,
-                                  on_token=on_token)
+                                  on_token=on_token, clock=self.clock)
             self.stats["admitted"] += 1
             _profiler.dispatch_count("requests_admitted")
             _telemetry.trace_begin("request", fut.trace_id, cat="gen",
@@ -455,7 +457,7 @@ class GenerationServer:
                     break
                 if self._drain_flag.is_set() and self._state == SERVING:
                     self._state = DRAINING
-                self._expire_locked(time.monotonic())
+                self._expire_locked(self.clock.now())
                 if (self._pending
                         and len(self._active) < self.cfg.max_slots):
                     work = self._pending.popleft()
@@ -535,7 +537,7 @@ class GenerationServer:
             self._inflight = None
             if fut.done:                           # drain/deadline raced
                 eng.allocator.free(pages)
-            elif time.monotonic() >= fut.deadline:
+            elif self.clock.now() >= fut.deadline:
                 self._reject_locked(fut, DeadlineExceeded(
                     "deadline passed during prefill"))
                 eng.allocator.free(pages)
@@ -627,7 +629,7 @@ class GenerationServer:
         ``Draining`` so nothing ever hangs.  Returns True when everything
         in flight completed."""
         self._drain_flag.set()
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self.clock.now() + timeout
         with self._cv:
             if self._state == STOPPED:
                 return True
@@ -637,7 +639,7 @@ class GenerationServer:
                      % (len(self._pending), len(self._active)))
             self._cv.notify_all()
             while self._pending or self._active or self._inflight is not None:
-                if deadline is not None and time.monotonic() >= deadline:
+                if deadline is not None and self.clock.now() >= deadline:
                     break
                 self._cv.wait(0.05)
             drained = not (self._pending or self._active

@@ -69,6 +69,7 @@ import time
 import numpy as np
 
 from . import chaos as _chaos
+from . import clock as _clockmod
 from . import telemetry as _telemetry
 from .async_kv import backoff_delay as _backoff_delay
 
@@ -157,13 +158,15 @@ class ServingFuture:
     :meth:`_resolve` / :meth:`_reject` under the server lock)."""
 
     __slots__ = ("inputs", "rows", "deadline", "t_admit", "job",
-                 "_outputs", "_error", "_event", "t_done", "trace_id")
+                 "_outputs", "_error", "_event", "t_done", "trace_id",
+                 "clock")
 
-    def __init__(self, inputs, rows, deadline, t_admit):
+    def __init__(self, inputs, rows, deadline, t_admit, clock=None):
         self.inputs = inputs          # {name: np.ndarray}, leading dim=rows
         self.rows = rows
-        self.deadline = deadline      # absolute time.monotonic()
+        self.deadline = deadline      # absolute clock.now() time
         self.t_admit = t_admit
+        self.clock = _clockmod.resolve(clock)
         self.job = None               # set when batched
         self._outputs = None
         self._error = None
@@ -180,7 +183,7 @@ class ServingFuture:
 
     def _settle(self):
         """Mark terminal (caller holds the server lock)."""
-        self.t_done = time.monotonic()
+        self.t_done = self.clock.now()
         if self.job is not None:
             self.job.unresolved -= 1
         self._event.set()
@@ -245,8 +248,9 @@ class StreamingFuture(ServingFuture):
 
     __slots__ = ("_stream", "_stream_cv", "_on_token", "t_first_token")
 
-    def __init__(self, inputs, rows, deadline, t_admit, on_token=None):
-        super().__init__(inputs, rows, deadline, t_admit)
+    def __init__(self, inputs, rows, deadline, t_admit, on_token=None,
+                 clock=None):
+        super().__init__(inputs, rows, deadline, t_admit, clock=clock)
         self._stream = []
         self._stream_cv = threading.Condition()
         self._on_token = on_token
@@ -259,7 +263,7 @@ class StreamingFuture(ServingFuture):
             if self._event.is_set():
                 return False
             if self.t_first_token is None:
-                self.t_first_token = time.monotonic()
+                self.t_first_token = self.clock.now()
             self._stream.append(token)
             self._stream_cv.notify_all()
         if self._on_token is not None:
@@ -518,7 +522,8 @@ class ModelServer:
                  deadline_ms=None, hedge_ms=None, buckets=None,
                  breaker_threshold=None, breaker_backoff=None,
                  breaker_backoff_cap=None, warm=True,
-                 mesh_axes=None, rules=None, devices=None):
+                 mesh_axes=None, rules=None, devices=None, clock=None):
+        self.clock = _clockmod.resolve(clock)
         self.max_queue = _DEF_MAX_QUEUE if max_queue is None \
             else int(max_queue)
         self.max_batch = _DEF_MAX_BATCH if max_batch is None \
@@ -715,7 +720,7 @@ class ModelServer:
             raise ValueError("request rows %d > max_batch %d"
                              % (rows, self.max_batch))
 
-        now = time.monotonic()
+        now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
         with self._cv:
@@ -732,7 +737,8 @@ class ModelServer:
                 raise Overloaded(
                     "admission queue at capacity (%d/%d): request shed"
                     % (depth, self.max_queue))
-            req = ServingFuture(feed, rows, deadline, now)
+            req = ServingFuture(feed, rows, deadline, now,
+                                clock=self.clock)
             self._pending.append(req)
             self.stats["admitted"] += 1
             _count("requests_admitted")
@@ -751,7 +757,7 @@ class ModelServer:
         typed :class:`ServingError` raised."""
         fut = self.submit_async(inputs, deadline_ms=deadline_ms)
         if timeout is None:
-            timeout = (fut.deadline - time.monotonic()) + 30.0
+            timeout = (fut.deadline - self.clock.now()) + 30.0
         return fut.result(timeout=timeout)
 
     def install_preemption_drain(self, handler=None):
@@ -775,7 +781,7 @@ class ModelServer:
         outcome, then stop the worker threads.  Returns True when
         everything in flight completed (False on timeout)."""
         self._drain_flag.set()
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self.clock.now() + timeout
         with self._cv:
             if self._state == STOPPED:
                 return True
@@ -785,7 +791,7 @@ class ModelServer:
                      % (len(self._pending), len(self._jobs)))
             self._cv.notify_all()
             while self._pending or self._jobs:
-                if deadline is not None and time.monotonic() >= deadline:
+                if deadline is not None and self.clock.now() >= deadline:
                     break
                 self._cv.wait(0.05)
             drained = not self._pending and not self._jobs
@@ -877,7 +883,7 @@ class ModelServer:
         servers clone the newest active replica (shared weights, no HBM
         copy).  The build + warm run OUTSIDE the lock, so serving never
         pauses while a replica compiles."""
-        t0 = time.monotonic()
+        t0 = self.clock.now()
         from .predict import Predictor
 
         with self._cv:
@@ -941,7 +947,7 @@ class ModelServer:
             self._cv.notify_all()
         _count("fleet_replicas_added")
         _log("replica %d added in %.0fms%s" % (
-            rid, (time.monotonic() - t0) * 1e3,
+            rid, (self.clock.now() - t0) * 1e3,
             " (mesh slice)" if slice_mesh is not None else ""))
         return rid
 
@@ -1208,7 +1214,7 @@ class ModelServer:
     def _scheduler_loop(self):
         with self._cv:
             while not self._stop:
-                now = time.monotonic()
+                now = self.clock.now()
                 if self._drain_flag.is_set() and \
                         self._state in (SERVING, DEGRADED):
                     self._state = DRAINING
@@ -1255,7 +1261,7 @@ class ModelServer:
                         repl.inflight -= 1
                         job.inflight_execs -= 1
                         tripped = repl.breaker.record_failure(
-                            time.monotonic())
+                            self.clock.now())
                         # the batch never actually ran here: let it
                         # retry this replica after the next backoff
                         job.tried.discard(repl.id)
@@ -1293,7 +1299,7 @@ class ModelServer:
             with self._cv:
                 repl.inflight -= 1
                 job.inflight_execs -= 1
-                now = time.monotonic()
+                now = self.clock.now()
                 if err is None:
                     repl.breaker.record_success()
                     self._ewma_latency = (

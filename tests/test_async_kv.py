@@ -135,9 +135,11 @@ def test_retries_exhausted_raises_connection_error(server):
         c.pull("w")
 
 
-def test_rlist_reaps_expired_rows_inline(server):
-    """``rlist`` on a prefix with dead entries must reap them inline —
-    never return an expired row, and not leave corpses for ``rreap``."""
+def test_rlist_hides_expired_rows_without_reaping(server):
+    """``rlist`` must never return an expired row, but must not purge
+    it either: listing is read-only, so the explicit ``rreap`` sees
+    every TTL lapse exactly once (the ``fleet.reaped`` accounting the
+    supervisor and the fleet view's reap log depend on)."""
     c = _client(server)
     c.registry_set("fleet/s/alive", {"x": 1}, ttl_s=30.0)
     c.registry_set("fleet/s/dead1", {"x": 2}, ttl_s=0.05)
@@ -146,13 +148,16 @@ def test_rlist_reaps_expired_rows_inline(server):
     time.sleep(0.1)
     live = c.registry_list("fleet/s/")
     assert sorted(live) == ["fleet/s/alive"]
-    # the expired matching rows were deleted server-side by the list
+    # the expired rows are invisible but still stored (listing does not
+    # mutate) — the explicit reaper is the one that purges and reports
     with server.lock:
-        assert sorted(server.registry) == ["fleet/s/alive", "other/keep"]
-    # nothing left under the prefix for the explicit reaper
+        assert sorted(server.registry) == [
+            "fleet/s/alive", "fleet/s/dead1", "fleet/s/dead2",
+            "other/keep"]
+    assert sorted(c.registry_reap("fleet/s/")) == ["fleet/s/dead1",
+                                                   "fleet/s/dead2"]
     assert c.registry_reap("fleet/s/") == []
-    # non-matching prefixes were untouched (reaped only on their own
-    # list/get/reap)
+    # non-matching prefixes were untouched
     assert c.registry_reap("other/") == ["other/keep"]
 
 

@@ -1,0 +1,351 @@
+"""Trace-driven load replay tests (mxnet_tpu/loadgen.py).
+
+Covers the trace model (seeded determinism, segments/MMPP arrivals,
+deadline classes, sessions, shared prefixes), the JSONL round-trip, the
+replay engine's one-typed-outcome-per-request contract against fake and
+real in-process targets, the aggregate curves + shed-knee detection,
+and the bench-leg JSONL schema.  The spawn parity smoke at the bottom
+replays a seeded trace through a REAL 2-process worker fleet behind the
+HTTP gateway (the PR 11 front door) — replay-vs-real parity for the
+simulator's outcome vocabulary.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import loadgen
+from mxnet_tpu.loadgen import ReplayReport, TraceSpec
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conftest import subprocess_env  # noqa: E402
+
+
+def _spec(**kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("segments", [{"duration_s": 4.0, "rate_rps": 25.0}])
+    return TraceSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# trace model
+# ---------------------------------------------------------------------------
+def test_trace_seeded_determinism_and_schema():
+    a = loadgen.generate_trace(_spec())
+    b = loadgen.generate_trace(_spec())
+    assert a == b                       # same seed: identical traces
+    assert a != loadgen.generate_trace(_spec(seed=8))
+    assert len(a) > 50                  # ~100 expected at 25 rps * 4 s
+    last_t = -1.0
+    for i, r in enumerate(a):
+        assert r["i"] == i
+        assert r["t"] >= last_t         # arrivals are time-ordered
+        last_t = r["t"]
+        assert 1 <= r["prompt_len"] <= _spec().prompt_len_max
+        assert 1 <= r["max_new_tokens"] <= _spec().output_len_max
+        assert r["deadline_ms"] > 0
+        assert r["class"] == "default"
+
+
+def test_segments_shape_the_arrival_rate():
+    spec = _spec(segments=[{"duration_s": 5.0, "rate_rps": 10.0},
+                           {"duration_s": 5.0, "rate_rps": 80.0}])
+    trace = loadgen.generate_trace(spec)
+    first = sum(1 for r in trace if r["t"] < 5.0)
+    second = sum(1 for r in trace if r["t"] >= 5.0)
+    assert second > 3 * first           # the ramp is visible in counts
+    assert spec.duration_s == 10.0
+
+
+def test_bursty_arrivals_are_burstier_than_poisson():
+    """MMPP with a strong burst state must produce a higher variance/
+    mean ratio of per-second counts than the plain Poisson trace at the
+    same average rate (index of dispersion > 1 detects the bursts)."""
+    def dispersion(trace, dur):
+        counts = np.zeros(int(dur))
+        for r in trace:
+            counts[min(int(r["t"]), int(dur) - 1)] += 1
+        return counts.var() / max(counts.mean(), 1e-9)
+
+    base = _spec(segments=[{"duration_s": 120.0, "rate_rps": 20.0}])
+    bursty = _spec(segments=[{"duration_s": 120.0, "rate_rps": 20.0}],
+                   arrival="mmpp", burst_factor=8.0, burst_dwell_s=2.0)
+    d_base = dispersion(loadgen.generate_trace(base), 120)
+    d_burst = dispersion(loadgen.generate_trace(bursty), 120)
+    assert d_burst > d_base
+    assert d_burst > 2.0
+
+
+def test_deadline_classes_sessions_and_prefix_groups():
+    spec = _spec(
+        deadline_classes=[
+            {"name": "interactive", "deadline_ms": 300.0, "weight": 3.0},
+            {"name": "batch", "deadline_ms": 5000.0, "weight": 1.0}],
+        session_count=8, prefix_groups=4, prefix_hit_rate=1.0,
+        prefix_len=8)
+    trace = loadgen.generate_trace(spec)
+    classes = {r["class"] for r in trace}
+    assert classes == {"interactive", "batch"}
+    n_inter = sum(1 for r in trace if r["class"] == "interactive")
+    assert n_inter > len(trace) / 2     # 3:1 weighting dominates
+    for r in trace:
+        if r["class"] == "interactive":
+            assert r["deadline_ms"] == 300.0
+    assert {r["session"] for r in trace if r["session"]} <= {
+        "s%d" % i for i in range(8)}
+    # shared prefixes: same group => identical first prefix_len tokens
+    by_group = {}
+    for r in trace:
+        if r["prefix_group"] is not None and r["prompt_len"] >= 8:
+            by_group.setdefault(r["prefix_group"], []).append(r)
+    shared = False
+    for group, reqs in by_group.items():
+        toks = [loadgen.prompt_tokens(r, vocab=100, seed=0)[:8].tolist()
+                for r in reqs[:3]]
+        assert all(t == toks[0] for t in toks)
+        shared = True
+    assert shared
+
+
+def test_prompt_tokens_deterministic():
+    r = {"i": 3, "prompt_len": 12, "prefix_group": None}
+    a = loadgen.prompt_tokens(r, vocab=50, seed=1)
+    b = loadgen.prompt_tokens(r, vocab=50, seed=1)
+    np.testing.assert_array_equal(a, b)
+    assert np.issubdtype(a.dtype, np.integer) and len(a) == 12
+    assert (a >= 0).all() and (a < 50).all()
+
+
+def test_jsonl_round_trip_preserves_trace_and_spec(tmp_path):
+    spec = _spec(session_count=4)
+    trace = loadgen.generate_trace(spec)
+    path = str(tmp_path / "trace.jsonl")
+    loadgen.save_trace(path, trace, spec=spec)
+    back, spec2 = loadgen.load_trace(path)
+    assert back == trace
+    assert spec2 is not None
+    assert spec2.as_dict() == spec.as_dict()
+    # a header-less file still loads (hand-authored traces)
+    loadgen.save_trace(path, trace)
+    back2, spec3 = loadgen.load_trace(path)
+    assert back2 == trace and spec3 is None
+
+
+def test_trace_spec_validation():
+    with pytest.raises(ValueError):
+        TraceSpec(arrival="uniform")
+    with pytest.raises(ValueError):
+        TraceSpec(segments=[{"duration_s": -1.0, "rate_rps": 5.0}])
+    with pytest.raises(ValueError):
+        TraceSpec(deadline_classes=[{"name": "x", "deadline_ms": 0.0,
+                                     "weight": 1.0}])
+    with pytest.raises(ValueError):
+        loadgen.replay([], lambda r: None, speed=0.0)
+
+
+# ---------------------------------------------------------------------------
+# replay engine
+# ---------------------------------------------------------------------------
+def test_replay_every_request_exactly_one_outcome():
+    trace = loadgen.generate_trace(_spec())
+    seen = []
+
+    def target(req):
+        seen.append(req["i"])
+        out = "ok" if req["i"] % 3 else "Overloaded"
+        return loadgen._outcome_record(req, out, latency_ms=1.0)
+
+    report = loadgen.replay(trace, target, speed=float("inf"))
+    assert sorted(seen) == list(range(len(trace)))
+    assert len(report.records) == len(trace)
+    counts = report.outcome_counts()
+    assert counts["ok"] + counts["Overloaded"] == len(trace)
+    # records stay in trace order even though threads race
+    assert [r["i"] for r in report.records] == list(range(len(trace)))
+
+
+def test_replay_target_raise_becomes_untyped_record():
+    trace = loadgen.generate_trace(_spec())[:10]
+
+    def bad(req):
+        raise RuntimeError("adapter bug")
+
+    report = loadgen.replay(trace, bad, speed=float("inf"))
+    assert report.outcome_counts() == {
+        "UNTYPED:RuntimeError": len(trace)}
+
+
+def test_replay_compression_and_inflight_cap():
+    spec = _spec(segments=[{"duration_s": 2.0, "rate_rps": 20.0}])
+    trace = loadgen.generate_trace(spec)
+    peak = [0]
+    cur = [0]
+    import threading
+    lock = threading.Lock()
+
+    def target(req):
+        with lock:
+            cur[0] += 1
+            peak[0] = max(peak[0], cur[0])
+        time.sleep(0.005)
+        with lock:
+            cur[0] -= 1
+        return loadgen._outcome_record(req, "ok", latency_ms=5.0)
+
+    t0 = time.monotonic()
+    report = loadgen.replay(trace, target, speed=20.0, max_inflight=4)
+    wall = time.monotonic() - t0
+    assert wall < 2.0                   # 2 s trace compressed 20x
+    assert peak[0] <= 4
+    assert len(report.records) == len(trace)
+
+
+def test_replay_against_real_model_server():
+    """In-process ModelServer: outcomes are the serving stack's typed
+    vocabulary, never UNTYPED (the adapter maps every ServingError)."""
+    from mxnet_tpu.fleet_worker import demo_model
+
+    server = demo_model()
+    try:
+        spec = _spec(segments=[{"duration_s": 1.5, "rate_rps": 40.0}],
+                     deadline_classes=[{"name": "std",
+                                        "deadline_ms": 10000.0,
+                                        "weight": 1.0}])
+        trace = loadgen.generate_trace(spec)
+        x = np.ones((1, 4), np.float32)
+        target = loadgen.server_target(server, lambda req: {"data": x})
+        report = loadgen.replay(trace, target, speed=float("inf"),
+                                max_inflight=16)
+        counts = report.outcome_counts()
+        assert sum(counts.values()) == len(trace)
+        assert set(counts) <= set(loadgen.TYPED_OUTCOMES)
+        assert counts.get("ok", 0) >= 1
+    finally:
+        server.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# curves, knee, bench-leg JSONL schema
+# ---------------------------------------------------------------------------
+def _ramp_report():
+    """Synthetic report: healthy at low offered load, shedding hard
+    past 20 rps."""
+    records = []
+    i = 0
+    for sec, (rate, ok_frac) in enumerate(
+            [(5, 1.0), (10, 1.0), (20, 0.95), (40, 0.5), (60, 0.3)]):
+        for k in range(rate):
+            req = {"i": i, "t": sec + k / rate, "class": "default"}
+            out = "ok" if k < rate * ok_frac else "Overloaded"
+            records.append(loadgen._outcome_record(
+                req, out, latency_ms=50.0, ttft_ms=10.0))
+            i += 1
+    return ReplayReport(records, wall_s=5.0)
+
+
+def test_curve_and_shed_knee():
+    report = _ramp_report()
+    curve = report.curve(bucket_s=1.0)
+    assert len(curve) == 5
+    for b in curve:
+        assert {"t", "offered", "ok", "shed", "offered_per_sec",
+                "goodput_per_sec"} <= set(b)
+    knee = loadgen.shed_knee(curve, ok_floor=0.9)
+    assert knee == 40.0                 # first bucket below 90% goodput
+    assert loadgen.shed_knee(curve[:3], ok_floor=0.9) is None
+
+
+def test_summary_carries_tripwire_suffixes():
+    s = _ramp_report().summary(prefix="loadreplay")
+    assert s["loadreplay_requests"] == 135
+    assert s["loadreplay_goodput_per_sec"] > 0
+    assert s["loadreplay_offered_per_sec"] > \
+        s["loadreplay_goodput_per_sec"]
+    assert 0.0 < s["loadreplay_shed_rate"] < 1.0
+    assert s["loadreplay_latency_p99_ms"] == 50.0
+    assert s["loadreplay_ttft_p99_ms"] == 10.0
+
+
+def test_write_jsonl_bench_leg_schema(tmp_path):
+    path = str(tmp_path / "replay.jsonl")
+    report = _ramp_report()
+    report.write_jsonl(path)
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    outcomes = [l for l in lines if l.get("kind") == "outcome"]
+    curves = [l for l in lines if l.get("kind") == "curve"]
+    assert len(outcomes) == len(report.records)
+    for o in outcomes:
+        assert {"i", "t_offered", "class", "outcome", "latency_ms",
+                "ttft_ms", "tokens"} <= set(o)
+    assert curves and all("offered_per_sec" in c for c in curves)
+    # the final line is the exact bench _flush_leg shape
+    leg = lines[-1]
+    assert set(leg) == {"leg", "status", "elapsed_s", "record"}
+    assert leg["leg"] == "loadreplay" and leg["status"] == "ok"
+    assert leg["record"]["loadreplay_requests"] == 135
+
+
+# ---------------------------------------------------------------------------
+# replay-vs-real parity: spawned 2-process fleet behind the gateway
+# ---------------------------------------------------------------------------
+def _worker_argv(registry_addr, rid):
+    return [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+            "--registry", registry_addr, "--service", "parity",
+            "--rid", rid, "--heartbeat-s", "0.1"]
+
+
+def test_replay_parity_through_real_process_fleet(tmp_path):
+    """Satellite: the same seeded trace the simulator consumes replays
+    through a REAL 2-process worker fleet behind the HTTP gateway —
+    every request exactly one typed outcome, and the emitted JSONL
+    validates against the bench-leg schema."""
+    from mxnet_tpu.fleet import ServiceRegistry, WorkerSupervisor
+    from mxnet_tpu.gateway import Gateway
+
+    reg = ServiceRegistry(service="parity", ttl_s=2.0)
+    sup = WorkerSupervisor(
+        {rid: _worker_argv(reg.addr, rid) for rid in ("w0", "w1")},
+        registry=reg, max_restarts=2, backoff=0.05, poll_s=0.05,
+        env=subprocess_env())
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    try:
+        sup.wait_registered(2, timeout=180)     # cold framework import
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if gw._view is not None and len(gw._view.replicas) == 2:
+                break
+            time.sleep(0.05)
+        assert gw._view is not None and len(gw._view.replicas) == 2
+
+        spec = _spec(segments=[{"duration_s": 3.0, "rate_rps": 12.0}],
+                     deadline_classes=[{"name": "std",
+                                        "deadline_ms": 30000.0,
+                                        "weight": 1.0}],
+                     session_count=4)
+        trace = loadgen.generate_trace(spec)
+        x = np.ones((1, 4), np.float32)
+        target = loadgen.gateway_target(
+            gw.addr, kind="predict", input_fn=lambda req: {"data": x},
+            timeout_s=90.0)
+        target(trace[0])                        # warm both compile paths
+        report = loadgen.replay(trace, target, speed=4.0,
+                                max_inflight=8, name="parity")
+        counts = report.outcome_counts()
+        assert sum(counts.values()) == len(trace)   # exactly one each
+        assert set(counts) <= set(loadgen.TYPED_OUTCOMES), counts
+        assert counts.get("ok", 0) >= len(trace) // 2
+
+        path = str(tmp_path / "parity.jsonl")
+        report.write_jsonl(path)
+        lines = [json.loads(l) for l in open(path) if l.strip()]
+        assert len(lines) == len(trace) + len(report.curve()) + 1
+        leg = lines[-1]
+        assert set(leg) == {"leg", "status", "elapsed_s", "record"}
+        assert leg["record"]["parity_requests"] == len(trace)
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()

@@ -1,0 +1,265 @@
+"""Simulated-clock fleet tests (mxnet_tpu/simfleet.py + clock.py).
+
+The acceptance invariants (ISSUE 12):
+
+* a seeded trace replayed twice through the simulator produces
+  IDENTICAL outcome curves (simulation is an experiment, not a vibe);
+* a 200+-replica fleet driven by the REAL FleetSupervisor and the REAL
+  gateway routing policy survives a combined chaos storm (registry
+  partition + worker kills) in seconds of wall clock, every request
+  getting exactly one typed outcome, with a detectable shed knee and an
+  inspectable debug bundle per incident.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from mxnet_tpu import loadgen, simfleet
+from mxnet_tpu.clock import Clock, MONOTONIC, SimClock, resolve
+from mxnet_tpu.simfleet import CostModel, SimFleet, partition_window
+
+
+# ---------------------------------------------------------------------------
+# clock seam
+# ---------------------------------------------------------------------------
+def test_clock_seam_basics():
+    assert resolve(None) is MONOTONIC
+    assert isinstance(MONOTONIC, Clock)
+    sc = SimClock(start=5.0)
+    assert resolve(sc) is sc
+    assert sc.now() == 5.0
+    sc.advance(2.5)
+    assert sc.now() == 7.5
+    sc.sleep(0.5)                       # sim sleep advances, never blocks
+    assert sc.now() == 8.0
+    with pytest.raises(ValueError):
+        sc.advance(-1.0)
+    # the real clock measures real time
+    t0 = MONOTONIC.now()
+    MONOTONIC.sleep(0.01)
+    assert MONOTONIC.now() - t0 >= 0.009
+
+
+def test_supervisor_and_gateway_accept_injected_clock():
+    """The production control plane takes the clock seam end to end:
+    suspect windows and cooldown math move with SimClock.advance, no
+    wall time involved."""
+    from mxnet_tpu.fleet import FleetView
+    from mxnet_tpu.gateway import Gateway
+
+    class _Reg:
+        service = "seam"
+
+    sc = SimClock()
+    gw = Gateway(registry=_Reg(), start=False, suspect_s=3.0, clock=sc)
+    try:
+        gw._view = FleetView("seam", {"w0": ({"addr": "h:1",
+                                              "inflight": 0}, 1.0)})
+        gw._note_suspect("w0")
+        assert gw._pick() is None       # suspect until sim t=3
+        sc.advance(3.5)
+        assert gw._pick() == ("w0", "h:1")
+    finally:
+        gw.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+def test_cost_model_defaults_and_telemetry_calibration():
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+
+    cm = CostModel()                    # empty tables: built-in defaults
+    rng = np.random.default_rng(0)
+    lats = [cm.latency_s(rng) for _ in range(500)]
+    tab = cm.tables["serving.latency_ms"]
+    assert tab["min"] / 1e3 <= min(lats) and max(lats) <= tab["max"] / 1e3
+    med = sorted(lats)[len(lats) // 2]
+    assert abs(med - tab["p50"] / 1e3) < 0.15   # median near p50 knot
+
+    # live calibration: an observed histogram overrides its default
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("serving.latency_ms")
+    for v in (10.0, 12.0, 14.0, 16.0, 18.0, 20.0):
+        h.observe(v)
+    cm2 = CostModel.from_telemetry(reg)
+    assert cm2.tables["serving.latency_ms"]["p50"] <= 20.0
+    samples = [cm2.latency_s(np.random.default_rng(1)) for _ in range(5)]
+    assert all(s <= 0.021 for s in samples)
+    # a histogram with no observations keeps its default
+    assert cm2.tables["fleet.scaleup_ms"]["p50"] == 2000.0
+
+
+def test_fleet_cost_model_snapshot_shape():
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.fleet import cost_model
+
+    reg = telemetry.MetricsRegistry()
+    out = cost_model(reg)
+    assert set(out) == {"fleet.scaleup_ms", "fleet.failover_ms",
+                        "serving.latency_ms", "serving.execute_ms",
+                        "gen.ttft_ms", "gen.decode_tokens_per_sec",
+                        "gateway.route_ms"}
+    assert all(v == {"count": 0} for v in out.values())
+    reg.histogram("gen.ttft_ms").observe(42.0)
+    out2 = cost_model(reg)
+    assert out2["gen.ttft_ms"]["count"] == 1
+    assert out2["gen.ttft_ms"]["p50"] == 42.0
+
+
+def test_cost_model_registered_as_debug_bundle_section(tmp_path,
+                                                       monkeypatch):
+    from mxnet_tpu import debug
+    from mxnet_tpu import fleet  # noqa: F401 — registers the section
+
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    path = debug.write_bundle("cost_model_section_probe", force=True)
+    assert path is not None
+    bundle = json.load(open(path))
+    assert "cost_model" in bundle["sections"]
+    assert "serving.latency_ms" in bundle["sections"]["cost_model"]
+
+
+# ---------------------------------------------------------------------------
+# simulator behavior
+# ---------------------------------------------------------------------------
+def _trace(seed=7, ramp=((4.0, 20.0), (4.0, 60.0))):
+    spec = loadgen.TraceSpec(
+        seed=seed,
+        segments=[{"duration_s": d, "rate_rps": r} for d, r in ramp],
+        deadline_classes=[{"name": "std", "deadline_ms": 3000.0,
+                           "weight": 1.0}])
+    return loadgen.generate_trace(spec)
+
+
+def test_seeded_replay_twice_identical_curves():
+    trace = _trace()
+
+    def once():
+        with SimFleet(trace, initial_replicas=2, max_replicas=8,
+                      slots=2, queue_cap=8, seed=1) as fl:
+            return fl.run()
+
+    a, b = once(), once()
+    assert a["curve"] == b["curve"]     # THE determinism invariant
+    assert a["outcomes"] == b["outcomes"]
+    assert a["sim_s"] == b["sim_s"]
+    assert a["supervisor"]["scale_ups"] == b["supervisor"]["scale_ups"]
+
+
+def test_autoscaler_reacts_to_overload_in_sim_time():
+    """The REAL FleetSupervisor rides the sim: overload produces
+    shed-rate breaches, breaches produce scale-ups, and the added
+    replicas absorb load after their sampled cold-start delay."""
+    trace = _trace(ramp=((2.0, 10.0), (6.0, 80.0)))
+    with SimFleet(trace, initial_replicas=2, max_replicas=12,
+                  slots=2, queue_cap=8, seed=3) as fl:
+        res = fl.run()
+    assert res["supervisor"]["scale_ups"] >= 2
+    assert res["server"]["admitted"] > 0
+    # 2 replicas x 2 slots / 0.3s ~ 13 rps capacity at the start vs 80
+    # offered; scale-ups claw back a meaningful ok fraction
+    assert res["outcomes"].get("ok", 0) > len(trace) * 0.2
+    # every request exactly one typed outcome, none UNTYPED
+    assert sum(res["outcomes"].values()) == len(trace)
+    assert set(res["outcomes"]) <= set(loadgen.TYPED_OUTCOMES)
+
+
+def test_worker_kill_drops_bundle_and_types_inflight_replica_lost(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    trace = _trace(ramp=((6.0, 40.0),))
+    with SimFleet(trace, initial_replicas=3, max_replicas=3,
+                  slots=2, queue_cap=8, seed=2, autoscale=False) as fl:
+        res = fl.run(chaos_spec="worker_kill@40")
+    kills = [i for i in res["incidents"] if i["kind"] == "worker_kill"]
+    assert len(kills) == 1
+    assert kills[0]["inflight_lost"] == res["outcomes"].get(
+        "ReplicaLost", 0)
+    bundles = [f for f in os.listdir(str(tmp_path))
+               if "sim_worker_kill" in f]
+    assert len(bundles) == 1
+    bundle = json.load(open(os.path.join(str(tmp_path), bundles[0])))
+    assert bundle["extra"]["kind"] == "worker_kill"
+    assert bundle["sections"]["simfleet"]["total"] == len(trace)
+
+
+def test_gateway_partition_serves_last_known_good_then_heals(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    trace = _trace(ramp=((8.0, 10.0),))     # under 2-replica capacity
+    with SimFleet(trace, initial_replicas=2, max_replicas=2,
+                  slots=2, queue_cap=16, seed=4, autoscale=False) as fl:
+        res = fl.run(chaos_spec=partition_window(4, 4))
+    kinds = [i["kind"] for i in res["incidents"]]
+    assert kinds == ["registry_partition", "registry_healed"]
+    # the last-known-good view kept serving THROUGH the partition
+    assert res["outcomes"].get("ok", 0) > len(trace) * 0.5
+    assert any("sim_registry_partition" in f
+               for f in os.listdir(str(tmp_path)))
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: 200+ replicas, combined storm, laptop-speed
+# ---------------------------------------------------------------------------
+def test_200_replica_fleet_combined_storm_under_60s(tmp_path,
+                                                    monkeypatch):
+    """ISSUE 12 acceptance: 200+ simulated replicas under the real
+    FleetSupervisor and real routing policy, a ramped trace crossing 2x
+    capacity, a registry partition AND worker kills mid-run — finishing
+    in < 60 s wall on CPU with a detectable shed knee, exactly one
+    typed outcome per request, and an inspectable bundle per
+    incident."""
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    costs = CostModel()
+    # capacity ~ replicas * slots / mean_latency: 200 * 2 / 0.3 ~ 1300
+    # rps; the last segment offers ~2x that
+    spec = loadgen.TraceSpec(seed=3, segments=[
+        {"duration_s": 8.0, "rate_rps": 400.0},
+        {"duration_s": 8.0, "rate_rps": 1300.0},
+        {"duration_s": 8.0, "rate_rps": 2600.0},
+    ], deadline_classes=[{"name": "std", "deadline_ms": 3000.0,
+                          "weight": 1.0}])
+    trace = loadgen.generate_trace(spec)
+    assert len(trace) > 20000           # millions-of-users shaped
+    storm = (partition_window(8, 6)
+             + ",worker_kill@100,worker_kill@140")
+    t0 = time.monotonic()
+    with SimFleet(trace, initial_replicas=200, max_replicas=240,
+                  slots=2, queue_cap=8, costs=costs, seed=5) as fl:
+        res = fl.run(chaos_spec=storm, chaos_seed=0)
+    wall = time.monotonic() - t0
+    assert wall < 60.0, "storm took %.1fs wall" % wall
+
+    # exactly one typed outcome per request
+    assert sum(res["outcomes"].values()) == len(trace)
+    assert set(res["outcomes"]) <= set(loadgen.TYPED_OUTCOMES)
+    assert res["outcomes"].get("ok", 0) > 5000
+
+    # the goodput-vs-offered curve bends at a detectable knee
+    knee = loadgen.shed_knee(res["curve"])
+    assert knee is not None
+    assert knee > 400.0                 # healthy at the low segment
+
+    # the storm is visible: partition + heal + both kills, each with an
+    # inspectable bundle that json-parses and carries the sim section
+    kinds = [i["kind"] for i in res["incidents"]]
+    assert kinds.count("worker_kill") == 2
+    assert "registry_partition" in kinds and "registry_healed" in kinds
+    bundles = sorted(os.listdir(str(tmp_path)))
+    assert len([b for b in bundles if "sim_worker_kill" in b]) == 2
+    assert len([b for b in bundles
+                if "sim_registry_partition" in b]) == 1
+    for b in bundles:
+        d = json.load(open(os.path.join(str(tmp_path), b)))
+        assert d["sections"]["simfleet"]["replicas"] >= 198
+        assert "cost_model" in d["sections"]
+
+    # the report rides the same bench-leg schema as live replay
+    summary = res["report"].summary(prefix="simfleet")
+    assert summary["simfleet_requests"] == len(trace)
+    assert summary["simfleet_goodput_per_sec"] > 0

@@ -397,6 +397,59 @@ def test_bench_sigterm_still_emits_summary(tmp_path):
     assert result["extra"].get("budget_exceeded") == "SIGTERM from driver"
 
 
+def test_bench_external_blackout_still_emits_summary(tmp_path):
+    """Satellite hardening for the r05 blackout class: bench dies under
+    an EXTERNAL ``timeout -k`` (exactly how the driver kills a round) —
+    coreutils timeout reports 124, but the last stdout line must still
+    parse as the JSON summary with the SIGTERM marker, so a blacked-out
+    round is diagnosable instead of `parsed: null`."""
+    import shutil
+
+    if shutil.which("timeout") is None:
+        pytest.skip("coreutils timeout not on PATH")
+    partial = str(tmp_path / "partial.jsonl")
+    env = subprocess_env(BENCH_LEGS="train", BENCH_PARTIAL_PATH=partial,
+                         BENCH_QUICK="1")
+    proc = subprocess.run(
+        ["timeout", "-k", "30", "8",
+         sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    # 124 == timeout delivered SIGTERM; bench must have flushed first
+    assert proc.returncode == 124, (proc.returncode, proc.stderr[-2000:])
+    lines = proc.stdout.strip().splitlines()
+    assert lines, "blackout: no stdout at all"
+    result = json.loads(lines[-1])
+    assert result["extra"].get("budget_exceeded") == "SIGTERM from driver"
+
+
+def test_bench_quick_budgets_fit_strictly_below_outer_budget(tmp_path):
+    """The quick-mode leg allowances for the legs that will RUN must sum
+    STRICTLY below 0.8x the outer budget even after the 45s floors —
+    otherwise a worst-case round overruns into the driver's kill."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    legs = [("a", None, 60.0), ("b", None, 45.0), ("c", None, 75.0),
+            ("d", None, 45.0), ("e", None, 45.0), ("f", None, 45.0)]
+    # plenty of budget: untouched
+    out, scale = bench._quick_leg_budgets(legs, None, 1000.0)
+    assert scale is None and out == legs
+    # tight budget: every active leg fits, sum strictly below the cap
+    out, scale = bench._quick_leg_budgets(legs, None, 240.0)
+    assert scale is not None
+    total = sum(need for _, _, need in out)
+    assert total < 0.8 * 240.0
+    # floors would sum to 6*45=270 > cap 192: the shave must have bitten
+    assert all(need < 45.0 for _, _, need in out)
+    # a BENCH_LEGS subset: skipped legs keep their budgets and the
+    # selected pair needs no scaling under a 200s budget (115 < 160)
+    out, scale = bench._quick_leg_budgets(legs, {"a", "b"}, 200.0)
+    assert scale is None
+    assert out == legs
+
+
 def test_bench_regression_tripwire(tmp_path):
     """check_regressions flags >10% drops on higher-is-better metrics
     and >10% increases on latency metrics, and nothing else."""
