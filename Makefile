@@ -8,6 +8,7 @@
 #   make test       -> full pytest suite (CPU oracle, 8-device mesh)
 #   make test-fast  -> quick shard (operators + ndarray + autograd)
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
+#   make lockdep-smoke-> runtime lock-order sanitizer lane (MXTPU_LOCKDEP=raise)
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
@@ -38,7 +39,11 @@ test-fast:
 	    tests/test_autograd.py -q
 
 lint:
-	$(PYTHON) tools/mxlint mxnet_tpu/ example/ tools/
+	$(PYTHON) tools/mxlint mxnet_tpu/ example/ tools/ \
+	    --baseline ci/mxlint_baseline.json
+
+lockdep-smoke:
+	bash ci/runtime_functions.sh lockdep_check
 
 chaos:
 	bash ci/runtime_functions.sh chaos_check
@@ -73,4 +78,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke sim-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke sim-smoke obs-smoke debug-smoke ci clean

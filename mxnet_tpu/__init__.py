@@ -11,6 +11,13 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# arm the runtime lock-order sanitizer (MXTPU_LOCKDEP) before ANY other
+# framework import — the factories must be wrapped before the first
+# module-level lock is created, and lockdep itself is stdlib-only
+from . import lockdep  # noqa: F401
+
+lockdep.install_from_env()
+
 # arm the persistent XLA compilation cache (MXNET_COMPILE_CACHE) before
 # anything can trigger a compile — jax reads the cache dir at compile time,
 # so this must precede the first jitted call anywhere in the process

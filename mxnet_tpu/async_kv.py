@@ -399,6 +399,7 @@ class AsyncKVClient:
         # would interleave frames from concurrent trainer threads and
         # tear the stream.  Nothing else is guarded by this lock, so the
         # CC001 deadlock shape (peer needs the same lock) cannot occur.
+        # mxlint: disable-block=CC001 -- lock-across-I/O IS the protocol
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -406,12 +407,12 @@ class AsyncKVClient:
             for attempt in range(self._retries + 1):
                 try:
                     if self._sock is None:
-                        self._connect()  # mxlint: disable=CC001
+                        self._connect()
                     fi_delay = self._fi_delay_before_send.pop(seq, None)
                     if fi_delay:
                         _chaos_note("kv_delay", seq)
-                        time.sleep(fi_delay)  # mxlint: disable=CC001
-                    _send_msg(  # mxlint: disable=CC001
+                        time.sleep(fi_delay)
+                    _send_msg(
                         self._sock,
                         (self._client_id, seq, op, key, payload))
                     fi_dup = seq in self._fi_duplicate_send
@@ -421,7 +422,7 @@ class AsyncKVClient:
                         # retransmit the identical frame: the server must
                         # answer both from its dedup cache; the spare
                         # reply is drained right after the real one
-                        _send_msg(  # mxlint: disable=CC001
+                        _send_msg(
                             self._sock,
                             (self._client_id, seq, op, key, payload))
                     if seq in self._fi_drop_after_send:
@@ -430,7 +431,7 @@ class AsyncKVClient:
                         self._close()
                         raise ConnectionError(
                             "injected reply loss (seq %d)" % seq)
-                    rseq, reply = _recv_msg(  # mxlint: disable=CC001
+                    rseq, reply = _recv_msg(
                         self._sock)
                     if rseq != seq:  # torn stream: resync on a fresh conn
                         raise ConnectionError(
@@ -439,7 +440,7 @@ class AsyncKVClient:
                         # drain the duplicate's reply so the stream stays
                         # aligned; the server's dedup answered it from
                         # the (client_id, seq) cache
-                        dseq, _dreply = _recv_msg(  # mxlint: disable=CC001
+                        dseq, _dreply = _recv_msg(
                             self._sock)
                         if dseq != seq:
                             raise ConnectionError(
@@ -456,7 +457,7 @@ class AsyncKVClient:
                             % (op, self._retries, last_err)) from last_err
                     delay = backoff_delay(attempt, self._backoff,
                                           self._backoff_cap)
-                    time.sleep(delay)  # mxlint: disable=CC001 -- see above
+                    time.sleep(delay)
         if isinstance(reply, Exception):
             raise reply
         return reply

@@ -205,6 +205,19 @@ class _Config:
              "interpreter on any backend — the CPU parity-testing mode. "
              "Each resolution bumps a pallas.select.<kernel>.<impl> "
              "telemetry counter."),
+        Knob("MXTPU_LOCKDEP", str, "off",
+             "Runtime lock-order sanitizer (mxnet_tpu.lockdep; "
+             "docs/STATIC_ANALYSIS.md 'Runtime lockdep'): wraps every "
+             "threading.Lock/RLock created by mxnet_tpu code at import "
+             "and maintains the acquisition-order graph by creation "
+             "site. 'record' keeps edges, inversions, and held-across-"
+             "blocking events (lockdep.* telemetry gauges + a 'lockdep' "
+             "debug-bundle section); 'raise' additionally turns an "
+             "acquisition that closes a cycle into "
+             "lockdep.LockOrderError at the acquire that would deadlock "
+             "— the CI mode for the chaos and gateway suites. 'off' "
+             "(default) leaves the factories untouched: zero overhead. "
+             "Read once, before the first framework lock exists."),
         Knob("MXNET_INT64_TENSOR_SIZE", bool, False,
              "Opt into int64 tensor sizes/indices (arrays past 2^31 "
              "elements) by enabling jax x64 mode at import — the "

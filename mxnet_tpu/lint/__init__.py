@@ -28,15 +28,39 @@ TS004  warning   Python control flow branching on a tracer-valued
                  expression (recompile / ConcretizationError trap)
 TS005  error     use-after-donate: a buffer read after being passed
                  through a donating jit call in the same scope
+TS007  error     dict/list/set in a static_argnums position of
+                 TrackedJit/jit (unhashable cache key; retrace storm)
 CC001  error     lock held across a blocking call (recv/join/sleep/
-                 sendall/connect)
+                 sendall/connect) — inter-procedural: a helper that
+                 blocks taints every caller invoking it under a lock
 CC002  error     non-daemon thread with no join path
+CC003  error     lock-order inversion: a cycle in the package-wide
+                 acquisition-order graph, both witness paths reported
+CC004  error     user callback (on_*) or Future settle (set_result/
+                 set_exception) invoked while holding a lock
+CC005  warning   raw socket I/O or an unbounded wait reachable from a
+                 registered daemon-loop body (heartbeat/control ticks)
 =====  ========  =====================================================
+
+Every entry point builds a package-wide call graph
+(:mod:`~mxnet_tpu.lint.interproc`) and propagates blocking-ness,
+host-sync, callback-fire, and holds-lock facts across resolved call
+edges, so CC001/TS001/CC004 see through helper indirection and CC003
+unions lock ordering across modules.
 
 Suppress a finding with a trailing (or immediately preceding standalone)
 comment ``# mxlint: disable=TS002`` (comma list, or ``disable=all``);
+``# mxlint: disable-block=CC001`` on a compound statement (e.g. a
+``with`` holding a transport lock by design) silences the rule for the
+whole statement body — one audit point per critical section;
 ``# mxlint: skip-file`` skips a whole file.  Suppressions should carry a
 rationale — they are audit points, not escape hatches.
+
+Accepted findings live in a baseline ledger
+(``ci/mxlint_baseline.json``; see :mod:`~mxnet_tpu.lint.baseline`):
+``--baseline`` runs fail only on findings NOT in the ledger, so new
+rules land without a zero-findings flag day and the ratchet only
+tightens.
 
 The static analyzer is complemented by a *runtime* trace guard
 (``MXNET_TRACE_GUARD=warn|raise``, see ``mxnet_tpu.dispatch``) that
@@ -59,10 +83,18 @@ from .core import (  # noqa: F401
     lint_file,
     lint_paths,
     lint_source,
+    register_program_rule,
     register_rule,
 )
 from . import rules as _rules  # noqa: F401  (registers the rule set)
+from .baseline import (  # noqa: F401
+    compare,
+    load_baseline,
+    write_baseline,
+)
+from .interproc import Program  # noqa: F401
 
 __all__ = ["RULES", "Finding", "LintError", "Rule", "Severity",
-           "format_json", "format_text", "lint_file", "lint_paths",
-           "lint_source", "register_rule"]
+           "Program", "compare", "format_json", "format_text",
+           "lint_file", "lint_paths", "lint_source", "load_baseline",
+           "register_program_rule", "register_rule", "write_baseline"]

@@ -2,13 +2,15 @@
 ``tools/mxlint``).
 
 Exit codes: 0 clean, 1 findings at failing severity (errors, plus
-warnings under ``--strict``), 2 usage / internal error.
+warnings under ``--strict``; with ``--baseline``, any finding not in
+the ledger), 2 usage / internal error.
 """
 from __future__ import annotations
 
 import argparse
 import sys
 
+from .baseline import compare, load_baseline, write_baseline
 from .core import (RULES, LintError, Severity, format_json, format_text,
                    lint_paths)
 
@@ -28,6 +30,12 @@ def _build_parser():
                    help="comma list of rule ids to skip")
     p.add_argument("--strict", action="store_true",
                    help="warnings also fail the run (exit 1)")
+    p.add_argument("--baseline", default=None, metavar="JSON",
+                   help="accepted-findings ledger: only findings NOT in "
+                        "the ledger fail the run (any severity)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="with --baseline: (re)write the ledger from the "
+                        "current findings and exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -55,6 +63,8 @@ def main(argv=None):
         return 0
     if not ns.paths:
         parser.error("no paths given (or use --list-rules)")
+    if ns.write_baseline and not ns.baseline:
+        parser.error("--write-baseline requires --baseline <json>")
     select = _split_rules(ns.select)
     disable = _split_rules(ns.disable)
     for spec in (select or ()), (disable or ()):
@@ -69,6 +79,27 @@ def main(argv=None):
     except LintError as e:
         sys.stderr.write("mxlint: %s\n" % e)
         return 2
+    if ns.baseline:
+        if ns.write_baseline:
+            n = write_baseline(findings, ns.baseline)
+            sys.stdout.write("mxlint: wrote %d accepted fingerprint(s) "
+                             "(%d finding(s)) to %s\n"
+                             % (n, len(findings), ns.baseline))
+            return 0
+        try:
+            ledger = load_baseline(ns.baseline)
+        except (OSError, ValueError) as e:
+            sys.stderr.write("mxlint: bad baseline: %s\n" % e)
+            return 2
+        new, accepted = compare(findings, ledger)
+        if ns.format == "json":
+            sys.stdout.write(format_json(new, n_files) + "\n")
+        else:
+            sys.stdout.write(format_text(new, n_files) + "\n")
+            sys.stdout.write("baseline: %d new finding(s), %d accepted "
+                             "by %s\n" % (len(new), len(accepted),
+                                          ns.baseline))
+        return 1 if new else 0
     if ns.format == "json":
         sys.stdout.write(format_json(findings, n_files) + "\n")
     else:

@@ -1,0 +1,96 @@
+"""Finding baselines: an accepted-findings ledger so new rules can land
+without a same-PR zero-findings flag day.
+
+A baseline is a JSON ledger of fingerprints ``(path, rule, message)``
+with occurrence counts.  Line numbers are deliberately NOT part of the
+fingerprint — unrelated edits move lines constantly; a finding only
+counts as *new* when its (file, rule, message) triple appears more times
+than the ledger allows.  Paths are stored relative (forward slashes) so
+the ledger is stable across checkouts; absolute inputs are relativized
+against ``root`` (default: the current directory).
+
+CI contract (``ci/runtime_functions.sh lint_check``): the committed
+``ci/mxlint_baseline.json`` holds the accepted findings; a run with
+``--baseline`` fails on any finding not covered by the ledger, whatever
+its severity — the ratchet only tightens.  Shrink the ledger by fixing
+findings and rewriting it with ``--write-baseline``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["fingerprint", "write_baseline", "load_baseline", "compare"]
+
+_VERSION = 1
+
+
+def _norm_path(path, root=None):
+    if os.path.isabs(path):
+        path = os.path.relpath(path, root or os.getcwd())
+    return path.replace(os.sep, "/")
+
+
+def fingerprint(finding, root=None):
+    """Stable identity of a finding: (relative path, rule, message)."""
+    return (_norm_path(finding.path, root), finding.rule, finding.message)
+
+
+def _tally(findings, root=None):
+    counts = {}
+    for f in findings:
+        key = fingerprint(f, root)
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_baseline(findings, out_path, root=None):
+    """Write the accepted-findings ledger for ``findings`` (atomic
+    rename; sorted and indented so diffs review cleanly)."""
+    counts = _tally(findings, root)
+    payload = {
+        "version": _VERSION,
+        "tool": "mxlint-baseline",
+        "findings": [
+            {"path": p, "rule": r, "message": m, "count": c}
+            for (p, r, m), c in sorted(counts.items())
+        ],
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    return len(counts)
+
+
+def load_baseline(path):
+    """Ledger file -> {fingerprint: allowed count}.  Raises
+    ``ValueError`` on a schema it does not understand."""
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    if payload.get("tool") != "mxlint-baseline" or \
+            payload.get("version") != _VERSION:
+        raise ValueError("%s is not an mxlint baseline (v%d)"
+                         % (path, _VERSION))
+    out = {}
+    for item in payload.get("findings", ()):
+        key = (item["path"], item["rule"], item["message"])
+        out[key] = out.get(key, 0) + int(item.get("count", 1))
+    return out
+
+
+def compare(findings, baseline, root=None):
+    """Split ``findings`` into (new, accepted) against the ledger.  Each
+    fingerprint consumes its allowance in order; overflow occurrences —
+    and fingerprints absent from the ledger — are new."""
+    budget = dict(baseline)
+    new, accepted = [], []
+    for f in findings:
+        key = fingerprint(f, root)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            accepted.append(f)
+        else:
+            new.append(f)
+    return new, accepted

@@ -6,7 +6,17 @@ routes the tree through every registered rule; rules are small functions
 so projects (and tests) can extend the rule set without touching the
 driver.  Suppression directives are read from the raw source lines
 (``# mxlint: disable=RULE``), pylint-style: a trailing comment silences
-its own line, a standalone directive line silences the next line.
+its own line, a standalone directive line silences the next line, and
+``# mxlint: disable-block=RULE`` on (or immediately above) a compound
+statement silences the whole statement body — ONE audit point for a
+deliberately-held critical section instead of a comment per line.
+
+Every lint entry point builds an :class:`~.interproc.Program` over the
+linted set — a single file gets intra-module transitivity, a package run
+gets the full cross-module call graph — so rules can consult
+``ctx.program`` unconditionally.  Rules registered with
+:func:`register_program_rule` run once per program (not per module) and
+yield findings anchored to witness files.
 """
 from __future__ import annotations
 
@@ -16,8 +26,8 @@ import os
 import re
 
 __all__ = ["Severity", "Finding", "Rule", "RULES", "LintError",
-           "register_rule", "lint_source", "lint_file", "lint_paths",
-           "format_text", "format_json"]
+           "register_rule", "register_program_rule", "lint_source",
+           "lint_file", "lint_paths", "format_text", "format_json"]
 
 
 class Severity:
@@ -62,18 +72,21 @@ class Finding:
 
 
 class Rule:
-    """A registered rule: id, default severity, one-line summary, and the
-    checker ``fn(ModuleContext) -> iterable[(node_or_line, col, msg)]``
-    (checkers yield positions; the driver stamps rule/severity/path)."""
+    """A registered rule: id, default severity, one-line summary, scope,
+    and the checker.  Module-scope checkers take a ``ModuleContext`` and
+    yield ``(node_or_line, col, msg)``; program-scope checkers take a
+    ``Program`` and yield ``(path, node_or_line, col, msg)``."""
 
-    __slots__ = ("id", "severity", "summary", "doc", "checker")
+    __slots__ = ("id", "severity", "summary", "doc", "checker", "scope")
 
-    def __init__(self, id, severity, summary, checker, doc=None):
+    def __init__(self, id, severity, summary, checker, doc=None,
+                 scope="module"):
         self.id = id
         self.severity = severity
         self.summary = summary
         self.checker = checker
         self.doc = doc or (checker.__doc__ or "").strip()
+        self.scope = scope
 
 
 #: rule id -> Rule.  Populated by :func:`register_rule` (rules.py imports
@@ -81,40 +94,59 @@ class Rule:
 RULES: dict = {}
 
 
-def register_rule(rule_id, severity, summary):
-    """Decorator: register ``fn(ctx)`` as rule ``rule_id``.
-
-    The checker receives a :class:`mxnet_tpu.lint.rules.ModuleContext`
-    and yields ``(lineno, col, message)`` triples (or ast nodes in place
-    of ``lineno``, from which position is taken)."""
+def _register(rule_id, severity, summary, scope):
     assert re.fullmatch(r"[A-Z]{2}\d{3}", rule_id), rule_id
 
     def deco(fn):
         if rule_id in RULES:
             raise LintError("duplicate rule id %s" % rule_id)
-        RULES[rule_id] = Rule(rule_id, severity, summary, fn)
+        RULES[rule_id] = Rule(rule_id, severity, summary, fn, scope=scope)
         return fn
 
     return deco
 
 
+def register_rule(rule_id, severity, summary):
+    """Decorator: register ``fn(ctx)`` as module-scope rule ``rule_id``.
+
+    The checker receives a :class:`mxnet_tpu.lint.rules.ModuleContext`
+    and yields ``(lineno, col, message)`` triples (or ast nodes in place
+    of ``lineno``, from which position is taken)."""
+    return _register(rule_id, severity, summary, "module")
+
+
+def register_program_rule(rule_id, severity, summary):
+    """Decorator: register ``fn(program)`` as a program-scope rule that
+    runs ONCE per lint invocation over the package-wide
+    :class:`~.interproc.Program`.  The checker yields
+    ``(path, lineno_or_node, col, message)`` — findings are anchored to
+    the named witness file and honor that file's suppressions."""
+    return _register(rule_id, severity, summary, "program")
+
+
 # -- suppressions -----------------------------------------------------------
 _DIRECTIVE = re.compile(
-    r"#\s*mxlint:\s*(?P<verb>disable|skip-file)\s*"
+    r"#\s*mxlint:\s*(?P<verb>disable-block|disable|skip-file)\s*"
     r"(?:=\s*(?P<rules>[A-Za-z0-9_,\s]+?))?\s*(?:—|--|\.|$)")
 
 
 def _parse_suppressions(lines):
-    """(skip_file, {lineno: set(rule_ids) | {'all'}}) from raw source
-    lines.  A directive with code before the ``#`` applies to its own
-    line; a standalone comment line applies to the following line too."""
+    """``(skip_file, per_line, block_directives)`` from raw source
+    lines.  ``per_line`` maps lineno -> set(rule ids) | {'all'}; a
+    directive with code before the ``#`` applies to its own line, a
+    standalone comment line applies to the following line too.
+    ``block_directives`` is ``[(lineno, rules, standalone)]`` for
+    ``disable-block`` directives, resolved to statement extents once the
+    AST is available."""
     skip_file = False
     per_line = {}
+    blocks = []
     for i, raw in enumerate(lines, start=1):
         m = _DIRECTIVE.search(raw)
         if not m:
             continue
-        if m.group("verb") == "skip-file":
+        verb = m.group("verb")
+        if verb == "skip-file":
             skip_file = True
             continue
         rules = {r.strip().upper() for r in
@@ -122,47 +154,126 @@ def _parse_suppressions(lines):
         if not rules:
             rules = {"ALL"}
         rules = {"all" if r == "ALL" else r for r in rules}
+        standalone = not raw.split("#", 1)[0].strip()
+        if verb == "disable-block":
+            blocks.append((i, rules, standalone))
+            continue
         targets = [i]
-        if not raw.split("#", 1)[0].strip():
+        if standalone:
             targets.append(i + 1)  # standalone directive: next line too
         for t in targets:
             per_line.setdefault(t, set()).update(rules)
-    return skip_file, per_line
+    return skip_file, per_line, blocks
 
 
-def _suppressed(finding, per_line):
+def _block_ranges(tree, blocks):
+    """Resolve ``disable-block`` directives to ``(start, end, rules)``
+    line ranges: the widest statement starting on the directive line
+    (trailing form) or the next line (standalone form)."""
+    if not blocks:
+        return []
+    stmts = [n for n in ast.walk(tree)
+             if isinstance(n, ast.stmt) and getattr(n, "end_lineno", None)]
+    ranges = []
+    for (line, rules, standalone) in blocks:
+        starts = {line, line + 1} if standalone else {line}
+        cands = [n for n in stmts if n.lineno in starts]
+        if not cands:
+            continue
+        best = max(cands, key=lambda n: n.end_lineno - n.lineno)
+        ranges.append((best.lineno, best.end_lineno, rules))
+    return ranges
+
+
+def _suppressed(finding, per_line, ranges=()):
     got = per_line.get(finding.line)
-    return bool(got) and ("all" in got or finding.rule in got)
+    if got and ("all" in got or finding.rule in got):
+        return True
+    for (start, end, rules) in ranges:
+        if start <= finding.line <= end and \
+                ("all" in rules or finding.rule in rules):
+            return True
+    return False
 
 
 # -- driver -----------------------------------------------------------------
-def lint_source(source, path="<string>", select=None, disable=None):
-    """Lint one source string; returns a list of :class:`Finding`.
+class _Entry:
+    """One file staged for linting: raw text, suppression state, and the
+    parse result (tree, or a synthetic PARSE finding)."""
 
-    ``select``/``disable``: iterables of rule ids restricting which rules
-    run.  Suppression comments are honored.  A syntax error yields a
-    single synthetic ``PARSE``-rule error finding rather than raising, so
-    one broken file cannot take down a whole-tree run."""
-    from .rules import ModuleContext
+    __slots__ = ("path", "source", "lines", "skip", "per_line", "ranges",
+                 "tree", "ctx", "parse_finding")
 
-    lines = source.splitlines()
-    skip_file, per_line = _parse_suppressions(lines)
-    if skip_file:
-        return []
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("PARSE", Severity.ERROR, path, e.lineno or 1,
-                        (e.offset or 1) - 1, "syntax error: %s" % e.msg)]
-    ctx = ModuleContext(tree, path, lines)
-    findings = []
-    for rule in RULES.values():
-        if select and rule.id not in select:
+    def __init__(self, source, path):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.skip, self.per_line, blocks = _parse_suppressions(self.lines)
+        self.tree = None
+        self.ctx = None
+        self.parse_finding = None
+        self.ranges = ()
+        if self.skip:
+            return
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_finding = Finding(
+                "PARSE", Severity.ERROR, path, e.lineno or 1,
+                (e.offset or 1) - 1, "syntax error: %s" % e.msg)
+            return
+        self.ranges = _block_ranges(self.tree, blocks)
+
+
+def _rule_enabled(rule, select, disable):
+    if select and rule.id not in select:
+        return False
+    if disable and rule.id in disable:
+        return False
+    return True
+
+
+def _lint_entries(entries, select=None, disable=None):
+    """Shared driver: build one Program over every parseable entry, run
+    module rules per file and program rules once, honor suppressions."""
+    from .interproc import Program
+
+    program = Program()
+    live = []
+    by_path = {}
+    out = {}  # path -> [Finding]
+    for e in entries:
+        out[e.path] = []
+        if e.skip:
             continue
-        if disable and rule.id in disable:
+        if e.parse_finding is not None:
+            out[e.path].append(e.parse_finding)
             continue
-        for hit in rule.checker(ctx):
-            node_or_line, col, message = hit
+        e.ctx = program.add_module(e.tree, e.path, e.lines)
+        live.append(e)
+        by_path[e.path] = e
+    program.finalize()
+
+    module_rules = [r for r in RULES.values() if r.scope == "module"
+                    and _rule_enabled(r, select, disable)]
+    program_rules = [r for r in RULES.values() if r.scope == "program"
+                     and _rule_enabled(r, select, disable)]
+    for e in live:
+        for rule in module_rules:
+            for hit in rule.checker(e.ctx):
+                node_or_line, col, message = hit
+                if isinstance(node_or_line, ast.AST):
+                    line = node_or_line.lineno
+                    col = node_or_line.col_offset if col is None else col
+                else:
+                    line = node_or_line
+                f = Finding(rule.id, rule.severity, e.path, line,
+                            col or 0, message)
+                if not _suppressed(f, e.per_line, e.ranges):
+                    out[e.path].append(f)
+    for rule in program_rules:
+        for hit in rule.checker(program):
+            path, node_or_line, col, message = hit
             if isinstance(node_or_line, ast.AST):
                 line = node_or_line.lineno
                 col = node_or_line.col_offset if col is None else col
@@ -170,10 +281,28 @@ def lint_source(source, path="<string>", select=None, disable=None):
                 line = node_or_line
             f = Finding(rule.id, rule.severity, path, line, col or 0,
                         message)
-            if not _suppressed(f, per_line):
-                findings.append(f)
-    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+            e = by_path.get(path)
+            if e is None or not _suppressed(f, e.per_line, e.ranges):
+                out.setdefault(path, []).append(f)
+
+    findings = []
+    for path in out:
+        findings.extend(sorted(out[path],
+                               key=lambda f: (f.line, f.col, f.rule)))
     return findings
+
+
+def lint_source(source, path="<string>", select=None, disable=None):
+    """Lint one source string; returns a list of :class:`Finding`.
+
+    ``select``/``disable``: iterables of rule ids restricting which rules
+    run.  Suppression comments are honored.  A syntax error yields a
+    single synthetic ``PARSE``-rule error finding rather than raising, so
+    one broken file cannot take down a whole-tree run.  A one-module
+    Program backs ``ctx.program``, so inter-procedural facts resolve
+    within the file."""
+    return _lint_entries([_Entry(source, path)], select=select,
+                         disable=disable)
 
 
 def lint_file(path, select=None, disable=None):
@@ -205,12 +334,15 @@ def iter_python_files(paths):
 
 
 def lint_paths(paths, select=None, disable=None):
-    """Lint files/trees; returns (findings, n_files)."""
-    findings = []
+    """Lint files/trees as ONE program (cross-module facts flow between
+    every file in the set); returns (findings, n_files)."""
     files = iter_python_files(paths)
+    entries = []
     for path in files:
-        findings.extend(lint_file(path, select=select, disable=disable))
-    return findings, len(files)
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            entries.append(_Entry(f.read(), path))
+    return _lint_entries(entries, select=select, disable=disable), \
+        len(files)
 
 
 # -- reporters --------------------------------------------------------------

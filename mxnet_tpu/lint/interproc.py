@@ -1,0 +1,427 @@
+"""mxlint inter-procedural plane: package-wide call graph + fact
+propagation (stdlib-only, like the rest of the analyzer).
+
+``Program`` parses every file once into per-module ``ModuleContext``s,
+builds a package-wide function table, and runs a fixpoint that
+propagates facts across resolved call edges:
+
+* **blocking** — the function (transitively) reaches a blocking
+  primitive: socket I/O, ``time.sleep``, thread join (feeds CC001);
+* **io_blocking** — restricted to raw socket-level I/O (feeds CC005);
+* **host_sync** — the function performs a device->host sync such as
+  ``.asnumpy()`` / ``.item()`` (feeds TS001);
+* **callback** — the function settles a Future (``set_result`` /
+  ``set_exception``) or fires a user callback ``on_*`` (feeds CC004);
+* **unbounded** — the function reaches a wait with no timeout:
+  ``x.join()`` / eventish ``x.wait()`` / ``input()`` (feeds CC005);
+
+plus the transitive **acquires** set (lock labels the function may
+take), from which the global lock acquisition-order graph is built and
+cycles reported (CC003) with one witness path per edge.
+
+Name resolution is deliberately conservative — precision over recall:
+
+* ``self.f(...)`` / ``cls.f(...)`` resolve within the enclosing class
+  (one level of same-module base classes included) or not at all;
+* a bare name resolves to same-module plain functions, an explicit
+  ``from x import f`` binding, or a package-unique def of that name;
+* ``obj.attr(...)`` resolves only when ``attr`` is package-unique AND
+  intention-revealing (underscore-prefixed or snake_case, never a
+  generic container/stream verb) — ``q.get()`` does not resolve to some
+  random class's blocking ``get``.
+
+Facts carry human-readable witness chains ("_call -> _send_msg ->
+sendall() at async_kv.py:203") so a finding three hops from the
+primitive still explains itself.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .rules import (BLOCKING_ATTRS, CALLBACK_PREFIXES, EVENTISH_TOKENS,
+                    GENERIC_METHOD_NAMES, HOST_SYNC_METHODS,
+                    SETTLE_CALLS, ModuleContext, _lock_exprs,
+                    _root_name, _terminal_name)
+
+__all__ = ["Program", "FunctionInfo"]
+
+_FACTS = ("blocking", "io_blocking", "host_sync", "callback", "unbounded")
+_MAX_ACQUIRES = 24   # per-function transitive lock-label cap
+_MAX_WHY = 220       # witness-chain length cap (chars)
+
+
+def _where(path, node):
+    return "%s:%d" % (os.path.basename(path), node.lineno)
+
+
+def _clip(why):
+    return why if len(why) <= _MAX_WHY else why[:_MAX_WHY] + "..."
+
+
+class FunctionInfo:
+    """Per-function facts: direct from one AST scan, then widened by the
+    package fixpoint."""
+
+    __slots__ = ("ctx", "node", "name", "cls", "qualname", "blocking",
+                 "io_blocking", "host_sync", "host_sync_depth",
+                 "callback", "unbounded", "acquires", "calls",
+                 "edges_direct")
+
+    def __init__(self, ctx, node):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.cls = ctx.class_of.get(id(node))
+        self.qualname = ".".join(
+            p for p in (ctx.module_stem, self.cls, node.name) if p)
+        self.blocking = None     # witness str, or None
+        self.io_blocking = None
+        self.host_sync = None
+        self.host_sync_depth = None  # hops from the direct sync
+        self.callback = None
+        self.unbounded = None
+        self.acquires = {}       # lock label -> (path, line, why)
+        self.calls = []          # (Call node, tuple(held lock labels))
+        self.edges_direct = []   # (label_a, label_b, path, line, why)
+
+    def __repr__(self):
+        return "FunctionInfo(%s)" % self.qualname
+
+
+class Program:
+    """Whole-package analysis state shared by every module's rules."""
+
+    def __init__(self):
+        self.contexts = []       # ModuleContext, in add order
+        self.functions = []      # FunctionInfo, in add order
+        self.by_node = {}        # id(def node) -> FunctionInfo
+        self.by_name = {}        # terminal name -> [FunctionInfo]
+        self._resolved = {}      # id(Call node) -> tuple(FunctionInfo)
+        self._edges = {}         # (a, b) -> (path, line, why)
+        self._finalized = False
+
+    # -- construction -----------------------------------------------------
+    def add_module(self, tree, path, lines):
+        ctx = ModuleContext(tree, path, lines)
+        ctx.program = self
+        self.contexts.append(ctx)
+        for fn in ctx.functions:
+            fi = FunctionInfo(ctx, fn)
+            self.functions.append(fi)
+            self.by_node[id(fn)] = fi
+            self.by_name.setdefault(fi.name, []).append(fi)
+            self._scan(fi)
+        return ctx
+
+    def _scan(self, fi):
+        """One pass over the function body: direct facts, calls with the
+        lock labels held at each call site, and direct nested-with lock
+        edges."""
+        ctx = fi.ctx
+
+        def visit(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef,
+                                      ast.Lambda)):
+                    continue  # nested defs get their own FunctionInfo
+                new_held = held
+                if isinstance(child, ast.With):
+                    labels = [self._lock_label(e, fi)
+                              for e in _lock_exprs(child)]
+                    for lbl in labels:
+                        fi.acquires.setdefault(
+                            lbl, (ctx.path, child.lineno,
+                                  "with %s at %s" % (
+                                      lbl, _where(ctx.path, child))))
+                        for h in held:
+                            if h != lbl:
+                                fi.edges_direct.append(
+                                    (h, lbl, ctx.path, child.lineno,
+                                     "%s takes %s inside %s"
+                                     % (fi.qualname, lbl, h)))
+                    fresh = tuple(l for l in labels if l not in held)
+                    if fresh:
+                        new_held = held + fresh
+                elif isinstance(child, ast.Call):
+                    self._note_call(fi, child, held)
+                visit(child, new_held)
+
+        visit(fi.node, ())
+
+    def _note_call(self, fi, call, held):
+        ctx = fi.ctx
+        name = _terminal_name(call.func)
+        fi.calls.append((call, held))
+        if name is None:
+            return
+        at = "%s() at %s" % (name, _where(ctx.path, call))
+        if ctx.is_blocking_call(call):
+            if fi.blocking is None:
+                fi.blocking = at
+            if name in BLOCKING_ATTRS and fi.io_blocking is None:
+                fi.io_blocking = at
+        if fi.callback is None and (
+                name in SETTLE_CALLS
+                or name.startswith(CALLBACK_PREFIXES)):
+            fi.callback = at
+        if fi.host_sync is None and isinstance(call.func, ast.Attribute) \
+                and name in HOST_SYNC_METHODS:
+            fi.host_sync = at
+            fi.host_sync_depth = 0
+        if fi.unbounded is None and not call.args and not call.keywords:
+            if isinstance(call.func, ast.Attribute) and name == "join":
+                fi.unbounded = "join() with no timeout at %s" \
+                    % _where(ctx.path, call)
+            elif isinstance(call.func, ast.Attribute) and name == "wait":
+                recv = _terminal_name(call.func.value) or ""
+                if set(recv.lower().split("_")) & EVENTISH_TOKENS:
+                    fi.unbounded = "%s.wait() with no timeout at %s" \
+                        % (recv, _where(ctx.path, call))
+            elif isinstance(call.func, ast.Name) and name == "input":
+                fi.unbounded = "input() at %s" % _where(ctx.path, call)
+
+    def _lock_label(self, expr, fi):
+        """Stable identity for a lock expression.  ``self._lock`` in a
+        method of ``C`` in module ``m`` -> ``m.C._lock`` (every instance
+        of the class shares ordering discipline); module globals ->
+        ``m.name``; function locals -> ``m.fn.name``."""
+        ctx = fi.ctx
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and fi.cls:
+                return "%s.%s.%s" % (ctx.module_stem, fi.cls, expr.attr)
+            root = _root_name(expr)
+            return "%s.%s" % (root or "?", expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in ctx.module_globals:
+                return "%s.%s" % (ctx.module_stem, expr.id)
+            return "%s.%s.%s" % (ctx.module_stem, fi.name, expr.id)
+        return _terminal_name(expr) or "<lock>"
+
+    # -- resolution -------------------------------------------------------
+    def _method_in_class(self, ctx, cls, name, _depth=0):
+        node = ctx.class_methods.get(cls, {}).get(name)
+        if node is not None:
+            return self.by_node.get(id(node))
+        if _depth >= 2:
+            return None
+        # one level of same-module inheritance
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ClassDef) and n.name == cls:
+                for base in n.bases:
+                    bname = _terminal_name(base)
+                    if bname and bname in ctx.class_methods:
+                        got = self._method_in_class(ctx, bname, name,
+                                                    _depth + 1)
+                        if got is not None:
+                            return got
+        return None
+
+    def _unique(self, name):
+        if name in GENERIC_METHOD_NAMES:
+            return []
+        cands = self.by_name.get(name, ())
+        return list(cands) if len(cands) == 1 else []
+
+    def resolve_callable(self, ctx, caller, expr):
+        """Resolve a callee expression to FunctionInfos.  ``caller`` is
+        the enclosing def node (or FunctionInfo, or None for module
+        level)."""
+        if isinstance(caller, ast.AST):
+            caller = self.by_node.get(id(caller))
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if caller is not None and name in ctx.params_of(caller.node):
+                return []  # a passed-in callable: unresolvable
+            binding = ctx.from_imports.get(name)
+            if binding is not None:
+                got = self._from_module(binding[0], binding[1])
+                if got:
+                    return got
+            local = [fi for fi in self.by_name.get(name, ())
+                     if fi.ctx is ctx and fi.cls is None]
+            if local:
+                return local
+            return self._unique(name)
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and caller is not None \
+                        and caller.cls:
+                    got = self._method_in_class(ctx, caller.cls, attr)
+                    return [got] if got is not None else []
+                stem = ctx.mod_aliases.get(base.id)
+                if stem is not None:
+                    return self._from_module(stem, attr)
+            if attr.startswith("_") or ("_" in attr and
+                                        attr not in GENERIC_METHOD_NAMES):
+                return self._unique(attr)
+            return []
+        return []
+
+    def _from_module(self, stem, name):
+        out = []
+        for ctx in self.contexts:
+            if ctx.module_stem != stem:
+                continue
+            for fi in self.by_name.get(name, ()):
+                if fi.ctx is ctx and fi.cls is None:
+                    out.append(fi)
+        return out
+
+    # -- fixpoint ---------------------------------------------------------
+    def finalize(self):
+        """Resolve every call site once, then widen facts and transitive
+        lock-acquire sets to a fixpoint; finally union the global lock
+        acquisition-order graph."""
+        if self._finalized:
+            return
+        self._finalized = True
+        for fi in self.functions:
+            for call, _held in fi.calls:
+                self._resolved[id(call)] = tuple(
+                    c for c in self.resolve_callable(fi.ctx, fi, call.func)
+                    if c is not fi)
+        changed, rounds = True, 0
+        while changed and rounds < 50:
+            changed, rounds = False, rounds + 1
+            for fi in self.functions:
+                for call, _held in fi.calls:
+                    for callee in self._resolved.get(id(call), ()):
+                        for fact in _FACTS:
+                            if getattr(fi, fact) is not None or \
+                                    getattr(callee, fact) is None:
+                                continue
+                            if fact == "host_sync":
+                                # Host-sync facts decay: past 2 hops the
+                                # chain is almost always host-side
+                                # bookkeeping (cache keys, logging), not
+                                # a tracer sync worth flagging.
+                                d = callee.host_sync_depth
+                                if d is None or d >= 2:
+                                    continue
+                                fi.host_sync_depth = d + 1
+                            setattr(fi, fact, _clip(
+                                "%s -> %s" % (callee.qualname,
+                                              getattr(callee, fact))))
+                            changed = True
+                        if len(fi.acquires) < _MAX_ACQUIRES:
+                            for lbl, (p, ln, why) in \
+                                    callee.acquires.items():
+                                if lbl not in fi.acquires:
+                                    fi.acquires[lbl] = (p, ln, _clip(
+                                        "via %s: %s" % (callee.qualname,
+                                                        why)))
+                                    changed = True
+        for fi in self.functions:
+            for (a, b, p, ln, why) in fi.edges_direct:
+                self._edges.setdefault((a, b), (p, ln, why))
+            for call, held in fi.calls:
+                if not held:
+                    continue
+                for callee in self._resolved.get(id(call), ()):
+                    for lbl, (_p, _ln, why) in callee.acquires.items():
+                        for h in held:
+                            if h != lbl:
+                                self._edges.setdefault(
+                                    (h, lbl),
+                                    (fi.ctx.path, call.lineno, _clip(
+                                        "%s calls %s under %s; %s"
+                                        % (fi.qualname, callee.qualname,
+                                           h, why))))
+
+    # -- rule queries -----------------------------------------------------
+    def _fact_of_call(self, ctx, caller, call, fact):
+        if not self._finalized:
+            self.finalize()
+        callees = self._resolved.get(id(call))
+        if callees is None:  # call site outside any scanned function
+            callees = tuple(self.resolve_callable(ctx, caller, call.func))
+        for callee in callees:
+            why = getattr(callee, fact)
+            if why is not None:
+                return callee, why
+        return None, None
+
+    def blocking_callee(self, ctx, caller, call):
+        """Witness chain if the resolved callee transitively blocks."""
+        callee, why = self._fact_of_call(ctx, caller, call, "blocking")
+        if callee is None:
+            return None
+        return _clip("%s -> %s" % (callee.qualname, why)
+                     if not why.startswith(callee.qualname) else why)
+
+    def host_sync_callee(self, ctx, caller, call):
+        """Witness chain if the resolved callee transitively performs a
+        device->host sync (traced callees excluded — they are flagged at
+        the source)."""
+        callee, why = self._fact_of_call(ctx, caller, call, "host_sync")
+        if callee is None or callee.node in callee.ctx.traced:
+            return None
+        return _clip("%s: %s" % (callee.qualname, why))
+
+    def callback_callee(self, ctx, caller, call):
+        """Witness chain if the resolved callee settles a future or
+        fires a user callback."""
+        callee, why = self._fact_of_call(ctx, caller, call, "callback")
+        if callee is None:
+            return None
+        return _clip("%s: %s" % (callee.qualname, why))
+
+    # -- lock-order cycles (CC003) ----------------------------------------
+    def lock_cycles(self):
+        """Enumerate acquisition-order cycles, one per distinct node
+        set, as lists of ``(a, b, path, line, why)`` edges."""
+        if not self._finalized:
+            self.finalize()
+        adj = {}
+        for (a, b) in self._edges:
+            adj.setdefault(a, []).append(b)
+        seen = set()
+        cycles = []
+        for (a, b) in sorted(self._edges):
+            path_back = self._bfs(b, a, adj)
+            if path_back is None:
+                continue
+            nodes = [a] + path_back  # [a, b, ..., a]
+            key = frozenset(nodes)
+            if key in seen:
+                continue
+            seen.add(key)
+            edges = []
+            for x, y in zip(nodes, nodes[1:]):
+                wit = self._edges.get((x, y))
+                if wit is None:
+                    continue
+                edges.append((x, y, wit[0], wit[1], wit[2]))
+            if edges:
+                cycles.append(edges)
+        return cycles
+
+    def _bfs(self, start, goal, adj):
+        """Shortest path start -> ... -> goal, or None."""
+        if start == goal:
+            return [start]
+        frontier = [start]
+        came = {start: None}
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for m in adj.get(n, ()):
+                    if m in came:
+                        continue
+                    came[m] = n
+                    if m == goal:
+                        out = [m]
+                        while came[out[-1]] is not None:
+                            out.append(came[out[-1]])
+                        return list(reversed(out))
+                    nxt.append(m)
+            frontier = nxt
+        return None

@@ -30,8 +30,9 @@ are known at trace time and safe to branch on.
 from __future__ import annotations
 
 import ast
+import os
 
-from .core import Severity, register_rule
+from .core import Severity, register_program_rule, register_rule
 
 __all__ = ["ModuleContext"]
 
@@ -73,6 +74,30 @@ BLOCKING_ATTRS = frozenset({"recv", "recvfrom", "recv_into", "accept",
                             "sendall", "connect", "create_connection",
                             "select", "poll"})
 TIME_BLOCKING = frozenset({"sleep"})
+
+# Future-settle surface (CC004): settling a concurrent.futures-style
+# future runs its done-callbacks INLINE in the settling thread — doing it
+# under a lock runs arbitrary user code inside the critical section
+SETTLE_CALLS = frozenset({"set_result", "set_exception"})
+# user-callback naming convention (on_token / on_batch / _on_scale_up)
+CALLBACK_PREFIXES = ("on_", "_on_")
+
+# receiver-name tokens that mark a `.wait()` as an event wait (CC005):
+# an unbounded Event.wait() inside a supervisor tick never times out
+EVENTISH_TOKENS = frozenset({"evt", "event", "stop", "done", "ready",
+                             "quit", "shutdown", "flag"})
+
+# method names too generic for package-unique attribute resolution —
+# `q.get()` must never resolve to some random class's blocking `get`
+GENERIC_METHOD_NAMES = frozenset({
+    "get", "put", "set", "add", "pop", "run", "start", "stop", "close",
+    "open", "read", "write", "send", "join", "wait", "acquire",
+    "release", "append", "extend", "update", "clear", "next", "flush",
+    "copy", "items", "keys", "values", "submit", "result", "cancel",
+    "done", "count", "index", "sort", "reverse", "insert", "remove",
+    "encode", "decode", "strip", "split", "format", "mean", "sum",
+    "tolist", "step", "reset", "call", "apply", "emit", "push", "pull",
+})
 
 
 def _terminal_name(node):
@@ -126,6 +151,10 @@ class ModuleContext:
         self.tree = tree
         self.path = path
         self.lines = lines
+        self.module_stem = os.path.splitext(os.path.basename(path))[0]
+        #: set by interproc.Program when this module is part of a
+        #: package-wide run; rules consult it for cross-function facts
+        self.program = None
         self.numpy_aliases = set()      # names bound to the numpy module
         self.np_random_aliases = set()  # names bound to numpy.random
         self.random_aliases = set()     # names bound to stdlib random
@@ -134,6 +163,8 @@ class ModuleContext:
         self.from_random_names = set()  # from random import <name>
         self.from_time_names = set()    # from time import sleep
         self.thread_ctor_names = set()  # from threading import Thread
+        self.mod_aliases = {}           # local name -> module stem
+        self.from_imports = {}          # local name -> (mod stem, orig)
         self._collect_imports()
         self.functions = [n for n in ast.walk(tree)
                           if isinstance(n, (ast.FunctionDef,
@@ -145,6 +176,28 @@ class ModuleContext:
         for parent in ast.walk(tree):
             for child in ast.iter_child_nodes(parent):
                 self._parents[child] = parent
+        self.class_methods = {}         # class name -> {method: def}
+        self.class_of = {}              # id(def) -> class name
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                methods = self.class_methods.setdefault(node.name, {})
+                for ch in node.body:
+                    if isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        methods[ch.name] = ch
+                        self.class_of[id(ch)] = node.name
+        self.module_globals = set()     # names assigned at module level
+        for st in tree.body:
+            tgts = ()
+            if isinstance(st, ast.Assign):
+                tgts = st.targets
+            elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+                tgts = (st.target,)
+            for tgt in tgts:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name) and isinstance(
+                            n.ctx, ast.Store):
+                        self.module_globals.add(n.id)
         self.traced = self._find_traced()
         self._locals_cache = {}
 
@@ -155,6 +208,8 @@ class ModuleContext:
                 for alias in node.names:
                     name = alias.asname or alias.name.split(".")[0]
                     mod = alias.name
+                    self.mod_aliases[name] = mod.split(".")[-1] \
+                        if alias.asname else mod.split(".")[0]
                     if mod in ("numpy", "jax.numpy"):
                         self.numpy_aliases.add(name)
                     elif mod in ("numpy.random",):
@@ -169,6 +224,11 @@ class ModuleContext:
                 mod = node.module or ""
                 for alias in node.names:
                     name = alias.asname or alias.name
+                    if mod:
+                        self.from_imports[name] = (mod.split(".")[-1],
+                                                   alias.name)
+                    elif node.level:  # from . import sibling
+                        self.mod_aliases[name] = alias.name.split(".")[-1]
                     if mod == "numpy" and alias.name == "random":
                         self.np_random_aliases.add(name)
                     elif mod == "random":
@@ -393,6 +453,14 @@ class ModuleContext:
         time.sleep, Thread/Process.join — str.join is screened out by its
         single non-numeric argument.)"""
         name = _terminal_name(call.func)
+        if name == "poll":
+            # select poller.poll(timeout) blocks; Popen.poll() is an
+            # instant status probe — distinguish by argument presence
+            # and a poller-ish receiver name
+            recv = _terminal_name(call.func.value) \
+                if isinstance(call.func, ast.Attribute) else None
+            return bool(call.args) or bool(
+                recv and ("poll" in recv.lower() or "sel" in recv.lower()))
         if name in BLOCKING_ATTRS:
             return True
         if name == "join":
@@ -480,6 +548,19 @@ def check_host_sync(ctx):
                 yield (node, None,
                        "jax.device_get inside traced function %r is a "
                        "host transfer at trace time." % fn.name)
+            elif name is not None and ctx.program is not None:
+                # inter-procedural: a helper that syncs taints the
+                # traced caller (the helper itself is only flagged when
+                # it is traced in its own right)
+                hit = ctx.program.host_sync_callee(ctx, fn, node)
+                if hit is not None:
+                    yield (node, None,
+                           "%r performs a device->host sync (via %s) "
+                           "and is called inside traced function %r: "
+                           "the sync runs at trace time (baked "
+                           "constant) or raises on a tracer. Sync "
+                           "outside the traced region."
+                           % (name, hit, fn.name))
 
 
 @register_rule("TS002", Severity.ERROR,
@@ -801,12 +882,68 @@ def check_unguarded_math(ctx):
 # ===========================================================================
 def _lockish(expr):
     """Is this `with` context expression a lock?  Name/Attribute chains
-    whose terminal identifier contains 'lock' or 'mutex'."""
+    whose terminal identifier contains 'lock'/'mutex', or whose
+    snake_case tokens name a condition variable or semaphore (entering
+    ``with cv:`` acquires the underlying lock all the same)."""
     name = _terminal_name(expr)
     if name is None:
         return False
     low = name.lower()
-    return "lock" in low or "mutex" in low
+    if "lock" in low or "mutex" in low:
+        return True
+    tokens = set(low.split("_"))
+    return bool(tokens & {"cv", "cond", "condition", "sem", "semaphore"})
+
+
+def _lock_exprs(with_node):
+    """The lockish context expressions of a ``with`` statement (the
+    callee for ``with make_lock():`` call forms)."""
+    out = []
+    for item in with_node.items:
+        expr = item.context_expr
+        tgt = expr.func if isinstance(expr, ast.Call) else expr
+        if _lockish(tgt):
+            out.append(tgt)
+    return out
+
+
+def _thread_creations(ctx):
+    """Yield ``(call_node, daemon, target_expr)`` for every
+    ``threading.Thread(...)`` construction in the module.  ``daemon`` is
+    True when the ctor passes ``daemon=True`` or the bound name later
+    gets ``.daemon = True`` assigned."""
+    daemon_assigned = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
+                    root = _terminal_name(tgt.value)
+                    if root:
+                        daemon_assigned.add(root)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name != "Thread":
+            continue
+        dotted = _dotted(node.func)
+        if dotted and "." in dotted:
+            if dotted.split(".")[0] not in ctx.threading_aliases:
+                continue
+        elif name not in ctx.thread_ctor_names:
+            continue
+        daemon = any(kw.arg == "daemon"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True for kw in node.keywords)
+        if not daemon:
+            parent = ctx._parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for tgt in parent.targets:
+                    if _terminal_name(tgt) in daemon_assigned:
+                        daemon = True
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        yield node, daemon, target
 
 
 @register_rule("CC001", Severity.ERROR,
@@ -816,20 +953,23 @@ def check_lock_blocking(ctx):
     thread join, sleep) serializes every other thread on I/O latency —
     and deadlocks outright if the blocked peer needs the same lock.
     Move the blocking call outside the critical section (stage the data
-    under the lock, send after release)."""
+    under the lock, send after release).
+
+    With the inter-procedural pass (always on), a call whose resolved
+    callee *transitively* blocks — a helper one or more hops away that
+    sleeps or does socket I/O — taints the call site under the lock; the
+    message carries the witness chain down to the blocking primitive."""
     blocking_fns = ctx.blocking_functions()
+    prog = ctx.program
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.With):
             continue
-        if not any(_lockish(item.context_expr)
-                   or (isinstance(item.context_expr, ast.Call)
-                       and _lockish(item.context_expr.func))
-                   for item in node.items):
+        lock_exprs = _lock_exprs(node)
+        if not lock_exprs:
             continue
-        lock_names = [_terminal_name(
-            it.context_expr.func if isinstance(it.context_expr, ast.Call)
-            else it.context_expr) for it in node.items]
-        lock_label = next((n for n in lock_names if n), "lock")
+        lock_label = next((_terminal_name(e) for e in lock_exprs
+                           if _terminal_name(e)), "lock")
+        caller = ctx._enclosing_fn(node)
         for inner in ast.walk(node):
             if inner is node or not isinstance(inner, ast.Call):
                 continue
@@ -841,15 +981,26 @@ def check_lock_blocking(ctx):
                        "Stage under the lock, block after release."
                        % (_terminal_name(inner.func) or "call",
                           lock_label))
-            else:
-                callee = _terminal_name(inner.func)
-                if callee in blocking_fns and callee is not None:
+                continue
+            callee = _terminal_name(inner.func)
+            if callee is None:
+                continue
+            if prog is not None:
+                hit = prog.blocking_callee(ctx, caller, inner)
+                if hit is not None:
                     yield (inner, None,
-                           "%r (which performs blocking I/O) called "
-                           "while holding %r: the critical section "
-                           "waits on the network. Stage the payload "
-                           "under the lock and call %r after release."
-                           % (callee, lock_label, callee))
+                           "%r blocks while holding %r (via %s): the "
+                           "critical section waits on the I/O. Stage "
+                           "the payload under the lock and call %r "
+                           "after release." % (callee, lock_label,
+                                               hit, callee))
+            elif callee in blocking_fns:
+                yield (inner, None,
+                       "%r (which performs blocking I/O) called "
+                       "while holding %r: the critical section "
+                       "waits on the network. Stage the payload "
+                       "under the lock and call %r after release."
+                       % (callee, lock_label, callee))
 
 
 @register_rule("CC002", Severity.ERROR,
@@ -912,3 +1063,217 @@ def check_thread_lifecycle(ctx):
                "zombie worker on preemption). Pass daemon=True or join "
                "it on every exit path."
                % (" bound to %r" % target if target else ""))
+
+
+@register_program_rule("CC003", Severity.ERROR,
+                       "lock-order inversion across the package")
+def check_lock_order_cycles(program):
+    """Two code paths that acquire the same pair of locks in opposite
+    orders can deadlock the moment both run concurrently — each holds
+    what the other needs.  The acquisition-order graph is unioned across
+    the whole package (nested ``with`` blocks plus lock acquisitions
+    reached through resolved calls), so an inversion between, say, a
+    serving method and a fleet callback is caught even though no single
+    function ever takes both locks.  Every cycle is reported once, with
+    a witness path for each edge."""
+    for cycle in program.lock_cycles():
+        (a, b, path, line, _why) = cycle[0]
+        edges = "; ".join(
+            "%s -> %s at %s:%d (%s)" % (ea, eb, os.path.basename(ep),
+                                        el, ew)
+            for (ea, eb, ep, el, ew) in cycle)
+        yield (path, line, 0,
+               "lock-order cycle: %s. Threads taking these locks in "
+               "opposite orders deadlock under contention; pick one "
+               "global order and acquire in that order everywhere "
+               "(docs/STATIC_ANALYSIS.md#lock-ordering)." % edges)
+
+
+@register_rule("CC004", Severity.ERROR,
+               "user callback or Future settled while holding a lock")
+def check_callback_under_lock(ctx):
+    """Settling a future (``set_result``/``set_exception``) runs its
+    done-callbacks INLINE in the settling thread; firing a user callback
+    (``on_token``/``on_batch``/...) hands arbitrary user code the
+    thread.  Doing either while holding a lock means user code executes
+    inside the critical section — it can block forever, or re-enter the
+    server and deadlock on the very lock being held.  Stage the
+    callback/settle under the lock, fire after release."""
+    prog = ctx.program
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        lock_exprs = _lock_exprs(node)
+        if not lock_exprs:
+            continue
+        lock_label = next((_terminal_name(e) for e in lock_exprs
+                           if _terminal_name(e)), "lock")
+        caller = ctx._enclosing_fn(node)
+        for inner in ast.walk(node):
+            if inner is node or not isinstance(inner, ast.Call):
+                continue
+            name = _terminal_name(inner.func)
+            if name is None:
+                continue
+            if name in SETTLE_CALLS:
+                yield (inner, None,
+                       "%s() while holding %r: settling a future runs "
+                       "its done-callbacks inline inside the critical "
+                       "section (user code can block or re-enter and "
+                       "deadlock). Collect the future under the lock, "
+                       "settle after release." % (name, lock_label))
+            elif name.startswith(CALLBACK_PREFIXES):
+                yield (inner, None,
+                       "user callback %s() fired while holding %r: "
+                       "callbacks must fire OUTSIDE all locks (user "
+                       "code can block, or call back into the server "
+                       "and deadlock on %r). Stage the payload under "
+                       "the lock, fire after release."
+                       % (name, lock_label, lock_label))
+            elif prog is not None:
+                hit = prog.callback_callee(ctx, caller, inner)
+                if hit is not None:
+                    yield (inner, None,
+                           "%r settles a future or fires a user "
+                           "callback (via %s) while %r is held: user "
+                           "code runs inside the critical section. "
+                           "Move the call after release."
+                           % (name, hit, lock_label))
+
+
+@register_rule("CC005", Severity.WARNING,
+               "unbounded blocking reachable from a daemon loop")
+def check_daemon_loop_blocking(ctx):
+    """Daemon supervisor ticks (heartbeat/control/monitor loops) must
+    bound every wait: the loop body reaching raw socket I/O or an
+    unbounded ``join()``/``Event.wait()`` means one wedged peer stalls
+    the tick forever — heartbeats stop, TTLs expire, and the fleet
+    declares a healthy process dead.  Route I/O through a
+    timeout-bearing transport and give every wait a timeout."""
+    prog = ctx.program
+    if prog is None:
+        return
+    for node, daemon, target in _thread_creations(ctx):
+        if not daemon or target is None:
+            continue
+        caller = ctx._enclosing_fn(node)
+        for finfo in prog.resolve_callable(ctx, caller, target):
+            why = finfo.io_blocking or finfo.unbounded
+            if why is None:
+                continue
+            kind = ("raw socket I/O" if finfo.io_blocking
+                    else "an unbounded wait")
+            yield (node, None,
+                   "daemon loop %r reaches %s (%s): a wedged peer "
+                   "stalls this supervisor tick forever (missed "
+                   "heartbeats read as process death). Bound every "
+                   "wait and use a timeout-bearing transport."
+                   % (finfo.qualname, kind, why))
+            break
+
+
+@register_rule("TS007", Severity.ERROR,
+               "unhashable/unstable static arg to TrackedJit")
+def check_static_arg_stability(ctx):
+    """``static_argnums`` hashes the argument into the compile-cache
+    key.  A dict/list/set there raises ``TypeError: unhashable`` at the
+    first call — or, with a mutable *default* on the wrapped function,
+    every call site that builds a fresh container forces a fresh trace:
+    a recompile storm the flight recorder can only report after the
+    fact.  Pass tuples / frozensets / scalars in static positions."""
+
+    def static_positions(call):
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    return tuple(el.value for el in kw.value.elts
+                                 if isinstance(el, ast.Constant)
+                                 and isinstance(el.value, int))
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, int):
+                    return (kw.value.value,)
+        return ()
+
+    def is_mutable_literal(node):
+        if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                             ast.ListComp, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("dict", "list", "set"))
+
+    def is_jit_ctor(call):
+        return _terminal_name(call.func) in ("jit", "TrackedJit")
+
+    def check_fn_defaults(call, positions):
+        """TrackedJit(f, static_argnums=(k,)) where f's k-th param
+        carries a dict/list/set default."""
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return
+        cands = ctx.func_by_name.get(call.args[0].id, ())
+        if len(cands) != 1:
+            if ctx.program is None:
+                return
+            infos = ctx.program.resolve_callable(ctx, None, call.args[0])
+            if len(infos) != 1:
+                return
+            cands = (infos[0].node,)
+        fn = cands[0]
+        pos = getattr(fn.args, "posonlyargs", []) + fn.args.args
+        n_default = len(fn.args.defaults)
+        first_default = len(pos) - n_default
+        for k in positions:
+            if k >= len(pos):
+                continue
+            d = k - first_default
+            if 0 <= d < n_default and is_mutable_literal(
+                    fn.args.defaults[d]):
+                yield (call, None,
+                       "static arg %d of %r defaults to a dict/list/"
+                       "set: unhashable in the compile-cache key "
+                       "(TypeError at call time) and a fresh default "
+                       "per call means a fresh trace per call — a "
+                       "recompile storm. Use a tuple/frozenset/None "
+                       "sentinel." % (k, call.args[0].id))
+
+    for scope in [ctx.tree] + ctx.functions:
+        walk = _walk_skip_nested(scope) if scope is not ctx.tree else (
+            n for n in _walk_skip_nested(scope)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        nodes = sorted((n for n in walk
+                        if isinstance(n, (ast.Assign, ast.Call))),
+                       key=lambda n: (n.lineno, n.col_offset))
+        static_of = {}  # local name -> static positions
+        for n in nodes:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                call = n.value
+                if is_jit_ctor(call):
+                    positions = static_positions(call)
+                    for hit in check_fn_defaults(call, positions):
+                        yield hit
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            if positions:
+                                static_of[tgt.id] = positions
+                            else:
+                                static_of.pop(tgt.id, None)
+            elif isinstance(n, ast.Call):
+                positions = None
+                if isinstance(n.func, ast.Name) and \
+                        n.func.id in static_of:
+                    positions = static_of[n.func.id]
+                elif isinstance(n.func, ast.Call) and is_jit_ctor(n.func):
+                    positions = static_positions(n.func)
+                    for hit in check_fn_defaults(n.func, positions):
+                        yield hit
+                if not positions:
+                    continue
+                for k in positions:
+                    if k < len(n.args) and is_mutable_literal(n.args[k]):
+                        yield (n.args[k], None,
+                               "dict/list/set passed in static position "
+                               "%d: static args are hashed into the "
+                               "compile-cache key — this raises "
+                               "TypeError (unhashable), and a fresh "
+                               "container per call would retrace per "
+                               "call. Pass a tuple/frozenset." % k)

@@ -1,0 +1,15 @@
+"""CC001 bad (inter-procedural): the blocking call is one helper deep —
+the caller never touches the socket, but the helper it invokes under the
+lock does."""
+import threading
+
+lock = threading.Lock()
+
+
+def _send_frame(sock, payload):
+    sock.sendall(payload)
+
+
+def flush(sock, payload):
+    with lock:
+        _send_frame(sock, payload)

@@ -1,0 +1,12 @@
+"""CC001 cross-module fixture, caller half: holds a lock across a
+blocking helper imported from another module."""
+import threading
+
+from bad_cc001_x_helper import _push_wire
+
+lock = threading.Lock()
+
+
+def publish(sock, blob):
+    with lock:
+        _push_wire(sock, blob)

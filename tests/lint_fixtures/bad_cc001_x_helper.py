@@ -1,0 +1,6 @@
+"""CC001 cross-module fixture, helper half: a transport primitive that
+blocks (paired with bad_cc001_x_caller.py)."""
+
+
+def _push_wire(sock, blob):
+    sock.sendall(blob)

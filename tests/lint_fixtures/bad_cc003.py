@@ -1,0 +1,18 @@
+"""CC003 bad: two code paths acquire the same pair of module locks in
+opposite orders — a deadlock under contention."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def backward():
+    with lock_b:
+        with lock_a:
+            pass

@@ -1,0 +1,20 @@
+"""CC003 cross-module fixture, server half: takes its own lock, then
+calls into the store while holding it — the opposite order of
+bad_cc003_x_store.Store._apply_update."""
+import threading
+
+from bad_cc003_x_store import Store
+
+
+class Server:
+    def __init__(self):
+        self._wait_lock = threading.Lock()
+        self.store = Store()
+
+    def _notify_waiters(self, key, value):
+        with self._wait_lock:
+            pass
+
+    def _drain(self, key, value):
+        with self._wait_lock:
+            self.store._apply_update(self, key, value)

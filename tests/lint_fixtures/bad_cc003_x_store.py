@@ -1,0 +1,13 @@
+"""CC003 cross-module fixture, store half: takes its own lock, then
+calls into the server while holding it (paired with
+bad_cc003_x_server.py — no single function ever takes both locks)."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._store_lock = threading.Lock()
+
+    def _apply_update(self, server, key, value):
+        with self._store_lock:
+            server._notify_waiters(key, value)

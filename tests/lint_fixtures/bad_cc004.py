@@ -1,0 +1,18 @@
+"""CC004 bad: a future is settled and a user callback fired inside the
+critical section — user code runs while the lock is held."""
+import threading
+
+
+class Streamer:
+    def __init__(self, on_token):
+        self._lock = threading.Lock()
+        self._on_token = on_token
+        self._waiters = []
+
+    def finish(self, fut, value):
+        with self._lock:
+            fut.set_result(value)
+
+    def emit(self, token):
+        with self._lock:
+            self._on_token(token)

@@ -1,0 +1,12 @@
+"""CC004 cross-module fixture, caller half: the settle happens one
+imported helper deep, still inside the critical section."""
+import threading
+
+from bad_cc004_x_helper import _settle_waiter
+
+lock = threading.Lock()
+
+
+def finish(fut, value):
+    with lock:
+        _settle_waiter(fut, value)

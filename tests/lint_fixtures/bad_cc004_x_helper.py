@@ -1,0 +1,6 @@
+"""CC004 cross-module fixture, helper half: settles a future (paired
+with bad_cc004_x_caller.py, which invokes this under a lock)."""
+
+
+def _settle_waiter(fut, value):
+    fut.set_result(value)

@@ -1,0 +1,22 @@
+"""CC005 bad: a daemon supervisor loop reaches raw socket I/O and an
+unbounded join — one wedged peer stalls the tick forever."""
+import threading
+
+
+class Beater:
+    def __init__(self, sock, worker):
+        self._sock = sock
+        self._worker = worker
+        t = threading.Thread(target=self._beat_loop, daemon=True)
+        t.start()
+
+    def _beat_loop(self):
+        while True:
+            self._sock.recv(1024)
+
+
+def watch(worker):
+    def _watch_loop():
+        worker.join()
+
+    threading.Thread(target=_watch_loop, daemon=True).start()

@@ -1,0 +1,7 @@
+"""CC005 cross-module fixture, loop half: the daemon body that blocks
+on raw socket I/O (paired with bad_cc005_x_spawn.py)."""
+
+
+def _recv_loop(sock):
+    while True:
+        sock.recv(4096)

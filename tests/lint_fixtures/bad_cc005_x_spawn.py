@@ -1,0 +1,11 @@
+"""CC005 cross-module fixture, spawn half: registers an imported loop
+body as a daemon thread target."""
+import threading
+
+from bad_cc005_x_loop import _recv_loop
+
+
+def start(sock):
+    t = threading.Thread(target=_recv_loop, args=(sock,), daemon=True)
+    t.start()
+    return t

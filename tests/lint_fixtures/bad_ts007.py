@@ -1,0 +1,14 @@
+"""TS007 bad: dict/list/set in static_argnums positions — unhashable
+compile-cache keys and per-call retraces."""
+from mxnet_tpu.dispatch import TrackedJit
+
+
+def kernel(x, cfg={}):
+    return x
+
+
+step = TrackedJit(kernel, static_argnums=(1,))
+
+
+def run(x):
+    return step(x, ["fresh", "list", "every", "call"])

@@ -1,0 +1,6 @@
+"""TS007 cross-module fixture, kernel half: the static param's mutable
+default lives in another module than the TrackedJit wrapping."""
+
+
+def fused_kernel(x, cfg={}):
+    return x

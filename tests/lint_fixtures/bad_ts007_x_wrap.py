@@ -1,0 +1,7 @@
+"""TS007 cross-module fixture, wrap half: TrackedJit marks static a
+param whose dict default is defined in the imported module."""
+from mxnet_tpu.dispatch import TrackedJit
+
+from bad_ts007_x_kernel import fused_kernel
+
+step = TrackedJit(fused_kernel, static_argnums=(1,))

@@ -1,0 +1,17 @@
+"""CC001 good (inter-procedural): the helper blocks, but the caller
+stages under the lock and invokes the helper after release."""
+import threading
+
+lock = threading.Lock()
+pending = []
+
+
+def _send_frame(sock, payload):
+    sock.sendall(payload)
+
+
+def flush(sock):
+    with lock:
+        payload = b"".join(pending)
+        pending.clear()
+    _send_frame(sock, payload)

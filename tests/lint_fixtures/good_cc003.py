@@ -1,0 +1,17 @@
+"""CC003 good: every path takes the pair in the same global order."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def also_forward():
+    with lock_a:
+        with lock_b:
+            pass

@@ -1,0 +1,26 @@
+"""CC004 good: the settle/callback payload is staged under the lock and
+fired after release."""
+import threading
+
+
+class Streamer:
+    def __init__(self, on_token):
+        self._lock = threading.Lock()
+        self._on_token = on_token
+        self._pending = []
+
+    def finish(self, fut, value):
+        with self._lock:
+            self._pending.append((fut, value))
+        for f, v in self._drain():
+            f.set_result(v)
+
+    def emit(self, token):
+        with self._lock:
+            staged = token
+        self._on_token(staged)
+
+    def _drain(self):
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out

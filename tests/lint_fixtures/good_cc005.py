@@ -1,0 +1,16 @@
+"""CC005 good: every wait in the daemon loop is bounded."""
+import threading
+
+
+class Beater:
+    def __init__(self):
+        self._stop_evt = threading.Event()
+        t = threading.Thread(target=self._beat_loop, daemon=True)
+        t.start()
+
+    def _beat_loop(self):
+        while not self._stop_evt.wait(timeout=0.5):
+            self._tick()
+
+    def _tick(self):
+        pass

@@ -1,0 +1,13 @@
+"""TS007 good: static positions carry hashable, stable values."""
+from mxnet_tpu.dispatch import TrackedJit
+
+
+def kernel(x, cfg=()):
+    return x
+
+
+step = TrackedJit(kernel, static_argnums=(1,))
+
+
+def run(x):
+    return step(x, ("stable", "tuple"))

@@ -1,0 +1,18 @@
+"""CC003 suppressed: the inversion is real but audited (e.g. guarded by
+an outer serialization the analyzer cannot see)."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:  # mxlint: disable=CC003 -- serialized by caller
+            pass
+
+
+def backward():
+    with lock_b:
+        with lock_a:  # mxlint: disable=CC003 -- serialized by caller
+            pass

@@ -1,0 +1,13 @@
+"""TS007 suppressed: the container is audited as call-site-constant."""
+from mxnet_tpu.dispatch import TrackedJit
+
+
+def kernel(x, axes):
+    return x
+
+
+step = TrackedJit(kernel, static_argnums=(1,))
+
+
+def run(x):
+    return step(x, [0, 1])  # mxlint: disable=TS007 -- module constant

@@ -1,0 +1,232 @@
+"""Runtime lock-order sanitizer (mxnet_tpu.lockdep).
+
+Covers: the order graph from a real two-thread inversion, record vs
+raise semantics (raise fires BEFORE the deadlocking acquire), scope
+discipline (only mxnet_tpu-created locks are wrapped; zero overhead
+when off), held-across-blocking recording, Condition/RLock integration,
+the lockdep.* telemetry gauges, and the debug-bundle section
+round-trip.
+"""
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import subprocess_env
+
+import mxnet_tpu  # noqa: F401  (install_from_env runs at import)
+from mxnet_tpu import debug, lockdep, telemetry
+from mxnet_tpu.lockdep import _LockWrapper
+
+
+def _wrapped(site, kind="Lock"):
+    real = threading._allocate_lock() if kind == "Lock" \
+        else threading._RLock()
+    return _LockWrapper(real, site, kind)
+
+
+@pytest.fixture
+def recording():
+    """Arm record mode for one test, restore and wipe afterwards."""
+    was_installed = lockdep.installed()
+    lockdep.install("record")
+    lockdep.reset()
+    try:
+        yield lockdep
+    finally:
+        if not was_installed:
+            lockdep.uninstall()
+        lockdep.reset()
+
+
+def _run_inverted_pair(a, b):
+    """Take a->b on one thread, then b->a on another (sequentially, so
+    the test itself cannot deadlock)."""
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (order_ab, order_ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_two_thread_inversion_recorded(recording):
+    a = _wrapped("store.py:10")
+    b = _wrapped("server.py:20")
+    _run_inverted_pair(a, b)
+    snap = lockdep.snapshot()
+    assert snap["counters"]["inversions"] == 1
+    assert snap["counters"]["edges"] == 1          # the reverse edge is
+    (inv,) = snap["inversions"]                    # reported, not added
+    assert {inv["a"], inv["b"]} == {"store.py:10", "server.py:20"}
+    # both witness paths, each naming its thread's acquire sites
+    assert "store.py:10" in inv["path_ab"] and "server.py:20" in inv["path_ab"]
+    assert "store.py:10" in inv["path_ba"] and "server.py:20" in inv["path_ba"]
+
+
+def test_record_mode_never_raises(recording):
+    a = _wrapped("rec_a.py:1")
+    b = _wrapped("rec_b.py:2")
+    _run_inverted_pair(a, b)                       # no LockOrderError
+    assert lockdep.snapshot()["counters"]["inversions"] == 1
+
+
+def test_raise_mode_fires_before_the_deadlocking_acquire(recording):
+    lockdep.install("raise")
+    a = _wrapped("raise_a.py:1")
+    b = _wrapped("raise_b.py:2")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockdep.LockOrderError, match="lock-order"):
+        with b:
+            with a:
+                pass
+    # the raise happened BEFORE taking a: nothing is left held
+    assert not a._inner.locked()
+    assert not b._inner.locked()
+    with a:                                        # clean held stack
+        pass
+
+
+def test_same_site_edges_skipped(recording):
+    """Two locks from one creation site (per-instance locks of a class)
+    are ordering-equivalent — opposite orders are not an inversion."""
+    a = _wrapped("cls.py:7")
+    b = _wrapped("cls.py:7")
+    _run_inverted_pair(a, b)
+    snap = lockdep.snapshot()
+    assert snap["counters"]["inversions"] == 0
+    assert snap["counters"]["edges"] == 0
+
+
+def test_held_across_blocking_recorded_not_raised(recording):
+    lockdep.install("raise")                       # even in raise mode
+    lk = _wrapped("transport.py:5")
+    with lk:
+        time.sleep(0.001)                          # auto-instrumented
+        lockdep.note_blocking("recv_msg")          # transport hook
+    snap = lockdep.snapshot()
+    assert snap["counters"]["held_across_blocking"] == 2
+    kinds = [e["kind"] for e in snap["held_across_blocking"]]
+    assert "recv_msg" in kinds
+    assert any(k.startswith("time.sleep") for k in kinds)
+    (evt,) = [e for e in snap["held_across_blocking"]
+              if e["kind"] == "recv_msg"]
+    assert evt["held"] == ["transport.py:5"]
+    assert "test_lockdep.py" in evt["at"]          # stack fingerprint
+
+
+def test_no_blocking_event_without_held_locks(recording):
+    time.sleep(0.001)
+    lockdep.note_blocking("idle")
+    assert lockdep.snapshot()["counters"]["held_across_blocking"] == 0
+
+
+def test_condition_and_rlock_integration(recording):
+    cv = threading.Condition(_wrapped("cv.py:3", kind="RLock"))
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=10)
+    assert hits == [1]
+    # RLock reentry records no self-edge
+    r = _wrapped("reent.py:4", kind="RLock")
+    with r:
+        with r:
+            pass
+    assert lockdep.snapshot()["counters"]["edges"] == 0
+
+
+def test_telemetry_gauges_exported(recording):
+    a = _wrapped("gauge_a.py:1")
+    b = _wrapped("gauge_b.py:2")
+    _run_inverted_pair(a, b)
+    lockdep.snapshot()
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert gauges["lockdep.inversions"] == 1.0
+    assert gauges["lockdep.acquires"] >= 4.0
+
+
+def test_debug_bundle_section_roundtrip(recording, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    a = _wrapped("bundle_a.py:1")
+    b = _wrapped("bundle_b.py:2")
+    _run_inverted_pair(a, b)
+    path = debug.write_bundle("lockdep_test", force=True)
+    assert path
+    payload = json.loads(open(path).read())
+    section = payload["sections"]["lockdep"]
+    assert section["mode"] == "record"
+    assert section["counters"]["inversions"] == 1
+    assert len(section["inversions"]) == 1
+    assert json.dumps(section)                     # JSON-clean
+
+
+def test_off_mode_is_zero_overhead():
+    """With MXTPU_LOCKDEP unset the factories are the stdlib originals —
+    no wrapper exists anywhere in the process."""
+    if lockdep.installed():
+        pytest.skip("suite running under MXTPU_LOCKDEP")
+    assert threading.Lock is lockdep._real_Lock
+    assert threading.RLock is lockdep._real_RLock
+    assert time.sleep is lockdep._real_sleep
+
+
+def test_uninstall_restores_factories(recording):
+    assert threading.Lock is not lockdep._real_Lock
+    lockdep.uninstall()
+    assert threading.Lock is lockdep._real_Lock
+    assert time.sleep is lockdep._real_sleep
+    # wrappers already handed out keep delegating, silently
+    lk = _wrapped("stale.py:1")
+    with lk:
+        pass
+    assert lockdep.snapshot()["counters"]["acquires"] == 0
+
+
+def test_install_from_env_wraps_framework_locks():
+    """End-to-end pin: under MXTPU_LOCKDEP=record the package arms the
+    sanitizer before its first lock exists, so module-level framework
+    locks (the telemetry registry's) come out wrapped; foreign locks do
+    not."""
+    code = (
+        "import threading\n"
+        "import mxnet_tpu\n"
+        "from mxnet_tpu import lockdep, telemetry\n"
+        "assert lockdep.installed() and lockdep.mode() == 'record'\n"
+        "wrapped = type(telemetry.registry()._lock).__name__\n"
+        "assert wrapped == '_LockWrapper', wrapped\n"
+        "assert lockdep.snapshot()['counters']['locks_created'] > 0\n"
+        "foreign = threading.Lock()  # created outside mxnet_tpu\n"
+        "assert type(foreign).__name__ != '_LockWrapper'\n"
+        "print('LOCKDEP_ENV_OK')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        env=subprocess_env(MXTPU_LOCKDEP="record"),
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "LOCKDEP_ENV_OK" in res.stdout
