@@ -15,6 +15,7 @@
 #   make kernel-smoke-> Pallas kernel parity + interpret lane (docs/KERNELS.md)
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make gateway-smoke-> cross-process fleet lane: gateway + worker failover
+#   make failover-smoke-> durable streams: resume, preemption, brownout
 #   make sim-smoke  -> load replay + simulated fleet lane (docs/SIMULATION.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
@@ -63,6 +64,9 @@ fleet-smoke:
 gateway-smoke:
 	bash ci/runtime_functions.sh gateway_check
 
+failover-smoke:
+	bash ci/runtime_functions.sh failover_check
+
 sim-smoke:
 	bash ci/runtime_functions.sh sim_check
 
@@ -78,4 +82,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke sim-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke sim-smoke obs-smoke debug-smoke ci clean

@@ -1240,10 +1240,117 @@ def gateway_bench(quick=False):
         ok_kill = run_burst(kill_at=burst // 4)
         out["gateway_burst_ok_baseline"] = ok_base
         out["gateway_burst_ok_killed"] = ok_kill
-        out["gateway_kill_goodput_vs_baseline"] = round(
-            ok_kill / max(ok_base, 1), 4)
         out["gateway_retries"] = gw.retried
         out["gateway_worker_restarts"] = sup.restarts
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()
+
+    # -- durable generation streams: the kill-goodput number ------------
+    # ``gateway_kill_goodput_vs_baseline`` is measured on *generation
+    # streams*, where the failover win actually lives: a stream whose
+    # worker is SIGKILLed mid-decode and resumes on the sibling counts
+    # as goodput ("ok" terminal), a ``ReplicaLost`` terminal as loss.
+    # (The old metric measured idempotent /v1/predict retries, which
+    # masked mid-decode stream deaths entirely.)
+    out.update(_gateway_gen_kill_goodput(quick=quick, env=env))
+    return out
+
+
+def _gateway_gen_kill_goodput(quick, env):
+    """Streamed-generation kill burst behind the gateway: 2 generation
+    workers, SIGKILL one after >= 1 token has streamed, count terminal
+    outcomes (docs/SHARDED_SERVING.md "Failure matrix")."""
+    import http.client
+    import threading
+
+    from mxnet_tpu.fleet import ServiceRegistry, WorkerSupervisor
+    from mxnet_tpu.gateway import Gateway
+
+    n_streams = 4 if quick else 8
+    max_new = 8 if quick else 12
+    out = {}
+
+    reg = ServiceRegistry(service="bench-gw-gen", ttl_s=2.0)
+    sup = WorkerSupervisor(
+        {rid: [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+               "--registry", reg.addr, "--service", "bench-gw-gen",
+               "--rid", rid, "--heartbeat-s", "0.1",
+               "--builder", "mxnet_tpu.fleet_worker:demo_generation"]
+         for rid in ("g0", "g1")},
+        registry=reg, service="bench-gw-gen", max_restarts=3,
+        backoff=0.05, poll_s=0.05, env=env)
+    gw = Gateway(registry=reg, service="bench-gw-gen", refresh_s=0.1,
+                 suspect_s=0.5, retries=2)
+
+    def stream(i, outcomes, lock):
+        host, _, port = gw.addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        try:
+            conn.request(
+                "POST", "/v1/generate",
+                body=json.dumps({"prompt": [1 + i, 2, 3],
+                                 "max_new_tokens": max_new,
+                                 "deadline_ms": 60000}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            outcome = "UNTYPED:HTTP%d" % resp.status
+            if resp.status == 200:
+                outcome = "UNTYPED:TruncatedStream"
+                while True:
+                    raw = resp.readline()
+                    if not raw:
+                        break
+                    line = json.loads(raw)
+                    if "done" in line:
+                        outcome = "ok"
+                        break
+                    if "error" in line:
+                        outcome = line["error"]
+                        break
+        except OSError as e:
+            outcome = "UNTYPED:%s" % type(e).__name__
+        finally:
+            conn.close()
+        with lock:
+            outcomes.append(outcome)
+
+    def run_burst(kill=False):
+        outcomes, lock = [], threading.Lock()
+        ts = [threading.Thread(target=stream, args=(i, outcomes, lock))
+              for i in range(n_streams)]
+        base_tokens = gw.tokens_streamed
+        for t in ts:
+            t.start()
+        if kill:
+            # mid-decode by construction: wait for >= 1 streamed token
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline \
+                    and gw.tokens_streamed <= base_tokens:
+                time.sleep(0.005)
+            sup.kill_worker()
+        for t in ts:
+            t.join(timeout=180)
+        return outcomes
+
+    try:
+        sup.wait_registered(2, timeout=180)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                gw._view is None or len(gw._view.replicas) < 2):
+            time.sleep(0.05)
+        base = run_burst()
+        killed = run_burst(kill=True)
+        ok_base = sum(1 for o in base if o == "ok")
+        ok_kill = sum(1 for o in killed if o == "ok")
+        out["gateway_gen_ok_baseline"] = ok_base
+        out["gateway_gen_ok_killed"] = ok_kill
+        out["gateway_gen_replica_lost"] = sum(
+            1 for o in killed if o == "ReplicaLost")
+        out["gateway_streams_resumed"] = gw.streams_resumed
+        out["gateway_kill_goodput_vs_baseline"] = round(
+            ok_kill / max(ok_base, 1), 4)
     finally:
         gw.stop()
         sup.stop(timeout=20.0)

@@ -220,6 +220,37 @@ gateway_check() {
     fi
 }
 
+failover_check() {
+    # Durable generation streams (docs/SHARDED_SERVING.md failure
+    # matrix, docs/GENERATIVE.md QoS/brownout): bitwise greedy resume +
+    # seeded-sampled replay after preemption, QoS-tiered victim
+    # selection under page exhaustion (preempt before shed; shed only
+    # when every victim is same-or-higher priority), the chaos
+    # worker_kill_mid_decode / page_pressure gates, and the brownout
+    # ladder engaging and fully recovering with hysteresis.  Runs
+    # under the lockdep sanitizer in raise mode: the resume path
+    # crosses the scheduler loop, the allocator, and gateway handler
+    # threads — any new lock inversion should fail here, not deadlock
+    # in production.
+    MXTPU_LOCKDEP=raise python -m pytest tests/test_failover.py \
+        tests/test_gateway.py -q -m "not slow"
+    # every module the failover path touches must lint clean — NO
+    # suppressions: preemption holds allocator state across the
+    # scheduler turn and the gateway journals inside handler threads
+    python -m mxnet_tpu.lint mxnet_tpu/generation.py \
+        mxnet_tpu/serving.py mxnet_tpu/gateway.py mxnet_tpu/fleet.py \
+        mxnet_tpu/fleet_worker.py mxnet_tpu/simfleet.py \
+        mxnet_tpu/loadgen.py mxnet_tpu/chaos.py
+    if grep -n "mxlint: disable" mxnet_tpu/generation.py \
+            mxnet_tpu/serving.py mxnet_tpu/gateway.py \
+            mxnet_tpu/fleet.py mxnet_tpu/fleet_worker.py \
+            mxnet_tpu/simfleet.py mxnet_tpu/loadgen.py \
+            mxnet_tpu/chaos.py; then
+        echo "failover-path modules must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 sim_check() {
     # Trace-driven load replay + simulated-clock fleet
     # (docs/SIMULATION.md): trace-model determinism (Poisson/MMPP
@@ -393,6 +424,7 @@ all() {
     kernel_check
     fleet_check
     gateway_check
+    failover_check
     sim_check
     obs_check
     debug_check

@@ -53,6 +53,15 @@ Faults (each firing bumps the ``faults_injected`` dispatch counter):
                     live worker process mid-stream — the supervisor must
                     restart it and the gateway must give every admitted
                     request exactly one typed terminal outcome
+``worker_kill_mid_decode@N``  fleet: like ``worker_kill`` but the Nth
+                    opportunity only fires once at least one generation
+                    stream has streamed >= 1 token — the gateway must
+                    resume the stream on a sibling from its journal
+                    (exactly-once token delivery, docs/SHARDED_SERVING.md)
+``page_pressure@N``  generation: the Nth scheduler-loop opportunity
+                    impounds most of the KV free list for a bounded
+                    window — page exhaustion that must preempt the
+                    lowest-priority stream, never shed a higher one
 ==================  ========================================================
 
 Every fault fires at most once per process (deterministic, idempotent
@@ -73,6 +82,7 @@ __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "slow_replica", "replica_crash", "request_burst",
            "registry_stale", "replica_slow_start",
            "gateway_partition", "worker_kill",
+           "worker_kill_mid_decode", "page_pressure",
            "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
@@ -81,6 +91,7 @@ FAULT_KINDS = frozenset({
     "slow_replica", "replica_crash", "request_burst",
     "registry_stale", "replica_slow_start",
     "gateway_partition", "worker_kill",
+    "worker_kill_mid_decode", "page_pressure",
 })
 
 
@@ -390,6 +401,31 @@ def worker_kill(n):
     non-resumable streams with typed ``ReplicaLost``."""
     plan = active()
     return plan is not None and plan.fire("worker_kill", n)
+
+
+def worker_kill_mid_decode(n, streamed):
+    """``worker_kill_mid_decode@N``: True when the Nth opportunity should
+    SIGKILL a live worker AND at least one generation stream has already
+    streamed a token (``streamed >= 1``).  Unlike ``worker_kill`` this
+    targets the mid-decode window specifically: the gateway must resume
+    the interrupted stream on a sibling from its journal so the client
+    sees an exactly-once continuation, not ``ReplicaLost``."""
+    plan = active()
+    if plan is None or streamed < 1:
+        return False
+    return plan.fire("worker_kill_mid_decode", n)
+
+
+def page_pressure(n, frac=0.9):
+    """``page_pressure@N``: fraction of the KV free list the generation
+    scheduler should impound on its Nth opportunity (0.0 otherwise).  The
+    resulting page exhaustion must be absorbed by QoS preemption — the
+    lowest-priority stream is journaled and re-admitted, never a
+    higher-priority one shed (docs/GENERATIVE.md)."""
+    plan = active()
+    if plan is not None and plan.fire("page_pressure", n):
+        return float(frac)
+    return 0.0
 
 
 class ChaosDataset:

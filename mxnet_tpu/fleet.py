@@ -58,6 +58,7 @@ import threading
 
 from . import chaos as _chaos
 from . import clock as _clock
+from . import serving as _serving
 from . import telemetry as _telemetry
 from .async_kv import AsyncKVClient, start_local_server
 from .elastic import PREEMPTED_EXIT_CODE, _backoff_delay
@@ -439,6 +440,10 @@ class FleetSupervisor:
         breach = shed_rate >= self.shed_up or \
             (self.p99_up_ms > 0 and p99 >= self.p99_up_ms)
         idle = offered == 0 and depth == 0 and inflight == 0
+        # the same breach bit that drives autoscaling feeds the brownout
+        # ladder: scaling adds capacity over seconds, brownout sheds load
+        # NOW and steps back down as the clear streak accumulates
+        _serving.brownout().observe(breach)
 
         if breach:
             self._breach_streak += 1
@@ -551,7 +556,7 @@ class WorkerSupervisor:
     def __init__(self, specs, registry=None, service="default",
                  max_restarts=3, backoff=0.05, backoff_cap=8.0,
                  poll_s=0.05, env=None, nonretryable=None, start=True,
-                 clock=None):
+                 clock=None, streamed_probe=None):
         if not isinstance(specs, dict):
             specs = {"w%d" % i: argv for i, argv in enumerate(specs)}
         self.clock = _clock.resolve(clock)
@@ -577,6 +582,11 @@ class WorkerSupervisor:
         self._given_up = set()
         self._done = set()         # clean rc-0 exits
         self._kill_seq = 0
+        # worker_kill_mid_decode@N: optional zero-arg callable returning
+        # how many generation tokens have been streamed fleet-wide (e.g.
+        # a gateway counter) — the kill only fires once it reads >= 1
+        self._streamed_probe = streamed_probe
+        self._mid_kill_seq = 0
         self.restarts = 0
         self.preemption_restarts = 0
         self.kills = 0
@@ -760,6 +770,14 @@ class WorkerSupervisor:
         if _chaos.worker_kill(self._kill_seq):
             self.kill_worker()
         self._kill_seq += 1
+        if self._streamed_probe is not None:
+            try:
+                streamed = int(self._streamed_probe())
+            except Exception:
+                streamed = 0
+            if _chaos.worker_kill_mid_decode(self._mid_kill_seq, streamed):
+                self.kill_worker()
+            self._mid_kill_seq += 1
         for rid, proc in list(self._procs.items()):
             if rid in self._died_at or rid in self._given_up \
                     or rid in self._done:

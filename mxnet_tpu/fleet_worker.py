@@ -313,14 +313,30 @@ class FleetWorker:
             lines.append(line)
             write_line(line)
 
+        resume = body.get("resume_from")
+        if resume:
+            cap = int(body.get("max_new_tokens")
+                      or self.server.cfg.max_new_tokens)
+            if len(resume) >= cap:
+                # the dead worker generated everything but its terminal
+                # line — nothing left to decode, finish the stream here
+                emit({"done": True, "tokens": 0, "rid": self.rid})
+                if ent is not None:
+                    ent.settle(200, lines=lines)
+                return
         try:
+            # resume_from (gateway mid-decode failover) and priority
+            # (QoS class from the X-MXTPU-Priority header) pass through
+            # verbatim — docs/SHARDED_SERVING.md "Failure matrix"
             fut = self.server.submit_async(
                 np.asarray(body["prompt"], np.int32),
                 max_new_tokens=body.get("max_new_tokens"),
                 deadline_ms=body.get("deadline_ms"),
                 temperature=body.get("temperature"),
                 top_k=body.get("top_k"),
-                seed=body.get("seed"))
+                seed=body.get("seed"),
+                priority=body.get("priority"),
+                resume_from=body.get("resume_from"))
         except serving.ServingError as e:
             emit({"error": type(e).__name__, "message": str(e),
                   "rid": self.rid})
@@ -379,6 +395,9 @@ class FleetWorker:
                     self._json(400, {"error": "BadRequest",
                                      "message": str(e)})
                     return
+                prio = self.headers.get("X-MXTPU-Priority")
+                if prio:
+                    body.setdefault("priority", prio)
                 if self.path == "/v1/predict" \
                         and worker.kind == "predict":
                     status, resp = worker._handle_predict(body)

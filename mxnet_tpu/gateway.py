@@ -30,18 +30,28 @@ Failover: every request gets an idempotency key (client-supplied or
 generated), so a retry on another worker never double-executes — the
 worker replays its stored outcome for a duplicate key.  A connection
 that dies **before any token streamed** is idempotent prefill-phase
-work and is retried on another worker (``gateway_retries``); a
-generation stream that dies **mid-decode** is not resumable (the KV
-pages died with the worker) and terminates with one typed
-``ReplicaLost`` outcome (``gateway_stream_lost``).
+work and is retried on another worker (``gateway_retries``).  A
+generation stream that dies **mid-decode** is *resumed*: the gateway
+journals each stream's prompt, sampling parameters (it mints a concrete
+``seed`` so seeded sampling replays exactly on any worker), and every
+token already delivered (bounded by ``MXTPU_GATE_JOURNAL_CAP``); on
+worker death it re-submits to a healthy sibling with a ``resume_from``
+payload and a fresh idempotency key — the worker re-prefills
+prompt+prefix and streams only the continuation, so the client sees an
+exactly-once (greedy: bitwise-identical) stream
+(``gateway_stream_resumed``).  ``ReplicaLost`` is the >= 2-failure
+fallback: the resumed incarnation died too, no sibling existed, or the
+journal overflowed its cap (``gateway_stream_lost``).
 
 Surface: ``POST /v1/predict`` (JSON in/out, typed errors as statuses),
 ``POST /v1/generate`` (NDJSON stream; the terminal line is the typed
-outcome), ``GET /v1/fleet`` (view + staleness), ``GET /healthz``.
+outcome; the ``X-MXTPU-Priority`` request header becomes the worker-side
+QoS class), ``GET /v1/fleet`` (view + staleness), ``GET /healthz``.
 
 Telemetry: the ``gateway.route_ms`` histogram (admission -> request
 handed to a worker) and ``gateway_requests`` / ``gateway_retries`` /
-``gateway_stream_lost`` / ``gateway_registry_errors`` counters.
+``gateway_stream_resumed`` / ``gateway_stream_lost`` /
+``gateway_registry_errors`` counters.
 
 Threading: refresh loop and handler threads share plain attributes;
 the only lock guards the in-flight/session dicts and is never held
@@ -69,6 +79,9 @@ _DEF_RETRIES = int(os.environ.get("MXTPU_GATE_RETRIES", "2"))
 _DEF_TIMEOUT_S = float(os.environ.get("MXTPU_GATE_TIMEOUT_S", "60"))
 _DEF_SUSPECT_S = float(os.environ.get("MXTPU_GATE_SUSPECT_S", "2.0"))
 _DEF_SESSION_CAP = int(os.environ.get("MXTPU_GATE_SESSION_CAP", "4096"))
+# max tokens journaled per stream for mid-decode resume; a stream past
+# the cap falls back to ReplicaLost on worker death
+_DEF_JOURNAL_CAP = int(os.environ.get("MXTPU_GATE_JOURNAL_CAP", "4096"))
 
 
 def _log(msg):
@@ -111,6 +124,9 @@ class Gateway:
         self.requests = 0
         self.retried = 0
         self.streams_lost = 0
+        self.streams_resumed = 0
+        self.tokens_streamed = 0    # fleet-wide delivered-token counter
+        #                             (worker_kill_mid_decode chaos probe)
 
         self._lock = threading.Lock()      # sessions + local inflight
         self._sessions = OrderedDict()     # session -> rid
@@ -165,6 +181,8 @@ class Gateway:
                 "refresh_failures": self._refresh_failures,
                 "requests": self.requests, "retried": self.retried,
                 "streams_lost": self.streams_lost,
+                "streams_resumed": self.streams_resumed,
+                "tokens_streamed": self.tokens_streamed,
                 "workers": sorted(view.replicas) if view is not None
                 else [],
                 "sessions": len(self._sessions)}
@@ -317,19 +335,53 @@ class Gateway:
     # -- generate path (streamed) ------------------------------------------
     def _forward_generate(self, body, write_line, t0):
         """Stream one generation request; the last line written is the
-        one typed terminal outcome."""
+        one typed terminal outcome.
+
+        Durable-stream contract (docs/SHARDED_SERVING.md "Failure
+        matrix"): ``delivered`` journals every token value written to the
+        client.  A worker death mid-decode re-submits the request to a
+        healthy sibling with ``resume_from=delivered`` and a *fresh*
+        idempotency key (a resume is new work, not a duplicate); the
+        worker re-prefills prompt+prefix and streams only the
+        continuation, so already-delivered tokens are suppressed by
+        construction and the client sees each position exactly once.
+        ``ReplicaLost`` survives only as the fallback: a second
+        mid-stream loss, no healthy sibling, or a journal past
+        ``MXTPU_GATE_JOURNAL_CAP`` tokens."""
         session = body.get("session")
-        payload = json.dumps(body).encode()
         excluded = []
         attempt = 0
+        losses = 0          # mid-stream worker deaths for this request
+        delivered = []      # journal: token values already written
+        overflowed = False  # journal passed the cap — resume disarmed
         while True:
             picked = self._pick(session=session, exclude=excluded)
             if picked is None:
-                write_line({"error": "Unavailable",
-                            "message": "no live worker (tried %s)"
-                            % (excluded or "none")})
+                if delivered:
+                    self.streams_lost += 1
+                    _count("gateway_stream_lost")
+                    write_line({"error": "ReplicaLost",
+                                "message": "no live worker to resume "
+                                "after %d token(s) (tried %s)"
+                                % (len(delivered), excluded or "none")})
+                else:
+                    write_line({"error": "Unavailable",
+                                "message": "no live worker (tried %s)"
+                                % (excluded or "none")})
                 return
             rid, addr = picked
+            req = body
+            if delivered:
+                # resume incarnation: ship the delivered prefix so the
+                # sibling reconstructs the exact KV/rng state, under a
+                # fresh idempotency key (this is new work — the old key
+                # would replay the dead worker's stored outcome)
+                req = dict(body)
+                req["resume_from"] = [int(t) for t in delivered]
+                req["idempotency_key"] = "gw-" + _telemetry.new_trace_id()
+                self.streams_resumed += 1
+                _count("gateway_stream_resumed")
+            payload = json.dumps(req).encode()
             self._track(rid, 1)
             streamed = 0
             try:
@@ -357,6 +409,18 @@ class Gateway:
                                       % (rid, line["error"]))
                     first = False
                     streamed += 1
+                    if "token" in line:
+                        if len(delivered) < _DEF_JOURNAL_CAP:
+                            delivered.append(int(line["token"]))
+                        else:
+                            overflowed = True
+                        self.tokens_streamed += 1
+                    elif "done" in line and losses:
+                        # terminal count covers every incarnation, not
+                        # just the one that finished the stream
+                        line = dict(line)
+                        line["tokens"] = len(delivered)
+                        line["resumed"] = losses
                     write_line(line)
                     if "done" in line or "error" in line:
                         break
@@ -365,16 +429,21 @@ class Gateway:
             except (OSError, ValueError) as e:
                 self._note_suspect(rid)
                 excluded.append(rid)
-                if streamed > 0:
-                    # mid-decode loss: the stream's KV pages died with
-                    # the worker — not resumable, one typed outcome
-                    self.streams_lost += 1
-                    _count("gateway_stream_lost")
-                    write_line({"error": "ReplicaLost",
-                                "message": "worker %s lost mid-stream "
-                                "after %d tokens (%s)"
-                                % (rid, streamed, e)})
-                    return
+                if delivered or streamed > 0:
+                    losses += 1
+                    if losses >= 2 or overflowed or not delivered:
+                        # second loss / uncapped journal: the fallback
+                        self.streams_lost += 1
+                        _count("gateway_stream_lost")
+                        write_line({"error": "ReplicaLost",
+                                    "message": "worker %s lost "
+                                    "mid-stream after %d token(s) (%s)"
+                                    % (rid, len(delivered), e)})
+                        return
+                    _log("worker %s died mid-stream after %d token(s) "
+                         "(%s: %s) — resuming on a sibling"
+                         % (rid, len(delivered), type(e).__name__, e))
+                    continue
                 attempt += 1
                 self.retried += 1
                 _count("gateway_retries")
@@ -431,11 +500,22 @@ class Gateway:
                 # key unless the client brought its own
                 body.setdefault("idempotency_key",
                                 "gw-" + _telemetry.new_trace_id())
+                # the QoS class rides a header so load tools and
+                # sidecars can set it without touching the body
+                prio = self.headers.get("X-MXTPU-Priority")
+                if prio:
+                    body.setdefault("priority", prio)
                 if self.path == "/v1/predict":
                     status, data, rid = gw._forward_predict(
                         json.dumps(body).encode(), t0)
                     self._json(status, data)
                 elif self.path == "/v1/generate":
+                    # pin a concrete seed: the worker-side default rng is
+                    # keyed to per-worker admission order, which a resume
+                    # on a different worker cannot replay
+                    if body.get("seed") is None:
+                        body["seed"] = int.from_bytes(os.urandom(4),
+                                                      "big")
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/x-ndjson")

@@ -43,15 +43,16 @@ import time
 
 import numpy as np
 
+from . import chaos as _chaos
 from . import clock as _clockmod
 from . import dispatch as _dispatch
 from . import profiler as _profiler
 from . import telemetry as _telemetry
 from .serving import (DRAINING, SERVING, STARTING, STOPPED, DeadlineExceeded,
-                      Draining, Overloaded, StreamingFuture)
+                      Draining, Overloaded, StreamingFuture, brownout)
 
 __all__ = ["GenerationConfig", "PageAllocator", "GenerationEngine",
-           "GenerationServer"]
+           "GenerationServer", "parse_priority"]
 
 _DEF_PAGE_SIZE = int(os.environ.get("MXTPU_GEN_PAGE_SIZE", "16"))
 _DEF_MAX_PAGES = int(os.environ.get("MXTPU_GEN_MAX_PAGES", "256"))
@@ -108,6 +109,36 @@ def _pick_bucket(chain, n):
     return chain[-1]
 
 
+def parse_priority(value):
+    """Normalize a request priority into ``(class_name, rank)``.
+
+    Higher rank = more important.  Accepted shapes: ``None`` (the default
+    class, rank 0), a bare int rank, a ``"name=rank"`` string (the
+    ``X-MXTPU-Priority`` wire form, docs/SHARDED_SERVING.md), a bare
+    numeric string, or a bare class name (rank 0).  Malformed ranks fall
+    back to 0 rather than failing admission."""
+    if value is None:
+        return ("default", 0)
+    if isinstance(value, (int, np.integer)):
+        r = int(value)
+        return ("p%d" % r, r)
+    s = str(value).strip()
+    if not s:
+        return ("default", 0)
+    if "=" in s:
+        name, _, tail = s.partition("=")
+        try:
+            rank = int(tail.strip())
+        except ValueError:
+            rank = 0
+        return (name.strip() or "default", rank)
+    try:
+        r = int(s)
+        return ("p%d" % r, r)
+    except ValueError:
+        return (s, 0)
+
+
 def _sample_token(logits, temperature, top_k, rng):
     """Pick the next token id from one logits row (np [V], host-side).
 
@@ -148,6 +179,7 @@ class PageAllocator:
         self._capacity = self.num_pages - 1
         # pop() from the tail -> lowest page ids are handed out first
         self._free = list(range(self.num_pages - 1, 0, -1))
+        self._held = []            # impounded by page_pressure chaos
         self._lock = threading.Lock()
         self.peak_util = 0.0
 
@@ -175,6 +207,28 @@ class PageAllocator:
             self._free.extend(int(p) for p in pages)
         self._publish()
 
+    def impound(self, frac):
+        """Chaos hook (``page_pressure``): move ``frac`` of the current
+        free list into a held side-pool so allocation sees artificial
+        exhaustion.  Impounded pages count as used on the util gauge.
+        Returns how many pages were impounded."""
+        with self._lock:
+            n = int(len(self._free) * float(frac))
+            for _ in range(n):
+                self._held.append(self._free.pop())
+        self._publish()
+        return n
+
+    def release(self):
+        """Return every impounded page to the free list (end of the
+        ``page_pressure`` window).  Returns how many were released."""
+        with self._lock:
+            n = len(self._held)
+            self._free.extend(self._held)
+            self._held = []
+        self._publish()
+        return n
+
     def _publish(self):
         util = self.used / self._capacity
         if util > self.peak_util:
@@ -185,23 +239,57 @@ class PageAllocator:
 # ---------------------------------------------------------------------------
 # engine: jitted prefill/decode over bucketed shapes
 # ---------------------------------------------------------------------------
+class _PendingReq:
+    """One queued admission (fresh, resumed, or preempted-and-journaled).
+
+    ``tokens`` is the full prefill input: the prompt, plus — for a resumed
+    or re-admitted stream — every token already generated, so re-prefill
+    reconstructs the exact KV state the dead/preempted incarnation held.
+    ``start_new`` counts those already-generated tail tokens (0 for a
+    fresh request); ``patient`` marks an internally-preempted stream,
+    which requeues on page exhaustion instead of shedding."""
+
+    __slots__ = ("fut", "tokens", "max_new", "sampling", "prio_name",
+                 "prio_rank", "start_new", "patient")
+
+    def __init__(self, fut, tokens, max_new, sampling, prio_name,
+                 prio_rank, start_new=0, patient=False):
+        self.fut = fut
+        self.tokens = tokens
+        self.max_new = max_new
+        self.sampling = sampling      # (temperature, top_k, rng)
+        self.prio_name = prio_name
+        self.prio_rank = prio_rank
+        self.start_new = start_new
+        self.patient = patient
+
+
 class _Seq:
     """One sequence resident in the decode batch (host-side bookkeeping)."""
 
     __slots__ = ("fut", "table", "n_pages", "length", "last_token",
-                 "n_new", "max_new", "prompt_len", "sampling")
+                 "n_new", "max_new", "prompt_len", "sampling",
+                 "prio_name", "prio_rank", "input_tokens", "gen_tokens",
+                 "preempted")
 
     def __init__(self, fut, table, n_pages, length, last_token, max_new,
-                 prompt_len, sampling):
+                 prompt_len, sampling, prio_name="default", prio_rank=0,
+                 input_tokens=None, start_new=0):
         self.fut = fut
         self.table = table            # np [M] int32, padded with 0
         self.n_pages = n_pages        # leading valid entries of table
         self.length = length          # tokens with K/V in the cache
         self.last_token = last_token  # next token to feed decode_step
-        self.n_new = 1                # generated so far (prefill emits #1)
+        self.n_new = start_new + 1    # generated so far, all incarnations
+        #                               (prefill emits one)
         self.max_new = max_new
         self.prompt_len = prompt_len
         self.sampling = sampling      # (temperature, top_k, rng)
+        self.prio_name = prio_name
+        self.prio_rank = prio_rank
+        self.input_tokens = input_tokens  # np array actually prefilled
+        self.gen_tokens = [last_token]    # sampled by THIS incarnation
+        self.preempted = False
 
 
 class GenerationEngine:
@@ -359,18 +447,23 @@ class GenerationServer:
                                  else float(deadline_ms)) / 1e3
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending = collections.deque()   # (fut, prompt, max_new,
-        #                                       (temperature, top_k, rng))
+        self._pending = collections.deque()   # [_PendingReq]
         self._active = []                     # [_Seq]
         self._inflight = None                 # fut mid-prefill (not yet in
         #                                       _active; drain must see it)
         self._drain_flag = threading.Event()
         self._stop = False
         self._preemption = None
+        self._defer_prefill = False           # force one decode turn so a
+        #                                       requeued patient prefill
+        #                                       cannot starve the batch
+        self._loop_turn = 0                   # page_pressure chaos clock
+        self._pressure_until = 0
         self._state = STARTING
         self.stats = {
             "admitted": 0, "shed_queue": 0, "shed_pages": 0, "ok": 0,
             "deadline_exceeded": 0, "rejected_draining": 0,
+            "preempted": 0, "resumed": 0, "shed_brownout": 0,
         }
         if warm:
             self.engine.warm()
@@ -389,7 +482,8 @@ class GenerationServer:
 
     # -- admission -----------------------------------------------------
     def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
-                     on_token=None, temperature=None, top_k=None, seed=None):
+                     on_token=None, temperature=None, top_k=None, seed=None,
+                     priority=None, resume_from=None):
         """Admit one generation request; returns a
         :class:`~mxnet_tpu.serving.StreamingFuture` or raises the typed
         admission error (:class:`Overloaded` / :class:`Draining`).
@@ -400,21 +494,45 @@ class GenerationServer:
         and host-side, so batch composition never perturbs a stream: an
         explicit ``seed`` replays the exact token stream; by default each
         request derives an independent rng from ``(cfg.seed, admission
-        index)``."""
+        index)``.
+
+        ``priority`` is any :func:`parse_priority` shape; under page
+        exhaustion strictly-lower-rank streams are preempted before
+        anything is shed, and brownout level 3 admits only ranks at or
+        above the configured floor (docs/GENERATIVE.md).
+
+        ``resume_from`` — a list of tokens an earlier incarnation of this
+        stream already generated (gateway failover, docs/
+        SHARDED_SERVING.md).  The worker re-prefills prompt+prefix and the
+        returned future streams only the continuation.  With an explicit
+        ``seed`` the rng is fast-forwarded by ``len(resume_from)`` draws,
+        so a sampled resume produces the exact suffix the unkilled run
+        would have (greedy mode is bitwise-identical by construction)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        if prompt.size >= self.engine.max_seq:
+        prefix = (np.asarray(resume_from, np.int32).reshape(-1)
+                  if resume_from is not None else None)
+        start_new = 0 if prefix is None else int(prefix.size)
+        tokens = prompt if prefix is None \
+            else np.concatenate([prompt, prefix])
+        if tokens.size >= self.engine.max_seq:
             raise ValueError("prompt length %d >= max_seq_len %d"
-                             % (prompt.size, self.engine.max_seq))
+                             % (tokens.size, self.engine.max_seq))
         max_new = int(max_new_tokens or self.cfg.max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if start_new and max_new - start_new < 1:
+            raise ValueError("resume_from already carries %d token(s), "
+                             ">= max_new_tokens %d" % (start_new, max_new))
         temperature = (self.cfg.temperature if temperature is None
                        else float(temperature))
         top_k = self.cfg.top_k if top_k is None else int(top_k)
         if top_k < 0:
             raise ValueError("top_k must be >= 0")
+        prio_name, prio_rank = parse_priority(priority)
+        bo = brownout()
+        max_new = max(bo.cap_max_new(max_new), start_new + 1)
         now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
@@ -423,24 +541,43 @@ class GenerationServer:
                     or self._state in (DRAINING, STOPPED)):
                 self.stats["rejected_draining"] += 1
                 raise Draining("generation server is draining")
+            if not bo.admits(prio_rank):
+                self.stats["shed_brownout"] += 1
+                _profiler.dispatch_count("gen_brownout_shed")
+                raise Overloaded(
+                    "brownout level %d admits only priority rank >= %d "
+                    "(got %s=%d)" % (bo.level, bo.min_rank, prio_name,
+                                     prio_rank))
             if len(self._pending) >= self.max_queue:
                 self.stats["shed_queue"] += 1
                 _profiler.dispatch_count("requests_shed")
                 raise Overloaded("generation queue full (%d pending)"
                                  % len(self._pending))
-            fut = StreamingFuture({"tokens": prompt}, rows=1,
+            fut = StreamingFuture({"tokens": tokens}, rows=1,
                                   deadline=deadline, t_admit=now,
                                   on_token=on_token, clock=self.clock)
             self.stats["admitted"] += 1
+            if start_new:
+                self.stats["resumed"] += 1
+                _profiler.dispatch_count("gen_resumed")
             _profiler.dispatch_count("requests_admitted")
+            _profiler.dispatch_count("gen.admitted_by_class.%s" % prio_name)
             _telemetry.trace_begin("request", fut.trace_id, cat="gen",
                                    args={"prompt_len": int(prompt.size),
-                                         "max_new": max_new})
+                                         "max_new": max_new,
+                                         "priority": prio_name,
+                                         "resumed": start_new})
             rng = np.random.default_rng(
                 int(seed) if seed is not None
                 else (self.cfg.seed, self.stats["admitted"]))
-            self._pending.append((fut, prompt, max_new,
-                                  (temperature, top_k, rng)))
+            if start_new and seed is not None and temperature > 0.0:
+                # one uniform draw per sampled token (rng.choice consumes
+                # exactly one double) — fast-forward past the prefix so
+                # the resumed suffix replays the unkilled stream
+                rng.random(start_new)
+            self._pending.append(_PendingReq(
+                fut, tokens, max_new, (temperature, top_k, rng),
+                prio_name, prio_rank, start_new=start_new))
             self._cv.notify_all()
         return fut
 
@@ -458,21 +595,45 @@ class GenerationServer:
                 if self._drain_flag.is_set() and self._state == SERVING:
                     self._state = DRAINING
                 self._expire_locked(self.clock.now())
-                if (self._pending
+                self._loop_turn += 1
+            self._chaos_pressure()                 # allocator IO, no lock
+            with self._cv:
+                if self._stop:
+                    break
+                if (self._pending and not self._defer_prefill
                         and len(self._active) < self.cfg.max_slots):
                     work = self._pending.popleft()
-                    self._inflight = work[0]
+                    self._inflight = work.fut
                 elif not self._active:
+                    self._defer_prefill = False
                     self._cv.wait(0.02)
                     continue
+                else:
+                    self._defer_prefill = False
             if work is not None:
-                self._do_prefill(*work)
+                self._do_prefill(work)
             else:
                 self._decode_iteration()
 
+    def _chaos_pressure(self):
+        """``page_pressure`` chaos: impound most of the KV free list for a
+        bounded window of scheduler turns, forcing the preemption path."""
+        frac = _chaos.page_pressure(self._loop_turn)
+        if frac > 0.0:
+            n = self.engine.allocator.impound(frac)
+            self._pressure_until = self._loop_turn + 32
+            _log("chaos page_pressure: impounded %d page(s) for 32 turns"
+                 % n)
+        elif self._pressure_until and self._loop_turn >= self._pressure_until:
+            self._pressure_until = 0
+            n = self.engine.allocator.release()
+            _log("chaos page_pressure: released %d page(s)" % n)
+            with self._cv:
+                self._cv.notify_all()
+
     def _expire_locked(self, now):
         for i in range(len(self._pending) - 1, -1, -1):
-            fut = self._pending[i][0]
+            fut = self._pending[i].fut
             if now >= fut.deadline:
                 del self._pending[i]
                 self._reject_locked(fut, DeadlineExceeded(
@@ -508,11 +669,71 @@ class GenerationServer:
             self.engine.allocator.free(pages)
         self._cv.notify_all()
 
-    def _do_prefill(self, fut, prompt, max_new, sampling):
+    # -- QoS preemption ------------------------------------------------
+    def _preempt_locked(self, rank, need):
+        """Free pages for a rank-``rank`` admission by preempting
+        strictly-lower-priority active streams, lowest rank (then largest
+        footprint) first.  Each victim is journaled as a patient
+        :class:`_PendingReq` — its future stays live and it re-admits
+        through the same resume path a gateway failover uses — so nothing
+        is shed unless every victim is same-or-higher priority.  Returns
+        True once ``need`` pages are free.  Caller holds the cv; the
+        scheduler thread is the only decoder, so victims are never
+        mid-device-step."""
+        alloc = self.engine.allocator
+        while alloc.capacity - alloc.used < need:
+            victims = [s for s in self._active
+                       if s.prio_rank < rank and not s.preempted
+                       and not s.fut.done]
+            if not victims:
+                return False
+            v = min(victims, key=lambda s: (s.prio_rank, -s.n_pages))
+            self._preempt_seq_locked(v)
+        return True
+
+    def _preempt_seq_locked(self, seq):
+        """Evict ``seq`` from the batch, journal its exact state (prompt +
+        every generated token + its live sampling rng) and requeue it as a
+        patient pending entry.  The future is NOT settled — the stream
+        simply pauses until re-prefill."""
+        self._active.remove(seq)
+        seq.preempted = True
+        tokens = np.concatenate(
+            [seq.input_tokens, np.asarray(seq.gen_tokens, np.int32)])
+        self._pending.append(_PendingReq(
+            seq.fut, tokens, seq.max_new, seq.sampling, seq.prio_name,
+            seq.prio_rank, start_new=seq.n_new, patient=True))
+        self.stats["preempted"] += 1
+        _profiler.dispatch_count("gen_preempted")
+        _telemetry.trace_instant(
+            "gen.preempt", cat="gen",
+            args={"priority": seq.prio_name, "tokens": seq.n_new})
+        pages = [int(p) for p in seq.table[:seq.n_pages]]
+        if pages:
+            self.engine.allocator.free(pages)
+        self._cv.notify_all()
+
+    def _do_prefill(self, req):
         eng = self.engine
-        need = -(-int(prompt.size) // eng.page_size)
+        fut, max_new, sampling = req.fut, req.max_new, req.sampling
+        tokens = req.tokens
+        need = -(-int(tokens.size) // eng.page_size)
         pages = eng.allocator.alloc(need)
         if pages is None:
+            with self._cv:
+                if self._preempt_locked(req.prio_rank, need):
+                    pages = eng.allocator.alloc(need)
+        if pages is None:
+            if req.patient:
+                # an internally-preempted stream waits out the pressure
+                # instead of shedding; defer one turn to the decode side
+                # so the batch keeps draining and freeing pages
+                with self._cv:
+                    self._inflight = None
+                    self._pending.append(req)
+                    self._defer_prefill = True
+                    self._cv.notify_all()
+                return
             _profiler.dispatch_count("gen_pages_shed")
             with self._cv:
                 self._inflight = None
@@ -524,13 +745,16 @@ class GenerationServer:
             return
         table = np.zeros(eng.pages_per_seq, np.int32)
         table[:need] = pages
-        logits = eng.prefill(prompt, table)        # device work, no lock
+        logits = eng.prefill(tokens, table)        # device work, no lock
         tok = _sample_token(logits, *sampling)
-        seq = _Seq(fut, table, need, int(prompt.size), tok, max_new,
-                   int(prompt.size), sampling)
+        seq = _Seq(fut, table, need, int(tokens.size), tok, max_new,
+                   int(tokens.size), sampling, prio_name=req.prio_name,
+                   prio_rank=req.prio_rank, input_tokens=tokens,
+                   start_new=req.start_new)
         is_eos = self.cfg.eos_id >= 0 and tok == self.cfg.eos_id
         emitted = False if is_eos else fut._emit(tok)  # EOS never streams
-        if emitted and fut.t_first_token is not None:
+        if (emitted and req.start_new == 0
+                and fut.t_first_token is not None):
             _telemetry.registry().histogram("gen.ttft_ms").observe(
                 (fut.t_first_token - fut.t_admit) * 1e3)
         with self._cv:
@@ -541,7 +765,7 @@ class GenerationServer:
                 self._reject_locked(fut, DeadlineExceeded(
                     "deadline passed during prefill"))
                 eng.allocator.free(pages)
-            elif is_eos or max_new <= 1:
+            elif is_eos or seq.n_new >= max_new:
                 self._active.append(seq)
                 self._retire_locked(seq)
             else:
@@ -555,13 +779,21 @@ class GenerationServer:
         if not seqs:
             return
         # grow page tables for sequences crossing a page boundary; a pool
-        # miss sheds THAT sequence with a typed Overloaded (its streamed
-        # tokens stand; the outcome names the truncation)
+        # miss first preempts strictly-lower-priority streams (journaled,
+        # not shed) and only sheds THIS sequence with a typed Overloaded
+        # when no lower-rank victim exists (its streamed tokens stand;
+        # the outcome names the truncation)
         survivors = []
         for s in seqs:
+            if s.preempted or s.fut.done:
+                continue
             needed = s.length // eng.page_size + 1
             if needed > s.n_pages:
                 got = eng.allocator.alloc(1)
+                if got is None:
+                    with self._cv:
+                        if self._preempt_locked(s.prio_rank, 1):
+                            got = eng.allocator.alloc(1)
                 if got is None:
                     _profiler.dispatch_count("gen_pages_shed")
                     with self._cv:
@@ -572,6 +804,10 @@ class GenerationServer:
                 s.table[s.n_pages] = got[0]
                 s.n_pages += 1
             survivors.append(s)
+        # a grow-phase preemption may have evicted a sequence admitted to
+        # survivors earlier in this same pass — its pages are gone, so it
+        # must not reach the device; its journal already holds its state
+        survivors = [s for s in survivors if not s.preempted]
         if not survivors:
             return
         t0 = time.perf_counter()
@@ -599,6 +835,7 @@ class GenerationServer:
                 finished.append(s)
                 continue
             s.last_token = tok
+            s.gen_tokens.append(tok)
             s.n_new += 1
             if not s.fut._emit(tok):
                 finished.append(s)
@@ -647,7 +884,7 @@ class GenerationServer:
             if not drained:
                 aborted = 0
                 while self._pending:
-                    fut = self._pending.popleft()[0]
+                    fut = self._pending.popleft().fut
                     self._reject_locked(fut, Draining(
                         "drain timed out with the request still queued"))
                     aborted += 1

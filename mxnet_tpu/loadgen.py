@@ -180,15 +180,21 @@ def generate_trace(spec):
     """Materialize ``spec`` into a list of request dicts, each::
 
         {"i", "t", "prompt_len", "max_new_tokens", "deadline_ms",
-         "class", "session", "prefix_group"}
+         "class", "priority", "session", "prefix_group"}
 
     ``t`` is the arrival offset in seconds from trace start.  Same spec
-    (same seed) -> identical trace."""
+    (same seed) -> identical trace.  ``priority`` is the QoS wire form
+    ``"name=rank"``: the tighter a class's deadline, the higher its rank
+    (loosest class = rank 0), so preemption and brownout admission favor
+    exactly the requests with the least slack."""
     rng = np.random.default_rng(spec.seed)
     times = _arrival_times(spec, rng)
     weights = np.asarray([c["weight"] for c in spec.deadline_classes],
                          float)
     weights = weights / weights.sum()
+    by_slack = sorted(spec.deadline_classes,
+                      key=lambda c: -float(c["deadline_ms"]))
+    rank_of = {str(c["name"]): r for r, c in enumerate(by_slack)}
     reqs = []
     for i, t in enumerate(times):
         plen = int(min(spec.prompt_len_max, max(1, round(
@@ -205,10 +211,12 @@ def generate_trace(spec):
         session = None
         if spec.session_count > 0:
             session = "s%d" % int(rng.integers(spec.session_count))
+        name = str(cls["name"])
         reqs.append({"i": i, "t": round(float(t), 6),
                      "prompt_len": plen, "max_new_tokens": olen,
                      "deadline_ms": float(cls["deadline_ms"]),
-                     "class": str(cls["name"]),
+                     "class": name,
+                     "priority": "%s=%d" % (name, rank_of[name]),
                      "session": session, "prefix_group": group})
     return reqs
 
@@ -440,7 +448,8 @@ def generation_target(server, vocab=None, seed=0, timeout_s=None):
             fut = server.submit_async(
                 prompt_tokens(req, vocab=vocab, seed=seed),
                 max_new_tokens=req["max_new_tokens"],
-                deadline_ms=req["deadline_ms"])
+                deadline_ms=req["deadline_ms"],
+                priority=req.get("priority") or req.get("class"))
             for _ in fut.tokens(timeout=timeout_s):
                 n_tok += 1
         except Exception as e:   # noqa: BLE001 — typed below
@@ -498,9 +507,13 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
                     "deadline_ms": req["deadline_ms"]}
             if req.get("session"):
                 body["session"] = req["session"]
+            headers = {"Content-Type": "application/json"}
+            prio = req.get("priority") or req.get("class")
+            if prio:
+                headers["X-MXTPU-Priority"] = str(prio)
             conn.request("POST", "/v1/generate",
                          body=json.dumps(body).encode(),
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             resp = conn.getresponse()
             if resp.status != 200:
                 return _outcome_record(

@@ -233,10 +233,13 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   # overload-safe serving layer (docs/SERVING.md)
                   "requests_admitted", "requests_shed", "hedges_fired",
                   "breaker_trips", "batches_closed_by_deadline",
+                  "requests_shed_brownout", "brownout_escalated",
+                  "brownout_recovered",
                   # continuous-batching generative inference
                   # (docs/GENERATIVE.md)
                   "gen_prefills", "gen_decode_iters", "gen_tokens",
-                  "gen_pages_shed",
+                  "gen_pages_shed", "gen_preempted", "gen_resumed",
+                  "gen_brownout_shed",
                   # fleet layer: sharded replicas + autoscaling
                   # (docs/SHARDED_SERVING.md)
                   "fleet_replicas_added", "fleet_replicas_removed",
@@ -250,7 +253,8 @@ _DISPATCH_KEYS = ("jit_cache_hit", "jit_cache_miss", "recompile",
                   "fleet_worker_beats_failed", "fleet_worker_requests",
                   "fleet_worker_idem_replays",
                   "gateway_requests", "gateway_retries",
-                  "gateway_stream_lost", "gateway_registry_errors",
+                  "gateway_stream_lost", "gateway_stream_resumed",
+                  "gateway_registry_errors",
                   # diagnosis plane (docs/OBSERVABILITY.md): cost-capture
                   # failures behind mfu_source fallbacks, and postmortem
                   # bundles written by the debug plane

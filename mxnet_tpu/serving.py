@@ -74,7 +74,7 @@ from . import telemetry as _telemetry
 from .async_kv import backoff_delay as _backoff_delay
 
 __all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
-           "StreamingFuture",
+           "StreamingFuture", "BrownoutController", "brownout",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
            "Unavailable", "ReplicaLost",
            "STARTING", "SERVING", "DEGRADED", "DRAINING", "STOPPED"]
@@ -98,6 +98,15 @@ _DEF_BREAKER_BACKOFF = float(os.environ.get(
     "MXTPU_SERVE_BREAKER_BACKOFF", "0.2"))
 _DEF_BREAKER_BACKOFF_CAP = float(os.environ.get(
     "MXTPU_SERVE_BREAKER_BACKOFF_CAP", "30"))
+# brownout ladder (docs/GENERATIVE.md "Brownout"): consecutive breach /
+# clear ticks to step one level up / down, the max_new_tokens cap applied
+# at level >= 1, and the minimum priority rank admitted at level 3
+_DEF_BROWNOUT_ENGAGE = int(os.environ.get("MXTPU_BROWNOUT_ENGAGE_TICKS",
+                                          "3"))
+_DEF_BROWNOUT_RECOVER = int(os.environ.get("MXTPU_BROWNOUT_RECOVER_TICKS",
+                                           "5"))
+_DEF_BROWNOUT_CAP = int(os.environ.get("MXTPU_BROWNOUT_CAP_TOKENS", "32"))
+_DEF_BROWNOUT_MIN_RANK = int(os.environ.get("MXTPU_BROWNOUT_MIN_RANK", "1"))
 
 # close a batch this many seconds before the oldest deadline would be
 # missed, on top of the EWMA latency estimate (slack safety margin)
@@ -142,11 +151,142 @@ class Unavailable(ServingError):
 
 class ReplicaLost(ServingError):
     """The worker process holding this request died mid-execution and
-    the work is not safely resumable elsewhere (a generation stream past
-    its first token: the KV pages died with the worker).  Idempotent
-    prefill-phase work is retried on another worker instead — only
-    non-resumable in-flight requests surface this (gateway failover
-    contract, docs/SHARDED_SERVING.md "Deployment")."""
+    the work could not be completed anywhere else.  Since the durable-
+    stream contract, a generation stream that loses its worker mid-decode
+    is *resumed* on a healthy sibling from the gateway's journal (prompt
+    + seed + delivered tokens → re-prefill, exactly-once continuation);
+    this error is the >= 2-failure fallback — the resumed incarnation
+    died too, or no healthy sibling existed (gateway failover contract,
+    docs/SHARDED_SERVING.md "Failure matrix")."""
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+class BrownoutController:
+    """Typed overload-degradation ladder with tick-count hysteresis.
+
+    Levels (each includes the measures of the ones below it):
+
+    ====  ============  ====================================================
+    0     normal        no degradation
+    1     cap_tokens    generation ``max_new_tokens`` capped at
+                        ``MXTPU_BROWNOUT_CAP_TOKENS``
+    2     no_hedge      speculative hedging disabled (halves worst-case
+                        duplicate work)
+    3     qos_only      only priority ranks >= ``MXTPU_BROWNOUT_MIN_RANK``
+                        admitted; the rest shed with typed ``Overloaded``
+    ====  ============  ====================================================
+
+    :meth:`observe` is fed one breach/clear signal per supervisor tick
+    (:meth:`FleetSupervisor._tick <mxnet_tpu.fleet.FleetSupervisor>` —
+    the same shed-rate / p99 breach bit that drives autoscaling).
+    ``engage_ticks`` consecutive breaches escalate one level;
+    ``recover_ticks`` consecutive clears de-escalate one — so the ladder
+    both engages and fully recovers automatically, without flapping.
+    The current level is published on the ``serving.brownout_level``
+    gauge and every transition is counted and trace-marked."""
+
+    LEVELS = ("normal", "cap_tokens", "no_hedge", "qos_only")
+
+    def __init__(self, engage_ticks=None, recover_ticks=None,
+                 cap_tokens=None, min_rank=None):
+        self.engage_ticks = max(1, _DEF_BROWNOUT_ENGAGE
+                                if engage_ticks is None
+                                else int(engage_ticks))
+        self.recover_ticks = max(1, _DEF_BROWNOUT_RECOVER
+                                 if recover_ticks is None
+                                 else int(recover_ticks))
+        self.cap_tokens = (_DEF_BROWNOUT_CAP if cap_tokens is None
+                           else int(cap_tokens))
+        self.min_rank = (_DEF_BROWNOUT_MIN_RANK if min_rank is None
+                         else int(min_rank))
+        self._lock = threading.Lock()
+        self._level = 0
+        self._breach_streak = 0
+        self._clear_streak = 0
+        self._publish(0)
+
+    def _publish(self, level):
+        _telemetry.registry().gauge("serving.brownout_level").set(level)
+
+    @property
+    def level(self):
+        return self._level
+
+    @property
+    def mode(self):
+        return self.LEVELS[self._level]
+
+    def observe(self, breach):
+        """Feed one supervisor-tick overload signal; returns the (possibly
+        new) level.  Hysteresis: a level only changes after
+        ``engage_ticks`` consecutive breaches / ``recover_ticks``
+        consecutive clears, and streaks reset on every transition."""
+        with self._lock:
+            old = self._level
+            if breach:
+                self._clear_streak = 0
+                self._breach_streak += 1
+                if (self._breach_streak >= self.engage_ticks
+                        and self._level < len(self.LEVELS) - 1):
+                    self._level += 1
+                    self._breach_streak = 0
+            else:
+                self._breach_streak = 0
+                self._clear_streak += 1
+                if (self._clear_streak >= self.recover_ticks
+                        and self._level > 0):
+                    self._level -= 1
+                    self._clear_streak = 0
+            level = self._level
+        if level != old:
+            self._publish(level)
+            _count("brownout_escalated" if level > old
+                   else "brownout_recovered")
+            _telemetry.trace_instant(
+                "serving.brownout", args={"level": level,
+                                          "mode": self.LEVELS[level]})
+            _log("brownout level %d (%s) -> %d (%s)"
+                 % (old, self.LEVELS[old], level, self.LEVELS[level]))
+        return level
+
+    # -- degradation measures (queried at the enforcement sites) -------
+    def cap_max_new(self, max_new):
+        """Level >= 1: cap a generation request's ``max_new_tokens``."""
+        if self._level >= 1 and self.cap_tokens > 0:
+            return min(int(max_new), self.cap_tokens)
+        return int(max_new)
+
+    def hedging_disabled(self):
+        """Level >= 2: the hedging sweep becomes a no-op."""
+        return self._level >= 2
+
+    def admits(self, rank):
+        """Level 3: only priority ranks >= ``min_rank`` are admitted."""
+        return self._level < 3 or int(rank) >= self.min_rank
+
+    def reset(self):
+        with self._lock:
+            self._level = 0
+            self._breach_streak = 0
+            self._clear_streak = 0
+        self._publish(0)
+
+
+_BROWNOUT = None
+_BROWNOUT_LOCK = threading.Lock()
+
+
+def brownout():
+    """The process-global :class:`BrownoutController` — shared by the
+    fleet supervisor (which feeds it) and every admission/hedging
+    enforcement site (which query it).  Tests ``reset()`` it."""
+    global _BROWNOUT
+    with _BROWNOUT_LOCK:
+        if _BROWNOUT is None:
+            _BROWNOUT = BrownoutController()
+        return _BROWNOUT
 
 
 # ---------------------------------------------------------------------------
@@ -560,6 +700,7 @@ class ModelServer:
         self._preemption = None
         self.stats = {
             "queue_depth_peak": 0, "admitted": 0, "shed": 0,
+            "shed_brownout": 0,
             "rejected_draining": 0, "ok": 0, "deadline_exceeded": 0,
             "unavailable": 0, "batches_full": 0, "batches_timer": 0,
             "batches_deadline": 0, "hedges_fired": 0, "hedge_wins": 0,
@@ -690,9 +831,12 @@ class ModelServer:
         with self._cv:
             return self._queue_depth_locked()
 
-    def submit_async(self, inputs, deadline_ms=None):
+    def submit_async(self, inputs, deadline_ms=None, priority=None):
         """Admit one request; returns a :class:`ServingFuture`.  Raises
-        :class:`Overloaded` / :class:`Draining` at admission time."""
+        :class:`Overloaded` / :class:`Draining` at admission time.
+        ``priority`` is a QoS rank (int, or the ``"name=rank"`` wire
+        form); at brownout level 3 only ranks at or above
+        ``MXTPU_BROWNOUT_MIN_RANK`` are admitted."""
         feed = {}
         rows = None
         for name, arr in dict(inputs).items():
@@ -720,6 +864,15 @@ class ModelServer:
             raise ValueError("request rows %d > max_batch %d"
                              % (rows, self.max_batch))
 
+        # QoS rank for the brownout gate: int, or "name=rank" wire form
+        rank = 0
+        if priority is not None:
+            tail = str(priority).partition("=")[2] or str(priority)
+            try:
+                rank = int(tail.strip())
+            except ValueError:
+                rank = 0
+        bo = brownout()
         now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
@@ -730,6 +883,15 @@ class ModelServer:
                 raise Draining("server is %s: not admitting requests"
                                % (DRAINING if self._state != STOPPED
                                   else STOPPED))
+            if not bo.admits(rank):
+                # metered separately from "shed": deliberate degradation
+                # must not feed the supervisor's shed-rate breach bit, or
+                # the ladder would latch itself at level 3
+                self.stats["shed_brownout"] += 1
+                _count("requests_shed_brownout")
+                raise Overloaded(
+                    "brownout level %d (%s) admits only priority rank >= "
+                    "%d" % (bo.level, bo.mode, bo.min_rank))
             depth = self._queue_depth_locked()
             if depth >= self.max_queue:
                 self.stats["shed"] += 1
@@ -1146,7 +1308,7 @@ class ModelServer:
             self._dispatch_locked(job, repl, now)
 
     def _hedge_locked(self, now):
-        if self.hedge_ms <= 0:
+        if self.hedge_ms <= 0 or brownout().hedging_disabled():
             return
         for job in self._jobs:
             if (job.unresolved and job.inflight_execs >= 1

@@ -46,6 +46,7 @@ from . import chaos as _chaos
 from . import clock as _clockmod
 from . import debug as _debug
 from . import loadgen as _loadgen
+from . import serving as _serving
 from .fleet import FleetSupervisor, ServiceRegistry, cost_model
 from .gateway import Gateway
 
@@ -179,8 +180,8 @@ class SimServer:
                              else int(max_replicas))
         self.replicas = {}           # rid -> _SimReplica (insertion order)
         self._seq = 0
-        self.stats = {"admitted": 0, "shed": 0, "ok": 0,
-                      "deadline_exceeded": 0, "replica_lost": 0,
+        self.stats = {"admitted": 0, "shed": 0, "shed_brownout": 0,
+                      "ok": 0, "deadline_exceeded": 0, "replica_lost": 0,
                       "unavailable": 0}
         for _ in range(int(initial_replicas)):
             self.add_replica(instant=instant_start)
@@ -317,7 +318,31 @@ class SimFleet:
                 "incidents": list(self.incidents)}
 
     # -- routing (the real gateway policy + retry discipline) ----------
+    @staticmethod
+    def _prio_rank(req):
+        """QoS rank from the trace's ``"name=rank"`` priority (or bare
+        class name -> rank 0) — the wire form loadgen stamps."""
+        p = req.get("priority") or req.get("class")
+        if p is None:
+            return 0
+        tail = str(p).partition("=")[2] or str(p)
+        try:
+            return int(tail.strip())
+        except ValueError:
+            return 0
+
     def _route(self, req, now):
+        # brownout level 3 (qos_only): the real admission gate — fed by
+        # the real FleetSupervisor._tick breach bit — sheds low-rank
+        # classes with one typed Overloaded before they reach a replica
+        bo = _serving.brownout()
+        if not bo.admits(self._prio_rank(req)):
+            # metered apart from "shed": a deliberate qos_only rejection
+            # must not feed the shed-rate breach bit, or the ladder would
+            # hold its own level up and never recover
+            self.server.stats["shed_brownout"] += 1
+            self._settle(req, "Overloaded", now)
+            return
         excluded = []
         attempt = 0
         while True:

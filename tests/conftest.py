@@ -126,3 +126,14 @@ def _seed_everything():
     np.random.seed(seed)
     mx.random.seed(seed)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _reset_brownout():
+    """The brownout ladder is process-global and fed by every
+    FleetSupervisor tick — reset it after each test so an overload test
+    cannot leak degraded admission into its neighbors."""
+    yield
+    serving = sys.modules.get("mxnet_tpu.serving")
+    if serving is not None and serving._BROWNOUT is not None:
+        serving._BROWNOUT.reset()

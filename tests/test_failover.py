@@ -1,0 +1,415 @@
+"""Durable generation streams (ISSUE 14): bitwise mid-decode resume,
+QoS-tiered preemption, the page_pressure / worker_kill_mid_decode chaos
+kinds, and the fleet brownout degradation ladder.
+
+The in-process tests drive the SAME resume path a gateway failover uses
+(``submit_async(resume_from=...)``) so the bitwise-continuation invariant
+is asserted against the CPU oracle without process churn; the 2-process
+acceptance lives in tests/test_gateway.py
+(test_generation_stream_failover_across_processes).
+"""
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from mxnet_tpu import chaos, loadgen, profiler, serving, telemetry
+from mxnet_tpu.fleet import WorkerSupervisor
+from mxnet_tpu.generation import (GenerationConfig, GenerationServer,
+                                  PageAllocator, parse_priority)
+from mxnet_tpu.models import TransformerLM, TransformerConfig
+from mxnet_tpu.serving import BrownoutController, Overloaded
+from mxnet_tpu.simfleet import SimFleet
+
+VOCAB = 97
+
+
+def _model(max_len=64):
+    cfg = TransformerConfig(vocab_size=VOCAB, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_len=max_len,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _prompts(ns, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=n).astype(np.int32) for n in ns]
+
+
+def _gcfg(**kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_pages", 32)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_new_tokens", 8)
+    return GenerationConfig(**kw)
+
+
+def _wait(cond, timeout=30.0, interval=0.005, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError("timed out waiting for %s" % msg)
+
+
+# ---------------------------------------------------------------------------
+# priority parsing + allocator impound (the page_pressure mechanism)
+# ---------------------------------------------------------------------------
+def test_parse_priority_shapes():
+    assert parse_priority(None) == ("default", 0)
+    assert parse_priority(2) == ("p2", 2)
+    assert parse_priority("interactive=2") == ("interactive", 2)
+    assert parse_priority("3") == ("p3", 3)
+    assert parse_priority("batch") == ("batch", 0)
+    assert parse_priority("batch=junk") == ("batch", 0)
+
+
+def test_allocator_impound_counts_as_used_then_releases():
+    a = PageAllocator(11)                 # 10 usable, page 0 reserved
+    held = a.alloc(2)
+    n = a.impound(0.9)                    # int(8 * 0.9) = 7
+    assert n == 7
+    assert a.used == 9                    # impounded pages read as used
+    assert a.alloc(2) is None             # only 1 page actually free
+    assert a.release() == 7
+    assert a.used == 2
+    a.free(held + a.alloc(8))
+    assert a.used == 0
+
+
+# ---------------------------------------------------------------------------
+# the two new chaos kinds
+# ---------------------------------------------------------------------------
+def test_worker_kill_mid_decode_requires_streamed_token():
+    """The kind is gated on >= 1 streamed token so the kill is mid-decode
+    BY CONSTRUCTION — a kill before the first token is the (already
+    covered) idempotent pre-stream retry case, not this fault."""
+    with chaos.inject("worker_kill_mid_decode@0"):
+        assert not chaos.worker_kill_mid_decode(0, 0)   # nothing streamed
+        assert chaos.worker_kill_mid_decode(0, 1)       # gate satisfied
+        assert not chaos.worker_kill_mid_decode(0, 1)   # once per item
+    assert not chaos.worker_kill_mid_decode(0, 5)       # no plan: inert
+
+
+def test_page_pressure_fires_once_with_fraction():
+    with chaos.inject("page_pressure@2"):
+        assert chaos.page_pressure(1) == 0.0
+        assert chaos.page_pressure(2) == pytest.approx(0.9)
+        assert chaos.page_pressure(2) == 0.0            # once per item
+    assert chaos.page_pressure(2) == 0.0                # no plan: inert
+
+
+_SLEEPER = [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+def test_supervisor_mid_decode_kill_waits_for_streamed_token():
+    """WorkerSupervisor only fires worker_kill_mid_decode after its
+    streamed-token probe reports delivery (the gateway's fleet-wide
+    ``tokens_streamed`` counter in production)."""
+    streamed = [0]
+    spec = ",".join("worker_kill_mid_decode@%d" % i for i in range(2000))
+    with chaos.inject(spec):
+        sup = WorkerSupervisor({"w0": _SLEEPER}, max_restarts=5,
+                               backoff=0.01, backoff_cap=0.02,
+                               poll_s=0.01,
+                               streamed_probe=lambda: streamed[0])
+        try:
+            time.sleep(0.3)
+            assert sup.kills == 0         # probe at 0: kill held back
+            streamed[0] = 1
+            _wait(lambda: sup.kills >= 1 and sup.restarts >= 1,
+                  msg="mid-decode kill + respawn")
+        finally:
+            sup.stop(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# bitwise resume (the in-process half of the failover tentpole)
+# ---------------------------------------------------------------------------
+class TestResume:
+    def test_greedy_resume_is_bitwise_identical(self):
+        """Re-prefilling prompt+prefix continues the exact stream: for
+        every cut point the resumed suffix equals the unkilled run."""
+        model, params = _model()
+        srv = GenerationServer(model, params, _gcfg())
+        try:
+            prompt = _prompts([6])[0]
+            full = srv.submit(prompt, max_new_tokens=8, temperature=0.0,
+                              timeout=60)
+            assert len(full) == 8
+            base = profiler.dispatch_value("gen_resumed")
+            for cut in (1, 3, 7):
+                suffix = srv.submit(prompt, max_new_tokens=8,
+                                    temperature=0.0,
+                                    resume_from=full[:cut], timeout=60)
+                assert suffix == full[cut:], "cut=%d" % cut
+            assert srv.snapshot()["stats"]["resumed"] == 3
+            assert profiler.dispatch_value("gen_resumed") == base + 3
+        finally:
+            srv.drain(timeout=10)
+
+    def test_seeded_sampled_resume_replays_suffix(self):
+        """Sampled streams resume bitwise too: one rng draw per token, so
+        fast-forwarding the seeded rng by len(prefix) draws lands exactly
+        where the dead incarnation stopped."""
+        model, params = _model()
+        srv = GenerationServer(model, params, _gcfg())
+        try:
+            prompt = _prompts([6], seed=23)[0]
+            kw = dict(max_new_tokens=8, temperature=1.2, top_k=8,
+                      seed=123)
+            full = srv.submit(prompt, timeout=60, **kw)
+            assert len(full) == 8
+            for cut in (1, 4, 6):
+                suffix = srv.submit(prompt, resume_from=full[:cut],
+                                    timeout=60, **kw)
+                assert suffix == full[cut:], "cut=%d" % cut
+        finally:
+            srv.drain(timeout=10)
+
+    def test_resume_already_at_cap_rejected(self):
+        model, params = _model()
+        srv = GenerationServer(model, params, _gcfg())
+        try:
+            with pytest.raises(ValueError):
+                srv.submit_async(_prompts([4])[0], max_new_tokens=4,
+                                 resume_from=[1, 2, 3, 4])
+        finally:
+            srv.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# QoS-tiered preemption under page exhaustion
+# ---------------------------------------------------------------------------
+class TestPreemption:
+    def test_high_priority_preempts_low_then_low_completes(self):
+        """Page exhaustion preempts the lowest-priority stream (journaled
+        + re-admitted via the resume path) instead of shedding; every
+        stream still completes with its exact token sequence and
+        ``gen_pages_shed`` never fires."""
+        model, params = _model()
+        # 5 usable pages; each 9-token prompt needs 2 -> the third
+        # admission must preempt
+        srv = GenerationServer(model, params,
+                               _gcfg(max_pages=6, max_new_tokens=6))
+        try:
+            base_shed = profiler.dispatch_value("gen_pages_shed")
+            p = _prompts([9, 9, 9], seed=5)
+            skw = dict(temperature=1.1, top_k=8, seed=77)
+            lows = [srv.submit_async(p[0], max_new_tokens=6,
+                                     temperature=0.0, priority="batch=0"),
+                    srv.submit_async(p[1], max_new_tokens=6,
+                                     priority=0, **skw)]
+            high = srv.submit_async(p[2], max_new_tokens=6,
+                                    temperature=0.0,
+                                    priority="interactive=2")
+            hi = high.result(timeout=60)
+            lo = [f.result(timeout=60) for f in lows]
+            stats = srv.snapshot()["stats"]
+            assert stats["preempted"] >= 1
+            assert stats["shed_pages"] == 0
+            assert profiler.dispatch_value("gen_pages_shed") == base_shed
+            assert profiler.dispatch_value("gen_preempted") >= 1
+            assert srv.engine.allocator.used == 0    # victims freed pages
+            # preemption + re-admission perturbed nothing: greedy and
+            # seeded streams both match an uncontended run bitwise
+            assert lo[0] == srv.submit(p[0], max_new_tokens=6,
+                                       temperature=0.0, timeout=60)
+            assert lo[1] == srv.submit(p[1], max_new_tokens=6,
+                                       timeout=60, **skw)
+            assert hi == srv.submit(p[2], max_new_tokens=6,
+                                    temperature=0.0, timeout=60)
+        finally:
+            srv.drain(timeout=10)
+
+    def test_same_or_higher_priority_only_then_shed_fires(self):
+        """gen_pages_shed is the LAST resort: it fires only when every
+        page-holding stream is same-or-higher priority than the starved
+        admission."""
+        model, params = _model()
+        srv = GenerationServer(model, params,
+                               _gcfg(max_pages=6, max_new_tokens=6))
+        try:
+            base_shed = profiler.dispatch_value("gen_pages_shed")
+            p = _prompts([9, 9, 9], seed=5)
+            highs = [srv.submit_async(x, max_new_tokens=6,
+                                      temperature=0.0,
+                                      priority="interactive=2")
+                     for x in p[:2]]
+            low = srv.submit_async(p[2], max_new_tokens=6,
+                                   temperature=0.0, priority="batch=0")
+            outcomes = []
+            for f in highs + [low]:
+                try:
+                    outcomes.append(("ok", f.result(timeout=60)))
+                except Overloaded:
+                    outcomes.append(("overloaded", None))
+            stats = srv.snapshot()["stats"]
+            # the low-rank admission found no lower-rank victim: shed
+            assert stats["preempted"] == 0
+            if stats["shed_pages"]:
+                assert profiler.dispatch_value("gen_pages_shed") \
+                    > base_shed
+                assert outcomes[2][0] == "overloaded"
+            assert outcomes[0][0] == outcomes[1][0] == "ok"
+        finally:
+            srv.drain(timeout=10)
+
+    @pytest.mark.chaos
+    def test_page_pressure_chaos_preempts_low_never_sheds_high(self):
+        """ISSUE 14 acceptance: page_pressure shrinks the free list
+        mid-run; a high-priority admission preempts the low-priority
+        stream (which later completes) and no high-priority work is
+        shed."""
+        model, params = _model()
+        srv = GenerationServer(model, params,
+                               _gcfg(max_pages=8, max_new_tokens=10))
+        try:
+            seen = []
+
+            def slow_token(t):
+                seen.append(t)
+                time.sleep(0.02)     # keep the stream mid-decode
+
+            low = srv.submit_async(_prompts([9])[0], max_new_tokens=10,
+                                   temperature=0.0, priority="batch=0",
+                                   on_token=slow_token)
+            _wait(lambda: len(seen) >= 1, msg="low stream to start")
+            turn = srv._loop_turn
+            spec = ",".join("page_pressure@%d" % i
+                            for i in range(turn, turn + 200))
+            with chaos.inject(spec):
+                _wait(lambda: srv.engine.allocator._held,
+                      msg="free list impounded")
+                high = srv.submit_async(_prompts([9], seed=9)[0],
+                                        max_new_tokens=4,
+                                        temperature=0.0,
+                                        priority="interactive=2")
+                assert len(high.result(timeout=60)) == 4
+            assert len(low.result(timeout=120)) == 10   # low completed
+            stats = srv.snapshot()["stats"]
+            assert stats["preempted"] >= 1
+            assert stats["shed_pages"] == 0
+            _wait(lambda: not srv.engine.allocator._held, timeout=60,
+                  msg="pressure window to release")
+        finally:
+            srv.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# brownout degradation ladder
+# ---------------------------------------------------------------------------
+class TestBrownout:
+    def test_ladder_hysteresis_gauge_and_recovery(self):
+        esc0 = profiler.dispatch_value("brownout_escalated")
+        rec0 = profiler.dispatch_value("brownout_recovered")
+        bo = BrownoutController(engage_ticks=2, recover_ticks=2,
+                                cap_tokens=8, min_rank=1)
+        assert bo.level == 0 and bo.mode == "normal"
+        assert bo.cap_max_new(64) == 64
+        assert bo.observe(True) == 0          # hysteresis: 1 breach
+        assert bo.observe(True) == 1          # cap_tokens engages
+        assert bo.cap_max_new(64) == 8
+        assert not bo.hedging_disabled() and bo.admits(0)
+        bo.observe(True)
+        assert bo.observe(True) == 2          # no_hedge
+        assert bo.hedging_disabled() and bo.admits(0)
+        bo.observe(True)
+        assert bo.observe(True) == 3          # qos_only
+        assert not bo.admits(0) and bo.admits(1)
+        assert bo.observe(True) == 3          # saturates
+        assert telemetry.registry().gauge(
+            "serving.brownout_level").value == 3
+        # one clear does not de-escalate; a breach resets the streak
+        assert bo.observe(False) == 3
+        assert bo.observe(True) == 3
+        # full automatic recovery, one level per recover_ticks streak
+        levels = [bo.observe(False) for _ in range(6)]
+        assert levels == [3, 2, 2, 1, 1, 0]
+        assert bo.mode == "normal" and bo.admits(0)
+        assert telemetry.registry().gauge(
+            "serving.brownout_level").value == 0
+        assert profiler.dispatch_value("brownout_escalated") == esc0 + 3
+        assert profiler.dispatch_value("brownout_recovered") == rec0 + 3
+
+    def test_generation_brownout_caps_and_gates_admission(self):
+        """Level >= 1 caps max_new_tokens; level 3 admits only ranks at
+        or above MXTPU_BROWNOUT_MIN_RANK with a typed Overloaded for the
+        rest (the _reset_brownout conftest fixture restores level 0)."""
+        bo = serving.brownout()
+        model, params = _model()
+        srv = GenerationServer(model, params, _gcfg())
+        try:
+            for _ in range(3 * bo.engage_ticks):
+                bo.observe(True)
+            assert bo.level == 3
+            with pytest.raises(Overloaded):
+                srv.submit(_prompts([5])[0], max_new_tokens=3, timeout=60)
+            assert srv.snapshot()["stats"]["shed_brownout"] == 1
+            # rank >= min_rank still admitted, but token-capped
+            capped = srv.submit(_prompts([4])[0], max_new_tokens=40,
+                                priority="interactive=1", timeout=60)
+            assert len(capped) == bo.cap_tokens
+            bo.reset()
+            out = srv.submit(_prompts([5])[0], max_new_tokens=3,
+                             timeout=60)
+            assert len(out) == 3
+        finally:
+            srv.drain(timeout=10)
+
+    def test_model_server_brownout_shed_is_typed_and_metered_apart(self):
+        from mxnet_tpu.fleet_worker import demo_model
+
+        bo = serving.brownout()
+        srv = demo_model()
+        try:
+            for _ in range(3 * bo.engage_ticks):
+                bo.observe(True)
+            x = {"data": np.ones((1, 4), np.float32)}
+            with pytest.raises(Overloaded):
+                srv.submit_async(x)
+            snap = srv.snapshot()
+            # deliberate degradation must not feed the shed-rate breach
+            # bit (that would latch the ladder at level 3 forever)
+            assert snap["shed_brownout"] == 1 and snap["shed"] == 0
+            fut = srv.submit_async(x, priority="interactive=1")
+            assert len(fut.result(timeout=60)) == 1
+        finally:
+            bo.reset()
+            srv.drain(timeout=30)
+
+    def test_simfleet_overload_brownout_engages_and_recovers(self):
+        """ISSUE 14 acceptance: a SimFleet overload replay drives the
+        ladder up through the REAL FleetSupervisor breach bit and back
+        to level 0 in the quiet tail, with every request typed."""
+        bo = serving.brownout()
+        esc0 = profiler.dispatch_value("brownout_escalated")
+        rec0 = profiler.dispatch_value("brownout_recovered")
+        spec = loadgen.TraceSpec(seed=11, segments=[
+            {"duration_s": 2.0, "rate_rps": 4.0},
+            {"duration_s": 10.0, "rate_rps": 120.0},
+            {"duration_s": 25.0, "rate_rps": 1.0},
+        ], deadline_classes=[
+            {"name": "interactive", "deadline_ms": 500.0, "weight": 0.5},
+            {"name": "batch", "deadline_ms": 5000.0, "weight": 0.5},
+        ])
+        trace = loadgen.generate_trace(spec)
+        # loadgen stamps wire-form priorities: tightest deadline gets the
+        # highest rank, loosest rank 0
+        assert {r["priority"] for r in trace} \
+            == {"interactive=1", "batch=0"}
+        with SimFleet(trace, initial_replicas=2, max_replicas=2,
+                      slots=2, queue_cap=8, seed=6) as fl:
+            res = fl.run()
+        esc = profiler.dispatch_value("brownout_escalated") - esc0
+        rec = profiler.dispatch_value("brownout_recovered") - rec0
+        assert esc >= 1                       # the ladder engaged …
+        assert rec == esc and bo.level == 0   # … and fully recovered
+        # exactly one typed outcome per request, none UNTYPED
+        assert sum(res["outcomes"].values()) == len(trace)
+        assert set(res["outcomes"]) <= set(loadgen.TYPED_OUTCOMES)
+        assert res["outcomes"].get("ok", 0) > 0

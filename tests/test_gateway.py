@@ -121,13 +121,17 @@ def _offline_gateway():
 def test_new_chaos_kinds_and_counters_registered():
     assert "gateway_partition" in chaos.FAULT_KINDS
     assert "worker_kill" in chaos.FAULT_KINDS
+    assert "worker_kill_mid_decode" in chaos.FAULT_KINDS
+    assert "page_pressure" in chaos.FAULT_KINDS
     stats = profiler.dispatch_stats()
     for key in ("fleet_worker_restarts", "fleet_worker_crashes",
                 "fleet_worker_kills", "fleet_worker_beats",
                 "fleet_worker_beats_failed", "fleet_worker_requests",
                 "fleet_worker_idem_replays", "gateway_requests",
                 "gateway_retries", "gateway_stream_lost",
-                "gateway_registry_errors"):
+                "gateway_stream_resumed", "gateway_registry_errors",
+                "gen_preempted", "gen_resumed", "gen_brownout_shed",
+                "brownout_escalated", "brownout_recovered"):
         assert key in stats, key
 
 
@@ -274,9 +278,13 @@ def test_gateway_roundtrip_and_partition_staleness():
 # failover mechanics against fake NDJSON workers (deterministic)
 # ---------------------------------------------------------------------------
 class _FakeStreamWorker:
-    """Minimal NDJSON /v1/generate endpoint: streams ``tokens`` token
-    lines, then either a terminal line or a bare close (a SIGKILL'd
-    worker looks exactly like this — clean EOF, no reset)."""
+    """Minimal NDJSON /v1/generate endpoint: streams token lines up to
+    ``tokens``, then either a terminal line or a bare close (a SIGKILL'd
+    worker looks exactly like this — clean EOF, no reset).  Resume-aware
+    like the real worker: ``resume_from`` in the body makes it re-prefill
+    (conceptually) and stream only positions ``len(resume_from)..`` —
+    token value == position, so exactly-once delivery is checkable as a
+    plain list equality."""
 
     def __init__(self, rid, tokens=3, die_mid_stream=False):
         fake = self
@@ -284,17 +292,19 @@ class _FakeStreamWorker:
         class _H(BaseHTTPRequestHandler):
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
-                self.rfile.read(n)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                fake.requests.append(body)
+                start = len(body.get("resume_from") or [])
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.end_headers()
-                for t in range(fake.tokens):
+                for t in range(start, fake.tokens):
                     self.wfile.write(
                         (json.dumps({"token": t}) + "\n").encode())
                     self.wfile.flush()
                 if not fake.die_mid_stream:
                     self.wfile.write((json.dumps(
-                        {"done": True, "tokens": fake.tokens,
+                        {"done": True, "tokens": fake.tokens - start,
                          "rid": fake.rid}) + "\n").encode())
 
             def log_message(self, *a):
@@ -303,6 +313,7 @@ class _FakeStreamWorker:
         self.rid = rid
         self.tokens = tokens
         self.die_mid_stream = die_mid_stream
+        self.requests = []
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
         self.httpd.daemon_threads = True
         self.addr = "127.0.0.1:%d" % self.httpd.server_address[1]
@@ -314,30 +325,100 @@ class _FakeStreamWorker:
         self.httpd.server_close()
 
 
-def test_generate_mid_stream_death_is_one_typed_replica_lost():
-    """A stream that dies after the first token is NOT retried (the KV
-    pages died with the worker): the client sees the streamed prefix
-    plus exactly one typed ReplicaLost terminal line."""
+def test_generate_mid_stream_death_resumes_on_sibling():
+    """Durable-stream tentpole: a worker death mid-decode re-submits to
+    the healthy sibling with ``resume_from`` = the delivered prefix and a
+    fresh idempotency key; the client sees every position exactly once
+    and ONE terminal done line covering all incarnations."""
     dying = _FakeStreamWorker("d0", tokens=3, die_mid_stream=True)
-    healthy = _FakeStreamWorker("h0", tokens=2)
+    healthy = _FakeStreamWorker("h0", tokens=6)
     gw = _offline_gateway()
     try:
         gw._view = _view({"d0": {"addr": dying.addr, "inflight": 0},
                           "h0": {"addr": healthy.addr, "inflight": 9}})
         got = []
-        gw._forward_generate({"prompt": [1], "session": "s1"},
-                             got.append, time.monotonic())
-        assert [l for l in got if "token" in l] == [
-            {"token": 0}, {"token": 1}, {"token": 2}]
+        gw._forward_generate(
+            {"prompt": [1], "session": "s1", "idempotency_key": "k0"},
+            got.append, time.monotonic())
+        # exactly-once: positions 0..5, no duplicates, no gaps
+        assert [l["token"] for l in got if "token" in l] == list(range(6))
+        assert got[-1]["done"] is True
+        assert got[-1]["tokens"] == 6          # covers both incarnations
+        assert got[-1]["resumed"] == 1
+        assert not any("error" in l for l in got)
+        assert gw.streams_resumed == 1 and gw.streams_lost == 0
+        # the sibling was handed the journaled prefix + a FRESH key (the
+        # dead worker's key would replay its stored outcome)
+        resumed = healthy.requests[-1]
+        assert resumed["resume_from"] == [0, 1, 2]
+        assert resumed["idempotency_key"] != "k0"
+    finally:
+        gw.httpd.server_close()
+        dying.close()
+        healthy.close()
+
+
+def test_generate_second_mid_stream_death_is_one_typed_replica_lost():
+    """ReplicaLost survives as the >= 2-failure fallback: when the
+    resume incarnation ALSO dies, the client gets exactly one typed
+    ReplicaLost terminal — never a bare EOF, never a third attempt."""
+    d0 = _FakeStreamWorker("d0", tokens=3, die_mid_stream=True)
+    d1 = _FakeStreamWorker("d1", tokens=5, die_mid_stream=True)
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"d0": {"addr": d0.addr, "inflight": 0},
+                          "d1": {"addr": d1.addr, "inflight": 9}})
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
         assert got[-1]["error"] == "ReplicaLost"
         assert sum(1 for l in got if "error" in l) == 1
-        assert gw.streams_lost == 1
-        # the lost worker is suspect now; the same session re-routes to
-        # the survivor and completes normally
-        got2 = []
-        gw._forward_generate({"prompt": [1], "session": "s1"},
-                             got2.append, time.monotonic())
-        assert got2[-1] == {"done": True, "tokens": 2, "rid": "h0"}
+        assert gw.streams_resumed == 1          # first death resumed …
+        assert gw.streams_lost == 1             # … second one lost
+        # the resume incarnation streamed only the continuation
+        assert [l["token"] for l in got if "token" in l] == list(range(5))
+    finally:
+        gw.httpd.server_close()
+        d0.close()
+        d1.close()
+
+
+def test_generate_no_sibling_to_resume_is_replica_lost():
+    """A death with no healthy sibling left cannot resume: typed
+    ReplicaLost, not an untyped hang or bare EOF."""
+    dying = _FakeStreamWorker("d0", tokens=2, die_mid_stream=True)
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"d0": {"addr": dying.addr, "inflight": 0}})
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
+        assert got[-1]["error"] == "ReplicaLost"
+        assert gw.streams_lost == 1 and gw.streams_resumed == 0
+    finally:
+        gw.httpd.server_close()
+        dying.close()
+
+
+def test_generate_journal_cap_disarms_resume(monkeypatch):
+    """Past MXTPU_GATE_JOURNAL_CAP tokens the journal stops growing and
+    a later death falls back to ReplicaLost (an unbounded prefix would
+    make the re-prefill cost unbounded too)."""
+    from mxnet_tpu import gateway as gwmod
+
+    monkeypatch.setattr(gwmod, "_DEF_JOURNAL_CAP", 2)
+    dying = _FakeStreamWorker("d0", tokens=5, die_mid_stream=True)
+    healthy = _FakeStreamWorker("h0", tokens=8)
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"d0": {"addr": dying.addr, "inflight": 0},
+                          "h0": {"addr": healthy.addr, "inflight": 9}})
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
+        assert got[-1]["error"] == "ReplicaLost"
+        assert gw.streams_lost == 1 and gw.streams_resumed == 0
+        assert healthy.requests == []           # resume never attempted
     finally:
         gw.httpd.server_close()
         dying.close()
@@ -529,9 +610,11 @@ def test_fleet_survives_worker_kill_and_gateway_partition():
 # ---------------------------------------------------------------------------
 @pytest.mark.slow
 def test_generation_stream_failover_across_processes():
-    """Mid-decode SIGKILL of a real generation worker: the client's
-    stream terminates with one typed ReplicaLost line and the same
-    session's next request completes on the survivor."""
+    """ISSUE 14 acceptance: mid-decode SIGKILL of a real generation
+    worker (>= 1 token streamed).  The stream resumes on the sibling —
+    re-prefilled from the journaled prefix — and the complete greedy
+    stream is BITWISE IDENTICAL to an unkilled run of the same request,
+    with zero ReplicaLost terminals."""
     reg = ServiceRegistry(service="accept", ttl_s=1.0)
     builder = "mxnet_tpu.fleet_worker:demo_generation"
     sup = WorkerSupervisor(
@@ -544,15 +627,29 @@ def test_generation_stream_failover_across_processes():
         sup.wait_registered(2, timeout=300)
         _wait(lambda: gw._view is not None and len(gw._view.replicas) == 2,
               timeout=30, msg="gateway to see both workers")
-        req = {"prompt": [1, 2, 3], "max_new_tokens": 64,
-               "session": "s1"}
-        # warm the decode path end-to-end (first stream compiles)
+        # greedy (temperature 0): the reference stream is a pure
+        # function of the prompt — identical on every replica
+        req = {"prompt": [1, 2, 3], "max_new_tokens": 24,
+               "temperature": 0.0, "session": "s1"}
+        # warm the decode path end-to-end on BOTH sides (first stream
+        # compiles) and learn the session's worker
         lines = _stream(gw.addr, "/v1/generate",
                         {**req, "max_new_tokens": 4}, timeout=300)
         assert lines[-1].get("done") is True
         first_rid = lines[-1]["rid"]
+        other = _stream(gw.addr, "/v1/generate",
+                        {**req, "session": "s2", "max_new_tokens": 4},
+                        timeout=300)
+        assert other[-1].get("done") is True
 
-        # stream again, killing the session's worker after 3 tokens
+        # the unkilled reference run
+        ref = _stream(gw.addr, "/v1/generate", req, timeout=300)
+        assert ref[-1].get("done") is True
+        ref_tokens = [l["token"] for l in ref if "token" in l]
+        assert len(ref_tokens) >= 2
+
+        # same request again, SIGKILLing the session's worker after the
+        # first streamed token (mid-decode by construction)
         host, _, port = gw.addr.rpartition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=300)
         conn.request("POST", "/v1/generate",
@@ -566,18 +663,21 @@ def test_generation_stream_failover_across_processes():
             if not raw:
                 break
             got.append(json.loads(raw))
-            if len(got) == 3 and killed is None:
+            if "token" in got[-1] and killed is None:
                 killed = sup.kill_worker(first_rid)
             if "done" in got[-1] or "error" in got[-1]:
                 break
         conn.close()
         assert killed == first_rid
         terminal = got[-1]
-        # either the kill landed mid-stream (ReplicaLost) or the tiny
-        # model finished the stream before the signal did (done) — both
-        # are single typed terminals; no bare EOF
-        assert ("error" in terminal and terminal["error"] == "ReplicaLost") \
-            or terminal.get("done") is True, got
+        assert terminal.get("done") is True, got    # zero ReplicaLost
+        got_tokens = [l["token"] for l in got if "token" in l]
+        # bitwise-identical continuation, each position exactly once
+        assert got_tokens == ref_tokens
+        if terminal.get("resumed"):
+            assert gw.streams_resumed >= 1
+            assert terminal["tokens"] == len(ref_tokens)
+        assert gw.streams_lost == 0
 
         # the same session re-routes and completes on a live worker
         lines = _stream(gw.addr, "/v1/generate",
