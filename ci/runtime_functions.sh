@@ -118,7 +118,10 @@ chaos_check() {
     # tests/test_chaos.py — NaN-gradient skip/rollback/rescale/restore
     # escalation, KV drop/delay/dup healing, checkpoint-corruption CRC
     # fallback, loader skip-and-count — plus the preemption smoke.
-    python -m pytest tests/ -q -m chaos
+    # MXTPU_LEAKCHECK=raise: every test must end quiescent — pages
+    # freed, probe slots released, admitted futures settled
+    # (docs/STATIC_ANALYSIS.md "Runtime leakcheck").
+    MXTPU_LEAKCHECK=raise python -m pytest tests/ -q -m chaos
     fault_injection_smoke
 }
 
@@ -208,7 +211,10 @@ gateway_check() {
     # acceptance scenario (worker_kill + gateway_partition mid-burst,
     # every request typed, killed worker back in rotation, survivor
     # zero-recompile across the process boundary).
-    python -m pytest tests/test_gateway.py -q -m "not slow"
+    # MXTPU_LEAKCHECK=raise: a resume-heavy burst must leave zero live
+    # stream journals and zero unsettled futures behind
+    MXTPU_LEAKCHECK=raise python -m pytest tests/test_gateway.py -q \
+        -m "not slow"
     # both new modules must lint clean — NO suppressions: the gateway
     # handler threads and the worker heartbeat do blocking socket I/O,
     # so a single CC001 slip serializes the whole front door
@@ -231,8 +237,11 @@ failover_check() {
     # under the lockdep sanitizer in raise mode: the resume path
     # crosses the scheduler loop, the allocator, and gateway handler
     # threads — any new lock inversion should fail here, not deadlock
-    # in production.
-    MXTPU_LOCKDEP=raise python -m pytest tests/test_failover.py \
+    # in production.  Leakcheck rides along in raise mode: a failover
+    # or preemption that strands a page, probe slot, or future fails
+    # the lane at the first non-quiescent test.
+    MXTPU_LOCKDEP=raise MXTPU_LEAKCHECK=raise \
+        python -m pytest tests/test_failover.py \
         tests/test_gateway.py -q -m "not slow"
     # every module the failover path touches must lint clean — NO
     # suppressions: preemption holds allocator state across the

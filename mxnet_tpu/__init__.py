@@ -18,6 +18,13 @@ from . import lockdep  # noqa: F401
 
 lockdep.install_from_env()
 
+# arm the runtime resource-leak sanitizer (MXTPU_LEAKCHECK) the same way
+# — stdlib-only, and its track/untrack hooks must be live before the
+# first allocator/breaker/future exists
+from . import leakcheck  # noqa: F401
+
+leakcheck.install_from_env()
+
 # arm the persistent XLA compilation cache (MXNET_COMPILE_CACHE) before
 # anything can trigger a compile — jax reads the cache dir at compile time,
 # so this must precede the first jitted call anywhere in the process

@@ -69,6 +69,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import chaos as _chaos
 from . import clock as _clockmod
+from . import leakcheck as _leakcheck
 from . import telemetry as _telemetry
 
 __all__ = ["Gateway"]
@@ -347,12 +348,26 @@ class Gateway:
         construction and the client sees each position exactly once.
         ``ReplicaLost`` survives only as the fallback: a second
         mid-stream loss, no healthy sibling, or a journal past
-        ``MXTPU_GATE_JOURNAL_CAP`` tokens."""
+        ``MXTPU_GATE_JOURNAL_CAP`` tokens.
+
+        Journal lifetime: the ``delivered`` journal lives exactly as
+        long as the request that owns it — created here, dropped on
+        every way out of the stream (terminal line written, fallback
+        error, or handler crash).  The leakcheck ledger (``journal``
+        kind) pins that eviction at runtime: after any burst, however
+        resume-heavy, the live-journal count returns to zero."""
+        delivered = []      # journal: token values already written
+        _leakcheck.track("journal", id(delivered))
+        try:
+            self._stream_generate(body, write_line, t0, delivered)
+        finally:
+            _leakcheck.untrack("journal", id(delivered))
+
+    def _stream_generate(self, body, write_line, t0, delivered):
         session = body.get("session")
         excluded = []
         attempt = 0
         losses = 0          # mid-stream worker deaths for this request
-        delivered = []      # journal: token values already written
         overflowed = False  # journal passed the cap — resume disarmed
         while True:
             picked = self._pick(session=session, exclude=excluded)

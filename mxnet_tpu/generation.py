@@ -46,6 +46,7 @@ import numpy as np
 from . import chaos as _chaos
 from . import clock as _clockmod
 from . import dispatch as _dispatch
+from . import leakcheck as _leakcheck
 from . import profiler as _profiler
 from . import telemetry as _telemetry
 from .serving import (DRAINING, SERVING, STARTING, STOPPED, DeadlineExceeded,
@@ -199,12 +200,19 @@ class PageAllocator:
             if n > len(self._free):
                 return None
             got = [self._free.pop() for _ in range(int(n))]
+        for p in got:
+            # leakcheck ledger: one entry per page until it comes back
+            # through free() (RL001's kv-pages pair, mirrored at runtime)
+            _leakcheck.track("kv_pages", (id(self), p))
         self._publish()
         return got
 
     def free(self, pages):
+        pages = [int(p) for p in pages]
         with self._lock:
-            self._free.extend(int(p) for p in pages)
+            self._free.extend(pages)
+        for p in pages:
+            _leakcheck.untrack("kv_pages", (id(self), p))
         self._publish()
 
     def impound(self, frac):

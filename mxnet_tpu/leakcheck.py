@@ -1,0 +1,289 @@
+"""Runtime resource-leak sanitizer (the dynamic half of mxlint's RL rules).
+
+Static analysis (``mxnet_tpu.lint.lifecycle``, rules RL001-RL004) proves
+release-on-every-path for the acquire/release pairs it can see; this
+module watches the ones it cannot — lifetimes that cross threads, queue
+hand-offs, or process boundaries — by keeping a creation-site-attributed
+ledger of every live instance of the framework's leak-prone resources:
+
+=============  ========================================================
+kind           one live entry per ...
+=============  ========================================================
+``kv_pages``   KV-cache page handed out by ``PageAllocator.alloc`` and
+               not yet returned through ``free``
+``probe_slots``  reserved half-open circuit-breaker probe slot
+               (``CircuitBreaker.acquire_probe``) with no outcome or
+               release recorded yet
+``mesh_slices``  mesh slice in the transitional scale-up window —
+               popped from the server's free pool but not yet owned by
+               a replica or returned (``ModelServer.add_replica``);
+               replica-held slices are legitimate long-lived ownership
+               and are NOT counted
+``futures``    admitted :class:`~mxnet_tpu.serving.ServingFuture` /
+               ``StreamingFuture`` with no typed terminal outcome yet
+``journal``    gateway stream journal alive for an in-flight
+               ``/v1/generate`` request (``_forward_generate``)
+=============  ========================================================
+
+Armed with ``MXTPU_LEAKCHECK``:
+
+* ``off`` (default) — every hook is a single ``if not _installed``
+  check: zero ledger state, zero per-event cost.
+* ``record`` — live entries are recorded with creation site + thread,
+  exported as ``leakcheck.*`` telemetry gauges and a ``leakcheck``
+  debug-bundle section; :func:`assert_quiescent` returns the leftovers.
+* ``raise`` — additionally, :func:`assert_quiescent` raises
+  :class:`LeakError` naming every live entry's kind and creation site.
+  This is the CI enforcement mode for the chaos, gateway, and failover
+  suites (``ci/runtime_functions.sh``): after each test the process
+  must be quiescent — every page freed, every probe slot released,
+  every admitted future settled, every stream journal evicted.
+
+Unlike lockdep there is no "moment of leak" observable at runtime — a
+handle is only leaked relative to a quiescence point — so ``raise``
+mode gates :func:`assert_quiescent` rather than the tracking hooks.
+:func:`assert_quiescent` polls with a short settle grace so background
+settlement (scheduler threads draining) is not misread as a leak.
+
+Like the static analyzer, this module is stdlib-only and must stay
+importable (and installable) without jax.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+__all__ = ["LeakError", "KINDS", "install", "install_from_env",
+           "uninstall", "installed", "mode", "track", "untrack",
+           "live_count", "assert_quiescent", "snapshot", "reset"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_THIS_FILE = os.path.abspath(__file__)
+
+KINDS = ("kv_pages", "probe_slots", "mesh_slices", "futures", "journal")
+
+_MAX_FRAMES = 15        # creation-site walk depth
+_MAX_REPORTED = 20      # entries listed per kind in LeakError / snapshot
+
+_installed = False
+_mode = "off"
+
+# the ledger: kind -> {token: (site, thread_name)}; all mutation under
+# one raw lock held only for dict operations, never across a call out
+_ledger = {k: {} for k in KINDS}
+_counters = {"tracked": 0, "untracked": 0, "untrack_misses": 0,
+             "double_tracks": 0}
+_state_lock = threading.Lock()
+
+_tls = threading.local()
+
+
+class LeakError(RuntimeError):
+    """Quiescence violated: live resources remain past the point where
+    the program claims everything was released/settled, reported with
+    each survivor's creation site."""
+
+
+def mode():
+    return _mode
+
+
+def installed():
+    return _installed
+
+
+def _site(skip):
+    """Attribution frame: first frame at/above ``skip`` that is outside
+    this file, as 'file.py:123 (func)' (framework files relative to the
+    package root)."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return "?"
+    for _ in range(_MAX_FRAMES):
+        if f is None:
+            return "?"
+        fname = os.path.abspath(f.f_code.co_filename)
+        if fname == _THIS_FILE:
+            f = f.f_back
+            continue
+        if fname.startswith(_PKG_DIR + os.sep):
+            fname = os.path.relpath(fname, _PKG_DIR).replace(os.sep, "/")
+        else:
+            fname = os.path.basename(fname)
+        return "%s:%d (%s)" % (fname, f.f_lineno, f.f_code.co_name)
+    return "?"
+
+
+def track(kind, token, skip=0):
+    """Record one live resource.  ``token`` is any hashable identity
+    unique among live entries of the kind (instrumentation sites use
+    ``id(obj)`` or ``(id(owner), small_int)``).  ``skip`` pushes the
+    creation-site attribution up past wrapper frames (0 attributes the
+    caller of the instrumented function).  No-op unless installed."""
+    if not _installed or getattr(_tls, "bypass", False):
+        return
+    site = _site(3 + skip)
+    thread = threading.current_thread().name
+    with _state_lock:
+        book = _ledger[kind]
+        if token in book:
+            _counters["double_tracks"] += 1
+        else:
+            _counters["tracked"] += 1
+        book[token] = (site, thread)
+
+
+def untrack(kind, token):
+    """Drop one live resource.  A miss (token not live) is counted, not
+    raised — arming mid-process legitimately sees releases of resources
+    acquired before install.  No-op unless installed."""
+    if not _installed or getattr(_tls, "bypass", False):
+        return
+    with _state_lock:
+        if _ledger[kind].pop(token, None) is None:
+            _counters["untrack_misses"] += 1
+        else:
+            _counters["untracked"] += 1
+
+
+def live_count(kind=None):
+    """Live entries of ``kind`` (all kinds summed when None)."""
+    with _state_lock:
+        if kind is not None:
+            return len(_ledger[kind])
+        return sum(len(b) for b in _ledger.values())
+
+
+def _leftovers(kinds):
+    out = {}
+    with _state_lock:
+        for k in kinds:
+            if _ledger[k]:
+                out[k] = [site for site, _ in _ledger[k].values()]
+    return out
+
+
+def assert_quiescent(kinds=None, grace_s=0.5):
+    """Assert every tracked resource has been released/settled.
+
+    Polls for up to ``grace_s`` so settlement still in flight on a
+    background thread (a scheduler draining, a worker finishing its
+    last release) is not misread as a leak.  Leftovers after the grace:
+    ``raise`` mode raises :class:`LeakError` naming each survivor's
+    kind and creation site; ``record`` mode returns them as
+    ``{kind: [site, ...]}`` (empty dict == quiescent).  A no-op
+    (returns {}) when the sanitizer is not installed."""
+    if not _installed:
+        return {}
+    kinds = tuple(kinds) if kinds is not None else KINDS
+    deadline = time.monotonic() + float(grace_s)
+    while True:
+        left = _leftovers(kinds)
+        if not left:
+            return {}
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    if _mode != "raise":
+        return left
+    lines = []
+    for k in sorted(left):
+        sites = left[k]
+        shown = sites[:_MAX_REPORTED]
+        more = len(sites) - len(shown)
+        lines.append("  %s: %d live -- %s%s"
+                     % (k, len(sites), ", ".join(shown),
+                        " (+%d more)" % more if more else ""))
+    raise LeakError(
+        "leakcheck: %d resource(s) still live at quiescence point:\n%s"
+        % (sum(len(v) for v in left.values()), "\n".join(lines)))
+
+
+def install(sanitize_mode="record"):
+    """Start the ledger.  Idempotent; ``sanitize_mode`` is 'record' or
+    'raise'."""
+    global _installed, _mode
+    if sanitize_mode not in ("record", "raise"):
+        raise ValueError("MXTPU_LEAKCHECK mode must be 'record' or "
+                         "'raise', got %r" % (sanitize_mode,))
+    _mode = sanitize_mode
+    if _installed:
+        return
+    _installed = True
+    from . import debug
+
+    debug.add_section("leakcheck", snapshot)
+
+
+def install_from_env():
+    """Arm from ``MXTPU_LEAKCHECK`` (called at package import, next to
+    the lockdep arming).  Unset/off: no-op."""
+    raw = os.environ.get("MXTPU_LEAKCHECK", "off").strip().lower()
+    if raw in ("", "off", "0", "false", "no"):
+        return
+    install("raise" if raw == "raise" else "record")
+
+
+def uninstall():
+    """Stop tracking (tests).  Hooks already inlined at call sites keep
+    hitting the ``_installed`` fast path and recording nothing."""
+    global _installed, _mode
+    if not _installed:
+        return
+    _installed = False
+    _mode = "off"
+    from . import debug
+
+    debug.remove_section("leakcheck")
+
+
+def reset():
+    """Clear the ledger and counters (tests / measurement windows); the
+    installed state is untouched."""
+    with _state_lock:
+        for book in _ledger.values():
+            book.clear()
+        for k in _counters:
+            _counters[k] = 0
+
+
+def _publish_gauges():
+    """Export ``leakcheck.live.<kind>`` + counters as telemetry gauges;
+    bypasses tracking so publishing cannot feed back into the ledger."""
+    try:
+        from . import telemetry
+    except ImportError:       # partial interpreter teardown
+        return
+    _tls.bypass = True
+    try:
+        reg = telemetry.registry()
+        with _state_lock:
+            live = {k: len(b) for k, b in _ledger.items()}
+            counters = dict(_counters)
+        for k, n in live.items():
+            reg.gauge("leakcheck.live.%s" % k).set(float(n))
+        for name, value in counters.items():
+            reg.gauge("leakcheck.%s" % name).set(float(value))
+    finally:
+        _tls.bypass = False
+
+
+def snapshot():
+    """JSON-ready view (the debug-bundle section): mode, counters, live
+    counts, and a bounded sample of creation sites per kind.  Publishes
+    the telemetry gauges."""
+    with _state_lock:
+        out = {
+            "mode": _mode,
+            "installed": _installed,
+            "counters": dict(_counters),
+            "live": {k: len(b) for k, b in _ledger.items()},
+            "sites": {k: [{"site": site, "thread": thr}
+                          for site, thr in list(b.values())[:_MAX_REPORTED]]
+                      for k, b in _ledger.items() if b},
+        }
+    _publish_gauges()
+    return out

@@ -40,7 +40,22 @@ CC004  error     user callback (on_*) or Future settle (set_result/
                  set_exception) invoked while holding a lock
 CC005  warning   raw socket I/O or an unbounded wait reachable from a
                  registered daemon-loop body (heartbeat/control ticks)
+RL001  error     resource (KV pages / probe slot / mesh slice /
+                 journal entry) acquired but not released on some
+                 exit path (raise / early return / fall-through)
+RL002  error     double-release: the same handle released twice on
+                 one path with no intervening re-acquire
+RL003  error     future created or admitted but not settled on every
+                 path out of the owning scope (the PR 5 drain bug,
+                 as a rule)
+RL004  error     settle reachable twice on one path (double-settle)
 =====  ========  =====================================================
+
+The RL rules are driven by a declarative pair registry
+(:mod:`~mxnet_tpu.lint.lifecycle`): a subsystem declares its
+acquire/release or create/settle contract with ``register_pair`` and a
+path-sensitive dataflow engine enforces it on every exit path,
+resolving releases through helpers via the same package-wide Program.
 
 Every entry point builds a package-wide call graph
 (:mod:`~mxnet_tpu.lint.interproc`) and propagates blocking-ness,
@@ -87,6 +102,7 @@ from .core import (  # noqa: F401
     register_rule,
 )
 from . import rules as _rules  # noqa: F401  (registers the rule set)
+from . import lifecycle as _lifecycle  # noqa: F401  (registers RL rules)
 from .baseline import (  # noqa: F401
     compare,
     load_baseline,

@@ -1,0 +1,881 @@
+"""mxlint v3: resource-lifecycle and exactly-once-outcome dataflow rules.
+
+======  ==========================================================
+RL001   resource acquired but not released on some exit path
+RL002   resource released twice on one path (double-release)
+RL003   future created/admitted but not settled on every path out
+        of the owning scope
+RL004   settle reachable twice on one path (double-settle)
+======  ==========================================================
+
+The engine is a path-sensitive, intra-procedural symbolic walk over
+each function body, riding the package-wide :class:`~.interproc.Program`
+for cross-helper resolution.  It is driven by a declarative *pair
+registry*: a subsystem declares its acquire/release (or create/settle)
+contract with :func:`register_pair` and the analyzer enforces it --
+new subsystems register their contracts instead of editing the
+analyzer.
+
+Precision contract
+------------------
+The serving/generation/gateway/fleet modules are linted with NO
+suppressions (the CI lanes grep for and reject ``mxlint: disable``
+there), so every transition prefers a missed finding over a false
+positive:
+
+* an acquire may return ``None`` (``PageAllocator.alloc`` is
+  all-or-nothing): handles are *maybe-held*, and an ``if h is None``
+  test refines the branch states instead of forking a false leak;
+* a handle that escapes the scope -- returned, yielded, raised, stored
+  into an attribute/subscript/container, captured by a nested def, or
+  passed to a call the Program cannot uniquely resolve -- transfers
+  ownership and ends tracking;
+* a resolved helper call applies the callee's computed per-parameter
+  release/escape facts (one helper deep and beyond, to a fixpoint);
+  only a callee that provably neither releases nor escapes the handle
+  leaves it held in the caller;
+* only *explicit* ``raise`` statements count as exceptional exits
+  (every call can in principle raise -- modelling that would flag all
+  non-``finally`` code);
+* a function whose path fan-out exceeds the budget is skipped outright.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Severity, register_program_rule
+from .rules import _dotted, _terminal_name
+
+__all__ = ["LifecyclePair", "register_pair", "unregister_pair", "pairs"]
+
+_MAX_PATHS = 96          # live path states per statement list
+_SELF = ("self", "cls")
+
+# handle states (per path)
+_HELD, _RELEASED, _ESCAPED = "held", "released", "escaped"
+
+
+class LifecyclePair:
+    """One declared acquire/release (or create/settle) contract.
+
+    ``kind``:
+      * ``"value"``    -- the acquire call *returns* the handle
+        (``pages = allocator.alloc(n)``); releases take the handle as
+        an argument (``allocator.free(pages)``) or as the receiver root
+        (``fut._reject(err)``).
+      * ``"receiver"`` -- acquire and release are methods on the same
+        receiver (``b.acquire_probe()`` / ``b.release_probe()``); the
+        resource identity is the dotted receiver.  Calls whose receiver
+        is bare ``self``/``cls`` are the contract's *implementation*
+        (the resource class mutating its own slot) and are exempt.
+
+    ``family``: ``"resource"`` findings report as RL001/RL002,
+    ``"outcome"`` (exactly-once settle) as RL003/RL004.
+
+    ``acquire_recv`` / ``release_recv``: optional receiver-name token
+    sets -- the call only matches when the dotted receiver shares a
+    token (``self._free_slices`` -> ``{"self", "free", "slices"}``).
+    Required for generic method names like ``popleft``/``append``.
+
+    ``attr_recv_only``: acquire must be an attribute call with a dotted
+    (depth >= 2) receiver -- ``self._pending.popleft()`` matches, a
+    bare local ``pending.popleft()`` does not.
+
+    ``ctors``: constructor names whose call *creates* the handle
+    (``StreamingFuture(...)``), for outcome pairs.
+    """
+
+    __slots__ = ("name", "family", "kind", "acquire", "release",
+                 "acquire_recv", "release_recv", "attr_recv_only",
+                 "ctors", "describe", "advice")
+
+    def __init__(self, name, family, kind, acquire=(), release=(),
+                 acquire_recv=(), release_recv=(), attr_recv_only=False,
+                 ctors=(), describe="", advice=""):
+        assert family in ("resource", "outcome"), family
+        assert kind in ("value", "receiver"), kind
+        self.name = name
+        self.family = family
+        self.kind = kind
+        self.acquire = frozenset(acquire)
+        self.release = frozenset(release)
+        self.acquire_recv = frozenset(acquire_recv)
+        self.release_recv = frozenset(release_recv)
+        self.attr_recv_only = bool(attr_recv_only)
+        self.ctors = frozenset(ctors)
+        self.describe = describe or name
+        self.advice = advice or ("release it (%s) on every exit path or "
+                                 "hand ownership off explicitly"
+                                 % "/".join(sorted(self.release)))
+
+
+_PAIRS: list = []
+
+
+def register_pair(pair):
+    """Register a lifecycle contract (idempotent by ``pair.name``)."""
+    unregister_pair(pair.name)
+    _PAIRS.append(pair)
+    return pair
+
+
+def unregister_pair(name):
+    _PAIRS[:] = [p for p in _PAIRS if p.name != name]
+
+
+def pairs():
+    return tuple(_PAIRS)
+
+
+# -- the built-in contracts (the serving arc's hand-enforced invariants) ----
+register_pair(LifecyclePair(
+    "kv-pages", "resource", "value",
+    acquire=("alloc",), release=("free",),
+    describe="KV cache pages (PageAllocator.alloc/free)"))
+register_pair(LifecyclePair(
+    "probe-slot", "resource", "receiver",
+    acquire=("acquire_probe",),
+    release=("release_probe", "record_success", "record_failure"),
+    describe="half-open breaker probe slot "
+             "(CircuitBreaker.acquire_probe/release_probe)",
+    advice="release it (release_probe, or record_success/record_failure "
+           "with an outcome) on every exit path, or the slot stays taken "
+           "and the replica never rejoins rotation"))
+register_pair(LifecyclePair(
+    "mesh-slice", "resource", "value",
+    acquire=("popleft", "pop"), acquire_recv=("slices", "slice"),
+    release=("append", "appendleft"), release_recv=("slices", "slice"),
+    attr_recv_only=True,
+    describe="mesh slice pool entry (free-slice popleft/append)"))
+register_pair(LifecyclePair(
+    "journal-entry", "resource", "value",
+    acquire=("add", "admit"), acquire_recv=("journal",),
+    release=("evict", "remove", "pop", "discard"),
+    release_recv=("journal",), attr_recv_only=True,
+    describe="stream journal entry (journal add/evict)"))
+register_pair(LifecyclePair(
+    "typed-outcome", "outcome", "value",
+    acquire=("popleft",), acquire_recv=("pending",), attr_recv_only=True,
+    ctors=("ServingFuture", "StreamingFuture"),
+    release=("_resolve", "_reject", "_settle",
+             "set_result", "set_exception"),
+    describe="admitted request future (exactly-once typed outcome)",
+    advice="settle it (_resolve/_reject) on every path out of the owning "
+           "scope, or the caller blocks on a future that never resolves"))
+
+
+# -- helpers ----------------------------------------------------------------
+def _recv_tokens(dotted):
+    toks = set()
+    for seg in (dotted or "").split("."):
+        for t in seg.split("_"):
+            if t:
+                toks.add(t.lower())
+    return toks
+
+
+def _recv_ok(required, dotted):
+    return not required or bool(required & _recv_tokens(dotted))
+
+
+def _names_in(expr):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+# runtime-sanitizer modules whose hook calls are ownership-transparent:
+# they record a handle's identity but never release or adopt it
+_SANITIZER_ROOTS = ("leakcheck", "_leakcheck", "lockdep", "_lockdep")
+
+
+def _is_sanitizer_call(call):
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    root = func.value
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    return isinstance(root, ast.Name) and root.id in _SANITIZER_ROOTS
+
+
+class _Res:
+    __slots__ = ("rid", "pair", "node", "label", "param")
+
+    def __init__(self, rid, pair, node, label, param=None):
+        self.rid = rid
+        self.pair = pair
+        self.node = node
+        self.label = label
+        self.param = param            # seeded param index (facts pass)
+
+
+class _State:
+    """One symbolic path: ``env`` maps a variable name (value pairs) or
+    dotted receiver (receiver pairs) to a resource id; ``st`` maps the
+    resource id to ``(status, info)``.  ``info`` is the line of the
+    releasing call for RELEASED, or (facts pass) the set of pair names
+    released so far for a seeded parameter."""
+
+    __slots__ = ("env", "st")
+
+    def __init__(self, env=None, st=None):
+        self.env = dict(env or {})
+        self.st = dict(st or {})
+
+    def copy(self):
+        return _State(self.env, self.st)
+
+    def key(self):
+        return (tuple(sorted(self.env.items())),
+                tuple(sorted((k, (v[0], tuple(sorted(v[1]))
+                                  if isinstance(v[1], (set, frozenset))
+                                  else v[1]))
+                             for k, v in self.st.items())))
+
+
+class _Analysis:
+    """Per-function symbolic walk.  ``seed=True`` runs the facts pass
+    (parameters seeded as polymorphic handles, no findings emitted)."""
+
+    def __init__(self, program, fi, facts, seed=False):
+        self.program = program
+        self.fi = fi
+        self.ctx = fi.ctx
+        self.facts = facts
+        self.seed = seed
+        self.findings = []            # (rule, anchor_node, msg)
+        self.res = {}                 # rid -> _Res
+        self.blown = False
+        self._next_rid = 0
+
+    # -- resource bookkeeping ----------------------------------------------
+    def _new_res(self, pair, node, label, param=None):
+        self._next_rid += 1
+        r = _Res(self._next_rid, pair, node, label, param)
+        self.res[r.rid] = r
+        return r
+
+    def _bind(self, s, key, res):
+        self._unbind(s, key)
+        s.env[key] = res.rid
+        s.st[res.rid] = (_HELD, set() if res.param is not None else None)
+
+    def _unbind(self, s, key):
+        """Drop ``key`` and any receiver resources rooted at it."""
+        pref = key + "."
+        for k in [k for k in s.env if k == key or k.startswith(pref)]:
+            del s.env[k]
+
+    def _transition_release(self, s, rid, call, pair_name=None):
+        res = self.res[rid]
+        status, info = s.st.get(rid, (None, None))
+        if res.param is not None:                      # seeded (facts pass)
+            if status == _HELD:
+                # replace, never mutate: forked path states share the set
+                s.st[rid] = (_HELD, set(info or ())
+                             | {pair_name or (res.pair and res.pair.name)})
+            return
+        if status == _HELD:
+            s.st[rid] = (_RELEASED, call.lineno)
+        elif status == _RELEASED:
+            rule = "RL002" if res.pair.family == "resource" else "RL004"
+            if rule == "RL002":
+                msg = ("%s already released at line %d is released again "
+                       "here -- a double-release corrupts the pool's free "
+                       "state (the same handle returns twice)"
+                       % (res.pair.describe, info))
+            else:
+                msg = ("settle reachable twice on one path: this %s "
+                       "already reached a terminal outcome at line %d -- "
+                       "the exactly-once outcome contract forbids a "
+                       "second settle" % (res.pair.describe, info))
+            self._finding(rule, call, msg)
+        # ESCAPED: ownership was handed off; a later release is not ours
+        # to judge.
+
+    def _escape(self, s, rid):
+        res = self.res[rid]
+        if res.param is not None:
+            s.st[rid] = (_ESCAPED, s.st.get(rid, (None, set()))[1])
+            return
+        # only a HELD handle can escape: a RELEASED one stays released,
+        # so a later release still reads as a double-release
+        if s.st.get(rid, (None, None))[0] == _HELD:
+            s.st[rid] = (_ESCAPED, None)
+
+    def _escape_name(self, s, name):
+        pref = name + "."
+        for k, rid in list(s.env.items()):
+            if k == name or k.startswith(pref):
+                self._escape(s, rid)
+
+    def _escape_names_in(self, s, expr):
+        if expr is None:
+            return
+        for name in set(_names_in(expr)):
+            if name in s.env or any(k.startswith(name + ".")
+                                    for k in s.env):
+                self._escape_name(s, name)
+
+    def _finding(self, rule, node, msg):
+        self.findings.append((rule, node, msg))
+
+    # -- call effects -------------------------------------------------------
+    def _calls_in(self, expr):
+        if expr is None:
+            return
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                yield n
+
+    def _apply_calls(self, expr, s, skip=None):
+        skipped = set()
+        for call in self._calls_in(expr):
+            if call is skip or id(call) in skipped:
+                continue
+            if _is_sanitizer_call(call):
+                # sanitizer hooks (leakcheck.track(kind, id(h)),
+                # lockdep.note_blocking(...)) observe handles without
+                # taking ownership -- transparent, including their
+                # nested calls, or instrumenting a site would kill the
+                # static tracking of that same site
+                for n in ast.walk(call):
+                    if isinstance(n, ast.Call):
+                        skipped.add(id(n))
+                continue
+            self._apply_call(call, s)
+
+    def _tracked_args(self, call, s):
+        """Top-level (arg expr, env key) bindings: bare names and dotted
+        attributes that are live handles; plus the set of handle names
+        appearing *nested* anywhere in the arguments."""
+        top, nested = [], set()
+        args = list(call.args) + [k.value for k in call.keywords]
+        for i, a in enumerate(args):
+            key = None
+            if isinstance(a, ast.Name) and a.id in s.env:
+                key = a.id
+            elif isinstance(a, ast.Attribute):
+                d = _dotted(a)
+                if d and d in s.env:
+                    key = d
+            if key is not None:
+                top.append((i, key))
+            else:
+                for name in set(_names_in(a)):
+                    if name in s.env or any(k.startswith(name + ".")
+                                            for k in s.env):
+                        nested.add(name)
+        return top, nested
+
+    def _param_index(self, callee, call, pos=None, kw=None):
+        node = callee.node
+        names = [a.arg for a in (list(getattr(node.args, "posonlyargs", []))
+                                 + list(node.args.args))]
+        if kw is not None:
+            return names.index(kw) if kw in names else None
+        if pos is None:                  # a **kwargs splat at the call site
+            return None
+        off = 1 if (callee.cls is not None
+                    and isinstance(call.func, ast.Attribute)) else 0
+        idx = pos + off
+        return idx if idx < len(names) else None
+
+    def _apply_call(self, call, s):
+        func = call.func
+        name = _terminal_name(func)
+        recv = _dotted(func.value) if isinstance(func, ast.Attribute) \
+            else None
+        handled = set()               # env keys whose effect is decided
+
+        # receiver-pair acquire/release on the dotted receiver
+        if recv and recv not in _SELF:
+            for p in _PAIRS:
+                if p.kind != "receiver":
+                    continue
+                if name in p.acquire and not self.seed:
+                    self._bind(s, recv, self._new_res(p, call, recv))
+                    handled.add(recv)
+                elif name in p.release:
+                    rid = s.env.get(recv)
+                    if rid is not None and self.res[rid].pair is p:
+                        self._transition_release(s, rid, call)
+                        handled.add(recv)
+                    elif self.seed:
+                        root = recv.split(".", 1)[0]
+                        rid = s.env.get(root)
+                        if rid is not None and \
+                                self.res[rid].param is not None:
+                            self._transition_release(s, rid, call, p.name)
+                            handled.add(root)
+
+        top, nested = self._tracked_args(call, s)
+
+        # value-pair release: handle as argument ...
+        for _, key in top:
+            rid = s.env[key]
+            res = self.res[rid]
+            cand = [res.pair] if res.pair is not None else \
+                [p for p in _PAIRS if p.kind == "value"]
+            for p in cand:
+                if p and name in p.release and \
+                        _recv_ok(p.release_recv, recv):
+                    self._transition_release(s, rid, call, p.name)
+                    handled.add(key)
+                    break
+
+        # ... or as the receiver root (fut._reject(err), seq.fut._resolve())
+        if recv:
+            root = recv.split(".", 1)[0]
+            for key in (recv, root):
+                rid = s.env.get(key)
+                if rid is None or key in handled:
+                    continue
+                res = self.res[rid]
+                cand = [res.pair] if res.pair is not None else \
+                    [p for p in _PAIRS if p.kind == "value"]
+                matched = False
+                for p in cand:
+                    if p and name in p.release:
+                        self._transition_release(s, rid, call, p.name)
+                        handled.add(key)
+                        matched = True
+                        break
+                if not matched and res.pair is not None:
+                    # unknown method ON the handle: conservative hand-off
+                    self._escape(s, rid)
+                    handled.add(key)
+
+        remaining = [(i, k) for i, k in top if k not in handled]
+        if remaining or nested:
+            callees = self.program._resolved.get(id(call))
+            if callees is None:
+                try:
+                    callees = tuple(self.program.resolve_callable(
+                        self.ctx, self.fi, func))
+                except Exception:
+                    callees = ()
+            callee = callees[0] if callees and len(callees) == 1 else None
+            fact = self.facts.get(id(callee.node)) if callee else None
+            if fact is not None:
+                n_pos = len(call.args)
+                kws = [k.arg for k in call.keywords]
+                for i, key in remaining:
+                    if i < n_pos:
+                        idx = self._param_index(callee, call, pos=i)
+                    else:
+                        idx = self._param_index(callee, call,
+                                                kw=kws[i - n_pos])
+                    rid = s.env[key]
+                    res = self.res[rid]
+                    if idx is None:
+                        self._escape(s, rid)
+                    elif res.param is not None:
+                        for pn in fact["rel"].get(idx, ()):
+                            self._transition_release(s, rid, call, pn)
+                        if idx in fact["esc"]:
+                            self._escape(s, rid)
+                    elif res.pair.name in fact["rel"].get(idx, ()):
+                        self._transition_release(s, rid, call)
+                    elif idx in fact["esc"]:
+                        self._escape(s, rid)
+                    # else: resolved callee provably neither releases nor
+                    # escapes it -- the handle stays OURS (one-helper-deep)
+            else:
+                for _, key in remaining:
+                    self._escape(s, rid=s.env[key])
+            for nm in nested:
+                self._escape_name(s, nm)
+
+    # -- acquires -----------------------------------------------------------
+    def _acquire_in(self, value):
+        """First registered acquire/ctor call in an assigned value."""
+        for call in self._calls_in(value):
+            name = _terminal_name(call.func)
+            is_attr = isinstance(call.func, ast.Attribute)
+            recv = _dotted(call.func.value) if is_attr else None
+            for p in _PAIRS:
+                if p.kind != "value":
+                    continue
+                if name in p.ctors:
+                    return call, p
+                if name in p.acquire:
+                    if p.attr_recv_only and (recv is None
+                                             or "." not in recv):
+                        continue
+                    if _recv_ok(p.acquire_recv, recv):
+                        return call, p
+        return None, None
+
+    # -- statement walk -----------------------------------------------------
+    def run(self):
+        body = self.fi.node.body
+        s0 = _State()
+        if self.seed:
+            node = self.fi.node
+            names = [a.arg for a in
+                     (list(getattr(node.args, "posonlyargs", []))
+                      + list(node.args.args))]
+            for i, nm in enumerate(names):
+                if nm in _SELF:
+                    continue
+                self._bind(s0, nm, self._new_res(None, node, nm, param=i))
+        falls, exits = self._walk(body, [s0])
+        end = body[-1]
+        endline = getattr(end, "end_lineno", None) or end.lineno
+        for st in falls:
+            exits.append(("end of function", endline, st))
+        return exits
+
+    def _dedup(self, states):
+        seen, out = set(), []
+        for s in states:
+            k = s.key()
+            if k not in seen:
+                seen.add(k)
+                out.append(s)
+        if len(out) > _MAX_PATHS:
+            self.blown = True
+            out = out[:_MAX_PATHS]
+        return out
+
+    def _walk(self, stmts, states):
+        exits = []
+        for stmt in stmts:
+            if not states:
+                break
+            nxt = []
+            for s in states:
+                falls, ex = self._step(stmt, s)
+                nxt.extend(falls)
+                exits.extend(ex)
+            states = self._dedup(nxt)
+        return states, exits
+
+    def _refine(self, test, s):
+        """Branch states for ``if test``: a *maybe-held* handle is
+        non-None exactly on the branch its ``is None``/truthiness test
+        excludes."""
+        t, f = s.copy(), s.copy()
+
+        def drop(state, name):
+            self._unbind(state, name)
+
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+                isinstance(test.left, ast.Name) and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            nm = test.left.id
+            if nm in s.env:
+                if isinstance(test.ops[0], ast.Is):
+                    drop(t, nm)          # x is None -> not held there
+                elif isinstance(test.ops[0], ast.IsNot):
+                    drop(f, nm)
+        elif isinstance(test, ast.Name) and test.id in s.env:
+            drop(f, test.id)             # if x: -> falsy branch not held
+        elif isinstance(test, ast.UnaryOp) and \
+                isinstance(test.op, ast.Not) and \
+                isinstance(test.operand, ast.Name) and \
+                test.operand.id in s.env:
+            drop(t, test.operand.id)
+        return t, f
+
+    def _step(self, stmt, s):
+        T = ast
+        if isinstance(stmt, (T.FunctionDef, T.AsyncFunctionDef,
+                             T.ClassDef)):
+            for name in set(_names_in(stmt)):
+                if name in s.env or any(k.startswith(name + ".")
+                                        for k in s.env):
+                    self._escape_name(s, name)   # closure capture
+            return [s], []
+
+        if isinstance(stmt, (T.Assign, T.AnnAssign, T.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return [s], []
+            acq_call, acq_pair = (None, None)
+            targets = getattr(stmt, "targets", None) or [stmt.target]
+            if not self.seed and isinstance(stmt, T.Assign):
+                acq_call, acq_pair = self._acquire_in(value)
+            self._apply_calls(value, s, skip=acq_call)
+            if acq_call is not None:
+                # the acquire's own arguments can still hand off handles
+                for a in list(acq_call.args) + \
+                        [k.value for k in acq_call.keywords]:
+                    self._escape_names_in(s, a)
+            simple_alias = (isinstance(value, T.Name)
+                            and value.id in s.env)
+            if simple_alias and len(targets) == 1 and \
+                    isinstance(targets[0], T.Name):
+                rid = s.env[value.id]
+                self._unbind(s, targets[0].id)
+                s.env[targets[0].id] = rid
+            else:
+                self._escape_names_in(s, value)
+            for tgt in targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, (T.Subscript, T.Attribute)):
+                        # index/owner expressions may mention handles
+                        self._escape_names_in(s, n)
+                        break
+                if isinstance(tgt, T.Name):
+                    self._unbind(s, tgt.id)
+            if acq_call is not None and len(targets) == 1 and \
+                    isinstance(targets[0], T.Name):
+                nm = targets[0].id
+                self._bind(s, nm, self._new_res(acq_pair, acq_call, nm))
+            return [s], []
+
+        if isinstance(stmt, T.Expr):
+            self._apply_calls(stmt.value, s)
+            return [s], []
+
+        if isinstance(stmt, T.Return):
+            self._apply_calls(stmt.value, s)
+            self._escape_names_in(s, stmt.value)
+            return [], [("return", stmt.lineno, s)]
+
+        if isinstance(stmt, T.Raise):
+            self._apply_calls(stmt.exc, s)
+            self._escape_names_in(s, stmt.exc)
+            return [], [("raise", stmt.lineno, s)]
+
+        if isinstance(stmt, T.Break):
+            return [], [("break", stmt.lineno, s)]
+        if isinstance(stmt, T.Continue):
+            return [], [("continue", stmt.lineno, s)]
+
+        if isinstance(stmt, T.If):
+            self._apply_calls(stmt.test, s)
+            t, f = self._refine(stmt.test, s)
+            falls_t, ex_t = self._walk(stmt.body, [t])
+            falls_f, ex_f = self._walk(stmt.orelse, [f])
+            return falls_t + falls_f, ex_t + ex_f
+
+        if isinstance(stmt, (T.While, T.For, T.AsyncFor)):
+            exits = []
+            if isinstance(stmt, T.While):
+                self._apply_calls(stmt.test, s)
+            else:
+                self._apply_calls(stmt.iter, s)
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, T.Name):
+                        self._unbind(s, n.id)
+            body_falls, body_ex = self._walk(stmt.body, [s.copy()])
+            post = [s]
+            for kind, line, bs in body_ex:
+                if kind == "break":
+                    post.append(bs)
+                elif kind == "continue":
+                    body_falls.append(bs)
+                else:
+                    exits.append((kind, line, bs))
+            for bs in body_falls:
+                # iteration boundary: per-iteration RELEASED handles are
+                # done with; HELD ones persist so a leak-per-iteration
+                # still reaches the function exit check
+                for k, rid in list(bs.env.items()):
+                    if bs.st.get(rid, (None, None))[0] == _RELEASED:
+                        del bs.env[k]
+                post.append(bs)
+            falls, ex = self._walk(stmt.orelse, self._dedup(post))
+            return falls, exits + ex
+
+        if isinstance(stmt, (T.With, T.AsyncWith)):
+            for item in stmt.items:
+                self._apply_calls(item.context_expr, s)
+            return self._walk(stmt.body, [s])
+
+        if isinstance(stmt, T.Try):
+            falls_b, ex_b = self._walk(stmt.body, [s.copy()])
+            falls_o, ex_o = self._walk(stmt.orelse, falls_b)
+            h_falls, h_ex = [], []
+            if stmt.handlers:
+                for h in stmt.handlers:
+                    f, e = self._walk(h.body, [s.copy()])
+                    h_falls += f
+                    h_ex += e
+                # an explicit raise inside a guarded try is caught by
+                # the handlers (approximated by the handler walk above)
+                ex_b = [e for e in ex_b if e[0] != "raise"]
+                ex_o = [e for e in ex_o if e[0] != "raise"]
+            pend_falls = falls_o + h_falls
+            pend_ex = ex_b + ex_o + h_ex
+            if stmt.finalbody:
+                out_falls, out_ex = [], []
+                for st in pend_falls:
+                    f2, e2 = self._walk(stmt.finalbody, [st])
+                    out_falls += f2
+                    out_ex += e2
+                for kind, line, st in pend_ex:
+                    f2, e2 = self._walk(stmt.finalbody, [st])
+                    out_ex += [(kind, line, x) for x in f2] + e2
+                return self._dedup(out_falls), out_ex
+            return self._dedup(pend_falls), pend_ex
+
+        if isinstance(stmt, (T.Pass, T.Import, T.ImportFrom, T.Global,
+                             T.Nonlocal)):
+            return [s], []
+
+        if isinstance(stmt, (T.Delete, T.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                self._apply_calls(child, s)
+            if isinstance(stmt, T.Delete):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, T.Name):
+                        self._unbind(s, tgt.id)
+            return [s], []
+
+        # anything we do not model (match statements, ...): stop
+        # tracking everything live rather than risk a false finding
+        s.env.clear()
+        return [s], []
+
+
+# -- program-level driver ---------------------------------------------------
+def _facts_for(program):
+    """Per-function, per-parameter release/escape facts, to a fixpoint.
+
+    ``facts[id(fn def node)] = {"rel": {param_idx: set(pair names
+    released on EVERY non-raising exit)}, "esc": set(param_idx escaped
+    on any path)}``.
+    """
+    facts = {}
+    for _ in range(4):
+        changed = False
+        for fi in program.functions:
+            a = _Analysis(program, fi, facts, seed=True)
+            try:
+                exits = a.run()
+            except RecursionError:
+                exits, a.blown = [], True
+            rel, esc = {}, set()
+            if a.blown:
+                esc = {r.param for r in a.res.values()
+                       if r.param is not None}
+            else:
+                per_param = {}
+                for r in a.res.values():
+                    if r.param is None:
+                        continue
+                    sets = []
+                    for kind, _line, st in exits:
+                        status, info = st.st.get(r.rid, (None, None))
+                        if status == _ESCAPED:
+                            esc.add(r.param)
+                        if kind == "raise":
+                            continue
+                        sets.append(set(info or ())
+                                    if status in (_HELD, _ESCAPED)
+                                    else set(info or ()))
+                    if sets:
+                        got = set.intersection(*sets)
+                        if got:
+                            per_param[r.param] = got
+                rel = per_param
+            prev = facts.get(id(fi.node))
+            cur = {"rel": rel, "esc": esc}
+            if prev != cur:
+                facts[id(fi.node)] = cur
+                changed = True
+        if not changed:
+            break
+    return facts
+
+
+def _lifecycle_findings(program):
+    cached = getattr(program, "_lifecycle_findings", None)
+    if cached is not None:
+        return cached
+    facts = _facts_for(program)
+    findings = []
+    seen = set()
+    for fi in program.functions:
+        a = _Analysis(program, fi, facts, seed=False)
+        try:
+            exits = a.run()
+        except RecursionError:
+            continue
+        if a.blown:
+            continue
+        for rule, node, msg in a.findings:
+            key = (rule, fi.ctx.path, node.lineno, msg)
+            if key not in seen:
+                seen.add(key)
+                findings.append((rule, fi.ctx.path, node, msg))
+        for kind, line, st in exits:
+            for key, rid in st.env.items():
+                status, _info = st.st.get(rid, (None, None))
+                if status != _HELD:
+                    continue
+                res = a.res[rid]
+                if res.param is not None:
+                    continue
+                rule = ("RL001" if res.pair.family == "resource"
+                        else "RL003")
+                msg = ("%s bound to '%s' is still held at the exit on "
+                       "line %d (%s) -- %s"
+                       % (res.pair.describe, res.label, line, kind,
+                          res.pair.advice))
+                dkey = (rule, fi.ctx.path, res.node.lineno, line)
+                if dkey not in seen:
+                    seen.add(dkey)
+                    findings.append((rule, fi.ctx.path, res.node, msg))
+    program._lifecycle_findings = findings
+    return findings
+
+
+def _yield_rule(program, rule_id):
+    for rule, path, node, msg in _lifecycle_findings(program):
+        if rule == rule_id:
+            yield (path, node, None, msg)
+
+
+@register_program_rule("RL001", Severity.ERROR,
+                       "resource acquired but not released on some "
+                       "exit path")
+def check_resource_leak(program):
+    """A declared resource (KV pages, probe slot, mesh slice, journal
+    entry) is acquired on a path that then leaves the owning scope --
+    via return, an explicit raise, or falling off the end -- without a
+    matching release and without handing ownership off.  The PR 5
+    review round shipped exactly this bug: a half-open probe slot
+    leaked on the first-wins cancel path and the replica never rejoined
+    rotation."""
+    return _yield_rule(program, "RL001")
+
+
+@register_program_rule("RL002", Severity.ERROR,
+                       "double-release of an already-released resource")
+def check_double_release(program):
+    """The same handle reaches a second release on one path with no
+    intervening re-acquire: the pool's free state now contains the
+    handle twice and a later acquire can hand one resource to two
+    owners."""
+    return _yield_rule(program, "RL002")
+
+
+@register_program_rule("RL003", Severity.ERROR,
+                       "admitted future not settled on every path out "
+                       "of the owning scope")
+def check_unsettled_outcome(program):
+    """A future created or adopted (popped from a pending queue) in
+    this scope leaves it unsettled on some path: the exactly-once
+    outcome contract (every admitted request gets one typed terminal
+    outcome) is broken and the caller blocks forever.  This is the PR 5
+    ``drain(timeout)`` bug -- workers stopped with admitted futures
+    still queued -- as a rule."""
+    return _yield_rule(program, "RL003")
+
+
+@register_program_rule("RL004", Severity.ERROR,
+                       "settle reachable twice on one path "
+                       "(double-settle)")
+def check_double_settle(program):
+    """One path settles the same future twice.  The runtime settle
+    surface is first-writer-wins, so the second outcome is silently
+    dropped -- the code's intent and the delivered outcome disagree."""
+    return _yield_rule(program, "RL004")

@@ -70,6 +70,7 @@ import numpy as np
 
 from . import chaos as _chaos
 from . import clock as _clockmod
+from . import leakcheck as _leakcheck
 from . import telemetry as _telemetry
 from .async_kv import backoff_delay as _backoff_delay
 
@@ -316,6 +317,9 @@ class ServingFuture:
         # chrome-trace span per admitted request, keyed by this id across
         # admission -> batch close -> dispatch -> hedge -> outcome
         self.trace_id = _telemetry.new_trace_id()
+        # leakcheck ledger: live until the one typed terminal outcome
+        # lands (RL003's exactly-once contract, mirrored at runtime)
+        _leakcheck.track("futures", id(self))
 
     @property
     def done(self):
@@ -327,6 +331,7 @@ class ServingFuture:
         if self.job is not None:
             self.job.unresolved -= 1
         self._event.set()
+        _leakcheck.untrack("futures", id(self))
 
     def _resolve(self, outputs):
         if self._event.is_set():
@@ -522,12 +527,21 @@ class CircuitBreaker:
             if now < self.reopen_at:
                 return False
             self.state = self.HALF_OPEN
-            self.probe_inflight = True
+            self.acquire_probe()
             return True
         if self.probe_inflight:
             return False
-        self.probe_inflight = True
+        self.acquire_probe()
         return True
+
+    def acquire_probe(self):
+        """Reserve the single half-open probe slot.  Exactly one of
+        :meth:`record_success` / :meth:`record_failure` /
+        :meth:`release_probe` must follow on every path — the
+        acquire/release contract mxlint's RL001 checks statically and
+        the leakcheck ledger (``probe_slots``) mirrors at runtime."""
+        self.probe_inflight = True
+        _leakcheck.track("probe_slots", id(self))
 
     def record_success(self):
         if self.state != self.CLOSED:
@@ -537,6 +551,8 @@ class CircuitBreaker:
         self.failures = 0
         self.trips = 0
         self.reopen_at = None
+        if self.probe_inflight:
+            _leakcheck.untrack("probe_slots", id(self))
         self.probe_inflight = False
 
     def release_probe(self):
@@ -545,11 +561,15 @@ class CircuitBreaker:
         its batch settled first).  Without this the breaker would stay
         HALF_OPEN with the slot taken forever and the replica would
         never rejoin rotation."""
+        if self.probe_inflight:
+            _leakcheck.untrack("probe_slots", id(self))
         self.probe_inflight = False
 
     def record_failure(self, now):
         """Returns True when this failure tripped (or re-tripped) the
         breaker."""
+        if self.probe_inflight:
+            _leakcheck.untrack("probe_slots", id(self))
         self.probe_inflight = False
         self.failures += 1
         if self.state == self.HALF_OPEN:
@@ -1063,6 +1083,10 @@ class ModelServer:
                             "mesh pool exhausted (%d slices, all serving "
                             "or retiring)" % len(self._mesh_slices))
                     slice_mesh = self._free_slices.popleft()
+                    # leakcheck: live for the transitional scale-up
+                    # window only — until a replica owns the slice or
+                    # it returns to the pool (RL001's mesh-slice pair)
+                    _leakcheck.track("mesh_slices", id(slice_mesh))
                 else:
                     act = self._active_replicas()
                     if not act:
@@ -1090,6 +1114,7 @@ class ModelServer:
             if slice_mesh is not None:
                 with self._cv:
                     self._free_slices.append(slice_mesh)
+                _leakcheck.untrack("mesh_slices", id(slice_mesh))
             raise
         with self._cv:
             if self._drain_flag.is_set() or self._state in (DRAINING,
@@ -1098,12 +1123,15 @@ class ModelServer:
                 # slice so a later restart can use it
                 if slice_mesh is not None:
                     self._free_slices.append(slice_mesh)
+                    _leakcheck.untrack("mesh_slices", id(slice_mesh))
                 raise Draining("server drained while the replica built")
             rid = self._replica_seq
             self._replica_seq += 1
             r = Replica(rid, predictor, *self._breaker_cfg)
             r.mesh = slice_mesh if slice_mesh is not None \
                 else getattr(predictor, "_mesh", None)
+            if slice_mesh is not None:     # ownership -> the replica
+                _leakcheck.untrack("mesh_slices", id(slice_mesh))
             self._replicas.append(r)
             self.stats["replicas_added"] += 1
             self._cv.notify_all()

@@ -113,6 +113,25 @@ def pytest_terminal_summary(terminalreporter):
                     % (name, h["count"], h["p50"], h["p99"], h["max"]))
     except Exception:
         pass  # never let diagnostics fail the suite
+    try:
+        from mxnet_tpu import leakcheck
+
+        if leakcheck.installed():
+            snap = leakcheck.snapshot()
+            terminalreporter.write_sep(
+                "-", "leakcheck ledger (failures present)")
+            terminalreporter.write_line(
+                "live: %s  counters: %s"
+                % ("  ".join("%s=%d" % kv
+                             for kv in sorted(snap["live"].items())),
+                   "  ".join("%s=%d" % kv
+                             for kv in sorted(snap["counters"].items()))))
+            for kind, entries in sorted(snap.get("sites", {}).items()):
+                for e in entries:
+                    terminalreporter.write_line(
+                        "  %s: %s [%s]" % (kind, e["site"], e["thread"]))
+    except Exception:
+        pass  # never let diagnostics fail the suite
 
 
 @pytest.fixture(autouse=True)
@@ -137,3 +156,21 @@ def _reset_brownout():
     serving = sys.modules.get("mxnet_tpu.serving")
     if serving is not None and serving._BROWNOUT is not None:
         serving._BROWNOUT.reset()
+
+
+@pytest.fixture(autouse=True)
+def _leakcheck_quiescent():
+    """When the leak sanitizer is armed (MXTPU_LEAKCHECK, the CI chaos/
+    gateway/failover lanes), every test must end quiescent: pages freed,
+    probe slots released, futures settled, journals evicted.  In raise
+    mode a leak fails THIS test (the one that leaked), with creation
+    sites in the LeakError; the ledger is cleared afterwards so one leak
+    cannot cascade into its neighbors."""
+    yield
+    leakcheck = sys.modules.get("mxnet_tpu.leakcheck")
+    if leakcheck is None or not leakcheck.installed():
+        return
+    try:
+        leakcheck.assert_quiescent()
+    finally:
+        leakcheck.reset()

@@ -1,0 +1,23 @@
+"""RL001 one-helper-deep fixture: the acquired pages reach a helper
+that only *reads* them — it neither releases nor takes ownership — so
+the early-raise path leaks the allocation."""
+
+
+def _page_span(pages):
+    lo, hi = None, 0
+    for p in pages:
+        if lo is None or p < lo:
+            lo = p
+        if p > hi:
+            hi = p
+    return hi - (lo or 0)
+
+
+def prefill(pool, tokens, max_span):
+    pages = pool.alloc(len(tokens))
+    if pages is None:
+        return None
+    if _page_span(pages) > max_span:
+        raise ValueError("fragmented allocation")   # leaks `pages`
+    pool.free(pages)
+    return len(pages)

@@ -1,0 +1,31 @@
+"""RL001 historical fixture: the PR 5 half-open probe-slot leak,
+re-introduced.
+
+The shipped bug: ``_worker_loop`` popped a dispatch whose batch had
+already settled (first-wins cancel / hedge loser) and skipped it with
+``continue`` — but when the dispatch carried the half-open probe
+reservation, the reserved slot was never released, so the breaker
+stayed HALF_OPEN with the slot taken forever and the replica never
+rejoined rotation.  (The fix releases the probe on the cancel path;
+here the acquire is inlined at the dispatch site so the leak is visible
+intra-procedurally.)
+"""
+
+
+class WorkerLoop:
+    def run(self):
+        while True:
+            item = self._dispatch_q.get()
+            if item is None:
+                return
+            job, repl, is_probe = item
+            with self._cv:
+                if is_probe:
+                    repl.breaker.acquire_probe()
+                if job.done:
+                    # first-wins cancel: the batch settled while this
+                    # dispatch sat in the queue.  BUG (PR 5): the
+                    # reserved probe slot is never released.
+                    self.stats["hedge_cancelled"] += 1
+                    continue
+            self._execute(job, repl, is_probe)

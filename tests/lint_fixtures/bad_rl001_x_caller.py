@@ -1,0 +1,14 @@
+"""RL001 cross-module fixture, caller half: hands the pages to a
+cleanup helper in another module that only releases them on its happy
+path (paired with bad_rl001_x_helper.py) — no all-paths release fact,
+so the caller still owns the handle at its return."""
+
+from bad_rl001_x_helper import give_back_if_quiet
+
+
+def serve_one(pool, busy):
+    pages = pool.alloc(2)
+    if pages is None:
+        return 0
+    give_back_if_quiet(pool, pages, busy)
+    return 2

@@ -1,0 +1,9 @@
+"""RL001 cross-module fixture, helper half: releases the pages only
+when the server is quiet (paired with bad_rl001_x_caller.py)."""
+
+
+def give_back_if_quiet(pool, pages, busy):
+    if busy:
+        return False
+    pool.free(pages)
+    return True

@@ -1,0 +1,16 @@
+"""RL002 one-helper-deep fixture: a helper already returned the pages
+to the pool on every path; the caller frees them again."""
+
+
+def _recycle(pool, pages):
+    pool.free(pages)
+    return len(pages)
+
+
+def decode_step(pool, n):
+    pages = pool.alloc(n)
+    if pages is None:
+        return 0
+    freed = _recycle(pool, pages)
+    pool.free(pages)                 # double-release: _recycle already freed
+    return freed

@@ -1,0 +1,13 @@
+"""RL002 cross-module fixture, caller half: frees the pages itself and
+then calls a cross-module teardown that frees them again on every path
+(paired with bad_rl002_x_helper.py)."""
+
+from bad_rl002_x_helper import teardown_pages
+
+
+def retire(pool, n):
+    pages = pool.alloc(n)
+    if pages is None:
+        return
+    pool.free(pages)
+    teardown_pages(pool, pages)      # second release, one helper away

@@ -1,0 +1,6 @@
+"""RL002 cross-module fixture, helper half: unconditionally returns
+the pages to the pool (paired with bad_rl002_x_caller.py)."""
+
+
+def teardown_pages(pool, pages):
+    pool.free(pages)

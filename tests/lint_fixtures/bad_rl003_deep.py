@@ -1,0 +1,18 @@
+"""RL003 one-helper-deep fixture: an adopted pending future reaches a
+bookkeeping helper that counts the abort but never settles it — the
+sweep ends with the caller blocked on a future that never resolves."""
+
+
+def _note_abort(counts, fut):
+    if fut.done:
+        counts["already_done"] += 1
+    else:
+        counts["aborted"] += 1
+
+
+class AbortSweep:
+    def sweep(self, counts):
+        while self._pending:
+            fut = self._pending.popleft()
+            _note_abort(counts, fut)     # records, never settles
+        self._stop = True

@@ -1,0 +1,32 @@
+"""RL003 historical fixture: the PR 5 ``drain(timeout)`` bug,
+re-introduced.
+
+The shipped bug: a drain that timed out stopped the scheduler with
+admitted requests still queued.  Deadline expiry never fires once the
+scheduler stops, so every unsettled future hangs its caller forever —
+the exactly-once typed-outcome contract is broken on the timeout path.
+(The fix sweeps the queue and rejects each future with a typed
+``Draining`` outcome; here the sweep pops the futures but never settles
+them.)
+"""
+
+
+class GenerationServer:
+    def drain(self, timeout):
+        deadline = self.clock.now() + timeout
+        with self._cv:
+            self._drain_flag = True
+            while self._pending or self._active:
+                if self.clock.now() >= deadline:
+                    break
+                self._cv.wait(0.05)
+            drained = not self._pending and not self._active
+            if not drained:
+                # BUG (PR 5): the admitted futures are dropped from the
+                # queue without a typed terminal outcome.
+                while self._pending:
+                    fut = self._pending.popleft().fut
+                    self.stats["aborted"] += 1
+            self._stop = True
+            self._cv.notify_all()
+        return drained

@@ -1,0 +1,14 @@
+"""RL003 cross-module fixture, caller half: the sweep relies on a
+helper from another module that settles only expired futures (paired
+with bad_rl003_x_helper.py) — futures still inside their deadline leave
+the scope unsettled."""
+
+from bad_rl003_x_helper import settle_if_late
+
+
+class DeadlineSweep:
+    def sweep(self, now):
+        while self._pending:
+            fut = self._pending.popleft()
+            settle_if_late(fut, now)
+        self._stop = True

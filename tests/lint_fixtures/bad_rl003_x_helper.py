@@ -1,0 +1,9 @@
+"""RL003 cross-module fixture, helper half: settles the future only
+when its deadline has passed (paired with bad_rl003_x_caller.py)."""
+
+
+def settle_if_late(fut, now):
+    if now >= fut.deadline:
+        fut._reject(TimeoutError("deadline passed while queued"))
+        return True
+    return False

@@ -1,0 +1,14 @@
+"""RL004 one-helper-deep fixture: the future is rejected inline and
+then handed to a flush helper that rejects it again — the second
+outcome is silently dropped by the first-writer-wins settle surface."""
+
+
+def _flush_reject(fut, err):
+    fut._reject(err)
+
+
+class Settler:
+    def on_error(self, err):
+        fut = self._pending.popleft()
+        fut._reject(err)
+        _flush_reject(fut, err)      # settles the same future again

@@ -1,0 +1,12 @@
+"""RL004 cross-module fixture, caller half: rejects the future and
+then calls a cross-module expiry helper that unconditionally settles
+it again (paired with bad_rl004_x_helper.py)."""
+
+from bad_rl004_x_helper import force_timeout
+
+
+class Expirer:
+    def expire(self):
+        fut = self._pending.popleft()
+        fut._reject(RuntimeError("expired while queued"))
+        force_timeout(fut)

@@ -1,0 +1,6 @@
+"""RL004 cross-module fixture, helper half: unconditionally settles
+the future (paired with bad_rl004_x_caller.py)."""
+
+
+def force_timeout(fut):
+    fut._reject(TimeoutError("forced timeout"))

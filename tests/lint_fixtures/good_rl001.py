@@ -1,0 +1,33 @@
+"""RL001 good twin: every exit path either releases the pages
+(try/finally), refines the None-failure branch, or hands ownership off
+to the sequence table."""
+
+
+def _stash(table, pages):
+    table[0:len(pages)] = pages     # ownership transfers to the table
+
+
+def prefill_guarded(pool, tokens, table):
+    pages = pool.alloc(len(tokens))
+    if pages is None:
+        return None
+    try:
+        if not tokens:
+            raise ValueError("empty prompt")
+        _stash(table, pages)
+    except ValueError:
+        pool.free(pages)
+        raise
+    return len(pages)
+
+
+def span_checked(pool, n, max_span):
+    pages = pool.alloc(n)
+    if pages is None:
+        return 0
+    try:
+        if max(pages) - min(pages) > max_span:
+            raise ValueError("fragmented allocation")
+    finally:
+        pool.free(pages)
+    return n

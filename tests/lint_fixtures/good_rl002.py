@@ -1,0 +1,13 @@
+"""RL002 good twin: the two release sites sit on mutually exclusive
+paths, so no single path frees twice."""
+
+
+def retire(pool, n, expired):
+    pages = pool.alloc(n)
+    if pages is None:
+        return "shed"
+    if expired:
+        pool.free(pages)
+        return "expired"
+    pool.free(pages)
+    return "ok"

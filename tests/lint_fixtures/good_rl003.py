@@ -1,0 +1,30 @@
+"""RL003 good twin: every adopted or created future reaches exactly
+one settle (or ownership is handed off to the pending queue) on every
+path out of the owning scope."""
+
+
+class StreamingFuture:
+    def __init__(self, payload):
+        self.payload = payload
+        self.done = False
+
+    def _reject(self, err):
+        was = self.done
+        self.done = True
+        return not was
+
+
+class Drainer:
+    def sweep(self):
+        while self._pending:
+            fut = self._pending.popleft()
+            fut._reject(RuntimeError("drain timed out while queued"))
+        self._stop = True
+
+    def admit(self, payload):
+        fut = StreamingFuture(payload)
+        if self._stopped:
+            fut._reject(RuntimeError("not admitting"))
+            return fut
+        self._pending.append(fut)    # ownership -> scheduler queue
+        return fut

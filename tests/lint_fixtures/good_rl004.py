@@ -1,0 +1,11 @@
+"""RL004 good twin: the resolve and reject sites are on mutually
+exclusive paths — each path settles exactly once."""
+
+
+class Settler:
+    def finish(self, outputs, err):
+        fut = self._pending.popleft()
+        if err is None:
+            fut._resolve(outputs)
+        else:
+            fut._reject(err)

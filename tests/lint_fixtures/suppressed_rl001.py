@@ -1,0 +1,12 @@
+"""RL001 suppressed twin: same leak shape as bad_rl001_deep, silenced
+at the acquire site with a rationale."""
+
+
+def prefill(pool, tokens, max_span):
+    pages = pool.alloc(len(tokens))  # mxlint: disable=RL001 -- torn down by owner
+    if pages is None:
+        return None
+    if len(pages) > max_span:
+        raise ValueError("fragmented allocation")
+    pool.free(pages)
+    return len(pages)

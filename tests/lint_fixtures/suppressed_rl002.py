@@ -1,0 +1,15 @@
+"""RL002 suppressed twin: same double-free shape as bad_rl002_deep,
+silenced at the second release with a rationale."""
+
+
+def _recycle(pool, pages):
+    pool.free(pages)
+
+
+def decode_step(pool, n):
+    pages = pool.alloc(n)
+    if pages is None:
+        return 0
+    _recycle(pool, pages)
+    pool.free(pages)  # mxlint: disable=RL002 -- pool.free is idempotent here
+    return n

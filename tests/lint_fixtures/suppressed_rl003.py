@@ -1,0 +1,10 @@
+"""RL003 suppressed twin: same unsettled-sweep shape as
+bad_rl003_deep, silenced at the adoption site with a rationale."""
+
+
+class AbortSweep:
+    def sweep(self, counts):
+        while self._pending:
+            fut = self._pending.popleft()  # mxlint: disable=RL003 -- settled by owner thread
+            counts["aborted"] += 1
+        self._stop = True

@@ -1,0 +1,9 @@
+"""RL004 suppressed twin: same double-settle shape as bad_rl004_deep,
+silenced at the second settle with a rationale."""
+
+
+class Settler:
+    def on_error(self, err):
+        fut = self._pending.popleft()
+        fut._reject(err)
+        fut._reject(err)  # mxlint: disable=RL004 -- settle is first-writer-wins

@@ -449,6 +449,54 @@ def test_generate_pre_stream_failure_retries_elsewhere():
         healthy.close()
 
 
+def test_journal_lifetime_zero_after_resume_heavy_burst():
+    """Stream-journal lifetime audit (leakcheck ``journal`` kind): the
+    ``delivered`` journal lives exactly as long as its request.  After a
+    resume-heavy burst — every stream dying mid-decode once and resuming
+    on the sibling, plus a lost stream and a no-worker rejection — the
+    live-journal count is back to zero: nothing keeps journals alive
+    past their terminal line, however the stream ended."""
+    from mxnet_tpu import leakcheck
+
+    pre_installed = leakcheck.installed()
+    if not pre_installed:
+        leakcheck.install("record")
+    leakcheck.reset()
+    dying = _FakeStreamWorker("d0", tokens=3, die_mid_stream=True)
+    healthy = _FakeStreamWorker("h0", tokens=6)
+    gw = _offline_gateway()
+    try:
+        gw._view = _view({"d0": {"addr": dying.addr, "inflight": 0},
+                          "h0": {"addr": healthy.addr, "inflight": 9}})
+        for _ in range(8):                     # resumed incarnations
+            gw._suspect.clear()   # re-eligible: every stream dies once
+            got = []
+            gw._forward_generate({"prompt": [1]}, got.append,
+                                 time.monotonic())
+            assert got[-1].get("done") is True
+        healthy.close()                        # second death -> lost
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
+        assert got[-1]["error"] == "ReplicaLost"
+        gw._view = _view({})                   # nobody to ask at all
+        got = []
+        gw._forward_generate({"prompt": [1]}, got.append,
+                             time.monotonic())
+        assert got[-1]["error"] == "Unavailable"
+        assert gw.streams_resumed >= 8
+        snap = leakcheck.snapshot()
+        assert snap["counters"]["tracked"] >= 10   # journals were live...
+        assert leakcheck.live_count("journal") == 0  # ...and all evicted
+    finally:
+        gw.httpd.server_close()
+        dying.close()
+        healthy.close()
+        leakcheck.reset()
+        if not pre_installed:
+            leakcheck.uninstall()
+
+
 # ---------------------------------------------------------------------------
 # WorkerSupervisor restart semantics (cheap non-framework children)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,259 @@
+"""Runtime resource-leak sanitizer (mxnet_tpu.leakcheck).
+
+Covers: the live ledger with creation-site attribution, record vs raise
+semantics (raise gates ``assert_quiescent``, with every survivor's kind
+and site in the LeakError), the settle-grace poll, the ``leakcheck.*``
+telemetry gauges and debug-bundle section, zero-overhead off mode, env
+arming, and the instrumented framework pairs: KV pages
+(``PageAllocator.alloc``/``free``), half-open probe slots
+(``CircuitBreaker.acquire_probe`` + all three outcomes), and the
+exactly-once future settle (``ServingFuture``).
+"""
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import subprocess_env
+
+import mxnet_tpu  # noqa: F401  (install_from_env runs at import)
+from mxnet_tpu import debug, leakcheck, telemetry
+from mxnet_tpu.generation import PageAllocator
+from mxnet_tpu.serving import CircuitBreaker, ServingFuture
+
+
+@pytest.fixture
+def recording():
+    """Arm record mode for one test, restore and wipe afterwards."""
+    was_installed = leakcheck.installed()
+    prev_mode = leakcheck.mode()
+    leakcheck.install("record")
+    leakcheck.reset()
+    try:
+        yield leakcheck
+    finally:
+        leakcheck.reset()
+        if not was_installed:
+            leakcheck.uninstall()
+        else:
+            leakcheck.install(prev_mode)
+
+
+@pytest.fixture
+def raising():
+    was_installed = leakcheck.installed()
+    prev_mode = leakcheck.mode()
+    leakcheck.install("raise")
+    leakcheck.reset()
+    try:
+        yield leakcheck
+    finally:
+        leakcheck.reset()
+        if not was_installed:
+            leakcheck.uninstall()
+        else:
+            leakcheck.install(prev_mode)
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics
+# ---------------------------------------------------------------------------
+def test_track_untrack_roundtrip_and_counters(recording):
+    leakcheck.track("kv_pages", ("t", 1))
+    leakcheck.track("kv_pages", ("t", 2))
+    leakcheck.track("futures", ("t", 3))
+    assert leakcheck.live_count("kv_pages") == 2
+    assert leakcheck.live_count() == 3
+    leakcheck.untrack("kv_pages", ("t", 1))
+    leakcheck.untrack("kv_pages", ("t", 2))
+    leakcheck.untrack("futures", ("t", 3))
+    assert leakcheck.live_count() == 0
+    c = leakcheck.snapshot()["counters"]
+    assert c["tracked"] == 3 and c["untracked"] == 3
+    assert c["untrack_misses"] == 0 and c["double_tracks"] == 0
+
+
+def test_miss_and_double_track_are_counted_not_raised(recording):
+    leakcheck.untrack("kv_pages", ("never", 0))   # pre-install release
+    leakcheck.track("futures", ("dup", 0))
+    leakcheck.track("futures", ("dup", 0))
+    c = leakcheck.snapshot()["counters"]
+    assert c["untrack_misses"] == 1 and c["double_tracks"] == 1
+    assert leakcheck.live_count("futures") == 1
+    leakcheck.untrack("futures", ("dup", 0))
+    assert leakcheck.assert_quiescent(grace_s=0) == {}
+
+
+def test_creation_site_attributed_to_tracking_caller(recording):
+    def acquire_here():
+        leakcheck.track("probe_slots", ("site", 0))
+
+    def outer():
+        acquire_here()
+
+    outer()
+    sites = leakcheck.snapshot()["sites"]["probe_slots"]
+    # skip=0 attributes the caller of the instrumented function
+    assert "test_leakcheck.py" in sites[0]["site"]
+    assert "(outer)" in sites[0]["site"]
+
+
+def test_record_mode_returns_leftovers(recording):
+    leakcheck.track("journal", ("left", 0))
+    left = leakcheck.assert_quiescent(grace_s=0)
+    assert list(left) == ["journal"] and len(left["journal"]) == 1
+
+
+def _acquire_leaked_page():
+    # a helper frame, so attribution (the instrumented function's
+    # caller) lands in this file, as it does for real instrumented sites
+    leakcheck.track("kv_pages", ("leak", 0))
+
+
+def test_raise_mode_names_kind_and_site(raising):
+    _acquire_leaked_page()
+    with pytest.raises(leakcheck.LeakError) as ei:
+        leakcheck.assert_quiescent(grace_s=0)
+    msg = str(ei.value)
+    assert "kv_pages: 1 live" in msg
+    assert "test_leakcheck.py" in msg
+
+
+def test_settle_grace_absorbs_background_release(raising):
+    leakcheck.track("futures", ("slow", 0))
+    t = threading.Timer(0.1, leakcheck.untrack, ("futures", ("slow", 0)))
+    t.start()
+    try:
+        # still live now, settled within the grace window: not a leak
+        assert leakcheck.live_count("futures") == 1
+        assert leakcheck.assert_quiescent(grace_s=2.0) == {}
+    finally:
+        t.join()
+
+
+def test_telemetry_gauges_exported(recording):
+    leakcheck.track("mesh_slices", ("g", 0))
+    leakcheck.snapshot()
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert gauges["leakcheck.live.mesh_slices"] == 1.0
+    assert gauges["leakcheck.tracked"] == 1.0
+    leakcheck.untrack("mesh_slices", ("g", 0))
+    leakcheck.snapshot()
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert gauges["leakcheck.live.mesh_slices"] == 0.0
+
+
+def test_debug_bundle_section_roundtrip(recording, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    leakcheck.track("journal", ("bundle", 0))
+    path = debug.write_bundle("leakcheck_test", force=True)
+    assert path
+    payload = json.loads(open(path).read())
+    section = payload["sections"]["leakcheck"]
+    assert section["mode"] == "record"
+    assert section["live"]["journal"] == 1
+    assert section["sites"]["journal"][0]["site"]
+    assert json.dumps(section)                     # JSON-clean
+    leakcheck.untrack("journal", ("bundle", 0))
+
+
+def test_off_mode_is_zero_overhead():
+    """With MXTPU_LEAKCHECK unset every hook is one module-global check:
+    no ledger entries, no counters, quiescence trivially true."""
+    if leakcheck.installed():
+        pytest.skip("suite running under MXTPU_LEAKCHECK")
+    leakcheck.track("kv_pages", ("off", 0))
+    assert leakcheck.live_count() == 0
+    assert leakcheck.snapshot()["counters"]["tracked"] == 0
+    assert leakcheck.assert_quiescent(grace_s=0) == {}
+    a = PageAllocator(4)
+    a.free(a.alloc(2))
+    assert leakcheck.snapshot()["counters"] == {
+        "tracked": 0, "untracked": 0, "untrack_misses": 0,
+        "double_tracks": 0}
+
+
+def test_install_mode_validation_and_idempotence(recording):
+    with pytest.raises(ValueError):
+        leakcheck.install("audit")
+    leakcheck.install("record")                    # idempotent
+    assert leakcheck.installed()
+
+
+def test_install_from_env_arms_at_package_import():
+    code = (
+        "import mxnet_tpu\n"
+        "from mxnet_tpu import leakcheck\n"
+        "assert leakcheck.installed() and leakcheck.mode() == 'raise'\n"
+        "from mxnet_tpu.generation import PageAllocator\n"
+        "a = PageAllocator(4)\n"
+        "pages = a.alloc(2)\n"
+        "assert leakcheck.live_count('kv_pages') == 2\n"
+        "try:\n"
+        "    leakcheck.assert_quiescent(grace_s=0.05)\n"
+        "    raise SystemExit('expected LeakError')\n"
+        "except leakcheck.LeakError:\n"
+        "    pass\n"
+        "a.free(pages)\n"
+        "leakcheck.assert_quiescent(grace_s=0.05)\n"
+        "print('LEAKCHECK_ENV_OK')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        env=subprocess_env(MXTPU_LEAKCHECK="raise"),
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "LEAKCHECK_ENV_OK" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# instrumented framework pairs
+# ---------------------------------------------------------------------------
+def test_page_allocator_ledger(recording):
+    a = PageAllocator(8)
+    pages = a.alloc(3)
+    assert leakcheck.live_count("kv_pages") == 3
+    a.free(pages[:1])
+    assert leakcheck.live_count("kv_pages") == 2
+    a.free(pages[1:])
+    assert leakcheck.live_count("kv_pages") == 0
+    assert a.alloc(99) is None                    # no grant, no entries
+    assert leakcheck.live_count("kv_pages") == 0
+    # two allocators never collide in the ledger
+    b = PageAllocator(8)
+    pa, pb = a.alloc(2), b.alloc(2)
+    assert leakcheck.live_count("kv_pages") == 4
+    a.free(pa)
+    b.free(pb)
+    assert leakcheck.assert_quiescent(grace_s=0) == {}
+
+
+def test_probe_slot_ledger_all_three_outcomes(recording):
+    for outcome in ("record_success", "release_probe", "record_failure"):
+        br = CircuitBreaker(threshold=1, backoff=0.01)
+        assert br.record_failure(0.0)             # trips OPEN
+        assert leakcheck.live_count("probe_slots") == 0
+        assert br.allow(10.0)                     # HALF_OPEN: slot taken
+        assert leakcheck.live_count("probe_slots") == 1
+        if outcome == "record_failure":
+            br.record_failure(10.0)
+        else:
+            getattr(br, outcome)()
+        assert leakcheck.live_count("probe_slots") == 0
+    # a CLOSED-state failure (no probe in flight) never miscounts
+    br = CircuitBreaker(threshold=5)
+    br.record_failure(0.0)
+    assert leakcheck.snapshot()["counters"]["untrack_misses"] == 0
+
+
+def test_future_settles_exactly_once_in_ledger(recording):
+    fut = ServingFuture({}, 1, 10.0, 0.0)
+    assert leakcheck.live_count("futures") == 1
+    assert fut._resolve([1])
+    assert leakcheck.live_count("futures") == 0
+    assert not fut._reject(RuntimeError("late"))  # first writer won
+    assert leakcheck.snapshot()["counters"]["untrack_misses"] == 0
+    assert leakcheck.assert_quiescent(grace_s=0) == {}

@@ -3,10 +3,13 @@
 Covers: one failing and one passing fixture per rule (TS001–TS005,
 CC001–CC002), the v2 inter-procedural corpus (tests/lint_fixtures/:
 CC003/CC004/CC005/TS007 positive, negative, suppressed, and
-cross-module, plus the one-helper-deep CC001 cases), suppression
-directives including ``disable-block``, the baseline ledger (module API
-and CLI), the JSON reporter schema, CLI exit codes, the jax-free
-contract, the MXNET_TRACE_GUARD runtime guard end-to-end, and the
+cross-module, plus the one-helper-deep CC001 cases), the v3
+resource-lifecycle corpus (RL001–RL004: deep, cross-module, good twin,
+suppressed twin, and the two historical PR 5 bugs re-introduced as
+fixtures), suppression directives including ``disable-block``, the
+baseline ledger (module API and CLI, RL included in the ratchet), the
+JSON reporter schema, CLI exit codes, the jax-free contract, the
+MXNET_TRACE_GUARD runtime guard end-to-end, and the
 one-host-sync-per-batch metric contract.
 """
 import json
@@ -32,6 +35,7 @@ FIXTURES_V2 = os.path.join(REPO, "tests", "lint_fixtures")
 ALL_RULES = ("TS001", "TS002", "TS003", "TS004", "TS005", "TS006",
              "CC001", "CC002")
 V2_RULES = ("TS007", "CC003", "CC004", "CC005")
+RL_RULES = ("RL001", "RL002", "RL003", "RL004")
 
 
 def _rules_hit(findings):
@@ -64,11 +68,14 @@ def test_findings_carry_position_and_severity():
 
 
 def test_rule_registry_complete():
-    assert set(ALL_RULES) | set(V2_RULES) <= set(RULES)
+    assert set(ALL_RULES) | set(V2_RULES) | set(RL_RULES) <= set(RULES)
     for rule in RULES.values():
         assert rule.summary and rule.doc
         assert rule.scope in ("module", "program")
     assert RULES["CC003"].scope == "program"
+    for r in RL_RULES:
+        assert RULES[r].scope == "program"
+        assert RULES[r].severity == Severity.ERROR
 
 
 # -- v2 inter-procedural corpus (tests/lint_fixtures/) ----------------------
@@ -89,6 +96,16 @@ V2_BAD = [
     ("CC005", ("bad_cc005_x_spawn.py", "bad_cc005_x_loop.py")),
     ("TS007", ("bad_ts007.py",)),
     ("TS007", ("bad_ts007_x_wrap.py", "bad_ts007_x_kernel.py")),
+    ("RL001", ("bad_rl001_deep.py",)),
+    ("RL001", ("bad_rl001_x_caller.py", "bad_rl001_x_helper.py")),
+    ("RL001", ("bad_rl001_probe_cancel.py",)),
+    ("RL002", ("bad_rl002_deep.py",)),
+    ("RL002", ("bad_rl002_x_caller.py", "bad_rl002_x_helper.py")),
+    ("RL003", ("bad_rl003_deep.py",)),
+    ("RL003", ("bad_rl003_x_caller.py", "bad_rl003_x_helper.py")),
+    ("RL003", ("bad_rl003_drain.py",)),
+    ("RL004", ("bad_rl004_deep.py",)),
+    ("RL004", ("bad_rl004_x_caller.py", "bad_rl004_x_helper.py")),
 ]
 
 V2_CLEAN = [
@@ -96,6 +113,9 @@ V2_CLEAN = [
     ("good_cc005.py",), ("good_ts007.py",), ("suppressed_cc003.py",),
     ("suppressed_cc004.py",), ("suppressed_cc005.py",),
     ("suppressed_ts007.py",), ("suppressed_block_cc001.py",),
+    ("good_rl001.py",), ("good_rl002.py",), ("good_rl003.py",),
+    ("good_rl004.py",), ("suppressed_rl001.py",), ("suppressed_rl002.py",),
+    ("suppressed_rl003.py",), ("suppressed_rl004.py",),
 ]
 
 
@@ -134,6 +154,53 @@ def test_cc003_reports_both_witness_paths():
     assert "Server._wait_lock" in f.message
     assert f.message.count(" -> ") >= 2
     assert "_drain" in f.message and "_apply_update" in f.message
+
+
+def test_rl001_one_helper_deep_keeps_ownership():
+    """Acceptance pin: a helper that provably neither releases nor
+    escapes the handle leaves ownership with the caller — the leak is
+    reported there, anchored at the acquire."""
+    (f,) = [f for f in _lint_v2("bad_rl001_deep.py")
+            if f.rule == "RL001"]
+    assert "PageAllocator.alloc/free" in f.message
+    assert "'pages'" in f.message
+    assert "raise" in f.message                  # the leaking exit kind
+    assert "free" in f.message                   # the advice names the fix
+
+
+def test_rl001_historical_probe_cancel_bug_caught():
+    """The PR 5 half-open probe-slot leak (first-wins cancel skipped a
+    dispatch without releasing the reserved probe), re-introduced as a
+    fixture: RL001 reports it at the acquire."""
+    (f,) = [f for f in _lint_v2("bad_rl001_probe_cancel.py")
+            if f.rule == "RL001"]
+    assert "probe slot" in f.message
+    assert "'repl.breaker'" in f.message
+    assert "never rejoins rotation" in f.message
+
+
+def test_rl003_historical_drain_bug_caught():
+    """The PR 5 drain(timeout) bug (timed-out drain stopped the
+    scheduler with admitted futures still queued, hanging their
+    callers), re-introduced as a fixture: RL003 reports the popped
+    future that never reaches a typed terminal outcome."""
+    (f,) = [f for f in _lint_v2("bad_rl003_drain.py")
+            if f.rule == "RL003"]
+    assert "exactly-once" in f.message
+    assert "'fut'" in f.message
+    assert "never resolves" in f.message
+
+
+def test_rl002_and_rl004_anchor_at_the_second_release():
+    """Double-release/double-settle findings point at the SECOND call
+    and name the line of the first."""
+    (f2,) = [f for f in _lint_v2("bad_rl002_deep.py")
+             if f.rule == "RL002"]
+    assert "already released at line" in f2.message
+    (f4,) = [f for f in _lint_v2("bad_rl004_deep.py")
+             if f.rule == "RL004"]
+    assert "already reached a terminal outcome at line" in f4.message
+    assert "exactly-once outcome contract" in f4.message
 
 
 def test_ts001_sees_through_a_helper():
@@ -378,6 +445,44 @@ def test_lint_package_runs_with_jax_unimportable(tmp_path):
     assert res.returncode == 1, res.stderr
     assert "CC001" in res.stdout
     assert "ImportError" not in res.stderr
+
+
+def test_rl_rules_run_with_jax_unimportable(tmp_path):
+    """The jax-free contract extends to the v3 lifecycle pass: with a
+    poisoned ``jax`` on PYTHONPATH, tools/mxlint still runs the
+    path-sensitive dataflow analysis (cross-module resolution included)
+    and reports RL findings."""
+    (tmp_path / "jax.py").write_text(
+        "raise ImportError('jax must never be imported by mxlint')\n")
+    env = subprocess_env()
+    env["PYTHONPATH"] = "%s%s%s" % (tmp_path, os.pathsep,
+                                    env["PYTHONPATH"])
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint"),
+         os.path.join(FIXTURES_V2, "bad_rl001_x_caller.py"),
+         os.path.join(FIXTURES_V2, "bad_rl001_x_helper.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1, res.stderr
+    assert "RL001" in res.stdout
+    assert "ImportError" not in res.stderr
+
+
+def test_cli_baseline_gates_rl_findings(tmp_path):
+    """RL findings ride the same ratchet as every other rule: accepted
+    via --write-baseline, gated on the rerun, and any NEW lifecycle
+    finding still fails the run."""
+    bad = os.path.join(FIXTURES_V2, "bad_rl002_deep.py")
+    ledger = str(tmp_path / "baseline.json")
+    res = _run_cli(bad)
+    assert res.returncode == 1 and "RL002" in res.stdout
+    res = _run_cli(bad, "--baseline", ledger, "--write-baseline")
+    assert res.returncode == 0, res.stderr
+    res = _run_cli(bad, "--baseline", ledger)
+    assert res.returncode == 0, res.stdout
+    res = _run_cli(bad, os.path.join(FIXTURES_V2, "bad_rl003_deep.py"),
+                   "--baseline", ledger)
+    assert res.returncode == 1
+    assert "RL003" in res.stdout
 
 
 def test_repo_is_lint_clean_modulo_baseline():
