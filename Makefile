@@ -16,6 +16,7 @@
 #   make fleet-smoke-> sharded-serving + autoscaling lane (docs/SHARDED_SERVING.md)
 #   make gateway-smoke-> cross-process fleet lane: gateway + worker failover
 #   make failover-smoke-> durable streams: resume, preemption, brownout
+#   make migrate-smoke-> live KV migration: drain, rebalance, defrag
 #   make sim-smoke  -> load replay + simulated fleet lane (docs/SIMULATION.md)
 #   make obs-smoke  -> telemetry/observability lane (docs/OBSERVABILITY.md)
 #   make debug-smoke-> diagnosis plane: flight recorder, mem tags, bundles
@@ -67,6 +68,9 @@ gateway-smoke:
 failover-smoke:
 	bash ci/runtime_functions.sh failover_check
 
+migrate-smoke:
+	bash ci/runtime_functions.sh migrate_check
+
 sim-smoke:
 	bash ci/runtime_functions.sh sim_check
 
@@ -82,4 +86,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke sim-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke migrate-smoke sim-smoke obs-smoke debug-smoke ci clean

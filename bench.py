@@ -287,7 +287,7 @@ def _emit_summary():
 _HIGHER_BETTER = ("_img_per_sec", "_per_sec", "_tokens_per_sec", "mfu",
                   "_vs_bf16", "_vs_naive", "_vs_baseline",
                   "_vs_v100_fp16", "value")
-_LOWER_BETTER = ("_ms",)
+_LOWER_BETTER = ("_ms", "_reprefill_ratio")
 
 
 def _flat_metrics(result):
@@ -604,6 +604,9 @@ def main(argv=None):
     def loadreplay_leg():
         return loadreplay_bench(quick=quick)
 
+    def migration_leg():
+        return migration_bench(quick=quick)
+
     # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
     # builds a second XLA module — so some exceed their full-mode numbers
     legs = [
@@ -645,6 +648,12 @@ def main(argv=None):
     # capacity and TTFT p99, both under the regression tripwire
     if os.environ.get("BENCH_LOADREPLAY", "1") != "0":
         legs.append(("loadreplay", loadreplay_leg, 45 if quick else 75))
+    # the migration leg runs in quick mode too: live KV handoff
+    # (docs/SHARDED_SERVING.md "Live migration") is accepted on
+    # migrate_vs_reprefill_ratio at the longest context (lower-better
+    # under the >10% tripwire; < 1.0 means the handoff beats re-prefill)
+    if os.environ.get("BENCH_MIGRATION", "1") != "0":
+        legs.append(("migration", migration_leg, 60 if quick else 150))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
@@ -842,6 +851,106 @@ def decode_bench(quick=False):
             profiler.dispatch_value("recompile") - base_recompiles)
     finally:
         srv.drain(timeout=30)
+    return out
+
+
+def migration_bench(quick=False):
+    """Live KV-migration leg (docs/SHARDED_SERVING.md "Live
+    migration"): at each context length, a greedy stream is parked
+    mid-decode and restored on a sibling server two ways — the live
+    handoff (export -> import -> attach, no prefill) and the journal
+    re-prefill (``resume_from``) — measuring park-to-next-token latency
+    for both.  Reports per-context ``migrate_ctx<N>_ms`` /
+    ``reprefill_ctx<N>_ms`` and the headline
+    ``migrate_vs_reprefill_ratio`` at the LONGEST context (lower-better
+    under the >10% tripwire): re-prefill grows with the attention
+    window while the handoff moves pages, so the ratio must stay below
+    1 at long contexts — migration earning its keep."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.generation import GenerationConfig, GenerationServer
+    from mxnet_tpu.models import TransformerConfig, TransformerLM
+    from mxnet_tpu.serving import StreamMigrated
+
+    vocab = 1024
+    max_len = 576 if quick else 1024
+    ctxs = (96, 512) if quick else (96, 256, 512, 896)
+    cfg = TransformerConfig(vocab_size=vocab, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_len=max_len,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    gcfg = GenerationConfig(page_size=16, max_pages=48 if quick else 64,
+                            max_slots=4, max_new_tokens=16)
+    a = GenerationServer(model, params, gcfg)
+    b = GenerationServer(model, params, gcfg)
+    rng = np.random.RandomState(0)
+
+    def parked(prompt):
+        fut = a.submit_async(prompt, temperature=0.0)
+        while len(fut.stream_tokens) < 4:
+            time.sleep(0.001)
+        [handle] = a.park_streams(1)
+        try:
+            fut.result(timeout=30)
+        except StreamMigrated:
+            pass
+        return handle, fut.stream_tokens
+
+    def t_first(submit):
+        # park-to-next-token: the client-visible gap each path leaves
+        evt = threading.Event()
+        t0 = time.perf_counter()
+        fut = submit(lambda t: evt.set())
+        if not evt.wait(120):
+            raise TimeoutError("no continuation token within 120s")
+        dt = (time.perf_counter() - t0) * 1e3
+        fut.result(timeout=120)
+        return dt
+
+    out = {}
+    reps = 3
+    try:
+        for ctx in ctxs:
+            prompt = rng.randint(0, vocab, size=ctx).astype(np.int32)
+            # warm every path at this context: both prefill buckets,
+            # the export/import/attach machinery, the resume re-prefill
+            a.submit(prompt, max_new_tokens=4)
+            b.submit(prompt, max_new_tokens=4)
+            handle, deliv = parked(prompt)
+            h2 = b.import_stream(a.export_stream(handle))
+            b.submit_async(prompt, resume_from=deliv, migrate_handle=h2,
+                           temperature=0.0).result(timeout=120)
+            b.submit_async(prompt, resume_from=deliv,
+                           temperature=0.0).result(timeout=120)
+            mig, rep = [], []
+            for _ in range(reps):
+                handle, deliv = parked(prompt)
+
+                def migrate(cb, handle=handle, deliv=deliv):
+                    h2 = b.import_stream(a.export_stream(handle))
+                    return b.submit_async(
+                        prompt, resume_from=deliv, migrate_handle=h2,
+                        temperature=0.0, on_token=cb)
+
+                mig.append(t_first(migrate))
+                rep.append(t_first(
+                    lambda cb, deliv=deliv: b.submit_async(
+                        prompt, resume_from=deliv, temperature=0.0,
+                        on_token=cb)))
+            out["migrate_ctx%d_ms" % ctx] = round(min(mig), 3)
+            out["reprefill_ctx%d_ms" % ctx] = round(min(rep), 3)
+        last = ctxs[-1]
+        out["migrate_vs_reprefill_ratio"] = round(
+            out["migrate_ctx%d_ms" % last]
+            / out["reprefill_ctx%d_ms" % last], 4)
+        out["migration_ctx_longest"] = last
+    finally:
+        a.drain(timeout=30)
+        b.drain(timeout=30)
     return out
 
 

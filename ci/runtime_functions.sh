@@ -260,6 +260,40 @@ failover_check() {
     fi
 }
 
+migrate_check() {
+    # Live KV-state migration (docs/SHARDED_SERVING.md "Live
+    # migration"): the MXKV blob round-trip + corruption rejection,
+    # bitwise forced migration (greedy AND seeded-sampled — the rng
+    # ships in the blob), defrag with bitwise continuation, the
+    # chunked /v1/migrate_in receiver (idempotent replay, abort), the
+    # rebalancer policy, the gateway HTTP handoff with the
+    # migrate_interrupt chaos kind degrading to journal resume, and
+    # the SimFleet drain-storm policy A/B.  Lockdep rides along in
+    # raise mode (the transfer path crosses the scheduler loop, the
+    # worker's buffer lock, and gateway handler threads) and leakcheck
+    # in raise mode audits BOTH sides of every transfer including
+    # aborts — a stranded page or half-assembled buffer fails the lane
+    # at the first non-quiescent test.
+    MXTPU_LOCKDEP=raise MXTPU_LEAKCHECK=raise \
+        python -m pytest tests/test_migration.py -q -m "not slow"
+    # every module the migration path touches must lint clean — NO
+    # suppressions: export/import hold allocator state across the
+    # scheduler turn and the receiver buffers live under a worker lock
+    python -m mxnet_tpu.lint mxnet_tpu/generation.py \
+        mxnet_tpu/serving.py mxnet_tpu/gateway.py mxnet_tpu/fleet.py \
+        mxnet_tpu/fleet_worker.py mxnet_tpu/simfleet.py \
+        mxnet_tpu/loadgen.py mxnet_tpu/chaos.py \
+        mxnet_tpu/leakcheck.py
+    if grep -n "mxlint: disable" mxnet_tpu/generation.py \
+            mxnet_tpu/serving.py mxnet_tpu/gateway.py \
+            mxnet_tpu/fleet.py mxnet_tpu/fleet_worker.py \
+            mxnet_tpu/simfleet.py mxnet_tpu/loadgen.py \
+            mxnet_tpu/chaos.py mxnet_tpu/leakcheck.py; then
+        echo "migration-path modules must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 sim_check() {
     # Trace-driven load replay + simulated-clock fleet
     # (docs/SIMULATION.md): trace-model determinism (Poisson/MMPP
@@ -434,6 +468,7 @@ all() {
     fleet_check
     gateway_check
     failover_check
+    migrate_check
     sim_check
     obs_check
     debug_check

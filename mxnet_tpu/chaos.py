@@ -62,6 +62,18 @@ Faults (each firing bumps the ``faults_injected`` dispatch counter):
                     impounds most of the KV free list for a bounded
                     window — page exhaustion that must preempt the
                     lowest-priority stream, never shed a higher one
+``migrate_interrupt@N``  gateway: the Nth KV-migration chunk push is
+                    killed mid-transfer — the gateway must abort the
+                    receiver (freeing its pages via the leak-audited
+                    contract) and degrade to the resume-from-journal
+                    path, so the client still sees exactly one typed
+                    outcome (docs/SHARDED_SERVING.md "Live migration")
+``drain_migrate@N``  fleet: the Nth drain-migrate opportunity with at
+                    least one active generation stream SIGTERMs a live
+                    worker (rc-76 drain, not a crash) — the worker must
+                    park + export its streams so the gateway re-attaches
+                    them on siblings with zero ``ReplicaLost`` and zero
+                    re-prefills
 ==================  ========================================================
 
 Every fault fires at most once per process (deterministic, idempotent
@@ -83,6 +95,7 @@ __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "registry_stale", "replica_slow_start",
            "gateway_partition", "worker_kill",
            "worker_kill_mid_decode", "page_pressure",
+           "migrate_interrupt", "drain_migrate",
            "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
@@ -92,6 +105,7 @@ FAULT_KINDS = frozenset({
     "registry_stale", "replica_slow_start",
     "gateway_partition", "worker_kill",
     "worker_kill_mid_decode", "page_pressure",
+    "migrate_interrupt", "drain_migrate",
 })
 
 
@@ -414,6 +428,31 @@ def worker_kill_mid_decode(n, streamed):
     if plan is None or streamed < 1:
         return False
     return plan.fire("worker_kill_mid_decode", n)
+
+
+def migrate_interrupt(n):
+    """``migrate_interrupt@N``: True when the Nth KV-migration chunk
+    push should die mid-transfer (the gateway raises a connection error
+    between chunks).  The transfer-abort path must free the receiver's
+    partial buffer/pages (leakcheck-audited) and the stream must degrade
+    to the resume-from-journal path — migration is never worse than
+    failover, even when the transfer itself is the casualty."""
+    plan = active()
+    return plan is not None and plan.fire("migrate_interrupt", n)
+
+
+def drain_migrate(n, streams):
+    """``drain_migrate@N``: True when the Nth opportunity should SIGTERM
+    a live worker that holds ``streams >= 1`` active generation streams
+    — a *planned* drain (rc-76), not a crash.  The zero-loss drain
+    contract: the worker parks + exports every active stream and the
+    gateway re-attaches each on a sibling, so the chaos suite asserts
+    zero ``ReplicaLost`` and zero re-prefills alongside the usual
+    exactly-one-typed-outcome invariant."""
+    plan = active()
+    if plan is None or streams < 1:
+        return False
+    return plan.fire("drain_migrate", n)
 
 
 def page_pressure(n, frac=0.9):

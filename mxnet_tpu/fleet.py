@@ -64,7 +64,7 @@ from .async_kv import AsyncKVClient, start_local_server
 from .elastic import PREEMPTED_EXIT_CODE, _backoff_delay
 
 __all__ = ["ServiceRegistry", "FleetView", "FleetSupervisor",
-           "WorkerSupervisor", "cost_model"]
+           "WorkerSupervisor", "FleetRebalancer", "cost_model"]
 
 # env-tunable defaults (docs/SHARDED_SERVING.md / docs/ENV_VARS.md)
 _DEF_HEARTBEAT_S = float(os.environ.get("MXTPU_FLEET_HEARTBEAT_S", "0.25"))
@@ -77,6 +77,17 @@ _DEF_P99_UP_MS = float(os.environ.get("MXTPU_FLEET_P99_UP_MS", "0"))
 _DEF_IDLE_DOWN_S = float(os.environ.get("MXTPU_FLEET_IDLE_DOWN_S", "2.0"))
 _DEF_COOLDOWN_S = float(os.environ.get("MXTPU_FLEET_COOLDOWN_S", "1.0"))
 _DEF_BREACH_TICKS = int(os.environ.get("MXTPU_FLEET_BREACH_TICKS", "2"))
+# sticky-session rebalancer (docs/SHARDED_SERVING.md "Live migration"):
+# a worker whose inflight exceeds the fleet median by more than BAND
+# gets up to MAX streams parked for migration, then COOLDOWN_S of peace
+_DEF_REBALANCE_S = float(os.environ.get(
+    "MXTPU_MIGRATE_REBALANCE_S", "0.5"))
+_DEF_REBALANCE_BAND = float(os.environ.get(
+    "MXTPU_MIGRATE_REBALANCE_BAND", "2"))
+_DEF_REBALANCE_COOLDOWN_S = float(os.environ.get(
+    "MXTPU_MIGRATE_REBALANCE_COOLDOWN_S", "2"))
+_DEF_REBALANCE_MAX = int(os.environ.get(
+    "MXTPU_MIGRATE_REBALANCE_MAX", "1"))
 
 
 def _log(msg):
@@ -587,6 +598,7 @@ class WorkerSupervisor:
         # a gateway counter) — the kill only fires once it reads >= 1
         self._streamed_probe = streamed_probe
         self._mid_kill_seq = 0
+        self._drain_seq = 0
         self.restarts = 0
         self.preemption_restarts = 0
         self.kills = 0
@@ -766,6 +778,26 @@ class WorkerSupervisor:
                 extra={"rid": rid, "rc": rc,
                        "snapshot": self.snapshot()})
 
+    def _busiest_alive(self):
+        """The live worker reporting the highest inflight (registry
+        view), falling back to the first live id — the drain_migrate
+        chaos victim with the most streams to migrate."""
+        live = set(self.alive())
+        if not live:
+            return None
+        if self.registry is not None:
+            try:
+                view = self.registry.view(reap=False)
+                loaded = sorted(
+                    ((rep.get("inflight", 0), rid)
+                     for rid, rep in view.replicas.items()
+                     if rid in live), reverse=True)
+                if loaded:
+                    return loaded[0][1]
+            except Exception:
+                pass
+        return sorted(live)[0]
+
     def _tick(self, now):
         if _chaos.worker_kill(self._kill_seq):
             self.kill_worker()
@@ -778,6 +810,14 @@ class WorkerSupervisor:
             if _chaos.worker_kill_mid_decode(self._mid_kill_seq, streamed):
                 self.kill_worker()
             self._mid_kill_seq += 1
+            # drain_migrate@N: SIGTERM (not SIGKILL) the busiest worker
+            # while streams are in flight — its rc-76 drain parks them
+            # for live migration instead of losing the KV state, the
+            # zero-loss half of the worker_kill_mid_decode drill
+            if _chaos.drain_migrate(self._drain_seq, streamed):
+                self.kill_worker(self._busiest_alive(),
+                                 sig=signal.SIGTERM)
+            self._drain_seq += 1
         for rid, proc in list(self._procs.items()):
             if rid in self._died_at or rid in self._given_up \
                     or rid in self._done:
@@ -798,6 +838,147 @@ class WorkerSupervisor:
                 _log("worker-supervisor tick failed: %s: %s"
                      % (type(e).__name__, e))
             self._stop_evt.wait(self.poll_s)
+
+
+class FleetRebalancer:
+    """Sticky-session load rebalancer (docs/SHARDED_SERVING.md "Live
+    migration").
+
+    Session affinity keeps a stream's KV pages on one worker, so a
+    fleet's load can skew permanently: sessions pile onto whichever
+    worker held them when the burst landed, and least-loaded routing
+    cannot move work that is already admitted.  This control loop closes
+    that gap with live migration: every ``MXTPU_MIGRATE_REBALANCE_S`` it
+    reads the registry view, computes the fleet-median inflight across
+    serving generate workers, and any worker whose inflight exceeds the
+    median by more than the ``MXTPU_MIGRATE_REBALANCE_BAND`` hysteresis
+    band gets up to ``MXTPU_MIGRATE_REBALANCE_MAX`` streams parked
+    (``POST /v1/migrate_out {"park": k}``) — the gateway carries each
+    parked stream's KV blob to the least-loaded sibling with no
+    re-prefill and no client-visible gap.  A rebalanced worker then
+    rests for ``MXTPU_MIGRATE_REBALANCE_COOLDOWN_S`` so reports can
+    catch up (no park storms, no oscillation).
+
+    Same threading shape as the other supervisors: one daemon thread,
+    plain-attribute state, nothing blocking under a lock."""
+
+    def __init__(self, registry=None, registry_addr=None,
+                 service="default", interval_s=None, band=None,
+                 cooldown_s=None, max_moves=None, start=True,
+                 clock=None):
+        self.clock = _clock.resolve(clock)
+        self.registry = registry if registry is not None else \
+            ServiceRegistry(addr=registry_addr, service=service)
+        self.interval_s = _DEF_REBALANCE_S if interval_s is None \
+            else float(interval_s)
+        self.band = _DEF_REBALANCE_BAND if band is None else float(band)
+        self.cooldown_s = _DEF_REBALANCE_COOLDOWN_S if cooldown_s is None \
+            else float(cooldown_s)
+        self.max_moves = _DEF_REBALANCE_MAX if max_moves is None \
+            else int(max_moves)
+        self.ticks = 0
+        self.rebalances = 0        # park actions issued
+        self.streams_parked = 0    # streams those actions parked
+        self.errors = 0
+        self._cooldown = {}        # rid -> earliest next action
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-rebalancer",
+                                        daemon=True)
+        if start:
+            self.start()
+
+    def start(self):
+        if not self._thread.is_alive():
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    def snapshot(self):
+        return {"ticks": self.ticks, "rebalances": self.rebalances,
+                "streams_parked": self.streams_parked,
+                "errors": self.errors, "band": self.band,
+                "cooldown_s": self.cooldown_s,
+                "max_moves": self.max_moves}
+
+    @staticmethod
+    def _post_json(addr, path, obj, timeout=5.0):
+        import http.client
+        import json as _json
+
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=timeout)
+        try:
+            conn.request("POST", path, body=_json.dumps(obj).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, _json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def tick(self):
+        """One rebalance pass (the loop body; tests drive it directly).
+        Returns how many streams were parked this pass."""
+        self.ticks += 1
+        now = self.clock.now()
+        try:
+            view = self.registry.view(reap=True)
+        except Exception:
+            self.errors += 1
+            return 0
+        loads = []
+        for rid, rep in view.replicas.items():
+            if not rep.get("addr") or rep.get("kind") != "generate":
+                continue
+            if rep.get("state") not in (None, "SERVING"):
+                continue
+            loads.append((int(rep.get("inflight", 0)), rid,
+                          rep["addr"]))
+        if len(loads) < 2:
+            return 0                # nowhere to migrate to
+        ranked = sorted(x[0] for x in loads)
+        median = ranked[len(ranked) // 2]
+        _telemetry.registry().gauge("fleet.rebalance_median").set(median)
+        parked = 0
+        for load, rid, addr in sorted(loads, reverse=True):
+            if load <= median + self.band:
+                break               # sorted: nobody further is over
+            if now < self._cooldown.get(rid, 0.0):
+                continue
+            k = min(self.max_moves, int(load - median))
+            try:
+                status, resp = self._post_json(addr, "/v1/migrate_out",
+                                               {"park": k})
+            except OSError:
+                self.errors += 1
+                continue
+            handles = resp.get("handles") or []
+            self._cooldown[rid] = now + self.cooldown_s
+            if status == 200 and handles:
+                self.rebalances += 1
+                self.streams_parked += len(handles)
+                parked += len(handles)
+                _count("fleet_rebalancer_parked", len(handles))
+                _log("rebalance: parked %d stream(s) on %s "
+                     "(inflight %d > median %d + band %g)"
+                     % (len(handles), rid, load, median, self.band))
+        return parked
+
+    def _loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self.tick()
+            except Exception as e:
+                self.errors += 1
+                _log("rebalancer tick failed: %s: %s"
+                     % (type(e).__name__, e))
+            self._stop_evt.wait(self.interval_s)
 
 
 # every debug bundle carries the measured cost profile (module-level

@@ -34,7 +34,19 @@ HTTP surface (JSON bodies; one typed terminal outcome per request):
   ``{"outputs": [...]}`` or ``{"error": <ServingError name>}``.
 * ``POST /v1/generate`` — ``{"prompt": [ids], ...}`` -> a streamed
   NDJSON body: one ``{"token": t}`` line per generated token, then a
-  terminal ``{"done": true, ...}`` or ``{"error": ...}`` line.
+  terminal ``{"done": true, ...}`` or ``{"error": ...}`` line — or a
+  non-terminal ``{"migrate": handle, ...}`` line when the stream was
+  parked for live migration (the gateway carries it to a sibling).
+* ``POST /v1/migrate_out`` — ``{"park": n}`` parks up to n streams and
+  returns their handles; ``{"handle": h}`` exports one parked stream as
+  a base64 KV blob (docs/SHARDED_SERVING.md "Live migration").
+* ``POST /v1/migrate_in`` — app-level chunked blob upload
+  ``{"key", "seq", "total", "data"}``; the final chunk installs the
+  blob and returns ``{"handle": h'}``.  The key is an idempotency key:
+  replayed chunks and a replayed final chunk are safe.
+* ``POST /v1/migrate_abort`` — ``{"key": k}`` and/or ``{"handle": h}``
+  frees a half-assembled buffer / staged import (leakcheck-audited).
+* ``POST /v1/defrag``   — compact fragmented KV page tables in place.
 * ``GET /healthz``      — worker snapshot (state, inflight, beats).
 
 Env knobs (``MXTPU_FLEET_WORKER_*``, docs/ENV_VARS.md): heartbeat
@@ -61,6 +73,13 @@ _DEF_IDEM_CACHE = int(os.environ.get(
     "MXTPU_FLEET_WORKER_IDEM_CACHE", "1024"))
 _DEF_DEADLINE_MS = float(os.environ.get(
     "MXTPU_FLEET_WORKER_DEADLINE_MS", "30000"))
+# live KV migration (docs/SHARDED_SERVING.md "Live migration"): receiver
+# transfer buffers expire on the same TTL the server uses for parked
+# streams; the drain path waits this long for parked streams' export
+_DEF_MIGR_TTL_S = float(os.environ.get(
+    "MXTPU_MIGRATE_PARK_TIMEOUT_S", "30"))
+_DEF_MIGR_DRAIN_WAIT_S = float(os.environ.get(
+    "MXTPU_MIGRATE_DRAIN_WAIT_S", "5"))
 
 
 def _log(msg):
@@ -134,6 +153,16 @@ class FleetWorker:
         self._drain_evt = threading.Event()
         self._stop_evt = threading.Event()
         self._preemption = None
+        # live-migration receiver state: chunk-reassembly buffers keyed
+        # by the gateway's transfer key, plus a bounded replay cache of
+        # settled transfers (key -> terminal response dict).  The lock
+        # guards only the dicts — blob install runs outside it.
+        self._migr_lock = threading.Lock()
+        self._migr_buf = {}           # key -> {"chunks", "total", "expires"}
+        self._migr_done = OrderedDict()
+        self.streams_parked = 0
+        self.migrations_in = 0
+        self.migrations_aborted = 0
 
         self.httpd = self._make_httpd(host, port)
         self.port = self.httpd.server_address[1]
@@ -164,13 +193,52 @@ class FleetWorker:
         return self._preemption
 
     def run(self):
-        """Serve until a drain signal, then withdraw + drain + exit 76."""
+        """Serve until a drain signal, then migrate out active streams,
+        withdraw + drain + exit 76."""
         self.start()
         while not self._drain_evt.wait(0.1):
             pass
+        self._migrate_on_drain()
         self.shutdown(drain_timeout=60)
         if self._preemption is not None:
             self._preemption.drain()          # exits rc 76
+
+    def _migrate_on_drain(self, wait_s=None):
+        """rc-76 zero-loss drain: withdraw from the registry (no new
+        streams land here), park every active generation stream — each
+        in-flight ``/v1/generate`` handler emits its ``migrate`` line —
+        then keep the HTTP endpoint alive until the gateway has fetched
+        every parked blob (or a bounded wait expires and the leftovers
+        fall back to journal resume).  Returns how many streams parked."""
+        if self.kind != "generate" \
+                or not hasattr(self.server, "park_streams"):
+            return 0
+        try:
+            self.registry.withdraw(self.rid)
+        except Exception:
+            pass
+        try:
+            handles = self.server.park_streams()
+        except Exception as e:
+            _log("drain park failed (%s: %s) — falling back to plain "
+                 "drain" % (type(e).__name__, e))
+            return 0
+        if not handles:
+            return 0
+        self.streams_parked += len(handles)
+        _count("fleet_worker_drain_parked", len(handles))
+        _log("drain: parked %d stream(s) for migration" % len(handles))
+        wait_s = _DEF_MIGR_DRAIN_WAIT_S if wait_s is None \
+            else float(wait_s)
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            try:
+                if not self.server.snapshot().get("parked"):
+                    break
+            except Exception:
+                break
+            time.sleep(0.05)
+        return len(handles)
 
     def shutdown(self, drain_timeout=30):
         """Withdraw from the registry, drain the server, stop serving."""
@@ -201,6 +269,10 @@ class FleetWorker:
                 "beats_failed": self.beats_failed,
                 "requests": self.requests,
                 "idem_replays": self.idem_replays,
+                "streams_parked": self.streams_parked,
+                "migrations_in": self.migrations_in,
+                "migrations_aborted": self.migrations_aborted,
+                "parked": snap.get("parked", 0),
                 # the zero-recompile assertion reaches across the
                 # process boundary through /healthz
                 "recompiles": _prof.dispatch_value("recompile")}
@@ -223,6 +295,7 @@ class FleetWorker:
                 _count("fleet_worker_beats_failed")
                 _log("heartbeat %d failed (%s: %s) — will re-register "
                      "on heal" % (beat, type(e).__name__, e))
+            self._sweep_migr_buffers()
             self._stop_evt.wait(self.heartbeat_s)
 
     # -- idempotency -------------------------------------------------------
@@ -320,6 +393,9 @@ class FleetWorker:
             if len(resume) >= cap:
                 # the dead worker generated everything but its terminal
                 # line — nothing left to decode, finish the stream here
+                mh = body.get("migrate_handle")
+                if mh and hasattr(self.server, "release_import"):
+                    self.server.release_import(mh)  # nothing to attach
                 emit({"done": True, "tokens": 0, "rid": self.rid})
                 if ent is not None:
                     ent.settle(200, lines=lines)
@@ -336,7 +412,8 @@ class FleetWorker:
                 top_k=body.get("top_k"),
                 seed=body.get("seed"),
                 priority=body.get("priority"),
-                resume_from=body.get("resume_from"))
+                resume_from=body.get("resume_from"),
+                migrate_handle=body.get("migrate_handle"))
         except serving.ServingError as e:
             emit({"error": type(e).__name__, "message": str(e),
                   "rid": self.rid})
@@ -353,6 +430,16 @@ class FleetWorker:
             emit({"done": True, "tokens": n, "rid": self.rid})
             if ent is not None:
                 ent.settle(200, lines=lines)
+        except serving.StreamMigrated as e:
+            # NOT a client-terminal outcome: the stream was parked for
+            # live migration.  Hand the gateway the export handle; it
+            # carries the KV blob to a sibling and re-issues the request
+            # there with no client-visible gap (docs/SHARDED_SERVING.md
+            # "Live migration").  Replays of this key see the same line
+            # and re-enter the same fetch-or-fallback path.
+            emit({"migrate": e.handle, "tokens": n, "rid": self.rid})
+            if ent is not None:
+                ent.settle(200, lines=lines)
         except serving.ServingError as e:
             emit({"error": type(e).__name__, "message": str(e),
                   "rid": self.rid})
@@ -365,6 +452,167 @@ class FleetWorker:
             if ent is not None:
                 ent.settle(500, lines=lines)
                 self._idem_forget(key)
+
+    # -- live migration (docs/SHARDED_SERVING.md "Live migration") ---------
+    def _handle_migrate_out(self, body):
+        """Sender side.  ``{"park": n}`` parks up to n streams (their
+        in-flight ``/v1/generate`` handlers emit the ``migrate`` lines);
+        ``{"handle": h}`` exports one parked stream as a base64 blob —
+        the export pops the record, so a replayed fetch of the same
+        handle returns 404 and the gateway falls back to resume."""
+        import base64
+
+        if "handle" in body:
+            try:
+                blob = self.server.export_stream(str(body["handle"]))
+            except KeyError:
+                return 404, {"error": "UnknownHandle", "rid": self.rid}
+            except Exception as e:
+                return 500, {"error": "Internal", "message": "%s: %s"
+                             % (type(e).__name__, e), "rid": self.rid}
+            return 200, {"blob": base64.b64encode(blob).decode("ascii"),
+                         "rid": self.rid}
+        n = body.get("park")
+        try:
+            handles = self.server.park_streams(
+                None if n in (None, "all") else int(n))
+        except Exception as e:
+            return 500, {"error": "Internal", "message": "%s: %s"
+                         % (type(e).__name__, e), "rid": self.rid}
+        self.streams_parked += len(handles)
+        if handles:
+            _count("fleet_worker_parked", len(handles))
+        return 200, {"handles": list(handles), "rid": self.rid}
+
+    def _handle_migrate_in(self, body):
+        """Receiver side: app-level chunked upload (the stdlib server
+        cannot parse chunked request bodies).  ``key`` is the transfer's
+        idempotency key; the final chunk assembles + installs the blob
+        and the settled outcome is cached so replays are safe.  The
+        half-assembled buffer is a tracked ``migrations`` leakcheck
+        resource until installed, aborted, or expired."""
+        import base64
+
+        from . import leakcheck, serving
+
+        try:
+            key = str(body["key"])
+            seq = int(body["seq"])
+            total = int(body["total"])
+            data = base64.b64decode(body.get("data", "") or "")
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": "BadRequest", "message": str(e),
+                         "rid": self.rid}
+        if total < 1 or not 0 <= seq < total:
+            return 400, {"error": "BadRequest",
+                         "message": "chunk %d/%d out of range"
+                         % (seq, total), "rid": self.rid}
+        with self._migr_lock:
+            done = self._migr_done.get(key)
+            if done is not None:
+                status, resp = done
+                return status, dict(resp)       # idempotent replay
+            buf = self._migr_buf.get(key)
+            if buf is None:
+                buf = self._migr_buf[key] = {
+                    "chunks": {}, "total": total,
+                    "expires": time.monotonic() + _DEF_MIGR_TTL_S}
+                leakcheck.track("migrations", key)
+            buf["chunks"][seq] = data
+            buf["expires"] = time.monotonic() + _DEF_MIGR_TTL_S
+            if len(buf["chunks"]) < buf["total"]:
+                return 200, {"ok": True, "have": len(buf["chunks"]),
+                             "rid": self.rid}
+            # complete: consume the buffer, install outside the lock
+            del self._migr_buf[key]
+        leakcheck.untrack("migrations", key)
+        blob = b"".join(buf["chunks"][i] for i in range(total))
+        try:
+            handle = self.server.import_stream(blob)
+        except ValueError as e:
+            # corrupt/mismatched blob: checksum-or-version fallback —
+            # the gateway degrades to re-prefill resume
+            status, resp = 400, {"error": "BadBlob", "message": str(e),
+                                 "rid": self.rid}
+        except serving.ServingError as e:
+            status = _ERROR_STATUS.get(type(e).__name__, 500)
+            resp = {"error": type(e).__name__, "message": str(e),
+                    "rid": self.rid}
+        except Exception as e:
+            status, resp = 500, {"error": "Internal", "message": "%s: %s"
+                                 % (type(e).__name__, e), "rid": self.rid}
+        else:
+            status, resp = 200, {"handle": handle, "rid": self.rid}
+            self.migrations_in += 1
+            _count("fleet_worker_migrations_in")
+        with self._migr_lock:
+            self._migr_done[key] = (status, resp)
+            while len(self._migr_done) > self._idem_cap:
+                self._migr_done.popitem(last=False)
+        return status, dict(resp)
+
+    def _handle_migrate_abort(self, body):
+        """Transfer-abort: drop a half-assembled buffer by ``key`` (and
+        release its install if the final chunk already landed), and/or
+        release a staged import by ``handle``.  Idempotent — aborting an
+        unknown transfer is a no-op, not an error."""
+        from . import leakcheck
+
+        dropped = False
+        key = body.get("key")
+        if key is not None:
+            with self._migr_lock:
+                buf = self._migr_buf.pop(str(key), None)
+                done = self._migr_done.pop(str(key), None)
+            if buf is not None:
+                leakcheck.untrack("migrations", str(key))
+                dropped = True
+            if done is not None and done[0] == 200 \
+                    and "handle" in done[1]:
+                # installed, but the gateway gave up before attaching
+                dropped = self.server.release_import(
+                    done[1]["handle"]) or dropped
+        handle = body.get("handle")
+        if handle is not None \
+                and hasattr(self.server, "release_import"):
+            dropped = self.server.release_import(str(handle)) or dropped
+        if dropped:
+            self.migrations_aborted += 1
+            _count("fleet_worker_migrations_aborted")
+        return 200, {"aborted": bool(dropped), "rid": self.rid}
+
+    def _handle_defrag(self, body):
+        """In-worker defrag: migrate fragmented streams to this server
+        itself, compacting page tables toward low page ids."""
+        from . import serving
+
+        try:
+            moved = self.server.defrag()
+        except serving.ServingError as e:
+            return _ERROR_STATUS.get(type(e).__name__, 500), \
+                {"error": type(e).__name__, "message": str(e),
+                 "rid": self.rid}
+        except Exception as e:
+            return 500, {"error": "Internal", "message": "%s: %s"
+                         % (type(e).__name__, e), "rid": self.rid}
+        return 200, {"moved": int(moved), "rid": self.rid}
+
+    def _sweep_migr_buffers(self):
+        """Expire abandoned chunk buffers (gateway died mid-transfer)
+        so a lost sender cannot pin receiver memory forever."""
+        if not self._migr_buf:
+            return
+        from . import leakcheck
+
+        now = time.monotonic()
+        with self._migr_lock:
+            stale = [k for k, b in self._migr_buf.items()
+                     if now >= b["expires"]]
+            for k in stale:
+                del self._migr_buf[k]
+        for k in stale:
+            leakcheck.untrack("migrations", k)
+            _log("migrate_in buffer %r expired before completion" % k)
 
     # -- HTTP plumbing -----------------------------------------------------
     def _make_httpd(self, host, port):
@@ -420,6 +668,16 @@ class FleetWorker:
                         worker._handle_generate(body, write_line)
                     except OSError:
                         pass      # client went away mid-stream
+                elif self.path in ("/v1/migrate_out", "/v1/migrate_in",
+                                   "/v1/migrate_abort", "/v1/defrag") \
+                        and worker.kind == "generate":
+                    fn = {"/v1/migrate_out": worker._handle_migrate_out,
+                          "/v1/migrate_in": worker._handle_migrate_in,
+                          "/v1/migrate_abort":
+                              worker._handle_migrate_abort,
+                          "/v1/defrag": worker._handle_defrag}[self.path]
+                    status, resp = fn(body)
+                    self._json(status, resp)
                 else:
                     self._json(404, {"error": "NotFound",
                                      "message": "no %s on a %s worker"

@@ -43,6 +43,19 @@ exactly-once (greedy: bitwise-identical) stream
 fallback: the resumed incarnation died too, no sibling existed, or the
 journal overflowed its cap (``gateway_stream_lost``).
 
+Live migration (docs/SHARDED_SERVING.md "Live migration"): a draining
+or rebalancing worker *parks* a stream instead of finishing it and
+emits a non-terminal ``migrate`` line.  The gateway fetches the
+stream's versioned KV blob from the sender (``/v1/migrate_out``),
+relays it to a healthy sibling in chunks (``/v1/migrate_in``,
+``MXTPU_MIGRATE_CHUNK_KB``), and re-issues the request there with the
+import handle — the receiver attaches the shipped KV pages + rng state
+and continues decoding bitwise-identically with **no re-prefill** and
+no client-visible gap (``gateway_stream_migrated``).  Any transfer
+failure aborts the receiver side and degrades to the journal-resume
+path above (``gateway_migrate_fallbacks``) — never worse than a plain
+worker death.
+
 Surface: ``POST /v1/predict`` (JSON in/out, typed errors as statuses),
 ``POST /v1/generate`` (NDJSON stream; the terminal line is the typed
 outcome; the ``X-MXTPU-Priority`` request header becomes the worker-side
@@ -83,6 +96,10 @@ _DEF_SESSION_CAP = int(os.environ.get("MXTPU_GATE_SESSION_CAP", "4096"))
 # max tokens journaled per stream for mid-decode resume; a stream past
 # the cap falls back to ReplicaLost on worker death
 _DEF_JOURNAL_CAP = int(os.environ.get("MXTPU_GATE_JOURNAL_CAP", "4096"))
+# live KV migration transfer chunk size (docs/SHARDED_SERVING.md "Live
+# migration"): the gateway relays sender blobs to the receiver in
+# app-level chunks of this many KiB under one idempotency key
+_DEF_MIGR_CHUNK_KB = int(os.environ.get("MXTPU_MIGRATE_CHUNK_KB", "256"))
 
 
 def _log(msg):
@@ -126,8 +143,11 @@ class Gateway:
         self.retried = 0
         self.streams_lost = 0
         self.streams_resumed = 0
+        self.streams_migrated = 0   # live KV handoffs completed
+        self.migrate_fallbacks = 0  # handoffs degraded to journal resume
         self.tokens_streamed = 0    # fleet-wide delivered-token counter
         #                             (worker_kill_mid_decode chaos probe)
+        self._migrate_seq = 0       # chaos kill-point (migrate_interrupt)
 
         self._lock = threading.Lock()      # sessions + local inflight
         self._sessions = OrderedDict()     # session -> rid
@@ -183,6 +203,8 @@ class Gateway:
                 "requests": self.requests, "retried": self.retried,
                 "streams_lost": self.streams_lost,
                 "streams_resumed": self.streams_resumed,
+                "streams_migrated": self.streams_migrated,
+                "migrate_fallbacks": self.migrate_fallbacks,
                 "tokens_streamed": self.tokens_streamed,
                 "workers": sorted(view.replicas) if view is not None
                 else [],
@@ -368,9 +390,21 @@ class Gateway:
         excluded = []
         attempt = 0
         losses = 0          # mid-stream worker deaths for this request
+        migrations = 0      # live KV handoffs completed for this request
+        fallbacks = 0       # handoffs degraded to journal resume
         overflowed = False  # journal passed the cap — resume disarmed
+        pending = None      # (rid, addr, handle) of a completed handoff
         while True:
-            picked = self._pick(session=session, exclude=excluded)
+            migrate_handle = None
+            if pending is not None:
+                # a live-migration transfer just landed on this sibling:
+                # target it directly, attaching the imported KV state
+                rid, addr = pending[0], pending[1]
+                migrate_handle = pending[2]
+                pending = None
+                picked = (rid, addr)
+            else:
+                picked = self._pick(session=session, exclude=excluded)
             if picked is None:
                 if delivered:
                     self.streams_lost += 1
@@ -386,7 +420,16 @@ class Gateway:
                 return
             rid, addr = picked
             req = body
-            if delivered:
+            if migrate_handle is not None:
+                # migrated incarnation: the receiver attaches the
+                # imported KV pages + rng state to this request and
+                # continues decoding — no re-prefill.  Fresh key: this
+                # is new work on a new worker.
+                req = dict(body)
+                req["migrate_handle"] = migrate_handle
+                req["resume_from"] = [int(t) for t in delivered]
+                req["idempotency_key"] = "gw-" + _telemetry.new_trace_id()
+            elif delivered:
                 # resume incarnation: ship the delivered prefix so the
                 # sibling reconstructs the exact KV/rng state, under a
                 # fresh idempotency key (this is new work — the old key
@@ -423,6 +466,11 @@ class Gateway:
                         raise OSError("worker %s shed: %s"
                                       % (rid, line["error"]))
                     first = False
+                    if "migrate" in line:
+                        # live migration handoff: NOT client-terminal
+                        # and never written to the client — handled
+                        # below, outside the read loop
+                        break
                     streamed += 1
                     if "token" in line:
                         if len(delivered) < _DEF_JOURNAL_CAP:
@@ -430,16 +478,43 @@ class Gateway:
                         else:
                             overflowed = True
                         self.tokens_streamed += 1
-                    elif "done" in line and losses:
+                    elif "done" in line and (losses or migrations
+                                             or fallbacks):
                         # terminal count covers every incarnation, not
                         # just the one that finished the stream
                         line = dict(line)
                         line["tokens"] = len(delivered)
-                        line["resumed"] = losses
+                        if losses or fallbacks:
+                            line["resumed"] = losses + fallbacks
+                        if migrations:
+                            line["migrated"] = migrations
                     write_line(line)
                     if "done" in line or "error" in line:
                         break
                 conn.close()
+                if "migrate" in line:
+                    # the worker parked this stream for live migration
+                    # (drain or rebalance).  Carry the KV blob to a
+                    # sibling; ANY failure degrades to the plain
+                    # journal-resume path — never worse than today.
+                    excluded.append(rid)
+                    moved = self._migrate_stream(addr, line["migrate"],
+                                                 excluded)
+                    if moved is not None:
+                        migrations += 1
+                        self.streams_migrated += 1
+                        _count("gateway_stream_migrated")
+                        if session:
+                            with self._lock:
+                                self._sessions[session] = moved[0]
+                        pending = moved
+                    else:
+                        fallbacks += 1
+                        self.migrate_fallbacks += 1
+                        _count("gateway_migrate_fallbacks")
+                        _log("migration of stream off worker %s failed "
+                             "— falling back to journal resume" % rid)
+                    continue
                 return
             except (OSError, ValueError) as e:
                 self._note_suspect(rid)
@@ -471,6 +546,82 @@ class Gateway:
                     return
             finally:
                 self._track(rid, -1)
+
+    # -- live KV migration -------------------------------------------------
+    def _post_json(self, addr, path, obj):
+        """One JSON POST -> (status, parsed body).  Raises OSError on
+        connection failure like every other worker call."""
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.timeout_s)
+        try:
+            conn.request("POST", path, body=json.dumps(obj).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def _migrate_stream(self, sender_addr, handle, exclude):
+        """Carry one parked stream's KV blob sender -> sibling.
+
+        Fetches the versioned blob from the sender's ``/v1/migrate_out``,
+        pushes it to a healthy sibling's ``/v1/migrate_in`` in
+        ``MXTPU_MIGRATE_CHUNK_KB`` chunks under one transfer key, and
+        returns ``(rid, addr, new_handle)`` for the caller to target.
+        Returns None on ANY failure — after a best-effort
+        ``/v1/migrate_abort`` so the receiver frees whatever it already
+        buffered or installed (the leakcheck-audited contract); the
+        caller then degrades to the journal-resume path.  The
+        ``migrate_interrupt`` chaos kind severs the transfer between
+        chunks to drill exactly that degradation."""
+        import base64
+
+        mseq = self._migrate_seq
+        self._migrate_seq += 1
+        target = self._pick(exclude=tuple(exclude))
+        if target is None:
+            return None
+        rid2, addr2 = target
+        key = "mig-" + _telemetry.new_trace_id()
+        try:
+            status, resp = self._post_json(sender_addr, "/v1/migrate_out",
+                                           {"handle": handle})
+            if status != 200 or "blob" not in resp:
+                raise OSError("export of %s failed: HTTP %d %s"
+                              % (handle, status, resp.get("error")))
+            blob = base64.b64decode(resp["blob"])
+            chunk = max(1, _DEF_MIGR_CHUNK_KB) * 1024
+            total = max(1, -(-len(blob) // chunk))
+            resp = {}
+            for i in range(total):
+                if _chaos.migrate_interrupt(mseq):
+                    raise OSError("chaos: migration interrupted after "
+                                  "%d/%d chunk(s)" % (i, total))
+                part = blob[i * chunk:(i + 1) * chunk]
+                status, resp = self._post_json(
+                    addr2, "/v1/migrate_in",
+                    {"key": key, "seq": i, "total": total,
+                     "data": base64.b64encode(part).decode("ascii")})
+                if status != 200:
+                    raise OSError("chunk %d/%d rejected: HTTP %d %s"
+                                  % (i, total, status,
+                                     resp.get("error")))
+            new_handle = resp.get("handle")
+            if not new_handle:
+                raise OSError("transfer settled without a handle: %s"
+                              % resp)
+            return rid2, addr2, new_handle
+        except (OSError, ValueError, KeyError) as e:
+            _log("KV transfer %s -> %s failed (%s: %s) — aborting"
+                 % (handle, rid2, type(e).__name__, e))
+            try:
+                # frees the receiver's buffer AND any installed-but-
+                # unclaimed import under the same key
+                self._post_json(addr2, "/v1/migrate_abort", {"key": key})
+            except OSError:
+                pass          # receiver gone too; its TTL sweep cleans up
+            return None
 
     # -- HTTP plumbing -----------------------------------------------------
     def _make_httpd(self, host, port):

@@ -37,9 +37,12 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import json
 import os
+import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -50,10 +53,12 @@ from . import leakcheck as _leakcheck
 from . import profiler as _profiler
 from . import telemetry as _telemetry
 from .serving import (DRAINING, SERVING, STARTING, STOPPED, DeadlineExceeded,
-                      Draining, Overloaded, StreamingFuture, brownout)
+                      Draining, Overloaded, StreamingFuture, StreamMigrated,
+                      brownout)
 
 __all__ = ["GenerationConfig", "PageAllocator", "GenerationEngine",
-           "GenerationServer", "parse_priority"]
+           "GenerationServer", "parse_priority", "pack_kv_blob",
+           "unpack_kv_blob", "KV_BLOB_MAGIC", "KV_BLOB_VERSION"]
 
 _DEF_PAGE_SIZE = int(os.environ.get("MXTPU_GEN_PAGE_SIZE", "16"))
 _DEF_MAX_PAGES = int(os.environ.get("MXTPU_GEN_MAX_PAGES", "256"))
@@ -66,6 +71,11 @@ _DEF_PREFILL_BUCKETS = os.environ.get("MXTPU_GEN_PREFILL_BUCKETS", "")
 _DEF_TEMPERATURE = float(os.environ.get("MXTPU_GEN_TEMPERATURE", "0"))
 _DEF_TOP_K = int(os.environ.get("MXTPU_GEN_TOP_K", "0"))
 _DEF_SEED = int(os.environ.get("MXTPU_GEN_SEED", "0"))
+# live KV migration (docs/SHARDED_SERVING.md "Live migration"): how long
+# a parked/imported stream may hold its pages before the TTL sweep frees
+# them (an abandoned transfer must not leak KV pages)
+_DEF_MIGRATE_PARK_S = float(os.environ.get(
+    "MXTPU_MIGRATE_PARK_TIMEOUT_S", "30"))
 
 
 def _log(msg):
@@ -162,6 +172,87 @@ def _sample_token(logits, temperature, top_k, rng):
 
 
 # ---------------------------------------------------------------------------
+# KV snapshot wire format (live migration, docs/GENERATIVE.md)
+# ---------------------------------------------------------------------------
+# Layout (big-endian):
+#   magic[4] | version u16 | header_len u32 | header JSON | payload_len u64
+#   | payload (raw K block bytes ++ raw V block bytes) | crc32 u32
+# The CRC covers header+payload; any magic/version/CRC/shape mismatch is
+# a ValueError so the transfer path can fall back to re-prefill — a
+# migration can never be worse than the resume-from-journal path.
+KV_BLOB_MAGIC = b"MXKV"
+KV_BLOB_VERSION = 1
+
+
+def pack_kv_blob(header, k_block, v_block):
+    """Serialize one parked stream: ``header`` (JSON-able dict) plus its
+    gathered K/V pages (np arrays ``[L, n_pages, page_size, H, D]``)."""
+    k_block = np.ascontiguousarray(k_block)
+    v_block = np.ascontiguousarray(v_block)
+    header = dict(header)
+    header["kv_dtype"] = str(k_block.dtype)
+    header["kv_shape"] = list(k_block.shape)
+    hbytes = json.dumps(header, sort_keys=True).encode()
+    payload = k_block.tobytes() + v_block.tobytes()
+    crc = zlib.crc32(hbytes + payload) & 0xFFFFFFFF
+    return b"".join([KV_BLOB_MAGIC,
+                     struct.pack(">HI", KV_BLOB_VERSION, len(hbytes)),
+                     hbytes,
+                     struct.pack(">Q", len(payload)),
+                     payload,
+                     struct.pack(">I", crc)])
+
+
+def unpack_kv_blob(blob):
+    """Validate + parse a :func:`pack_kv_blob` blob.  Returns
+    ``(header, k_block, v_block)``; raises ``ValueError`` on any magic /
+    version / truncation / checksum mismatch."""
+    blob = bytes(blob)
+    if len(blob) < 10 or blob[:4] != KV_BLOB_MAGIC:
+        raise ValueError("KV blob: bad magic")
+    version, hlen = struct.unpack(">HI", blob[4:10])
+    if version != KV_BLOB_VERSION:
+        raise ValueError("KV blob: version %d != %d"
+                         % (version, KV_BLOB_VERSION))
+    off = 10
+    if len(blob) < off + hlen + 8:
+        raise ValueError("KV blob: truncated header")
+    hbytes = blob[off:off + hlen]
+    off += hlen
+    (plen,) = struct.unpack(">Q", blob[off:off + 8])
+    off += 8
+    if len(blob) != off + plen + 4:
+        raise ValueError("KV blob: truncated payload")
+    payload = blob[off:off + plen]
+    (crc,) = struct.unpack(">I", blob[off + plen:off + plen + 4])
+    if crc != (zlib.crc32(hbytes + payload) & 0xFFFFFFFF):
+        raise ValueError("KV blob: CRC mismatch")
+    try:
+        header = json.loads(hbytes)
+    except ValueError:
+        raise ValueError("KV blob: unparseable header")
+    shape = tuple(int(d) for d in header["kv_shape"])
+    dtype = np.dtype(header["kv_dtype"])
+    n = int(np.prod(shape)) * dtype.itemsize
+    if plen != 2 * n:
+        raise ValueError("KV blob: payload is %d byte(s), header says "
+                         "2x%d" % (plen, n))
+    k_block = np.frombuffer(payload[:n], dtype=dtype).reshape(shape)
+    v_block = np.frombuffer(payload[n:], dtype=dtype).reshape(shape)
+    return header, k_block, v_block
+
+
+def _restore_rng(state):
+    """Rebuild a ``np.random.Generator`` from its journaled
+    ``bit_generator.state`` dict — the migrated stream's sampler resumes
+    mid-sequence, bitwise (no fast-forward approximation needed)."""
+    name = str(state.get("bit_generator", "PCG64"))
+    bg = getattr(np.random, name)()
+    bg.state = state
+    return np.random.Generator(bg)
+
+
+# ---------------------------------------------------------------------------
 # page allocator
 # ---------------------------------------------------------------------------
 class PageAllocator:
@@ -219,9 +310,15 @@ class PageAllocator:
         """Chaos hook (``page_pressure``): move ``frac`` of the current
         free list into a held side-pool so allocation sees artificial
         exhaustion.  Impounded pages count as used on the util gauge.
-        Returns how many pages were impounded."""
+        Returns how many pages were impounded.
+
+        Hardened edge cases (tests/test_generation.py): ``frac`` is
+        clamped to [0, 1] so a malformed plan can never pop past the end
+        of a near-empty free list, and repeated impounds accumulate into
+        the same side-pool (one ``release()`` returns them all)."""
         with self._lock:
-            n = int(len(self._free) * float(frac))
+            frac = min(1.0, max(0.0, float(frac)))
+            n = min(len(self._free), int(len(self._free) * frac))
             for _ in range(n):
                 self._held.append(self._free.pop())
         self._publish()
@@ -229,13 +326,30 @@ class PageAllocator:
 
     def release(self):
         """Return every impounded page to the free list (end of the
-        ``page_pressure`` window).  Returns how many were released."""
+        ``page_pressure`` window).  Returns how many were released.
+        Idempotent: a double release (chaos window ending twice, or a
+        release racing a drain sweep) finds an empty side-pool and
+        returns 0 — pages re-enter the free list exactly once."""
         with self._lock:
             n = len(self._held)
             self._free.extend(self._held)
             self._held = []
         self._publish()
         return n
+
+    @property
+    def held(self):
+        """Pages currently impounded by chaos (tests/introspection)."""
+        with self._lock:
+            return len(self._held)
+
+    def min_free(self):
+        """Lowest free page id, or None when the pool is exhausted — the
+        defrag pass moves a stream only when a lower-numbered page than
+        one it occupies is free (free+realloc pops lowest ids first, so
+        relocation provably compacts)."""
+        with self._lock:
+            return min(self._free) if self._free else None
 
     def _publish(self):
         util = self.used / self._capacity
@@ -467,11 +581,25 @@ class GenerationServer:
         #                                       cannot starve the batch
         self._loop_turn = 0                   # page_pressure chaos clock
         self._pressure_until = 0
+        # live migration (docs/SHARDED_SERVING.md "Live migration"):
+        # parked streams awaiting export, imported streams awaiting
+        # attach — both hold KV pages under a TTL so an abandoned
+        # transfer can never leak them
+        self._parked = {}                     # handle -> record
+        self._imports = {}                    # handle -> record
+        self._park_timeout = _DEF_MIGRATE_PARK_S
+        self._tasks = collections.deque()     # (fn, box, evt) run on the
+        #                                       scheduler thread (engine
+        #                                       arrays have one writer)
+        self._limbo = 0                       # seqs mid-defrag-relocation
         self._state = STARTING
         self.stats = {
             "admitted": 0, "shed_queue": 0, "shed_pages": 0, "ok": 0,
             "deadline_exceeded": 0, "rejected_draining": 0,
             "preempted": 0, "resumed": 0, "shed_brownout": 0,
+            "parked": 0, "migrated_out": 0, "migrated_in": 0,
+            "migrate_attached": 0, "migrate_expired": 0,
+            "defrag_moved": 0,
         }
         if warm:
             self.engine.warm()
@@ -491,7 +619,7 @@ class GenerationServer:
     # -- admission -----------------------------------------------------
     def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
                      on_token=None, temperature=None, top_k=None, seed=None,
-                     priority=None, resume_from=None):
+                     priority=None, resume_from=None, migrate_handle=None):
         """Admit one generation request; returns a
         :class:`~mxnet_tpu.serving.StreamingFuture` or raises the typed
         admission error (:class:`Overloaded` / :class:`Draining`).
@@ -515,12 +643,28 @@ class GenerationServer:
         returned future streams only the continuation.  With an explicit
         ``seed`` the rng is fast-forwarded by ``len(resume_from)`` draws,
         so a sampled resume produces the exact suffix the unkilled run
-        would have (greedy mode is bitwise-identical by construction)."""
+        would have (greedy mode is bitwise-identical by construction).
+
+        ``migrate_handle`` — a handle returned by :meth:`import_stream`:
+        attach directly to the installed KV state (length, last token and
+        live sampling rng shipped in the snapshot) with **no prefill at
+        all** — the bitwise-continuation guarantee without the O(context)
+        recompute.  An unknown/expired handle, or a snapshot that
+        disagrees with the caller's journal, silently falls back to the
+        ``resume_from`` re-prefill path — migration is never worse than
+        failover."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         prefix = (np.asarray(resume_from, np.int32).reshape(-1)
                   if resume_from is not None else None)
+        if migrate_handle is not None:
+            fut = self._attach_migrated(migrate_handle, prompt, prefix,
+                                        max_new_tokens, deadline_ms,
+                                        on_token)
+            if fut is not None:
+                return fut
+            # fall through: re-prefill from the journal instead
         start_new = 0 if prefix is None else int(prefix.size)
         tokens = prompt if prefix is None \
             else np.concatenate([prompt, prefix])
@@ -593,10 +737,392 @@ class GenerationServer:
         """Blocking convenience: the generated token-id list."""
         return self.submit_async(prompt, **kw).result(timeout=timeout)
 
+    # -- live KV migration (docs/SHARDED_SERVING.md "Live migration") --
+    @staticmethod
+    def _new_handle():
+        return "kvm-" + os.urandom(8).hex()
+
+    def _run_on_scheduler(self, fn, timeout=30.0):
+        """Run ``fn`` on the scheduler thread and return its result.
+
+        The engine's page arrays have exactly one writer (the scheduler:
+        prefill/decode reassign them functionally), so any read-modify-
+        write — the import scatter, the defrag relocation — must run
+        there too or a concurrent decode's reassignment would silently
+        drop the update."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        box = {}
+        evt = threading.Event()
+        with self._cv:
+            if self._stop or self._state == STOPPED:
+                raise Draining("generation server is stopped")
+            self._tasks.append((fn, box, evt))
+            self._cv.notify_all()
+        if not evt.wait(timeout):
+            raise TimeoutError("scheduler did not service the task "
+                               "within %.1fs" % timeout)
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _park_seq_locked(self, seq):
+        """Evict ``seq`` from the batch but KEEP its pages: record every
+        field a receiver needs for bitwise continuation (page table, host
+        cursor, live sampling rng, QoS rank) under a fresh handle, and
+        settle the old future with :class:`StreamMigrated` so the worker
+        emits a ``migrate`` line instead of tokens.  Caller holds the cv.
+
+        Safe against an in-flight decode: the post-decode advance loop
+        skips done futures without touching host state, and a re-run of
+        the same decode position writes bitwise-identical KV — so the
+        snapshot cursor and the page contents can never disagree."""
+        self._active.remove(seq)
+        handle = self._new_handle()
+        start0 = seq.n_new - len(seq.gen_tokens)
+        n_prompt = int(seq.input_tokens.size) - start0
+        temperature, top_k, rng = seq.sampling
+        rec = {
+            "prompt": np.asarray(seq.input_tokens[:n_prompt], np.int32),
+            "generated": ([int(t) for t in seq.input_tokens[n_prompt:]]
+                          + [int(t) for t in seq.gen_tokens]),
+            "input_tokens": seq.input_tokens,
+            "gen_tokens": [int(t) for t in seq.gen_tokens],
+            "length": int(seq.length),
+            "last_token": int(seq.last_token),
+            "n_new": int(seq.n_new),
+            "max_new": int(seq.max_new),
+            "prompt_len": int(seq.prompt_len),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "rng": rng,
+            "prio_name": seq.prio_name,
+            "prio_rank": int(seq.prio_rank),
+            "table": seq.table,
+            "n_pages": int(seq.n_pages),
+            "expires": self.clock.now() + self._park_timeout,
+        }
+        self._parked[handle] = rec
+        self.stats["parked"] += 1
+        _profiler.dispatch_count("gen_parked")
+        _telemetry.trace_instant(
+            "gen.park", cat="gen",
+            args={"handle": handle, "tokens": seq.n_new,
+                  "pages": seq.n_pages})
+        seq.fut._reject(StreamMigrated(
+            "stream parked for migration after %d token(s)" % seq.n_new,
+            handle=handle))
+        self._cv.notify_all()
+        return handle
+
+    def park_streams(self, n=None):
+        """Park up to ``n`` active streams (all of them by default) for
+        migration; returns their handles.  Largest KV footprint first —
+        the stream whose move frees the most pages / saves the most
+        re-prefill.  Each parked stream's old future settles with
+        :class:`StreamMigrated`; the state is claimable via
+        :meth:`export_stream` until the park TTL expires."""
+        with self._cv:
+            cands = [s for s in self._active
+                     if not s.fut.done and not s.preempted]
+            cands.sort(key=lambda s: (-s.n_pages, -s.n_new))
+            if n is not None:
+                cands = cands[:max(0, int(n))]
+            return [self._park_seq_locked(s) for s in cands]
+
+    def export_stream(self, handle):
+        """Serialize a parked stream into the versioned, CRC-checksummed
+        wire blob and free its pages on this side (the blob is now the
+        only copy — the sender forgets the stream).  Raises ``KeyError``
+        for an unknown/expired handle."""
+        t0 = time.perf_counter()
+        with self._cv:
+            rec = self._parked.pop(handle, None)
+            if rec is None:
+                raise KeyError("unknown or expired migration handle %r"
+                               % handle)
+            # capture the current page-array version under the lock; jax
+            # arrays are immutable, so the gather below is race-free even
+            # while the scheduler keeps decoding other streams
+            k_pages, v_pages = self.engine.k_pages, self.engine.v_pages
+        pages = [int(p) for p in rec["table"][:rec["n_pages"]]]
+        k_block = np.asarray(k_pages)[:, pages]
+        v_block = np.asarray(v_pages)[:, pages]
+        header = {
+            "prompt": [int(t) for t in rec["prompt"]],
+            "generated": rec["generated"],
+            "input_tokens": [int(t) for t in rec["input_tokens"]],
+            "gen_tokens": rec["gen_tokens"],
+            "length": rec["length"],
+            "last_token": rec["last_token"],
+            "n_new": rec["n_new"],
+            "max_new": rec["max_new"],
+            "prompt_len": rec["prompt_len"],
+            "temperature": rec["temperature"],
+            "top_k": rec["top_k"],
+            "rng_state": rec["rng"].bit_generator.state,
+            "prio_name": rec["prio_name"],
+            "prio_rank": rec["prio_rank"],
+            "n_pages": rec["n_pages"],
+            "page_size": int(self.engine.page_size),
+        }
+        blob = pack_kv_blob(header, k_block, v_block)
+        self.engine.allocator.free(pages)
+        with self._cv:
+            self.stats["migrated_out"] += 1
+        _profiler.dispatch_count("gen_migrated_out")
+        _telemetry.registry().histogram("gen.migrate_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        return blob
+
+    def import_stream(self, blob):
+        """Validate + install a :meth:`export_stream` blob: allocate
+        pages from this server's :class:`PageAllocator` (leak-audited
+        like any admission), scatter the KV block into the page arrays
+        on the scheduler thread, and stage the stream for
+        ``submit_async(migrate_handle=...)`` attach.  Returns the local
+        handle.  Raises ``ValueError`` on checksum/version/shape
+        mismatch and :class:`Overloaded` when no pages are free — the
+        caller falls back to re-prefill either way."""
+        t0 = time.perf_counter()
+        header, k_block, v_block = unpack_kv_blob(blob)
+        eng = self.engine
+        n_pages = int(header["n_pages"])
+        shape = k_block.shape
+        want = np.asarray(eng.k_pages).shape
+        if (int(header["page_size"]) != eng.page_size
+                or shape[0] != want[0] or shape[1] != n_pages
+                or shape[2:] != want[2:]
+                or str(k_block.dtype) != str(np.asarray(eng.k_pages).dtype)):
+            raise ValueError(
+                "KV blob: incompatible geometry %s/%s page_size=%s for "
+                "engine %s page_size=%d"
+                % (shape, k_block.dtype, header["page_size"], want,
+                   eng.page_size))
+        if n_pages > eng.pages_per_seq \
+                or int(header["length"]) >= eng.max_seq:
+            raise ValueError("KV blob: %d page(s) / length %d exceed "
+                             "this engine's max_seq_len %d"
+                             % (n_pages, header["length"], eng.max_seq))
+
+        def install():
+            pages = eng.allocator.alloc(n_pages)
+            if pages is None:
+                raise Overloaded(
+                    "KV pages exhausted: migration needs %d page(s), "
+                    "%d free of %d" % (n_pages, eng.allocator.capacity
+                                       - eng.allocator.used,
+                                       eng.allocator.capacity))
+            jnp = eng._jnp
+            idx = jnp.asarray(np.asarray(pages, np.int32))
+            eng.k_pages = eng.k_pages.at[:, idx].set(jnp.asarray(k_block))
+            eng.v_pages = eng.v_pages.at[:, idx].set(jnp.asarray(v_block))
+            return pages
+
+        pages = self._run_on_scheduler(install)
+        table = np.zeros(eng.pages_per_seq, np.int32)
+        table[:n_pages] = pages
+        handle = self._new_handle()
+        rec = {
+            "prompt": np.asarray(header["prompt"], np.int32),
+            "generated": [int(t) for t in header["generated"]],
+            "input_tokens": np.asarray(header["input_tokens"], np.int32),
+            "gen_tokens": [int(t) for t in header["gen_tokens"]],
+            "length": int(header["length"]),
+            "last_token": int(header["last_token"]),
+            "n_new": int(header["n_new"]),
+            "max_new": int(header["max_new"]),
+            "prompt_len": int(header["prompt_len"]),
+            "temperature": float(header["temperature"]),
+            "top_k": int(header["top_k"]),
+            "rng": _restore_rng(header["rng_state"]),
+            "prio_name": str(header["prio_name"]),
+            "prio_rank": int(header["prio_rank"]),
+            "table": table,
+            "n_pages": n_pages,
+            "expires": self.clock.now() + self._park_timeout,
+        }
+        with self._cv:
+            self._imports[handle] = rec
+            self.stats["migrated_in"] += 1
+        _profiler.dispatch_count("gen_migrated_in")
+        _telemetry.registry().histogram("gen.migrate_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        return handle
+
+    def _attach_migrated(self, handle, prompt, prefix, max_new_tokens,
+                         deadline_ms, on_token):
+        """Attach a fresh future to an imported stream — the
+        ``migrate_handle`` half of :meth:`submit_async`.  Returns the
+        future, or None to fall back to the re-prefill path."""
+        delivered = [] if prefix is None else [int(t) for t in prefix]
+        now = self.clock.now()
+        deadline = now + (self.default_deadline if deadline_ms is None
+                          else float(deadline_ms) / 1e3)
+        with self._cv:
+            if (self._drain_flag.is_set()
+                    or self._state in (DRAINING, STOPPED)):
+                self.stats["rejected_draining"] += 1
+                raise Draining("generation server is draining")
+            rec = self._imports.get(handle)
+            if rec is None:
+                return None
+            generated = rec["generated"]
+            if (not np.array_equal(prompt, rec["prompt"])
+                    or len(delivered) > len(generated)
+                    or generated[:len(delivered)] != delivered):
+                # snapshot and journal disagree: drop the import, free
+                # its pages, re-prefill from the journal (never worse)
+                del self._imports[handle]
+                self.engine.allocator.free(
+                    [int(p) for p in rec["table"][:rec["n_pages"]]])
+                self.stats["migrate_expired"] += 1
+                return None
+            del self._imports[handle]
+            max_new = int(max_new_tokens or rec["max_new"])
+            bo = brownout()
+            max_new = max(bo.cap_max_new(max_new), len(generated))
+            fut = StreamingFuture({"tokens": rec["input_tokens"]}, rows=1,
+                                  deadline=deadline, t_admit=now,
+                                  on_token=on_token, clock=self.clock)
+            self.stats["admitted"] += 1
+            self.stats["migrate_attached"] += 1
+            _profiler.dispatch_count("requests_admitted")
+            _profiler.dispatch_count("gen_migrate_attached")
+            _telemetry.trace_begin("request", fut.trace_id, cat="gen",
+                                   args={"migrated": True,
+                                         "tokens": len(generated)})
+            seq = _Seq(fut, rec["table"], rec["n_pages"], rec["length"],
+                       rec["last_token"], max_new, rec["prompt_len"],
+                       (rec["temperature"], rec["top_k"], rec["rng"]),
+                       prio_name=rec["prio_name"],
+                       prio_rank=rec["prio_rank"],
+                       input_tokens=rec["input_tokens"])
+            seq.gen_tokens = list(rec["gen_tokens"])
+            seq.n_new = len(generated)
+            gap = generated[len(delivered):]
+        # catch-up emission outside the lock (token callbacks are user
+        # code) — tokens generated before the park that the client has
+        # not seen yet stream first, then decode continues from the KV
+        finished = seq.n_new >= seq.max_new
+        for t in gap:
+            if not fut._emit(int(t)):
+                finished = True
+                break
+        with self._cv:
+            if fut.done:                       # deadline/cancel raced
+                self.engine.allocator.free(
+                    [int(p) for p in seq.table[:seq.n_pages]])
+            elif finished:
+                self._active.append(seq)
+                self._retire_locked(seq)
+            else:
+                self._active.append(seq)
+                self._cv.notify_all()
+        return fut
+
+    def release_import(self, handle):
+        """Drop a staged (imported, unattached) migration record and free
+        its pages — the transfer-abort path (``/v1/migrate_abort``).
+        Returns True if the handle was live.  Idempotent."""
+        with self._cv:
+            rec = self._imports.pop(handle, None)
+            if rec is None:
+                return False
+            pages = [int(p) for p in rec["table"][:rec["n_pages"]]]
+            if pages:
+                self.engine.allocator.free(pages)
+            self.stats["migrate_expired"] += 1
+        _profiler.dispatch_count("gen_migrate_expired")
+        return True
+
+    def _sweep_migration_locked(self, now):
+        """TTL sweep: free the pages of parked/imported streams nobody
+        claimed (aborted transfer, dead gateway).  Caller holds the cv."""
+        for store in (self._parked, self._imports):
+            for h in [h for h, r in store.items() if now >= r["expires"]]:
+                rec = store.pop(h)
+                pages = [int(p) for p in rec["table"][:rec["n_pages"]]]
+                if pages:
+                    self.engine.allocator.free(pages)
+                self.stats["migrate_expired"] += 1
+                _profiler.dispatch_count("gen_migrate_expired")
+                _log("migration handle %s expired unclaimed — freed %d "
+                     "page(s)" % (h, len(pages)))
+
+    # -- defrag (self-migration) ---------------------------------------
+    def defrag(self, timeout=30.0):
+        """Compact fragmented page tables by migrating streams to this
+        server itself: gather a stream's pages, free them, re-allocate
+        (the free list hands out lowest ids first) and scatter back.
+        Returns how many streams moved.  Runs on the scheduler thread —
+        the only writer of the page arrays — between iterations, so the
+        decode loop never sees a half-moved table."""
+        return self._run_on_scheduler(self._defrag_pass, timeout=timeout)
+
+    def _defrag_pass(self):
+        eng = self.engine
+        jnp = eng._jnp
+        moved = 0
+        with self._cv:
+            seqs = [s for s in self._active
+                    if not s.fut.done and not s.preempted]
+        for s in seqs:
+            with self._cv:
+                if s not in self._active or s.fut.done or s.preempted:
+                    continue
+                old = [int(p) for p in s.table[:s.n_pages]]
+                low = eng.allocator.min_free()
+                if not old or low is None or low >= max(old):
+                    continue          # already as compact as it can get
+                # take the seq out of the batch while its pages move so
+                # a racing retire/park cannot free a stale table
+                self._active.remove(s)
+                self._limbo += 1
+            new = None
+            try:
+                idx_old = jnp.asarray(np.asarray(old, np.int32))
+                k_block = eng.k_pages[:, idx_old]
+                v_block = eng.v_pages[:, idx_old]
+                eng.allocator.free(old)
+                new = eng.allocator.alloc(len(old))
+                if new is None:       # cannot happen (just freed n)
+                    raise Overloaded("defrag lost its own pages")
+                idx_new = jnp.asarray(np.asarray(new, np.int32))
+                eng.k_pages = eng.k_pages.at[:, idx_new].set(k_block)
+                eng.v_pages = eng.v_pages.at[:, idx_new].set(v_block)
+                with self._cv:
+                    self._limbo -= 1
+                    s.table[:len(new)] = new
+                    if s.fut.done:    # settled while relocating: tidy up
+                        eng.allocator.free(new)
+                    else:
+                        self._active.append(s)
+                        moved += 1
+                        self.stats["defrag_moved"] += 1
+                    self._cv.notify_all()
+            except BaseException:
+                # relocation failed mid-flight: the stream's KV is in an
+                # unknown state — give it one typed outcome, return any
+                # pages it still holds, and keep the server healthy
+                with self._cv:
+                    self._limbo -= 1
+                    if new:
+                        eng.allocator.free(new)
+                    self._reject_locked(s.fut, Overloaded(
+                        "defrag relocation failed after %d token(s)"
+                        % s.n_new))
+                continue
+        if moved:
+            _profiler.dispatch_count("gen_defrag_moved", moved)
+            _telemetry.trace_instant("gen.defrag", cat="gen",
+                                     args={"moved": moved})
+        return moved
+
     # -- scheduler loop ------------------------------------------------
     def _loop(self):
         while True:
-            work = None
+            work = task = None
             with self._cv:
                 if self._stop:
                     break
@@ -608,7 +1134,12 @@ class GenerationServer:
             with self._cv:
                 if self._stop:
                     break
-                if (self._pending and not self._defer_prefill
+                if self._tasks:
+                    # engine-array work posted by another thread (import
+                    # scatter, defrag) — serviced here because this
+                    # thread is the page arrays' only writer
+                    task = self._tasks.popleft()
+                elif (self._pending and not self._defer_prefill
                         and len(self._active) < self.cfg.max_slots):
                     work = self._pending.popleft()
                     self._inflight = work.fut
@@ -618,10 +1149,25 @@ class GenerationServer:
                     continue
                 else:
                     self._defer_prefill = False
-            if work is not None:
+            if task is not None:
+                fn, box, evt = task
+                try:
+                    box["result"] = fn()           # device work, no lock
+                except BaseException as e:
+                    box["error"] = e
+                evt.set()
+            elif work is not None:
                 self._do_prefill(work)
             else:
                 self._decode_iteration()
+        # scheduler stopped: unblock every waiter still queued behind it
+        with self._cv:
+            leftovers = list(self._tasks)
+            self._tasks.clear()
+        for _fn, box, evt in leftovers:
+            box["error"] = Draining("scheduler stopped before the "
+                                    "migration task ran")
+            evt.set()
 
     def _chaos_pressure(self):
         """``page_pressure`` chaos: impound most of the KV free list for a
@@ -640,6 +1186,7 @@ class GenerationServer:
                 self._cv.notify_all()
 
     def _expire_locked(self, now):
+        self._sweep_migration_locked(now)
         for i in range(len(self._pending) - 1, -1, -1):
             fut = self._pending[i].fut
             if now >= fut.deadline:
@@ -883,11 +1430,12 @@ class GenerationServer:
                 _log("state -> DRAINING (%d queued, %d active)"
                      % (len(self._pending), len(self._active)))
             self._cv.notify_all()
-            while self._pending or self._active or self._inflight is not None:
+            while self._pending or self._active or self._limbo \
+                    or self._inflight is not None:
                 if deadline is not None and self.clock.now() >= deadline:
                     break
                 self._cv.wait(0.05)
-            drained = not (self._pending or self._active
+            drained = not (self._pending or self._active or self._limbo
                            or self._inflight is not None)
             if not drained:
                 aborted = 0
@@ -907,6 +1455,11 @@ class GenerationServer:
                         aborted += 1
                 _log("drain timeout: aborted %d unresolved request(s) "
                      "with typed Draining" % aborted)
+            # unexported parked / unclaimed imported streams die with the
+            # server: their KV pages return to the pool so the leakcheck
+            # ledger is quiescent at stop (an export racing this simply
+            # finds the handle gone and the gateway re-prefills)
+            self._sweep_migration_locked(float("inf"))
             self._stop = True
             self._cv.notify_all()
         self._thread.join(timeout=5.0)
@@ -923,6 +1476,8 @@ class GenerationServer:
                 "state": self._state,
                 "pending": len(self._pending),
                 "active": len(self._active),
+                "parked": len(self._parked),
+                "imports": len(self._imports),
                 "pages_used": alloc.used,
                 "pages_capacity": alloc.capacity,
                 "kv_page_util_peak": round(alloc.peak_util, 4),

@@ -23,6 +23,11 @@ kind           one live entry per ...
                ``StreamingFuture`` with no typed terminal outcome yet
 ``journal``    gateway stream journal alive for an in-flight
                ``/v1/generate`` request (``_forward_generate``)
+``migrations``  in-flight live-migration transfer buffer on the
+               receiving worker (``/v1/migrate_in`` chunk reassembly)
+               not yet installed, aborted, or expired — the KV pages a
+               transfer installs/frees are themselves audited under
+               ``kv_pages`` on both sides
 =============  ========================================================
 
 Armed with ``MXTPU_LEAKCHECK``:
@@ -62,7 +67,8 @@ __all__ = ["LeakError", "KINDS", "install", "install_from_env",
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _THIS_FILE = os.path.abspath(__file__)
 
-KINDS = ("kv_pages", "probe_slots", "mesh_slices", "futures", "journal")
+KINDS = ("kv_pages", "probe_slots", "mesh_slices", "futures", "journal",
+         "migrations")
 
 _MAX_FRAMES = 15        # creation-site walk depth
 _MAX_REPORTED = 20      # entries listed per kind in LeakError / snapshot

@@ -277,7 +277,7 @@ def load_trace(path):
 # outcome records + report
 # ---------------------------------------------------------------------------
 def _outcome_record(req, outcome, latency_ms=None, ttft_ms=None,
-                    tokens=0):
+                    tokens=0, migrated=0):
     return {"kind": "outcome", "i": int(req["i"]),
             "t_offered": float(req["t"]), "class": req.get("class"),
             "outcome": str(outcome),
@@ -285,7 +285,10 @@ def _outcome_record(req, outcome, latency_ms=None, ttft_ms=None,
             else round(float(latency_ms), 3),
             "ttft_ms": None if ttft_ms is None
             else round(float(ttft_ms), 3),
-            "tokens": int(tokens)}
+            "tokens": int(tokens),
+            # live KV handoffs this stream survived (the gateway's
+            # terminal line carries the count; 0 = never migrated)
+            "migrated": int(migrated)}
 
 
 def _pctl(vals, q):
@@ -381,6 +384,9 @@ class ReplayReport:
             out["%s_latency_p99_ms" % prefix] = round(_pctl(lats, 99), 3)
         if ttfts:
             out["%s_ttft_p99_ms" % prefix] = round(_pctl(ttfts, 99), 3)
+        migrated = sum(r.get("migrated", 0) for r in self.records)
+        if migrated:
+            out["%s_streams_migrated" % prefix] = migrated
         return out
 
     def write_jsonl(self, path, bucket_s=1.0):
@@ -519,7 +525,7 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
                 return _outcome_record(
                     req, "UNTYPED:HTTP%d" % resp.status,
                     (time.monotonic() - t0) * 1e3)
-            n_tok, ttft, outcome = 0, None, None
+            n_tok, ttft, outcome, migrated = 0, None, None, 0
             while True:
                 raw = resp.readline()
                 if not raw:
@@ -531,6 +537,7 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
                     break
                 if "done" in line:
                     outcome = "ok"
+                    migrated = int(line.get("migrated", 0))
                     break
                 if "token" in line:
                     if ttft is None:
@@ -538,7 +545,8 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
                     n_tok += 1
             return _outcome_record(req, outcome,
                                    (time.monotonic() - t0) * 1e3,
-                                   ttft_ms=ttft, tokens=n_tok)
+                                   ttft_ms=ttft, tokens=n_tok,
+                                   migrated=migrated)
         except OSError as e:
             return _outcome_record(req, "UNTYPED:%s" % type(e).__name__,
                                    (time.monotonic() - t0) * 1e3)

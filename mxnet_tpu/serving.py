@@ -161,6 +161,20 @@ class ReplicaLost(ServingError):
     docs/SHARDED_SERVING.md "Failure matrix")."""
 
 
+class StreamMigrated(ServingError):
+    """The generation stream was parked for live KV migration (drain,
+    rebalance — docs/SHARDED_SERVING.md "Live migration").  NOT a
+    client-visible outcome: the worker translates it into a ``migrate``
+    NDJSON line carrying :attr:`handle`, and the gateway either completes
+    the transfer (export -> import -> re-attach on the receiver, no
+    re-prefill) or falls back to the resume-from-journal path — so the
+    client still sees exactly one typed terminal outcome."""
+
+    def __init__(self, msg="", handle=None):
+        super().__init__(msg)
+        self.handle = handle
+
+
 # ---------------------------------------------------------------------------
 # brownout ladder
 # ---------------------------------------------------------------------------

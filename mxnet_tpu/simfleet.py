@@ -182,7 +182,7 @@ class SimServer:
         self._seq = 0
         self.stats = {"admitted": 0, "shed": 0, "shed_brownout": 0,
                       "ok": 0, "deadline_exceeded": 0, "replica_lost": 0,
-                      "unavailable": 0}
+                      "unavailable": 0, "migrated": 0}
         for _ in range(int(initial_replicas)):
             self.add_replica(instant=instant_start)
 
@@ -242,16 +242,19 @@ class SimFleet:
     (``incidents``), and the supervisor/server end states.  Chaos
     storms arm the real plan: ``chaos_spec`` uses the production kinds
     — ``gateway_partition@N`` fails the gateway's Nth registry refresh
-    (see :func:`partition_window`) and ``worker_kill@N`` hard-kills a
+    (see :func:`partition_window`), ``worker_kill@N`` hard-kills a
     replica on the Nth sim tick, exactly like the WorkerSupervisor's
-    kill hook."""
+    kill hook, and ``drain_migrate@N`` rc-76-drains the busiest replica
+    with the :attr:`migrate_on_drain` policy deciding whether its
+    streams live-migrate or die (the drain-storm A/B)."""
 
     def __init__(self, trace, initial_replicas=4, max_replicas=None,
                  slots=None, queue_cap=None, costs=None, seed=0,
                  tick_s=None, heartbeat_s=0.5, interval_s=0.5,
                  refresh_s=0.5, suspect_s=1.0, retries=2,
                  autoscale=True, shed_up=0.05, cooldown_s=2.0,
-                 breach_ticks=2, idle_down_s=30.0, service="sim"):
+                 breach_ticks=2, idle_down_s=30.0, service="sim",
+                 migrate_on_drain=True, migrate_cost_s=0.05):
         self.trace = sorted(trace, key=lambda r: (r["t"], r["i"]))
         self.clock = _clockmod.SimClock()
         self.rng = np.random.default_rng(int(seed))
@@ -285,8 +288,16 @@ class SimFleet:
                                clock=self.clock)
         self.records = [None] * len(self.trace)
         self.incidents = []
+        # drain policy sweep (docs/SIMULATION.md): with migrate_on_drain
+        # a drained replica's in-flight streams transfer to siblings
+        # keeping their remaining service time (+ a small migrate cost);
+        # without it the drain degrades to the kill-and-resume path so
+        # the same drain-storm trace A/Bs the two policies
+        self.migrate_on_drain = bool(migrate_on_drain)
+        self.migrate_cost_s = float(migrate_cost_s)
         self._settled = 0
         self._kill_seq = 0
+        self._drain_seq = 0
         self._beat_seq = 0
         self._next_beat = 0.0
         self._next_sup = 0.0
@@ -415,6 +426,64 @@ class SimFleet:
         _log("t=%.2fs killed replica %d (%d in-flight lost, %d "
              "requeued)" % (now, victim.rid, lost, requeue))
 
+    def _drain_replica(self, now):
+        """rc-76 drain of the busiest ready replica (chaos
+        ``drain_migrate``).  With ``migrate_on_drain`` every in-flight
+        stream live-migrates to a ready sibling: its KV state moves, so
+        it keeps its remaining service time and only pays the small
+        transfer cost — no ReplicaLost, no re-prefill.  Without it (or
+        with no sibling) the drain degrades to the kill path, so one
+        trace sweeps both policies."""
+        if not self.migrate_on_drain:
+            self._kill_replica(now)
+            return
+        ready = self.server.ready_replicas(now)
+        if not ready:
+            return
+        victim = max(ready, key=lambda r: (r.load(), r.rid))
+        siblings = [r for r in ready if r.rid != victim.rid]
+        if not siblings:
+            # nowhere to migrate to: same outcome as a kill
+            self._kill_replica(now)
+            return
+        victim.state = "DEAD"
+        gw_rid = str(victim.rid)
+        self.gateway._note_suspect(gw_rid)
+        try:
+            self.registry.withdraw(victim.rid)
+        except Exception:
+            pass
+        moved = 0
+        for done_at, deadline_abs, req in victim.inflight:
+            # live migration: remaining decode continues on the least-
+            # loaded sibling — the transferred stream keeps its decode
+            # slot (brief oversubscription, like the real receiver
+            # attaching an imported stream ahead of the admission gate)
+            target = min(siblings, key=lambda r: (r.load(), r.rid))
+            self.gateway._track(gw_rid, -1)
+            self.gateway._track(str(target.rid), 1)
+            target.inflight.append(
+                (done_at + self.migrate_cost_s, deadline_abs, req))
+            self.server.stats["migrated"] += 1
+            moved += 1
+        victim.inflight = []
+        queued = list(victim.queue)
+        victim.queue.clear()
+        for req, _, _ in queued:
+            # not started yet: plain idempotent re-admission
+            self.gateway._track(gw_rid, -1)
+            self.server.stats["admitted"] -= 1
+            self._route(req, now)
+        self.incidents.append({"kind": "drain_migrate",
+                               "rid": victim.rid,
+                               "sim_t": round(now, 3),
+                               "migrated": moved,
+                               "requeued": len(queued)})
+        _debug.write_bundle("sim_drain_migrate",
+                            extra=self.incidents[-1], force=True)
+        _log("t=%.2fs drained replica %d (%d stream(s) migrated, %d "
+             "requeued)" % (now, victim.rid, moved, len(queued)))
+
     # -- the stepping loop ---------------------------------------------
     def _heartbeat(self, now):
         beat = self._beat_seq
@@ -519,6 +588,12 @@ class SimFleet:
             if _chaos.worker_kill(self._kill_seq):
                 self._kill_replica(now)
             self._kill_seq += 1
+            streams = sum(len(r.inflight)
+                          for r in self.server.replicas.values()
+                          if r.state == "SERVING")
+            if _chaos.drain_migrate(self._drain_seq, streams):
+                self._drain_replica(now)
+            self._drain_seq += 1
             if now >= self._next_beat:
                 self._heartbeat(now)
                 self._next_beat = now + self.heartbeat_s

@@ -81,6 +81,58 @@ class TestPageAllocator:
         assert telemetry.registry().gauge("gen.kv_page_util").value \
             == pytest.approx(0.5)
 
+    def test_impound_frac_clamps_above_one(self):
+        """frac > 1 impounds the whole free list, never over-counts."""
+        a = PageAllocator(8)             # 7 usable
+        assert a.impound(1.7) == 7
+        assert a.held == 7 and a.used == 7
+        assert a.alloc(1) is None
+        assert a.release() == 7
+        assert a.held == 0 and a.used == 0
+
+    def test_impound_negative_frac_is_noop(self):
+        a = PageAllocator(8)
+        assert a.impound(-0.5) == 0
+        assert a.held == 0 and a.used == 0
+
+    def test_impound_empty_free_list(self):
+        """Impounding when every page is allocated takes nothing."""
+        a = PageAllocator(8)
+        got = a.alloc(7)
+        assert a.impound(1.0) == 0 and a.held == 0
+        a.free(got)
+        assert a.used == 0
+
+    def test_release_is_idempotent(self):
+        a = PageAllocator(11)
+        a.impound(0.5)
+        first = a.release()
+        assert first == 5
+        assert a.release() == 0          # second release: empty side-pool
+        assert a.held == 0 and a.used == 0
+
+    def test_impound_accumulates_across_calls(self):
+        a = PageAllocator(11)            # 10 usable
+        n1 = a.impound(0.5)              # 5
+        n2 = a.impound(0.5)              # 2 of the remaining 5
+        assert (n1, n2) == (5, 2)
+        assert a.held == 7 and a.used == 7
+        assert a.release() == 7 and a.used == 0
+
+    def test_min_free_tracks_lowest_page(self):
+        """min_free() is the defrag frontier: the lowest free page id,
+        None when the free list is exhausted."""
+        a = PageAllocator(8)
+        assert a.min_free() == 1
+        got = a.alloc(3)                 # pops lowest-first: 1, 2, 3
+        assert a.min_free() == 4
+        a.free([got[0]])                 # return page 1
+        assert a.min_free() == 1
+        a.free(got[1:])
+        a.alloc(7)
+        assert a.min_free() is None
+        assert a.impound(1.0) == 0       # nothing free to impound either
+
 
 # ---------------------------------------------------------------------------
 # decode parity vs the full-forward oracle

@@ -1,0 +1,694 @@
+"""Live KV-state migration tests (docs/SHARDED_SERVING.md "Live
+migration", ISSUE 17).
+
+Layers under test, innermost first:
+
+* the versioned, CRC-checksummed ``MXKV`` wire blob
+  (``pack_kv_blob``/``unpack_kv_blob``);
+* the ``GenerationServer`` park/export/import/attach surface, asserted
+  BITWISE against an unmigrated reference stream (greedy AND
+  seeded-sampled — the rng ships inside the blob);
+* KV defrag (a stream migrated to itself) with bitwise continuation;
+* the ``FleetWorker`` chunked ``/v1/migrate_in`` receiver (idempotent
+  replay, abort, leak-audited buffers);
+* the ``FleetRebalancer`` median/band/cooldown policy (unit, fake
+  registry);
+* the full HTTP path — registry + two workers + gateway — including the
+  ``migrate_interrupt`` chaos kind degrading a severed transfer to the
+  journal-resume path;
+* the ``SimFleet`` drain-storm policy A/B (migrate-on-drain vs
+  kill-and-resume on the same trace);
+* the 2-process rc-76 drain acceptance (slow): SIGTERM a real worker
+  mid-stream, zero ``ReplicaLost``, zero re-prefills.
+"""
+import base64
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+import jax
+
+from conftest import subprocess_env
+from mxnet_tpu import chaos, leakcheck, loadgen, profiler
+from mxnet_tpu.elastic import PREEMPTED_EXIT_CODE
+from mxnet_tpu.fleet import FleetRebalancer, ServiceRegistry
+from mxnet_tpu.fleet_worker import FleetWorker
+from mxnet_tpu.gateway import Gateway
+from mxnet_tpu.generation import (KV_BLOB_MAGIC, KV_BLOB_VERSION,
+                                  GenerationConfig, GenerationServer,
+                                  pack_kv_blob, unpack_kv_blob)
+from mxnet_tpu.models import TransformerLM, TransformerConfig
+from mxnet_tpu.serving import StreamMigrated
+from mxnet_tpu.simfleet import SimFleet
+
+VOCAB = 97
+
+
+def _model(max_len=64):
+    cfg = TransformerConfig(vocab_size=VOCAB, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_len=max_len,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _prompts(ns, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=n).astype(np.int32) for n in ns]
+
+
+def _gcfg(**kw):
+    # long streams: a 48-token budget keeps the stream alive while the
+    # test parks it mid-decode (short demo streams race the park)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_pages", 64)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_new_tokens", 48)
+    return GenerationConfig(**kw)
+
+
+def _wait(cond, timeout=30.0, interval=0.005, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("timed out waiting for " + msg)
+        time.sleep(interval)
+
+
+def _post(addr, path, body, timeout=30):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _stream(addr, body, timeout=300):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", "/v1/generate", body=json.dumps(body).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    lines = []
+    while True:
+        raw = resp.readline()
+        if not raw:
+            break
+        lines.append(json.loads(raw))
+        if "done" in lines[-1] or "error" in lines[-1]:
+            break
+    conn.close()
+    return lines
+
+
+def _toks(lines):
+    return [l["token"] for l in lines if "token" in l]
+
+
+# ---------------------------------------------------------------------------
+# the MXKV wire blob
+# ---------------------------------------------------------------------------
+class TestKVBlob:
+    def _sample(self):
+        header = {"length": 12, "last_token": 4, "n_pages": 2,
+                  "page_size": 8, "rng_state": {"state": {"key": [1, 2]}},
+                  "gen_tokens": [5, 6, 7]}
+        rng = np.random.RandomState(0)
+        k = rng.randn(2, 2, 8, 4, 16).astype(np.float32)
+        v = rng.randn(2, 2, 8, 4, 16).astype(np.float32)
+        return header, k, v
+
+    def test_roundtrip_bitwise(self):
+        header, k, v = self._sample()
+        blob = pack_kv_blob(header, k, v)
+        assert blob[:4] == KV_BLOB_MAGIC
+        h2, k2, v2 = unpack_kv_blob(blob)
+        # pack() stamps kv_dtype/kv_shape; everything else round-trips
+        # JSON-normalized
+        want = json.loads(json.dumps(header))
+        assert {k: h2[k] for k in want} == want
+        assert h2["kv_dtype"] == "float32"
+        assert h2["kv_shape"] == [2, 2, 8, 4, 16]
+        assert k2.dtype == k.dtype and v2.dtype == v.dtype
+        assert np.array_equal(k2, k) and np.array_equal(v2, v)
+
+    def test_crc_corruption_rejected(self):
+        header, k, v = self._sample()
+        blob = bytearray(pack_kv_blob(header, k, v))
+        blob[len(blob) // 2] ^= 0xFF       # flip a payload byte
+        with pytest.raises(ValueError):
+            unpack_kv_blob(bytes(blob))
+
+    def test_bad_magic_rejected(self):
+        header, k, v = self._sample()
+        blob = pack_kv_blob(header, k, v)
+        with pytest.raises(ValueError):
+            unpack_kv_blob(b"XXXX" + blob[4:])
+
+    def test_version_mismatch_rejected(self):
+        import struct
+        header, k, v = self._sample()
+        blob = pack_kv_blob(header, k, v)
+        bumped = blob[:4] + struct.pack(">H", KV_BLOB_VERSION + 1) \
+            + blob[6:]
+        with pytest.raises(ValueError):
+            unpack_kv_blob(bumped)
+
+    def test_truncated_rejected(self):
+        header, k, v = self._sample()
+        blob = pack_kv_blob(header, k, v)
+        for cut in (0, 3, 9, len(blob) // 2, len(blob) - 1):
+            with pytest.raises(ValueError):
+                unpack_kv_blob(blob[:cut])
+
+
+# ---------------------------------------------------------------------------
+# chaos kinds: armed / fire-once / inert
+# ---------------------------------------------------------------------------
+class TestMigrationChaosKinds:
+    def test_migrate_interrupt_gate(self):
+        assert chaos.migrate_interrupt(0) is False      # inert: no plan
+        with chaos.inject("migrate_interrupt@1"):
+            assert chaos.migrate_interrupt(0) is False
+            assert chaos.migrate_interrupt(1) is True
+            assert chaos.migrate_interrupt(1) is False  # fire-once
+        assert chaos.migrate_interrupt(1) is False
+
+    def test_drain_migrate_requires_live_stream(self):
+        assert chaos.drain_migrate(0, 5) is False       # inert: no plan
+        with chaos.inject("drain_migrate@0"):
+            # streams < 1: the drain opportunity is NOT consumed — a
+            # drain with nothing to migrate proves nothing
+            assert chaos.drain_migrate(0, 0) is False
+            assert chaos.drain_migrate(0, 3) is True
+            assert chaos.drain_migrate(0, 3) is False   # fire-once
+
+
+# ---------------------------------------------------------------------------
+# GenerationServer park / export / import / attach (in-process, no HTTP)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def pair():
+    model, params = _model()
+    a = GenerationServer(model, params, _gcfg())
+    b = GenerationServer(model, params, _gcfg())
+    yield a, b
+    a.drain(timeout=10)
+    b.drain(timeout=10)
+
+
+class TestDirectMigration:
+    def _migrate(self, a, b, prompt, n_before=3, **samp):
+        """Run prompt on ``a``, park after ``n_before`` tokens, carry
+        the blob to ``b`` and attach; returns (delivered, continuation).
+        """
+        fut = a.submit_async(prompt, **samp)
+        _wait(lambda: len(fut.stream_tokens) >= n_before,
+              msg="%d token(s) before park" % n_before)
+        handles = a.park_streams(1)
+        assert len(handles) == 1
+        with pytest.raises(StreamMigrated) as ei:
+            fut.result(timeout=10)
+        assert ei.value.handle == handles[0]
+        delivered = fut.stream_tokens
+        blob = a.export_stream(handles[0])
+        p0 = profiler.dispatch_stats().get("gen_prefills", 0)
+        h2 = b.import_stream(blob)
+        fut2 = b.submit_async(prompt, resume_from=delivered,
+                              migrate_handle=h2, **samp)
+        cont = list(fut2.tokens(timeout=60))
+        # the import+attach did ZERO prefills — that's the whole point
+        assert profiler.dispatch_stats().get("gen_prefills", 0) == p0
+        return delivered, cont
+
+    def test_forced_migration_bitwise_greedy(self, pair):
+        a, b = pair
+        prompt = _prompts([8])[0]
+        ref = a.submit_async(prompt, temperature=0.0).result(timeout=60)
+        delivered, cont = self._migrate(a, b, prompt, temperature=0.0)
+        assert len(delivered) >= 3 and cont
+        assert delivered + cont == ref          # bitwise across the move
+        assert a.stats["parked"] >= 1 and a.stats["migrated_out"] >= 1
+        assert b.stats["migrated_in"] >= 1
+        assert b.stats["migrate_attached"] >= 1
+
+    def test_forced_migration_bitwise_sampled(self, pair):
+        """The live numpy rng ships inside the blob: a seeded SAMPLED
+        stream continues bitwise on the receiver — no rng fast-forward,
+        no replay."""
+        a, b = pair
+        prompt = _prompts([8], seed=21)[0]
+        samp = dict(temperature=0.9, top_k=12, seed=123)
+        ref = a.submit_async(prompt, **samp).result(timeout=60)
+        delivered, cont = self._migrate(a, b, prompt, **samp)
+        assert delivered + cont == ref
+
+    def test_unknown_handle_falls_back_to_resume(self, pair):
+        """An expired/bogus handle is NEVER fatal: submit_async falls
+        through to the re-prefill resume path and the stream still
+        completes bitwise."""
+        a, b = pair
+        prompt = _prompts([6], seed=11)[0]
+        ref = a.submit_async(prompt, temperature=0.0).result(timeout=60)
+        delivered = ref[:3]
+        resumed = b.stats["resumed"]
+        fut = b.submit_async(prompt, resume_from=delivered,
+                             migrate_handle="kvm-deadbeef",
+                             temperature=0.0)
+        cont = list(fut.tokens(timeout=60))
+        assert delivered + cont == ref
+        assert b.stats["resumed"] == resumed + 1
+
+    def test_corrupt_blob_rejected_then_resume(self, pair):
+        """A bit-flipped blob fails the CRC on import; the caller falls
+        back to re-prefill from the journaled prefix — the stream is
+        never worse off than plain failover."""
+        a, b = pair
+        prompt = _prompts([8], seed=13)[0]
+        ref = a.submit_async(prompt, temperature=0.0).result(timeout=60)
+        fut = a.submit_async(prompt, temperature=0.0)
+        _wait(lambda: len(fut.stream_tokens) >= 2, msg="2 tokens")
+        [h] = a.park_streams(1)
+        with pytest.raises(StreamMigrated):
+            fut.result(timeout=10)
+        delivered = fut.stream_tokens
+        blob = bytearray(a.export_stream(h))
+        blob[len(blob) - 9] ^= 0x01
+        used = b.engine.allocator.used
+        with pytest.raises(ValueError):
+            b.import_stream(bytes(blob))
+        assert b.engine.allocator.used == used  # nothing staged
+        fut2 = b.submit_async(prompt, resume_from=delivered,
+                              temperature=0.0)
+        assert delivered + list(fut2.tokens(timeout=60)) == ref
+
+    def test_export_unknown_handle(self, pair):
+        a, _ = pair
+        with pytest.raises(KeyError):
+            a.export_stream("kvm-0000000000000000")
+
+    def test_release_import_frees_pages(self, pair):
+        """The transfer-abort contract: a staged import's pages go back
+        to the allocator exactly once (idempotent release)."""
+        a, b = pair
+        prompt = _prompts([8], seed=3)[0]
+        fut = a.submit_async(prompt, temperature=0.0)
+        _wait(lambda: len(fut.stream_tokens) >= 2, msg="2 tokens")
+        [h] = a.park_streams(1)
+        with pytest.raises(StreamMigrated):
+            fut.result(timeout=10)
+        blob = a.export_stream(h)
+        used0 = b.engine.allocator.used
+        h2 = b.import_stream(blob)
+        assert b.engine.allocator.used > used0
+        assert b.release_import(h2) is True
+        assert b.engine.allocator.used == used0
+        assert b.release_import(h2) is False    # idempotent
+
+    def test_pages_quiescent_after_full_cycle(self, pair):
+        """Every page allocated for migration is back in the free list
+        once the streams settle — both sides."""
+        a, b = pair
+        _wait(lambda: a.snapshot()["active"] == 0
+              and b.snapshot()["active"] == 0, msg="streams settled")
+        assert a.engine.allocator.used == 0
+        assert b.engine.allocator.used == 0
+        assert a.snapshot()["parked"] == 0
+        assert b.snapshot()["imports"] == 0
+
+
+def test_defrag_relocates_and_continues_bitwise():
+    """In-worker defrag — a stream migrated to itself: after a sibling
+    stream frees low pages, defrag() moves the survivor's pages down
+    and the token stream continues bitwise."""
+    model, params = _model()
+    srv = GenerationServer(model, params, _gcfg())
+    try:
+        p_long = _prompts([8], seed=5)[0]
+        ref = srv.submit_async(p_long, temperature=0.0).result(timeout=60)
+        # throttle decode from on_token (scheduler-thread callback) so
+        # the defrag lands while the stream is mid-flight
+        gate = threading.Event()
+        fut_s = srv.submit_async(_prompts([8], seed=6)[0],
+                                 max_new_tokens=4, temperature=0.0)
+        fut_l = srv.submit_async(
+            p_long, temperature=0.0,
+            on_token=lambda t: gate.wait(0.01))
+        fut_s.result(timeout=60)        # frees the low pages
+        _wait(lambda: len(fut_l.stream_tokens) >= 6, msg="6 tokens")
+        moved = srv.defrag()
+        gate.set()                      # full speed again
+        cont = fut_l.result(timeout=60)
+        assert cont == ref              # bitwise across the relocation
+        assert moved >= 1
+        assert srv.stats["defrag_moved"] >= 1
+        _wait(lambda: srv.snapshot()["active"] == 0, msg="settled")
+        assert srv.engine.allocator.used == 0
+    finally:
+        srv.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# FleetRebalancer policy unit (fake registry, no HTTP)
+# ---------------------------------------------------------------------------
+class _FakeRegistry:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def view(self, reap=True):
+        return types.SimpleNamespace(replicas=self.replicas)
+
+
+class TestRebalancer:
+    def _reg(self, hot=9):
+        return _FakeRegistry({
+            "w0": {"addr": "h:1", "kind": "generate",
+                   "state": "SERVING", "inflight": hot},
+            "w1": {"addr": "h:2", "kind": "generate",
+                   "state": "SERVING", "inflight": 1},
+            "w2": {"addr": "h:3", "kind": "generate",
+                   "state": "SERVING", "inflight": 1},
+            # ignored: wrong kind / not serving
+            "p0": {"addr": "h:4", "kind": "predict", "inflight": 99},
+            "w3": {"addr": "h:5", "kind": "generate",
+                   "state": "DRAINING", "inflight": 50},
+        })
+
+    def test_parks_only_over_band(self, monkeypatch):
+        calls = []
+
+        def fake_post(addr, path, obj, timeout=5.0):
+            calls.append((addr, path, dict(obj)))
+            return 200, {"handles": ["h%d" % len(calls)]}
+
+        monkeypatch.setattr(FleetRebalancer, "_post_json",
+                            staticmethod(fake_post))
+        rb = FleetRebalancer(registry=self._reg(), band=2,
+                             cooldown_s=60, max_moves=2, start=False)
+        # median inflight over serving generate workers = 1; only w0
+        # (9 > 1 + 2) is over the hysteresis band
+        assert rb.tick() == 1
+        assert calls == [("h:1", "/v1/migrate_out", {"park": 2})]
+        assert rb.rebalances == 1 and rb.streams_parked == 1
+        # cooldown: the same worker rests before the next park
+        assert rb.tick() == 0 and len(calls) == 1
+
+    def test_balanced_fleet_is_left_alone(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            FleetRebalancer, "_post_json",
+            staticmethod(lambda *a, **k: calls.append(a) or (200, {})))
+        rb = FleetRebalancer(registry=self._reg(hot=2), band=2,
+                             start=False)
+        assert rb.tick() == 0 and not calls
+
+    def test_single_worker_is_never_parked(self, monkeypatch):
+        reg = _FakeRegistry({"w0": {"addr": "h:1", "kind": "generate",
+                                    "state": "SERVING", "inflight": 50}})
+        monkeypatch.setattr(
+            FleetRebalancer, "_post_json",
+            staticmethod(lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("nowhere to migrate to"))))
+        rb = FleetRebalancer(registry=reg, band=0, start=False)
+        assert rb.tick() == 0
+
+    def test_post_failure_counts_error(self, monkeypatch):
+        def boom(addr, path, obj, timeout=5.0):
+            raise OSError("connection refused")
+
+        monkeypatch.setattr(FleetRebalancer, "_post_json",
+                            staticmethod(boom))
+        rb = FleetRebalancer(registry=self._reg(), band=2, start=False)
+        assert rb.tick() == 0 and rb.errors == 1
+
+
+# ---------------------------------------------------------------------------
+# the full HTTP path: registry + 2 workers + gateway
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def stack():
+    model, params = _model()
+    reg = ServiceRegistry(service="mig", ttl_s=2.0)
+    w0 = FleetWorker(GenerationServer(model, params, _gcfg()), "w0",
+                     registry=reg, heartbeat_s=0.05).start()
+    w1 = FleetWorker(GenerationServer(model, params, _gcfg()), "w1",
+                     registry=reg, heartbeat_s=0.05).start()
+    gw = Gateway(registry=reg, refresh_s=0.05, suspect_s=0.2)
+    _wait(lambda: gw._view is not None
+          and {"w0", "w1"} <= set(gw._view.replicas),
+          msg="gateway sees both workers")
+    yield reg, w0, w1, gw
+    gw.stop()
+    w0.shutdown(drain_timeout=30)
+    w1.shutdown(drain_timeout=30)
+    reg.close()
+
+
+def _park_mid_stream(gw, workers, body, tries=5):
+    """Start a gateway stream and park it on whichever worker holds it;
+    returns (lines, sender) — retries with a fresh session in the
+    (rare) case the stream finishes before the park lands."""
+    for i in range(tries):
+        req = dict(body, session="%s-%d" % (body["session"], i))
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.update(lines=_stream(gw.addr, req)))
+        t.start()
+
+        def active():
+            for w in workers:
+                snap = w.server.snapshot()
+                if snap.get("active") or snap.get("pending"):
+                    return w
+            return None
+
+        _wait(lambda: active() is not None or not t.is_alive(),
+              msg="stream active somewhere")
+        sender = active()
+        parked = {"handles": []}
+        if sender is not None:
+            time.sleep(0.02)            # a few tokens first
+            _, parked = _post(sender.addr, "/v1/migrate_out",
+                              {"park": 1})
+        t.join(timeout=60)
+        assert not t.is_alive(), "client stream hung"
+        if parked.get("handles"):
+            return got["lines"], sender
+    raise AssertionError("could not park a stream in %d tries" % tries)
+
+
+class TestGatewayMigration:
+    def test_http_migrate_bitwise_no_client_gap(self, stack):
+        reg, w0, w1, gw = stack
+        prompt = [int(t) for t in _prompts([8])[0]]
+        body = {"prompt": prompt, "max_new_tokens": 48, "seed": 7}
+        base = _stream(gw.addr, body)
+        assert base[-1].get("done"), base[-1]
+        base_toks = _toks(base)
+
+        migrated0 = gw.streams_migrated
+        lines, sender = _park_mid_stream(
+            gw, (w0, w1), dict(body, session="s-mig"))
+        term = lines[-1]
+        assert term.get("done"), term
+        # bitwise-identical stream, no client-visible gap, no migrate
+        # line ever written to the client
+        assert _toks(lines) == base_toks
+        assert not any("migrate" in l for l in lines)
+        assert term.get("migrated") == 1
+        assert "resumed" not in term            # migration is NOT a loss
+        assert term["tokens"] == len(base_toks)
+        assert gw.streams_migrated == migrated0 + 1
+        assert gw.streams_resumed == 0 and gw.streams_lost == 0
+        # the terminal rid is the receiver; the sticky session moved
+        recv = term["rid"]
+        assert recv != sender.rid
+        receiver = w0 if recv == "w0" else w1
+        assert receiver.migrations_in >= 1
+        assert sender.streams_parked >= 1
+        with gw._lock:
+            assert any(v == recv for v in gw._sessions.values())
+
+    def test_migrate_interrupt_degrades_to_resume(self, stack):
+        """Sever the transfer between chunks (chaos migrate_interrupt):
+        the receiver's partial buffer is aborted and the stream degrades
+        to the journal-resume path — still exactly one terminal, still
+        bitwise."""
+        reg, w0, w1, gw = stack
+        prompt = [int(t) for t in _prompts([8], seed=17)[0]]
+        body = {"prompt": prompt, "max_new_tokens": 48, "seed": 9}
+        base_toks = _toks(_stream(gw.addr, body))
+
+        fb0, n = gw.migrate_fallbacks, gw._migrate_seq
+        with chaos.inject("migrate_interrupt@%d" % n):
+            lines, sender = _park_mid_stream(
+                gw, (w0, w1), dict(body, session="s-int"))
+        term = lines[-1]
+        assert term.get("done"), term
+        assert _toks(lines) == base_toks        # exactly-once, bitwise
+        assert gw.migrate_fallbacks == fb0 + 1
+        assert term.get("resumed") == 1 and "migrated" not in term
+        assert term["tokens"] == len(base_toks)
+        # the severed transfer left nothing behind on either receiver
+        for w in (w0, w1):
+            with w._migr_lock:
+                assert not w._migr_buf
+        leakcheck.assert_quiescent(kinds=("migrations",))
+
+    def test_migrate_in_chunked_idempotent_replay(self, stack):
+        reg, w0, w1, gw = stack
+        prompt = _prompts([8], seed=23)[0]
+        fut = w0.server.submit_async(prompt, temperature=0.0)
+        _wait(lambda: len(fut.stream_tokens) >= 2, msg="2 tokens")
+        [h] = w0.server.park_streams(1)
+        with pytest.raises(StreamMigrated):
+            fut.result(timeout=10)
+        blob = w0.server.export_stream(h)
+        half = len(blob) // 2
+        chunks = [blob[:half], blob[half:]]
+
+        def push(seq):
+            return w1._handle_migrate_in({
+                "key": "idem-chunk-1", "seq": seq, "total": 2,
+                "data": base64.b64encode(chunks[seq]).decode()})
+
+        st, r1 = push(0)
+        assert (st, r1.get("have")) == (200, 1)
+        st, r2 = push(1)
+        assert st == 200 and "handle" in r2
+        st, r3 = push(1)                        # replayed final chunk
+        assert st == 200 and r3["handle"] == r2["handle"]
+        used = w1.server.engine.allocator.used
+        st, r4 = w1._handle_migrate_abort({"key": "idem-chunk-1"})
+        assert st == 200 and r4["aborted"] is True
+        assert w1.server.engine.allocator.used < used   # pages freed
+        st, r5 = w1._handle_migrate_abort({"key": "idem-chunk-1"})
+        assert st == 200 and r5["aborted"] is False     # idempotent
+        st, bad = w1._handle_migrate_in(
+            {"key": "k", "seq": 5, "total": 2, "data": ""})
+        assert st == 400 and bad["error"] == "BadRequest"
+        leakcheck.assert_quiescent(kinds=("migrations",))
+
+
+# ---------------------------------------------------------------------------
+# SimFleet drain-storm policy A/B
+# ---------------------------------------------------------------------------
+def test_sim_drain_storm_migrate_beats_kill():
+    """The acceptance A/B: the same trace + drain storm under both
+    policies.  migrate-on-drain keeps every admitted stream alive (zero
+    ReplicaLost) and clears more goodput than kill-and-resume."""
+    spec = loadgen.TraceSpec(
+        seed=5, segments=[{"duration_s": 10.0, "rate_rps": 40.0}],
+        deadline_classes=[{"name": "batch", "deadline_ms": 4000.0,
+                           "weight": 1.0}])
+    trace = loadgen.generate_trace(spec)
+    storm = "drain_migrate@30,drain_migrate@60,drain_migrate@90"
+
+    def run(policy):
+        fl = SimFleet(trace, initial_replicas=4, autoscale=False,
+                      seed=1, migrate_on_drain=policy)
+        return fl.run(chaos_spec=storm)
+
+    mig, kill = run(True), run(False)
+    assert mig["outcomes"].get("ReplicaLost", 0) == 0
+    assert kill["outcomes"].get("ReplicaLost", 0) > 0
+    assert mig["outcomes"]["ok"] > kill["outcomes"]["ok"]
+    assert mig["server"]["migrated"] >= 1
+    kinds = [i["kind"] for i in mig["incidents"]]
+    assert kinds.count("drain_migrate") == 3
+
+
+# ---------------------------------------------------------------------------
+# 2-process rc-76 drain acceptance (heavy: not tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_rc76_drain_migrates_streams_zero_loss():
+    """ISSUE 17 acceptance: SIGTERM (planned drain, rc-76) a real
+    generation worker mid-stream.  The stream live-migrates to the
+    sibling — zero ReplicaLost, zero re-prefills (streams_resumed == 0)
+    — and is bitwise identical to an undrained run."""
+    reg = ServiceRegistry(service="accept", ttl_s=1.0)
+    builder = "mxnet_tpu.fleet_worker:demo_generation"
+    env = subprocess_env()
+    procs = {}
+    for rid in ("g0", "g1"):
+        argv = [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+                "--registry", reg.addr, "--service", "accept",
+                "--rid", rid, "--heartbeat-s", "0.1",
+                "--builder", builder]
+        procs[rid] = subprocess.Popen(argv, env=env)
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    try:
+        _wait(lambda: {"g0", "g1"}
+              <= set(reg.view(reap=False).replicas), timeout=300,
+              msg="both workers registered")
+        _wait(lambda: gw._view is not None
+              and len(gw._view.replicas) == 2, msg="gateway view")
+        req = {"prompt": [1, 2, 3], "max_new_tokens": 16,
+               "temperature": 0.0, "session": "s1"}
+        # warm the decode path on both sides (first stream compiles)
+        warm = _stream(gw.addr, {**req, "max_new_tokens": 4})
+        assert warm[-1].get("done") is True
+        first_rid = warm[-1]["rid"]
+        other = _stream(gw.addr, {**req, "session": "s2",
+                                  "max_new_tokens": 4})
+        assert other[-1].get("done") is True
+
+        ref = _stream(gw.addr, req)
+        assert ref[-1].get("done") is True
+        ref_tokens = _toks(ref)
+        assert len(ref_tokens) >= 2
+
+        # same request again, SIGTERMing the session's worker after the
+        # first streamed token (mid-decode by construction)
+        host, _, port = gw.addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=300)
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps(req).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        got, drained = [], False
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            got.append(json.loads(raw))
+            if "token" in got[-1] and not drained:
+                procs[first_rid].send_signal(signal.SIGTERM)
+                drained = True
+            if "done" in got[-1] or "error" in got[-1]:
+                break
+        conn.close()
+        assert drained
+        term = got[-1]
+        assert term.get("done") is True, got    # zero ReplicaLost
+        assert _toks(got) == ref_tokens         # bitwise, exactly-once
+        assert term.get("migrated", 0) >= 1
+        assert gw.streams_migrated >= 1
+        assert gw.streams_resumed == 0          # zero re-prefills
+        assert gw.streams_lost == 0
+        # the planned drain exits with the preemption code, not a crash
+        assert procs[first_rid].wait(timeout=60) == PREEMPTED_EXIT_CODE
+    finally:
+        gw.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        reg.close()
