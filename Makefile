@@ -9,6 +9,7 @@
 #   make test-fast  -> quick shard (operators + ndarray + autograd)
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make lockdep-smoke-> runtime lock-order sanitizer lane (MXTPU_LOCKDEP=raise)
+#   make race-smoke -> runtime lockset race sanitizer lane (MXTPU_RACECHECK=raise)
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
@@ -46,6 +47,9 @@ lint:
 
 lockdep-smoke:
 	bash ci/runtime_functions.sh lockdep_check
+
+race-smoke:
+	bash ci/runtime_functions.sh racecheck_check
 
 chaos:
 	bash ci/runtime_functions.sh chaos_check
@@ -86,4 +90,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint lockdep-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke migrate-smoke sim-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint lockdep-smoke race-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke migrate-smoke sim-smoke obs-smoke debug-smoke ci clean

@@ -592,6 +592,9 @@ def main(argv=None):
     def kernels_leg():
         return kernels_bench(quick=quick)
 
+    def racecheck_leg():
+        return racecheck_bench(quick=quick)
+
     def longctx_leg():
         return long_context_bench()
 
@@ -643,6 +646,12 @@ def main(argv=None):
     # accepted on kernels_flash_vs_naive / kernels_int8_matmul_vs_bf16
     if os.environ.get("BENCH_KERNELS", "1") != "0":
         legs.append(("kernels", kernels_leg, 45 if quick else 90))
+    # the racecheck leg runs in quick mode too: the armed lockset race
+    # sanitizer is accepted on racecheck_checked_ops_per_sec (tripwired)
+    # with racecheck_overhead_pct alongside; the off half of each pair
+    # doubles as the off-mode zero-overhead baseline
+    if os.environ.get("BENCH_RACECHECK", "1") != "0":
+        legs.append(("racecheck", racecheck_leg, 20 if quick else 30))
     # the loadreplay leg runs in quick mode too: trace-driven overload
     # replay (docs/SIMULATION.md) is accepted on goodput at 2x measured
     # capacity and TTFT p99, both under the regression tripwire
@@ -785,6 +794,76 @@ def serving_bench(quick=False):
     finally:
         srv.drain(timeout=30)
     return out
+
+
+def racecheck_bench(quick=False):
+    """Racecheck leg (docs/STATIC_ANALYSIS.md "Data-race detection"):
+    cost of the armed lockset detector over a representative tracked
+    critical section — a tracked counter bumped under a held lock, the
+    shape every serving-stack stats field has — vs the same class with
+    the sanitizer uninstalled (no hooks exist, so the baseline IS the
+    off-mode zero-overhead path the tests pin).  The on-window seeds the
+    field into shared-modified first so every access pays the full
+    lockset-intersection step, not the cheap exclusive-phase one.
+    Interleaved off/on window pairs; the overhead is the MEDIAN per-pair
+    ratio, same discipline as the sentinel leg.  The tripwire gates on
+    ``racecheck_checked_ops_per_sec``."""
+    import threading as _threading
+
+    from mxnet_tpu import racecheck
+
+    if racecheck.installed():
+        # the round itself is running under MXTPU_RACECHECK: there is no
+        # off window to pair against, so the leg carries no number
+        return {"racecheck_skipped": "sanitizer already armed"}
+
+    @racecheck.track("ctr")
+    class _Counter:
+        def __init__(self):
+            self.ctr = 0
+
+    ops = 20_000 if quick else 100_000
+    reps = 3 if quick else 5
+
+    def window(box, lk):
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            with lk:
+                box.ctr += 1
+        return time.perf_counter() - t0
+
+    checked_ops_s, ratios = 0.0, []
+    for _ in range(reps):
+        box, lk = _Counter(), _threading.Lock()
+        dt_off = window(box, lk)
+        racecheck.install("record")
+        try:
+            box = _Counter()
+            lk = racecheck._LockToken(_threading._allocate_lock(),
+                                      "bench.py:0", "Lock")
+
+            def seed():
+                with lk:
+                    box.ctr = 0    # second thread: leave exclusive phase
+
+            t = _threading.Thread(target=seed)
+            t.start()
+            t.join()
+            dt_on = window(box, lk)
+            races = racecheck.snapshot()["counters"]["races"]
+        finally:
+            racecheck.uninstall()
+            racecheck.reset()
+        if races:                  # the bench loop is lock-disciplined
+            return {"racecheck_error": "false race in bench loop"}
+        checked_ops_s = max(checked_ops_s, ops / dt_on)
+        ratios.append(dt_on / dt_off - 1.0)
+    ratios.sort()
+    mid = len(ratios) // 2
+    overhead = (ratios[mid] if len(ratios) % 2
+                else (ratios[mid - 1] + ratios[mid]) / 2.0)
+    return {"racecheck_checked_ops_per_sec": round(checked_ops_s, 1),
+            "racecheck_overhead_pct": round(overhead * 100.0, 2)}
 
 
 def decode_bench(quick=False):

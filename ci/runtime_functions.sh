@@ -48,6 +48,32 @@ lockdep_check() {
         tests/test_serving.py -q -m "not slow"
 }
 
+racecheck_check() {
+    # Runtime lockset race sanitizer (docs/STATIC_ANALYSIS.md
+    # "Data-race detection"): first the detector's own suite, then the
+    # concurrency-heavy serving suites with all three runtime
+    # sanitizers stacked in raise mode — every tracked serving counter
+    # written by two threads without a common lock fails the lane at
+    # the racing write (racecheck), every acquisition-order inversion
+    # at the acquire that would deadlock (lockdep), and every stranded
+    # resource at the first non-quiescent test (leakcheck).
+    python -m pytest tests/test_racecheck.py -q
+    MXTPU_RACECHECK=raise MXTPU_LOCKDEP=raise MXTPU_LEAKCHECK=raise \
+        python -m pytest tests/test_chaos.py tests/test_gateway.py \
+        tests/test_failover.py tests/test_migration.py \
+        tests/test_racecheck.py -q -m "not slow"
+    # the sanitizer itself and the guard-disciplined serving modules it
+    # instruments must lint clean under the RC rules — no suppressions
+    python -m mxnet_tpu.lint mxnet_tpu/racecheck.py \
+        mxnet_tpu/gateway.py mxnet_tpu/fleet_worker.py mxnet_tpu/fleet.py
+    if grep -n "mxlint: disable" mxnet_tpu/racecheck.py \
+            mxnet_tpu/gateway.py mxnet_tpu/fleet_worker.py \
+            mxnet_tpu/fleet.py; then
+        echo "racecheck-path modules must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 unittest_core() {
     python -m pytest tests/test_operator.py tests/test_operator_corpus.py \
         tests/test_operator_extra.py tests/test_random.py \
@@ -476,6 +502,7 @@ all() {
     integration_examples
     chaos_check
     lockdep_check
+    racecheck_check
     multichip_dryrun
 }
 

@@ -18,6 +18,13 @@ from . import lockdep  # noqa: F401
 
 lockdep.install_from_env()
 
+# arm the runtime lockset race sanitizer (MXTPU_RACECHECK) next — its
+# lock-identity tokens must wrap whatever factory is live (stacking on
+# lockdep's), and before any tracked class is instantiated
+from . import racecheck  # noqa: F401
+
+racecheck.install_from_env()
+
 # arm the runtime resource-leak sanitizer (MXTPU_LEAKCHECK) the same way
 # — stdlib-only, and its track/untrack hooks must be live before the
 # first allocator/breaker/future exists

@@ -359,7 +359,10 @@ class PreemptionHandler:
         self._signal_count = 0
         self._prev = {}
         self._installed = False
-        self._callbacks = []
+        # registration happens during setup, before install() arms the
+        # signal; Python delivers signals on the main thread, so the
+        # iteration in _on_signal never overlaps add_callback
+        self._callbacks = []  # mxlint: not-shared — registered pre-install, read on main thread
 
     def install(self):
         """Register the signal handlers (main thread only — CPython

@@ -296,8 +296,8 @@ class FleetSupervisor:
                              "(got %d..%d)" % (self.min_replicas,
                                                self.max_replicas))
 
-        # control state (written by the loops, read by snapshot():
-        # plain attributes, no lock — nothing blocks on them)
+        # control state: single-writer attributes (each written by
+        # exactly one loop thread, read by snapshot()) stay plain
         self.shed_rate = 0.0
         self.p99_ms = 0.0
         self.scale_ups = 0
@@ -312,6 +312,11 @@ class FleetSupervisor:
         self._idle_since = None
         self._cooldown_until = 0.0
         self._beat_seq = 0
+        # the one cross-thread set: the heartbeat thread adds ids, the
+        # control thread discards on scale-down, and stop() (any
+        # thread) iterates it for withdrawal — so it gets its own lock
+        # (never held across anything blocking)
+        self._pub_lock = threading.Lock()
         self._published = set()
 
         # postmortem bundles embed the live fleet view (weakly held:
@@ -344,7 +349,9 @@ class FleetSupervisor:
         for t in self._threads:
             t.join(timeout=5.0)
         if withdraw:
-            for rid in sorted(self._published):
+            with self._pub_lock:
+                published = sorted(self._published)
+            for rid in published:
                 try:
                     self.registry.withdraw(rid)
                 except Exception:
@@ -393,7 +400,8 @@ class FleetSupervisor:
                             "p99_ms": self.p99_ms,
                             "beat": beat,
                         })
-                        self._published.add(r["id"])
+                        with self._pub_lock:
+                            self._published.add(r["id"])
                         self.heartbeats += 1
                         _count("fleet_heartbeats")
                 except Exception as e:
@@ -516,7 +524,8 @@ class FleetSupervisor:
             self.registry.withdraw(rid)      # clean deregistration
         except Exception:
             pass
-        self._published.discard(rid)
+        with self._pub_lock:
+            self._published.discard(rid)
         _log("scale DOWN %d -> %d (retired replica %d after %.1fs idle)"
              % (n, n - 1, rid, self.idle_down_s))
 
@@ -559,9 +568,11 @@ class WorkerSupervisor:
     ``worker_kill@N`` SIGKILLs a live worker on the Nth monitor tick;
     tests can also call :meth:`kill_worker` directly.
 
-    Lock-free like :class:`FleetSupervisor`: the monitor thread owns the
-    lifecycle state, public methods read plain attributes, and nothing
-    blocking ever runs under a lock.
+    The monitor thread owns the restart bookkeeping (plain single-writer
+    attributes), while the process table ``_procs`` — mutated by the
+    monitor, iterated by ``stop()``/``alive()``/``kill_worker()`` from
+    other threads — is guarded by ``_procs_lock``; the lock is never held across
+    ``Popen``/``wait`` (snapshot-copy, then block outside it).
     """
 
     def __init__(self, specs, registry=None, service="default",
@@ -585,7 +596,8 @@ class WorkerSupervisor:
         self.nonretryable = frozenset(nonretryable)
 
         # monitor-thread state (plain attributes; snapshot() only reads)
-        self._procs = {}           # rid -> live Popen
+        self._procs_lock = threading.Lock()
+        self._procs = {}           # rid -> live Popen (guarded by _procs_lock)
         self._incarnation = {rid: 0 for rid in self.specs}
         self._failures = {rid: 0 for rid in self.specs}
         self._died_at = {}         # rid -> monotonic death time
@@ -617,8 +629,10 @@ class WorkerSupervisor:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
+        with self._procs_lock:
+            have = set(self._procs)
         for rid in self.specs:
-            if rid not in self._procs:
+            if rid not in have:
                 self._spawn(rid)
         if not self._thread.is_alive():
             self._thread.start()
@@ -632,14 +646,16 @@ class WorkerSupervisor:
         if self._thread.is_alive() and \
                 self._thread is not threading.current_thread():
             self._thread.join(timeout=5.0)
-        for proc in self._procs.values():
+        with self._procs_lock:
+            procs = dict(self._procs)
+        for proc in procs.values():
             if proc.poll() is None:
                 try:
                     proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
         deadline = self.clock.now() + float(timeout)
-        for rid, proc in self._procs.items():
+        for rid, proc in procs.items():
             left = max(0.1, deadline - self.clock.now())
             try:
                 proc.wait(timeout=left)
@@ -668,11 +684,13 @@ class WorkerSupervisor:
 
     def alive(self):
         """Worker ids whose process is currently running."""
-        return [rid for rid, p in self._procs.items()
-                if p.poll() is None]
+        with self._procs_lock:
+            procs = list(self._procs.items())
+        return [rid for rid, p in procs if p.poll() is None]
 
     def pid(self, rid):
-        proc = self._procs.get(str(rid))
+        with self._procs_lock:
+            proc = self._procs.get(str(rid))
         return None if proc is None else proc.pid
 
     def kill_worker(self, rid=None, sig=signal.SIGKILL):
@@ -684,7 +702,8 @@ class WorkerSupervisor:
                 return None
             rid = live[0]
         rid = str(rid)
-        proc = self._procs.get(rid)
+        with self._procs_lock:
+            proc = self._procs.get(rid)
         if proc is None or proc.poll() is not None:
             return None
         try:
@@ -719,7 +738,9 @@ class WorkerSupervisor:
     def _spawn(self, rid):
         inc = self._incarnation[rid]
         env = {**self._env, "MXTPU_RESTART_COUNT": str(inc)}
-        self._procs[rid] = subprocess.Popen(self.specs[rid], env=env)
+        proc = subprocess.Popen(self.specs[rid], env=env)
+        with self._procs_lock:
+            self._procs[rid] = proc
         self._incarnation[rid] = inc + 1
         self._restart_at.pop(rid, None)
         died = self._died_at.pop(rid, None)
@@ -730,8 +751,7 @@ class WorkerSupervisor:
             self.restarts += 1
             _count("fleet_worker_restarts")
             _log("worker %s respawned (incarnation %d, pid %d, "
-                 "%.0fms after death)" % (rid, inc,
-                                          self._procs[rid].pid, dt_ms))
+                 "%.0fms after death)" % (rid, inc, proc.pid, dt_ms))
 
     def _on_exit(self, rid, rc, now):
         self._died_at[rid] = now
@@ -818,7 +838,9 @@ class WorkerSupervisor:
                 self.kill_worker(self._busiest_alive(),
                                  sig=signal.SIGTERM)
             self._drain_seq += 1
-        for rid, proc in list(self._procs.items()):
+        with self._procs_lock:
+            procs = list(self._procs.items())
+        for rid, proc in procs:
             if rid in self._died_at or rid in self._given_up \
                     or rid in self._done:
                 continue

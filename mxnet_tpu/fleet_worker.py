@@ -65,6 +65,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from . import racecheck as _racecheck
+
 __all__ = ["FleetWorker", "demo_model", "demo_generation", "main"]
 
 _DEF_HEARTBEAT_S = float(os.environ.get(
@@ -118,6 +120,8 @@ class _IdemEntry:
         self.event.set()
 
 
+@_racecheck.track("requests", "idem_replays", "streams_parked",
+                  "migrations_in", "migrations_aborted")
 class FleetWorker:
     """One worker process's runtime: HTTP endpoint + registry heartbeat
     around a built ``ModelServer``/``GenerationServer``.
@@ -141,14 +145,17 @@ class FleetWorker:
             ServiceRegistry(addr=registry_addr, service=service)
         self.heartbeat_s = _DEF_HEARTBEAT_S if heartbeat_s is None \
             else float(heartbeat_s)
-        self.beats = 0
+        self.beats = 0           # heartbeat-thread-only (single writer)
         self.beats_failed = 0
+        # stats bumped from concurrent handler threads and read by the
+        # heartbeat's load report: every access under _stats_lock
+        self._stats_lock = threading.Lock()
         self.requests = 0
         self.idem_replays = 0
         self._beat_seq = 0
         self._idem = OrderedDict()
-        self._idem_cap = _DEF_IDEM_CACHE if idem_cache is None \
-            else int(idem_cache)
+        self._idem_cap = (_DEF_IDEM_CACHE if idem_cache is None
+                          else int(idem_cache))  # mxlint: not-shared — immutable after __init__
         self._idem_lock = threading.Lock()
         self._drain_evt = threading.Event()
         self._stop_evt = threading.Event()
@@ -225,7 +232,8 @@ class FleetWorker:
             return 0
         if not handles:
             return 0
-        self.streams_parked += len(handles)
+        with self._stats_lock:
+            self.streams_parked += len(handles)
         _count("fleet_worker_drain_parked", len(handles))
         _log("drain: parked %d stream(s) for migration" % len(handles))
         wait_s = _DEF_MIGR_DRAIN_WAIT_S if wait_s is None \
@@ -263,15 +271,17 @@ class FleetWorker:
         else:
             inflight = sum(r["inflight"] for r in snap["replicas"]) \
                 + snap.get("queue_depth", 0)
+        with self._stats_lock:
+            stats = {"requests": self.requests,
+                     "idem_replays": self.idem_replays,
+                     "streams_parked": self.streams_parked,
+                     "migrations_in": self.migrations_in,
+                     "migrations_aborted": self.migrations_aborted}
         return {"rid": self.rid, "kind": self.kind, "addr": self.addr,
                 "pid": os.getpid(), "state": snap["state"],
                 "inflight": inflight, "beats": self.beats,
                 "beats_failed": self.beats_failed,
-                "requests": self.requests,
-                "idem_replays": self.idem_replays,
-                "streams_parked": self.streams_parked,
-                "migrations_in": self.migrations_in,
-                "migrations_aborted": self.migrations_aborted,
+                **stats,
                 "parked": snap.get("parked", 0),
                 # the zero-recompile assertion reaches across the
                 # process boundary through /healthz
@@ -327,7 +337,8 @@ class FleetWorker:
             ent, owner = self._idem_claim(key)
             if not owner:
                 ent.event.wait(timeout=_DEF_DEADLINE_MS / 1e3)
-                self.idem_replays += 1
+                with self._stats_lock:
+                    self.idem_replays += 1
                 _count("fleet_worker_idem_replays")
                 return ent.status or 500, dict(ent.body or
                                                {"error": "Unavailable"})
@@ -374,7 +385,8 @@ class FleetWorker:
             ent, owner = self._idem_claim(key)
             if not owner:
                 ent.event.wait(timeout=_DEF_DEADLINE_MS / 1e3)
-                self.idem_replays += 1
+                with self._stats_lock:
+                    self.idem_replays += 1
                 _count("fleet_worker_idem_replays")
                 for line in (ent.lines or
                              [{"error": "Unavailable", "rid": self.rid}]):
@@ -479,7 +491,8 @@ class FleetWorker:
         except Exception as e:
             return 500, {"error": "Internal", "message": "%s: %s"
                          % (type(e).__name__, e), "rid": self.rid}
-        self.streams_parked += len(handles)
+        with self._stats_lock:
+            self.streams_parked += len(handles)
         if handles:
             _count("fleet_worker_parked", len(handles))
         return 200, {"handles": list(handles), "rid": self.rid}
@@ -543,7 +556,8 @@ class FleetWorker:
                                  % (type(e).__name__, e), "rid": self.rid}
         else:
             status, resp = 200, {"handle": handle, "rid": self.rid}
-            self.migrations_in += 1
+            with self._stats_lock:
+                self.migrations_in += 1
             _count("fleet_worker_migrations_in")
         with self._migr_lock:
             self._migr_done[key] = (status, resp)
@@ -577,7 +591,8 @@ class FleetWorker:
                 and hasattr(self.server, "release_import"):
             dropped = self.server.release_import(str(handle)) or dropped
         if dropped:
-            self.migrations_aborted += 1
+            with self._stats_lock:
+                self.migrations_aborted += 1
             _count("fleet_worker_migrations_aborted")
         return 200, {"aborted": bool(dropped), "rid": self.rid}
 
@@ -600,12 +615,12 @@ class FleetWorker:
     def _sweep_migr_buffers(self):
         """Expire abandoned chunk buffers (gateway died mid-transfer)
         so a lost sender cannot pin receiver memory forever."""
-        if not self._migr_buf:
-            return
         from . import leakcheck
 
         now = time.monotonic()
         with self._migr_lock:
+            if not self._migr_buf:
+                return
             stale = [k for k, b in self._migr_buf.items()
                      if now >= b["expires"]]
             for k in stale:
@@ -634,7 +649,8 @@ class FleetWorker:
                     self._json(404, {"error": "NotFound"})
 
             def do_POST(self):
-                worker.requests += 1
+                with worker._stats_lock:
+                    worker.requests += 1
                 _count("fleet_worker_requests")
                 try:
                     n = int(self.headers.get("Content-Length", "0"))

@@ -83,6 +83,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import chaos as _chaos
 from . import clock as _clockmod
 from . import leakcheck as _leakcheck
+from . import racecheck as _racecheck
 from . import telemetry as _telemetry
 
 __all__ = ["Gateway"]
@@ -112,6 +113,9 @@ def _count(name, delta=1):
     _prof.dispatch_count(name, delta)
 
 
+@_racecheck.track("requests", "retried", "streams_lost",
+                  "streams_resumed", "streams_migrated",
+                  "migrate_fallbacks", "tokens_streamed")
 class Gateway:
     """Route requests across registered fleet workers (one instance =
     one HTTP listener + one registry refresh loop)."""
@@ -149,7 +153,10 @@ class Gateway:
         #                             (worker_kill_mid_decode chaos probe)
         self._migrate_seq = 0       # chaos kill-point (migrate_interrupt)
 
-        self._lock = threading.Lock()      # sessions + local inflight
+        self._lock = threading.Lock()      # sessions, inflight, suspects
+        #                                    + the stats counters above
+        #                                    (handler threads bump them
+        #                                    concurrently)
         self._sessions = OrderedDict()     # session -> rid
         self._inflight = {}                # rid -> gateway-local count
         self._suspect = {}                 # rid -> monotonic expiry
@@ -196,19 +203,20 @@ class Gateway:
 
     def snapshot(self):
         view = self._view
-        return {"addr": self.addr, "stale": self.stale,
-                "view_age_s": self.view_age_s(),
-                "refreshes": self.refreshes,
-                "refresh_failures": self._refresh_failures,
-                "requests": self.requests, "retried": self.retried,
-                "streams_lost": self.streams_lost,
-                "streams_resumed": self.streams_resumed,
-                "streams_migrated": self.streams_migrated,
-                "migrate_fallbacks": self.migrate_fallbacks,
-                "tokens_streamed": self.tokens_streamed,
-                "workers": sorted(view.replicas) if view is not None
-                else [],
-                "sessions": len(self._sessions)}
+        with self._lock:
+            return {"addr": self.addr, "stale": self.stale,
+                    "view_age_s": self.view_age_s(),
+                    "refreshes": self.refreshes,
+                    "refresh_failures": self._refresh_failures,
+                    "requests": self.requests, "retried": self.retried,
+                    "streams_lost": self.streams_lost,
+                    "streams_resumed": self.streams_resumed,
+                    "streams_migrated": self.streams_migrated,
+                    "migrate_fallbacks": self.migrate_fallbacks,
+                    "tokens_streamed": self.tokens_streamed,
+                    "workers": sorted(view.replicas) if view is not None
+                    else [],
+                    "sessions": len(self._sessions)}
 
     # -- registry refresh --------------------------------------------------
     def refresh_once(self):
@@ -333,7 +341,8 @@ class Gateway:
                 self._note_suspect(rid)
                 excluded.append(rid)
                 attempt += 1
-                self.retried += 1
+                with self._lock:
+                    self.retried += 1
                 _count("gateway_retries")
                 _log("worker %s failed mid-predict (%s: %s) — "
                      "retrying elsewhere" % (rid, type(e).__name__, e))
@@ -350,7 +359,8 @@ class Gateway:
                 # shed/draining on that worker: spill to a sibling
                 excluded.append(rid)
                 attempt += 1
-                self.retried += 1
+                with self._lock:
+                    self.retried += 1
                 _count("gateway_retries")
                 continue
             return status, data, rid
@@ -407,7 +417,8 @@ class Gateway:
                 picked = self._pick(session=session, exclude=excluded)
             if picked is None:
                 if delivered:
-                    self.streams_lost += 1
+                    with self._lock:
+                        self.streams_lost += 1
                     _count("gateway_stream_lost")
                     write_line({"error": "ReplicaLost",
                                 "message": "no live worker to resume "
@@ -437,7 +448,8 @@ class Gateway:
                 req = dict(body)
                 req["resume_from"] = [int(t) for t in delivered]
                 req["idempotency_key"] = "gw-" + _telemetry.new_trace_id()
-                self.streams_resumed += 1
+                with self._lock:
+                    self.streams_resumed += 1
                 _count("gateway_stream_resumed")
             payload = json.dumps(req).encode()
             self._track(rid, 1)
@@ -477,7 +489,8 @@ class Gateway:
                             delivered.append(int(line["token"]))
                         else:
                             overflowed = True
-                        self.tokens_streamed += 1
+                        with self._lock:
+                            self.tokens_streamed += 1
                     elif "done" in line and (losses or migrations
                                              or fallbacks):
                         # terminal count covers every incarnation, not
@@ -502,7 +515,8 @@ class Gateway:
                                                  excluded)
                     if moved is not None:
                         migrations += 1
-                        self.streams_migrated += 1
+                        with self._lock:
+                            self.streams_migrated += 1
                         _count("gateway_stream_migrated")
                         if session:
                             with self._lock:
@@ -510,7 +524,8 @@ class Gateway:
                         pending = moved
                     else:
                         fallbacks += 1
-                        self.migrate_fallbacks += 1
+                        with self._lock:
+                            self.migrate_fallbacks += 1
                         _count("gateway_migrate_fallbacks")
                         _log("migration of stream off worker %s failed "
                              "— falling back to journal resume" % rid)
@@ -523,7 +538,8 @@ class Gateway:
                     losses += 1
                     if losses >= 2 or overflowed or not delivered:
                         # second loss / uncapped journal: the fallback
-                        self.streams_lost += 1
+                        with self._lock:
+                            self.streams_lost += 1
                         _count("gateway_stream_lost")
                         write_line({"error": "ReplicaLost",
                                     "message": "worker %s lost "
@@ -535,7 +551,8 @@ class Gateway:
                          % (rid, len(delivered), type(e).__name__, e))
                     continue
                 attempt += 1
-                self.retried += 1
+                with self._lock:
+                    self.retried += 1
                 _count("gateway_retries")
                 _log("worker %s failed pre-stream (%s: %s) — "
                      "retrying elsewhere" % (rid, type(e).__name__, e))
@@ -577,8 +594,9 @@ class Gateway:
         chunks to drill exactly that degradation."""
         import base64
 
-        mseq = self._migrate_seq
-        self._migrate_seq += 1
+        with self._lock:
+            mseq = self._migrate_seq
+            self._migrate_seq += 1
         target = self._pick(exclude=tuple(exclude))
         if target is None:
             return None
@@ -653,7 +671,8 @@ class Gateway:
 
             def do_POST(self):
                 t0 = gw.clock.now()
-                gw.requests += 1
+                with gw._lock:
+                    gw.requests += 1
                 _count("gateway_requests")
                 try:
                     n = int(self.headers.get("Content-Length", "0"))

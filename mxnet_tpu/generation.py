@@ -614,7 +614,8 @@ class GenerationServer:
 
     @property
     def state(self):
-        return self._state
+        with self._lock:
+            return self._state
 
     # -- admission -----------------------------------------------------
     def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
@@ -1463,7 +1464,8 @@ class GenerationServer:
             self._stop = True
             self._cv.notify_all()
         self._thread.join(timeout=5.0)
-        self._state = STOPPED
+        with self._cv:
+            self._state = STOPPED
         return drained
 
     def close(self, timeout=5.0):

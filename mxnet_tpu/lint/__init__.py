@@ -49,7 +49,26 @@ RL003  error     future created or admitted but not settled on every
                  path out of the owning scope (the PR 5 drain bug,
                  as a rule)
 RL004  error     settle reachable twice on one path (double-settle)
+RC001  error     shared attribute written from >= 2 concurrent thread
+                 roots with an unguarded access (guard inferred from
+                 the majority of lock-held accesses)
+RC002  error     inconsistent guards: one attribute accessed under two
+                 different locks (neither excludes the other)
+RC003  error     check-then-act: a value read under a lock gates a
+                 write that re-acquires it — the check can go stale
+RC004  error     container iterated in one thread root while another
+                 mutates it with no common lock
 =====  ========  =====================================================
+
+The RC rules (:mod:`~mxnet_tpu.lint.races`) infer each attribute's
+guard from the majority of accesses made under a held lock (the
+``--explain-guards`` CLI dump shows the inferred map) and honor two
+*intent annotations* on the attribute's assignment line:
+``# mxlint: guarded-by(self._lock)`` declares the guard (overriding
+inference) and ``# mxlint: not-shared`` exempts a single-threaded or
+externally-synchronized attribute.  The runtime half is
+:mod:`mxnet_tpu.racecheck` (``MXTPU_RACECHECK=record|raise``), an
+Eraser-style lockset sanitizer over instrumented classes.
 
 The RL rules are driven by a declarative pair registry
 (:mod:`~mxnet_tpu.lint.lifecycle`): a subsystem declares its
@@ -103,6 +122,7 @@ from .core import (  # noqa: F401
 )
 from . import rules as _rules  # noqa: F401  (registers the rule set)
 from . import lifecycle as _lifecycle  # noqa: F401  (registers RL rules)
+from . import races as _races  # noqa: F401  (registers RC rules)
 from .baseline import (  # noqa: F401
     compare,
     load_baseline,

@@ -38,6 +38,9 @@ def _build_parser():
                         "current findings and exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--explain-guards", action="store_true",
+                   help="dump the guard map the RC data-race rules "
+                        "inferred for every shared attribute and exit")
     return p
 
 
@@ -65,6 +68,14 @@ def main(argv=None):
         parser.error("no paths given (or use --list-rules)")
     if ns.write_baseline and not ns.baseline:
         parser.error("--write-baseline requires --baseline <json>")
+    if ns.explain_guards:
+        from .races import format_guard_map, guard_map
+        try:
+            sys.stdout.write(format_guard_map(guard_map(ns.paths)) + "\n")
+        except LintError as e:
+            sys.stderr.write("mxlint: %s\n" % e)
+            return 2
+        return 0
     select = _split_rules(ns.select)
     disable = _split_rules(ns.disable)
     for spec in (select or ()), (disable or ()):

@@ -1,0 +1,915 @@
+"""mxlint data-race plane: guarded-by inference + static race rules.
+
+The lockdep plane (PR 13) proves lock *ordering* and the lifecycle
+plane (PR 15) proves resource *ownership*; neither verifies that shared
+mutable state is actually *guarded*.  This module closes that gap with
+an Eraser-shaped static pass over the package-wide
+:class:`~.interproc.Program`:
+
+1. **Thread roots** — entry points from which a non-main thread can
+   execute: ``threading.Thread(target=...)`` callables (daemon loop
+   bodies included), ``do_*`` methods of ``BaseHTTPRequestHandler``
+   subclasses (one root, *many* concurrent threads — it counts as two),
+   and callback-registered ``on_*`` functions nothing in the package
+   calls directly.  Functions reachable from no spawned root belong to
+   the ``caller`` pseudo-root (public API invoked from the main/test
+   thread).
+2. **Guard inference** — every ``self.attr`` access (reads, writes,
+   in-place container mutations, iterations) is recorded with the lock
+   labels held at that point: lexical ``with lock:`` blocks plus the
+   *entry-held* set of private helpers — the intersection of the locks
+   held at every package call site, so a ``_foo_locked`` helper called
+   only under ``self._lock`` analyzes as holding it (the one-helper-deep
+   contract).  Per attribute, the majority lock among guarded accesses
+   becomes the inferred guard.
+3. **Rules** — each finding is anchored at the offending access and
+   carries the thread-root witness chains:
+
+   * **RC001** — attribute written from >= 2 concurrent thread roots
+     with at least one post-init access holding no lock.
+   * **RC002** — inconsistent guards: the same attribute is accessed
+     under two disjoint lock sets (a reader under one lock cannot see
+     writes under the other).
+   * **RC003** — check-then-act: a value read under a lock gates a
+     write that re-acquires the same lock — the guard was released
+     between the read and the dependent write, so the check can go
+     stale.
+   * **RC004** — a container iterated in one thread root while mutated
+     in another with no common lock (``RuntimeError: dictionary changed
+     size`` at best, silent corruption at worst).
+
+Intent annotations (distinct from ``# mxlint: disable`` suppressions —
+they feed the *inference*, not the reporter) go on the attribute's
+assignment line:
+
+* ``# mxlint: guarded-by(self._lock)`` — declares the guard, overriding
+  majority inference; accesses under a different lock become RC002.
+* ``# mxlint: not-shared`` — declares the attribute single-threaded or
+  externally synchronized (rationale prose welcome after an em-dash);
+  all RC rules skip it.
+
+``python -m mxnet_tpu.lint --explain-guards <paths>`` dumps the
+inferred guard map (:func:`guard_map` / :func:`format_guard_map`).
+
+The dynamic half is :mod:`mxnet_tpu.racecheck` — a runtime lockset
+sanitizer catching the races this pass cannot see (getattr indirection,
+foreign callbacks).  Like the rest of mxlint this module is
+stdlib-only and never imports jax.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Severity, register_program_rule
+from .rules import (CALLBACK_PREFIXES, _lock_exprs, _lockish,
+                    _terminal_name, _thread_creations)
+
+__all__ = ["guard_map", "format_guard_map"]
+
+# race findings carry two witness chains plus the remediation advice —
+# the interproc 220-char why-cap would truncate the actionable tail, so
+# RC messages get their own wider cap
+_MAX_MSG = 480
+
+
+def _clip(msg):
+    return msg if len(msg) <= _MAX_MSG else msg[:_MAX_MSG] + "..."
+
+# container methods that mutate the receiver in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update",
+})
+# builtins whose call iterates their first argument
+_ITERATING_BUILTINS = frozenset({
+    "sorted", "list", "tuple", "set", "frozenset", "dict", "min", "max",
+    "sum", "any", "all",
+})
+# iterating view methods: for k in self.d.items() / values() / keys()
+_VIEW_METHODS = frozenset({"items", "values", "keys"})
+# HTTP-handler base classes: their do_*/handle methods run one thread
+# per connection — a single root that is concurrent with itself
+_HANDLER_BASES = frozenset({
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "BaseRequestHandler", "StreamRequestHandler",
+})
+_HANDLER_METHODS = ("do_",)
+_DUNDER = re.compile(r"^__.*__$")
+_MAX_CHAIN = 4          # witness-chain hops shown per root
+_ENTRY_ROUNDS = 8       # entry-held fixpoint cap
+
+_ANNOTATION = re.compile(
+    r"#\s*mxlint:\s*(?:guarded-by\(\s*(?P<guard>[^)]+?)\s*\)"
+    r"|(?P<notshared>not-shared))")
+
+
+class _Root:
+    """One thread entry point: the FunctionInfo it starts in, its kind
+    ('thread' / 'handler' / 'callback' / 'caller'), and its concurrency
+    weight (how many simultaneous threads it stands for)."""
+
+    __slots__ = ("fi", "kind", "weight", "label")
+
+    def __init__(self, fi, kind, weight, label):
+        self.fi = fi
+        self.kind = kind
+        self.weight = weight
+        self.label = label
+
+    def __repr__(self):
+        return "_Root(%s %s)" % (self.kind, self.label)
+
+
+class _Access:
+    """One attribute access: where, what kind, under which locks, from
+    which thread roots."""
+
+    __slots__ = ("cls_key", "attr", "kind", "fi", "node", "line", "col",
+                 "held", "in_init", "with_node")
+
+    def __init__(self, cls_key, attr, kind, fi, node, held, in_init,
+                 with_node=None):
+        self.cls_key = cls_key
+        self.attr = attr
+        self.kind = kind          # 'read' | 'write' | 'mut' | 'iter'
+        self.fi = fi
+        self.node = node
+        self.line = node.lineno
+        self.col = node.col_offset
+        self.held = held          # frozenset of lock labels
+        self.in_init = in_init
+        self.with_node = with_node  # innermost lock With, or None
+
+
+def _is_write_kind(kind):
+    return kind in ("write", "mut")
+
+
+# ---------------------------------------------------------------------------
+# thread roots + reachability
+# ---------------------------------------------------------------------------
+def _call_sites(program):
+    """callee FunctionInfo -> [(caller fi, Call node, held labels)]."""
+    sites = {}
+    for fi in program.functions:
+        for call, held in fi.calls:
+            for callee in program._resolved.get(id(call), ()):
+                sites.setdefault(callee, []).append((fi, call, held))
+    return sites
+
+
+def _thread_name_kwarg(call):
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _handler_classes(ctx):
+    """Class names in this module subclassing an HTTP/socket handler."""
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for base in node.bases:
+            if _terminal_name(base) in _HANDLER_BASES:
+                out.add(node.name)
+    return out
+
+
+def _find_roots(program, call_sites):
+    """Every spawned-thread entry point in the program."""
+    roots = []
+    seen = set()
+
+    def add(fi, kind, weight, label):
+        if fi is None or id(fi) in seen:
+            return
+        seen.add(id(fi))
+        roots.append(_Root(fi, kind, weight, label))
+
+    for ctx in program.contexts:
+        for call, _daemon, target in _thread_creations(ctx):
+            if target is None:
+                continue
+            caller = ctx._enclosing_fn(call)
+            caller_fi = program.by_node.get(id(caller)) \
+                if caller is not None else None
+            for fi in program.resolve_callable(ctx, caller_fi, target):
+                tname = _thread_name_kwarg(call)
+                add(fi, "thread", 1,
+                    "%s%s" % (fi.qualname,
+                              " (%r)" % tname if tname else ""))
+        handlers = _handler_classes(ctx)
+        if handlers:
+            for fi in program.functions:
+                if fi.ctx is ctx and fi.cls in handlers and \
+                        fi.name.startswith(_HANDLER_METHODS):
+                    add(fi, "handler", 2, fi.qualname)
+    # callback-registered functions: on_*/_on_* defs that nothing in the
+    # package calls directly are invoked from foreign threads
+    for fi in program.functions:
+        if fi.name.startswith(CALLBACK_PREFIXES) and \
+                fi not in call_sites and id(fi) not in seen:
+            add(fi, "callback", 1, fi.qualname)
+    return roots
+
+
+def _reachable(root, program):
+    """fi -> qualname chain from the root, by BFS over resolved calls."""
+    chains = {root.fi: (root.fi.qualname,)}
+    frontier = [root.fi]
+    while frontier:
+        nxt = []
+        for fi in frontier:
+            base = chains[fi]
+            for call, _held in fi.calls:
+                for callee in program._resolved.get(id(call), ()):
+                    if callee in chains:
+                        continue
+                    chains[callee] = base + (callee.qualname,)
+                    nxt.append(callee)
+        frontier = nxt
+    return chains
+
+
+def _chain_text(root, chains, fi):
+    chain = chains.get(fi, (fi.qualname,))
+    if len(chain) > _MAX_CHAIN:
+        chain = chain[:1] + ("...",) + chain[-(_MAX_CHAIN - 2):]
+    return "%s %s" % (root.kind, " -> ".join(chain))
+
+
+# ---------------------------------------------------------------------------
+# entry-held lock sets (the one-helper-deep contract)
+# ---------------------------------------------------------------------------
+def _entry_held(program, call_sites):
+    """fi -> locks provably held on EVERY package call path into it.
+
+    Only private (underscore) functions with at least one package call
+    site qualify — a public method is part of the API surface and may be
+    entered bare from anywhere, whatever internal callers hold."""
+    entry = {}
+    for _ in range(_ENTRY_ROUNDS):
+        changed = False
+        for fi, sites in call_sites.items():
+            if not fi.name.startswith("_") or _DUNDER.match(fi.name):
+                continue
+            held_sets = [
+                frozenset(held) | entry.get(caller, frozenset())
+                for caller, _call, held in sites]
+            new = frozenset.intersection(*held_sets) if held_sets \
+                else frozenset()
+            if new != entry.get(fi, frozenset()):
+                entry[fi] = new
+                changed = True
+        if not changed:
+            break
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# attribute access collection
+# ---------------------------------------------------------------------------
+def _self_aliases(ctx):
+    """Module-wide ``name -> class`` map from ``name = self`` bindings
+    (the ``gw = self`` closure idiom nested HTTP handlers use)."""
+    aliases = {}
+    dropped = set()
+    for fi_node in ctx.functions:
+        cls = ctx.class_of.get(id(fi_node))
+        if cls is None:
+            continue
+        for node in ast.walk(fi_node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if tgt.id in aliases and aliases[tgt.id] != cls:
+                            dropped.add(tgt.id)
+                        aliases[tgt.id] = cls
+    for name in dropped:
+        aliases.pop(name, None)
+    return aliases
+
+
+def _selfish_attr(node, fi, aliases):
+    """(cls_name, attr) when ``node`` is ``self.X`` / ``cls.X`` of the
+    enclosing class, or ``alias.X`` through a ``alias = self`` binding;
+    None otherwise."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    base = node.value
+    if not isinstance(base, ast.Name):
+        return None
+    if base.id in ("self", "cls"):
+        return (fi.cls, node.attr) if fi.cls else None
+    cls = aliases.get(base.id)
+    if cls is not None and base.id not in fi.ctx.params_of(fi.node):
+        return cls, node.attr
+    return None
+
+
+def _iter_source_attr(expr, fi, aliases):
+    """The (cls, attr) a for/comprehension/builtin iterates, if it is a
+    selfish attribute (directly or through .items()/.values()/.keys())."""
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr in _VIEW_METHODS and not expr.args:
+        expr = expr.func.value
+    return _selfish_attr(expr, fi, aliases)
+
+
+def _collect_accesses(program, entry_held):
+    """Every selfish attribute access in the program, with held locks."""
+    accesses = []
+    alias_cache = {}
+    for fi in program.functions:
+        if _DUNDER.match(fi.name) and fi.name != "__init__":
+            continue
+        ctx = fi.ctx
+        aliases = alias_cache.get(id(ctx))
+        if aliases is None:
+            aliases = alias_cache[id(ctx)] = _self_aliases(ctx)
+        in_init = fi.name == "__init__"
+        entry = entry_held.get(fi, frozenset())
+
+        def note(node, cls, attr, kind, held, with_node):
+            accesses.append(_Access(
+                (ctx.module_stem, cls), attr, kind, fi, node,
+                frozenset(held), in_init, with_node))
+
+        def visit(node, held, with_node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef,
+                                      ast.Lambda)):
+                    continue  # nested defs analyzed on their own
+                new_held, new_with = held, with_node
+                if isinstance(child, ast.With):
+                    labels = [program._lock_label(e, fi)
+                              for e in _lock_exprs(child)]
+                    fresh = [l for l in labels if l not in held]
+                    if fresh:
+                        new_held = held | frozenset(fresh)
+                        new_with = child
+                if isinstance(child, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)):
+                    tgts = child.targets if isinstance(child, ast.Assign) \
+                        else [child.target]
+                    for tgt in tgts:
+                        got = _selfish_attr(tgt, fi, aliases)
+                        if got is not None:
+                            note(tgt, got[0], got[1], "write", held,
+                                 with_node)
+                        elif isinstance(tgt, ast.Subscript):
+                            got = _selfish_attr(tgt.value, fi, aliases)
+                            if got is not None:
+                                note(tgt, got[0], got[1], "mut", held,
+                                     with_node)
+                elif isinstance(child, ast.Delete):
+                    for tgt in child.targets:
+                        got = _selfish_attr(tgt, fi, aliases)
+                        if got is not None:
+                            note(tgt, got[0], got[1], "write", held,
+                                 with_node)
+                        elif isinstance(tgt, ast.Subscript):
+                            got = _selfish_attr(tgt.value, fi, aliases)
+                            if got is not None:
+                                note(tgt, got[0], got[1], "mut", held,
+                                     with_node)
+                elif isinstance(child, ast.Call):
+                    func = child.func
+                    if isinstance(func, ast.Attribute) and \
+                            func.attr in _MUTATORS:
+                        got = _selfish_attr(func.value, fi, aliases)
+                        if got is not None:
+                            note(child, got[0], got[1], "mut", held,
+                                 with_node)
+                    elif isinstance(func, ast.Name) and \
+                            func.id in _ITERATING_BUILTINS and child.args:
+                        got = _iter_source_attr(child.args[0], fi,
+                                                aliases)
+                        if got is not None:
+                            note(child, got[0], got[1], "iter", held,
+                                 with_node)
+                elif isinstance(child, ast.For):
+                    got = _iter_source_attr(child.iter, fi, aliases)
+                    if got is not None:
+                        note(child.iter, got[0], got[1], "iter", held,
+                             with_node)
+                elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                        ast.DictComp, ast.GeneratorExp)):
+                    for gen in child.generators:
+                        got = _iter_source_attr(gen.iter, fi, aliases)
+                        if got is not None:
+                            note(gen.iter, got[0], got[1], "iter", held,
+                                 with_node)
+                elif isinstance(child, ast.Attribute) and \
+                        isinstance(child.ctx, ast.Load):
+                    got = _selfish_attr(child, fi, aliases)
+                    if got is not None:
+                        note(child, got[0], got[1], "read", held,
+                             with_node)
+                visit(child, new_held, new_with)
+
+        visit(fi.node, entry, None)
+    return accesses
+
+
+# ---------------------------------------------------------------------------
+# lock aliasing: a Condition shares its underlying Lock
+# ---------------------------------------------------------------------------
+def _lock_aliases(program):
+    """label -> canonical label, from ``self._cv =
+    threading.Condition(self._lock)`` bindings: the Condition and the
+    lock it wraps are ONE mutex, so ``with self._cv:`` and ``with
+    self._lock:`` exclude each other and must unify for guard
+    inference."""
+    alias = {}
+    for fi in program.functions:
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and node.value.args
+                    and _terminal_name(node.value.func) == "Condition"):
+                continue
+            src = program._lock_label(node.value.args[0], fi)
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Name)):
+                    dst = program._lock_label(tgt, fi)
+                    if src and dst and src != dst:
+                        alias[dst] = src
+
+    def resolve(label):
+        seen = set()
+        while label in alias and label not in seen:
+            seen.add(label)
+            label = alias[label]
+        return label
+
+    return {k: resolve(k) for k in alias}
+
+
+# ---------------------------------------------------------------------------
+# intent annotations
+# ---------------------------------------------------------------------------
+def _canon_guard(raw, cls_key, ctx):
+    """Canonicalize a guarded-by(<lock>) value to the interproc lock
+    label space: ``self._lock`` -> ``mod.Cls._lock``; a bare name ->
+    module global; an already-dotted label passes through."""
+    raw = raw.strip()
+    if raw.startswith("self.") or raw.startswith("cls."):
+        return "%s.%s.%s" % (cls_key[0], cls_key[1],
+                             raw.split(".", 1)[1])
+    if "." in raw:
+        return raw
+    return "%s.%s" % (cls_key[0], raw)
+
+
+def _annotations(program):
+    """(cls_key, attr) -> ('not-shared', None) | ('guarded-by', label),
+    read from assignment-line comments."""
+    out = {}
+    for fi in program.functions:
+        ctx = fi.ctx
+        if fi.cls is None:
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in tgts:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")):
+                    continue
+                # the comment may sit on any physical line of a
+                # multiline assignment (continuation/parenthesized)
+                m = None
+                for line in range(node.lineno,
+                                  getattr(node, "end_lineno",
+                                          node.lineno) + 1):
+                    if line - 1 >= len(ctx.lines):
+                        break
+                    m = _ANNOTATION.search(ctx.lines[line - 1])
+                    if m is not None:
+                        break
+                if m is None:
+                    continue
+                key = ((ctx.module_stem, fi.cls), tgt.attr)
+                if m.group("notshared"):
+                    out[key] = ("not-shared", None)
+                else:
+                    out[key] = ("guarded-by", _canon_guard(
+                        m.group("guard"), key[0], ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the shared analysis
+# ---------------------------------------------------------------------------
+class _RaceState:
+    __slots__ = ("roots", "chains", "by_attr", "annotations",
+                 "roots_of_cache", "findings")
+
+    def __init__(self):
+        self.roots = []
+        self.chains = {}          # root -> {fi: chain tuple}
+        self.by_attr = {}         # (cls_key, attr) -> [_Access]
+        self.annotations = {}
+        self.roots_of_cache = {}
+        self.findings = {}        # rule id -> [(path, node, col, msg)]
+
+    def roots_of(self, fi):
+        """Spawned roots reaching ``fi`` (or the caller pseudo-root)."""
+        got = self.roots_of_cache.get(fi)
+        if got is None:
+            got = tuple(r for r in self.roots
+                        if r.kind != "caller" and fi in self.chains[r])
+            if not got:
+                got = (self.roots[-1],)   # the caller pseudo-root
+            self.roots_of_cache[fi] = got
+        return got
+
+    def chain(self, root, fi):
+        if root.kind == "caller":
+            return "caller %s" % fi.qualname
+        return _chain_text(root, self.chains[root], fi)
+
+
+def _race_state(program):
+    got = getattr(program, "_race_state_cache", None)
+    if got is not None:
+        return got
+    program.finalize()
+    state = _RaceState()
+    call_sites = _call_sites(program)
+    state.roots = _find_roots(program, call_sites)
+    for root in state.roots:
+        state.chains[root] = _reachable(root, program)
+    # the caller pseudo-root, always last (see roots_of)
+    state.roots.append(_Root(None, "caller", 1, "caller"))
+    entry = _entry_held(program, call_sites)
+    aliases = _lock_aliases(program)
+    self_alias_cache = {}
+    for acc in _collect_accesses(program, entry):
+        if _lockish(ast.Name(id=acc.attr)):
+            continue   # the lock objects themselves: assigned once,
+            #            then only read — not shared *data*
+        if acc.held:
+            ctx = acc.fi.ctx
+            amap = self_alias_cache.get(id(ctx))
+            if amap is None:
+                amap = self_alias_cache[id(ctx)] = _self_aliases(ctx)
+            held = set()
+            for label in acc.held:
+                # unify `with gw._lock:` (alias = self closure) with
+                # the canonical `mod.Cls._lock` label
+                head, _, rest = label.partition(".")
+                if rest and head in amap:
+                    label = "%s.%s.%s" % (ctx.module_stem, amap[head],
+                                          rest)
+                held.add(aliases.get(label, label))
+            acc.held = frozenset(held)
+        state.by_attr.setdefault((acc.cls_key, acc.attr),
+                                 []).append(acc)
+    state.annotations = {
+        key: (kind, aliases.get(label, label) if label else None)
+        for key, (kind, label) in _annotations(program).items()}
+    _run_rules(program, state)
+    program._race_state_cache = state
+    return state
+
+
+def _attr_label(cls_key, attr):
+    return "%s.%s" % (cls_key[1], attr)
+
+
+def _lock_desc(held):
+    if not held:
+        return "no lock"
+    return " + ".join("'%s'" % l for l in sorted(held))
+
+
+def _majority_guard(accesses, annotation):
+    """The inferred guard label: the annotation when present, else the
+    most common lock label among guarded accesses (ties -> sorted
+    first)."""
+    if annotation is not None and annotation[0] == "guarded-by":
+        return annotation[1]
+    counts = {}
+    for acc in accesses:
+        for label in acc.held:
+            counts[label] = counts.get(label, 0) + 1
+    if not counts:
+        return None
+    top = max(counts.values())
+    return sorted(l for l, n in counts.items() if n == top)[0]
+
+
+def _access_word(kind):
+    return {"write": "write", "mut": "mutation", "read": "read",
+            "iter": "iteration"}[kind]
+
+
+def _witnesses(state, accesses, prefer=()):
+    """Up to two distinct root chains covering these accesses, writers
+    first."""
+    ordered = []
+    for acc in list(prefer) + list(accesses):
+        for root in state.roots_of(acc.fi):
+            entry = (root, acc.fi)
+            if entry not in ordered:
+                ordered.append(entry)
+    texts = []
+    seen_roots = set()
+    for root, fi in ordered:
+        if id(root) in seen_roots:
+            continue
+        seen_roots.add(id(root))
+        texts.append(state.chain(root, fi))
+        if len(texts) == 2:
+            break
+    return texts
+
+
+def _run_rules(program, state):
+    rc1, rc2, rc3, rc4 = [], [], [], []
+    for (cls_key, attr), accesses in sorted(
+            state.by_attr.items(),
+            key=lambda kv: (kv[0][0][0], kv[0][0][1], kv[0][1])):
+        annotation = state.annotations.get((cls_key, attr))
+        if annotation is not None and annotation[0] == "not-shared":
+            continue
+        live = [a for a in accesses if not a.in_init]
+        if not live:
+            continue
+        guard = _majority_guard(live, annotation)
+
+        # RC001: written from >= 2 concurrent roots, >= 1 bare access
+        writers = [a for a in live if _is_write_kind(a.kind)]
+        writer_roots = {}
+        for a in writers:
+            for root in state.roots_of(a.fi):
+                writer_roots[id(root)] = root
+        weight = sum(r.weight for r in writer_roots.values())
+        spawned = any(r.kind != "caller" for r in writer_roots.values())
+        if weight >= 2 and spawned:
+            bare = sorted((a for a in live if not a.held),
+                          key=lambda a: (a.fi.ctx.path, a.line, a.col))
+            if bare:
+                a = bare[0]
+                wits = _witnesses(state, live, prefer=writers)
+                hint = " (majority guard: '%s')" % guard if guard else ""
+                rc1.append((a.fi.ctx.path, a.node, None, _clip(
+                    "shared attribute '%s' is written from %d concurrent"
+                    " thread roots with an unguarded %s here%s; "
+                    "witnesses: %s. Guard every post-init access with "
+                    "one lock, or annotate its init-site "
+                    "'# mxlint: not-shared'."
+                    % (_attr_label(cls_key, attr), weight,
+                       _access_word(a.kind), hint, " | ".join(wits)))))
+
+        # RC002: two disjoint non-empty guard sets on one attribute
+        guarded = [a for a in live if a.held]
+        if guard is not None and guarded:
+            all_roots = {}
+            for a in live:
+                for root in state.roots_of(a.fi):
+                    all_roots[id(root)] = root
+            total_weight = sum(r.weight for r in all_roots.values())
+            if total_weight >= 2:
+                odd = sorted((a for a in guarded if guard not in a.held),
+                             key=lambda a: (a.fi.ctx.path, a.line,
+                                            a.col))
+                if odd:
+                    a = odd[0]
+                    n_major = sum(1 for x in guarded if guard in x.held)
+                    rc2.append((a.fi.ctx.path, a.node, None, _clip(
+                        "inconsistent guards for attribute '%s': %d "
+                        "access(es) hold '%s' but this %s holds %s; a "
+                        "thread under one lock cannot exclude writers "
+                        "under the other. Guard every access with one "
+                        "lock, or declare the intent "
+                        "'# mxlint: guarded-by(<lock>)'."
+                        % (_attr_label(cls_key, attr), n_major, guard,
+                           _access_word(a.kind), _lock_desc(a.held)))))
+
+        # RC004: iterated in one root, mutated in another, no common lock
+        iters = [a for a in live if a.kind == "iter"]
+        muts = [a for a in live if a.kind == "mut"]
+        hit = None
+        for it in sorted(iters, key=lambda a: (a.fi.ctx.path, a.line)):
+            for mu in sorted(muts,
+                             key=lambda a: (a.fi.ctx.path, a.line)):
+                if it.held & mu.held:
+                    continue
+                it_roots = state.roots_of(it.fi)
+                mu_roots = state.roots_of(mu.fi)
+                disjointish = [
+                    (ri, rm) for ri in it_roots for rm in mu_roots
+                    if ri is not rm or ri.weight >= 2]
+                if disjointish:
+                    hit = (it, mu, disjointish[0])
+                    break
+            if hit:
+                break
+        if hit is not None:
+            it, mu, (ri, rm) = hit
+            rc4.append((it.fi.ctx.path, it.node, None, _clip(
+                "container attribute '%s' is iterated under %s in [%s] "
+                "but mutated under %s in [%s] with no common lock: "
+                "concurrent mutation corrupts the iteration "
+                "(RuntimeError: changed size, or skipped entries). "
+                "Guard both sides with one lock, or iterate a snapshot "
+                "taken under it."
+                % (_attr_label(cls_key, attr), _lock_desc(it.held),
+                   state.chain(ri, it.fi), _lock_desc(mu.held),
+                   state.chain(rm, mu.fi)))))
+
+        # RC003: check-then-act across a released guard (per function)
+        rc3.extend(_check_then_act(program, cls_key, attr, live))
+
+    state.findings = {"RC001": rc1, "RC002": rc2, "RC003": rc3,
+                      "RC004": rc4}
+
+
+def _check_then_act(program, cls_key, attr, accesses):
+    """Detect: value read from the attribute under lock L and bound to a
+    name; a later ``if`` on that name gates a write to the same
+    attribute under a *new* acquisition of L."""
+    out = []
+    by_fn = {}
+    for a in accesses:
+        by_fn.setdefault(a.fi, []).append(a)
+    for fi, accs in by_fn.items():
+        ctx = fi.ctx
+        reads = []   # (bound name, access)
+        for a in accs:
+            if a.kind != "read" or not a.held or a.with_node is None:
+                continue
+            # the read must feed an Assign to a simple name
+            p = ctx._parents.get(a.node)
+            while p is not None and not isinstance(p, ast.stmt):
+                p = ctx._parents.get(p)
+            if isinstance(p, ast.Assign) and len(p.targets) == 1 and \
+                    isinstance(p.targets[0], ast.Name):
+                # ownership transfer, not a stale check: ``x =
+                # self.pool.popleft()`` REMOVES the value under the
+                # lock, so a later compensating write gated on x is
+                # safe by construction — skip mutator-sourced binds
+                if isinstance(p.value, ast.Call) and \
+                        isinstance(p.value.func, ast.Attribute) and \
+                        p.value.func.attr in _MUTATORS:
+                    continue
+                reads.append((p.targets[0].id, a))
+        if not reads:
+            continue
+        for a in accs:
+            if not _is_write_kind(a.kind) or not a.held or \
+                    a.with_node is None:
+                continue
+            for name, r in reads:
+                if a.with_node is r.with_node or a.line <= r.line:
+                    continue
+                if not (a.held & r.held):
+                    continue
+                # the write must sit under an if testing the bound name,
+                # and that if must start after the read's with closed
+                gate = None
+                p = ctx._parents.get(a.node)
+                while p is not None and p is not fi.node:
+                    if isinstance(p, ast.If) and any(
+                            isinstance(n, ast.Name) and n.id == name
+                            for n in ast.walk(p.test)):
+                        gate = p
+                    p = ctx._parents.get(p)
+                if gate is None or \
+                        gate.lineno < getattr(r.with_node, "end_lineno",
+                                              r.with_node.lineno):
+                    continue
+                out.append((ctx.path, a.node, None, _clip(
+                    "check-then-act on attribute '%s': the value read "
+                    "under %s at line %d gates this %s, but the lock "
+                    "was released in between — the check can go stale "
+                    "before the write lands. Do the read and the "
+                    "dependent write in one critical section (or "
+                    "re-validate under the lock)."
+                    % (_attr_label(cls_key, attr), _lock_desc(r.held),
+                       r.line, _access_word(a.kind)))))
+                break
+    return out
+
+
+def _yield_rule(program, rule_id):
+    for hit in _race_state(program).findings.get(rule_id, ()):
+        yield hit
+
+
+@register_program_rule("RC001", Severity.ERROR,
+                       "shared attribute written without its guard")
+def check_unguarded_shared_write(program):
+    """An attribute written from two or more concurrent thread roots
+    must hold one lock at every post-init access; a bare ``+=`` from a
+    handler thread silently loses increments under the GIL's bytecode
+    interleaving, and bare container writes corrupt readers.  The
+    finding is anchored at the unguarded access and names both thread
+    roots' witness chains."""
+    return _yield_rule(program, "RC001")
+
+
+@register_program_rule("RC002", Severity.ERROR,
+                       "inconsistent guards on one attribute")
+def check_inconsistent_guards(program):
+    """Accesses to one attribute under two different locks exclude
+    nothing: each critical section only excludes threads taking the
+    SAME lock.  The finding fires at the minority-lock access, with the
+    majority (or annotated) guard named."""
+    return _yield_rule(program, "RC002")
+
+
+@register_program_rule("RC003", Severity.ERROR,
+                       "check-then-act across a released guard")
+def check_check_then_act(program):
+    """Reading a value under a lock, releasing it, then writing based on
+    that value under a re-acquired lock is atomic-looking but racy: the
+    attribute can change between the two critical sections.  Fires at
+    the dependent write."""
+    return _yield_rule(program, "RC003")
+
+
+@register_program_rule("RC004", Severity.ERROR,
+                       "container iterated and mutated with no common "
+                       "lock")
+def check_iter_vs_mutate(program):
+    """A dict/set/list iterated in one thread root while another root
+    mutates it throws ``RuntimeError: ... changed size during
+    iteration`` at best — and at worst the iteration silently skips or
+    repeats entries.  Fires at the iteration with both witness
+    chains."""
+    return _yield_rule(program, "RC004")
+
+
+# ---------------------------------------------------------------------------
+# --explain-guards
+# ---------------------------------------------------------------------------
+def guard_map(paths):
+    """Build the program over ``paths`` and return the inferred guard
+    map: ``{ 'mod.Cls.attr': {guard, guarded, unguarded, annotation,
+    roots} }`` (the ``--explain-guards`` payload)."""
+    from .core import _Entry, iter_python_files
+    from .interproc import Program
+
+    program = Program()
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            entry = _Entry(f.read(), path)
+        if entry.tree is not None and not entry.skip:
+            program.add_module(entry.tree, entry.path, entry.lines)
+    program.finalize()
+    state = _race_state(program)
+    out = {}
+    for (cls_key, attr), accesses in state.by_attr.items():
+        live = [a for a in accesses if not a.in_init]
+        if not live:
+            continue
+        annotation = state.annotations.get((cls_key, attr))
+        guard = _majority_guard(live, annotation)
+        roots = {}
+        for a in live:
+            for root in state.roots_of(a.fi):
+                roots[id(root)] = "%s(%s)" % (root.kind, root.label)
+        key = "%s.%s.%s" % (cls_key[0], cls_key[1], attr)
+        out[key] = {
+            "guard": guard,
+            "guarded": sum(1 for a in live if a.held),
+            "unguarded": sum(1 for a in live if not a.held),
+            "annotation": None if annotation is None else (
+                annotation[0] if annotation[1] is None
+                else "%s(%s)" % annotation),
+            "roots": sorted(roots.values()),
+        }
+    return out
+
+
+def format_guard_map(mapping):
+    """Human-readable --explain-guards dump, one attribute per line."""
+    lines = ["== inferred guard map (%d shared attribute(s)) =="
+             % len(mapping)]
+    for key in sorted(mapping):
+        info = mapping[key]
+        bits = ["guard=%s" % (info["guard"] or "-"),
+                "%d guarded / %d unguarded" % (info["guarded"],
+                                               info["unguarded"])]
+        if info["annotation"]:
+            bits.append("annotated %s" % info["annotation"])
+        bits.append("roots: %s" % ", ".join(info["roots"]))
+        lines.append("%-48s %s" % (key, "  ".join(bits)))
+    return "\n".join(lines)

@@ -52,7 +52,10 @@ __all__ = ["LockOrderError", "install", "install_from_env", "uninstall",
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _THIS_FILE = os.path.abspath(__file__)
 _THREADING_FILE = os.path.abspath(threading.__file__)
-_INTERNAL_FILES = (_THIS_FILE, _THREADING_FILE)
+# racecheck wraps the same factories; when both sanitizers are armed
+# the creation-site walk must see through the sibling's frames too
+_INTERNAL_FILES = (_THIS_FILE, _THREADING_FILE,
+                   os.path.join(_PKG_DIR, "racecheck.py"))
 
 _MAX_EDGES = 4096     # order-graph size cap (creation-site pairs)
 _MAX_EVENTS = 128     # held-across-blocking ring cap
@@ -124,7 +127,7 @@ def _creation_site():
         if f is None:
             return None
         fname = os.path.abspath(f.f_code.co_filename)
-        if fname == _THREADING_FILE or fname == _THIS_FILE:
+        if fname in _INTERNAL_FILES:
             f = f.f_back
             continue
         if not fname.startswith(_PKG_DIR + os.sep):

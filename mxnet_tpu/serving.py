@@ -323,10 +323,13 @@ class ServingFuture:
         self.t_admit = t_admit
         self.clock = _clockmod.resolve(clock)
         self.job = None               # set when batched
-        self._outputs = None
-        self._error = None
+        # settle writes happen-before every reader: _settle() stores
+        # them, then _event.set() publishes, and readers gate on the
+        # event (done / result()) — no lock needed
+        self._outputs = None  # mxlint: not-shared — published via _event.set()
+        self._error = None  # mxlint: not-shared — published via _event.set()
         self._event = threading.Event()
-        self.t_done = None
+        self.t_done = None  # mxlint: not-shared — published via _event.set()
         # end-to-end request trace (docs/OBSERVABILITY.md): one async
         # chrome-trace span per admitted request, keyed by this id across
         # admission -> batch close -> dispatch -> hedge -> outcome
@@ -518,11 +521,15 @@ class CircuitBreaker:
         self.threshold = max(1, int(threshold))
         self.backoff = float(backoff)
         self.backoff_cap = float(backoff_cap)
-        self.state = self.CLOSED
+        # externally synchronized: every CircuitBreaker method runs
+        # under the owning ModelServer's _cv (the _locked helpers and
+        # the worker-loop settle blocks) — one replica, one breaker,
+        # one lock
+        self.state = self.CLOSED  # mxlint: not-shared — under owner's _cv
         self.failures = 0         # consecutive
         self.trips = 0
         self.reopen_at = None
-        self.probe_inflight = False
+        self.probe_inflight = False  # mxlint: not-shared — under owner's _cv
 
     def would_allow(self, now):
         """Non-mutating availability check (scheduler peek)."""
@@ -777,7 +784,8 @@ class ModelServer:
             for i in range(n_workers)]
         for t in self._threads:
             t.start()
-        self._state = SERVING
+        with self._cv:
+            self._state = SERVING
         # tagged memory accounting: every replica's bound weights/aux
         # (per-slice copies in sharded mode) under one tag (weakly held)
         from . import memory as _memory
@@ -829,12 +837,17 @@ class ModelServer:
                 # over its own mesh slice (its own param copy — slices
                 # are disjoint device groups)
                 for _ in range(int(num_replicas)):
-                    if not self._free_slices:
-                        raise ValueError(
-                            "mesh pool has %d slice(s); cannot build %d "
-                            "replicas" % (len(self._mesh_slices),
-                                          int(num_replicas)))
-                    m = self._free_slices.popleft()
+                    # claim the slice under the scheduler lock (reload
+                    # calls this while the scheduler is live); the
+                    # Predictor build below stays outside it
+                    with self._cv:
+                        if not self._free_slices:
+                            raise ValueError(
+                                "mesh pool has %d slice(s); cannot "
+                                "build %d replicas"
+                                % (len(self._mesh_slices),
+                                   int(num_replicas)))
+                        m = self._free_slices.popleft()
                     slices.append(m)
                     preds.append(Predictor(symbol, params, ctx=ctx,
                                            input_shapes=input_shapes,
@@ -859,7 +872,8 @@ class ModelServer:
     # -- public surface ----------------------------------------------------
     @property
     def state(self):
-        return self._state
+        with self._cv:
+            return self._state
 
     def queue_depth(self):
         with self._cv:

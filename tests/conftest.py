@@ -132,6 +132,29 @@ def pytest_terminal_summary(terminalreporter):
                         "  %s: %s [%s]" % (kind, e["site"], e["thread"]))
     except Exception:
         pass  # never let diagnostics fail the suite
+    try:
+        from mxnet_tpu import racecheck
+
+        if racecheck.installed():
+            snap = racecheck.snapshot()
+            terminalreporter.write_sep(
+                "-", "racecheck ledger (failures present)")
+            terminalreporter.write_line(
+                "field states: %s  counters: %s"
+                % ("  ".join("%s=%d" % kv for kv in
+                             sorted(snap["field_states"].items())),
+                   "  ".join("%s=%d" % kv for kv in
+                             sorted(snap["counters"].items()))))
+            for r in snap["races"]:
+                terminalreporter.write_line(
+                    "  %s.%s: %s at %s [%s, %s] vs %s at %s [%s, %s]"
+                    % (r["cls"], r["field"],
+                       r["access"]["kind"], r["access"]["at"],
+                       r["access"]["thread"], r["access"]["held"],
+                       r["prior"]["kind"], r["prior"]["at"],
+                       r["prior"]["thread"], r["prior"]["held"]))
+    except Exception:
+        pass  # never let diagnostics fail the suite
 
 
 @pytest.fixture(autouse=True)

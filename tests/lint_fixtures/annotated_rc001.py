@@ -1,0 +1,26 @@
+"""RC001 annotated twin: same two-root shape, but the attribute is
+declared not-shared at its init site (the writes are serialized by an
+external mechanism the analyzer cannot see), so RC001 stays quiet."""
+import threading
+import time
+
+
+class Collector:
+    def __init__(self):
+        self.hits = 0   # mxlint: not-shared — serialized by the runner
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="collector", daemon=True)
+        self._thread.start()
+
+    def _note(self):
+        self.hits += 1
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._note()
+            time.sleep(0.005)
+
+    def submit(self, item):
+        self.hits += 1
+        return item

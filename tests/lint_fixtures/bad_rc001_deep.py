@@ -1,0 +1,31 @@
+"""RC001 bad (inter-procedural): the monitor thread bumps the counter
+one helper deep while the public submit path bumps it too — no lock
+anywhere.  Shaped like the gateway stats-counter race; doubles as the
+runtime seed for the racecheck two-thread test."""
+import threading
+import time
+
+
+class Collector:
+    def __init__(self):
+        self.hits = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="collector", daemon=True)
+        self._thread.start()
+
+    def _note(self):
+        self.hits += 1
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._note()
+            time.sleep(0.005)
+
+    def submit(self, item):
+        self.hits += 1
+        return item
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

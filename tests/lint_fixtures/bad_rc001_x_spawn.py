@@ -1,0 +1,13 @@
+"""RC001 cross-module fixture, spawn half: registers the imported
+class's pump loop as a daemon thread target (paired with
+bad_rc001_x_stats.py)."""
+import threading
+
+from bad_rc001_x_stats import WireStats
+
+
+def start():
+    stats = WireStats()
+    t = threading.Thread(target=stats._pump_loop, daemon=True)
+    t.start()
+    return stats

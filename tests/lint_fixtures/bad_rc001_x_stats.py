@@ -1,0 +1,15 @@
+"""RC001 cross-module fixture, stats half: the class whose counter is
+written both by its pump loop and by the public path (paired with
+bad_rc001_x_spawn.py, which registers the loop as a thread target)."""
+
+
+class WireStats:
+    def __init__(self):
+        self.frames = 0
+
+    def _pump_loop(self):
+        while True:
+            self.frames += 1
+
+    def note_frame(self):
+        self.frames += 1

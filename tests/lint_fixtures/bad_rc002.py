@@ -1,0 +1,28 @@
+"""RC002 bad: the flush loop resets the counter under its own lock
+while the public paths use another — two disjoint guards on one
+attribute exclude nothing."""
+import threading
+import time
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self.entries = 0
+        t = threading.Thread(target=self._flush_loop, daemon=True)
+        t.start()
+
+    def append(self, item):
+        with self._lock:
+            self.entries += 1
+
+    def depth(self):
+        with self._lock:
+            return self.entries
+
+    def _flush_loop(self):
+        while True:
+            with self._flush_lock:
+                self.entries = 0
+            time.sleep(0.005)

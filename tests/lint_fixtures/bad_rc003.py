@@ -1,0 +1,28 @@
+"""RC003 bad: the free-slot count is read under the lock, the lock is
+released, and the dependent write re-acquires it — the check can go
+stale in the window."""
+import threading
+import time
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.free = 4
+        t = threading.Thread(target=self._reaper, daemon=True)
+        t.start()
+
+    def claim(self):
+        with self._lock:
+            avail = self.free
+        if avail > 0:
+            with self._lock:
+                self.free = avail - 1
+            return True
+        return False
+
+    def _reaper(self):
+        while True:
+            with self._lock:
+                self.free += 1
+            time.sleep(0.005)

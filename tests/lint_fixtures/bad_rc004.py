@@ -1,0 +1,26 @@
+"""RC004 bad: the sweep loop iterates the session table bare while the
+public close path mutates it under the lock — no common lock, so the
+iteration can see the dict change size under it."""
+import threading
+import time
+
+
+class SessionTable:
+    def __init__(self):
+        self.sessions = {}
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._sweep_loop, daemon=True)
+        t.start()
+
+    def close(self, sid):
+        with self._lock:
+            self.sessions.pop(sid, None)
+
+    def _sweep_loop(self):
+        while True:
+            for sid in list(self.sessions):
+                self._ping(sid)
+            time.sleep(0.005)
+
+    def _ping(self, sid):
+        return sid

@@ -1,0 +1,32 @@
+"""RC001 good twin: same two-root counter shape, every post-init
+access under the one lock."""
+import threading
+import time
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="collector", daemon=True)
+        self._thread.start()
+
+    def _note(self):
+        with self._lock:
+            self.hits += 1
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._note()
+            time.sleep(0.005)
+
+    def submit(self, item):
+        with self._lock:
+            self.hits += 1
+        return item
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,26 @@
+"""RC002 good twin: the flush loop and the public paths agree on one
+guard."""
+import threading
+import time
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = 0
+        t = threading.Thread(target=self._flush_loop, daemon=True)
+        t.start()
+
+    def append(self, item):
+        with self._lock:
+            self.entries += 1
+
+    def depth(self):
+        with self._lock:
+            return self.entries
+
+    def _flush_loop(self):
+        while True:
+            with self._lock:
+                self.entries = 0
+            time.sleep(0.005)

@@ -1,0 +1,25 @@
+"""RC003 good twin: the read and the dependent write share one
+critical section — the check cannot go stale."""
+import threading
+import time
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.free = 4
+        t = threading.Thread(target=self._reaper, daemon=True)
+        t.start()
+
+    def claim(self):
+        with self._lock:
+            if self.free > 0:
+                self.free -= 1
+                return True
+        return False
+
+    def _reaper(self):
+        while True:
+            with self._lock:
+                self.free += 1
+            time.sleep(0.005)

@@ -1,0 +1,27 @@
+"""RC004 good twin: the sweep loop iterates a snapshot taken under the
+same lock the close path mutates under."""
+import threading
+import time
+
+
+class SessionTable:
+    def __init__(self):
+        self.sessions = {}
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._sweep_loop, daemon=True)
+        t.start()
+
+    def close(self, sid):
+        with self._lock:
+            self.sessions.pop(sid, None)
+
+    def _sweep_loop(self):
+        while True:
+            with self._lock:
+                snapshot = list(self.sessions)
+            for sid in snapshot:
+                self._ping(sid)
+            time.sleep(0.005)
+
+    def _ping(self, sid):
+        return sid

@@ -6,7 +6,10 @@ CC003/CC004/CC005/TS007 positive, negative, suppressed, and
 cross-module, plus the one-helper-deep CC001 cases), the v3
 resource-lifecycle corpus (RL001–RL004: deep, cross-module, good twin,
 suppressed twin, and the two historical PR 5 bugs re-introduced as
-fixtures), suppression directives including ``disable-block``, the
+fixtures), the v4 data-race corpus (RC001–RC004: deep, cross-module,
+good twin, not-shared-annotated twin, suppressed twin, with
+exact-message pins and the --explain-guards guard map),
+suppression directives including ``disable-block``, the
 baseline ledger (module API and CLI, RL included in the ratchet), the
 JSON reporter schema, CLI exit codes, the jax-free contract, the
 MXNET_TRACE_GUARD runtime guard end-to-end, and the
@@ -36,6 +39,7 @@ ALL_RULES = ("TS001", "TS002", "TS003", "TS004", "TS005", "TS006",
              "CC001", "CC002")
 V2_RULES = ("TS007", "CC003", "CC004", "CC005")
 RL_RULES = ("RL001", "RL002", "RL003", "RL004")
+RC_RULES = ("RC001", "RC002", "RC003", "RC004")
 
 
 def _rules_hit(findings):
@@ -73,7 +77,7 @@ def test_rule_registry_complete():
         assert rule.summary and rule.doc
         assert rule.scope in ("module", "program")
     assert RULES["CC003"].scope == "program"
-    for r in RL_RULES:
+    for r in RL_RULES + RC_RULES:
         assert RULES[r].scope == "program"
         assert RULES[r].severity == Severity.ERROR
 
@@ -106,6 +110,11 @@ V2_BAD = [
     ("RL003", ("bad_rl003_drain.py",)),
     ("RL004", ("bad_rl004_deep.py",)),
     ("RL004", ("bad_rl004_x_caller.py", "bad_rl004_x_helper.py")),
+    ("RC001", ("bad_rc001_deep.py",)),
+    ("RC001", ("bad_rc001_x_spawn.py", "bad_rc001_x_stats.py")),
+    ("RC002", ("bad_rc002.py",)),
+    ("RC003", ("bad_rc003.py",)),
+    ("RC004", ("bad_rc004.py",)),
 ]
 
 V2_CLEAN = [
@@ -116,6 +125,8 @@ V2_CLEAN = [
     ("good_rl001.py",), ("good_rl002.py",), ("good_rl003.py",),
     ("good_rl004.py",), ("suppressed_rl001.py",), ("suppressed_rl002.py",),
     ("suppressed_rl003.py",), ("suppressed_rl004.py",),
+    ("good_rc001.py",), ("good_rc002.py",), ("good_rc003.py",),
+    ("good_rc004.py",), ("annotated_rc001.py",), ("suppressed_rc001.py",),
 ]
 
 
@@ -201,6 +212,80 @@ def test_rl002_and_rl004_anchor_at_the_second_release():
              if f.rule == "RL004"]
     assert "already reached a terminal outcome at line" in f4.message
     assert "exactly-once outcome contract" in f4.message
+
+
+def test_rc001_anchors_at_the_bare_access_with_both_witness_chains():
+    """Acceptance pin: the two-root counter race is anchored at the
+    unguarded write inside the helper, and the witnesses name both
+    thread-root chains (the spawned loop through the helper, and the
+    public caller path)."""
+    (f,) = [f for f in _lint_v2("bad_rc001_deep.py")
+            if f.rule == "RC001"]
+    assert "'Collector.hits'" in f.message
+    assert "written from 2 concurrent thread roots" in f.message
+    assert "unguarded write" in f.message
+    assert "thread bad_rc001_deep.Collector._loop -> " \
+           "bad_rc001_deep.Collector._note" in f.message
+    assert "caller" in f.message
+    assert "'# mxlint: not-shared'" in f.message
+    # anchored at the helper's bump, one call deep from the root
+    assert f.line == 18
+
+
+def test_rc001_cross_module_thread_target_resolved():
+    """The thread root lives in another module (Thread(target=
+    stats._pump_loop) on an imported instance); the race is still
+    rooted and reported in the class's module."""
+    (f,) = [f for f in _lint_v2("bad_rc001_x_spawn.py",
+                                "bad_rc001_x_stats.py")
+            if f.rule == "RC001"]
+    assert f.path.endswith("bad_rc001_x_stats.py")
+    assert "'WireStats.frames'" in f.message
+    assert "thread bad_rc001_x_stats.WireStats._pump_loop" in f.message
+
+
+def test_rc002_names_both_guards_and_the_majority_count():
+    (f,) = [f for f in _lint_v2("bad_rc002.py") if f.rule == "RC002"]
+    assert "inconsistent guards for attribute 'Journal.entries'" \
+        in f.message
+    assert "2 access(es) hold 'bad_rc002.Journal._lock'" in f.message
+    assert "this write holds 'bad_rc002.Journal._flush_lock'" in f.message
+    assert "'# mxlint: guarded-by(<lock>)'" in f.message
+
+
+def test_rc003_points_at_the_gated_write_and_names_the_read_line():
+    (f,) = [f for f in _lint_v2("bad_rc003.py") if f.rule == "RC003"]
+    assert "check-then-act on attribute 'SlotTable.free'" in f.message
+    assert "at line 17 gates this write" in f.message
+    assert "the lock was released in between" in f.message
+    assert "one critical section" in f.message
+    assert f.line == 20                    # the stale write, not the read
+
+
+def test_rc004_reports_both_sides_with_their_roots():
+    (f,) = [f for f in _lint_v2("bad_rc004.py") if f.rule == "RC004"]
+    assert "container attribute 'SessionTable.sessions'" in f.message
+    assert "iterated under no lock in " \
+           "[thread bad_rc004.SessionTable._sweep_loop]" in f.message
+    assert "mutated under 'bad_rc004.SessionTable._lock' in " \
+           "[caller bad_rc004.SessionTable.close]" in f.message
+    assert "iterate a snapshot" in f.message
+
+
+def test_rc_guard_map_reports_inferred_guards():
+    """The --explain-guards plumbing: guard_map infers the majority
+    guard for a disciplined attribute and reports the per-attribute
+    guarded/unguarded split with the thread roots."""
+    from mxnet_tpu.lint.races import format_guard_map, guard_map
+
+    mapping = guard_map([os.path.join(FIXTURES_V2, "good_rc001.py")])
+    info = mapping["good_rc001.Collector.hits"]
+    assert info["guard"] == "good_rc001.Collector._lock"
+    assert info["unguarded"] == 0 and info["guarded"] >= 2
+    assert any(r.startswith("thread") for r in info["roots"])
+    text = format_guard_map(mapping)
+    assert "good_rc001.Collector._lock" in text
+    assert "inferred guard map" in text
 
 
 def test_ts001_sees_through_a_helper():
@@ -465,6 +550,54 @@ def test_rl_rules_run_with_jax_unimportable(tmp_path):
     assert res.returncode == 1, res.stderr
     assert "RL001" in res.stdout
     assert "ImportError" not in res.stderr
+
+
+def test_rc_rules_run_with_jax_unimportable(tmp_path):
+    """The jax-free contract extends to the v4 data-race pass: with a
+    poisoned ``jax`` on PYTHONPATH, tools/mxlint still builds the
+    program, roots the threads (cross-module target resolution
+    included), and reports RC findings."""
+    (tmp_path / "jax.py").write_text(
+        "raise ImportError('jax must never be imported by mxlint')\n")
+    env = subprocess_env()
+    env["PYTHONPATH"] = "%s%s%s" % (tmp_path, os.pathsep,
+                                    env["PYTHONPATH"])
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint"),
+         os.path.join(FIXTURES_V2, "bad_rc001_x_spawn.py"),
+         os.path.join(FIXTURES_V2, "bad_rc001_x_stats.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1, res.stderr
+    assert "RC001" in res.stdout
+    assert "ImportError" not in res.stderr
+
+
+def test_cli_explain_guards_dump():
+    """--explain-guards prints the inferred guard map and exits 0
+    (an introspection mode, not a gate)."""
+    res = _run_cli("--explain-guards",
+                   os.path.join(FIXTURES_V2, "good_rc001.py"))
+    assert res.returncode == 0, res.stderr
+    assert "inferred guard map" in res.stdout
+    assert "good_rc001.Collector._lock" in res.stdout
+
+
+def test_cli_baseline_gates_rc_findings(tmp_path):
+    """RC findings ride the same ratchet: accepted via
+    --write-baseline, gated on the rerun, and any NEW race finding
+    still fails the run."""
+    bad = os.path.join(FIXTURES_V2, "bad_rc002.py")
+    ledger = str(tmp_path / "baseline.json")
+    res = _run_cli(bad)
+    assert res.returncode == 1 and "RC002" in res.stdout
+    res = _run_cli(bad, "--baseline", ledger, "--write-baseline")
+    assert res.returncode == 0, res.stderr
+    res = _run_cli(bad, "--baseline", ledger)
+    assert res.returncode == 0, res.stdout
+    res = _run_cli(bad, os.path.join(FIXTURES_V2, "bad_rc003.py"),
+                   "--baseline", ledger)
+    assert res.returncode == 1
+    assert "RC003" in res.stdout
 
 
 def test_cli_baseline_gates_rl_findings(tmp_path):

@@ -1,0 +1,505 @@
+"""Runtime lockset race sanitizer (mxnet_tpu.racecheck).
+
+Covers: the Eraser state machine on a real two-thread unguarded write
+(both witness sites and thread names), lock-discipline silence and the
+write-lockset deviation (unguarded main-thread reads never report),
+per-object lock identity (guarding with the wrong instance's lock is
+caught even from the same creation site), single-owner handoff
+exemption, record vs raise semantics, scope discipline (zero overhead
+when off), Condition integration across ``wait()``, the ``racecheck.*``
+telemetry gauges and debug-bundle section, id-reuse hygiene after GC,
+the env-arming pin, the static/dynamic acceptance handshake (the RC001
+lint fixture caught live by raise mode), and race-free regression runs
+over the serving-stack classes whose counter discipline mxlint v4
+fixed (Gateway, FleetWorker, WorkerSupervisor, FleetSupervisor).
+"""
+import gc
+import importlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import subprocess_env
+
+import mxnet_tpu  # noqa: F401  (install_from_env runs at import)
+from mxnet_tpu import debug, racecheck, telemetry
+from mxnet_tpu.racecheck import _LockToken
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _token(site, kind="Lock"):
+    real = threading._allocate_lock() if kind == "Lock" \
+        else threading._RLock()
+    return _LockToken(real, site, kind)
+
+
+def _boxcls():
+    @racecheck.track("ctr")
+    class Box:
+        def __init__(self):
+            self.ctr = 0
+
+    return Box
+
+
+def _wait(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError("timed out waiting for %s" % msg)
+
+
+@pytest.fixture
+def recording():
+    """Arm record mode for one test; restore the prior armed state
+    afterwards (the racecheck CI lane runs this file in raise mode)."""
+    was_installed = racecheck.installed()
+    prev_mode = racecheck.mode()
+    racecheck.install("record")
+    racecheck.reset()
+    try:
+        yield racecheck
+    finally:
+        if was_installed:
+            racecheck.install(prev_mode)
+        else:
+            racecheck.uninstall()
+        racecheck.reset()
+
+
+# ---------------------------------------------------------------------------
+# the Eraser core: detection, silence, identity, handoff
+# ---------------------------------------------------------------------------
+def test_two_thread_unguarded_write_detected(recording):
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: setattr(box, "ctr", 1),
+                         name="writer")
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    box.ctr = 2                   # second post-init writer thread
+    snap = racecheck.snapshot()
+    assert snap["counters"]["races"] == 1
+    (race,) = snap["races"]
+    assert race["cls"] == "Box" and race["field"] == "ctr"
+    # both witness accesses, each naming its site, thread, and lockset
+    assert race["access"]["thread"] == "MainThread"
+    assert race["prior"]["thread"] == "writer"
+    assert race["access"]["held"] == "no locks"
+    assert race["prior"]["held"] == "no locks"
+    assert "test_racecheck.py" in race["access"]["at"]
+    assert "test_racecheck.py" in race["prior"]["at"]
+
+
+def test_lock_disciplined_writes_and_bare_main_reads_stay_silent(recording):
+    Box = _boxcls()
+    lk = _token("box.py:1")
+    box = Box()
+
+    def bump():
+        with lk:
+            box.ctr += 1
+
+    t = threading.Thread(target=bump)
+    t.start()
+    t.join(timeout=10)
+    with lk:
+        box.ctr += 1
+    # the write-lockset deviation: a bare read of a lock-disciplined
+    # counter (main thread asserting after join) is happens-before
+    # ordered and must not report
+    assert box.ctr == 2
+    snap = racecheck.snapshot()
+    assert snap["counters"]["races"] == 0
+    assert snap["races"] == []
+
+
+def test_wrong_instance_lock_is_caught(recording):
+    """Locks are identified per object: two locks from the SAME creation
+    site (per-instance locks of one class) are still distinct, so
+    guarding instance A's counter with instance B's lock reports."""
+    Box = _boxcls()
+    a_lk, b_lk = _token("box.py:1"), _token("box.py:1")
+    box = Box()
+
+    def bump():
+        with a_lk:
+            box.ctr += 1
+
+    t = threading.Thread(target=bump, name="holder-a")
+    t.start()
+    t.join(timeout=10)
+    with b_lk:
+        box.ctr += 1
+    snap = racecheck.snapshot()
+    assert snap["counters"]["races"] == 1
+    (race,) = snap["races"]
+    assert "box.py:1" in race["access"]["held"]
+    assert "box.py:1" in race["prior"]["held"]
+
+
+def test_single_owner_handoff_stays_silent(recording):
+    Box = _boxcls()
+    box = Box()
+    box.ctr = 1                   # main builds it (exclusive phase)
+
+    def own():
+        for _ in range(50):
+            box.ctr += 1          # sole post-handoff writer
+
+    t = threading.Thread(target=own)
+    t.start()
+    t.join(timeout=10)
+    snap = racecheck.snapshot()
+    assert snap["counters"]["races"] == 0
+    assert snap["field_states"].get("shared-modified") == 1
+
+
+def test_read_sharing_refines_without_reporting(recording):
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: box.ctr)
+    t.start()
+    t.join(timeout=10)
+    snap = racecheck.snapshot()
+    assert snap["field_states"] == {"shared": 1}
+    assert snap["counters"]["races"] == 0
+    assert snap["counters"]["refinements"] >= 1
+
+
+def test_raise_mode_raises_at_the_racing_write_once(recording):
+    racecheck.install("raise")
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: setattr(box, "ctr", 1), name="w")
+    t.start()
+    t.join(timeout=10)
+    with pytest.raises(racecheck.RaceError, match="unsynchronized writes"):
+        box.ctr = 2
+    box.ctr = 3                   # reported once per field: no storm
+    assert racecheck.snapshot()["counters"]["races"] == 1
+
+
+def test_condition_integration_no_false_race(recording):
+    @racecheck.track("items")
+    class Q:
+        def __init__(self):
+            self.items = 0
+
+    cv = threading.Condition(_token("q.py:1", kind="RLock"))
+    q = Q()
+    done = []
+
+    def producer():
+        with cv:
+            q.items += 1
+            cv.notify_all()
+
+    def consumer():
+        with cv:
+            while q.items == 0:
+                cv.wait(timeout=5)
+            q.items -= 1          # reacquired via _acquire_restore
+            done.append(1)
+
+    t1 = threading.Thread(target=consumer)
+    t2 = threading.Thread(target=producer)
+    t1.start()
+    time.sleep(0.05)
+    t2.start()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert done == [1]
+    assert racecheck.snapshot()["counters"]["races"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: scope discipline, uninstall, GC hygiene
+# ---------------------------------------------------------------------------
+def test_off_mode_is_zero_overhead():
+    """With MXTPU_RACECHECK unset the decorator only records the
+    declaration — no hooks on the class, stdlib lock factories."""
+    if racecheck.installed():
+        pytest.skip("suite running under MXTPU_RACECHECK")
+    Box = _boxcls()
+    assert "__getattribute__" not in vars(Box)
+    assert "__setattr__" not in vars(Box)
+    box = Box()
+    box.ctr += 1
+    assert racecheck.snapshot()["counters"]["accesses"] == 0
+    from mxnet_tpu import lockdep
+
+    if not lockdep.installed():
+        assert threading.Lock is racecheck._real_Lock
+        assert threading.RLock is racecheck._real_RLock
+
+
+def test_uninstall_restores_factories_and_hooks(recording):
+    Box = _boxcls()
+    assert "__getattribute__" in vars(Box)
+    prev = racecheck._prev_Lock
+    racecheck.uninstall()
+    assert threading.Lock is prev
+    racecheck.reset()
+    box = Box()
+    box.ctr += 1                  # de-instrumented: nothing counted
+    assert racecheck.snapshot()["counters"]["accesses"] == 0
+    # tokens already handed out keep delegating, silently
+    lk = _token("stale.py:1")
+    with lk:
+        pass
+
+
+def test_collected_instance_states_are_dropped(recording):
+    """id() reuse hygiene: a collected instance's field states (writer
+    threads, locksets) must not be inherited by a new allocation."""
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: setattr(box, "ctr", 1))
+    t.start()
+    t.join(timeout=10)
+    assert racecheck.snapshot()["field_states"]
+    del box, t
+    gc.collect()
+    assert racecheck.snapshot()["field_states"] == {}
+
+
+# ---------------------------------------------------------------------------
+# telemetry, debug bundle, env arming
+# ---------------------------------------------------------------------------
+def test_telemetry_gauges_exported(recording):
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: setattr(box, "ctr", 1))
+    t.start()
+    t.join(timeout=10)
+    box.ctr = 2
+    racecheck.snapshot()
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert gauges["racecheck.races"] == 1.0
+    assert gauges["racecheck.accesses"] >= 3.0
+    assert gauges["racecheck.fields_tracked"] == 1.0
+
+
+def test_debug_bundle_section_roundtrip(recording, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DEBUG_BUNDLE_DIR", str(tmp_path))
+    Box = _boxcls()
+    box = Box()
+    t = threading.Thread(target=lambda: setattr(box, "ctr", 1))
+    t.start()
+    t.join(timeout=10)
+    box.ctr = 2
+    path = debug.write_bundle("racecheck_test", force=True)
+    assert path
+    section = json.loads(open(path).read())["sections"]["racecheck"]
+    assert section["mode"] == "record"
+    assert section["counters"]["races"] == 1
+    assert len(section["races"]) == 1
+    assert json.dumps(section)                     # JSON-clean
+
+
+def test_install_from_env_instruments_framework_classes():
+    """End-to-end pin: under MXTPU_RACECHECK=record the package arms the
+    sanitizer before its first lock exists and before any tracked class
+    is defined, so the serving classes come out instrumented and
+    framework locks come out as identity tokens; foreign locks do not."""
+    code = (
+        "import threading\n"
+        "import mxnet_tpu\n"
+        "from mxnet_tpu import racecheck, telemetry\n"
+        "from mxnet_tpu.gateway import Gateway\n"
+        "from mxnet_tpu.fleet_worker import FleetWorker\n"
+        "assert racecheck.installed() and racecheck.mode() == 'record'\n"
+        "assert '__getattribute__' in vars(Gateway)\n"
+        "assert '__setattr__' in vars(FleetWorker)\n"
+        "wrapped = type(telemetry.registry()._lock).__name__\n"
+        "assert wrapped == '_LockToken', wrapped\n"
+        "assert racecheck.snapshot()['counters']['locks_created'] > 0\n"
+        "foreign = threading.Lock()  # created outside mxnet_tpu\n"
+        "assert type(foreign).__name__ != '_LockToken'\n"
+        "print('RACECHECK_ENV_OK')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        env=subprocess_env(MXTPU_RACECHECK="record"),
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "RACECHECK_ENV_OK" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# static/dynamic acceptance handshake: the RC001 lint fixture, live
+# ---------------------------------------------------------------------------
+def test_static_race_fixture_is_caught_at_runtime(recording):
+    """The same monitor-loop-vs-submit shape mxlint's RC001 flags
+    statically (tests/lint_fixtures/bad_rc001_deep.py) trips the
+    lockset sanitizer when actually run under raise mode."""
+    racecheck.install("raise")
+    sys.path.insert(0, FIXTURES)
+    try:
+        sys.modules.pop("bad_rc001_deep", None)
+        mod = importlib.import_module("bad_rc001_deep")
+    finally:
+        sys.path.remove(FIXTURES)
+    Collector = racecheck.track("hits")(mod.Collector)
+    c = Collector()               # starts the daemon bump loop
+    try:
+        _wait(lambda: c.hits > 0, timeout=10, msg="monitor loop to bump")
+        with pytest.raises(racecheck.RaceError,
+                           match="unsynchronized writes to Collector.hits"):
+            for _ in range(2000):
+                c.submit(1)       # the unguarded main-thread write
+                time.sleep(0.001)
+    finally:
+        c.stop()
+    assert racecheck.snapshot()["counters"]["races"] == 1
+
+
+# ---------------------------------------------------------------------------
+# serving-stack regressions: the counter discipline mxlint v4 fixed
+# ---------------------------------------------------------------------------
+def test_gateway_counters_race_free_under_concurrent_traffic(recording):
+    """Two-thread regression for the gateway/worker stats fixes: real
+    handler threads bump the tracked counters while a reader thread
+    snapshots — all under the armed detector, which must stay silent,
+    and the lock-disciplined counts must come out exact."""
+    import http.client
+
+    from mxnet_tpu.fleet import ServiceRegistry
+    from mxnet_tpu.fleet_worker import FleetWorker, demo_model
+    from mxnet_tpu.gateway import Gateway
+
+    def _post(addr, path, obj, timeout=60):
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        try:
+            conn.request("POST", path, body=json.dumps(obj).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    reg = ServiceRegistry(service="racegw", ttl_s=2.0)
+    worker = FleetWorker(demo_model(), "w0", registry=reg,
+                         heartbeat_s=0.05).start()
+    gw = Gateway(registry=reg, refresh_s=0.05, suspect_s=0.2)
+    try:
+        _wait(lambda: gw._view is not None and "w0" in gw._view.replicas,
+              msg="gateway to see w0")
+        n, errs = 8, []
+
+        def fire():
+            try:
+                status, _ = _post(gw.addr, "/v1/predict",
+                                  {"inputs": {"data": [[1.0, 2.0,
+                                                        3.0, 4.0]]}})
+                if status != 200:
+                    errs.append(status)
+            except Exception as e:                 # noqa: BLE001
+                errs.append(e)
+
+        stop_reads = threading.Event()
+
+        def read_loop():
+            while not stop_reads.is_set():
+                gw.snapshot()
+                worker.snapshot()
+                time.sleep(0.001)
+
+        reader = threading.Thread(target=read_loop)
+        reader.start()
+        posters = [threading.Thread(target=fire) for _ in range(n)]
+        for t in posters:
+            t.start()
+        for t in posters:
+            t.join(timeout=60)
+        stop_reads.set()
+        reader.join(timeout=10)
+        assert not errs
+        assert gw.requests == n   # every bump at-site under the lock
+        assert worker.requests >= n
+        assert racecheck.snapshot()["races"] == []
+    finally:
+        gw.stop()
+        worker.shutdown(drain_timeout=30)
+        reg.close()
+
+
+def test_worker_supervisor_proc_table_churn_race_free(recording):
+    """Two-thread regression for the ``_procs_lock`` fix: pollers
+    iterate the process table from other threads while the monitor
+    respawns killed workers through it."""
+    from mxnet_tpu.fleet import WorkerSupervisor
+
+    spec = {"w0": [sys.executable, "-c", "import time; time.sleep(30)"]}
+    sup = WorkerSupervisor(spec, max_restarts=100, backoff=0.01,
+                           poll_s=0.01)
+    try:
+        _wait(lambda: sup.alive() == ["w0"], msg="w0 up")
+        stop = threading.Event()
+
+        def poll_loop():
+            while not stop.is_set():
+                sup.alive()
+                sup.pid("w0")
+                sup.snapshot()
+                time.sleep(0.001)
+
+        pollers = [threading.Thread(target=poll_loop) for _ in range(2)]
+        for t in pollers:
+            t.start()
+        for k in range(1, 4):
+            assert sup.kill_worker("w0") == "w0"
+            _wait(lambda: sup.restarts >= k, msg="respawn %d" % k)
+        stop.set()
+        for t in pollers:
+            t.join(timeout=10)
+        assert sup.kills == 3 and sup.restarts >= 3
+        assert racecheck.snapshot()["races"] == []
+    finally:
+        sup.stop(timeout=10)
+
+
+class _FakeServer:
+    """The slice of the ModelServer surface FleetSupervisor's loops
+    read (one healthy idle replica, nothing offered)."""
+
+    def num_active_replicas(self):
+        return 1
+
+    def snapshot(self):
+        return {"state": "serving", "queue_depth": 0, "shed": 0,
+                "admitted": 0, "free_slices": 0,
+                "replicas": [{"id": 0, "breaker": "closed",
+                              "inflight": 0, "devices": 1}]}
+
+
+def test_fleet_supervisor_withdraws_published_set_cleanly(recording):
+    """Two-thread regression for the ``_pub_lock`` fix: stop() iterates
+    the published set the heartbeat thread was filling, and every
+    published id is withdrawn (clean deregistration, not a TTL lapse)."""
+    from mxnet_tpu.fleet import FleetSupervisor, ServiceRegistry
+
+    reg = ServiceRegistry(service="racefleet", ttl_s=30.0)
+    sup = FleetSupervisor(_FakeServer(), registry=reg, heartbeat_s=0.01,
+                          interval_s=0.02, idle_down_s=60.0,
+                          cooldown_s=60.0)
+    try:
+        _wait(lambda: sup.heartbeats >= 5, msg="heartbeats flowing")
+        assert len(reg.view(reap=False)) == 1
+    finally:
+        sup.stop()
+    assert len(reg.view(reap=False)) == 0
+    assert racecheck.snapshot()["races"] == []
+    reg.close()
