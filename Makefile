@@ -10,6 +10,7 @@
 #   make lint       -> mxlint static analysis (docs/STATIC_ANALYSIS.md)
 #   make lockdep-smoke-> runtime lock-order sanitizer lane (MXTPU_LOCKDEP=raise)
 #   make race-smoke -> runtime lockset race sanitizer lane (MXTPU_RACECHECK=raise)
+#   make tenant-smoke-> multi-tenant serving plane: routes, quotas, autoscaling
 #   make chaos      -> seeded fault-injection matrix (docs/NUMERICAL_HEALTH.md)
 #   make serve-smoke-> overload-safe serving lane (docs/SERVING.md)
 #   make gen-smoke  -> continuous-batching decode lane (docs/GENERATIVE.md)
@@ -51,6 +52,9 @@ lockdep-smoke:
 race-smoke:
 	bash ci/runtime_functions.sh racecheck_check
 
+tenant-smoke:
+	bash ci/runtime_functions.sh tenant_check
+
 chaos:
 	bash ci/runtime_functions.sh chaos_check
 
@@ -90,4 +94,4 @@ ci:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native cpp test test-fast lint lockdep-smoke race-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke migrate-smoke sim-smoke obs-smoke debug-smoke ci clean
+.PHONY: all native cpp test test-fast lint lockdep-smoke race-smoke tenant-smoke chaos serve-smoke gen-smoke kernel-smoke fleet-smoke gateway-smoke failover-smoke migrate-smoke sim-smoke obs-smoke debug-smoke ci clean

@@ -610,6 +610,9 @@ def main(argv=None):
     def migration_leg():
         return migration_bench(quick=quick)
 
+    def tenant_leg():
+        return tenant_bench(quick=quick)
+
     # quick (CPU-oracle) budgets are compile-dominated — the sentinel leg
     # builds a second XLA module — so some exceed their full-mode numbers
     legs = [
@@ -663,6 +666,12 @@ def main(argv=None):
     # under the >10% tripwire; < 1.0 means the handoff beats re-prefill)
     if os.environ.get("BENCH_MIGRATION", "1") != "0":
         legs.append(("migration", migration_leg, 60 if quick else 150))
+    # the tenant leg runs in quick mode too: the multi-tenant serving
+    # plane is accepted on the deterministic SimFleet scale-up-lag A/B
+    # (tenant_scaleup_lag_{reactive,predictive}_ms, lower-better under
+    # the tripwire) with the noisy-neighbor isolation ratio alongside
+    if os.environ.get("BENCH_TENANT", "1") != "0":
+        legs.append(("tenant", tenant_leg, 75 if quick else 120))
     if not quick and os.environ.get("BENCH_LONGCTX", "1") != "0":
         legs.append(("longctx", longctx_leg, 150))
     if os.environ.get("BENCH_SERVING", "1") == "0":
@@ -1098,6 +1107,142 @@ def loadreplay_bench(quick=False):
         out["loadreplay_knee_rps"] = loadgen.shed_knee(report.curve())
     finally:
         srv.drain(timeout=30)
+    return out
+
+
+def tenant_bench(quick=False):
+    """Multi-tenant serving leg (docs/SHARDED_SERVING.md "Multi-tenant
+    serving").  Two halves:
+
+    * isolation — a three-tenant weighted trace replayed against a real
+      in-process :class:`GenerationServer` twice on the same seed: once
+      clean, once with a mid-burst ``tenant_flood`` storm from the
+      tightly quota'd ``bulk`` tenant.  ``tenant_isolation_ratio`` is
+      the victim (gold/free) TTFT p99 under flood over clean — 1.0 is
+      perfect isolation — and ``tenant_flood_shed_rate`` how much of
+      the flooder's offered load was typed ``QuotaExceeded``.  Both are
+      wall-clock noisy on a shared box, so neither carries a tripwire
+      suffix; the strict deterministic <10% proof is the SimFleet test
+      in tests/test_tenancy.py.
+    * scale-up lag A/B — the same seeded burst trace through SimFleet
+      reactive then predictive.  ``tenant_scaleup_lag_reactive_ms`` /
+      ``tenant_scaleup_lag_predictive_ms`` (mean ms from first raw
+      breach tick to the scale-up fire; 0 = capacity ordered before the
+      breach) are fully deterministic, so both sit under the >10%
+      lower-better regression tripwire.
+    """
+    import jax
+
+    from mxnet_tpu import loadgen, serving, simfleet, tenancy
+    from mxnet_tpu.generation import GenerationConfig, GenerationServer
+    from mxnet_tpu.models import TransformerConfig, TransformerLM
+
+    out = {}
+    tenants = [{"name": "gold", "weight": 4},
+               {"name": "free", "weight": 2},
+               {"name": "bulk", "weight": 1}]
+
+    # -- isolation: real server, quota-contained flood ----------------
+    vocab = 1024
+    cfg = TransformerConfig(vocab_size=vocab, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_len=128,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    max_new = 8 if quick else 16
+    gcfg = GenerationConfig(page_size=16, max_pages=128,
+                            max_slots=4 if quick else 8,
+                            max_new_tokens=max_new)
+    srv = GenerationServer(model, params, gcfg, max_queue=16)
+    try:
+        spec = loadgen.TraceSpec(
+            seed=31,
+            segments=[{"duration_s": 4.0 if quick else 6.0,
+                       "rate_rps": 8.0 if quick else 12.0}],
+            prompt_len_mean=6.0, prompt_len_max=24,
+            output_len_mean=float(max_new), output_len_max=max_new,
+            tenants=tenants)
+        trace = loadgen.generate_trace(spec)
+        target = loadgen.generation_target(srv, vocab=vocab)
+        # warm every prefill bucket before anything is timed (no
+        # governor yet: nothing sheds during warmup)
+        loadgen.replay(trace, target, speed=float("inf"), name="warm")
+
+        def run(chaos_spec):
+            tenancy.reset_governor(tenancy.TenantGovernor(
+                quotas={"bulk": tenancy.TenantSpec("bulk", rate=2.0,
+                                                   burst=2.0)}))
+            serving.brownout().reset()
+            try:
+                if chaos_spec:
+                    from mxnet_tpu import chaos
+                    with chaos.inject(chaos_spec):
+                        return loadgen.replay(trace, target, speed=2.0,
+                                              name="tenant")
+                return loadgen.replay(trace, target, speed=2.0,
+                                      name="tenant")
+            finally:
+                tenancy.reset_governor()
+                serving.brownout().reset()
+
+        def victim_p99(report):
+            ttfts = [r["ttft_ms"] for r in report.records
+                     if r["tenant"] in ("gold", "free")
+                     and r["outcome"] == "ok"
+                     and r["ttft_ms"] is not None]
+            return loadgen._pctl(ttfts, 99) if ttfts else None
+
+        base = run(None)
+        bulk_idx = [i for i, r in enumerate(trace)
+                    if r["tenant"] == "bulk"]
+        steps = bulk_idx[len(bulk_idx) // 2:len(bulk_idx) // 2 + 3]
+        flood = run(",".join("tenant_flood@%d" % s for s in steps))
+
+        p99_base, p99_flood = victim_p99(base), victim_p99(flood)
+        if p99_base and p99_flood:
+            out["tenant_isolation_ratio"] = round(p99_flood / p99_base,
+                                                  4)
+        else:
+            out["tenant_status_detail"] = ("victims produced no ok "
+                                           "TTFTs: base=%s flood=%s"
+                                           % (base.outcome_counts(),
+                                              flood.outcome_counts()))
+        bulk = flood.tenant_summary().get("bulk", {})
+        out["tenant_flood_shed_rate"] = round(
+            bulk.get("shed_quota", 0) / max(1, bulk.get("requests", 1)),
+            4)
+    finally:
+        srv.drain(timeout=30)
+
+    # -- scale-up lag: reactive vs predictive on one seeded trace -----
+    burst = loadgen.generate_trace(loadgen.TraceSpec(
+        seed=33, segments=[{"duration_s": 3.0, "rate_rps": 2.0},
+                           {"duration_s": 6.0, "rate_rps": 60.0}]))
+
+    def lags(predict):
+        tenancy.reset_governor(tenancy.TenantGovernor(quotas={}))
+        serving.brownout().reset()
+        try:
+            with simfleet.SimFleet(burst, initial_replicas=2,
+                                   max_replicas=12, seed=5,
+                                   predict=predict,
+                                   predict_horizon_s=4.0,
+                                   predict_depth_up=6) as fleet:
+                res = fleet.run()
+        finally:
+            tenancy.reset_governor()
+            serving.brownout().reset()
+        return res["supervisor"]["scaleup_lags_ms"]
+
+    r_lags, p_lags = lags(False), lags(True)
+    if r_lags:
+        out["tenant_scaleup_lag_reactive_ms"] = round(
+            sum(r_lags) / len(r_lags), 1)
+    if p_lags:
+        out["tenant_scaleup_lag_predictive_ms"] = round(
+            sum(p_lags) / len(p_lags), 1)
+    out["tenant_scaleups_reactive"] = len(r_lags)
+    out["tenant_scaleups_predictive"] = len(p_lags)
     return out
 
 

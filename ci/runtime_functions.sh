@@ -74,6 +74,28 @@ racecheck_check() {
     fi
 }
 
+tenant_check() {
+    # Multi-tenant serving plane (docs/SHARDED_SERVING.md "Multi-tenant
+    # serving"): hostile-header hardening, the TenantGovernor's
+    # token-bucket/fair-share/exemption admission, the named-route +
+    # adapter hot-swap spawned acceptance scenario, the tenant_flood /
+    # adapter_swap_mid_burst chaos kinds, and the reactive-vs-predictive
+    # autoscaling A/B in SimFleet.  All three runtime sanitizers ride in
+    # raise mode: the governor's bucket lock, the worker's multi-route
+    # stats lock, and the adapter-swap path cross handler threads, the
+    # heartbeat loop, and the scheduler loop.
+    MXTPU_RACECHECK=raise MXTPU_LOCKDEP=raise MXTPU_LEAKCHECK=raise \
+        python -m pytest tests/test_tenancy.py \
+        tests/test_tenant_serving.py -q -m "not slow"
+    # the admission-path modules must lint clean with no suppressions
+    python -m mxnet_tpu.lint mxnet_tpu/tenancy.py \
+        mxnet_tpu/fleet_worker.py mxnet_tpu/gateway.py mxnet_tpu/fleet.py
+    if grep -n "mxlint: disable" mxnet_tpu/tenancy.py; then
+        echo "tenancy.py must not carry mxlint suppressions" >&2
+        return 1
+    fi
+}
+
 unittest_core() {
     python -m pytest tests/test_operator.py tests/test_operator_corpus.py \
         tests/test_operator_extra.py tests/test_random.py \
@@ -503,6 +525,7 @@ all() {
     chaos_check
     lockdep_check
     racecheck_check
+    tenant_check
     multichip_dryrun
 }
 

@@ -96,6 +96,7 @@ __all__ = ["ChaosPlan", "ChaosDataset", "inject", "active",
            "gateway_partition", "worker_kill",
            "worker_kill_mid_decode", "page_pressure",
            "migrate_interrupt", "drain_migrate",
+           "tenant_flood", "adapter_swap_mid_burst",
            "InjectedReplicaCrash"]
 
 FAULT_KINDS = frozenset({
@@ -106,6 +107,7 @@ FAULT_KINDS = frozenset({
     "gateway_partition", "worker_kill",
     "worker_kill_mid_decode", "page_pressure",
     "migrate_interrupt", "drain_migrate",
+    "tenant_flood", "adapter_swap_mid_burst",
 })
 
 
@@ -453,6 +455,34 @@ def drain_migrate(n, streams):
     if plan is None or streams < 1:
         return False
     return plan.fire("drain_migrate", n)
+
+
+def tenant_flood(n, factor=8):
+    """``tenant_flood@N``: multiplier for the Nth load-generator wave's
+    *single noisiest tenant* (1 otherwise) — one tenant suddenly offers
+    ``factor``x its traffic while everyone else stays steady.  The
+    noisy-neighbor isolation contract: the flooder sheds typed
+    ``QuotaExceeded`` at its token-bucket/fair-share limits and every
+    other tenant's TTFT p99 stays put (docs/SHARDED_SERVING.md
+    "Multi-tenant serving")."""
+    plan = active()
+    if plan is not None and plan.fire("tenant_flood", n):
+        return int(factor)
+    return 1
+
+
+def adapter_swap_mid_burst(n, adapters):
+    """``adapter_swap_mid_burst@N``: True when the worker's Nth
+    heartbeat should hot-swap a route's resident adapter while traffic
+    is in flight — an operator rollout at the worst moment.  Gated on
+    ``adapters >= 1`` resident adapters BEFORE consuming the plan item,
+    so the fault waits for a swappable worker.  The atomic hot-swap
+    contract absorbs it: in-flight streams keep their typed outcomes
+    and the recompile counter does not move."""
+    plan = active()
+    if plan is None or adapters < 1:
+        return False
+    return plan.fire("adapter_swap_mid_burst", n)
 
 
 def page_pressure(n, frac=0.9):

@@ -77,6 +77,17 @@ _DEF_P99_UP_MS = float(os.environ.get("MXTPU_FLEET_P99_UP_MS", "0"))
 _DEF_IDLE_DOWN_S = float(os.environ.get("MXTPU_FLEET_IDLE_DOWN_S", "2.0"))
 _DEF_COOLDOWN_S = float(os.environ.get("MXTPU_FLEET_COOLDOWN_S", "1.0"))
 _DEF_BREACH_TICKS = int(os.environ.get("MXTPU_FLEET_BREACH_TICKS", "2"))
+# predictive autoscaling (docs/SHARDED_SERVING.md "Multi-tenant
+# serving"): scale on the EWMA'd queue-depth slope so capacity arrives
+# BEFORE the shed-rate breach — off by default, swept in SimFleet
+_DEF_PREDICT = os.environ.get("MXTPU_FLEET_PREDICT", "0") not in \
+    ("0", "", "false")
+_DEF_PREDICT_ALPHA = float(os.environ.get(
+    "MXTPU_FLEET_PREDICT_ALPHA", "0.4"))
+_DEF_PREDICT_HORIZON_S = float(os.environ.get(
+    "MXTPU_FLEET_PREDICT_HORIZON_S", "3.0"))
+_DEF_PREDICT_DEPTH_UP = float(os.environ.get(
+    "MXTPU_FLEET_PREDICT_DEPTH_UP", "8"))
 # sticky-session rebalancer (docs/SHARDED_SERVING.md "Live migration"):
 # a worker whose inflight exceeds the fleet median by more than BAND
 # gets up to MAX streams parked for migration, then COOLDOWN_S of peace
@@ -269,7 +280,8 @@ class FleetSupervisor:
                  min_replicas=None, max_replicas=None,
                  shed_up=None, p99_up_ms=None, idle_down_s=None,
                  cooldown_s=None, breach_ticks=None, start=True,
-                 clock=None):
+                 clock=None, predict=None, predict_alpha=None,
+                 predict_horizon_s=None, predict_depth_up=None):
         self.server = server
         self.clock = _clock.resolve(clock)
         self.registry = registry if registry is not None \
@@ -291,6 +303,15 @@ class FleetSupervisor:
             else float(cooldown_s)
         self.breach_ticks = max(1, _DEF_BREACH_TICKS if breach_ticks
                                 is None else int(breach_ticks))
+        self.predict = _DEF_PREDICT if predict is None else bool(predict)
+        self.predict_alpha = _DEF_PREDICT_ALPHA if predict_alpha is None \
+            else float(predict_alpha)
+        self.predict_horizon_s = (_DEF_PREDICT_HORIZON_S
+                                  if predict_horizon_s is None
+                                  else float(predict_horizon_s))
+        self.predict_depth_up = (_DEF_PREDICT_DEPTH_UP
+                                 if predict_depth_up is None
+                                 else float(predict_depth_up))
         if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas "
                              "(got %d..%d)" % (self.min_replicas,
@@ -312,6 +333,16 @@ class FleetSupervisor:
         self._idle_since = None
         self._cooldown_until = 0.0
         self._beat_seq = 0
+        # predictive-scaling state (control-thread-only): EWMA'd queue-
+        # depth slope, the clock reading of the first tick of the
+        # current raw-breach episode (the scaleup-lag anchor), and the
+        # per-decision lags — the reactive-vs-predictive evidence
+        self._last_depth = None
+        self._last_tick_t = None
+        self._depth_slope = 0.0
+        self._raw_breach_since = None
+        self.predictive_ups = 0
+        self.scaleup_lags_ms = []
         # the one cross-thread set: the heartbeat thread adds ids, the
         # control thread discards on scale-down, and stop() (any
         # thread) iterates it for withdrawal — so it gets its own lock
@@ -373,7 +404,19 @@ class FleetSupervisor:
             "breach_streak": self._breach_streak,
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
+            "predict": self.predict,
+            "predictive_ups": self.predictive_ups,
+            "depth_slope": round(self._depth_slope, 4),
+            "scaleup_lags_ms": self.scaleup_lags(),
         }
+
+    def scaleup_lags(self):
+        """Per-scale-up lag (ms from the first raw breach tick of the
+        episode; 0 for a pre-breach predictive fire) — the
+        reactive-vs-predictive figure of merit, read per-supervisor so
+        bench A/Bs never mix runs through the process histogram."""
+        with self._pub_lock:
+            return [round(v, 1) for v in self.scaleup_lags_ms]
 
     # -- heartbeat thread --------------------------------------------------
     def _heartbeat_loop(self):
@@ -461,29 +504,51 @@ class FleetSupervisor:
         idle = offered == 0 and depth == 0 and inflight == 0
         # the same breach bit that drives autoscaling feeds the brownout
         # ladder: scaling adds capacity over seconds, brownout sheds load
-        # NOW and steps back down as the clear streak accumulates
+        # NOW and steps back down as the clear streak accumulates.
+        # Predictive forecasts do NOT feed it — brownout degrades live
+        # traffic, and a forecast is not yet pain.
         _serving.brownout().observe(breach)
 
+        # EWMA'd queue-depth slope: a rising queue forecasts the breach
+        # the shed-rate signal only reports after the fact
+        if self._last_depth is not None and self._last_tick_t is not None \
+                and now > self._last_tick_t:
+            raw_slope = (depth - self._last_depth) \
+                / (now - self._last_tick_t)
+            a = self.predict_alpha
+            self._depth_slope = a * raw_slope + (1 - a) * self._depth_slope
+        self._last_depth, self._last_tick_t = depth, now
+        pred_breach = bool(
+            self.predict and self._depth_slope > 0
+            and depth + self._depth_slope * self.predict_horizon_s
+            >= self.predict_depth_up)
+        reg.gauge("fleet.depth_slope").set(round(self._depth_slope, 4))
+
         if breach:
+            if self._raw_breach_since is None:
+                self._raw_breach_since = now    # scaleup-lag anchor
             self._breach_streak += 1
             self._idle_since = None
         else:
+            self._raw_breach_since = None
             self._breach_streak = 0
-            if idle:
+            if idle and not pred_breach:
                 if self._idle_since is None:
                     self._idle_since = now
             else:
                 self._idle_since = None
 
-        if breach and self._breach_streak >= self.breach_ticks \
+        reactive_fire = breach and self._breach_streak >= self.breach_ticks
+        if (reactive_fire or pred_breach) \
                 and n < self.max_replicas and now >= self._cooldown_until:
-            self._scale_up(n)
+            self._scale_up(n, now=now,
+                           predicted=pred_breach and not reactive_fire)
         elif (not breach) and self._idle_since is not None \
                 and now - self._idle_since >= self.idle_down_s \
                 and n > self.min_replicas and now >= self._cooldown_until:
             self._scale_down(n)
 
-    def _scale_up(self, n):
+    def _scale_up(self, n, now=None, predicted=False):
         t0 = self.clock.now()
         try:
             rid = self.server.add_replica()
@@ -505,9 +570,25 @@ class FleetSupervisor:
         self._cooldown_until = self.clock.now() + self.cooldown_s
         _count("fleet_scale_ups")
         _telemetry.registry().histogram("fleet.scaleup_ms").observe(dt_ms)
-        _log("scale UP %d -> %d (replica %d, %.0fms; shed_rate=%.3f "
-             "p99=%.1fms)" % (n, n + 1, rid, dt_ms, self.shed_rate,
-                              self.p99_ms))
+        # scaleup lag: how long the fleet had been in raw breach before
+        # this capacity decision fired.  A predictive fire lands at 0 —
+        # capacity arrived BEFORE the breach — which is exactly the
+        # reactive-vs-predictive figure of merit SimFleet sweeps.
+        lag_ms = 0.0
+        if self._raw_breach_since is not None and now is not None:
+            lag_ms = max(0.0, (now - self._raw_breach_since) * 1e3)
+        if predicted:
+            self.predictive_ups += 1
+            _count("fleet_predictive_ups")
+        with self._pub_lock:
+            self.scaleup_lags_ms.append(lag_ms)
+        _telemetry.registry().histogram("fleet.scaleup_lag_ms").observe(
+            lag_ms)
+        _log("scale UP %d -> %d (replica %d, %.0fms%s, lag %.0fms; "
+             "shed_rate=%.3f p99=%.1fms)"
+             % (n, n + 1, rid, dt_ms,
+                ", predictive" if predicted else "", lag_ms,
+                self.shed_rate, self.p99_ms))
 
     def _scale_down(self, n):
         try:

@@ -28,7 +28,13 @@ Contracts this entrypoint honors:
   instead of double-executing, so a gateway retry after a lost reply is
   safe.
 
-HTTP surface (JSON bodies; one typed terminal outcome per request):
+HTTP surface (JSON bodies; one typed terminal outcome per request).
+A worker hosts one or more **named model routes** (``model@version``
+style, docs/SHARDED_SERVING.md "Multi-tenant serving"): every verb
+below also exists route-qualified as ``POST /v1/<route>/<verb>``, the
+bare form aliasing route ``"default"``.  An unhosted route is a typed
+404 ``UnknownRoute``; requests carry the validated ``X-MXTPU-Tenant``
+header (malformed -> typed 400 ``BadTenant``, never a 500).
 
 * ``POST /v1/predict``  — ``{"inputs": {name: nested-list}, ...}`` ->
   ``{"outputs": [...]}`` or ``{"error": <ServingError name>}``.
@@ -37,6 +43,10 @@ HTTP surface (JSON bodies; one typed terminal outcome per request):
   terminal ``{"done": true, ...}`` or ``{"error": ...}`` line — or a
   non-terminal ``{"migrate": handle, ...}`` line when the stream was
   parked for live migration (the gateway carries it to a sibling).
+* ``POST /v1/<route>/adapter`` — ``{"adapter": name}`` hot-swaps the
+  route's resident adapter over the atomic hot-swap contract (same
+  structure/shape/dtype params -> zero recompiles, asserted via the
+  ``recompiles`` field the response and ``/healthz`` both carry).
 * ``POST /v1/migrate_out`` — ``{"park": n}`` parks up to n streams and
   returns their handles; ``{"handle": h}`` exports one parked stream as
   a base64 KV blob (docs/SHARDED_SERVING.md "Live migration").
@@ -67,7 +77,8 @@ import numpy as np
 
 from . import racecheck as _racecheck
 
-__all__ = ["FleetWorker", "demo_model", "demo_generation", "main"]
+__all__ = ["FleetWorker", "demo_model", "demo_generation", "demo_duo",
+           "main"]
 
 _DEF_HEARTBEAT_S = float(os.environ.get(
     "MXTPU_FLEET_WORKER_HEARTBEAT_S", "0.25"))
@@ -101,6 +112,11 @@ _ERROR_STATUS = {
     "Draining": 503,
     "Unavailable": 503,
     "ReplicaLost": 502,
+    # per-tenant shed: the flooding tenant's own outcome — 429 so naive
+    # clients back off, but the gateway never spills it to a sibling
+    "QuotaExceeded": 429,
+    # no worker hosts the named route: a client error, not capacity
+    "UnknownRoute": 404,
 }
 
 
@@ -121,7 +137,8 @@ class _IdemEntry:
 
 
 @_racecheck.track("requests", "idem_replays", "streams_parked",
-                  "migrations_in", "migrations_aborted")
+                  "migrations_in", "migrations_aborted",
+                  "adapter_swaps")
 class FleetWorker:
     """One worker process's runtime: HTTP endpoint + registry heartbeat
     around a built ``ModelServer``/``GenerationServer``.
@@ -133,14 +150,34 @@ class FleetWorker:
 
     def __init__(self, server, rid, registry=None, registry_addr=None,
                  service="default", host="127.0.0.1", port=0,
-                 heartbeat_s=None, idem_cache=None):
+                 heartbeat_s=None, idem_cache=None, adapters=None):
         from .fleet import ServiceRegistry
+        from .tenancy import parse_route
 
-        self.server = server
+        # ``server`` is one server (hosted as route "default") or a
+        # {route: server} dict — several builders multiplexed behind one
+        # worker process, each addressable as POST /v1/<route>/<verb>
+        if isinstance(server, dict):
+            if not server:
+                raise ValueError("route map must host at least one server")
+            self.servers = {parse_route(r): s for r, s in server.items()}
+        else:
+            self.servers = {"default": server}
+        self.kinds = {r: ("generate"
+                          if type(s).__name__ == "GenerationServer"
+                          else "predict")
+                      for r, s in self.servers.items()}
+        # back-compat: single-route callers keep .server / .kind
+        _first = next(iter(self.servers))
+        self.server = self.servers[_first]
+        self.kind = self.kinds[_first]
+        # resident adapter sets: {route: {name: params-or-factory}};
+        # factories are called once and cached so a swap is O(assign)
+        self._adapters = {parse_route(r): dict(a)
+                          for r, a in (adapters or {}).items()}
+        self._adapter_live = {r: "base" for r in self._adapters}
+        self.adapter_swaps = 0
         self.rid = str(rid)
-        self.kind = ("generate"
-                     if type(server).__name__ == "GenerationServer"
-                     else "predict")
         self.registry = registry if registry is not None else \
             ServiceRegistry(addr=registry_addr, service=service)
         self.heartbeat_s = _DEF_HEARTBEAT_S if heartbeat_s is None \
@@ -217,19 +254,27 @@ class FleetWorker:
         then keep the HTTP endpoint alive until the gateway has fetched
         every parked blob (or a bounded wait expires and the leftovers
         fall back to journal resume).  Returns how many streams parked."""
-        if self.kind != "generate" \
-                or not hasattr(self.server, "park_streams"):
+        gens = [s for r, s in self.servers.items()
+                if self.kinds[r] == "generate"
+                and hasattr(s, "park_streams")]
+        if not gens:
             return 0
         try:
             self.registry.withdraw(self.rid)
         except Exception:
             pass
-        try:
-            handles = self.server.park_streams()
-        except Exception as e:
-            _log("drain park failed (%s: %s) — falling back to plain "
-                 "drain" % (type(e).__name__, e))
-            return 0
+        handles = []
+        parked_srvs = []
+        for srv in gens:
+            try:
+                hs = srv.park_streams()
+            except Exception as e:
+                _log("drain park failed (%s: %s) — falling back to plain "
+                     "drain" % (type(e).__name__, e))
+                continue
+            if hs:
+                handles.extend(hs)
+                parked_srvs.append(srv)
         if not handles:
             return 0
         with self._stats_lock:
@@ -241,7 +286,8 @@ class FleetWorker:
         deadline = time.monotonic() + wait_s
         while time.monotonic() < deadline:
             try:
-                if not self.server.snapshot().get("parked"):
+                if not any(s.snapshot().get("parked")
+                           for s in parked_srvs):
                     break
             except Exception:
                 break
@@ -255,43 +301,68 @@ class FleetWorker:
             self.registry.withdraw(self.rid)
         except Exception:
             pass                  # registry may be partitioned/gone
-        self.server.drain(timeout=drain_timeout)
+        for srv in self.servers.values():
+            srv.drain(timeout=drain_timeout)
         self.httpd.shutdown()
         self.httpd.server_close()
         for t in self._threads:
             if t.is_alive() and t is not threading.current_thread():
                 t.join(timeout=5.0)
 
+    @staticmethod
+    def _srv_inflight(kind, snap):
+        if kind == "generate":
+            return snap.get("pending", 0) + snap.get("active", 0)
+        return sum(r["inflight"] for r in snap["replicas"]) \
+            + snap.get("queue_depth", 0)
+
     def snapshot(self):
         from . import profiler as _prof
 
-        snap = self.server.snapshot()
-        if self.kind == "generate":
-            inflight = snap.get("pending", 0) + snap.get("active", 0)
-        else:
-            inflight = sum(r["inflight"] for r in snap["replicas"]) \
-                + snap.get("queue_depth", 0)
+        inflight = parked = 0
+        state = None
+        for route, srv in self.servers.items():
+            snap = srv.snapshot()
+            inflight += self._srv_inflight(self.kinds[route], snap)
+            parked += snap.get("parked", 0)
+            # one lifecycle for the whole worker: all routes drain
+            # together, so any non-SERVING route is the worker's state
+            if state is None or snap["state"] != "SERVING":
+                state = snap["state"]
         with self._stats_lock:
             stats = {"requests": self.requests,
                      "idem_replays": self.idem_replays,
                      "streams_parked": self.streams_parked,
                      "migrations_in": self.migrations_in,
-                     "migrations_aborted": self.migrations_aborted}
+                     "migrations_aborted": self.migrations_aborted,
+                     "adapter_swaps": self.adapter_swaps,
+                     "adapter_live": dict(self._adapter_live)}
         return {"rid": self.rid, "kind": self.kind, "addr": self.addr,
-                "pid": os.getpid(), "state": snap["state"],
+                "pid": os.getpid(), "state": state,
                 "inflight": inflight, "beats": self.beats,
                 "beats_failed": self.beats_failed,
+                # route advertisement: the gateway routes on nothing but
+                # these heartbeats, so hosted routes + resident adapter
+                # sets travel in every load report
+                "routes": dict(self.kinds),
+                "adapters": {r: sorted(a)
+                             for r, a in self._adapters.items()},
                 **stats,
-                "parked": snap.get("parked", 0),
+                "parked": parked,
                 # the zero-recompile assertion reaches across the
                 # process boundary through /healthz
                 "recompiles": _prof.dispatch_value("recompile")}
 
     # -- heartbeat ---------------------------------------------------------
     def _heartbeat_loop(self):
+        from . import chaos as _chaos
+
         while not self._stop_evt.is_set():
             beat = self._beat_seq
             self._beat_seq += 1
+            n_adapters = sum(len(a) for a in self._adapters.values())
+            if _chaos.adapter_swap_mid_burst(beat, n_adapters):
+                self._chaos_adapter_swap()
             try:
                 snap = self.snapshot()
                 snap["beat"] = beat
@@ -328,9 +399,10 @@ class FleetWorker:
             self._idem.pop(key, None)
 
     # -- request handling --------------------------------------------------
-    def _handle_predict(self, body):
+    def _handle_predict(self, body, srv=None):
         from . import serving
 
+        srv = self.server if srv is None else srv
         key = body.get("idempotency_key")
         ent = owner = None
         if key:
@@ -345,8 +417,10 @@ class FleetWorker:
         try:
             inputs = {name: np.asarray(v, np.float32)
                       for name, v in dict(body["inputs"]).items()}
-            out = self.server.submit(
-                inputs, deadline_ms=body.get("deadline_ms"))
+            out = srv.submit(
+                inputs, deadline_ms=body.get("deadline_ms"),
+                priority=body.get("priority"),
+                tenant=body.get("tenant"))
             resp = {"outputs": [np.asarray(o).tolist() for o in out],
                     "rid": self.rid}
             status = 200
@@ -357,7 +431,8 @@ class FleetWorker:
                     "rid": self.rid}
             status = _ERROR_STATUS.get(type(e).__name__, 500)
             if ent is not None:
-                if isinstance(e, (serving.Overloaded, serving.Draining)):
+                if isinstance(e, (serving.Overloaded, serving.Draining,
+                                  serving.QuotaExceeded)):
                     # pre-admission rejection: nothing executed, a retry
                     # elsewhere/later must not replay the rejection
                     ent.settle(status, body=resp)
@@ -373,12 +448,13 @@ class FleetWorker:
                 self._idem_forget(key)
         return status, resp
 
-    def _handle_generate(self, body, write_line):
+    def _handle_generate(self, body, write_line, srv=None):
         """Run one generation request, streaming one NDJSON line per
         token through ``write_line``.  Returns the list of lines (for
         idempotent replay) — the last line is the typed terminal."""
         from . import serving
 
+        srv = self.server if srv is None else srv
         key = body.get("idempotency_key")
         ent = owner = None
         if key:
@@ -401,22 +477,23 @@ class FleetWorker:
         resume = body.get("resume_from")
         if resume:
             cap = int(body.get("max_new_tokens")
-                      or self.server.cfg.max_new_tokens)
+                      or srv.cfg.max_new_tokens)
             if len(resume) >= cap:
                 # the dead worker generated everything but its terminal
                 # line — nothing left to decode, finish the stream here
                 mh = body.get("migrate_handle")
-                if mh and hasattr(self.server, "release_import"):
-                    self.server.release_import(mh)  # nothing to attach
+                if mh and hasattr(srv, "release_import"):
+                    srv.release_import(mh)  # nothing to attach
                 emit({"done": True, "tokens": 0, "rid": self.rid})
                 if ent is not None:
                     ent.settle(200, lines=lines)
                 return
         try:
-            # resume_from (gateway mid-decode failover) and priority
-            # (QoS class from the X-MXTPU-Priority header) pass through
-            # verbatim — docs/SHARDED_SERVING.md "Failure matrix"
-            fut = self.server.submit_async(
+            # resume_from (gateway mid-decode failover), priority (QoS
+            # class from X-MXTPU-Priority) and tenant (X-MXTPU-Tenant,
+            # validated at the front door) pass through verbatim —
+            # docs/SHARDED_SERVING.md "Failure matrix"
+            fut = srv.submit_async(
                 np.asarray(body["prompt"], np.int32),
                 max_new_tokens=body.get("max_new_tokens"),
                 deadline_ms=body.get("deadline_ms"),
@@ -425,7 +502,8 @@ class FleetWorker:
                 seed=body.get("seed"),
                 priority=body.get("priority"),
                 resume_from=body.get("resume_from"),
-                migrate_handle=body.get("migrate_handle"))
+                migrate_handle=body.get("migrate_handle"),
+                tenant=body.get("tenant"))
         except serving.ServingError as e:
             emit({"error": type(e).__name__, "message": str(e),
                   "rid": self.rid})
@@ -466,7 +544,7 @@ class FleetWorker:
                 self._idem_forget(key)
 
     # -- live migration (docs/SHARDED_SERVING.md "Live migration") ---------
-    def _handle_migrate_out(self, body):
+    def _handle_migrate_out(self, body, srv=None):
         """Sender side.  ``{"park": n}`` parks up to n streams (their
         in-flight ``/v1/generate`` handlers emit the ``migrate`` lines);
         ``{"handle": h}`` exports one parked stream as a base64 blob —
@@ -474,9 +552,10 @@ class FleetWorker:
         handle returns 404 and the gateway falls back to resume."""
         import base64
 
+        srv = self.server if srv is None else srv
         if "handle" in body:
             try:
-                blob = self.server.export_stream(str(body["handle"]))
+                blob = srv.export_stream(str(body["handle"]))
             except KeyError:
                 return 404, {"error": "UnknownHandle", "rid": self.rid}
             except Exception as e:
@@ -486,7 +565,7 @@ class FleetWorker:
                          "rid": self.rid}
         n = body.get("park")
         try:
-            handles = self.server.park_streams(
+            handles = srv.park_streams(
                 None if n in (None, "all") else int(n))
         except Exception as e:
             return 500, {"error": "Internal", "message": "%s: %s"
@@ -497,7 +576,7 @@ class FleetWorker:
             _count("fleet_worker_parked", len(handles))
         return 200, {"handles": list(handles), "rid": self.rid}
 
-    def _handle_migrate_in(self, body):
+    def _handle_migrate_in(self, body, srv=None):
         """Receiver side: app-level chunked upload (the stdlib server
         cannot parse chunked request bodies).  ``key`` is the transfer's
         idempotency key; the final chunk assembles + installs the blob
@@ -508,6 +587,7 @@ class FleetWorker:
 
         from . import leakcheck, serving
 
+        srv = self.server if srv is None else srv
         try:
             key = str(body["key"])
             seq = int(body["seq"])
@@ -541,7 +621,7 @@ class FleetWorker:
         leakcheck.untrack("migrations", key)
         blob = b"".join(buf["chunks"][i] for i in range(total))
         try:
-            handle = self.server.import_stream(blob)
+            handle = srv.import_stream(blob)
         except ValueError as e:
             # corrupt/mismatched blob: checksum-or-version fallback —
             # the gateway degrades to re-prefill resume
@@ -565,13 +645,14 @@ class FleetWorker:
                 self._migr_done.popitem(last=False)
         return status, dict(resp)
 
-    def _handle_migrate_abort(self, body):
+    def _handle_migrate_abort(self, body, srv=None):
         """Transfer-abort: drop a half-assembled buffer by ``key`` (and
         release its install if the final chunk already landed), and/or
         release a staged import by ``handle``.  Idempotent — aborting an
         unknown transfer is a no-op, not an error."""
         from . import leakcheck
 
+        srv = self.server if srv is None else srv
         dropped = False
         key = body.get("key")
         if key is not None:
@@ -584,25 +665,26 @@ class FleetWorker:
             if done is not None and done[0] == 200 \
                     and "handle" in done[1]:
                 # installed, but the gateway gave up before attaching
-                dropped = self.server.release_import(
+                dropped = srv.release_import(
                     done[1]["handle"]) or dropped
         handle = body.get("handle")
         if handle is not None \
-                and hasattr(self.server, "release_import"):
-            dropped = self.server.release_import(str(handle)) or dropped
+                and hasattr(srv, "release_import"):
+            dropped = srv.release_import(str(handle)) or dropped
         if dropped:
             with self._stats_lock:
                 self.migrations_aborted += 1
             _count("fleet_worker_migrations_aborted")
         return 200, {"aborted": bool(dropped), "rid": self.rid}
 
-    def _handle_defrag(self, body):
+    def _handle_defrag(self, body, srv=None):
         """In-worker defrag: migrate fragmented streams to this server
         itself, compacting page tables toward low page ids."""
         from . import serving
 
+        srv = self.server if srv is None else srv
         try:
-            moved = self.server.defrag()
+            moved = srv.defrag()
         except serving.ServingError as e:
             return _ERROR_STATUS.get(type(e).__name__, 500), \
                 {"error": type(e).__name__, "message": str(e),
@@ -611,6 +693,77 @@ class FleetWorker:
             return 500, {"error": "Internal", "message": "%s: %s"
                          % (type(e).__name__, e), "rid": self.rid}
         return 200, {"moved": int(moved), "rid": self.rid}
+
+    # -- adapter hot-multiplexing ------------------------------------------
+    def _resolve_adapter(self, route, name):
+        """Adapter params for (route, name); factories are called once
+        and the materialized params cached in place."""
+        params = self._adapters[route][name]
+        if callable(params):
+            params = params()          # blocking init: outside any lock
+            with self._stats_lock:     # key set is fixed after __init__;
+                self._adapters[route][name] = params  # value swap only
+        return params
+
+    def _handle_adapter(self, body, srv=None, route=None):
+        """``{"adapter": name}`` hot-swaps ``route``'s resident weights
+        over the atomic hot-swap contract — ``swap_params`` for a
+        generation server, ``reload(params=...)`` for a model server.
+        The response carries the process recompile counter before and
+        after: equal values are the zero-recompile proof, asserted by
+        the acceptance test across the process boundary."""
+        from . import profiler as _prof
+        from . import serving
+
+        srv = self.server if srv is None else srv
+        route = route or next(r for r, s in self.servers.items()
+                              if s is srv)
+        name = str(body.get("adapter", ""))
+        if name not in self._adapters.get(route, ()):
+            return 404, {"error": "UnknownAdapter",
+                         "message": "route %r hosts adapters %s"
+                         % (route,
+                            sorted(self._adapters.get(route, ()))),
+                         "rid": self.rid}
+        before = _prof.dispatch_value("recompile")
+        try:
+            params = self._resolve_adapter(route, name)
+            if hasattr(srv, "swap_params"):
+                srv.swap_params(params)
+            else:
+                srv.reload(params=params)
+        except (ValueError, serving.ServingError) as e:
+            return 409, {"error": "BadAdapter", "message": str(e),
+                         "rid": self.rid}
+        except Exception as e:
+            return 500, {"error": "Internal", "message": "%s: %s"
+                         % (type(e).__name__, e), "rid": self.rid}
+        with self._stats_lock:
+            self.adapter_swaps += 1
+            self._adapter_live[route] = name
+        _count("fleet_worker_adapter_swaps")
+        return 200, {"adapter": name, "route": route, "rid": self.rid,
+                     "recompiles_before": before,
+                     "recompiles_after": _prof.dispatch_value("recompile")}
+
+    def _chaos_adapter_swap(self):
+        """``adapter_swap_mid_burst@n`` fault: cycle the first
+        adapter-bearing route to its next resident adapter, exactly the
+        way an operator rollout would, while traffic is in flight."""
+        for route in self._adapters:
+            names = sorted(self._adapters[route])
+            if not names:
+                continue
+            with self._stats_lock:
+                live = self._adapter_live.get(route)
+            nxt = names[(names.index(live) + 1) % len(names)] \
+                if live in names else names[0]
+            status, resp = self._handle_adapter(
+                {"adapter": nxt}, srv=self.servers[route], route=route)
+            _log("chaos adapter_swap_mid_burst: route %s -> %s (%d)"
+                 % (route, nxt, status))
+            return status == 200
+        return False
 
     def _sweep_migr_buffers(self):
         """Expire abandoned chunk buffers (gateway died mid-transfer)
@@ -662,12 +815,47 @@ class FleetWorker:
                 prio = self.headers.get("X-MXTPU-Priority")
                 if prio:
                     body.setdefault("priority", prio)
-                if self.path == "/v1/predict" \
-                        and worker.kind == "predict":
-                    status, resp = worker._handle_predict(body)
+                # tenant rides the X-MXTPU-Tenant header (or the body,
+                # on gateway-forwarded requests): validated HERE so a
+                # hostile value is a typed 400, never a handler 500
+                from .tenancy import parse_route, parse_tenant
+
+                try:
+                    body["tenant"] = parse_tenant(
+                        body.get("tenant",
+                                 self.headers.get("X-MXTPU-Tenant")))
+                except ValueError as e:
+                    self._json(400, {"error": "BadTenant",
+                                     "message": str(e)})
+                    return
+                # /v1/<verb> aliases /v1/default/<verb>
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "v1":
+                    route, verb = "default", parts[1]
+                elif len(parts) == 3 and parts[0] == "v1":
+                    route, verb = parts[1], parts[2]
+                else:
+                    self._json(404, {"error": "NotFound",
+                                     "message": "no %s here" % self.path})
+                    return
+                try:
+                    route = parse_route(route)
+                except ValueError as e:
+                    self._json(404, {"error": "UnknownRoute",
+                                     "message": str(e)})
+                    return
+                srv = worker.servers.get(route)
+                if srv is None:
+                    self._json(404, {"error": "UnknownRoute",
+                                     "message":
+                                         "worker hosts routes %s, not %r"
+                                     % (sorted(worker.servers), route)})
+                    return
+                kind = worker.kinds[route]
+                if verb == "predict" and kind == "predict":
+                    status, resp = worker._handle_predict(body, srv=srv)
                     self._json(status, resp)
-                elif self.path == "/v1/generate" \
-                        and worker.kind == "generate":
+                elif verb == "generate" and kind == "generate":
                     # streamed NDJSON: no Content-Length, one JSON line
                     # per token, connection close marks the end
                     self.send_response(200)
@@ -681,23 +869,28 @@ class FleetWorker:
                         self.wfile.flush()
 
                     try:
-                        worker._handle_generate(body, write_line)
+                        worker._handle_generate(body, write_line,
+                                                srv=srv)
                     except OSError:
                         pass      # client went away mid-stream
-                elif self.path in ("/v1/migrate_out", "/v1/migrate_in",
-                                   "/v1/migrate_abort", "/v1/defrag") \
-                        and worker.kind == "generate":
-                    fn = {"/v1/migrate_out": worker._handle_migrate_out,
-                          "/v1/migrate_in": worker._handle_migrate_in,
-                          "/v1/migrate_abort":
-                              worker._handle_migrate_abort,
-                          "/v1/defrag": worker._handle_defrag}[self.path]
-                    status, resp = fn(body)
+                elif verb == "adapter":
+                    status, resp = worker._handle_adapter(body, srv=srv,
+                                                          route=route)
+                    self._json(status, resp)
+                elif verb in ("migrate_out", "migrate_in",
+                              "migrate_abort", "defrag") \
+                        and kind == "generate":
+                    fn = {"migrate_out": worker._handle_migrate_out,
+                          "migrate_in": worker._handle_migrate_in,
+                          "migrate_abort": worker._handle_migrate_abort,
+                          "defrag": worker._handle_defrag}[verb]
+                    status, resp = fn(body, srv=srv)
                     self._json(status, resp)
                 else:
                     self._json(404, {"error": "NotFound",
-                                     "message": "no %s on a %s worker"
-                                     % (self.path, worker.kind)})
+                                     "message":
+                                         "no %s on a %s route (%s)"
+                                     % (verb, kind, route)})
 
             def log_message(self, *a):  # noqa: D102
                 pass
@@ -750,6 +943,33 @@ def demo_generation():
     return GenerationServer(model, params, gcfg)
 
 
+def demo_duo():
+    """Two named routes behind one worker — a generation route with two
+    resident same-shape adapters plus a predict route — the spawn-test
+    topology for multi-route + adapter-hot-swap acceptance.  Returns
+    ``(route_map, adapters)``; ``main()`` unpacks the pair."""
+    import jax
+
+    from .generation import GenerationConfig, GenerationServer
+    from .models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_len=64,
+                            dtype="float32", remat=False)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    gcfg = GenerationConfig(page_size=8, max_pages=64, max_slots=4,
+                            max_new_tokens=16)
+    gen = GenerationServer(model, params, gcfg)
+    # "alt" is a lazily-built second adapter with identical tree/shape/
+    # dtype — different weights, zero recompiles on swap
+    adapters = {"gen@v1": {
+        "base": params,
+        "alt": lambda: model.init(jax.random.PRNGKey(1)),
+    }}
+    return {"gen@v1": gen, "fc@v1": demo_model()}, adapters
+
+
 def _resolve_builder(spec):
     """``module:function`` -> the zero-arg server factory."""
     import importlib
@@ -769,7 +989,13 @@ def main(argv=None):
                     help="replica id to register under")
     ap.add_argument("--builder",
                     default="mxnet_tpu.fleet_worker:demo_model",
-                    help="module:function returning the server to host")
+                    help="module:function returning the server to host "
+                         "— or a {route: server} map, or a (map, "
+                         "adapters) pair (e.g. %(prog)s:demo_duo)")
+    ap.add_argument("--route", action="append", default=[],
+                    metavar="NAME=MODULE:FN",
+                    help="host MODULE:FN's server under route NAME "
+                         "(repeatable; overrides --builder)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--heartbeat-s", type=float, default=None)
@@ -778,12 +1004,24 @@ def main(argv=None):
 
     from .fleet import ServiceRegistry
 
-    server = _resolve_builder(args.builder)()
+    adapters = None
+    if args.route:
+        server = {}
+        for item in args.route:
+            name, eq, spec = item.partition("=")
+            if not eq:
+                ap.error("--route wants NAME=MODULE:FN, got %r" % item)
+            server[name] = _resolve_builder(spec)()
+    else:
+        server = _resolve_builder(args.builder)()
+        if isinstance(server, tuple):
+            server, adapters = server
     registry = ServiceRegistry(addr=args.registry, service=args.service,
                                ttl_s=args.ttl_s)
     worker = FleetWorker(server, args.rid, registry=registry,
                          host=args.host, port=args.port,
-                         heartbeat_s=args.heartbeat_s)
+                         heartbeat_s=args.heartbeat_s,
+                         adapters=adapters)
     worker.install_drain()
     worker.run()                    # returns only via the rc-76 exit
     raise SystemExit("fleet worker run loop ended without drain")

@@ -265,9 +265,34 @@ class Gateway:
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + delta
 
-    def _pick(self, session=None, exclude=()):
+    @staticmethod
+    def _rep_routes(rep):
+        """A worker's advertised route map; pre-route workers advertise
+        nothing, so they implicitly host route "default" of their kind
+        (kind ``None`` when they don't advertise that either — a legacy
+        worker that matches any verb)."""
+        return rep.get("routes") or {"default": rep.get("kind")}
+
+    def _route_known(self, route, kind=None):
+        """True when ANY worker in the view (healthy or not) advertises
+        ``route`` — distinguishes the typed 404 ``UnknownRoute`` (no
+        such model anywhere; retrying cannot help) from the capacity 503
+        ``Unavailable`` (the route exists, its workers are down)."""
+        view = self._view
+        if view is None:
+            return False
+        for rep in view.replicas.values():
+            routes = self._rep_routes(rep)
+            if route in routes and (kind is None
+                                    or routes[route] in (None, kind)):
+                return True
+        return False
+
+    def _pick(self, session=None, exclude=(), route=None, kind=None):
         """(rid, addr) of the routing choice, or None when no live
-        candidate exists."""
+        candidate exists.  With ``route``/``kind`` set, only workers
+        advertising that named model route (of that kind) are
+        candidates — the (route, load, affinity) routing contract."""
         view = self._view
         if view is None:
             return None
@@ -285,6 +310,13 @@ class Gateway:
                 continue
             if rep.get("state") not in (None, "SERVING"):
                 continue
+            if route is not None:
+                routes = self._rep_routes(rep)
+                if route not in routes:
+                    continue
+                if (kind is not None
+                        and routes[route] not in (None, kind)):
+                    continue
             cands.append((rep.get("inflight", 0) + local.get(rid, 0),
                           rid, addr))
         if not cands:
@@ -315,14 +347,29 @@ class Gateway:
         return conn
 
     # -- predict path ------------------------------------------------------
-    def _forward_predict(self, payload, t0):
+    @staticmethod
+    def _verb_path(route, verb):
+        """Worker-side path for (route, verb); the bare legacy path for
+        route "default" so pre-route workers keep serving."""
+        if route in (None, "default"):
+            return "/v1/%s" % verb
+        return "/v1/%s/%s" % (route, verb)
+
+    def _forward_predict(self, payload, t0, route="default"):
         """(status, body_bytes, rid, stale) — exactly one terminal
         outcome; retries idempotent work across workers."""
         excluded = []
         attempt = 0
         while True:
-            picked = self._pick(exclude=excluded)
+            picked = self._pick(exclude=excluded, route=route,
+                                kind="predict")
             if picked is None:
+                if self._view is not None and self._view.replicas \
+                        and not self._route_known(route, "predict"):
+                    return 404, json.dumps(
+                        {"error": "UnknownRoute",
+                         "message": "no worker advertises route %r"
+                         % route}).encode(), None
                 return 503, json.dumps(
                     {"error": "Unavailable",
                      "message": "no live worker (tried %s)"
@@ -330,7 +377,9 @@ class Gateway:
             rid, addr = picked
             self._track(rid, 1)
             try:
-                conn = self._connect(addr, "/v1/predict", payload, t0)
+                conn = self._connect(addr,
+                                     self._verb_path(route, "predict"),
+                                     payload, t0)
                 resp = conn.getresponse()
                 data = resp.read()
                 status = resp.status
@@ -356,17 +405,26 @@ class Gateway:
                 self._track(rid, -1)
             if status in (429, 503) and attempt < self.retries \
                     and len(self._view.replicas) > len(excluded) + 1:
-                # shed/draining on that worker: spill to a sibling
-                excluded.append(rid)
-                attempt += 1
-                with self._lock:
-                    self.retried += 1
-                _count("gateway_retries")
-                continue
+                # shed/draining on that worker: spill to a sibling —
+                # EXCEPT a per-tenant QuotaExceeded, which every sibling
+                # would return identically (the governor's verdict is
+                # deterministic per tenant, not per replica): spilling
+                # it would just multiply the flooder's offered load
+                try:
+                    err = json.loads(data or b"{}").get("error")
+                except ValueError:
+                    err = None
+                if err != "QuotaExceeded":
+                    excluded.append(rid)
+                    attempt += 1
+                    with self._lock:
+                        self.retried += 1
+                    _count("gateway_retries")
+                    continue
             return status, data, rid
 
     # -- generate path (streamed) ------------------------------------------
-    def _forward_generate(self, body, write_line, t0):
+    def _forward_generate(self, body, write_line, t0, route="default"):
         """Stream one generation request; the last line written is the
         one typed terminal outcome.
 
@@ -391,12 +449,18 @@ class Gateway:
         delivered = []      # journal: token values already written
         _leakcheck.track("journal", id(delivered))
         try:
-            self._stream_generate(body, write_line, t0, delivered)
+            self._stream_generate(body, write_line, t0, delivered,
+                                  route=route)
         finally:
             _leakcheck.untrack("journal", id(delivered))
 
-    def _stream_generate(self, body, write_line, t0, delivered):
+    def _stream_generate(self, body, write_line, t0, delivered,
+                         route="default"):
         session = body.get("session")
+        if session and route not in (None, "default"):
+            # affinity is per named route: the same client session may
+            # stream against several models without cross-pinning
+            session = "%s|%s" % (route, session)
         excluded = []
         attempt = 0
         losses = 0          # mid-stream worker deaths for this request
@@ -414,7 +478,8 @@ class Gateway:
                 pending = None
                 picked = (rid, addr)
             else:
-                picked = self._pick(session=session, exclude=excluded)
+                picked = self._pick(session=session, exclude=excluded,
+                                    route=route, kind="generate")
             if picked is None:
                 if delivered:
                     with self._lock:
@@ -424,6 +489,11 @@ class Gateway:
                                 "message": "no live worker to resume "
                                 "after %d token(s) (tried %s)"
                                 % (len(delivered), excluded or "none")})
+                elif self._view is not None and self._view.replicas \
+                        and not self._route_known(route, "generate"):
+                    write_line({"error": "UnknownRoute",
+                                "message": "no worker advertises route "
+                                "%r" % route})
                 else:
                     write_line({"error": "Unavailable",
                                 "message": "no live worker (tried %s)"
@@ -455,7 +525,9 @@ class Gateway:
             self._track(rid, 1)
             streamed = 0
             try:
-                conn = self._connect(addr, "/v1/generate", payload, t0)
+                conn = self._connect(addr,
+                                     self._verb_path(route, "generate"),
+                                     payload, t0)
                 resp = conn.getresponse()
                 if resp.status != 200:
                     raise OSError("worker %s: HTTP %d"
@@ -512,7 +584,7 @@ class Gateway:
                     # journal-resume path — never worse than today.
                     excluded.append(rid)
                     moved = self._migrate_stream(addr, line["migrate"],
-                                                 excluded)
+                                                 excluded, route=route)
                     if moved is not None:
                         migrations += 1
                         with self._lock:
@@ -579,7 +651,8 @@ class Gateway:
         finally:
             conn.close()
 
-    def _migrate_stream(self, sender_addr, handle, exclude):
+    def _migrate_stream(self, sender_addr, handle, exclude,
+                        route="default"):
         """Carry one parked stream's KV blob sender -> sibling.
 
         Fetches the versioned blob from the sender's ``/v1/migrate_out``,
@@ -597,14 +670,16 @@ class Gateway:
         with self._lock:
             mseq = self._migrate_seq
             self._migrate_seq += 1
-        target = self._pick(exclude=tuple(exclude))
+        target = self._pick(exclude=tuple(exclude), route=route,
+                            kind="generate")
         if target is None:
             return None
         rid2, addr2 = target
         key = "mig-" + _telemetry.new_trace_id()
         try:
-            status, resp = self._post_json(sender_addr, "/v1/migrate_out",
-                                           {"handle": handle})
+            status, resp = self._post_json(
+                sender_addr, self._verb_path(route, "migrate_out"),
+                {"handle": handle})
             if status != 200 or "blob" not in resp:
                 raise OSError("export of %s failed: HTTP %d %s"
                               % (handle, status, resp.get("error")))
@@ -618,7 +693,7 @@ class Gateway:
                                   "%d/%d chunk(s)" % (i, total))
                 part = blob[i * chunk:(i + 1) * chunk]
                 status, resp = self._post_json(
-                    addr2, "/v1/migrate_in",
+                    addr2, self._verb_path(route, "migrate_in"),
                     {"key": key, "seq": i, "total": total,
                      "data": base64.b64encode(part).decode("ascii")})
                 if status != 200:
@@ -636,7 +711,9 @@ class Gateway:
             try:
                 # frees the receiver's buffer AND any installed-but-
                 # unclaimed import under the same key
-                self._post_json(addr2, "/v1/migrate_abort", {"key": key})
+                self._post_json(addr2,
+                                self._verb_path(route, "migrate_abort"),
+                                {"key": key})
             except OSError:
                 pass          # receiver gone too; its TTL sweep cleans up
             return None
@@ -690,11 +767,39 @@ class Gateway:
                 prio = self.headers.get("X-MXTPU-Priority")
                 if prio:
                     body.setdefault("priority", prio)
-                if self.path == "/v1/predict":
+                # tenant id likewise (X-MXTPU-Tenant): validated at the
+                # front door — a hostile value is a typed 400 BadTenant,
+                # never a handler 500, and never reaches a worker
+                from .tenancy import parse_route, parse_tenant
+
+                try:
+                    body["tenant"] = parse_tenant(
+                        body.get("tenant",
+                                 self.headers.get("X-MXTPU-Tenant")))
+                except ValueError as e:
+                    self._json(400, {"error": "BadTenant",
+                                     "message": str(e)})
+                    return
+                # /v1/<verb> aliases /v1/default/<verb>
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "v1":
+                    route, verb = "default", parts[1]
+                elif len(parts) == 3 and parts[0] == "v1":
+                    route, verb = parts[1], parts[2]
+                else:
+                    self._json(404, {"error": "NotFound"})
+                    return
+                try:
+                    route = parse_route(route)
+                except ValueError as e:
+                    self._json(404, {"error": "UnknownRoute",
+                                     "message": str(e)})
+                    return
+                if verb == "predict":
                     status, data, rid = gw._forward_predict(
-                        json.dumps(body).encode(), t0)
+                        json.dumps(body).encode(), t0, route=route)
                     self._json(status, data)
-                elif self.path == "/v1/generate":
+                elif verb == "generate":
                     # pin a concrete seed: the worker-side default rng is
                     # keyed to per-worker admission order, which a resume
                     # on a different worker cannot replay
@@ -714,7 +819,8 @@ class Gateway:
                         self.wfile.flush()
 
                     try:
-                        gw._forward_generate(body, write_line, t0)
+                        gw._forward_generate(body, write_line, t0,
+                                             route=route)
                     except OSError:
                         pass      # client went away mid-stream
                 else:

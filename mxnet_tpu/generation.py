@@ -52,9 +52,10 @@ from . import dispatch as _dispatch
 from . import leakcheck as _leakcheck
 from . import profiler as _profiler
 from . import telemetry as _telemetry
+from . import tenancy as _tenancy
 from .serving import (DRAINING, SERVING, STARTING, STOPPED, DeadlineExceeded,
-                      Draining, Overloaded, StreamingFuture, StreamMigrated,
-                      brownout)
+                      Draining, Overloaded, QuotaExceeded, StreamingFuture,
+                      StreamMigrated, brownout)
 
 __all__ = ["GenerationConfig", "PageAllocator", "GenerationEngine",
            "GenerationServer", "parse_priority", "pack_kv_blob",
@@ -120,34 +121,60 @@ def _pick_bucket(chain, n):
     return chain[-1]
 
 
+# hostile-header hardening for parse_priority: the whole value is
+# length-capped, ranks are digit-capped (a 4000-digit "rank" must not
+# become a bignum that outranks everything), and class names are
+# sanitized to the counter-safe charset before they mint
+# `gen.admitted_by_class.<name>` telemetry keys
+_PRIO_MAX_LEN = 256
+_PRIO_RANK_DIGITS = 9
+_PRIO_NAME_MAX = 32
+_PRIO_NAME_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def _prio_rank_of(tail):
+    tail = tail.strip()
+    body = tail[1:] if tail[:1] in ("+", "-") else tail
+    if not body.isdigit() or len(body) > _PRIO_RANK_DIGITS:
+        return None
+    return int(tail)
+
+
+def _prio_name_of(name):
+    name = name.strip()
+    if (not name or len(name) > _PRIO_NAME_MAX
+            or not set(name) <= _PRIO_NAME_CHARS):
+        return "default"
+    return name
+
+
 def parse_priority(value):
     """Normalize a request priority into ``(class_name, rank)``.
 
     Higher rank = more important.  Accepted shapes: ``None`` (the default
     class, rank 0), a bare int rank, a ``"name=rank"`` string (the
     ``X-MXTPU-Priority`` wire form, docs/SHARDED_SERVING.md), a bare
-    numeric string, or a bare class name (rank 0).  Malformed ranks fall
-    back to 0 rather than failing admission."""
+    numeric string, or a bare class name (rank 0).  Malformed or hostile
+    values — oversized headers, junk/oversized ranks, class names outside
+    ``[A-Za-z0-9._-]`` — degrade to the default class/rank 0 rather than
+    failing admission (a bad QoS hint must never 500 a request)."""
     if value is None:
         return ("default", 0)
     if isinstance(value, (int, np.integer)):
         r = int(value)
         return ("p%d" % r, r)
     s = str(value).strip()
-    if not s:
+    if not s or len(s) > _PRIO_MAX_LEN:
         return ("default", 0)
     if "=" in s:
         name, _, tail = s.partition("=")
-        try:
-            rank = int(tail.strip())
-        except ValueError:
-            rank = 0
-        return (name.strip() or "default", rank)
-    try:
-        r = int(s)
+        rank = _prio_rank_of(tail)
+        return (_prio_name_of(name), 0 if rank is None else rank)
+    r = _prio_rank_of(s)
+    if r is not None:
         return ("p%d" % r, r)
-    except ValueError:
-        return (s, 0)
+    return (_prio_name_of(s), 0)
 
 
 def _sample_token(logits, temperature, top_k, rng):
@@ -372,10 +399,10 @@ class _PendingReq:
     which requeues on page exhaustion instead of shedding."""
 
     __slots__ = ("fut", "tokens", "max_new", "sampling", "prio_name",
-                 "prio_rank", "start_new", "patient")
+                 "prio_rank", "start_new", "patient", "tenant")
 
     def __init__(self, fut, tokens, max_new, sampling, prio_name,
-                 prio_rank, start_new=0, patient=False):
+                 prio_rank, start_new=0, patient=False, tenant="anon"):
         self.fut = fut
         self.tokens = tokens
         self.max_new = max_new
@@ -384,6 +411,7 @@ class _PendingReq:
         self.prio_rank = prio_rank
         self.start_new = start_new
         self.patient = patient
+        self.tenant = tenant
 
 
 class _Seq:
@@ -392,11 +420,11 @@ class _Seq:
     __slots__ = ("fut", "table", "n_pages", "length", "last_token",
                  "n_new", "max_new", "prompt_len", "sampling",
                  "prio_name", "prio_rank", "input_tokens", "gen_tokens",
-                 "preempted")
+                 "preempted", "tenant")
 
     def __init__(self, fut, table, n_pages, length, last_token, max_new,
                  prompt_len, sampling, prio_name="default", prio_rank=0,
-                 input_tokens=None, start_new=0):
+                 input_tokens=None, start_new=0, tenant="anon"):
         self.fut = fut
         self.table = table            # np [M] int32, padded with 0
         self.n_pages = n_pages        # leading valid entries of table
@@ -412,6 +440,7 @@ class _Seq:
         self.input_tokens = input_tokens  # np array actually prefilled
         self.gen_tokens = [last_token]    # sampled by THIS incarnation
         self.preempted = False
+        self.tenant = tenant
 
 
 class GenerationEngine:
@@ -597,6 +626,7 @@ class GenerationServer:
             "admitted": 0, "shed_queue": 0, "shed_pages": 0, "ok": 0,
             "deadline_exceeded": 0, "rejected_draining": 0,
             "preempted": 0, "resumed": 0, "shed_brownout": 0,
+            "shed_quota": 0,
             "parked": 0, "migrated_out": 0, "migrated_in": 0,
             "migrate_attached": 0, "migrate_expired": 0,
             "defrag_moved": 0,
@@ -620,10 +650,20 @@ class GenerationServer:
     # -- admission -----------------------------------------------------
     def submit_async(self, prompt, max_new_tokens=None, deadline_ms=None,
                      on_token=None, temperature=None, top_k=None, seed=None,
-                     priority=None, resume_from=None, migrate_handle=None):
+                     priority=None, resume_from=None, migrate_handle=None,
+                     tenant=None):
         """Admit one generation request; returns a
         :class:`~mxnet_tpu.serving.StreamingFuture` or raises the typed
-        admission error (:class:`Overloaded` / :class:`Draining`).
+        admission error (:class:`Overloaded` / :class:`Draining` /
+        :class:`QuotaExceeded`).
+
+        ``tenant`` is the validated ``X-MXTPU-Tenant`` id (see
+        :mod:`mxnet_tpu.tenancy`): admission spends one token from the
+        tenant's bucket and — when the queue is contended — holds each
+        tenant to its weighted-fair share of queue slots, so a flooding
+        tenant sheds typed :class:`QuotaExceeded` while everyone else
+        keeps streaming.  ``exempt`` tenants (paying tiers) bypass the
+        brownout rank gate and token cap, but never quota/fair-share.
 
         ``temperature`` / ``top_k`` / ``seed`` override the config-level
         sampling knobs per request (``temperature <= 0`` = greedy argmax,
@@ -684,8 +724,12 @@ class GenerationServer:
         if top_k < 0:
             raise ValueError("top_k must be >= 0")
         prio_name, prio_rank = parse_priority(priority)
+        tenant = _tenancy.parse_tenant(tenant)
+        gov = _tenancy.governor()
+        exempt = gov.exempt(tenant)
         bo = brownout()
-        max_new = max(bo.cap_max_new(max_new), start_new + 1)
+        if not exempt:
+            max_new = max(bo.cap_max_new(max_new), start_new + 1)
         now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
                           else float(deadline_ms) / 1e3)
@@ -694,9 +738,28 @@ class GenerationServer:
                     or self._state in (DRAINING, STOPPED)):
                 self.stats["rejected_draining"] += 1
                 raise Draining("generation server is draining")
-            if not bo.admits(prio_rank):
+            try:
+                # fair-share sees the live queue composition: how many
+                # slots this tenant already holds, and who else is queued
+                # (the pending deque is queue_cap-bounded, so the scan is
+                # O(max_queue), not O(traffic))
+                gov.check(tenant, now,
+                          queue_len=len(self._pending),
+                          queue_cap=self.max_queue,
+                          tenant_pending=sum(
+                              1 for r in self._pending
+                              if r.tenant == tenant),
+                          queue_tenants={r.tenant
+                                         for r in self._pending})
+            except QuotaExceeded:
+                self.stats["shed_quota"] += 1
+                _profiler.dispatch_count("gen_quota_shed")
+                _profiler.dispatch_count("gen.shed_by_tenant.%s" % tenant)
+                raise
+            if not exempt and not bo.admits(prio_rank):
                 self.stats["shed_brownout"] += 1
                 _profiler.dispatch_count("gen_brownout_shed")
+                _profiler.dispatch_count("gen.shed_by_tenant.%s" % tenant)
                 raise Overloaded(
                     "brownout level %d admits only priority rank >= %d "
                     "(got %s=%d)" % (bo.level, bo.min_rank, prio_name,
@@ -704,6 +767,7 @@ class GenerationServer:
             if len(self._pending) >= self.max_queue:
                 self.stats["shed_queue"] += 1
                 _profiler.dispatch_count("requests_shed")
+                _profiler.dispatch_count("gen.shed_by_tenant.%s" % tenant)
                 raise Overloaded("generation queue full (%d pending)"
                                  % len(self._pending))
             fut = StreamingFuture({"tokens": tokens}, rows=1,
@@ -715,6 +779,7 @@ class GenerationServer:
                 _profiler.dispatch_count("gen_resumed")
             _profiler.dispatch_count("requests_admitted")
             _profiler.dispatch_count("gen.admitted_by_class.%s" % prio_name)
+            _profiler.dispatch_count("gen.admitted_by_tenant.%s" % tenant)
             _telemetry.trace_begin("request", fut.trace_id, cat="gen",
                                    args={"prompt_len": int(prompt.size),
                                          "max_new": max_new,
@@ -730,7 +795,8 @@ class GenerationServer:
                 rng.random(start_new)
             self._pending.append(_PendingReq(
                 fut, tokens, max_new, (temperature, top_k, rng),
-                prio_name, prio_rank, start_new=start_new))
+                prio_name, prio_rank, start_new=start_new,
+                tenant=tenant))
             self._cv.notify_all()
         return fut
 
@@ -767,6 +833,48 @@ class GenerationServer:
             raise box["error"]
         return box["result"]
 
+    # -- adapter hot-multiplexing (docs/SHARDED_SERVING.md) ------------
+    def swap_params(self, params):
+        """Atomically swap the engine's weights for a same-structure
+        adapter — the generation side of the :meth:`ModelServer.reload
+        <mxnet_tpu.serving.ModelServer>` hot-swap contract.
+
+        The params pytree must match the resident one leaf-for-leaf in
+        structure, shape and dtype; since params are a *traced* argument
+        of the jitted prefill/decode callables, a conforming swap reuses
+        every compiled executable — zero recompiles, proven by the
+        ``recompile`` counter the worker's ``/healthz`` exposes.  The
+        assignment runs on the scheduler thread, between decode steps,
+        so every in-flight stream sees one coherent set of weights per
+        step (tokens sampled before the swap came wholly from the old
+        adapter, after it wholly from the new)."""
+        import jax
+
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.engine.params)
+        if new_def != old_def:
+            raise ValueError("adapter params tree structure differs from "
+                             "the resident model (%s vs %s)"
+                             % (new_def, old_def))
+        for i, (old, new) in enumerate(zip(old_leaves, new_leaves)):
+            os_, ns = tuple(old.shape), tuple(new.shape)
+            od, nd = str(old.dtype), str(new.dtype)
+            if os_ != ns or od != nd:
+                raise ValueError(
+                    "adapter params leaf %d is %s%s, resident model has "
+                    "%s%s — a swap must be shape/dtype-identical to stay "
+                    "recompile-free" % (i, nd, ns, od, os_))
+
+        def _install():
+            self.engine.params = params
+            return True
+
+        self._run_on_scheduler(_install)
+        _profiler.dispatch_count("gen_adapter_swaps")
+        _telemetry.trace_instant("gen.adapter_swap", cat="gen",
+                                 args={"leaves": len(new_leaves)})
+        return True
+
     def _park_seq_locked(self, seq):
         """Evict ``seq`` from the batch but KEEP its pages: record every
         field a receiver needs for bitwise continuation (page table, host
@@ -799,6 +907,7 @@ class GenerationServer:
             "rng": rng,
             "prio_name": seq.prio_name,
             "prio_rank": int(seq.prio_rank),
+            "tenant": seq.tenant,
             "table": seq.table,
             "n_pages": int(seq.n_pages),
             "expires": self.clock.now() + self._park_timeout,
@@ -998,7 +1107,8 @@ class GenerationServer:
                        (rec["temperature"], rec["top_k"], rec["rng"]),
                        prio_name=rec["prio_name"],
                        prio_rank=rec["prio_rank"],
-                       input_tokens=rec["input_tokens"])
+                       input_tokens=rec["input_tokens"],
+                       tenant=rec.get("tenant", "anon"))
             seq.gen_tokens = list(rec["gen_tokens"])
             seq.n_new = len(generated)
             gap = generated[len(delivered):]
@@ -1258,7 +1368,8 @@ class GenerationServer:
             [seq.input_tokens, np.asarray(seq.gen_tokens, np.int32)])
         self._pending.append(_PendingReq(
             seq.fut, tokens, seq.max_new, seq.sampling, seq.prio_name,
-            seq.prio_rank, start_new=seq.n_new, patient=True))
+            seq.prio_rank, start_new=seq.n_new, patient=True,
+            tenant=seq.tenant))
         self.stats["preempted"] += 1
         _profiler.dispatch_count("gen_preempted")
         _telemetry.trace_instant(
@@ -1306,7 +1417,7 @@ class GenerationServer:
         seq = _Seq(fut, table, need, int(tokens.size), tok, max_new,
                    int(tokens.size), sampling, prio_name=req.prio_name,
                    prio_rank=req.prio_rank, input_tokens=tokens,
-                   start_new=req.start_new)
+                   start_new=req.start_new, tenant=req.tenant)
         is_eos = self.cfg.eos_id >= 0 and tok == self.cfg.eos_id
         emitted = False if is_eos else fut._emit(tok)  # EOS never streams
         if (emitted and req.start_new == 0

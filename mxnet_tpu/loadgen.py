@@ -53,7 +53,8 @@ _DEF_TIMEOUT_S = float(os.environ.get("MXTPU_LOADGEN_TIMEOUT_S", "60"))
 # outcome names the serving stack can terminate a request with; anything
 # else surfaces as "UNTYPED:<Name>" so parity tests catch contract leaks
 TYPED_OUTCOMES = ("ok", "Overloaded", "DeadlineExceeded", "Draining",
-                  "Unavailable", "ReplicaLost")
+                  "Unavailable", "ReplicaLost", "QuotaExceeded",
+                  "UnknownRoute")
 
 
 # ---------------------------------------------------------------------------
@@ -76,13 +77,19 @@ class TraceSpec:
     prefix-cache-friendly fraction of traffic); ``session_count > 0``
     assigns requests round-robin-by-sample to sticky sessions (the
     gateway affinity path).
+
+    ``tenants`` is an optional weighted mix of ``{"name", "weight"}``
+    entries; each request samples one tenant by weight and carries it
+    end-to-end (``X-MXTPU-Tenant`` on the gateway wire), feeding the
+    per-tenant quota/fair-share machinery in :mod:`mxnet_tpu.tenancy`.
     """
 
     _FIELDS = ("seed", "arrival", "burst_factor", "burst_dwell_s",
                "segments", "prompt_len_mean", "prompt_len_sigma",
                "prompt_len_max", "output_len_mean", "output_len_sigma",
                "output_len_max", "deadline_classes", "prefix_groups",
-               "prefix_hit_rate", "prefix_len", "session_count")
+               "prefix_hit_rate", "prefix_len", "session_count",
+               "tenants")
 
     def __init__(self, seed=0, arrival="poisson", burst_factor=4.0,
                  burst_dwell_s=2.0, segments=None,
@@ -91,7 +98,8 @@ class TraceSpec:
                  output_len_mean=16, output_len_sigma=0.5,
                  output_len_max=256,
                  deadline_classes=None, prefix_groups=0,
-                 prefix_hit_rate=0.0, prefix_len=8, session_count=0):
+                 prefix_hit_rate=0.0, prefix_len=8, session_count=0,
+                 tenants=None):
         if arrival not in ("poisson", "mmpp"):
             raise ValueError("arrival must be 'poisson' or 'mmpp', got %r"
                              % (arrival,))
@@ -123,6 +131,11 @@ class TraceSpec:
         self.prefix_hit_rate = float(prefix_hit_rate)
         self.prefix_len = int(prefix_len)
         self.session_count = int(session_count)
+        self.tenants = None if not tenants else [dict(t) for t in tenants]
+        if self.tenants is not None and any(
+                not t.get("name") or t.get("weight", 0) <= 0
+                for t in self.tenants):
+            raise ValueError("tenants need a name and positive weight")
 
     @property
     def duration_s(self):
@@ -195,6 +208,11 @@ def generate_trace(spec):
     by_slack = sorted(spec.deadline_classes,
                       key=lambda c: -float(c["deadline_ms"]))
     rank_of = {str(c["name"]): r for r, c in enumerate(by_slack)}
+    tnames, tweights = None, None
+    if spec.tenants:
+        tnames = [str(t["name"]) for t in spec.tenants]
+        tweights = np.asarray([t["weight"] for t in spec.tenants], float)
+        tweights = tweights / tweights.sum()
     reqs = []
     for i, t in enumerate(times):
         plen = int(min(spec.prompt_len_max, max(1, round(
@@ -211,13 +229,17 @@ def generate_trace(spec):
         session = None
         if spec.session_count > 0:
             session = "s%d" % int(rng.integers(spec.session_count))
+        tenant = None
+        if tnames:
+            tenant = tnames[int(rng.choice(len(tnames), p=tweights))]
         name = str(cls["name"])
         reqs.append({"i": i, "t": round(float(t), 6),
                      "prompt_len": plen, "max_new_tokens": olen,
                      "deadline_ms": float(cls["deadline_ms"]),
                      "class": name,
                      "priority": "%s=%d" % (name, rank_of[name]),
-                     "session": session, "prefix_group": group})
+                     "session": session, "prefix_group": group,
+                     "tenant": tenant})
     return reqs
 
 
@@ -280,6 +302,7 @@ def _outcome_record(req, outcome, latency_ms=None, ttft_ms=None,
                     tokens=0, migrated=0):
     return {"kind": "outcome", "i": int(req["i"]),
             "t_offered": float(req["t"]), "class": req.get("class"),
+            "tenant": req.get("tenant"),
             "outcome": str(outcome),
             "latency_ms": None if latency_ms is None
             else round(float(latency_ms), 3),
@@ -387,7 +410,38 @@ class ReplayReport:
         migrated = sum(r.get("migrated", 0) for r in self.records)
         if migrated:
             out["%s_streams_migrated" % prefix] = migrated
+        tenants = self.tenant_summary()
+        if tenants:
+            out["%s_tenants" % prefix] = tenants
         return out
+
+    def tenant_summary(self):
+        """Per-tenant isolation view: request/ok/QuotaExceeded counts
+        plus latency and TTFT p99, keyed by tenant (records without a
+        tenant are skipped).  The noisy-neighbor proof reads exactly
+        this: the flooder's ``shed_quota`` climbs while the victims'
+        ``ttft_p99_ms`` barely moves."""
+        by = {}
+        for r in self.records:
+            t = r.get("tenant")
+            if not t:
+                continue
+            d = by.setdefault(t, {"requests": 0, "ok": 0,
+                                  "shed_quota": 0, "_lat": [],
+                                  "_ttft": []})
+            d["requests"] += 1
+            if r["outcome"] == "ok":
+                d["ok"] += 1
+                if r["latency_ms"] is not None:
+                    d["_lat"].append(r["latency_ms"])
+                if r["ttft_ms"] is not None:
+                    d["_ttft"].append(r["ttft_ms"])
+            elif r["outcome"] == "QuotaExceeded":
+                d["shed_quota"] += 1
+        for d in by.values():
+            d["latency_p99_ms"] = _pctl(d.pop("_lat"), 99)
+            d["ttft_p99_ms"] = _pctl(d.pop("_ttft"), 99)
+        return by
 
     def write_jsonl(self, path, bucket_s=1.0):
         """Emit the replay as bench-leg JSONL: one line per outcome
@@ -455,7 +509,8 @@ def generation_target(server, vocab=None, seed=0, timeout_s=None):
                 prompt_tokens(req, vocab=vocab, seed=seed),
                 max_new_tokens=req["max_new_tokens"],
                 deadline_ms=req["deadline_ms"],
-                priority=req.get("priority") or req.get("class"))
+                priority=req.get("priority") or req.get("class"),
+                tenant=req.get("tenant"))
             for _ in fut.tokens(timeout=timeout_s):
                 n_tok += 1
         except Exception as e:   # noqa: BLE001 — typed below
@@ -471,12 +526,15 @@ def generation_target(server, vocab=None, seed=0, timeout_s=None):
 
 
 def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
-                   seed=0, timeout_s=None):
+                   seed=0, timeout_s=None, route=None):
     """Adapter over the PR 11 HTTP front door at ``addr``
     (``host:port``).  ``kind='predict'`` POSTs ``input_fn(req)`` (JSON
     arrays) to ``/v1/predict``; ``kind='generate'`` streams
     ``/v1/generate`` NDJSON, mapping the terminal line to the typed
-    outcome.  Sticky sessions from the trace ride along."""
+    outcome.  ``route`` targets a named model route
+    (``/v1/<route>/<verb>``, e.g. ``gen@v1``) instead of the bare
+    default-route alias.  Sticky sessions — and each request's tenant
+    (``X-MXTPU-Tenant``) — from the trace ride along."""
     import http.client
 
     if kind not in ("predict", "generate"):
@@ -485,6 +543,7 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
         raise ValueError("predict replay needs input_fn(req) -> feed")
     timeout_s = _DEF_TIMEOUT_S if timeout_s is None else float(timeout_s)
     host, _, port = str(addr).rpartition(":")
+    prefix = "/v1" if route in (None, "default") else "/v1/%s" % route
 
     def call(req):
         t0 = time.monotonic()
@@ -495,10 +554,12 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
                 body = {"inputs": {k: np.asarray(v).tolist()
                                    for k, v in input_fn(req).items()},
                         "deadline_ms": req["deadline_ms"]}
-                conn.request("POST", "/v1/predict",
+                headers = {"Content-Type": "application/json"}
+                if req.get("tenant"):
+                    headers["X-MXTPU-Tenant"] = str(req["tenant"])
+                conn.request("POST", prefix + "/predict",
                              body=json.dumps(body).encode(),
-                             headers={"Content-Type":
-                                      "application/json"})
+                             headers=headers)
                 resp = conn.getresponse()
                 payload = json.loads(resp.read() or b"{}")
                 lat = (time.monotonic() - t0) * 1e3
@@ -517,7 +578,9 @@ def gateway_target(addr, kind="predict", input_fn=None, vocab=1000,
             prio = req.get("priority") or req.get("class")
             if prio:
                 headers["X-MXTPU-Priority"] = str(prio)
-            conn.request("POST", "/v1/generate",
+            if req.get("tenant"):
+                headers["X-MXTPU-Tenant"] = str(req["tenant"])
+            conn.request("POST", prefix + "/generate",
                          body=json.dumps(body).encode(),
                          headers=headers)
             resp = conn.getresponse()
@@ -570,7 +633,14 @@ def replay(trace, target, speed=1.0, max_inflight=None, name="loadreplay",
     thread (bounded by ``max_inflight``) so slow outcomes never stall
     the arrival process — exactly like independent clients.
 
+    An armed ``tenant_flood@n`` chaos hook fires at trace slot ``n``:
+    the triggering request's tenant bursts ``factor``-fold at that
+    instant (ghost duplicates appended after the trace's own records) —
+    the noisy-neighbor injection the isolation proof replays against.
+
     Returns a :class:`ReplayReport`; ``records[i]`` is trace order."""
+    from . import chaos as _chaos
+
     clk = _clockmod.resolve(clock)
     speed = float(speed)
     if speed <= 0:
@@ -600,11 +670,22 @@ def replay(trace, target, speed=1.0, max_inflight=None, name="loadreplay",
                 if dt <= 0:
                     break
                 clk.sleep(min(dt, 0.05))
-        sem.acquire()
-        th = threading.Thread(target=run_one, args=(slot, req),
-                              name="loadgen-%d" % slot, daemon=True)
-        th.start()
-        threads.append(th)
+        burst = [(slot, req)]
+        factor = _chaos.tenant_flood(slot)
+        if factor > 1:
+            for _ in range(factor - 1):
+                ghost = dict(req)
+                ghost["i"] = len(records)
+                ghost["session"] = None
+                ghost["ghost"] = 1
+                records.append(None)
+                burst.append((ghost["i"], ghost))
+        for gslot, greq in burst:
+            sem.acquire()
+            th = threading.Thread(target=run_one, args=(gslot, greq),
+                                  name="loadgen-%d" % gslot, daemon=True)
+            th.start()
+            threads.append(th)
     for th in threads:
         th.join()
     return ReplayReport(records, wall_s=clk.now() - t0, speed=speed,

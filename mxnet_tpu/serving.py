@@ -77,7 +77,7 @@ from .async_kv import backoff_delay as _backoff_delay
 __all__ = ["ModelServer", "Replica", "CircuitBreaker", "ServingFuture",
            "StreamingFuture", "BrownoutController", "brownout",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
-           "Unavailable", "ReplicaLost",
+           "Unavailable", "ReplicaLost", "QuotaExceeded", "UnknownRoute",
            "STARTING", "SERVING", "DEGRADED", "DRAINING", "STOPPED"]
 
 # -- lifecycle states -------------------------------------------------------
@@ -159,6 +159,24 @@ class ReplicaLost(ServingError):
     this error is the >= 2-failure fallback — the resumed incarnation
     died too, or no healthy sibling existed (gateway failover contract,
     docs/SHARDED_SERVING.md "Failure matrix")."""
+
+
+class QuotaExceeded(ServingError):
+    """The request's *tenant* is over its admission quota (empty token
+    bucket) or weighted-fair queue share (docs/SHARDED_SERVING.md
+    "Multi-tenant serving").  Deliberately distinct from
+    :class:`Overloaded`: it is the flooding tenant's own typed outcome,
+    the gateway never spills it to a sibling replica (every replica
+    shares the same per-tenant verdict), and it does not feed the
+    supervisor's shed-rate breach bit — one tenant's flood must not
+    trigger fleet-wide brownout or autoscaling panic."""
+
+
+class UnknownRoute(ServingError):
+    """No worker in the fleet advertises the named model route
+    (``POST /v1/<route>/...``).  A client-side 404, not a capacity
+    signal: retrying elsewhere cannot help, so the gateway returns it
+    without spilling."""
 
 
 class StreamMigrated(ServingError):
@@ -741,7 +759,7 @@ class ModelServer:
         self._preemption = None
         self.stats = {
             "queue_depth_peak": 0, "admitted": 0, "shed": 0,
-            "shed_brownout": 0,
+            "shed_brownout": 0, "shed_quota": 0,
             "rejected_draining": 0, "ok": 0, "deadline_exceeded": 0,
             "unavailable": 0, "batches_full": 0, "batches_timer": 0,
             "batches_deadline": 0, "hedges_fired": 0, "hedge_wins": 0,
@@ -879,12 +897,16 @@ class ModelServer:
         with self._cv:
             return self._queue_depth_locked()
 
-    def submit_async(self, inputs, deadline_ms=None, priority=None):
+    def submit_async(self, inputs, deadline_ms=None, priority=None,
+                     tenant=None):
         """Admit one request; returns a :class:`ServingFuture`.  Raises
-        :class:`Overloaded` / :class:`Draining` at admission time.
-        ``priority`` is a QoS rank (int, or the ``"name=rank"`` wire
-        form); at brownout level 3 only ranks at or above
-        ``MXTPU_BROWNOUT_MIN_RANK`` are admitted."""
+        :class:`Overloaded` / :class:`Draining` / :class:`QuotaExceeded`
+        at admission time.  ``priority`` is a QoS rank (int, or the
+        ``"name=rank"`` wire form); at brownout level 3 only ranks at or
+        above ``MXTPU_BROWNOUT_MIN_RANK`` are admitted.  ``tenant`` is
+        the validated tenant id (``X-MXTPU-Tenant``): it spends one
+        token from the tenant's bucket, and ``exempt`` tenants bypass
+        the brownout rank gate."""
         feed = {}
         rows = None
         for name, arr in dict(inputs).items():
@@ -920,6 +942,11 @@ class ModelServer:
                 rank = int(tail.strip())
             except ValueError:
                 rank = 0
+        from . import tenancy as _tenancy
+
+        tenant = _tenancy.parse_tenant(tenant)
+        gov = _tenancy.governor()
+        exempt = gov.exempt(tenant)
         bo = brownout()
         now = self.clock.now()
         deadline = now + (self.default_deadline if deadline_ms is None
@@ -931,7 +958,14 @@ class ModelServer:
                 raise Draining("server is %s: not admitting requests"
                                % (DRAINING if self._state != STOPPED
                                   else STOPPED))
-            if not bo.admits(rank):
+            try:
+                gov.check(tenant, now)
+            except QuotaExceeded:
+                self.stats["shed_quota"] += 1
+                _count("requests_shed_quota")
+                _count("requests_shed_by_tenant.%s" % tenant)
+                raise
+            if not exempt and not bo.admits(rank):
                 # metered separately from "shed": deliberate degradation
                 # must not feed the supervisor's shed-rate breach bit, or
                 # the ladder would latch itself at level 3
@@ -952,6 +986,8 @@ class ModelServer:
             self._pending.append(req)
             self.stats["admitted"] += 1
             _count("requests_admitted")
+            if tenant != "anon":
+                _count("requests_admitted_by_tenant.%s" % tenant)
             _telemetry.trace_begin("request", req.trace_id,
                                    args={"rows": rows,
                                          "deadline_ms": round(
@@ -962,10 +998,12 @@ class ModelServer:
             self._cv.notify_all()
         return req
 
-    def submit(self, inputs, deadline_ms=None, timeout=None):
+    def submit(self, inputs, deadline_ms=None, timeout=None,
+               priority=None, tenant=None):
         """Synchronous :meth:`submit_async`: the output list, or the
         typed :class:`ServingError` raised."""
-        fut = self.submit_async(inputs, deadline_ms=deadline_ms)
+        fut = self.submit_async(inputs, deadline_ms=deadline_ms,
+                                priority=priority, tenant=tenant)
         if timeout is None:
             timeout = (fut.deadline - self.clock.now()) + 30.0
         return fut.result(timeout=timeout)

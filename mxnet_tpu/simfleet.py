@@ -47,6 +47,7 @@ from . import clock as _clockmod
 from . import debug as _debug
 from . import loadgen as _loadgen
 from . import serving as _serving
+from . import tenancy as _tenancy
 from .fleet import FleetSupervisor, ServiceRegistry, cost_model
 from .gateway import Gateway
 
@@ -181,8 +182,8 @@ class SimServer:
         self.replicas = {}           # rid -> _SimReplica (insertion order)
         self._seq = 0
         self.stats = {"admitted": 0, "shed": 0, "shed_brownout": 0,
-                      "ok": 0, "deadline_exceeded": 0, "replica_lost": 0,
-                      "unavailable": 0, "migrated": 0}
+                      "shed_quota": 0, "ok": 0, "deadline_exceeded": 0,
+                      "replica_lost": 0, "unavailable": 0, "migrated": 0}
         for _ in range(int(initial_replicas)):
             self.add_replica(instant=instant_start)
 
@@ -244,9 +245,16 @@ class SimFleet:
     — ``gateway_partition@N`` fails the gateway's Nth registry refresh
     (see :func:`partition_window`), ``worker_kill@N`` hard-kills a
     replica on the Nth sim tick, exactly like the WorkerSupervisor's
-    kill hook, and ``drain_migrate@N`` rc-76-drains the busiest replica
+    kill hook, ``drain_migrate@N`` rc-76-drains the busiest replica
     with the :attr:`migrate_on_drain` policy deciding whether its
-    streams live-migrate or die (the drain-storm A/B)."""
+    streams live-migrate or die (the drain-storm A/B), and
+    ``tenant_flood@N`` bursts the Nth arrival's tenant factor-fold
+    through the real per-tenant quota gate (the noisy-neighbor A/B).
+
+    ``predict=True`` turns on the supervisor's predictive scale-up
+    (EWMA queue-depth slope); ``supervisor["scaleup_lags_ms"]`` in the
+    result is the reactive-vs-predictive figure of merit on the same
+    seeded trace."""
 
     def __init__(self, trace, initial_replicas=4, max_replicas=None,
                  slots=None, queue_cap=None, costs=None, seed=0,
@@ -254,7 +262,9 @@ class SimFleet:
                  refresh_s=0.5, suspect_s=1.0, retries=2,
                  autoscale=True, shed_up=0.05, cooldown_s=2.0,
                  breach_ticks=2, idle_down_s=30.0, service="sim",
-                 migrate_on_drain=True, migrate_cost_s=0.05):
+                 migrate_on_drain=True, migrate_cost_s=0.05,
+                 predict=None, predict_alpha=None,
+                 predict_horizon_s=None, predict_depth_up=None):
         self.trace = sorted(trace, key=lambda r: (r["t"], r["i"]))
         self.clock = _clockmod.SimClock()
         self.rng = np.random.default_rng(int(seed))
@@ -278,6 +288,9 @@ class SimFleet:
             shed_up=shed_up, p99_up_ms=0.0, idle_down_s=idle_down_s,
             cooldown_s=cooldown_s, breach_ticks=breach_ticks,
             heartbeat_s=heartbeat_s, interval_s=interval_s,
+            predict=predict, predict_alpha=predict_alpha,
+            predict_horizon_s=predict_horizon_s,
+            predict_depth_up=predict_depth_up,
             start=False, clock=self.clock)
         # offline gateway: no threads, no listener traffic — only the
         # production routing policy (_pick), suspect windows, and the
@@ -287,6 +300,10 @@ class SimFleet:
                                suspect_s=suspect_s, start=False,
                                clock=self.clock)
         self.records = [None] * len(self.trace)
+        # the live request list: trace order, plus any chaos ghosts
+        # (tenant_flood duplicates) appended mid-run — records[req["i"]]
+        # is each request's one settlement slot
+        self.reqs = list(self.trace)
         self.incidents = []
         # drain policy sweep (docs/SIMULATION.md): with migrate_on_drain
         # a drained replica's in-flight streams transfer to siblings
@@ -322,7 +339,7 @@ class SimFleet:
 
     def snapshot(self):
         return {"sim_now_s": round(self.clock.now(), 3),
-                "settled": self._settled, "total": len(self.trace),
+                "settled": self._settled, "total": len(self.records),
                 "replicas": self.server.num_active_replicas(),
                 "stats": dict(self.server.stats),
                 "gateway_stale": self.gateway.stale,
@@ -343,11 +360,23 @@ class SimFleet:
             return 0
 
     def _route(self, req, now):
+        # the real per-tenant admission gate: token-bucket quota through
+        # the process governor (queue_cap=0 -> fair-share skipped, the
+        # ModelServer treatment).  A flooding tenant sheds typed
+        # QuotaExceeded here and never reaches a replica queue.
+        tenant = req.get("tenant") or "anon"
+        gov = _tenancy.governor()
+        try:
+            gov.check(tenant, now)
+        except _serving.QuotaExceeded:
+            self.server.stats["shed_quota"] += 1
+            self._settle(req, "QuotaExceeded", now)
+            return
         # brownout level 3 (qos_only): the real admission gate — fed by
         # the real FleetSupervisor._tick breach bit — sheds low-rank
         # classes with one typed Overloaded before they reach a replica
         bo = _serving.brownout()
-        if not bo.admits(self._prio_rank(req)):
+        if not gov.exempt(tenant) and not bo.admits(self._prio_rank(req)):
             # metered apart from "shed": a deliberate qos_only rejection
             # must not feed the shed-rate breach bit, or the ladder would
             # hold its own level up and never recover
@@ -556,9 +585,10 @@ class SimFleet:
                 ctx.__exit__(None, None, None)
         now = self.clock.now()
         # drain sweep: anything unsettled at the horizon gets its one
-        # typed outcome (the contract survives even a truncated sim)
-        for i, req in enumerate(self.trace):
-            if self.records[i] is None:
+        # typed outcome (the contract survives even a truncated sim);
+        # reqs covers chaos ghosts appended after the trace's own slots
+        for req in self.reqs:
+            if self.records[int(req["i"])] is None:
                 self._settle(req, "Draining", now)
         report = _loadgen.ReplayReport(self.records, wall_s=now,
                                        speed=float("inf"),
@@ -575,15 +605,15 @@ class SimFleet:
     def _run_steps(self, horizon, wall0, max_wall):
         next_arrival = 0
         n = len(self.trace)
-        while self._settled < n:
+        while self._settled < len(self.records):
             now = self.clock.now()
             if now > horizon:
                 _log("sim horizon %.1fs reached with %d/%d settled"
-                     % (horizon, self._settled, n))
+                     % (horizon, self._settled, len(self.records)))
                 break
             if time.monotonic() - wall0 > max_wall:
                 _log("wall budget %.0fs exhausted with %d/%d settled"
-                     % (max_wall, self._settled, n))
+                     % (max_wall, self._settled, len(self.records)))
                 break
             if _chaos.worker_kill(self._kill_seq):
                 self._kill_replica(now)
@@ -615,7 +645,22 @@ class SimFleet:
                 self._next_refresh = now + self.refresh_s
             while next_arrival < n \
                     and self.trace[next_arrival]["t"] <= now:
-                self._route(self.trace[next_arrival], now)
+                req = self.trace[next_arrival]
+                # noisy-neighbor injection: the triggering arrival's
+                # tenant bursts factor-fold at this instant — ghost
+                # duplicates get fresh record slots so every one still
+                # settles with exactly one typed outcome
+                factor = _chaos.tenant_flood(next_arrival)
+                self._route(req, now)
+                if factor > 1:
+                    for _ in range(factor - 1):
+                        ghost = dict(req)
+                        ghost["i"] = len(self.records)
+                        ghost["session"] = None
+                        ghost["ghost"] = 1
+                        self.records.append(None)
+                        self.reqs.append(ghost)
+                        self._route(ghost, now)
                 next_arrival += 1
             self._step_replicas(now)
             if self.autoscale and now >= self._next_sup:

@@ -16,7 +16,7 @@ import time
 
 import pytest
 
-from mxnet_tpu import loadgen, simfleet
+from mxnet_tpu import loadgen, serving, simfleet
 from mxnet_tpu.clock import Clock, MONOTONIC, SimClock, resolve
 from mxnet_tpu.simfleet import CostModel, SimFleet, partition_window
 
@@ -140,6 +140,10 @@ def test_seeded_replay_twice_identical_curves():
     trace = _trace()
 
     def once():
+        # the brownout ladder is process-global and fed by the real
+        # supervisor breach bit: start each replay from level 0 or the
+        # first run's escalation leaks into the second's admission
+        serving.brownout().reset()
         with SimFleet(trace, initial_replicas=2, max_replicas=8,
                       slots=2, queue_cap=8, seed=1) as fl:
             return fl.run()

@@ -1,0 +1,403 @@
+"""Multi-tenant serving plane tests (ISSUE 19).
+
+Covers the in-process halves of the tentpole:
+
+* hostile-input hardening for ``parse_tenant``/``parse_route`` and the
+  hardened ``parse_priority`` — malformed headers are typed rejections
+  (or default-class degradation), never a raise out of admission;
+* :class:`~mxnet_tpu.tenancy.TenantGovernor` — token-bucket quotas,
+  weighted-fair queue shares, brownout exemptions;
+* the admission gates: a flooding tenant sheds typed ``QuotaExceeded``
+  at :class:`~mxnet_tpu.serving.ModelServer` while other tenants admit;
+* the new chaos kinds (``tenant_flood``, ``adapter_swap_mid_burst``);
+* loadgen's weighted tenant mix + flood ghosts + per-tenant summary;
+* SimFleet: noisy-neighbor isolation (victim TTFT p99 moves < 10%
+  under a quota-contained flood) and the reactive-vs-predictive
+  autoscaling A/B on the same seeded trace.
+
+The cross-process acceptance scenario lives in
+tests/test_tenant_serving.py.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, loadgen, serving, tenancy
+from mxnet_tpu.generation import parse_priority
+from mxnet_tpu.simfleet import SimFleet
+from mxnet_tpu.tenancy import TenantGovernor, TenantSpec
+
+
+@pytest.fixture(autouse=True)
+def _fresh_governor():
+    """Every test starts from an unlimited-by-default governor and
+    leaves the env-derived one behind (mirrors the brownout reset
+    idiom)."""
+    tenancy.reset_governor(TenantGovernor(quotas={}, default_rate=0))
+    yield
+    tenancy.reset_governor()
+
+
+# ---------------------------------------------------------------------------
+# hostile-header hardening
+# ---------------------------------------------------------------------------
+def test_parse_tenant_accepts_sane_names_and_anon():
+    assert tenancy.parse_tenant(None) == "anon"
+    assert tenancy.parse_tenant("") == "anon"
+    assert tenancy.parse_tenant("   ") == "anon"
+    assert tenancy.parse_tenant("gold") == "gold"
+    assert tenancy.parse_tenant("  team-a.prod_2  ") == "team-a.prod_2"
+    assert tenancy.parse_tenant("x" * 64) == "x" * 64
+
+
+@pytest.mark.parametrize("value", [
+    "x" * 65,                       # oversized
+    "a b",                          # embedded space
+    "a/b",                          # path-ish
+    "a\nb",                         # header splitting
+    "a\x00b",                       # NUL
+    "caf\xe9",                      # non-ASCII
+    b"\xff\xfe".decode("latin-1"),  # non-UTF-8 header bytes (latin-1)
+    "<script>",                     # markup junk
+    "gen@v1",                       # '@' is a route char, not a tenant
+])
+def test_parse_tenant_rejects_hostile_values_typed(value):
+    # the contract: ValueError (-> typed 400 BadTenant at the HTTP
+    # edge), never any other exception type
+    with pytest.raises(ValueError):
+        tenancy.parse_tenant(value)
+
+
+def test_parse_route_accepts_model_at_version():
+    assert tenancy.parse_route(None) == "default"
+    assert tenancy.parse_route("gen@v1") == "gen@v1"
+    assert tenancy.parse_route("fc") == "fc"
+    for bad in ("", "x" * 65, "a/b", "a b", "caf\xe9", "a\r\nb"):
+        with pytest.raises(ValueError):
+            tenancy.parse_route(bad)
+
+
+def test_parse_priority_hostile_values_degrade_never_raise():
+    # sane shapes still parse
+    assert parse_priority(None) == ("default", 0)
+    assert parse_priority("gold=3") == ("gold", 3)
+    assert parse_priority(2) == ("p2", 2)
+    assert parse_priority("7") == ("p7", 7)
+    assert parse_priority("batch") == ("batch", 0)
+    # oversized header value -> default class, rank 0
+    assert parse_priority("x" * 300) == ("default", 0)
+    # junk / oversized ranks -> rank 0, name kept when it is sane
+    assert parse_priority("gold=abc") == ("gold", 0)
+    assert parse_priority("gold=" + "9" * 20) == ("gold", 0)
+    assert parse_priority("gold=1e9") == ("gold", 0)
+    # hostile class names -> default, rank kept when it is sane
+    assert parse_priority("<script>=1") == ("default", 1)
+    assert parse_priority("a b=2") == ("default", 2)
+    assert parse_priority("x" * 33 + "=3") == ("default", 3)
+    # a corpus of junk must never escape as an exception
+    for junk in ("=", "==", "=1=2", "\x00", "caf\xe9=1", " ",
+                 "-" * 256, "a=" + "\xff" * 10, "9" * 256, "--3",
+                 b"\xff\xfe".decode("latin-1")):
+        name, rank = parse_priority(junk)
+        assert isinstance(name, str) and isinstance(rank, int)
+
+
+# ---------------------------------------------------------------------------
+# TenantGovernor
+# ---------------------------------------------------------------------------
+def test_token_bucket_sheds_then_refills():
+    gov = TenantGovernor(quotas={"t": TenantSpec("t", rate=1, burst=2)})
+    gov.check("t", 0.0)
+    gov.check("t", 0.0)
+    with pytest.raises(serving.QuotaExceeded):
+        gov.check("t", 0.0)
+    # one token refilled after one second at rate=1
+    gov.check("t", 1.05)
+    with pytest.raises(serving.QuotaExceeded):
+        gov.check("t", 1.05)
+    snap = gov.snapshot()
+    assert snap["shed_quota"] == 2 and snap["admitted"] == 3
+
+
+def test_unlisted_tenants_unlimited_by_default():
+    gov = TenantGovernor(quotas={}, default_rate=0)
+    for _ in range(200):
+        gov.check("whoever", 0.0)
+    assert gov.snapshot()["shed_quota"] == 0
+
+
+def test_weighted_fair_share_only_under_contention():
+    gov = TenantGovernor(
+        quotas={"hog": TenantSpec("hog", weight=1),
+                "vip": TenantSpec("vip", weight=3)}, fair_frac=0.5)
+    # uncontended queue: no fair-share enforcement at all
+    gov.check("hog", 0.0, queue_len=2, queue_cap=16, tenant_pending=2,
+              queue_tenants={"hog"})
+    # contended: hog's share of 16 slots vs vip is 1/4 -> cap 4
+    with pytest.raises(serving.QuotaExceeded):
+        gov.check("hog", 0.0, queue_len=8, queue_cap=16,
+                  tenant_pending=4, queue_tenants={"hog", "vip"})
+    # vip still admits into the same contended queue
+    gov.check("vip", 0.0, queue_len=8, queue_cap=16, tenant_pending=4,
+              queue_tenants={"hog", "vip"})
+    assert gov.snapshot()["shed_share"] == 1
+
+
+def test_fair_share_shed_spends_no_token():
+    gov = TenantGovernor(
+        quotas={"t": TenantSpec("t", rate=10, burst=2, weight=1),
+                "u": TenantSpec("u", weight=1)})
+    with pytest.raises(serving.QuotaExceeded):
+        gov.check("t", 0.0, queue_len=8, queue_cap=8, tenant_pending=8,
+                  queue_tenants={"t", "u"})
+    # the bucket is untouched: both burst tokens still admit
+    gov.check("t", 0.0)
+    gov.check("t", 0.0)
+
+
+def test_exempt_bypasses_brownout_not_quota():
+    gov = TenantGovernor(
+        quotas={"gold": TenantSpec("gold", rate=1, burst=1, exempt=True)})
+    assert gov.exempt("gold") and not gov.exempt("anon")
+    gov.check("gold", 0.0)
+    with pytest.raises(serving.QuotaExceeded):
+        gov.check("gold", 0.0)
+
+
+def test_quota_spec_string_parsing():
+    gov = TenantGovernor(
+        quotas="gold:rate=50,burst=100,weight=4,exempt;free:rate=5")
+    g = gov.spec_for("gold")
+    assert (g.rate, g.burst, g.weight, g.exempt) == (50.0, 100.0, 4.0,
+                                                     True)
+    f = gov.spec_for("free")
+    assert (f.rate, f.burst, f.exempt) == (5.0, 10.0, False)  # 2s burst
+    with pytest.raises(ValueError):
+        TenantGovernor(quotas="bad:nope=1")
+    with pytest.raises(ValueError):
+        TenantGovernor(quotas="s p a c e:rate=1")
+
+
+def test_model_server_sheds_flooding_tenant_only():
+    from mxnet_tpu.fleet_worker import demo_model
+
+    tenancy.reset_governor(TenantGovernor(
+        quotas={"noisy": TenantSpec("noisy", rate=1, burst=2)}))
+    srv = demo_model()
+    try:
+        x = {"data": np.ones((1, 4), np.float32)}
+        shed = 0
+        for _ in range(6):
+            try:
+                srv.submit(x, tenant="noisy", timeout=30)
+            except serving.QuotaExceeded:
+                shed += 1
+        assert shed >= 4                    # burst=2 admits, rest sheds
+        # another tenant is untouched by the noisy one's empty bucket
+        srv.submit(x, tenant="quiet", timeout=30)
+        snap = srv.snapshot()
+        assert snap["shed_quota"] == shed
+    finally:
+        srv.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# chaos kinds
+# ---------------------------------------------------------------------------
+def test_new_chaos_kinds_registered_and_fire_once():
+    assert {"tenant_flood", "adapter_swap_mid_burst"} <= chaos.FAULT_KINDS
+    with chaos.inject("tenant_flood@2,adapter_swap_mid_burst@1") as plan:
+        assert chaos.tenant_flood(0) == 1
+        assert chaos.tenant_flood(2) == 8          # default factor
+        assert chaos.tenant_flood(2) == 1          # consumed
+        assert chaos.tenant_flood(3, factor=4) == 1
+        # no resident adapter -> the fault cannot fire (and is NOT
+        # consumed: it waits for an adapter-bearing beat)
+        assert chaos.adapter_swap_mid_burst(1, 0) is False
+        assert chaos.adapter_swap_mid_burst(1, 2) is True
+        assert chaos.adapter_swap_mid_burst(1, 2) is False
+        assert plan.pending() == []
+    assert chaos.tenant_flood(2) == 1              # no plan armed
+
+
+# ---------------------------------------------------------------------------
+# loadgen: weighted tenant mix + flood ghosts + per-tenant summary
+# ---------------------------------------------------------------------------
+_TENANTS = [{"name": "gold", "weight": 6}, {"name": "free", "weight": 3},
+            {"name": "bulk", "weight": 1}]
+
+
+def test_trace_spec_tenants_round_trip_and_sampling():
+    spec = loadgen.TraceSpec(
+        seed=5, segments=[{"duration_s": 20.0, "rate_rps": 20.0}],
+        tenants=_TENANTS)
+    spec2 = loadgen.TraceSpec.from_dict(spec.as_dict())
+    assert spec2.tenants == spec.tenants
+    t1 = loadgen.generate_trace(spec)
+    t2 = loadgen.generate_trace(spec2)
+    assert [r["tenant"] for r in t1] == [r["tenant"] for r in t2]
+    counts = {}
+    for r in t1:
+        counts[r["tenant"]] = counts.get(r["tenant"], 0) + 1
+    assert set(counts) <= {"gold", "free", "bulk"}
+    assert counts["gold"] > counts["bulk"]         # weights respected
+    with pytest.raises(ValueError):
+        loadgen.TraceSpec(tenants=[{"name": "", "weight": 1}])
+    with pytest.raises(ValueError):
+        loadgen.TraceSpec(tenants=[{"name": "x", "weight": 0}])
+
+
+def test_replay_tenant_flood_injects_ghosts_and_summarizes():
+    spec = loadgen.TraceSpec(
+        seed=1, segments=[{"duration_s": 2.0, "rate_rps": 5.0}],
+        tenants=_TENANTS)
+    trace = loadgen.generate_trace(spec)
+    assert len(trace) >= 4
+
+    def target(req):
+        return loadgen._outcome_record(req, "ok", latency_ms=1.0,
+                                       ttft_ms=1.0)
+
+    with chaos.inject("tenant_flood@2"):
+        rep = loadgen.replay(trace, target, speed=float("inf"))
+    assert len(rep.records) == len(trace) + 7      # factor 8 -> 7 ghosts
+    flooder = trace[2]["tenant"]
+    ts = rep.tenant_summary()
+    assert ts[flooder]["requests"] == \
+        sum(1 for r in trace if r["tenant"] == flooder) + 7
+    assert "QuotaExceeded" in loadgen.TYPED_OUTCOMES
+    assert "UnknownRoute" in loadgen.TYPED_OUTCOMES
+    # every ghost settled: no None slots survive the report filter
+    assert all(r is not None for r in rep.records)
+    assert rep.summary()["loadreplay_tenants"][flooder]["ok"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# SimFleet: noisy-neighbor isolation (< 10% victim TTFT p99 movement)
+# ---------------------------------------------------------------------------
+def _sim_trace(seed=3, rate=25.0, dur=8.0):
+    return loadgen.generate_trace(loadgen.TraceSpec(
+        seed=seed, segments=[{"duration_s": dur, "rate_rps": rate}],
+        tenants=_TENANTS))
+
+
+def _victim_ttft_p99(report, victims=("gold", "free")):
+    ttfts = [r["ttft_ms"] for r in report.records
+             if r["tenant"] in victims and r["outcome"] == "ok"
+             and r["ttft_ms"] is not None]
+    assert ttfts, "victims produced no ok TTFTs"
+    return loadgen._pctl(ttfts, 99)
+
+
+def _flood_steps(trace, tenant="bulk", count=3):
+    idx = [i for i, r in enumerate(trace) if r["tenant"] == tenant]
+    assert len(idx) >= count, "trace has too few %s arrivals" % tenant
+    mid = len(idx) // 2
+    return idx[mid:mid + count]
+
+
+@pytest.mark.chaos
+def test_simfleet_tenant_flood_degrades_only_the_flooder():
+    """ISSUE 19 acceptance (sim half): a quota-contained tenant_flood
+    sheds the flooder with typed QuotaExceeded while the victim
+    tenants' TTFT p99 moves < 10% vs the same seeded trace without the
+    flood."""
+    trace = _sim_trace()
+    steps = _flood_steps(trace)
+    quotas = {"bulk": TenantSpec("bulk", rate=4, burst=8)}
+
+    def run(spec):
+        tenancy.reset_governor(TenantGovernor(quotas=quotas))
+        serving.brownout().reset()
+        with SimFleet(trace, initial_replicas=4, max_replicas=8,
+                      seed=7) as fleet:
+            return fleet.run(chaos_spec=spec, chaos_seed=0)
+
+    base = run(None)
+    flood = run(",".join("tenant_flood@%d" % s for s in steps))
+
+    # the flood really ran: ghosts appended, flooder shed typed quota
+    assert len(flood["report"].records) > len(base["report"].records)
+    assert flood["server"]["shed_quota"] > 0
+    by_tenant = flood["report"].tenant_summary()
+    assert by_tenant["bulk"]["shed_quota"] > 0
+    assert by_tenant["gold"]["shed_quota"] == 0
+    assert by_tenant["free"]["shed_quota"] == 0
+    # the typed-outcome contract holds for every record, ghosts included
+    assert not (set(flood["outcomes"]) - set(loadgen.TYPED_OUTCOMES))
+
+    # noisy-neighbor isolation: victim TTFT p99 moves < 10%
+    p99_base = _victim_ttft_p99(base["report"])
+    p99_flood = _victim_ttft_p99(flood["report"])
+    assert p99_flood <= p99_base * 1.10, \
+        "victim TTFT p99 moved %.1f -> %.1f ms under flood" \
+        % (p99_base, p99_flood)
+
+
+# ---------------------------------------------------------------------------
+# SimFleet: reactive vs predictive autoscaling on the same seeded trace
+# ---------------------------------------------------------------------------
+def _burst_trace(seed=11):
+    return loadgen.generate_trace(loadgen.TraceSpec(
+        seed=seed, segments=[{"duration_s": 3.0, "rate_rps": 2.0},
+                             {"duration_s": 6.0, "rate_rps": 60.0}]))
+
+
+def _scale_run(predict):
+    tenancy.reset_governor(TenantGovernor(quotas={}))
+    serving.brownout().reset()
+    with SimFleet(_burst_trace(), initial_replicas=2, max_replicas=12,
+                  seed=5, predict=predict, predict_horizon_s=4.0,
+                  predict_depth_up=6) as fleet:
+        return fleet.run()
+
+
+def test_predictive_autoscaling_beats_reactive_scaleup_lag():
+    reactive = _scale_run(predict=False)
+    predictive = _scale_run(predict=True)
+
+    r_sup, p_sup = reactive["supervisor"], predictive["supervisor"]
+    assert r_sup["predictive_ups"] == 0
+    assert p_sup["predictive_ups"] >= 1
+    assert p_sup["scaleup_lags_ms"], "predictive run never scaled up"
+    # capacity arrives before (or at) the breach: the predictive run's
+    # best scale-up lag beats reactive's best on the same seeded trace
+    r_lags = r_sup["scaleup_lags_ms"]
+    p_lags = p_sup["scaleup_lags_ms"]
+    assert min(p_lags) == 0.0
+    if r_lags:
+        assert min(p_lags) <= min(r_lags)
+        assert (sum(p_lags) / len(p_lags)) <= (sum(r_lags) / len(r_lags))
+    # both runs keep the typed-outcome contract
+    for res in (reactive, predictive):
+        assert not (set(res["outcomes"]) - set(loadgen.TYPED_OUTCOMES))
+
+
+@pytest.mark.slow
+def test_predictive_sweep_at_scale():
+    """The 200+ replica reactive-vs-predictive sweep (slow tier): same
+    seeded trace, goodput no worse and scale-up lag no worse under
+    prediction."""
+    trace = loadgen.generate_trace(loadgen.TraceSpec(
+        seed=21, segments=[{"duration_s": 5.0, "rate_rps": 40.0},
+                           {"duration_s": 20.0, "rate_rps": 900.0}]))
+
+    def run(predict):
+        tenancy.reset_governor(TenantGovernor(quotas={}))
+        serving.brownout().reset()
+        with SimFleet(trace, initial_replicas=40, max_replicas=220,
+                      seed=9, predict=predict, predict_horizon_s=4.0,
+                      predict_depth_up=32) as fleet:
+            return fleet.run(max_wall_s=240)
+
+    reactive = run(False)
+    predictive = run(True)
+    assert predictive["supervisor"]["predictive_ups"] >= 1
+    ok_r = reactive["outcomes"].get("ok", 0)
+    ok_p = predictive["outcomes"].get("ok", 0)
+    assert ok_p >= ok_r * 0.95
+    r_lags = reactive["supervisor"]["scaleup_lags_ms"]
+    p_lags = predictive["supervisor"]["scaleup_lags_ms"]
+    if r_lags and p_lags:
+        assert min(p_lags) <= min(r_lags)

@@ -1,0 +1,237 @@
+"""ISSUE 19 acceptance: the multi-tenant, multi-route serving plane
+across real processes.
+
+Two spawned workers each host two named routes (``gen@v1`` generate +
+``fc@v1`` predict, the :func:`mxnet_tpu.fleet_worker.demo_duo`
+topology) behind one in-process gateway.  Three tenants (``gold``
+exempt, ``free``, ``bulk`` tightly quota'd) replay the same seeded
+trace twice — once clean, once with a mid-burst ``tenant_flood`` storm
+— while ``adapter_swap_mid_burst`` chaos (armed via ``MXNET_CHAOS`` in
+the worker env) and an explicit ``/v1/gen@v1/adapter`` hot-swap cycle
+the resident adapters under load.
+
+The invariants:
+
+* every request — ghosts included — terminates with exactly one typed
+  outcome (never an UNTYPED/500);
+* the flooding tenant sheds typed ``QuotaExceeded`` while the victim
+  tenants shed nothing and their TTFT p99 barely moves (the strict
+  deterministic < 10% proof is tests/test_tenancy.py's sim variant;
+  here a small absolute slack absorbs wall-clock scheduler noise);
+* hostile tenant headers and unknown/hostile routes are typed 400/404
+  rejections at the front door;
+* adapter hot-swaps ride the atomic hot-swap contract: the worker's
+  process recompile counter is identical before and after (zero
+  recompile, zero reload), asserted across the process boundary.
+"""
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu import chaos, loadgen
+from mxnet_tpu.fleet import ServiceRegistry, WorkerSupervisor
+from mxnet_tpu.gateway import Gateway
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conftest import subprocess_env  # noqa: E402
+
+_QUOTAS = ("gold:rate=500,burst=500,weight=4,exempt;"
+           "free:rate=200,burst=200,weight=2;"
+           "bulk:rate=3,burst=3,weight=1")
+_TENANTS = [{"name": "gold", "weight": 4}, {"name": "free", "weight": 2},
+            {"name": "bulk", "weight": 1}]
+_VICTIMS = ("gold", "free")
+
+
+def _post(addr, path, obj, headers=None, timeout=60):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json", **(headers or {})}
+        conn.request("POST", path, body=json.dumps(obj).encode(),
+                     headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(addr, path, timeout=30):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _wait(cond, timeout, msg):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for %s" % msg)
+
+
+def _worker_argv(registry_addr, rid):
+    return [sys.executable, "-m", "mxnet_tpu.fleet_worker",
+            "--registry", registry_addr, "--service", "tenantaccept",
+            "--rid", rid, "--heartbeat-s", "0.1",
+            "--builder", "mxnet_tpu.fleet_worker:demo_duo"]
+
+
+def _trace(seed=19):
+    return loadgen.generate_trace(loadgen.TraceSpec(
+        seed=seed, segments=[{"duration_s": 6.0, "rate_rps": 8.0}],
+        prompt_len_mean=10, prompt_len_sigma=0.3, prompt_len_max=24,
+        output_len_mean=5, output_len_sigma=0.3, output_len_max=10,
+        tenants=_TENANTS))
+
+
+def _victim_ttft_p99(report):
+    ttfts = [r["ttft_ms"] for r in report.records
+             if r["tenant"] in _VICTIMS and r["outcome"] == "ok"
+             and r["ttft_ms"] is not None]
+    assert ttfts, "victims produced no ok TTFTs"
+    return loadgen._pctl(ttfts, 99)
+
+
+@pytest.mark.chaos
+def test_two_routes_three_tenants_flood_and_adapter_swap():
+    reg = ServiceRegistry(service="tenantaccept", ttl_s=1.0)
+    # adapter_swap_mid_burst is armed inside the worker processes (the
+    # heartbeat loop is its call site); beat 80 lands ~8s after
+    # registration — inside the replay phases on any realistic box
+    env = subprocess_env(MXTPU_TENANT_QUOTAS=_QUOTAS,
+                         MXNET_CHAOS="adapter_swap_mid_burst@80")
+    sup = WorkerSupervisor(
+        {rid: _worker_argv(reg.addr, rid) for rid in ("w0", "w1")},
+        registry=reg, max_restarts=3, backoff=0.05, backoff_cap=0.5,
+        poll_s=0.05, env=env)
+    gw = Gateway(registry=reg, refresh_s=0.1, suspect_s=0.5, retries=2)
+    try:
+        sup.wait_registered(2, timeout=240)     # cold framework import
+        _wait(lambda: gw._view is not None
+              and len(gw._view.replicas) == 2, timeout=30,
+              msg="gateway to see both workers")
+
+        # -- route advertisements reached the gateway's view ----------
+        for rep in gw._view.replicas.values():
+            assert rep["routes"] == {"gen@v1": "generate",
+                                     "fc@v1": "predict"}
+            assert sorted(rep["adapters"]["gen@v1"]) == ["alt", "base"]
+
+        # -- typed front-door rejections -------------------------------
+        x = {"inputs": {"data": [[1.0, 2.0, 3.0, 4.0]]}}
+        status, body = _post(gw.addr, "/v1/fc@v1/predict", x,
+                             headers={"X-MXTPU-Tenant": "gold"},
+                             timeout=120)
+        assert status == 200, body
+        status, body = _post(gw.addr, "/v1/nope@v9/predict", x)
+        assert (status, body["error"]) == (404, "UnknownRoute")
+        status, body = _post(gw.addr, "/v1/" + "x" * 70 + "/predict", x)
+        assert (status, body["error"]) == (404, "UnknownRoute")
+        status, body = _post(gw.addr, "/v1/fc@v1/predict", x,
+                             headers={"X-MXTPU-Tenant": "a b c"})
+        assert (status, body["error"]) == (400, "BadTenant")
+        status, body = _post(gw.addr, "/v1/fc@v1/predict", x,
+                             headers={"X-MXTPU-Tenant": "y" * 100})
+        assert (status, body["error"]) == (400, "BadTenant")
+        # a predict POST against a generate-only route is typed too
+        status, body = _post(gw.addr, "/v1/gen@v1/predict", x)
+        assert status == 404, body
+
+        # -- phase A: clean replay (also warms every prefill bucket) ---
+        trace = _trace()
+        target = loadgen.gateway_target(gw.addr, kind="generate",
+                                        vocab=97, seed=19,
+                                        timeout_s=120, route="gen@v1")
+        base = loadgen.replay(trace, target, speed=2.0, name="base")
+        assert all(r is not None for r in base.records)
+        assert not (set(base.outcome_counts())
+                    - set(loadgen.TYPED_OUTCOMES)), base.outcome_counts()
+        p99_base = _victim_ttft_p99(base)
+
+        # recompile floor after warmup: the flood + swaps must add none
+        recompiles_before = {rid: _get(rep["addr"], "/healthz")[1]
+                             ["recompiles"]
+                             for rid, rep in gw._view.replicas.items()}
+
+        # -- phase B: same trace with a mid-burst tenant_flood storm ---
+        bulk_idx = [i for i, r in enumerate(trace)
+                    if r["tenant"] == "bulk"]
+        assert len(bulk_idx) >= 3, "trace needs bulk arrivals to flood"
+        steps = bulk_idx[len(bulk_idx) // 2:len(bulk_idx) // 2 + 3]
+        spec = ",".join("tenant_flood@%d" % s for s in steps)
+
+        # an explicit adapter hot-swap mid-flood on every worker: the
+        # atomic hot-swap contract, exercised while streams are live
+        swap_results = []
+
+        def swap_all():
+            time.sleep(1.0)                     # into the flood window
+            for rep in list(gw._view.replicas.values()):
+                swap_results.append(_post(rep["addr"],
+                                          "/v1/gen@v1/adapter",
+                                          {"adapter": "alt"},
+                                          timeout=60))
+
+        swapper = threading.Thread(target=swap_all, daemon=True)
+        with chaos.inject(spec):
+            swapper.start()
+            flood = loadgen.replay(trace, target, speed=2.0,
+                                   name="flood")
+        swapper.join(timeout=60)
+        assert not swapper.is_alive()
+
+        # every request (ghosts included) got one typed outcome
+        assert len(flood.records) == len(trace) + 3 * 7
+        assert all(r is not None for r in flood.records)
+        assert not (set(flood.outcome_counts())
+                    - set(loadgen.TYPED_OUTCOMES)), \
+            flood.outcome_counts()
+
+        # the flooder degraded only itself
+        by_tenant = flood.tenant_summary()
+        assert by_tenant["bulk"]["shed_quota"] > 0
+        assert by_tenant["gold"]["shed_quota"] == 0
+        assert by_tenant["free"]["shed_quota"] == 0
+        p99_flood = _victim_ttft_p99(flood)
+        assert p99_flood <= max(p99_base * 1.10, p99_base + 75.0), \
+            "victim TTFT p99 moved %.1f -> %.1f ms under flood" \
+            % (p99_base, p99_flood)
+
+        # the explicit swaps succeeded with zero recompiles, and the
+        # whole storm (flood + swaps) compiled nothing anywhere
+        assert len(swap_results) == 2
+        for status, body in swap_results:
+            assert status == 200, body
+            assert body["adapter"] == "alt"
+            assert body["recompiles_after"] == body["recompiles_before"]
+        for rid, rep in gw._view.replicas.items():
+            _, hz = _get(rep["addr"], "/healthz")
+            assert hz["recompiles"] == recompiles_before[rid], \
+                "worker %s recompiled during the storm" % rid
+
+        # the chaos-armed mid-burst swap fired inside the workers and
+        # the adapter flip is visible in their route advertisements
+        def swaps_seen():
+            view = reg.view().replicas
+            return len(view) == 2 and all(
+                rep.get("adapter_swaps", 0) >= 1 for rep in view.values())
+        _wait(swaps_seen, timeout=60, msg="chaos adapter swap to fire")
+        live = [rep["adapter_live"]["gen@v1"]
+                for rep in reg.view().replicas.values()]
+        assert all(a in ("base", "alt") for a in live)
+    finally:
+        gw.stop()
+        sup.stop(timeout=20.0)
+        reg.close()
